@@ -1,0 +1,29 @@
+"""antidote_tpu — a TPU-native geo-replicated transactional CRDT store.
+
+A from-scratch rebuild of the capabilities of AntidoteDB (reference at
+/root/reference, Erlang/OTP + riak_core): Clock-SI/Cure causally-consistent
+snapshot transactions over an op-based CRDT type system, per-partition
+durable op logs with crash recovery, inter-DC replication with causal
+dependency gating and gap repair, and a gossiped stable-snapshot (GST)
+clock plane.
+
+The design is TPU-first, not a port: the data plane (CRDT materialization,
+vector-clock dominance, GST min-merge, causal gating) runs as batched
+JAX/XLA kernels over dense arrays of keys sharded across a device mesh;
+the control plane (transaction coordination, logging, replication
+transport) is host-side Python/C++.
+"""
+
+import os as _os
+
+import jax as _jax
+
+# Timestamps are int64 microseconds throughout (the reference uses Erlang
+# µs clocks); JAX defaults to 32-bit without this. NOTE: this is a
+# process-global flag — import antidote_tpu before building unrelated JAX
+# arrays, or set ANTIDOTE_TPU_NO_X64=1 and manage dtypes yourself (device
+# kernels are dtype-polymorphic; hot paths can rebase to int32 ticks).
+if not _os.environ.get("ANTIDOTE_TPU_NO_X64"):
+    _jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

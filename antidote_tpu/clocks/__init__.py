@@ -1,0 +1,2 @@
+from antidote_tpu.clocks.vc import VC, ClockDomain, vc_max, vc_min  # noqa: F401
+from antidote_tpu.clocks import dense  # noqa: F401

@@ -1,0 +1,173 @@
+"""Vector clock semantics — host VC and dense JAX kernels must agree.
+
+Golden cases mirror the reference's belongs_to_snapshot EUnit test
+(reference src/materializer.erl:171-193) and the vectorclock dep's
+dominance semantics.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from antidote_tpu.clocks import VC, ClockDomain, dense, vc_max, vc_min
+
+
+def test_vc_basic_dominance():
+    a = VC.from_list([(1, 1), (2, 1)])
+    b = VC.from_list([(1, 2), (2, 2)])
+    assert a.le(b) and not b.le(a)
+    assert b.ge(a) and not a.ge(b)
+    assert a.lt(b) and b.gt(a)
+    assert not a.concurrent(b)
+
+
+def test_vc_missing_entries_are_zero():
+    a = VC.from_list([(1, 3)])
+    b = VC.from_list([(1, 3), (2, 0)])
+    assert a == b
+    assert a.le(b) and a.ge(b)
+    assert VC().le(a)
+    assert a.get_dc(2) == 0
+
+
+def test_vc_concurrent():
+    a = VC.from_list([(1, 2), (2, 1)])
+    b = VC.from_list([(1, 1), (2, 2)])
+    assert a.concurrent(b)
+    assert not a.le(b) and not a.ge(b)
+
+
+def test_vc_join_meet():
+    a = VC.from_list([(1, 2), (2, 1)])
+    b = VC.from_list([(1, 1), (2, 2), (3, 5)])
+    assert a.join(b) == VC.from_list([(1, 2), (2, 2), (3, 5)])
+    # meet: DC 3 missing from a -> 0 -> dropped
+    assert a.meet(b) == VC.from_list([(1, 1), (2, 1)])
+    assert vc_min([a, b]) == a.meet(b)
+    assert vc_max([a, b]) == a.join(b)
+    assert vc_min([]) == VC()
+
+
+def test_vc_all_dots():
+    a = VC.from_list([(1, 2), (2, 2)])
+    b = VC.from_list([(1, 1), (2, 1)])
+    assert a.all_dots_greater(b)
+    assert b.all_dots_smaller(a)
+    # equal in one dot -> neither
+    c = VC.from_list([(1, 2), (2, 1)])
+    assert not c.all_dots_greater(b)
+    assert not c.all_dots_smaller(a)
+
+
+def test_clock_domain_roundtrip():
+    dom = ClockDomain(4)
+    vc = VC.from_list([("dc_b", 7), ("dc_a", 3)])
+    row = dom.to_dense(vc)
+    assert row.dtype == np.int64 and row.shape == (4,)
+    assert dom.from_dense(row) == vc
+    # stable indices
+    assert dom.index_of("dc_b") == 0 and dom.index_of("dc_a") == 1
+    grown = dom.grow(8)
+    assert grown.from_dense(grown.to_dense(vc)) == vc
+    with pytest.raises(ValueError):
+        dom.grow(2)
+
+
+def test_clock_domain_capacity():
+    dom = ClockDomain(2)
+    dom.index_of("a")
+    dom.index_of("b")
+    with pytest.raises(ValueError):
+        dom.index_of("c")
+
+
+def _rows(*rows):
+    return jnp.asarray(np.array(rows, dtype=np.int64))
+
+
+def test_dense_dominance_matches_host():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 4, size=(64, 5))
+    b = rng.integers(0, 4, size=(64, 5))
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+    for i in range(64):
+        va = VC.clean({d: int(a[i, d]) for d in range(5)})
+        vb = VC.clean({d: int(b[i, d]) for d in range(5)})
+        assert bool(dense.le(ja[i], jb[i])) == va.le(vb)
+        assert bool(dense.ge(ja[i], jb[i])) == va.ge(vb)
+        assert bool(dense.lt(ja[i], jb[i])) == va.lt(vb)
+        assert bool(dense.gt(ja[i], jb[i])) == va.gt(vb)
+        assert bool(dense.concurrent(ja[i], jb[i])) == va.concurrent(vb)
+        assert bool(dense.all_dots_greater(ja[i], jb[i])) == va.all_dots_greater(vb)
+
+
+def test_dense_batched_broadcast():
+    ops = _rows([1, 1], [2, 1], [3, 3])
+    snap = _rows([2, 2])[0]
+    np.testing.assert_array_equal(
+        np.asarray(dense.le(ops, snap)), [True, True, False]
+    )
+
+
+def test_dense_min_merge_missing_row():
+    stack = _rows([3, 4], [2, 5])
+    np.testing.assert_array_equal(np.asarray(dense.min_merge(stack)), [2, 4])
+    valid = jnp.asarray([True, False])
+    # invalid row behaves as an all-zero clock (reference
+    # src/stable_time_functions.erl:78-85)
+    np.testing.assert_array_equal(
+        np.asarray(dense.min_merge(stack, valid)), [0, 0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dense.max_merge(stack, valid)), [3, 4]
+    )
+
+
+def test_dense_set_get_dc():
+    vc = _rows([1, 2, 3], [4, 5, 6])
+    dcs = jnp.asarray([0, 2])
+    ts = jnp.asarray([9, 9])
+    out = np.asarray(dense.set_dc(vc, dcs, ts))
+    np.testing.assert_array_equal(out, [[9, 2, 3], [4, 5, 9]])
+    got = np.asarray(dense.get_dc(vc, dcs))
+    np.testing.assert_array_equal(got, [1, 6])
+
+
+def test_belongs_to_snapshot_golden():
+    """Reference src/materializer.erl:173-193 (belongs_to_snapshot_test).
+
+    belongs_to_snapshot_op returns True iff the op is NOT in the snapshot.
+    """
+    dom = ClockDomain(2)
+    d = 2
+    # the op's own snapshot VC in every reference case is [{1,5},{2,5}]
+    op_ss = jnp.asarray(dom.to_dense(VC.from_list([(1, 5), (2, 5)])))
+
+    def check(ss_pairs, op_dc, op_ct):
+        ss = jnp.asarray(dom.to_dense(VC.from_list(ss_pairs)))
+        cvc = dense.commit_vc(op_ss, jnp.asarray(dom.index_of(op_dc)),
+                              jnp.asarray(op_ct))
+        return bool(dense.op_not_in_snapshot(ss, cvc))
+
+    assert check([(1, 1), (2, 1)], 1, 5) is True
+    assert check([(1, 1), (2, 7)], 2, 5) is True
+    assert check([(1, 5), (2, 10)], 1, 5) is False
+    assert check([(1, 5), (2, 10)], 2, 5) is False
+
+
+def test_op_in_read_snapshot_inclusion():
+    """Dense form of the is_op_in_snapshot per-DC fold
+    (reference src/clocksi_materializer.erl:236-258)."""
+    d = 3
+    read = jnp.asarray(np.array([3, 2, 0], dtype=np.int64))
+    commit_vcs = _rows(
+        [3, 2, 0],   # equal -> included
+        [1, 1, 0],   # below -> included
+        [4, 0, 0],   # col 0 exceeds -> excluded
+        [0, 0, 1],   # "missing DC" col in read snapshot exceeds -> excluded
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dense.op_in_read_snapshot(read, commit_vcs)),
+        [True, True, False, False],
+    )
