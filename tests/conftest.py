@@ -3,12 +3,16 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a forced 8-device CPU platform (the driver separately
 dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
-Must run before the first `import jax` anywhere in the test session.
+
+NOTE: the environment pins JAX_PLATFORMS=axon via sitecustomize at
+interpreter start, so overriding the env var here is too late — the
+platform must be overridden through jax.config.  XLA_FLAGS is still read
+at backend-init time, which happens after conftest import, so the forced
+device count can go through the environment.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,4 +21,5 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

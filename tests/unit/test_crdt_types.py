@@ -1,0 +1,280 @@
+"""Directed CRDT semantics tests.
+
+Scenarios mirror the reference's client-visible behavior
+(reference test/singledc/pb_client_SUITE.erl:174-483) exercised directly
+against the type layer: sequential ops through downstream/update on one
+replica, plus targeted concurrency cases (add-wins vs remove-wins, etc.).
+"""
+
+import pytest
+
+from antidote_tpu.crdt import (
+    DownstreamCtx,
+    DownstreamError,
+    all_types,
+    get_type,
+    is_type,
+)
+
+
+def seq_apply(cls, ops, state=None, ctx=None):
+    """Apply client ops sequentially on a single replica."""
+    ctx = ctx or DownstreamCtx("dc1")
+    state = cls.new() if state is None else state
+    for op in ops:
+        eff = cls.downstream(op, state, ctx)
+        state = cls.update(eff, state)
+    return state
+
+
+def concurrent_apply(cls, base_ops, op_a, op_b):
+    """Two replicas diverge from a common state with one op each, then
+    exchange effects.  Returns (state_at_a, state_at_b) — these must agree."""
+    base = seq_apply(cls, base_ops, ctx=DownstreamCtx("dc0"))
+    eff_a = cls.downstream(op_a, base, DownstreamCtx("dcA"))
+    eff_b = cls.downstream(op_b, base, DownstreamCtx("dcB"))
+    sa = cls.update(eff_b, cls.update(eff_a, base))
+    sb = cls.update(eff_a, cls.update(eff_b, base))
+    return sa, sb
+
+
+def test_registry():
+    assert set(all_types()) == {
+        "counter_pn", "counter_fat", "counter_b", "register_lww",
+        "register_mv", "set_go", "set_aw", "set_rw", "flag_ew", "flag_dw",
+        "map_go", "map_rr", "rga",
+    }
+    assert get_type("antidote_crdt_counter_pn") is get_type("counter_pn")
+    assert is_type("antidote_crdt_set_aw") and not is_type("bogus")
+
+
+def test_counter_pn():
+    c = get_type("counter_pn")
+    st = seq_apply(c, [("increment", 1), ("increment", 2), ("decrement", 1)])
+    assert c.value(st) == 2
+    assert not c.require_state_downstream(("increment", 1))
+    assert c.is_operation(("increment", 5)) and not c.is_operation(("assign", 5))
+    with pytest.raises(DownstreamError):
+        c.downstream(("assign", 5), c.new())
+
+
+def test_counter_fat_reset_keeps_concurrent():
+    c = get_type("counter_fat")
+    st = seq_apply(c, [("increment", 7), ("increment", 10)])
+    assert c.value(st) == 17
+    # reset concurrent with an increment: increment survives
+    sa, sb = concurrent_apply(c, [("increment", 5)], ("reset", ()), ("increment", 3))
+    assert sa == sb and c.value(sa) == 3
+
+
+def test_counter_b_bounds():
+    c = get_type("counter_b")
+    st = seq_apply(c, [("increment", (10, "dc1"))])
+    assert c.value(st) == 10
+    assert c.local_permissions(st, "dc1") == 10
+    assert c.local_permissions(st, "dc2") == 0
+    with pytest.raises(DownstreamError):  # dc2 has no rights
+        c.downstream(("decrement", (1, "dc2")), st)
+    st = seq_apply(c, [("transfer", (4, "dc2", "dc1"))], state=st)
+    assert c.local_permissions(st, "dc1") == 6
+    assert c.local_permissions(st, "dc2") == 4
+    st = seq_apply(c, [("decrement", (3, "dc2"))], state=st)
+    assert c.value(st) == 7 and c.local_permissions(st, "dc2") == 1
+    with pytest.raises(DownstreamError):
+        c.downstream(("decrement", (7, "dc1")), st)
+    assert c.permissions(st) == {"dc1": 6, "dc2": 1}
+
+
+def test_register_lww():
+    r = get_type("register_lww")
+    st = seq_apply(r, [("assign", b"10"), ("assign_ts", (b"20", 999_999_999_999_999_999))])
+    assert r.value(st) == b"20"
+    # older timestamp loses even if applied later
+    st2 = r.update(r.downstream(("assign_ts", (b"old", 1)), r.new(), DownstreamCtx("x")), st)
+    assert r.value(st2) == b"20"
+
+
+def test_register_mv_concurrent_assigns_both_survive():
+    r = get_type("register_mv")
+    st = seq_apply(r, [("assign", b"a"), ("assign", b"b")])
+    assert r.value(st) == [b"b"]
+    sa, sb = concurrent_apply(r, [("assign", b"base")], ("assign", b"x"), ("assign", b"y"))
+    assert sa == sb and r.value(sa) == [b"x", b"y"]
+    # a later assign that observed both collapses them
+    st3 = seq_apply(r, [("assign", b"z")], state=sa)
+    assert r.value(st3) == [b"z"]
+
+
+def test_set_go():
+    s = get_type("set_go")
+    st = seq_apply(s, [("add", b"a"), ("add_all", [b"b", b"c"])])
+    assert s.value(st) == [b"a", b"b", b"c"]
+
+
+def test_set_aw_sequence():
+    """Mirrors reference pb_client_SUITE.erl:331-334."""
+    s = get_type("set_aw")
+    st = seq_apply(s, [
+        ("add", b"a"),
+        ("add_all", [b"b", b"c", b"d", b"e", b"f"]),
+        ("remove", b"b"),
+        ("remove_all", [b"c", b"d"]),
+    ])
+    assert s.value(st) == [b"a", b"e", b"f"]
+
+
+def test_set_aw_add_wins():
+    s = get_type("set_aw")
+    sa, sb = concurrent_apply(s, [("add", b"x")], ("remove", b"x"), ("add", b"x"))
+    assert sa == sb and s.value(sa) == [b"x"]
+
+
+def test_set_rw_remove_wins():
+    s = get_type("set_rw")
+    st = seq_apply(s, [("add_all", [b"x", b"y"]), ("remove", b"y")])
+    assert s.value(st) == [b"x"]
+    sa, sb = concurrent_apply(s, [("add", b"x")], ("remove", b"x"), ("add", b"x"))
+    assert sa == sb and s.value(sa) == []
+    # re-add after the remove was observed -> present again
+    st2 = seq_apply(s, [("add", b"x")], state=sa)
+    assert s.value(st2) == [b"x"]
+
+
+def test_flag_ew():
+    f = get_type("flag_ew")
+    assert f.value(f.new()) is False
+    st = seq_apply(f, [("enable", ())])
+    assert f.value(st) is True
+    st = seq_apply(f, [("disable", ())], state=st)
+    assert f.value(st) is False
+    sa, sb = concurrent_apply(f, [("enable", ())], ("disable", ()), ("enable", ()))
+    assert sa == sb and f.value(sa) is True  # enable wins
+
+
+def test_flag_dw():
+    f = get_type("flag_dw")
+    st = seq_apply(f, [("enable", ())])
+    assert f.value(st) is True
+    sa, sb = concurrent_apply(f, [("enable", ())], ("disable", ()), ("enable", ()))
+    assert sa == sb and f.value(sa) is False  # disable wins
+    st2 = seq_apply(f, [("enable", ())], state=sa)
+    assert f.value(st2) is True
+
+
+def test_map_go_nested():
+    m = get_type("map_go")
+    st = seq_apply(m, [
+        ("update", ((b"a", "register_mv"), ("assign", b"42"))),
+        ("update", [
+            ((b"d", "set_aw"), ("add_all", [b"Apple", b"Banana"])),
+            ((b"f", "counter_pn"), ("increment", 7)),
+        ]),
+    ])
+    v = m.value(st)
+    assert v[(b"a", "register_mv")] == [b"42"]
+    assert v[(b"d", "set_aw")] == [b"Apple", b"Banana"]
+    assert v[(b"f", "counter_pn")] == 7
+
+
+def test_map_rr_remove_and_nested_map():
+    """Mirrors reference pb_client_SUITE.erl:403-441."""
+    m = get_type("map_rr")
+    st = seq_apply(m, [
+        ("update", ((b"a", "register_mv"), ("assign", b"42"))),
+        ("update", [
+            ((b"b", "register_mv"), ("assign", b"X")),
+            ((b"f", "counter_fat"), ("increment", 7)),
+            ((b"g", "map_rr"), ("update", ((b"x", "counter_fat"), ("increment", 17)))),
+        ]),
+        ("remove", (b"b", "register_mv")),
+    ])
+    v = m.value(st)
+    assert (b"b", "register_mv") not in v
+    assert v[(b"f", "counter_fat")] == 7
+    assert v[(b"g", "map_rr")] == {(b"x", "counter_fat"): 17}
+    # batch: update one key, remove another
+    st = seq_apply(m, [
+        ("batch", (
+            [((b"i", "register_mv"), ("assign", b"X"))],
+            [(b"g", "map_rr")],
+        )),
+    ], state=st)
+    v = m.value(st)
+    assert (b"g", "map_rr") not in v and v[(b"i", "register_mv")] == [b"X"]
+    # non-resettable nested type cannot be removed
+    with pytest.raises(DownstreamError):
+        m.downstream(("remove", (b"z", "counter_pn")), st)
+
+
+def test_map_rr_concurrent_update_survives_remove():
+    m = get_type("map_rr")
+    sa, sb = concurrent_apply(
+        m,
+        [("update", ((b"k", "counter_fat"), ("increment", 5)))],
+        ("remove", (b"k", "counter_fat")),
+        ("update", ((b"k", "counter_fat"), ("increment", 3))),
+    )
+    assert sa == sb and m.value(sa) == {(b"k", "counter_fat"): 3}
+
+
+def test_rga_sequential():
+    r = get_type("rga")
+    st = seq_apply(r, [
+        ("add_right", (0, "H")),
+        ("add_right", (1, "i")),
+        ("add_right", (2, "!")),
+        ("remove", 3),
+        ("add_right", (0, ">")),
+    ])
+    assert r.value(st) == [">", "H", "i"]
+    with pytest.raises(DownstreamError):
+        r.downstream(("remove", 9), st)
+
+
+def test_rga_concurrent_inserts_converge():
+    r = get_type("rga")
+    base = seq_apply(r, [("add_right", (0, "a")), ("add_right", (1, "b"))])
+    ea = r.downstream(("add_right", (1, "X")), base, DownstreamCtx("dcA"))
+    eb = r.downstream(("add_right", (1, "Y")), base, DownstreamCtx("dcB"))
+    sa = r.update(eb, r.update(ea, base))
+    sb = r.update(ea, r.update(eb, base))
+    assert sa == sb
+    v = r.value(sa)
+    assert v[0] == "a" and v[3] == "b" and set(v[1:3]) == {"X", "Y"}
+    # duplicate delivery is a no-op
+    assert r.update(ea, sa) == sa
+
+def test_gen_downstream_wraps_malformed_args():
+    c = get_type("counter_pn")
+    with pytest.raises(DownstreamError):
+        c.gen_downstream(("increment", "abc"), c.new())
+    with pytest.raises(DownstreamError):
+        c.gen_downstream(("bogus", 1), c.new())
+    b = get_type("counter_b")
+    with pytest.raises(DownstreamError):
+        b.gen_downstream(("increment", 5), b.new())  # missing replica id
+
+
+def test_counter_b_rejects_nonpositive_amounts():
+    b = get_type("counter_b")
+    st = seq_apply(b, [("increment", (5, "dc1"))])
+    for op in [("increment", (-10, "dc1")), ("decrement", (-5, "dc2")),
+               ("decrement", (0, "dc1")), ("transfer", (-1, "dc2", "dc1"))]:
+        with pytest.raises(DownstreamError):
+            b.downstream(op, st)
+
+
+def test_map_rr_rejects_nonresettable_on_update():
+    m = get_type("map_rr")
+    with pytest.raises(DownstreamError):
+        m.downstream(("update", ((b"k", "counter_pn"), ("increment", 1))), m.new())
+
+
+def test_heterogeneous_values_read_cleanly():
+    s = get_type("set_aw")
+    st = seq_apply(s, [("add", b"a"), ("add", 1), ("add", "z")])
+    v = s.value(st)
+    assert set(v) == {b"a", 1, "z"} and len(v) == 3
+    r = get_type("register_mv")
+    sa, sb = concurrent_apply(r, [], ("assign", b"x"), ("assign", 3))
+    assert sa == sb and set(r.value(sa)) == {b"x", 3}

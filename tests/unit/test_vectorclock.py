@@ -91,15 +91,18 @@ def test_dense_dominance_matches_host():
     a = rng.integers(0, 4, size=(64, 5))
     b = rng.integers(0, 4, size=(64, 5))
     ja, jb = jnp.asarray(a), jnp.asarray(b)
+    # one batched device call per relation; compare rows against host VC
+    rel = {name: np.asarray(getattr(dense, name)(ja, jb))
+           for name in ("le", "ge", "lt", "gt", "concurrent", "all_dots_greater")}
     for i in range(64):
         va = VC.clean({d: int(a[i, d]) for d in range(5)})
         vb = VC.clean({d: int(b[i, d]) for d in range(5)})
-        assert bool(dense.le(ja[i], jb[i])) == va.le(vb)
-        assert bool(dense.ge(ja[i], jb[i])) == va.ge(vb)
-        assert bool(dense.lt(ja[i], jb[i])) == va.lt(vb)
-        assert bool(dense.gt(ja[i], jb[i])) == va.gt(vb)
-        assert bool(dense.concurrent(ja[i], jb[i])) == va.concurrent(vb)
-        assert bool(dense.all_dots_greater(ja[i], jb[i])) == va.all_dots_greater(vb)
+        assert bool(rel["le"][i]) == va.le(vb)
+        assert bool(rel["ge"][i]) == va.ge(vb)
+        assert bool(rel["lt"][i]) == va.lt(vb)
+        assert bool(rel["gt"][i]) == va.gt(vb)
+        assert bool(rel["concurrent"][i]) == va.concurrent(vb)
+        assert bool(rel["all_dots_greater"][i]) == va.all_dots_greater(vb)
 
 
 def test_dense_batched_broadcast():
