@@ -1,0 +1,10 @@
+from antidote_tpu.mat.materializer import (  # noqa: F401
+    MaterializedSnapshot,
+    MaterializeResult,
+    Payload,
+    SnapshotGetResponse,
+    materialize,
+    materialize_eager,
+    op_covered_by,
+    op_in_read_snapshot,
+)

@@ -1,0 +1,199 @@
+"""Batched device materialization kernels (JAX/XLA, TPU-first).
+
+The reference materializes one key at a time by walking its op list in a
+gen_server (reference src/clocksi_materializer.erl:145-171 — the #1 hot
+loop, see SURVEY §3.1).  Here materialization is a *batched tensor
+program* over K keys at once; every step is data-parallel:
+
+1. **Inclusion mask** — the per-op snapshot test (commit VC vs base/read
+   VC) over the whole padded op block ``[K, L]`` in one fused op
+   (semantics: src/materializer.erl:101-106, src/clocksi_materializer.erl:214-268).
+
+2. **Effect application** without sequential scans.  Under causal
+   delivery an OR-Set element's dot set always collapses to at most one
+   live dot per origin DC, so state is a dense version-vector table
+   ``dots[K, E, D]`` (E = element slots) and applying a batch of ops
+   reduces to two segmented max-reductions:
+
+   - ``last_seq[e, d]`` = max dot seq over included adds of element e
+     from DC d
+   - ``max_obs[e, d]``  = max observed-VV over included ops of element e
+
+   A dot survives iff ``max(base, last_seq) > max_obs`` — any op whose
+   observed VV dominates a dot was causally delivered after it and
+   cancels it (the ORSWOT join).  No scan, no op ordering: max is
+   associative and commutative, exactly because CRDT effects are.
+
+   MV-registers are the same lattice with values as elements; EW-flags
+   are a single implicit element; PN-counters are a masked sum.
+
+Conventions:
+- dots are ``(dc_index, seq)`` with seq monotonically increasing per
+  origin DC (seq 0 = no dot);
+- element slots are dense indices assigned host-side (hash interning);
+- all arrays are fixed-shape; invalid / padding lanes carry valid=False.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from antidote_tpu.clocks import dense
+
+
+def inclusion_mask(
+    op_dc: jax.Array,      # int32[K, L] origin DC column per op
+    op_ct: jax.Array,      # int[K, L] commit time
+    op_ss: jax.Array,      # int[K, L, D] op snapshot VC
+    op_valid: jax.Array,   # bool[K, L]
+    base_vc: jax.Array,    # int[K, D] base snapshot time (zeros = bottom)
+    has_base: jax.Array,   # bool[K] whether base_vc is a real snapshot
+    read_vc: jax.Array,    # int[K, D] or int[D] read snapshot
+) -> jax.Array:
+    """bool[K, L]: which ops to apply on top of the base snapshot for a
+    read at ``read_vc``.  Matches materialize()'s covered/included rules
+    (host oracle: antidote_tpu/mat/materializer.py)."""
+    cvc = dense.commit_vc(op_ss, op_dc, op_ct)          # [K, L, D]
+    covered = dense.le(cvc, base_vc[:, None, :]) & has_base[:, None]
+    if read_vc.ndim == 1:
+        read_vc = read_vc[None, :]
+    included = dense.le(cvc, read_vc[:, None, :])
+    return op_valid & ~covered & included
+
+
+def snapshot_vc_of(
+    op_dc, op_ct, op_ss, mask, base_vc
+) -> jax.Array:
+    """int[K, D]: smallest VC describing the produced snapshot = base
+    max'd with every included op's commit VC."""
+    cvc = dense.commit_vc(op_ss, op_dc, op_ct)          # [K, L, D]
+    cvc = jnp.where(mask[..., None], cvc, 0)
+    return jnp.maximum(base_vc, jnp.max(cvc, axis=-2))
+
+
+# ---------------------------------------------------------------------------
+# counter_pn
+
+
+def counter_read(base_val: jax.Array, deltas: jax.Array, mask: jax.Array):
+    """int[K]: base + masked sum of deltas (counter_pn materialize)."""
+    return base_val + jnp.sum(jnp.where(mask, deltas, 0), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# OR-set (set_aw) / MV-register — the dotted-version-vector lattice
+
+
+@partial(jax.vmap, in_axes=(0, 0, 0, 0, 0, 0, 0))
+def _orset_fold(base_dots, elem_slot, is_add, dot_dc, dot_seq, obs_vv, mask):
+    """Per-key fold of L ops into the element×DC dot table.
+
+    base_dots: [E, D]; elem_slot,is_add,dot_dc,dot_seq: [L]; obs_vv: [L, D].
+    Returns live dot table [E, D].
+    """
+    e, d = base_dots.shape
+    add_mask = mask & is_add
+    # scatter-max the add dots into [E, D]
+    seqs = jnp.where(add_mask, dot_seq, 0)
+    last_seq = jnp.zeros((e, d), dtype=base_dots.dtype).at[
+        elem_slot, dot_dc
+    ].max(seqs.astype(base_dots.dtype), mode="drop")
+    # scatter-max every included op's observed VV into its element row
+    obs = jnp.where(mask[:, None], obs_vv, 0)
+    max_obs = jnp.zeros((e, d), dtype=base_dots.dtype).at[elem_slot].max(
+        obs.astype(base_dots.dtype), mode="drop"
+    )
+    merged = jnp.maximum(base_dots, last_seq)
+    return jnp.where(merged > max_obs, merged, 0)
+
+
+def orset_apply(
+    base_dots: jax.Array,  # int[K, E, D] live dot table
+    elem_slot: jax.Array,  # int32[K, L] element slot per op
+    is_add: jax.Array,     # bool[K, L] add vs remove
+    dot_dc: jax.Array,     # int32[K, L] minting DC (adds)
+    dot_seq: jax.Array,    # int[K, L] minted seq (adds; 0 for removes)
+    obs_vv: jax.Array,     # int[K, L, D] observed VV per op
+    mask: jax.Array,       # bool[K, L] inclusion mask
+) -> jax.Array:
+    """Apply a padded op block to the OR-set dot tables; returns the new
+    ``dots[K, E, D]``.  Ops outside ``mask`` (padding / excluded by the
+    snapshot test) are no-ops.  Associative: callers may split L into
+    chunks and fold."""
+    # ops routed to a slot >= E are dropped by scatter mode="drop";
+    # padding lanes use slot E (out of range) for safety
+    return _orset_fold(
+        base_dots, elem_slot, is_add, dot_dc, dot_seq, obs_vv, mask
+    )
+
+
+def orset_present(dots: jax.Array) -> jax.Array:
+    """bool[K, E]: element visible iff it has any live dot."""
+    return jnp.any(dots > 0, axis=-1)
+
+
+@partial(jax.vmap, in_axes=(0, 0, 0, 0, 0, 0))
+def mvreg_apply(base_dots, val_slot, dot_dc, dot_seq, obs_vv, mask):
+    """MV-register fold: like the OR-set lattice over value slots, except
+    an assign supersedes *every* pair it observed regardless of value —
+    so the observed-VV cancellation applies across all rows, not just the
+    assign's own slot.  Concurrent assigns (mutually unobserved dots)
+    keep multiple live value slots.
+
+    base_dots: [E, D] (vmapped over K); val_slot/dot_dc/dot_seq: [L];
+    obs_vv: [L, D]; mask: [L]."""
+    e, d = base_dots.shape
+    seqs = jnp.where(mask, dot_seq, 0)
+    last_seq = jnp.zeros((e, d), dtype=base_dots.dtype).at[
+        val_slot, dot_dc
+    ].max(seqs.astype(base_dots.dtype), mode="drop")
+    max_obs = jnp.max(
+        jnp.where(mask[:, None], obs_vv, 0), axis=0
+    ).astype(base_dots.dtype)                       # [D] — all rows
+    merged = jnp.maximum(base_dots, last_seq)
+    return jnp.where(merged > max_obs[None, :], merged, 0)
+
+
+def flag_ew_read(base_dots, dot_dc, dot_seq, is_enable, obs_vv, mask):
+    """bool[K]: enable-wins flag = OR-set with one implicit element.
+    base_dots: [K, D]; others [K, L(, D)]."""
+    slot = jnp.zeros_like(dot_dc)
+    dots = orset_apply(
+        base_dots[:, None, :], slot, is_enable, dot_dc, dot_seq, obs_vv, mask
+    )
+    return jnp.any(dots[:, 0, :] > 0, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# register_lww
+
+
+def lww_read(
+    base_ts: jax.Array,    # int[K] base (ts) key
+    base_tie: jax.Array,   # int[K] base tiebreak
+    base_val: jax.Array,   # int[K] base interned value id
+    op_ts: jax.Array,      # int[K, L]
+    op_tie: jax.Array,     # int[K, L]
+    op_val: jax.Array,     # int[K, L] interned value ids
+    mask: jax.Array,       # bool[K, L]
+):
+    """(ts, tie, val)[K]: max (ts, tie) among base and included ops —
+    last-writer-wins with a deterministic tiebreak.  Lexicographic max is
+    computed in two masked reductions (no packing, no overflow)."""
+    neg = jnp.asarray(-1, dtype=op_ts.dtype)
+    ts = jnp.where(mask, op_ts, neg)
+    mts = jnp.max(ts, axis=-1)                                   # [K]
+    at_mts = mask & (ts == mts[:, None])
+    mtie = jnp.max(jnp.where(at_mts, op_tie, neg), axis=-1)      # [K]
+    idx = jnp.argmax(at_mts & (op_tie == mtie[:, None]), axis=-1)
+    k = jnp.arange(ts.shape[0])
+    cand_val = op_val[k, idx]
+    take = (mts > base_ts) | ((mts == base_ts) & (mtie > base_tie))
+    return (
+        jnp.where(take, mts, base_ts),
+        jnp.where(take, mtie, base_tie),
+        jnp.where(take, cand_val, base_val),
+    )

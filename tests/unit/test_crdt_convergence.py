@@ -20,7 +20,7 @@ from antidote_tpu.crdt import DownstreamCtx, DownstreamError, all_types, get_typ
 
 
 class Replica:
-    def __init__(self, rid, cls, n_replicas, ids):
+    def __init__(self, rid, cls, ids):
         self.rid = rid
         self.cls = cls
         self.ctx = DownstreamCtx(rid)
@@ -50,7 +50,7 @@ class Replica:
 def run_sim(cls, op_gen, n_replicas=3, n_ops=40, seed=0):
     rng = random.Random(seed)
     ids = [f"dc{i}" for i in range(n_replicas)]
-    reps = {r: Replica(r, cls, n_replicas, ids) for r in ids}
+    reps = {r: Replica(r, cls, ids) for r in ids}
     pending = {r: [] for r in ids}  # undelivered msgs per replica
 
     for step in range(n_ops):
