@@ -95,8 +95,7 @@ def op_in_read_snapshot(read_vc: Optional[VC], op: Payload) -> bool:
     ``ignore`` snapshot used by get_objects)."""
     if read_vc is None:
         return True
-    cvc = op.commit_vc()
-    return all(t <= read_vc.get_dc(dc) for dc, t in cvc.items())
+    return op.commit_vc().le(read_vc)
 
 
 def materialize(type_name: str, txid: Any, min_snapshot_time: VC,

@@ -1,0 +1,311 @@
+"""Device materializer store — the TPU-resident versioned key store.
+
+The reference keeps, per partition, an ETS op ring + a cache of
+materialized snapshots per key, GC'd by thresholds (reference
+src/materializer_vnode.erl:36-47, 511-647; ring layout doc
+include/antidote.hrl:81-90).  The TPU redesign collapses that to:
+
+- a dense **op ring** ``[K, L]`` per shard (padded, cursor per key), and
+- a single **base snapshot per key anchored at the GST**: because the
+  batched kernels can materialize at *any* read VC >= base in one call,
+  one base snapshot replaces the reference's per-key snapshot list.
+  Reads below the GST fall back to log replay, exactly like the
+  reference's snapshot-cache miss (src/materializer_vnode.erl:415-419).
+
+The GC step is the reference's op_insert_gc turned into a batched fold:
+every op whose commit VC has become stable (<= GST) is folded into the
+base (an associative lattice join — see mat/kernels.py) and the ring is
+compacted in-place with a cumsum scatter.  No per-key control flow; one
+fused XLA program covers the whole shard.
+
+Shapes: K keys, L ring lanes, E element slots, D dc columns.  Appends
+whose key ring is full are reported back (overflow) so the control plane
+can trigger a GC or spill to the log; reads of overflowed keys stay
+correct via log replay.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.clocks import dense
+from antidote_tpu.mat import kernels
+
+
+@dataclass
+class OrsetShardState:
+    """Device arrays for one OR-Set shard (a pytree)."""
+
+    dots: jax.Array      # int[K, E, D] base snapshot (live dot table)
+    base_vc: jax.Array   # int[D] snapshot time of the base (shard-wide GST)
+    has_base: jax.Array  # bool[] whether base_vc is meaningful
+    # --- op ring, [K, L] unless noted ---
+    count: jax.Array     # int32[K] live ops per key
+    elem_slot: jax.Array  # int32
+    is_add: jax.Array    # bool
+    dot_dc: jax.Array    # int32
+    dot_seq: jax.Array   # int
+    obs_vv: jax.Array    # int[K, L, D]
+    op_dc: jax.Array     # int32
+    op_ct: jax.Array     # int
+    op_ss: jax.Array     # int[K, L, D]
+    valid: jax.Array     # bool
+
+
+jax.tree_util.register_dataclass(
+    OrsetShardState,
+    data_fields=[
+        "dots", "base_vc", "has_base", "count", "elem_slot", "is_add",
+        "dot_dc", "dot_seq", "obs_vv", "op_dc", "op_ct", "op_ss", "valid",
+    ],
+    meta_fields=[],
+)
+
+
+def orset_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
+                     dtype=jnp.int32) -> OrsetShardState:
+    K, L, E, D = n_keys, n_lanes, n_slots, n_dcs
+    z = partial(jnp.zeros, dtype=dtype)
+    return OrsetShardState(
+        dots=z((K, E, D)),
+        base_vc=z((D,)),
+        has_base=jnp.zeros((), dtype=bool),
+        count=jnp.zeros((K,), dtype=jnp.int32),
+        elem_slot=jnp.full((K, L), E, dtype=jnp.int32),
+        is_add=jnp.zeros((K, L), dtype=bool),
+        dot_dc=jnp.zeros((K, L), dtype=jnp.int32),
+        dot_seq=z((K, L)),
+        obs_vv=z((K, L, D)),
+        op_dc=jnp.zeros((K, L), dtype=jnp.int32),
+        op_ct=z((K, L)),
+        op_ss=z((K, L, D)),
+        valid=jnp.zeros((K, L), dtype=bool),
+    )
+
+
+def _ring_append(count, valid, key_idx, lane_off, fields: dict):
+    """Shared ring scatter: place B ops at (key, count[key]+lane_off).
+
+    ``fields``: name -> (ring_array, batch_values).  Returns
+    (new_count, new_valid, new_fields, overflow[B]); overflowed ops are
+    NOT stored — the caller must GC or serve those keys from the log."""
+    L = valid.shape[1]
+    lane = count[key_idx] + lane_off
+    overflow = lane >= L
+    lane = jnp.where(overflow, L, lane)  # L = out of range -> dropped
+    new_count = count.at[key_idx].add(
+        jnp.where(overflow, 0, 1).astype(count.dtype), mode="drop")
+    new_valid = valid.at[key_idx, lane].set(
+        jnp.ones_like(overflow), mode="drop")
+    new_fields = {
+        name: a.at[key_idx, lane].set(v, mode="drop")
+        for name, (a, v) in fields.items()
+    }
+    return new_count, new_valid, new_fields, overflow
+
+
+def _ring_compact(keep, fields: dict):
+    """Shared ring compaction: move kept ops to the lane prefix.
+
+    ``fields``: name -> (ring_array, fill_value).  Returns
+    (new_count, new_valid, new_fields)."""
+    L = keep.shape[1]
+    new_pos = jnp.where(keep, jnp.cumsum(keep, axis=1) - 1, L)  # L -> drop
+    k_idx = jnp.broadcast_to(jnp.arange(keep.shape[0])[:, None], keep.shape)
+
+    def compact(a, fill):
+        out = jnp.full_like(a, fill)
+        return out.at[k_idx, new_pos].set(a, mode="drop")
+
+    new_valid = compact(keep, False)
+    new_count = jnp.sum(keep, axis=1, dtype=jnp.int32)
+    new_fields = {name: compact(a, fill) for name, (a, fill) in fields.items()}
+    return new_count, new_valid, new_fields
+
+
+@jax.jit
+def orset_append(
+    st: OrsetShardState,
+    key_idx: jax.Array,   # int32[B]
+    lane_off: jax.Array,  # int32[B] occurrence index of the key within batch
+    elem_slot: jax.Array, is_add: jax.Array,
+    dot_dc: jax.Array, dot_seq: jax.Array, obs_vv: jax.Array,
+    op_dc: jax.Array, op_ct: jax.Array, op_ss: jax.Array,
+) -> Tuple[OrsetShardState, jax.Array]:
+    """Scatter a batch of B committed ops into the rings (see _ring_append
+    for the overflow contract)."""
+    count, valid, f, overflow = _ring_append(
+        st.count, st.valid, key_idx, lane_off, {
+            "elem_slot": (st.elem_slot, elem_slot),
+            "is_add": (st.is_add, is_add),
+            "dot_dc": (st.dot_dc, dot_dc),
+            "dot_seq": (st.dot_seq, dot_seq),
+            "obs_vv": (st.obs_vv, obs_vv),
+            "op_dc": (st.op_dc, op_dc),
+            "op_ct": (st.op_ct, op_ct),
+            "op_ss": (st.op_ss, op_ss),
+        })
+    return replace(st, count=count, valid=valid, **f), overflow
+
+
+@jax.jit
+def orset_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
+    """Fold every ring op with commit VC <= GST into the base snapshot
+    and compact the rings (the batched op_insert_gc/snapshot_insert_gc,
+    reference src/materializer_vnode.erl:511-647).
+
+    Safe because the GST is a *stable* time: no op with commit VC <= GST
+    can still be in flight (reference dc_utilities:get_stable_snapshot
+    contract), so folding is permanent and base_vc := max(base_vc, gst)."""
+    cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)      # [K, L, D]
+    stable = st.valid & dense.le(cvc, gst[None, None, :])
+    dots = kernels.orset_apply(
+        st.dots, st.elem_slot, st.is_add, st.dot_dc, st.dot_seq,
+        st.obs_vv, stable,
+    )
+    keep = st.valid & ~stable
+    E = st.dots.shape[1]
+    count, valid, f = _ring_compact(keep, {
+        "elem_slot": (st.elem_slot, E),
+        "is_add": (st.is_add, False),
+        "dot_dc": (st.dot_dc, 0),
+        "dot_seq": (st.dot_seq, 0),
+        "obs_vv": (st.obs_vv, 0),
+        "op_dc": (st.op_dc, 0),
+        "op_ct": (st.op_ct, 0),
+        "op_ss": (st.op_ss, 0),
+    })
+    return replace(
+        st,
+        dots=dots,
+        base_vc=jnp.maximum(st.base_vc, gst),
+        has_base=jnp.ones((), dtype=bool),
+        count=count,
+        valid=valid,
+        **f,
+    )
+
+
+@jax.jit
+def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
+    """bool[K, E]: element presence for every key at ``read_vc`` in one
+    batched materialization (base + included ring ops).
+
+    Requires read_vc >= base_vc (reads under the base fall back to log
+    replay at the control plane, as in the reference's cache miss)."""
+    K = st.valid.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
+    has_base = jnp.broadcast_to(st.has_base, (K,))
+    mask = kernels.inclusion_mask(
+        st.op_dc, st.op_ct, st.op_ss, st.valid, base_vc, has_base, read_vc)
+    dots = kernels.orset_apply(
+        st.dots, st.elem_slot, st.is_add, st.dot_dc, st.dot_seq,
+        st.obs_vv, mask)
+    return kernels.orset_present(dots)
+
+
+# ---------------------------------------------------------------------------
+# counter_pn shard — same ring machinery, scalar state
+
+
+@dataclass
+class CounterShardState:
+    value: jax.Array     # int[K] base values
+    base_vc: jax.Array   # int[D]
+    has_base: jax.Array  # bool[]
+    count: jax.Array     # int32[K]
+    delta: jax.Array     # int[K, L]
+    op_dc: jax.Array     # int32[K, L]
+    op_ct: jax.Array     # int[K, L]
+    op_ss: jax.Array     # int[K, L, D]
+    valid: jax.Array     # bool[K, L]
+
+
+jax.tree_util.register_dataclass(
+    CounterShardState,
+    data_fields=["value", "base_vc", "has_base", "count", "delta",
+                 "op_dc", "op_ct", "op_ss", "valid"],
+    meta_fields=[],
+)
+
+
+def counter_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
+                       dtype=jnp.int32) -> CounterShardState:
+    K, L, D = n_keys, n_lanes, n_dcs
+    z = partial(jnp.zeros, dtype=dtype)
+    return CounterShardState(
+        value=z((K,)),
+        base_vc=z((D,)),
+        has_base=jnp.zeros((), dtype=bool),
+        count=jnp.zeros((K,), dtype=jnp.int32),
+        delta=z((K, L)),
+        op_dc=jnp.zeros((K, L), dtype=jnp.int32),
+        op_ct=z((K, L)),
+        op_ss=z((K, L, D)),
+        valid=jnp.zeros((K, L), dtype=bool),
+    )
+
+
+@jax.jit
+def counter_append(st: CounterShardState, key_idx, lane_off, delta,
+                   op_dc, op_ct, op_ss):
+    count, valid, f, overflow = _ring_append(
+        st.count, st.valid, key_idx, lane_off, {
+            "delta": (st.delta, delta),
+            "op_dc": (st.op_dc, op_dc),
+            "op_ct": (st.op_ct, op_ct),
+            "op_ss": (st.op_ss, op_ss),
+        })
+    return replace(st, count=count, valid=valid, **f), overflow
+
+
+@jax.jit
+def counter_gc(st: CounterShardState, gst: jax.Array) -> CounterShardState:
+    cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
+    stable = st.valid & dense.le(cvc, gst[None, None, :])
+    value = kernels.counter_read(st.value, st.delta, stable)
+    keep = st.valid & ~stable
+    count, valid, f = _ring_compact(keep, {
+        "delta": (st.delta, 0),
+        "op_dc": (st.op_dc, 0),
+        "op_ct": (st.op_ct, 0),
+        "op_ss": (st.op_ss, 0),
+    })
+    return replace(
+        st,
+        value=value,
+        base_vc=jnp.maximum(st.base_vc, gst),
+        has_base=jnp.ones((), dtype=bool),
+        count=count,
+        valid=valid,
+        **f,
+    )
+
+
+@jax.jit
+def counter_read(st: CounterShardState, read_vc: jax.Array) -> jax.Array:
+    """int[K]: counter values at ``read_vc``."""
+    K = st.valid.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
+    has_base = jnp.broadcast_to(st.has_base, (K,))
+    mask = kernels.inclusion_mask(
+        st.op_dc, st.op_ct, st.op_ss, st.valid, base_vc, has_base, read_vc)
+    return kernels.counter_read(st.value, st.delta, mask)
+
+
+def batch_lane_offsets(key_idx: np.ndarray) -> np.ndarray:
+    """Host helper: occurrence index of each key within the batch (0,1,...)
+    in batch order — disambiguates same-key ops in one append."""
+    out = np.zeros(len(key_idx), dtype=np.int32)
+    seen: dict = {}
+    for i, k in enumerate(key_idx):
+        k = int(k)
+        out[i] = seen.get(k, 0)
+        seen[k] = out[i] + 1
+    return out

@@ -1,0 +1,45 @@
+"""Synthetic committed-op streams for benchmarks and dry runs.
+
+One generator shared by bench.py and __graft_entry__.py so the causal
+plausibility invariants (per-DC monotone commit counters, op snapshot
+VC <= the commit frontier) live in one place.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def orset_batch(rng, K: int, B: int, D: int, n_dcs: int,
+                clock: np.ndarray, n_elems: int = 8,
+                obs_lag: int = 1) -> dict:
+    """One batch of B committed OR-Set ops over K keys.
+
+    ``clock`` (int32[n_dcs], mutated in place) carries the per-DC commit
+    counters across batches.  Every op's snapshot VC is <= the batch-end
+    frontier, so applying the whole batch and folding at that frontier is
+    causally valid.  Returns the dense field dict incl. the ``frontier``.
+    """
+    keys = rng.integers(0, K, size=B).astype(np.int32)
+    elem = rng.integers(0, n_elems, size=B).astype(np.int32)
+    is_add = rng.random(B) < 0.7
+    dc = rng.integers(0, n_dcs, size=B).astype(np.int32)
+    ct = np.zeros(B, dtype=np.int32)
+    for d in range(n_dcs):
+        m = dc == d
+        ct[m] = clock[d] + 1 + np.arange(m.sum(), dtype=np.int32)
+        clock[d] += int(m.sum())
+    ss = np.zeros((B, D), dtype=np.int32)
+    ss[:, :n_dcs] = np.minimum(clock[None, :], ct[:, None] - 1)
+    if obs_lag:
+        lag = rng.integers(0, obs_lag + 1, size=(B, D)).astype(np.int32)
+    else:
+        lag = 0
+    obs = np.maximum(ss - lag, 0)
+    frontier = np.zeros(D, dtype=np.int32)
+    frontier[:n_dcs] = clock
+    return dict(
+        key_idx=keys, elem_slot=elem, is_add=is_add, dot_dc=dc,
+        dot_seq=ct, obs_vv=obs, op_dc=dc.copy(), op_ct=ct.copy(), op_ss=ss,
+        frontier=frontier,
+    )
