@@ -1,0 +1,109 @@
+"""Public API — the surface of the reference's antidote.erl
+(reference src/antidote.erl:36-54): start/read/update/commit/abort,
+static-transaction variants, get_objects, get_log_operations, and hook
+registration, against one DC node.
+
+Bound objects are ``(key, type)`` or ``(key, type, bucket)``; updates are
+``(bound_object, op_name, op_param)``; the interactive handle is the
+Transaction returned by start_transaction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.config import Config
+from antidote_tpu.crdt import get_type
+from antidote_tpu.txn.coordinator import (  # noqa: F401 (re-exported)
+    Transaction,
+    TransactionAborted,
+    TxnProperties,
+)
+from antidote_tpu.txn.node import Node
+
+
+class AntidoteTPU:
+    """One DC node with the reference's client API."""
+
+    def __init__(self, dc_id="dc1", config: Optional[Config] = None,
+                 data_dir: Optional[str] = None):
+        self.node = Node(dc_id=dc_id, config=config, data_dir=data_dir)
+
+    # ------------------------------------------------------- interactive txn
+
+    def start_transaction(self, clock: Optional[VC] = None,
+                          properties: Optional[TxnProperties] = None
+                          ) -> Transaction:
+        return self.node.coordinator.start_transaction(clock, properties)
+
+    def read_objects(self, objects: List, tx: Transaction) -> List[Any]:
+        return self.node.coordinator.read_objects(tx, objects)
+
+    def update_objects(self, updates: List, tx: Transaction) -> None:
+        self.node.coordinator.update_objects(tx, updates)
+
+    def commit_transaction(self, tx: Transaction) -> VC:
+        return self.node.coordinator.commit_transaction(tx)
+
+    def abort_transaction(self, tx: Transaction) -> None:
+        self.node.coordinator.abort_transaction(tx)
+
+    # ------------------------------------------------------------ static txn
+
+    def read_objects_static(self, clock: Optional[VC], objects: List
+                            ) -> Tuple[List[Any], VC]:
+        """One-shot snapshot read (reference cure:obtain_objects fast
+        path, src/cure.erl:135-183)."""
+        tx = self.start_transaction(clock)
+        values = self.read_objects(objects, tx)
+        commit_vc = self.commit_transaction(tx)
+        return values, commit_vc
+
+    def update_objects_static(self, clock: Optional[VC], updates: List,
+                              properties: Optional[TxnProperties] = None
+                              ) -> VC:
+        """One-shot update transaction (reference antidote:update_objects/3)."""
+        tx = self.start_transaction(clock, properties)
+        self.update_objects(updates, tx)
+        return self.commit_transaction(tx)
+
+    # ------------------------------------------------------------- inspection
+
+    def get_objects(self, objects: List, clock: Optional[VC] = None
+                    ) -> List[Any]:
+        """Latest committed values, no snapshot wait (reference
+        antidote:get_objects, src/antidote.erl:69-90)."""
+        out = []
+        for bo in objects:
+            key, type_name, _b = self.node.normalize_bound(bo)
+            cls = get_type(type_name)
+            pm = self.node.partition_of(key)
+            value = pm.value_snapshot(key, type_name, clock)
+            out.append(cls.value(value))
+        return out
+
+    def get_log_operations(self, object_clock_pairs: List) -> List[List]:
+        """Committed log ops per object newer than the given clock
+        (reference antidote:get_log_operations)."""
+        out = []
+        for bo, clock in object_clock_pairs:
+            key, _type_name, _b = self.node.normalize_bound(bo)
+            pm = self.node.partition_of(key)
+            ops = pm.log.committed_payloads(key=key, from_vc=clock)
+            out.append([p for _i, p in ops])
+        return out
+
+    # ----------------------------------------------------------------- hooks
+
+    def register_pre_hook(self, bucket, hook) -> None:
+        self.node.hooks.register_pre_hook(bucket, hook)
+
+    def register_post_hook(self, bucket, hook) -> None:
+        self.node.hooks.register_post_hook(bucket, hook)
+
+    def unregister_hook(self, which: str, bucket) -> None:
+        self.node.hooks.unregister_hook(which, bucket)
+
+    def close(self) -> None:
+        self.node.close()

@@ -1,0 +1,35 @@
+"""Node/DC configuration flags.
+
+Mirrors the reference's OTP app env surface (reference
+src/antidote.app.src:30-63): txn_cert, txn_prot, sync_log,
+enable_logging, recover_from_log, recover_meta_data_on_start,
+auto_start_read_servers — plus the rebuild's own knobs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Config:
+    #: write-write certification on commit (reference txn_cert)
+    certify: bool = True
+    #: transaction protocol: "clocksi" | "gr" (GentleRain, reference txn_prot)
+    txn_prot: str = "clocksi"
+    #: fsync the log on commit records (reference sync_log)
+    sync_log: bool = False
+    #: append records to the durable log at all (reference enable_logging)
+    enable_logging: bool = True
+    #: rebuild the materializer caches from the log at boot
+    recover_from_log: bool = True
+    #: number of partitions per node (reference ring size, default 16 prod
+    #: / 4 in tests, config/vars.config:5)
+    n_partitions: int = 4
+    #: data directory for durable logs / metadata
+    data_dir: str = "antidote_data"
+    #: metadata gossip / stable-time tick, seconds (reference 1 s)
+    meta_sleep_s: float = 1.0
+    #: partition VC push throttle, seconds (reference 100 ms)
+    vc_push_s: float = 0.1
+    extra: dict = field(default_factory=dict)

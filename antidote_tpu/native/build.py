@@ -1,0 +1,33 @@
+"""On-demand g++ build of the native components (no pip/pybind11 in this
+environment — plain C ABI + ctypes)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
+_LOCK = threading.Lock()
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_BUILD_DIR, f"lib{name}.so")
+
+
+def ensure_built(name: str) -> str | None:
+    """Compile antidote_tpu/native/<name>.cpp into lib<name>.so if stale.
+    Returns the .so path, or None if no compiler is available."""
+    src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+    out = lib_path(name)
+    with _LOCK:
+        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", src, "-o", out]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+        except (FileNotFoundError, subprocess.CalledProcessError):
+            return None
+        return out

@@ -1,0 +1,7 @@
+from antidote_tpu.oplog.log import DurableLog  # noqa: F401
+from antidote_tpu.oplog.partition import PartitionLog  # noqa: F401
+from antidote_tpu.oplog.records import (  # noqa: F401
+    LogRecord,
+    OpId,
+    TxnAssembler,
+)

@@ -1,0 +1,221 @@
+"""Durable append-only log — Python API over the native core.
+
+Mirrors the reference's per-partition disk_log usage (reference
+src/logging_vnode.erl:896-919): buffered appends on the update path,
+fsync only on commit (``sync``), crash recovery truncating a torn tail.
+The record store is byte-payload framing only; record semantics live in
+:mod:`antidote_tpu.oplog.records`.
+
+Backend: ctypes over antidote_tpu/native/oplog.cpp (built on demand); a
+pure-Python fallback with identical behavior exists for environments
+without a compiler and for differential testing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import zlib
+from typing import Iterator, Optional, Tuple
+
+from antidote_tpu.native.build import ensure_built
+
+_HEADER = struct.Struct("<II")  # len, crc32
+
+
+class _NativeBackend:
+    _lib = None
+
+    @classmethod
+    def load(cls):
+        if cls._lib is not None:
+            return cls._lib
+        so = ensure_built("oplog")
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.oplog_open.restype = ctypes.c_void_p
+        lib.oplog_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.oplog_append.restype = ctypes.c_int64
+        lib.oplog_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+        lib.oplog_flush.argtypes = [ctypes.c_void_p]
+        lib.oplog_sync.argtypes = [ctypes.c_void_p]
+        lib.oplog_recover.restype = ctypes.c_int64
+        lib.oplog_recover.argtypes = [ctypes.c_void_p]
+        lib.oplog_end_offset.restype = ctypes.c_int64
+        lib.oplog_end_offset.argtypes = [ctypes.c_void_p]
+        lib.oplog_read.restype = ctypes.c_int64
+        lib.oplog_read.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.c_char_p, ctypes.c_int64]
+        lib.oplog_next.restype = ctypes.c_int64
+        lib.oplog_next.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.oplog_close.argtypes = [ctypes.c_void_p]
+        cls._lib = lib
+        return lib
+
+
+class DurableLog:
+    """One append-only log file with CRC-framed records."""
+
+    def __init__(self, path: str, backend: str = "auto"):
+        self.path = path
+        self._native = None
+        self._py = None
+        lib = _NativeBackend.load() if backend in ("auto", "native") else None
+        if lib is not None:
+            h = lib.oplog_open(path.encode(), 1)
+            if not h:
+                raise OSError(f"cannot open log {path}")
+            self._native = (lib, ctypes.c_void_p(h))
+            lib.oplog_recover(self._native[1])
+        elif backend == "native":
+            raise RuntimeError("native oplog backend unavailable")
+        else:
+            self._py = _PyLog(path)
+
+    @property
+    def backend_name(self) -> str:
+        return "native" if self._native else "python"
+
+    def append(self, payload: bytes) -> int:
+        """Buffered append; returns the record's offset."""
+        if not payload:
+            # recovery treats a zero-length frame as a torn tail; storing
+            # one would truncate every later record on restart
+            raise ValueError("empty log records are not allowed")
+        if self._native:
+            lib, h = self._native
+            off = lib.oplog_append(h, payload, len(payload))
+            if off < 0:
+                raise OSError("append failed")
+            return off
+        return self._py.append(payload)
+
+    def flush(self) -> None:
+        if self._native:
+            self._native[0].oplog_flush(self._native[1])
+        else:
+            self._py.flush()
+
+    def sync(self) -> None:
+        """Flush + fsync — the commit-path durability barrier."""
+        if self._native:
+            self._native[0].oplog_sync(self._native[1])
+        else:
+            self._py.sync()
+
+    def end_offset(self) -> int:
+        if self._native:
+            return self._native[0].oplog_end_offset(self._native[1])
+        return self._py.end
+
+    def read(self, offset: int) -> Optional[bytes]:
+        if self._native:
+            lib, h = self._native
+            n = 4096
+            while True:
+                buf = ctypes.create_string_buffer(n)
+                got = lib.oplog_read(h, offset, buf, n)
+                if got < 0:
+                    return None
+                if got <= n:
+                    return buf.raw[:got]
+                n = int(got)
+        return self._py.read(offset)
+
+    def scan(self, offset: int = 0) -> Iterator[Tuple[int, bytes]]:
+        """Iterate (offset, payload) from ``offset`` to the end."""
+        while True:
+            payload = self.read(offset)
+            if payload is None:
+                return
+            yield offset, payload
+            if self._native:
+                nxt = self._native[0].oplog_next(self._native[1], offset)
+            else:
+                nxt = self._py.next_offset(offset)
+            if nxt < 0:
+                return
+            offset = nxt
+
+    def close(self) -> None:
+        if self._native:
+            self._native[0].oplog_close(self._native[1])
+            self._native = None
+        elif self._py:
+            self._py.close()
+            self._py = None
+
+
+class _PyLog:
+    """Pure-Python twin of the native backend (same on-disk format)."""
+
+    def __init__(self, path: str):
+        self.f = open(path, "a+b")
+        self.f.seek(0, os.SEEK_END)
+        self.end = self.f.tell()
+        self._recover()
+
+    def _recover(self) -> None:
+        self.f.flush()
+        size = os.fstat(self.f.fileno()).st_size
+        off = 0
+        while off + _HEADER.size <= size:
+            self.f.seek(off)
+            hdr = self.f.read(_HEADER.size)
+            if len(hdr) < _HEADER.size:
+                break
+            ln, crc = _HEADER.unpack(hdr)
+            if ln == 0 or off + _HEADER.size + ln > size:
+                break
+            payload = self.f.read(ln)
+            if len(payload) < ln or zlib.crc32(payload) != crc:
+                break
+            off += _HEADER.size + ln
+        if off < size:
+            self.f.truncate(off)
+        self.end = off
+        self.f.seek(0, os.SEEK_END)
+
+    def append(self, payload: bytes) -> int:
+        off = self.end
+        self.f.seek(0, os.SEEK_END)
+        self.f.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
+        self.f.write(payload)
+        self.end += _HEADER.size + len(payload)
+        return off
+
+    def flush(self) -> None:
+        self.f.flush()
+
+    def sync(self) -> None:
+        self.f.flush()
+        os.fsync(self.f.fileno())
+
+    def read(self, offset: int) -> Optional[bytes]:
+        self.f.flush()
+        if offset + _HEADER.size > self.end:
+            return None
+        self.f.seek(offset)
+        ln, crc = _HEADER.unpack(self.f.read(_HEADER.size))
+        if offset + _HEADER.size + ln > self.end:
+            return None
+        payload = self.f.read(ln)
+        if len(payload) < ln or zlib.crc32(payload) != crc:
+            return None
+        return payload
+
+    def next_offset(self, offset: int) -> int:
+        self.f.flush()
+        if offset + _HEADER.size > self.end:
+            return -1
+        self.f.seek(offset)
+        ln, _ = _HEADER.unpack(self.f.read(_HEADER.size))
+        nxt = offset + _HEADER.size + ln
+        self.f.seek(0, os.SEEK_END)
+        return nxt if nxt <= self.end else -1
+
+    def close(self) -> None:
+        self.f.close()

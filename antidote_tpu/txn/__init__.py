@@ -1,0 +1,10 @@
+from antidote_tpu.txn.clock import HybridClock  # noqa: F401
+from antidote_tpu.txn.coordinator import (  # noqa: F401
+    Coordinator,
+    Transaction,
+    TransactionAborted,
+    TxnProperties,
+    TxnState,
+)
+from antidote_tpu.txn.manager import CertificationError, PartitionManager  # noqa: F401
+from antidote_tpu.txn.node import Node  # noqa: F401

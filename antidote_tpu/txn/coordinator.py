@@ -1,0 +1,185 @@
+"""Transaction coordinator — the clocksi_interactive_coord equivalent.
+
+The reference runs one gen_statem per transaction with states
+execute_op / receive_prepared / committing / ... (reference
+src/clocksi_interactive_coord.erl:90-105).  In-process, the same
+protocol is a plain object driven synchronously by the caller:
+
+- snapshot = stable snapshot ⊔ client clock, local entry bumped to now,
+  with a clock wait if the client clock runs ahead (:906-926)
+- updates: type check -> pre-commit hook -> downstream generation
+  (reading own writes) -> durable log append + staging (:965-1038)
+- commit: 0 partitions -> reads-only, causal clock = snapshot;
+  1 partition -> single-commit fast path; N -> 2PC with
+  commit time = max prepare time (:1043-1120)
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.crdt import DownstreamCtx, DownstreamError, get_type, is_type
+from antidote_tpu.txn.manager import CertificationError
+
+
+class TxnState(Enum):
+    ACTIVE = "active"
+    COMMITTED = "committed"
+    ABORTED = "aborted"
+
+
+class TransactionAborted(Exception):
+    pass
+
+
+@dataclass
+class TxnProperties:
+    """Reference txn properties (src/antidote.erl:202-238)."""
+
+    update_clock: bool = True   # False = ignore the client clock
+    certify: Optional[bool] = None  # None = node default
+
+
+@dataclass
+class Transaction:
+    txid: Any
+    snapshot_vc: VC
+    properties: TxnProperties
+    ctx: DownstreamCtx
+    state: TxnState = TxnState.ACTIVE
+    #: key -> (type_name, [effects]) in update order
+    writeset: Dict[Any, Tuple[str, List[Any]]] = field(default_factory=dict)
+    #: partitions touched by updates
+    partitions: List[int] = field(default_factory=list)
+    #: (bucket, key, type_name, op) for post-commit hooks
+    client_ops: List[Tuple] = field(default_factory=list)
+    commit_vc: Optional[VC] = None
+
+    def own_effects(self, key) -> List[Any]:
+        entry = self.writeset.get(key)
+        return entry[1] if entry else []
+
+
+class Coordinator:
+    """Drives transactions against a Node (antidote_tpu/txn/node.py)."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start_transaction(self, client_clock: Optional[VC] = None,
+                          properties: Optional[TxnProperties] = None
+                          ) -> Transaction:
+        props = properties or TxnProperties()
+        node = self.node
+        snap = VC(node.stable_vc())
+        if client_clock and props.update_clock:
+            snap = snap.join(client_clock)
+            # wait for the local clock to pass the client's view of us
+            node.clock.wait_until(client_clock.get_dc(node.dc_id))
+        snap = snap.set_dc(node.dc_id, max(snap.get_dc(node.dc_id),
+                                           node.clock.now_us()))
+        txid = (snap.get_dc(node.dc_id), uuid.uuid4().hex[:12])
+        return Transaction(
+            txid=txid, snapshot_vc=snap, properties=props,
+            ctx=DownstreamCtx(actor=(str(node.dc_id), txid[1])))
+
+    def _check_active(self, tx: Transaction) -> None:
+        if tx.state is not TxnState.ACTIVE:
+            raise TransactionAborted(f"transaction is {tx.state.value}")
+
+    # ---------------------------------------------------------------- reads
+
+    def read_objects(self, tx: Transaction, bound_objects: List) -> List[Any]:
+        self._check_active(tx)
+        out = []
+        for bo in bound_objects:
+            key, type_name, _bucket = self.node.normalize_bound(bo)
+            cls = get_type(type_name)
+            pm = self.node.partition_of(key)
+            value = pm.read_with_writeset(
+                key, cls.name, tx.snapshot_vc, tx.txid, tx.own_effects(key))
+            out.append(cls.value(value))
+        return out
+
+    # -------------------------------------------------------------- updates
+
+    def update_objects(self, tx: Transaction, updates: List) -> None:
+        """[(bound_object, op_name, op_param)] — validate, hook,
+        generate downstream, log, stage."""
+        self._check_active(tx)
+        for upd in updates:
+            bo, op_name, op_param = self.node.normalize_update(upd)
+            key, type_name, bucket = self.node.normalize_bound(bo)
+            cls = get_type(type_name) if is_type(type_name) else None
+            op = (op_name, op_param)
+            if cls is None or not cls.is_operation(op):
+                raise TypeError(f"type_check failed: {type_name} {op!r}")
+            try:
+                key2, type_name2, op = self.node.hooks.run_pre(
+                    bucket, key, type_name, op)
+            except Exception as e:
+                self.abort_transaction(tx)
+                raise TransactionAborted(f"pre-commit hook failed: {e}") from e
+            cls = get_type(type_name2)
+            pm = self.node.partition_of(key2)
+            try:
+                state = None
+                if cls.require_state_downstream(op):
+                    state = pm.read_with_writeset(
+                        key2, cls.name, tx.snapshot_vc, tx.txid,
+                        tx.own_effects(key2))
+                effect = self.node.gen_downstream(cls, op, state, tx.ctx)
+            except DownstreamError as e:
+                self.abort_transaction(tx)
+                raise TransactionAborted(f"downstream failed: {e}") from e
+            pm.stage_update(tx.txid, key2, cls.name, effect)
+            entry = tx.writeset.setdefault(key2, (cls.name, []))
+            entry[1].append(effect)
+            if pm.partition not in tx.partitions:
+                tx.partitions.append(pm.partition)
+            tx.client_ops.append((bucket, key2, cls.name, op))
+
+    # --------------------------------------------------------------- commit
+
+    def commit_transaction(self, tx: Transaction) -> VC:
+        self._check_active(tx)
+        node = self.node
+        certify = (tx.properties.certify
+                   if tx.properties.certify is not None else node.config.certify)
+        try:
+            if not tx.partitions:
+                commit_vc = tx.snapshot_vc
+            elif len(tx.partitions) == 1:
+                pm = node.partitions[tx.partitions[0]]
+                ct = pm.single_commit(tx.txid, tx.snapshot_vc, certify)
+                commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
+            else:
+                pms = [node.partitions[p] for p in tx.partitions]
+                prepare_times = [
+                    pm.prepare(tx.txid, tx.snapshot_vc, certify) for pm in pms
+                ]
+                ct = max(prepare_times)
+                for pm in pms:
+                    pm.commit(tx.txid, ct, tx.snapshot_vc)
+                commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
+        except CertificationError as e:
+            self.abort_transaction(tx)
+            raise TransactionAborted(str(e)) from e
+        tx.state = TxnState.COMMITTED
+        tx.commit_vc = commit_vc
+        for bucket, key, type_name, op in tx.client_ops:
+            node.hooks.run_post(bucket, key, type_name, op)
+        return commit_vc
+
+    def abort_transaction(self, tx: Transaction) -> None:
+        if tx.state is not TxnState.ACTIVE:
+            return
+        for p in tx.partitions:
+            self.node.partitions[p].abort(tx.txid)
+        tx.state = TxnState.ABORTED

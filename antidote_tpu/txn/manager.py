@@ -1,0 +1,190 @@
+"""Per-partition transaction participant — the clocksi_vnode equivalent.
+
+Owns the partition's prepared/committed bookkeeping, write-write
+certification, Clock-SI read gating, the durable log, and the host
+materializer store (reference src/clocksi_vnode.erl:253-678 and
+src/clocksi_readitem_server.erl:217-288).
+
+Concurrency model: the reference uses one vnode process + 20 read
+servers with shared-ETS lock-free reads; here a per-partition lock +
+condition variable — reads that must wait for a conflicting prepared
+transaction block on the condition until commit/abort notifies
+(check_prepared_list semantics, src/clocksi_readitem_server.erl:254-264).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.mat.host_store import HostStore
+from antidote_tpu.mat.materializer import Payload, materialize_eager
+from antidote_tpu.oplog.partition import PartitionLog
+from antidote_tpu.txn.clock import HybridClock
+
+
+class CertificationError(Exception):
+    """Write-write certification failed — transaction must abort."""
+
+
+class PartitionManager:
+    def __init__(self, partition: int, dc_id, log: PartitionLog,
+                 clock: HybridClock, read_wait_timeout: float = 5.0):
+        self.partition = partition
+        self.dc_id = dc_id
+        self.log = log
+        self.clock = clock
+        self.store = HostStore(log_fallback=log.committed_payloads)
+        self.read_wait_timeout = read_wait_timeout
+        self._lock = threading.Condition()
+        #: txid -> (prepare_time, [keys])
+        self.prepared: Dict[Any, Tuple[int, List[Any]]] = {}
+        #: key -> last committed time at this DC
+        self.committed: Dict[Any, int] = {}
+        #: ops staged per txid before commit (the txn's effects on this
+        #: partition, already in the durable log)
+        self._staged: Dict[Any, List[Tuple[Any, str, Any]]] = {}
+        #: latest commit time at this partition (feeds the stable plane)
+        self.max_committed_time = 0
+
+    # ------------------------------------------------------------ updates
+
+    def stage_update(self, txid, key, type_name: str, effect) -> None:
+        """Log the update record and stage it for commit (the reference's
+        async append + FSM ack path, src/clocksi_interactive_coord.erl:1029-1038)."""
+        with self._lock:
+            self.log.append_update(self.dc_id, txid, key, type_name, effect)
+            self._staged.setdefault(txid, []).append((key, type_name, effect))
+
+    # -------------------------------------------------------- 2PC on this partition
+
+    def certify(self, txid, keys: List[Any], snapshot_vc: VC) -> None:
+        """Write-write certification (reference certification_check,
+        src/clocksi_vnode.erl:588-632): abort if a key was committed after
+        the txn's local snapshot, or is prepared by another transaction."""
+        local_start = snapshot_vc.get_dc(self.dc_id)
+        for key in keys:
+            if self.committed.get(key, 0) > local_start:
+                raise CertificationError(f"key {key!r} committed after snapshot")
+        for other_tx, (_pt, pkeys) in self.prepared.items():
+            if other_tx == txid:
+                continue
+            if any(k in pkeys for k in keys):
+                raise CertificationError("key prepared by concurrent txn")
+
+    def prepare(self, txid, snapshot_vc: VC, certify: bool = True) -> int:
+        """Certify + log a prepare record; returns the prepare time."""
+        with self._lock:
+            keys = [k for k, _t, _e in self._staged.get(txid, [])]
+            if certify:
+                self.certify(txid, keys, snapshot_vc)
+            pt = self.clock.now_us()
+            self.prepared[txid] = (pt, keys)
+            self.log.append_prepare(self.dc_id, txid, pt)
+            return pt
+
+    def commit(self, txid, commit_time: int, snapshot_vc: VC) -> None:
+        """Log the commit (fsync per config), publish the effects to the
+        materializer store, release prepared state and wake blocked
+        readers (reference commit handler src/clocksi_vnode.erl:499-531,
+        update_materializer :634-657)."""
+        with self._lock:
+            self.log.append_commit(self.dc_id, txid, commit_time, snapshot_vc)
+            for key, type_name, effect in self._staged.pop(txid, []):
+                payload = Payload(
+                    key=key, type_name=type_name, effect=effect,
+                    commit_dc=self.dc_id, commit_time=commit_time,
+                    snapshot_vc=snapshot_vc, txid=txid)
+                self.store.insert(key, type_name, payload,
+                                  stable_vc=snapshot_vc)
+                if commit_time > self.committed.get(key, 0):
+                    self.committed[key] = commit_time
+            self.prepared.pop(txid, None)
+            self.max_committed_time = max(self.max_committed_time, commit_time)
+            self._lock.notify_all()
+
+    def single_commit(self, txid, snapshot_vc: VC,
+                      certify: bool = True) -> int:
+        """One-partition fast path: prepare + commit in one step
+        (reference single_commit, src/clocksi_vnode.erl:180-190)."""
+        with self._lock:
+            keys = [k for k, _t, _e in self._staged.get(txid, [])]
+            if certify:
+                self.certify(txid, keys, snapshot_vc)
+            ct = self.clock.now_us()
+            self.prepared[txid] = (ct, keys)
+        self.commit(txid, ct, snapshot_vc)
+        return ct
+
+    def abort(self, txid) -> None:
+        with self._lock:
+            if txid in self._staged or txid in self.prepared:
+                self.log.append_abort(self.dc_id, txid)
+            self._staged.pop(txid, None)
+            self.prepared.pop(txid, None)
+            self._lock.notify_all()
+
+    # --------------------------------------------------------------- reads
+
+    def _blocking_prepared(self, key, snapshot_vc: VC, txid) -> bool:
+        local = snapshot_vc.get_dc(self.dc_id)
+        for other_tx, (pt, pkeys) in self.prepared.items():
+            if other_tx != txid and pt <= local and key in pkeys:
+                return True
+        return False
+
+    def read(self, key, type_name: str, snapshot_vc: Optional[VC],
+             txid=None) -> Any:
+        """Clock-SI safe read: wait until the local clock passed the
+        snapshot and no conflicting prepared txn may commit below it
+        (reference check_clock/check_prepared,
+        src/clocksi_readitem_server.erl:236-264), then materialize."""
+        if snapshot_vc is not None:
+            # clock wait happens outside the lock (it can be long and
+            # must not stall commits on this partition)
+            self.clock.wait_until(snapshot_vc.get_dc(self.dc_id))
+        with self._lock:
+            if snapshot_vc is not None:
+                deadline = time.monotonic() + self.read_wait_timeout
+                while self._blocking_prepared(key, snapshot_vc, txid):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._lock.wait(timeout=remaining):
+                        raise TimeoutError(
+                            f"read of {key!r} blocked on prepared txn")
+            # store access stays under the partition lock: commit()
+            # mutates the same entries (one-writer semantics, like the
+            # reference's single vnode process + shared-ETS readers)
+            value, _vc = self.store.read(key, type_name, snapshot_vc,
+                                         txid=txid)
+        return value
+
+    def read_with_writeset(self, key, type_name: str, snapshot_vc,
+                           txid, own_effects: List[Any]) -> Any:
+        """Read + replay the transaction's own uncommitted effects
+        (read-your-writes, reference apply_tx_updates_to_snapshot,
+        src/clocksi_interactive_coord.erl:880-894)."""
+        value = self.read(key, type_name, snapshot_vc, txid=txid)
+        if own_effects:
+            value = materialize_eager(type_name, value, own_effects)
+        return value
+
+    # ------------------------------------------------------- stable plane
+
+    def min_prepared(self) -> int:
+        """Min prepare time of in-flight txns (caps the stable time so a
+        snapshot never passes a pending commit; reference get_min_prep,
+        src/clocksi_vnode.erl:671-678)."""
+        with self._lock:
+            if self.prepared:
+                return min(pt for pt, _ in self.prepared.values())
+            return self.clock.now_us()
+
+    def value_snapshot(self, key, type_name: str,
+                       clock: Optional[VC] = None) -> Any:
+        """Committed value at ``clock`` (None = latest) without Clock-SI
+        gating (get_objects path); store access under the partition lock."""
+        with self._lock:
+            value, _ = self.store.read(key, type_name, clock)
+        return value

@@ -1,0 +1,248 @@
+"""Single-DC transaction-protocol tests.
+
+Ports the observable behavior of the reference's clocksi_SUITE /
+antidote_SUITE / commit_hooks_SUITE single-DC cases (reference
+test/singledc/clocksi_SUITE.erl:78-92, test/singledc/antidote_SUITE.erl,
+test/singledc/commit_hooks_SUITE.erl): read-your-writes, causal chaining
+through commit clocks, certification aborts, multi-partition 2PC,
+static txns, hooks, and log recovery.
+"""
+
+import threading
+
+import pytest
+
+from antidote_tpu.api import AntidoteTPU, TransactionAborted, TxnProperties
+from antidote_tpu.clocks import VC
+
+
+@pytest.fixture
+def db(tmp_path):
+    db = AntidoteTPU(dc_id="dc1", data_dir=str(tmp_path / "data"))
+    yield db
+    db.close()
+
+
+def test_static_counter_roundtrip(db):
+    bo = ("k_ctr", "counter_pn")
+    clock = db.update_objects_static(None, [(bo, "increment", 5)])
+    vals, _ = db.read_objects_static(clock, [bo])
+    assert vals == [5]
+    clock2 = db.update_objects_static(clock, [(bo, "decrement", 2)])
+    vals, _ = db.read_objects_static(clock2, [bo])
+    assert vals == [3]
+
+
+def test_interactive_read_your_writes(db):
+    bo = ("k_set", "set_aw")
+    tx = db.start_transaction()
+    assert db.read_objects([bo], tx) == [[]]
+    db.update_objects([(bo, "add", b"x")], tx)
+    assert db.read_objects([bo], tx) == [[b"x"]]  # own write visible
+    db.update_objects([(bo, "add_all", [b"y", b"z"]), (bo, "remove", b"x")], tx)
+    assert db.read_objects([bo], tx) == [[b"y", b"z"]]
+    clock = db.commit_transaction(tx)
+    vals, _ = db.read_objects_static(clock, [bo])
+    assert vals == [[b"y", b"z"]]
+
+
+def test_snapshot_isolation_against_later_commit(db):
+    bo = ("k_iso", "counter_pn")
+    c1 = db.update_objects_static(None, [(bo, "increment", 1)])
+    tx = db.start_transaction(c1)  # snapshot fixed here
+    c2 = db.update_objects_static(c1, [(bo, "increment", 10)])
+    assert c2.gt(c1)
+    # the open txn must not see the later commit
+    assert db.read_objects([bo], tx) == [1]
+    db.commit_transaction(tx)
+    vals, _ = db.read_objects_static(c2, [bo])
+    assert vals == [11]
+
+
+def test_multikey_multipartition_2pc(db):
+    bos = [(f"k2pc_{i}", "counter_pn") for i in range(8)]  # spread partitions
+    tx = db.start_transaction()
+    db.update_objects([(bo, "increment", i) for i, bo in enumerate(bos)], tx)
+    clock = db.commit_transaction(tx)
+    assert len(tx.partitions) > 1  # really exercised 2PC
+    vals, _ = db.read_objects_static(clock, bos)
+    assert vals == list(range(8))
+
+
+def test_certification_abort_on_conflict(db):
+    bo = ("k_conflict", "counter_pn")
+    base = db.update_objects_static(None, [(bo, "increment", 1)])
+    tx1 = db.start_transaction(base)
+    tx2 = db.start_transaction(base)
+    db.update_objects([(bo, "increment", 10)], tx1)
+    db.update_objects([(bo, "increment", 100)], tx2)
+    c1 = db.commit_transaction(tx1)
+    with pytest.raises(TransactionAborted):
+        db.commit_transaction(tx2)
+    vals, _ = db.read_objects_static(c1, [bo])
+    assert vals == [11]
+
+
+def test_certification_disabled_allows_conflict(db):
+    bo = ("k_nocert", "counter_pn")
+    props = TxnProperties(certify=False)
+    tx1 = db.start_transaction(None, props)
+    tx2 = db.start_transaction(None, props)
+    db.update_objects([(bo, "increment", 1)], tx1)
+    db.update_objects([(bo, "increment", 2)], tx2)
+    c1 = db.commit_transaction(tx1)
+    c2 = db.commit_transaction(tx2)
+    vals, _ = db.read_objects_static(c1.join(c2), [bo])
+    assert vals == [3]  # counters merge; no abort
+
+
+def test_abort_discards_staged_updates(db):
+    bo = ("k_abort", "counter_pn")
+    tx = db.start_transaction()
+    db.update_objects([(bo, "increment", 42)], tx)
+    db.abort_transaction(tx)
+    with pytest.raises(TransactionAborted):
+        db.commit_transaction(tx)
+    vals, _ = db.read_objects_static(None, [bo])
+    assert vals == [0]
+
+
+def test_all_crdt_types_through_api(db):
+    """Mirrors pb_client_SUITE's every-type round-trip."""
+    cases = [
+        (("t_pn", "counter_pn"), [("increment", 3)], 3),
+        (("t_fat", "counter_fat"), [("increment", 7), ("reset", ())], 0),
+        (("t_lww", "register_lww"), [("assign", b"v")], b"v"),
+        (("t_mv", "register_mv"), [("assign", b"a")], [b"a"]),
+        (("t_go", "set_go"), [("add", b"x")], [b"x"]),
+        (("t_aw", "antidote_crdt_set_aw"),
+         [("add_all", [b"a", b"b"]), ("remove", b"a")], [b"b"]),
+        (("t_rw", "set_rw"), [("add", b"a"), ("remove", b"a")], []),
+        (("t_few", "flag_ew"), [("enable", ())], True),
+        (("t_fdw", "flag_dw"), [("enable", ()), ("disable", ())], False),
+        (("t_mgo", "map_go"),
+         [("update", ((b"c", "counter_pn"), ("increment", 2)))],
+         {(b"c", "counter_pn"): 2}),
+        (("t_mrr", "map_rr"),
+         [("update", ((b"r", "register_mv"), ("assign", b"z")))],
+         {(b"r", "register_mv"): [b"z"]}),
+        (("t_rga", "rga"),
+         [("add_right", (0, "a")), ("add_right", (1, "b"))], ["a", "b"]),
+    ]
+    clock = None
+    for bo, ops, _expected in cases:
+        for op_name, arg in ops:
+            clock = db.update_objects_static(clock, [(bo, op_name, arg)])
+    vals, _ = db.read_objects_static(clock, [bo for bo, _o, _e in cases])
+    assert vals == [e for _bo, _o, e in cases]
+
+
+def test_bound_counter_through_api(db):
+    bo = ("t_bc", "counter_b")
+    clock = db.update_objects_static(
+        None, [(bo, "increment", (10, "dc1"))])
+    clock = db.update_objects_static(clock, [(bo, "decrement", (4, "dc1"))])
+    vals, _ = db.read_objects_static(clock, [bo])
+    assert vals == [6]
+    with pytest.raises(TransactionAborted):
+        db.update_objects_static(clock, [(bo, "decrement", (100, "dc1"))])
+
+
+def test_pre_commit_hook_transforms_and_aborts(db):
+    """Reference commit_hooks_SUITE: pre hook may rewrite or reject."""
+    def double_increments(key, type_name, op):
+        name, arg = op
+        return key, type_name, (name, arg * 2)
+
+    db.register_pre_hook("dbl", double_increments)
+    bo = ("hk", "counter_pn", "dbl")
+    clock = db.update_objects_static(None, [(bo, "increment", 3)])
+    vals, _ = db.read_objects_static(clock, [bo])
+    assert vals == [6]
+
+    def reject(key, type_name, op):
+        raise ValueError("nope")
+
+    db.register_pre_hook("rej", reject)
+    with pytest.raises(TransactionAborted):
+        db.update_objects_static(None, [(("hk2", "counter_pn", "rej"),
+                                         "increment", 1)])
+
+
+def test_post_commit_hook_runs_and_failures_ignored(db):
+    seen = []
+    db.register_post_hook("log", lambda k, t, op: seen.append((k, op)))
+    db.register_post_hook("boom", lambda k, t, op: 1 / 0)
+    clock = db.update_objects_static(
+        None, [(("pk", "counter_pn", "log"), "increment", 1)])
+    assert seen == [("pk", ("increment", 1))]
+    # failing post hook must not fail the txn
+    clock = db.update_objects_static(
+        clock, [(("pk2", "counter_pn", "boom"), "increment", 1)])
+    vals, _ = db.read_objects_static(clock, [("pk2", "counter_pn", "boom")])
+    assert vals == [1]
+
+
+def test_get_objects_and_log_operations(db):
+    bo = ("gl", "counter_pn")
+    c1 = db.update_objects_static(None, [(bo, "increment", 1)])
+    c2 = db.update_objects_static(c1, [(bo, "increment", 2)])
+    assert db.get_objects([bo]) == [3]
+    # ops strictly newer than c1: just the second increment
+    [ops] = db.get_log_operations([(bo, c1)])
+    assert [p.effect for p in ops] == [2]
+    [ops_all] = db.get_log_operations([(bo, VC())])
+    assert [p.effect for p in ops_all] == [1, 2]
+    assert c2.gt(c1)
+
+
+def test_log_recovery_replays_committed_state(tmp_path):
+    """Reference log_recovery_SUITE: kill the node, restart, state must
+    be rebuilt from the durable log."""
+    data = str(tmp_path / "data")
+    db = AntidoteTPU(dc_id="dc1", data_dir=data)
+    bo = ("rec_k", "set_aw")
+    clock = None
+    for i in range(15):
+        clock = db.update_objects_static(
+            clock, [(bo, "add", f"e{i}".encode())])
+    db.update_objects_static(clock, [(bo, "remove", b"e0")])
+    expected = sorted(f"e{i}".encode() for i in range(1, 15))
+    db.close()  # "kill"
+
+    db2 = AntidoteTPU(dc_id="dc1", data_dir=data)
+    vals, _ = db2.read_objects_static(None, [bo])
+    assert vals == [expected]
+    # and writes continue cleanly after recovery
+    c = db2.update_objects_static(None, [(bo, "add", b"post")])
+    vals, _ = db2.read_objects_static(c, [bo])
+    assert vals == [sorted(expected + [b"post"])]
+    db2.close()
+
+
+def test_concurrent_threads_certification(db):
+    """Two threads race increments on one key with certification on:
+    some may abort, but the final value equals the committed sum."""
+    bo = ("race", "counter_pn")
+    committed = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(10):
+            try:
+                tx = db.start_transaction()
+                db.update_objects([(bo, "increment", 1)], tx)
+                db.commit_transaction(tx)
+                with lock:
+                    committed.append(1)
+            except TransactionAborted:
+                pass
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    vals, _ = db.read_objects_static(None, [bo])
+    assert vals == [len(committed)]
+    assert committed  # at least some committed
