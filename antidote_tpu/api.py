@@ -27,8 +27,10 @@ class AntidoteTPU:
     """One DC node with the reference's client API."""
 
     def __init__(self, dc_id="dc1", config: Optional[Config] = None,
-                 data_dir: Optional[str] = None):
-        self.node = Node(dc_id=dc_id, config=config, data_dir=data_dir)
+                 data_dir: Optional[str] = None,
+                 node: Optional[Node] = None):
+        self.node = node if node is not None else Node(
+            dc_id=dc_id, config=config, data_dir=data_dir)
 
     # ------------------------------------------------------- interactive txn
 
@@ -90,7 +92,9 @@ class AntidoteTPU:
         for bo, clock in object_clock_pairs:
             key, _type_name, _b = self.node.normalize_bound(bo)
             pm = self.node.partition_of(key)
-            ops = pm.log.committed_payloads(key=key, from_vc=clock)
+            # scans share the appenders' file handle — serialize with them
+            with pm._lock:
+                ops = pm.log.committed_payloads(key=key, from_vc=clock)
             out.append([p for _i, p in ops])
         return out
 

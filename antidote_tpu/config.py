@@ -32,4 +32,14 @@ class Config:
     meta_sleep_s: float = 1.0
     #: partition VC push throttle, seconds (reference 100 ms)
     vc_push_s: float = 0.1
+    #: inter-DC heartbeat period, seconds (reference ?HEARTBEAT_PERIOD
+    #: 1 s, include/antidote.hrl:55)
+    heartbeat_s: float = 1.0
+    #: reload DC descriptors / env flags from disk at boot (reference
+    #: recover_meta_data_on_start)
+    recover_meta_data_on_start: bool = True
+    #: cap on the causal clock wait (the reference spins forever,
+    #: src/clocksi_interactive_coord.erl:915-926; a cap keeps tests and
+    #: batch jobs from hanging on an unreachable dependency)
+    clock_wait_timeout_s: float = 30.0
     extra: dict = field(default_factory=dict)

@@ -5,9 +5,12 @@ src/antidote_hooks.erl:29-53, 92-164): a pre-commit hook runs at update
 time and may transform the operation or fail the transaction; a
 post-commit hook runs after commit and its failures are only logged.
 
-Hook signature: ``hook((key, bucket), type_name, op) -> (key_bucket,
-type_name, op)`` for pre-commit (return a possibly transformed triple,
-raise to abort); post-commit hooks' return value is ignored.
+Hook signature: ``hook(key, type_name, op) -> (key, type_name, op)``
+for pre-commit (return a possibly transformed triple, raise to abort);
+post-commit hooks take the same arguments and their return value is
+ignored.  Hooks are selected by the bucket they were registered under
+(the reference passes {Key, Bucket} as one tuple; here the bucket is
+implicit in the registration).
 """
 
 from __future__ import annotations

@@ -1,0 +1,67 @@
+"""Per-partition inter-DC log sender.
+
+Every local log append streams here (reference src/logging_vnode.erl:422
+→ src/inter_dc_log_sender_vnode.erl:119-131); a TxnAssembler groups the
+records per txid until the commit record arrives, then the whole txn is
+broadcast with the stream's opid watermark.  A periodic heartbeat/ping
+carries the partition's min-prepared time so remote GSTs keep advancing
+through quiet periods (reference :133-143, ?HEARTBEAT_PERIOD
+include/antidote.hrl:55).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from antidote_tpu.interdc.transport import Transport
+from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.oplog.records import LogRecord, TxnAssembler
+
+
+class InterDcLogSender:
+    def __init__(self, dc_id, partition: int, transport: Transport,
+                 enabled: bool = True):
+        self.dc_id = dc_id
+        self.partition = partition
+        self.transport = transport
+        #: publishing gate: off until the DC joins a cluster (reference
+        #: start_bg_processes ordering, src/inter_dc_manager.erl:112-145)
+        self.enabled = enabled
+        self.assembler = TxnAssembler()
+        #: opid watermark of the last broadcast record for this stream
+        #: (seeded from the recovered log at restart by the manager,
+        #: reference {start_timer} handler src/logging_vnode.erl:301-322)
+        self.last_sent_opid = 0
+        self._lock = threading.Lock()
+
+    def on_append(self, rec: LogRecord) -> None:
+        """Tap for locally-appended records.  Only records originated by
+        this DC stream out (remote records are re-broadcast by nobody —
+        full-mesh topology, reference inter_dc_query_response returns
+        locally-originated txns only)."""
+        if rec.op_id.dc != self.dc_id:
+            return
+        done = self.assembler.process(rec)
+        if done is None:
+            return
+        with self._lock:
+            txn = InterDcTxn.from_ops(self.dc_id, self.partition,
+                                      self.last_sent_opid, done)
+            self.last_sent_opid = txn.last_opid()
+        if self.enabled:
+            self.transport.publish(self.dc_id, txn.to_bin())
+
+    def ping(self, min_prepared_time: int) -> None:
+        """Broadcast a heartbeat carrying this partition's min-prepared
+        time (reference ping path src/inter_dc_log_sender_vnode.erl:133-143)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            txn = InterDcTxn.ping(self.dc_id, self.partition,
+                                  self.last_sent_opid, min_prepared_time)
+        self.transport.publish(self.dc_id, txn.to_bin())
+
+    def seed_watermark(self, opid: int) -> None:
+        with self._lock:
+            self.last_sent_opid = max(self.last_sent_opid, opid)

@@ -1,0 +1,90 @@
+"""Gap detection / message-loss repair for one (origin DC, partition)
+inbound stream.
+
+Mirrors inter_dc_sub_buf (reference src/inter_dc_sub_buf.erl): compare
+the incoming txn's ``prev_log_opid`` with the last opid this replica has
+observed for the stream —
+
+- equal   → deliver, advance the watermark,
+- smaller → duplicate, drop,
+- larger  → messages were lost: enter ``buffering``, queue the txn, and
+  ask the origin DC's log reader for the missing opid range
+  (src/inter_dc_sub_buf.erl:112-142, query :155-158).
+
+On first contact the watermark is seeded from the local durable log so a
+restarted replica resumes where it crashed (src/inter_dc_sub_buf.erl:58-76).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, List, Optional
+
+from antidote_tpu.interdc.wire import InterDcTxn
+
+
+class SubBuf:
+    def __init__(self, origin_dc, partition: int,
+                 deliver: Callable[[InterDcTxn], None],
+                 fetch_range: Callable[[Any, int, int, int],
+                                       Optional[List[InterDcTxn]]],
+                 last_opid: int = 0):
+        self.origin_dc = origin_dc
+        self.partition = partition
+        #: hand the txn to the dependency gate
+        self._deliver = deliver
+        #: fetch_range(origin_dc, partition, first, last) -> [InterDcTxn]
+        #: or None when the origin is unreachable (repair retried on the
+        #: next incoming frame)
+        self._fetch_range = fetch_range
+        self.last_opid = last_opid
+        self.state = "normal"  # | "buffering"
+        self._queue: deque = deque()
+
+    def process(self, txn: InterDcTxn) -> None:
+        if self.state == "buffering":
+            self._queue.append(txn)
+            self._try_repair()
+            return
+        self._handle(txn)
+
+    def _handle(self, txn: InterDcTxn) -> None:
+        if txn.prev_log_opid == self.last_opid:
+            self._deliver(txn)
+            self.last_opid = txn.last_opid()
+        elif txn.prev_log_opid < self.last_opid:
+            # duplicate / already covered (e.g. replayed after restart)
+            return
+        else:
+            self._queue.append(txn)
+            self.state = "buffering"
+            self._try_repair()
+
+    def _try_repair(self) -> None:
+        """Fetch (last_opid, first_queued.prev_log_opid] from the origin
+        and drain; stays in buffering if the origin is unreachable."""
+        while self._queue:
+            head = self._queue[0]
+            if head.prev_log_opid <= self.last_opid:
+                txn = self._queue.popleft()
+                if txn.prev_log_opid == self.last_opid:
+                    self._deliver(txn)
+                    self.last_opid = txn.last_opid()
+                # else: duplicate, drop
+                continue
+            missing = self._fetch_range(self.origin_dc, self.partition,
+                                        self.last_opid + 1,
+                                        head.prev_log_opid)
+            if missing is None:
+                return  # origin unreachable; retry on next frame
+            for txn in sorted(missing, key=lambda t: t.last_opid()):
+                if txn.last_opid() > self.last_opid:
+                    self._deliver(txn)
+                    self.last_opid = txn.last_opid()
+            # A successful answer authoritatively covers the requested
+            # range: opids in it that came back are applied above, and
+            # ones that didn't belong to aborted/uncommitted records that
+            # will never be broadcast — so the watermark advances to the
+            # head's prev even if nothing (or not everything) came back.
+            self.last_opid = max(self.last_opid, head.prev_log_opid)
+        self.state = "normal"

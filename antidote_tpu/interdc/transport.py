@@ -1,0 +1,177 @@
+"""Inter-DC transport abstraction + in-process bus.
+
+The reference's transport is ZeroMQ (erlzmq2 C NIF): PUB/SUB for the txn
+stream and REQ/ROUTER for log-repair / bounded-counter RPC (reference
+src/inter_dc_pub.erl, src/inter_dc_sub.erl, src/inter_dc_query.erl,
+src/zmq_utils.erl).  Here the same two channels sit behind a small
+interface so simulated multi-DC runs (tests, benchmarks) use an
+in-process bus, and real deployments use the native TCP transport
+(antidote_tpu/native, task: erlzmq replacement).
+
+The in-process bus also carries the test-side failure injection the
+reference gets from its harness: per-link down/up (cookie-partition
+analogue, reference test/utils/test_utils.erl:239-256) and message-drop
+windows for exercising the gap-repair path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from antidote_tpu.interdc.wire import DcDescriptor
+
+
+class LinkDown(Exception):
+    """Request channel unavailable (partitioned or unknown DC)."""
+
+
+class Transport:
+    """Both channels of the inter-DC fabric."""
+
+    def publish(self, origin, data: bytes) -> None:
+        """Broadcast a txn frame to every connected subscriber (PUB side,
+        reference src/inter_dc_pub.erl:87-92)."""
+        raise NotImplementedError
+
+    def request(self, origin, target, kind: str, payload) -> Any:
+        """Synchronous RPC to ``target``'s query handler (REQ/ROUTER side,
+        reference src/inter_dc_query.erl:76-79).  Raises LinkDown when the
+        target is unreachable."""
+        raise NotImplementedError
+
+
+class InProcBus(Transport):
+    """Registry of DCs in one process.
+
+    Published frames are *enqueued* per subscriber and drained either by
+    the subscriber's background delivery thread or by an explicit
+    ``pump()`` (deterministic tests) — mirroring the reference's
+    asynchronous ZMQ delivery, and avoiding cross-DC lock chains (the
+    publisher may hold partition locks while broadcasting, exactly like
+    logging_vnode does when it forwards appends).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        #: dc_id -> (descriptor, inbox queue, query handler)
+        self._dcs: Dict[Any, Tuple[DcDescriptor, "queue.Queue[bytes]",
+                                   Callable]] = {}
+        #: (a, b) unordered pairs that are DOWN
+        self._cut: set = set()
+        #: dc_ids whose *inbound* pub/sub frames are dropped (message-loss
+        #: injection for the gap-repair tests)
+        self._drop_rx: set = set()
+
+    # ------------------------------------------------------------ registry
+
+    def register(self, desc: DcDescriptor,
+                 query_handler: Callable[[Any, str, Any], Any]
+                 ) -> "queue.Queue[bytes]":
+        inbox: "queue.Queue[bytes]" = queue.Queue()
+        with self._lock:
+            self._dcs[desc.dc_id] = (desc, inbox, query_handler)
+        return inbox
+
+    def unregister(self, dc_id) -> None:
+        with self._lock:
+            self._dcs.pop(dc_id, None)
+
+    def descriptor(self, dc_id) -> DcDescriptor:
+        with self._lock:
+            if dc_id not in self._dcs:
+                raise LinkDown(f"unknown DC {dc_id!r}")
+            return self._dcs[dc_id][0]
+
+    def dc_ids(self) -> List[Any]:
+        with self._lock:
+            return list(self._dcs.keys())
+
+    # ---------------------------------------------------- failure injection
+
+    def set_link(self, a, b, up: bool) -> None:
+        """Partition / heal the pair of DCs (both channels)."""
+        pair = frozenset((a, b))
+        with self._lock:
+            if up:
+                self._cut.discard(pair)
+            else:
+                self._cut.add(pair)
+
+    def link_up(self, a, b) -> bool:
+        return frozenset((a, b)) not in self._cut
+
+    def set_drop_rx(self, dc_id, drop: bool) -> None:
+        """Silently drop pub/sub frames inbound to ``dc_id`` (lost-message
+        injection; the request channel stays up so gap repair can run)."""
+        with self._lock:
+            if drop:
+                self._drop_rx.add(dc_id)
+            else:
+                self._drop_rx.discard(dc_id)
+
+    # ------------------------------------------------------------- channels
+
+    def publish(self, origin, data: bytes) -> None:
+        with self._lock:
+            targets = [(dc_id, inbox) for dc_id, (_d, inbox, _q)
+                       in self._dcs.items() if dc_id != origin]
+            targets = [(dc_id, inbox) for dc_id, inbox in targets
+                       if self.link_up(origin, dc_id)
+                       and dc_id not in self._drop_rx]
+        for _dc_id, inbox in targets:
+            inbox.put(data)
+
+    def request(self, origin, target, kind: str, payload) -> Any:
+        with self._lock:
+            if not self.link_up(origin, target):
+                raise LinkDown(f"link {origin!r}-{target!r} is down")
+            if target not in self._dcs:
+                raise LinkDown(f"unknown DC {target!r}")
+            handler = self._dcs[target][2]
+        return handler(origin, kind, payload)
+
+
+class InboxWorker:
+    """Background delivery thread draining one DC's inbox (the reference's
+    per-socket ZMQ receive loop, src/inter_dc_sub.erl:89-95)."""
+
+    def __init__(self, inbox: "queue.Queue[bytes]",
+                 deliver: Callable[[bytes], None]):
+        self.inbox = inbox
+        self.deliver = deliver
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data = self.inbox.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self.deliver(data)
+
+    def pump(self, max_frames: int = 100000) -> int:
+        """Drain synchronously (deterministic mode); returns frames handled."""
+        n = 0
+        while n < max_frames:
+            try:
+                data = self.inbox.get_nowait()
+            except queue.Empty:
+                break
+            self.deliver(data)
+            n += 1
+        return n
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,124 @@
+"""Inter-DC wire format.
+
+Mirrors the reference's ``#interdc_txn{}`` record (reference
+include/inter_dc_repl.hrl:16-25) and its binary framing
+(src/inter_dc_txn.erl:95-105): a fixed-width big-endian partition-id
+prefix — the pub/sub subscription topic — followed by the serialized
+body.  An empty ``records`` list is a heartbeat/ping
+(src/inter_dc_txn.erl:63-71).
+
+``prev_log_opid`` is the origin stream's opid watermark *before* this
+txn: the op number of the last record previously broadcast for this
+(origin DC, partition) stream.  The commit record is appended last at
+the origin, so it carries the stream's highest opid at commit time —
+``last_opid()`` below — and watermarks are monotone per stream even when
+concurrent transactions interleave their update records in the log.
+Gap repair compares exactly these two numbers
+(src/inter_dc_sub_buf.erl:98-142).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.oplog.records import LogRecord
+
+#: topic prefix width (the reference uses 20 bytes for sext-encoded ids,
+#: include/antidote_message_types.hrl:17; 8-byte big-endian is enough
+#: for integer partition ids and keeps prefix-match subscription)
+PARTITION_PREFIX_LEN = 8
+
+
+@dataclass
+class InterDcTxn:
+    dc_id: Any
+    partition: int
+    #: opid watermark of this stream before this txn (gap detection)
+    prev_log_opid: int
+    #: the txn's snapshot VC (causal dependencies); None for heartbeats
+    snapshot_vc: Optional[VC]
+    #: commit time at the origin DC — or the stable/min-prepared time for
+    #: heartbeats (src/inter_dc_log_sender_vnode.erl:133-143)
+    timestamp: int
+    #: update records + the trailing commit record; [] = heartbeat
+    records: List[LogRecord] = field(default_factory=list)
+
+    # ------------------------------------------------------------ queries
+
+    def is_ping(self) -> bool:
+        return not self.records
+
+    def last_opid(self) -> int:
+        """New stream watermark after this txn (the commit record's opid,
+        appended last at the origin; heartbeats keep the old watermark)."""
+        if self.is_ping():
+            return self.prev_log_opid
+        return self.records[-1].op_id.n
+
+    def commit_record(self) -> LogRecord:
+        return self.records[-1]
+
+    def commit_time(self) -> int:
+        return self.timestamp
+
+    def update_records(self) -> List[LogRecord]:
+        return [r for r in self.records if r.kind() == "update"]
+
+    # ------------------------------------------------------- construction
+
+    @staticmethod
+    def from_ops(dc_id, partition: int, prev_log_opid: int,
+                 records: List[LogRecord]) -> "InterDcTxn":
+        """Build from an assembled op group; commit time and snapshot come
+        from the trailing commit record (reference inter_dc_txn:from_ops,
+        src/inter_dc_txn.erl:48-61)."""
+        commit = records[-1]
+        assert commit.kind() == "commit", "op group must end with a commit"
+        _, (_dc, commit_time), snapshot_vc = commit.payload
+        return InterDcTxn(dc_id=dc_id, partition=partition,
+                          prev_log_opid=prev_log_opid,
+                          snapshot_vc=snapshot_vc, timestamp=commit_time,
+                          records=records)
+
+    @staticmethod
+    def ping(dc_id, partition: int, prev_log_opid: int,
+             timestamp: int) -> "InterDcTxn":
+        return InterDcTxn(dc_id=dc_id, partition=partition,
+                          prev_log_opid=prev_log_opid, snapshot_vc=None,
+                          timestamp=timestamp, records=[])
+
+    # -------------------------------------------------------------- bytes
+
+    def to_bin(self) -> bytes:
+        """Topic prefix + serialized body (src/inter_dc_txn.erl:95-105)."""
+        return partition_prefix(self.partition) + pickle.dumps(
+            self, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def from_bin(data: bytes) -> "InterDcTxn":
+        txn = pickle.loads(data[PARTITION_PREFIX_LEN:])
+        if not isinstance(txn, InterDcTxn):
+            raise ValueError("corrupt inter-DC txn frame")
+        return txn
+
+
+def partition_prefix(partition: int) -> bytes:
+    return struct.pack(">Q", partition)
+
+
+@dataclass
+class DcDescriptor:
+    """DC membership descriptor exchanged on connect (reference
+    inter_dc_manager:get_descriptor, src/inter_dc_manager.erl:49-61)."""
+
+    dc_id: Any
+    n_partitions: int
+    #: transport addresses: publisher + log-reader endpoints.  For the
+    #: in-process bus these are just the registry key; for the TCP
+    #: transport, ("host", port) pairs.
+    pub_addrs: Tuple = ()
+    logreader_addrs: Tuple = ()

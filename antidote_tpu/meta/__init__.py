@@ -1,0 +1,5 @@
+"""Clock / metadata plane (reference §2.4: meta_data_sender,
+stable_meta_data_server, dc_utilities stable-snapshot accessors)."""
+
+from antidote_tpu.meta.gossip import StableTimeTracker  # noqa: F401
+from antidote_tpu.meta.stable_store import StableMetaData  # noqa: F401

@@ -1,0 +1,86 @@
+"""Stable-snapshot (GST) computation — the meta_data_sender equivalent.
+
+The reference gossips each partition's vector clock once a second and
+publishes the column-wise min, monotonically (reference
+src/meta_data_sender.erl:224-356, merge policy
+src/stable_time_functions.erl:39-85: a partition missing a DC's entry
+pins that column to zero).  In one process the gossip network collapses
+to a dense ``int64[P, D]`` matrix and the GST is a single min-reduce —
+the dense kernel path (antidote_tpu/clocks/dense.min_merge) that scales
+the same computation to 256 simulated DCs on device (BASELINE config 5).
+
+The node dimension of the reference's gossip (partitions live on many
+BEAM nodes per DC) maps to the device mesh in this rebuild: sharded
+partitions each hold their row, and the min-reduce over the mesh axis is
+an XLA collective — see bench_gst for the sharded form.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from antidote_tpu.clocks import VC, ClockDomain
+
+
+class StableTimeTracker:
+    """Per-partition VC rows -> monotone published GST for one DC."""
+
+    def __init__(self, dc_id, n_partitions: int, domain: Optional[ClockDomain] = None):
+        self.dc_id = dc_id
+        self.n_partitions = n_partitions
+        self.domain = domain or ClockDomain(8)
+        self._rows = np.zeros((n_partitions, self.domain.d), dtype=np.int64)
+        self._published = VC()
+        self._lock = threading.Lock()
+        #: pull sources: partition -> () -> VC; set by the DC assembly
+        #: (dep-gate applied watermarks + own min-prepared)
+        self.sources: List[Callable[[], VC]] = []
+
+    def _grow_if_needed(self, vc: VC) -> None:
+        unseen = [dc for dc, t in vc.items()
+                  if t and not self.domain.contains(dc)]
+        if len(self.domain) + len(unseen) > self.domain.d:
+            new_d = max(self.domain.d * 2, len(self.domain) + len(unseen))
+            self.domain = self.domain.grow(new_d)
+            rows = np.zeros((self.n_partitions, new_d), dtype=np.int64)
+            rows[:, : self._rows.shape[1]] = self._rows
+            self._rows = rows
+
+    def put(self, partition: int, vc: VC) -> None:
+        """Advance one partition's row (entries never regress — gossip
+        merges are monotone per source, reference update_stable
+        src/meta_data_sender.erl:341-356)."""
+        with self._lock:
+            self._grow_if_needed(vc)
+            row = self.domain.to_dense(vc)
+            np.maximum(self._rows[partition], row, out=self._rows[partition])
+
+    def refresh(self) -> None:
+        """Pull every partition's current VC from its source."""
+        for p, src in enumerate(self.sources):
+            self.put(p, src())
+
+    def get_stable_snapshot(self) -> VC:
+        """Column-wise min over partitions, published monotonically
+        (reference dc_utilities:get_stable_snapshot,
+        src/dc_utilities.erl:246-279)."""
+        if self.sources:
+            self.refresh()
+        with self._lock:
+            if len(self.domain) == 0:
+                return VC(self._published)
+            gst = self._rows.min(axis=0)
+            self._published = self._published.join(self.domain.from_dense(gst))
+            return VC(self._published)
+
+    def get_scalar_stable_time(self):
+        """GentleRain form: (GST scalar, vector stable time) — the min
+        entry over known DCs (reference dc_utilities:get_scalar_stable_time,
+        src/dc_utilities.erl:294-317)."""
+        vst = self.get_stable_snapshot()
+        known = [vst.get_dc(dc) for dc in self.domain.dc_ids]
+        gst = min(known) if known else 0
+        return gst, vst

@@ -1,0 +1,67 @@
+"""DC-wide durable configuration store — the stable_meta_data_server
+equivalent (reference src/stable_meta_data_server.erl): a small KV map
+holding DC descriptors, connected-DC lists, env flags, and the
+``has_started`` restart flag, persisted to disk (the reference uses
+dets) and reloaded at boot so a restarted node can re-join its DCs
+(reference check_node_restart, src/inter_dc_manager.erl:156-201).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+
+class StableMetaData:
+    def __init__(self, path: Optional[str], recover: bool = True):
+        self.path = path
+        self._lock = threading.Lock()
+        self._kv: Dict[Any, Any] = {}
+        if recover and path and os.path.exists(path):
+            with open(path, "rb") as f:
+                data = pickle.load(f)
+            if isinstance(data, dict):
+                self._kv = data
+
+    def get(self, key, default=None):
+        with self._lock:
+            return self._kv.get(key, default)
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._kv[key] = value
+            self._persist()
+
+    def merge_update(self, key, value, merge) -> None:
+        """Update ``key`` through a merge function (reference
+        broadcast_meta_data_merge, src/stable_meta_data_server.erl:180-190)."""
+        with self._lock:
+            self._kv[key] = merge(self._kv.get(key), value)
+            self._persist()
+
+    def delete(self, key) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+            self._persist()
+
+    def keys(self):
+        with self._lock:
+            return list(self._kv.keys())
+
+    def _persist(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._kv, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, self.path)
+
+    # ------------------------------------------------- well-known entries
+
+    def mark_started(self) -> None:
+        self.put("has_started", True)
+
+    def has_started(self) -> bool:
+        return bool(self.get("has_started", False))

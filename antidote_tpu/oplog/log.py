@@ -34,7 +34,12 @@ class _NativeBackend:
         so = ensure_built("oplog")
         if so is None:
             return None
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            # stale/wrong-platform artifact: auto mode falls back to the
+            # pure-Python backend instead of failing node startup
+            return None
         lib.oplog_open.restype = ctypes.c_void_p
         lib.oplog_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.oplog_append.restype = ctypes.c_int64

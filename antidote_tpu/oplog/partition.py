@@ -108,6 +108,13 @@ class PartitionLog:
     def records(self, offset: int = 0) -> Iterator[LogRecord]:
         if not self.enabled:
             return
+        # push buffered appends down before scanning: the append path is
+        # write-buffered (fwrite / buffered file) while scans read the
+        # file, so an unflushed tail would be invisible — which would make
+        # log replay lose recent ops and gap-repair answers silently omit
+        # committed txns (the requester treats the answer as covering the
+        # whole range)
+        self.log.flush()
         for _off, payload in self.log.scan(offset):
             yield LogRecord.from_bytes(payload)
 

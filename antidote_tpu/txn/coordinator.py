@@ -77,17 +77,39 @@ class Coordinator:
                           ) -> Transaction:
         props = properties or TxnProperties()
         node = self.node
-        snap = VC(node.stable_vc())
         if client_clock and props.update_clock:
-            snap = snap.join(client_clock)
-            # wait for the local clock to pass the client's view of us
-            node.clock.wait_until(client_clock.get_dc(node.dc_id))
+            snap = self._wait_for_clock(client_clock).join(client_clock)
+        else:
+            snap = VC(node.stable_vc())
         snap = snap.set_dc(node.dc_id, max(snap.get_dc(node.dc_id),
                                            node.clock.now_us()))
         txid = (snap.get_dc(node.dc_id), uuid.uuid4().hex[:12])
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
             ctx=DownstreamCtx(actor=(str(node.dc_id), txid[1])))
+
+    def _wait_for_clock(self, client_clock: VC) -> VC:
+        """Spin until the snapshot (stable GST with the local entry at
+        `now`) dominates the client's causal clock — THE cross-DC causal
+        wait (reference wait_for_clock,
+        src/clocksi_interactive_coord.erl:915-926).  The local entry
+        covers clock skew; remote entries block until replication has
+        applied everything the client has already seen."""
+        import time as _time
+
+        node = self.node
+        deadline = _time.monotonic() + node.config.clock_wait_timeout_s
+        while True:
+            snap = VC(node.stable_vc())
+            snap = snap.set_dc(node.dc_id, max(snap.get_dc(node.dc_id),
+                                               node.clock.now_us()))
+            if snap.ge(client_clock):
+                return snap
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"snapshot never caught up with client clock "
+                    f"{dict(client_clock)}; stable={dict(snap)}")
+            node.wait_hook()
 
     def _check_active(self, tx: Transaction) -> None:
         if tx.state is not TxnState.ACTIVE:

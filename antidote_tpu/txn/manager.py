@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from antidote_tpu.clocks import VC
 from antidote_tpu.mat.host_store import HostStore
@@ -29,6 +29,10 @@ class CertificationError(Exception):
     """Write-write certification failed — transaction must abort."""
 
 
+#: stable-horizon sampling throttle (seconds); see PartitionManager
+_STABLE_REFRESH_S = 0.05
+
+
 class PartitionManager:
     def __init__(self, partition: int, dc_id, log: PartitionLog,
                  clock: HybridClock, read_wait_timeout: float = 5.0):
@@ -38,6 +42,19 @@ class PartitionManager:
         self.clock = clock
         self.store = HostStore(log_fallback=log.committed_payloads)
         self.read_wait_timeout = read_wait_timeout
+        #: GC horizon source (set by Node): a clock no FUTURE commit can
+        #: fall below — the GST.  A txn's own snapshot is NOT safe here: a
+        #: concurrent txn prepared earlier can still commit with a lower
+        #: time, and pruning at an unstable horizon loses its op from the
+        #: cached bases.  Must be called OUTSIDE self._lock (it reads
+        #: min-prepared across partitions).
+        self.stable_vc_source: Callable[[], VC] = VC
+        #: sampled horizon cache: the source sweeps every partition, so
+        #: it is refreshed at most every ``_STABLE_REFRESH_S`` (the
+        #: reference's stable plane ticks at 1 s / 100 ms; an older
+        #: horizon is merely conservative for GC)
+        self._stable_cache = VC()
+        self._stable_cached_at = 0.0
         self._lock = threading.Condition()
         #: txid -> (prepare_time, [keys])
         self.prepared: Dict[Any, Tuple[int, List[Any]]] = {}
@@ -46,8 +63,6 @@ class PartitionManager:
         #: ops staged per txid before commit (the txn's effects on this
         #: partition, already in the durable log)
         self._staged: Dict[Any, List[Tuple[Any, str, Any]]] = {}
-        #: latest commit time at this partition (feeds the stable plane)
-        self.max_committed_time = 0
 
     # ------------------------------------------------------------ updates
 
@@ -85,11 +100,20 @@ class PartitionManager:
             self.log.append_prepare(self.dc_id, txid, pt)
             return pt
 
+    def _stable_for_gc(self) -> VC:
+        """Throttled GC horizon; call OUTSIDE self._lock."""
+        now = time.monotonic()
+        if now - self._stable_cached_at > _STABLE_REFRESH_S:
+            self._stable_cache = self.stable_vc_source()
+            self._stable_cached_at = now
+        return self._stable_cache
+
     def commit(self, txid, commit_time: int, snapshot_vc: VC) -> None:
         """Log the commit (fsync per config), publish the effects to the
         materializer store, release prepared state and wake blocked
         readers (reference commit handler src/clocksi_vnode.erl:499-531,
         update_materializer :634-657)."""
+        stable = self._stable_for_gc()  # before the lock (see __init__)
         with self._lock:
             self.log.append_commit(self.dc_id, txid, commit_time, snapshot_vc)
             for key, type_name, effect in self._staged.pop(txid, []):
@@ -98,11 +122,10 @@ class PartitionManager:
                     commit_dc=self.dc_id, commit_time=commit_time,
                     snapshot_vc=snapshot_vc, txid=txid)
                 self.store.insert(key, type_name, payload,
-                                  stable_vc=snapshot_vc)
+                                  stable_vc=stable)
                 if commit_time > self.committed.get(key, 0):
                     self.committed[key] = commit_time
             self.prepared.pop(txid, None)
-            self.max_committed_time = max(self.max_committed_time, commit_time)
             self._lock.notify_all()
 
     def single_commit(self, txid, snapshot_vc: VC,
@@ -124,6 +147,31 @@ class PartitionManager:
                 self.log.append_abort(self.dc_id, txid)
             self._staged.pop(txid, None)
             self.prepared.pop(txid, None)
+            self._lock.notify_all()
+
+    # ------------------------------------------------------ remote apply
+
+    def apply_remote(self, records, origin_dc, commit_time: int,
+                     snapshot_vc: VC) -> None:
+        """Apply a replicated transaction from another DC: append its
+        records without assigning local ids, then publish the effects to
+        the materializer store (reference inter_dc_dep_vnode try_store
+        apply path, src/inter_dc_dep_vnode.erl:144-152).  Remote txns do
+        not touch the prepared/committed certification tables — local
+        certification is local-only; concurrent remote updates resolve by
+        CRDT semantics, not aborts."""
+        stable = self._stable_for_gc()  # before the lock (see __init__)
+        with self._lock:
+            self.log.append_remote_group(records)
+            for rec in records:
+                if rec.kind() != "update":
+                    continue
+                _, key, type_name, effect = rec.payload
+                payload = Payload(
+                    key=key, type_name=type_name, effect=effect,
+                    commit_dc=origin_dc, commit_time=commit_time,
+                    snapshot_vc=snapshot_vc, txid=rec.txid)
+                self.store.insert(key, type_name, payload, stable_vc=stable)
             self._lock.notify_all()
 
     # --------------------------------------------------------------- reads

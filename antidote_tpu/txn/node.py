@@ -12,6 +12,7 @@ integer keys map by modulo, everything else by hash.
 from __future__ import annotations
 
 import os
+import time
 import zlib
 from typing import Any, Callable, List, Optional, Tuple
 
@@ -45,9 +46,17 @@ class Node:
             self.partitions.append(
                 PartitionManager(p, dc_id, log, self.clock))
         #: provider of the gossiped stable snapshot (set by the meta
-        #: plane / inter-DC layer; single-DC nodes see an empty VC and
-        #: rely on clock waits + client clocks)
-        self.stable_vc_provider: Callable[[], VC] = VC
+        #: plane / inter-DC layer).  The single-DC default is the node's
+        #: own min-prepared time: no future local commit can fall below
+        #: it, so it is a safe GC horizon and a valid (own-entry-only)
+        #: stable snapshot.
+        self.stable_vc_provider: Callable[[], VC] = (
+            lambda: VC({dc_id: self.min_prepared_vc()}))
+        for pm in self.partitions:
+            pm.stable_vc_source = self.stable_vc
+        #: called inside causal clock-wait spins; the inter-DC layer
+        #: points this at its inbound pump so waiting makes progress
+        self.wait_hook: Callable[[], None] = lambda: time.sleep(0.002)
         self.coordinator = Coordinator(self)
         #: optional detour for bounded-counter downstream generation
         #: (reference clocksi_downstream's bcounter_mgr hop)
@@ -118,10 +127,15 @@ class Node:
         for pm in self.partitions:
             for _seq, payload in pm.log.committed_payloads():
                 pm.store.insert(payload.key, payload.type_name, payload)
+                if payload.commit_dc != self.dc_id:
+                    # replicated records are durable too, but the
+                    # certification tables are local-only — exactly as on
+                    # the live apply_remote path; loading remote commit
+                    # times here would make certify() compare local
+                    # snapshot times against another DC's clock
+                    continue
                 if payload.commit_time > pm.committed.get(payload.key, 0):
                     pm.committed[payload.key] = payload.commit_time
-                pm.max_committed_time = max(
-                    pm.max_committed_time, payload.commit_time)
 
     def close(self) -> None:
         for pm in self.partitions:

@@ -1,0 +1,43 @@
+"""Multi-DC harness: simulated DCs on an in-process bus.
+
+The reference's analogue boots ct_slave BEAM peers with real sockets on
+one host (test/utils/test_utils.erl:110-165); here each "DC" is a
+DataCenter instance sharing an InProcBus, with background delivery +
+heartbeat threads running at a fast tick so causal waits resolve quickly.
+"""
+
+import pytest
+
+from antidote_tpu.config import Config
+from antidote_tpu.interdc import InProcBus
+from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+
+
+@pytest.fixture
+def bus():
+    return InProcBus()
+
+
+def make_cluster(bus, tmp_path, n_dcs=3, connect=True, **cfg_kw):
+    cfg_kw.setdefault("n_partitions", 4)
+    cfg_kw.setdefault("heartbeat_s", 0.02)
+    cfg_kw.setdefault("clock_wait_timeout_s", 10.0)
+    dcs = []
+    for i in range(n_dcs):
+        cfg = Config(**cfg_kw)
+        dc = DataCenter(f"dc{i + 1}", bus, config=cfg,
+                        data_dir=str(tmp_path / f"dc{i + 1}"))
+        dcs.append(dc)
+    if connect:
+        connect_dcs(dcs)
+    for dc in dcs:
+        dc.start_bg_processes()
+    return dcs
+
+
+@pytest.fixture
+def cluster3(bus, tmp_path):
+    dcs = make_cluster(bus, tmp_path, 3)
+    yield dcs
+    for dc in dcs:
+        dc.close()
