@@ -92,9 +92,8 @@ class AntidoteTPU:
         for bo, clock in object_clock_pairs:
             key, _type_name, _b = self.node.normalize_bound(bo)
             pm = self.node.partition_of(key)
-            # scans share the appenders' file handle — serialize with them
-            with pm._lock:
-                ops = pm.log.committed_payloads(key=key, from_vc=clock)
+            ops = pm.scan_log(
+                lambda log: log.committed_payloads(key=key, from_vc=clock))
             out.append([p for _i, p in ops])
         return out
 

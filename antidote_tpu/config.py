@@ -42,4 +42,10 @@ class Config:
     #: src/clocksi_interactive_coord.erl:915-926; a cap keeps tests and
     #: batch jobs from hanging on an unreachable dependency)
     clock_wait_timeout_s: float = 30.0
+    #: bounded-counter transfer pass period (reference ?TRANSFER_FREQ
+    #: 100 ms, include/antidote.hrl:79)
+    bcounter_transfer_period_s: float = 0.1
+    #: grace period suppressing repeated grants to the same requester
+    #: (reference ?GRACE_PERIOD 1 s, include/antidote.hrl:75)
+    bcounter_grace_period_s: float = 1.0
     extra: dict = field(default_factory=dict)

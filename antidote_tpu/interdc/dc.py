@@ -22,6 +22,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from antidote_tpu.api import AntidoteTPU
+from antidote_tpu.bcounter import BCounterMgr
 from antidote_tpu.clocks import VC
 from antidote_tpu.config import Config
 from antidote_tpu.interdc import query as idc_query
@@ -90,6 +91,8 @@ class DataCenter(AntidoteTPU):
         self._inbox = bus.register(self.descriptor(), self._handle_query)
         self._worker = InboxWorker(self._inbox, self._deliver)
         self._hb_worker: Optional[_Ticker] = None
+        self._bc_worker: Optional[_Ticker] = None
+        node.bcounter_mgr = BCounterMgr(self)
 
         # re-join DCs we knew before a restart
         for desc in (self.meta.get("connected_descriptors") or []):
@@ -162,6 +165,11 @@ class DataCenter(AntidoteTPU):
             self._hb_worker = _Ticker(self.node.config.heartbeat_s,
                                       self.tick_heartbeats)
             self._hb_worker.start()
+        if self._bc_worker is None:
+            self._bc_worker = _Ticker(
+                self.node.config.bcounter_transfer_period_s,
+                self.node.bcounter_mgr.transfer_periodic)
+            self._bc_worker.start()
 
     def tick_heartbeats(self) -> None:
         """One heartbeat round: each partition broadcasts its min-prepared
@@ -209,13 +217,10 @@ class DataCenter(AntidoteTPU):
         if kind == idc_query.LOG_READ:
             partition, first, last = payload
             pm = self.node.partitions[partition]
-            # runs on the requester's thread: serialize against this
-            # partition's appenders — the log backends share one file
-            # handle between append and scan, so an unlocked scan could
-            # interleave seeks with a writer and corrupt the log
-            with pm._lock:
-                return idc_query.answer_log_read(
-                    pm.log, self.node.dc_id, partition, first, last)
+            # runs on the requester's thread
+            return pm.scan_log(
+                lambda log: idc_query.answer_log_read(
+                    log, self.node.dc_id, partition, first, last))
         if kind == idc_query.CHECK_UP:
             return True
         if kind == idc_query.BCOUNTER_REQUEST:
@@ -236,6 +241,9 @@ class DataCenter(AntidoteTPU):
         if self._hb_worker is not None:
             self._hb_worker.stop()
             self._hb_worker = None
+        if self._bc_worker is not None:
+            self._bc_worker.stop()
+            self._bc_worker = None
         self._worker.stop()
         self.bus.unregister(self.node.dc_id)
         super().close()

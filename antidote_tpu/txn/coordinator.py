@@ -156,7 +156,8 @@ class Coordinator:
                     state = pm.read_with_writeset(
                         key2, cls.name, tx.snapshot_vc, tx.txid,
                         tx.own_effects(key2))
-                effect = self.node.gen_downstream(cls, op, state, tx.ctx)
+                effect = self.node.gen_downstream(
+                    cls, op, state, tx.ctx, key=key2, bucket=bucket)
             except DownstreamError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(f"downstream failed: {e}") from e

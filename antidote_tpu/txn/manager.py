@@ -64,6 +64,16 @@ class PartitionManager:
         #: partition, already in the durable log)
         self._staged: Dict[Any, List[Tuple[Any, str, Any]]] = {}
 
+    # ----------------------------------------------------------- log scans
+
+    def scan_log(self, fn):
+        """Run ``fn(self.log)`` serialized against this partition's
+        appenders: scans share the appenders' file handle, so an unlocked
+        scan could interleave seeks with a writer and corrupt the log.
+        The locking discipline lives here, not at call sites."""
+        with self._lock:
+            return fn(self.log)
+
     # ------------------------------------------------------------ updates
 
     def stage_update(self, txid, key, type_name: str, effect) -> None:

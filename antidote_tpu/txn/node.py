@@ -111,11 +111,12 @@ class Node:
 
     # ----------------------------------------------------------- downstream
 
-    def gen_downstream(self, cls, op, state, ctx):
+    def gen_downstream(self, cls, op, state, ctx, key=None, bucket=None):
         """Downstream generation with the bounded-counter detour
         (reference src/clocksi_downstream.erl:41-68)."""
         if cls.name == "counter_b" and self.bcounter_mgr is not None:
-            return self.bcounter_mgr.generate_downstream(op, state, ctx)
+            return self.bcounter_mgr.generate_downstream(
+                op, state, ctx, key=key, bucket=bucket)
         return cls.gen_downstream(op, state, ctx)
 
     # ------------------------------------------------------------- recovery

@@ -1,0 +1,132 @@
+"""Bounded-counter manager tests — the bcountermgr_SUITE analogue
+(reference test/multidc/bcountermgr_SUITE.erl): decrements bounded by
+local rights, no_permissions abort, and cross-DC permission transfer via
+the periodic transfer pass.
+"""
+
+import time
+
+import pytest
+
+from antidote_tpu.api import TransactionAborted
+
+
+BOUND = ("bc_key", "counter_b", "bkt")
+
+
+def incr(dc, n, clock=None, bound=BOUND):
+    return dc.update_objects_static(clock, [(bound, "increment", n)])
+
+
+def decr(dc, n, clock=None, bound=BOUND):
+    return dc.update_objects_static(clock, [(bound, "decrement", n)])
+
+
+def value(dc, clock, bound=BOUND):
+    vals, _ = dc.read_objects_static(clock, [bound])
+    return vals[0]
+
+
+def wait_value(dc, clock, want, bound=BOUND, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if value(dc, clock, bound) == want:
+            return
+        time.sleep(0.01)
+    assert value(dc, clock, bound) == want
+
+
+class TestLocalBounds:
+    """reference new_bcounter_test / test_dec_success / test_dec_fail
+    (test/multidc/bcountermgr_SUITE.erl:84-131)."""
+
+    def test_new_counter_is_zero(self, cluster3):
+        dc1 = cluster3[0]
+        assert value(dc1, None, ("fresh_bc", "counter_b", "bkt")) == 0
+
+    def test_decrement_within_rights_succeeds(self, cluster3):
+        dc1 = cluster3[0]
+        bound = ("bc_dec_ok", "counter_b", "bkt")
+        ct = incr(dc1, 10, bound=bound)
+        ct = decr(dc1, 4, clock=ct, bound=bound)
+        assert value(dc1, ct, bound) == 6
+
+    def test_decrement_beyond_rights_aborts(self, cluster3):
+        dc1 = cluster3[0]
+        bound = ("bc_dec_fail", "counter_b", "bkt")
+        ct = incr(dc1, 3, bound=bound)
+        with pytest.raises(TransactionAborted, match="no_permissions"):
+            decr(dc1, 5, clock=ct, bound=bound)
+        assert value(dc1, ct, bound) == 3
+
+    def test_conditional_write_skew_prevented(self, cluster3):
+        """Two DCs can never jointly overdraw: each decrement is checked
+        against that DC's own rights (reference
+        conditional_write_test_run, bcountermgr_SUITE)."""
+        dc1, dc2, _ = cluster3
+        bound = ("bc_skew", "counter_b", "bkt")
+        ct = incr(dc1, 5, bound=bound)
+        wait_value(dc2, ct, 5, bound)
+        # dc2 holds no rights — all 5 were minted by dc1
+        with pytest.raises(TransactionAborted, match="no_permissions"):
+            decr(dc2, 5, clock=ct, bound=bound)
+        ct = decr(dc1, 5, clock=ct, bound=bound)
+        for dc in cluster3:
+            wait_value(dc, ct, 0, bound)
+
+
+class TestPermissionTransfer:
+    """reference transfer_test (test/multidc/bcountermgr_SUITE.erl:133-160):
+    a failed decrement at a poor DC triggers a rights transfer from the
+    richest DC; the retried decrement then succeeds."""
+
+    def test_failed_decrement_triggers_transfer(self, cluster3):
+        dc1, dc2, _ = cluster3
+        bound = ("bc_transfer", "counter_b", "bkt")
+        ct = incr(dc1, 10, bound=bound)
+        wait_value(dc2, ct, 10, bound)
+
+        # dc2 has no rights yet: the decrement aborts but queues a request
+        with pytest.raises(TransactionAborted, match="no_permissions"):
+            decr(dc2, 6, clock=ct, bound=bound)
+
+        # retry until the transfer lands (background tickers run the
+        # transfer pass and replicate the grant), as the reference client
+        # does (bcountermgr_SUITE decrement retry loop)
+        deadline = time.monotonic() + 10.0
+        ct2 = None
+        while ct2 is None:
+            try:
+                ct2 = decr(dc2, 6, clock=ct, bound=bound)
+            except TransactionAborted:
+                assert time.monotonic() < deadline, \
+                    "transfer never arrived at dc2"
+                time.sleep(0.05)
+        for dc in cluster3:
+            wait_value(dc, ct2, 4, bound)
+
+    def test_malformed_op_aborts_cleanly(self, cluster3):
+        """Bad args abort as TransactionAborted (not a raw unpack error)
+        and must NOT queue a transfer request."""
+        dc1 = cluster3[0]
+        mgr = dc1.node.bcounter_mgr
+        bound = ("bc_malformed", "counter_b", "bkt")
+        with pytest.raises(TransactionAborted):
+            dc1.update_objects_static(None, [(bound, "decrement", "abc")])
+        with pytest.raises(TransactionAborted):
+            dc1.update_objects_static(None, [(bound, "decrement", 0)])
+        assert ("bc_malformed", "bkt") not in mgr._requests
+
+    def test_grace_period_suppresses_repeat_grants(self, cluster3):
+        dc1, dc2, _ = cluster3
+        mgr = dc1.node.bcounter_mgr
+        bound_key = ("bc_grace", "bkt")
+        incr(dc1, 8, bound=("bc_grace", "counter_b", "bkt"))
+        assert mgr.handle_remote_request(
+            "dc2", ("bc_grace", "bkt", 2, "dc2")) is True
+        # immediate repeat inside the grace period is refused
+        assert mgr.handle_remote_request(
+            "dc2", ("bc_grace", "bkt", 2, "dc2")) is False
+        # a different requester is unaffected
+        assert mgr.handle_remote_request(
+            "dc3", ("bc_grace", "bkt", 2, "dc3")) is True
