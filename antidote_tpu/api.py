@@ -56,8 +56,13 @@ class AntidoteTPU:
     def read_objects_static(self, clock: Optional[VC], objects: List
                             ) -> Tuple[List[Any], VC]:
         """One-shot snapshot read (reference cure:obtain_objects fast
-        path, src/cure.erl:135-183)."""
-        tx = self.start_transaction(clock)
+        path, src/cure.erl:135-183).  Under txn_prot="gr" the snapshot
+        is the GentleRain scalar-GST wait instead of the Clock-SI
+        max(stable, client) rule (reference src/cure.erl:233-257)."""
+        if self.node.config.txn_prot == "gr":
+            tx = self.node.coordinator.start_transaction_gr(clock)
+        else:
+            tx = self.start_transaction(clock)
         values = self.read_objects(objects, tx)
         commit_vc = self.commit_transaction(tx)
         return values, commit_vc

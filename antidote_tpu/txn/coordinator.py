@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
 
+from antidote_tpu import stats
 from antidote_tpu.clocks import VC
 from antidote_tpu.crdt import DownstreamCtx, DownstreamError, get_type, is_type
 from antidote_tpu.txn.manager import CertificationError
@@ -84,6 +85,7 @@ class Coordinator:
         snap = snap.set_dc(node.dc_id, max(snap.get_dc(node.dc_id),
                                            node.clock.now_us()))
         txid = (snap.get_dc(node.dc_id), uuid.uuid4().hex[:12])
+        stats.registry.open_transactions.inc()
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
             ctx=DownstreamCtx(actor=(str(node.dc_id), txid[1])))
@@ -111,6 +113,46 @@ class Coordinator:
                     f"{dict(client_clock)}; stable={dict(snap)}")
             node.wait_hook()
 
+    def gr_snapshot_wait(self, client_clock: Optional[VC]) -> VC:
+        """GentleRain snapshot choice (reference gr_snapshot_obtain,
+        src/cure.erl:233-257): block until the client's entry for THIS
+        DC is covered by the scalar GST, then read at a snapshot whose
+        every entry is the GST — the min over known DCs, replicated to
+        all entries (reference dc_utilities:get_stable_snapshot GR
+        branch, src/dc_utilities.erl:246-279).  One scalar per snapshot
+        is what makes GentleRain's metadata O(1) instead of O(#DCs)."""
+        import time as _time
+
+        node = self.node
+        want = client_clock.get_dc(node.dc_id) if client_clock else 0
+        deadline = _time.monotonic() + node.config.clock_wait_timeout_s
+        while True:
+            st = VC(node.stable_vc())
+            entries = dict(st)
+            gst = min(entries.values()) if entries else 0
+            if want <= gst:
+                snap = VC({dc: gst for dc in entries})
+                return snap.set_dc(node.dc_id, gst)
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"GST {gst} never caught up with client clock entry "
+                    f"{want} for {node.dc_id}")
+            node.wait_hook()
+
+    def start_transaction_gr(self, client_clock: Optional[VC] = None,
+                             properties: Optional[TxnProperties] = None
+                             ) -> Transaction:
+        """A transaction pinned to the GentleRain snapshot (static-read
+        path, reference cure:obtain_objects Protocol=gr)."""
+        props = properties or TxnProperties()
+        snap = self.gr_snapshot_wait(
+            client_clock if props.update_clock else None)
+        txid = (snap.get_dc(self.node.dc_id), uuid.uuid4().hex[:12])
+        stats.registry.open_transactions.inc()
+        return Transaction(
+            txid=txid, snapshot_vc=snap, properties=props,
+            ctx=DownstreamCtx(actor=(str(self.node.dc_id), txid[1])))
+
     def _check_active(self, tx: Transaction) -> None:
         if tx.state is not TxnState.ACTIVE:
             raise TransactionAborted(f"transaction is {tx.state.value}")
@@ -119,6 +161,7 @@ class Coordinator:
 
     def read_objects(self, tx: Transaction, bound_objects: List) -> List[Any]:
         self._check_active(tx)
+        stats.registry.operations.inc(len(bound_objects), type="read")
         out = []
         for bo in bound_objects:
             key, type_name, _bucket = self.node.normalize_bound(bo)
@@ -135,12 +178,17 @@ class Coordinator:
         """[(bound_object, op_name, op_param)] — validate, hook,
         generate downstream, log, stage."""
         self._check_active(tx)
+        stats.registry.operations.inc(len(updates), type="update")
         for upd in updates:
             bo, op_name, op_param = self.node.normalize_update(upd)
             key, type_name, bucket = self.node.normalize_bound(bo)
             cls = get_type(type_name) if is_type(type_name) else None
             op = (op_name, op_param)
             if cls is None or not cls.is_operation(op):
+                # abort like the hook/downstream failure paths below —
+                # leaving the txn ACTIVE would leak staged effects and
+                # the open-transactions gauge
+                self.abort_transaction(tx)
                 raise TypeError(f"type_check failed: {type_name} {op!r}")
             try:
                 key2, type_name2, op = self.node.hooks.run_pre(
@@ -196,6 +244,7 @@ class Coordinator:
             raise TransactionAborted(str(e)) from e
         tx.state = TxnState.COMMITTED
         tx.commit_vc = commit_vc
+        stats.registry.open_transactions.dec()
         for bucket, key, type_name, op in tx.client_ops:
             node.hooks.run_post(bucket, key, type_name, op)
         return commit_vc
@@ -206,3 +255,5 @@ class Coordinator:
         for p in tx.partitions:
             self.node.partitions[p].abort(tx.txid)
         tx.state = TxnState.ABORTED
+        stats.registry.open_transactions.dec()
+        stats.registry.aborted_transactions.inc()

@@ -1,0 +1,49 @@
+"""Multi-DC GentleRain tests — the multidc gr_SUITE analogue
+(reference test/multidc/gr_SUITE.erl): cross-DC reads at an all-GST
+snapshot, with the GST advanced by heartbeats from every peer.
+"""
+
+import time
+
+from tests.multidc.conftest import make_cluster
+
+
+def test_gr_replicated_read(bus, tmp_path):
+    dcs = make_cluster(bus, tmp_path, 3, txn_prot="gr")
+    try:
+        dc1, dc2, _dc3 = dcs
+        bo = ("gr_multi", "counter_pn", "bkt")
+        ct = dc1.update_objects_static(None, [(bo, "increment", 4)])
+
+        # a GR read at dc1 with its own commit clock blocks until every
+        # peer's heartbeat pushes the GST past the commit time, then the
+        # all-GST snapshot includes the write
+        vals, rvc = dc1.read_objects_static(ct, [bo])
+        assert vals == [4]
+        assert len(set(dict(rvc).values())) == 1
+
+        # at dc2 the value arrives over replication; GR reads converge
+        deadline = time.monotonic() + 10.0
+        while True:
+            vals, _ = dc2.read_objects_static(None, [bo])
+            if vals == [4]:
+                break
+            assert time.monotonic() < deadline, "GR read never converged"
+            time.sleep(0.01)
+
+        # chaining: dc2 updates on top of its GR read clock; dc1's GR
+        # wait rule only covers dc1's own entry (reference
+        # gr_snapshot_obtain checks Dt = ClientClock[local dc]), so
+        # dc2's fresh commit becomes visible once the GST passes its
+        # commit time — poll to convergence, as GentleRain promises
+        ct2 = dc2.update_objects_static(rvc, [(bo, "increment", 1)])
+        deadline = time.monotonic() + 10.0
+        while True:
+            vals, _ = dc1.read_objects_static(ct2, [bo])
+            if vals == [5]:
+                break
+            assert time.monotonic() < deadline, "chained GR read stale"
+            time.sleep(0.01)
+    finally:
+        for dc in dcs:
+            dc.close()

@@ -1,0 +1,68 @@
+"""GentleRain protocol tests — the single-DC gr_SUITE analogue
+(reference test/singledc/gr_SUITE.erl, enabled via env txn_prot=gr):
+static reads pick an all-GST snapshot after waiting for the scalar GST
+to cover the client's local clock entry.
+"""
+
+import pytest
+
+from antidote_tpu.api import AntidoteTPU
+from antidote_tpu.clocks import VC
+from antidote_tpu.config import Config
+
+
+@pytest.fixture
+def db(tmp_path):
+    db = AntidoteTPU(dc_id="dc1", config=Config(txn_prot="gr"),
+                     data_dir=str(tmp_path / "data"))
+    yield db
+    db.close()
+
+
+def test_static_read_after_update(db):
+    """reference gr_SUITE read_update_test: a static read carrying the
+    update's commit clock waits for the GST and sees the value."""
+    bo = ("gr_ctr", "counter_pn")
+    ct = db.update_objects_static(None, [(bo, "increment", 7)])
+    vals, rvc = db.read_objects_static(ct, [bo])
+    assert vals == [7]
+    # the GR snapshot replicates one scalar to every entry
+    entries = set(dict(rvc).values())
+    assert len(entries) == 1
+
+
+def test_gr_snapshot_chains(db):
+    bo = ("gr_chain", "counter_pn")
+    ct = db.update_objects_static(None, [(bo, "increment", 1)])
+    _, rvc = db.read_objects_static(ct, [bo])
+    ct2 = db.update_objects_static(rvc, [(bo, "increment", 1)])
+    vals, _ = db.read_objects_static(ct2, [bo])
+    assert vals == [2]
+
+
+def test_gr_read_without_clock(db):
+    bo = ("gr_noclock", "counter_pn")
+    db.update_objects_static(None, [(bo, "increment", 3)])
+    # no client clock: read at the current GST, no wait; the value may
+    # lag but repeated reads converge (GentleRain staleness)
+    import time
+    deadline = time.monotonic() + 5.0
+    while True:
+        vals, _ = db.read_objects_static(None, [bo])
+        if vals == [3]:
+            break
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+
+
+def test_gr_timeout_on_unreachable_clock(tmp_path):
+    db = AntidoteTPU(
+        dc_id="dc1",
+        config=Config(txn_prot="gr", clock_wait_timeout_s=0.2),
+        data_dir=str(tmp_path / "t"))
+    try:
+        future = VC({"dc1": 2**62})
+        with pytest.raises(TimeoutError):
+            db.read_objects_static(future, [("k", "counter_pn")])
+    finally:
+        db.close()
