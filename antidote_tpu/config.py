@@ -48,4 +48,11 @@ class Config:
     #: grace period suppressing repeated grants to the same requester
     #: (reference ?GRACE_PERIOD 1 s, include/antidote.hrl:75)
     bcounter_grace_period_s: float = 1.0
+    #: Prometheus exposition port; None disables the HTTP endpoint
+    #: (reference elli on :3001, src/antidote_sup.erl:118-128; 0 picks
+    #: a free port)
+    metrics_port: int | None = None
+    #: staleness histogram sampling period (reference 10 s,
+    #: src/antidote_stats_collector.erl:87-93)
+    staleness_sample_s: float = 10.0
     extra: dict = field(default_factory=dict)

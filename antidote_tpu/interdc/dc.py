@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from antidote_tpu import stats
 from antidote_tpu.api import AntidoteTPU
 from antidote_tpu.bcounter import BCounterMgr
 from antidote_tpu.clocks import VC
@@ -92,6 +93,7 @@ class DataCenter(AntidoteTPU):
         self._worker = InboxWorker(self._inbox, self._deliver)
         self._hb_worker: Optional[_Ticker] = None
         self._bc_worker: Optional[_Ticker] = None
+        self._staleness: Optional[stats.StalenessSampler] = None
         node.bcounter_mgr = BCounterMgr(self)
 
         # re-join DCs we knew before a restart
@@ -170,6 +172,15 @@ class DataCenter(AntidoteTPU):
                 self.node.config.bcounter_transfer_period_s,
                 self.node.bcounter_mgr.transfer_periodic)
             self._bc_worker.start()
+        if self._staleness is None:
+            self._staleness = stats.StalenessSampler(
+                self.stable.get_stable_snapshot, self.node.clock.now_us,
+                period_s=self.node.config.staleness_sample_s)
+            self._staleness.start()
+        stats.install_error_monitor()
+        if self.node.config.metrics_port is not None:
+            # process-global: all DCs share one registry and one server
+            stats.ensure_metrics_server(self.node.config.metrics_port)
 
     def tick_heartbeats(self) -> None:
         """One heartbeat round: each partition broadcasts its min-prepared
@@ -244,6 +255,9 @@ class DataCenter(AntidoteTPU):
         if self._bc_worker is not None:
             self._bc_worker.stop()
             self._bc_worker = None
+        if self._staleness is not None:
+            self._staleness.stop()
+            self._staleness = None
         self._worker.stop()
         self.bus.unregister(self.node.dc_id)
         super().close()

@@ -1,0 +1,309 @@
+"""Ops-plane metrics — the antidote_stats_collector / antidote_error_monitor
+equivalent, dependency-free.
+
+The reference defines five Prometheus metrics
+(reference src/antidote_stats_collector.erl:80-85) and exposes them over
+HTTP :3001 via elli (reference src/antidote_sup.erl:118-128); the same
+names and semantics are kept so the packaged Grafana dashboard
+(reference monitoring/Antidote-Dashboard.json) reads unchanged:
+
+- ``antidote_error_count``                 counter, bumped by the error
+  monitor (reference src/antidote_error_monitor.erl:38-46)
+- ``antidote_staleness``                   histogram, ms buckets
+  [1, 10, 100, 1000, 10000], sampled every 10 s from the GST
+  (reference src/antidote_stats_collector.erl:36-38, 87-93)
+- ``antidote_open_transactions``           gauge
+- ``antidote_aborted_transactions_total``  counter
+- ``antidote_operations_total{type}``      counter by operation type
+  (incremented in the coordinator, reference
+  src/clocksi_interactive_coord.erl:667, 734, 849, 870, 942, 966)
+
+Exposition is the Prometheus text format served by a stdlib HTTP server
+(the elli replacement).
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import threading
+import time
+from typing import Dict, Iterable, Optional, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, labels: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+        for key, v in items:
+            yield f"{self.name}{_fmt_labels(self.label_names, key)} {_fmt(v)}"
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        yield f"{self.name} {_fmt(self.value())}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: Tuple[float, ...]):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            counts, total = list(self._counts), self._sum
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            yield f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}'
+        cum += counts[-1]
+        yield f'{self.name}_bucket{{le="+Inf"}} {cum}'
+        yield f"{self.name}_sum {_fmt(total)}"
+        yield f"{self.name}_count {cum}"
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _fmt_labels(names: Tuple[str, ...], values: Tuple) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+class Registry:
+    """The metric set from reference init_metrics
+    (src/antidote_stats_collector.erl:80-85)."""
+
+    def __init__(self):
+        self.error_count = Counter(
+            "antidote_error_count",
+            "The number of error encountered during operation")
+        self.staleness = Histogram(
+            "antidote_staleness",
+            "The staleness of the stable snapshot",
+            buckets=(1, 10, 100, 1000, 10000))
+        self.open_transactions = Gauge(
+            "antidote_open_transactions", "Number of open transactions")
+        self.aborted_transactions = Counter(
+            "antidote_aborted_transactions_total",
+            "Number of aborted transactions")
+        self.operations = Counter(
+            "antidote_operations_total", "Number of operations executed",
+            labels=("type",))
+
+    def metrics(self):
+        return (self.error_count, self.staleness, self.open_transactions,
+                self.aborted_transactions, self.operations)
+
+    def exposition(self) -> str:
+        lines = []
+        for m in self.metrics():
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+#: process-wide registry (the reference's metrics are BEAM-node-global)
+registry = Registry()
+
+
+class ErrorMonitorHandler(logging.Handler):
+    """logging handler -> error counter (the error_logger handler,
+    reference src/antidote_error_monitor.erl:28-49)."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        super().__init__(level=logging.ERROR)
+        self.registry = reg or registry
+
+    def emit(self, record) -> None:
+        self.registry.error_count.inc()
+
+
+_error_monitor_installed = False
+_install_lock = threading.Lock()
+
+
+def install_error_monitor() -> None:
+    """Attach the error-count handler to the root logger, once per
+    process (the reference registers its handler with error_logger at
+    app start, src/antidote_error_monitor.erl:28-33)."""
+    global _error_monitor_installed
+    with _install_lock:
+        if _error_monitor_installed:
+            return
+        logging.getLogger().addHandler(ErrorMonitorHandler())
+        _error_monitor_installed = True
+
+
+_shared_server: Optional["MetricsServer"] = None
+
+
+def ensure_metrics_server(port: int) -> "MetricsServer":
+    """One exposition server per process: every DataCenter shares the
+    process-global registry, so per-DC servers would race on the port
+    and serve identical data anyway."""
+    global _shared_server
+    with _install_lock:
+        if _shared_server is None:
+            _shared_server = MetricsServer(port=port).start()
+        return _shared_server
+
+
+def stop_shared_metrics_server() -> None:
+    global _shared_server
+    with _install_lock:
+        if _shared_server is not None:
+            _shared_server.stop()
+            _shared_server = None
+
+
+class StalenessSampler:
+    """Every 10 s, observe (now - min GST entry) in ms (reference
+    src/antidote_stats_collector.erl:87-93: staleness of the stable
+    snapshot vs the local clock)."""
+
+    def __init__(self, stable_vc_source, now_us, reg: Optional[Registry] = None,
+                 period_s: float = 10.0):
+        self.stable_vc_source = stable_vc_source
+        self.now_us = now_us
+        self.registry = reg or registry
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self) -> float:
+        staleness_ms = sample_staleness_ms(
+            self.stable_vc_source(), self.now_us())
+        self.registry.staleness.observe(staleness_ms)
+        return staleness_ms
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 — sampler must not die
+                logging.getLogger(__name__).exception("staleness sample")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class MetricsServer:
+    """Prometheus text exposition over HTTP (the elli endpoint on :3001,
+    reference src/antidote_sup.erl:118-128)."""
+
+    def __init__(self, port: int = 3001, reg: Optional[Registry] = None,
+                 host: str = "127.0.0.1"):
+        self.registry = reg or registry
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = outer.registry.exposition().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence request logging
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2.0)
+
+
+def sample_staleness_ms(vc, now_us: int) -> float:
+    """Pure helper (exported for the device-side staleness kernel)."""
+    entries = list(dict(vc).values())
+    oldest = min(entries) if entries else 0
+    return max(now_us - oldest, 0) / 1000.0

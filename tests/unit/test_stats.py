@@ -1,0 +1,135 @@
+"""Ops-plane metrics tests — the antidote_stats_collector /
+antidote_error_monitor surface (reference
+src/antidote_stats_collector.erl:80-96, src/antidote_error_monitor.erl):
+metric names, coordinator increment sites, staleness sampling, error
+handler, and the Prometheus text endpoint.
+"""
+
+import logging
+import urllib.request
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.api import AntidoteTPU, TransactionAborted
+from antidote_tpu.clocks import VC
+
+
+@pytest.fixture
+def db(tmp_path):
+    db = AntidoteTPU(dc_id="dc1", data_dir=str(tmp_path / "data"))
+    yield db
+    db.close()
+
+
+def test_reference_metric_names_present():
+    text = stats.registry.exposition()
+    for name in ("antidote_error_count", "antidote_staleness",
+                 "antidote_open_transactions",
+                 "antidote_aborted_transactions_total",
+                 "antidote_operations_total"):
+        assert name in text
+
+
+def test_coordinator_increments(db):
+    reg = stats.registry
+    ops0 = reg.operations.value(type="update")
+    reads0 = reg.operations.value(type="read")
+    open0 = reg.open_transactions.value()
+
+    tx = db.start_transaction()
+    assert reg.open_transactions.value() == open0 + 1
+    db.update_objects([(("s_ctr", "counter_pn"), "increment", 1)], tx)
+    db.read_objects([("s_ctr", "counter_pn")], tx)
+    db.commit_transaction(tx)
+
+    assert reg.open_transactions.value() == open0
+    assert reg.operations.value(type="update") == ops0 + 1
+    assert reg.operations.value(type="read") == reads0 + 1
+
+
+def test_abort_counts(db):
+    reg = stats.registry
+    ab0 = reg.aborted_transactions.value()
+    open0 = reg.open_transactions.value()
+    tx = db.start_transaction()
+    with pytest.raises(TransactionAborted):
+        db.update_objects(
+            [(("bc_local", "counter_b"), "decrement", (5, "dc1"))], tx)
+    assert reg.aborted_transactions.value() == ab0 + 1
+    assert reg.open_transactions.value() == open0
+
+
+def test_type_check_failure_aborts_and_balances_gauge(db):
+    reg = stats.registry
+    open0 = reg.open_transactions.value()
+    tx = db.start_transaction()
+    db.update_objects([(("tc_k", "counter_pn"), "increment", 1)], tx)
+    with pytest.raises(TypeError, match="type_check"):
+        db.update_objects([(("tc_k", "counter_pn"), "bogus", 1)], tx)
+    # the txn was aborted, staged effects dropped, gauge balanced
+    assert reg.open_transactions.value() == open0
+    vals, _ = db.read_objects_static(None, [("tc_k", "counter_pn")])
+    assert vals == [0]
+
+
+def test_shared_metrics_server_single_instance():
+    try:
+        s1 = stats.ensure_metrics_server(0)
+        s2 = stats.ensure_metrics_server(0)
+        assert s1 is s2
+    finally:
+        stats.stop_shared_metrics_server()
+
+
+def test_error_monitor_handler():
+    reg = stats.Registry()
+    handler = stats.ErrorMonitorHandler(reg)
+    log = logging.getLogger("test_stats_err")
+    log.addHandler(handler)
+    try:
+        log.warning("not counted")
+        assert reg.error_count.value() == 0
+        log.error("counted")
+        log.exception("also counted")
+        assert reg.error_count.value() == 2
+    finally:
+        log.removeHandler(handler)
+
+
+def test_staleness_sampler():
+    reg = stats.Registry()
+    now = [10_000_000]
+    sampler = stats.StalenessSampler(
+        lambda: VC({"dc1": 9_990_000, "dc2": 9_000_000}),
+        lambda: now[0], reg=reg)
+    # staleness = now - oldest entry = 1_000_000 us = 1000 ms
+    assert sampler.sample_once() == pytest.approx(1000.0)
+    assert reg.staleness.count == 1
+
+
+def test_histogram_buckets_match_reference():
+    h = stats.registry.staleness
+    assert h.buckets == (1, 10, 100, 1000, 10000)
+    reg = stats.Registry()
+    reg.staleness.observe(5)     # -> le=10
+    reg.staleness.observe(50000)  # -> +Inf
+    text = "\n".join(reg.staleness.expose())
+    assert 'le="10"} 1' in text
+    assert 'le="+Inf"} 2' in text
+    assert "antidote_staleness_count 2" in text
+
+
+def test_http_exposition():
+    reg = stats.Registry()
+    reg.operations.inc(3, type="read")
+    srv = stats.MetricsServer(port=0, reg=reg).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+        assert 'antidote_operations_total{type="read"} 3' in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5)
+    finally:
+        srv.stop()
