@@ -53,16 +53,19 @@ class AntidoteTPU:
 
     # ------------------------------------------------------------ static txn
 
-    def read_objects_static(self, clock: Optional[VC], objects: List
+    def read_objects_static(self, clock: Optional[VC], objects: List,
+                            properties: Optional[TxnProperties] = None
                             ) -> Tuple[List[Any], VC]:
         """One-shot snapshot read (reference cure:obtain_objects fast
-        path, src/cure.erl:135-183).  Under txn_prot="gr" the snapshot
-        is the GentleRain scalar-GST wait instead of the Clock-SI
-        max(stable, client) rule (reference src/cure.erl:233-257)."""
+        path, src/cure.erl:135-183; reference antidote:read_objects/3
+        takes the same txn properties).  Under txn_prot="gr" the
+        snapshot is the GentleRain scalar-GST wait instead of the
+        Clock-SI max(stable, client) rule (reference src/cure.erl:233-257)."""
         if self.node.config.txn_prot == "gr":
-            tx = self.node.coordinator.start_transaction_gr(clock)
+            tx = self.node.coordinator.start_transaction_gr(
+                clock, properties)
         else:
-            tx = self.start_transaction(clock)
+            tx = self.start_transaction(clock, properties)
         values = self.read_objects(objects, tx)
         commit_vc = self.commit_transaction(tx)
         return values, commit_vc

@@ -54,9 +54,13 @@ class InterDcLogSender:
 
     def ping(self, min_prepared_time: int) -> None:
         """Broadcast a heartbeat carrying this partition's min-prepared
-        time (reference ping path src/inter_dc_log_sender_vnode.erl:133-143)."""
-        if not self.enabled:
-            return
+        time (reference ping path src/inter_dc_log_sender_vnode.erl:133-143).
+
+        Unlike txn publishing, pings are NOT gated on ``enabled``: the
+        reference's heartbeat timers run unconditionally once started,
+        which is what lets two DCs connect *sequentially* with sync
+        waits — the second DC's pings must flow before it has observed
+        anyone.  Callers only tick this from started heartbeat loops."""
         with self._lock:
             txn = InterDcTxn.ping(self.dc_id, self.partition,
                                   self.last_sent_opid, min_prepared_time)

@@ -1,0 +1,13 @@
+"""Wire protocol: length-framed protobuf over TCP (the reference's
+antidote_pb stack — listener, per-connection protocol loop, dispatch —
+reference src/antidote_pb_sup.erl, src/antidote_pb_protocol.erl,
+src/antidote_pb_process.erl).
+
+Regenerate ``antidote_pb2.py`` after editing ``antidote.proto``:
+``protoc --python_out=. antidote.proto`` in this directory.
+"""
+
+from antidote_tpu.pb.client import PbClient, PbError
+from antidote_tpu.pb.server import DEFAULT_PORT, PbServer
+
+__all__ = ["PbClient", "PbError", "PbServer", "DEFAULT_PORT"]
