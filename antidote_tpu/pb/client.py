@@ -49,13 +49,17 @@ class PbClient:
         try:
             self.sock.sendall(codec.encode_msg(msg))
             frame = codec.read_frame(self.sock)
-        except (TimeoutError, socket.timeout, OSError) as e:
+            if frame is None:
+                raise PbError("connection closed")
+            # decode failures (unknown code, corrupt payload) also mean
+            # the stream can no longer be trusted
+            resp = codec.decode_msg(*frame)
+        except PbError:
+            self._broken = True
+            raise
+        except Exception as e:  # noqa: BLE001 — any stream fault
             self._broken = True
             raise PbError(f"transport failure: {e}") from e
-        if frame is None:
-            self._broken = True
-            raise PbError("connection closed")
-        resp = codec.decode_msg(*frame)
         if isinstance(resp, pb.ApbErrorResp):
             raise PbError(resp.message)
         return resp

@@ -119,6 +119,11 @@ class _Connection:
             tx = self._tx(req.txid)
             objects = [codec.bound_from_pb(b) for b in req.objects]
             values = self.db.read_objects(objects, tx)
+        except TransactionAborted as e:
+            # the coordinator aborted the txn on the failed read: drop
+            # the token like the update handler does
+            self.txns.pop(req.txid, None)
+            return pb.ApbReadObjectsResp(success=False, error=str(e))
         except Exception as e:  # noqa: BLE001
             return pb.ApbReadObjectsResp(success=False, error=str(e))
         resp = pb.ApbReadObjectsResp(success=True)
@@ -156,7 +161,8 @@ class _Connection:
 
     def _abort(self, req: pb.ApbAbortTransaction):
         try:
-            tx = self.txns.pop(req.txid)
+            tx = self._tx(req.txid)
+            self.txns.pop(req.txid, None)
             self.db.abort_transaction(tx)
         except Exception as e:  # noqa: BLE001
             return pb.ApbOperationResp(success=False, error=str(e))

@@ -31,10 +31,21 @@ class TxnState(Enum):
     ACTIVE = "active"
     COMMITTED = "committed"
     ABORTED = "aborted"
+    #: a 2PC commit round failed after at least one partition durably
+    #: committed: the outcome is NOT a clean abort and must not be
+    #: retried blindly
+    UNKNOWN = "unknown"
 
 
 class TransactionAborted(Exception):
     pass
+
+
+class CommitOutcomeUnknown(Exception):
+    """Raised when the commit decision was reached (all prepares
+    succeeded) but applying it failed on some partition — effects may
+    be partially durable, so reporting an abort would invite a retry
+    and double-apply."""
 
 
 @dataclass
@@ -163,13 +174,21 @@ class Coordinator:
         self._check_active(tx)
         stats.registry.operations.inc(len(bound_objects), type="read")
         out = []
-        for bo in bound_objects:
-            key, type_name, _bucket = self.node.normalize_bound(bo)
-            cls = get_type(type_name)
-            pm = self.node.partition_of(key)
-            value = pm.read_with_writeset(
-                key, cls.name, tx.snapshot_vc, tx.txid, tx.own_effects(key))
-            out.append(cls.value(value))
+        try:
+            for bo in bound_objects:
+                key, type_name, _bucket = self.node.normalize_bound(bo)
+                cls = get_type(type_name)
+                pm = self.node.partition_of(key)
+                value = pm.read_with_writeset(
+                    key, cls.name, tx.snapshot_vc, tx.txid,
+                    tx.own_effects(key))
+                out.append(cls.value(value))
+        except Exception as e:
+            # a failed read aborts the transaction, as the coordinator
+            # FSM does on a read error (reference
+            # receive_read_objects_result error path)
+            self.abort_transaction(tx)
+            raise TransactionAborted(f"read failed: {e}") from e
         return out
 
     # -------------------------------------------------------------- updates
@@ -223,25 +242,51 @@ class Coordinator:
         node = self.node
         certify = (tx.properties.certify
                    if tx.properties.certify is not None else node.config.certify)
-        try:
-            if not tx.partitions:
-                commit_vc = tx.snapshot_vc
-            elif len(tx.partitions) == 1:
-                pm = node.partitions[tx.partitions[0]]
+        if not tx.partitions:
+            commit_vc = tx.snapshot_vc
+        elif len(tx.partitions) == 1:
+            pm = node.partitions[tx.partitions[0]]
+            try:
                 ct = pm.single_commit(tx.txid, tx.snapshot_vc, certify)
-                commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
-            else:
-                pms = [node.partitions[p] for p in tx.partitions]
+            except CertificationError as e:
+                self.abort_transaction(tx)
+                raise TransactionAborted(str(e)) from e
+            except Exception as e:
+                # single_commit is atomic at the partition: a failure
+                # means nothing durable happened, so aborting is safe —
+                # the reference FSM never leaves a transaction open
+                # after a failed prepare (receive_prepared abort path,
+                # src/clocksi_interactive_coord.erl:1078-1120)
+                self.abort_transaction(tx)
+                raise TransactionAborted(f"commit failed: {e}") from e
+            commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
+        else:
+            pms = [node.partitions[p] for p in tx.partitions]
+            try:
                 prepare_times = [
                     pm.prepare(tx.txid, tx.snapshot_vc, certify) for pm in pms
                 ]
-                ct = max(prepare_times)
+            except CertificationError as e:
+                self.abort_transaction(tx)
+                raise TransactionAborted(str(e)) from e
+            except Exception as e:
+                # prepare failures are pre-decision: abort is safe
+                self.abort_transaction(tx)
+                raise TransactionAborted(f"prepare failed: {e}") from e
+            ct = max(prepare_times)
+            try:
                 for pm in pms:
                     pm.commit(tx.txid, ct, tx.snapshot_vc)
-                commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
-        except CertificationError as e:
-            self.abort_transaction(tx)
-            raise TransactionAborted(str(e)) from e
+            except Exception as e:
+                # post-decision failure: some partitions may hold a
+                # durable commit record — reporting an abort here would
+                # invite a retry and double-apply
+                tx.state = TxnState.UNKNOWN
+                stats.registry.open_transactions.dec()
+                raise CommitOutcomeUnknown(
+                    f"commit decided at {ct} but applying it failed: {e}"
+                ) from e
+            commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
         tx.state = TxnState.COMMITTED
         tx.commit_vc = commit_vc
         stats.registry.open_transactions.dec()
