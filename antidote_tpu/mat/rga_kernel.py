@@ -1,0 +1,161 @@
+"""Batched RGA merge kernel — the long-sequence materialization target.
+
+The reference materializes an RGA by splicing one op at a time into a
+linked list inside a gen_server (reference antidote_crdt rga `update`,
+surveyed via the behaviour contract in SURVEY §2.6; host oracle:
+antidote_tpu/crdt/rga.py).  At 100k-op collaborative-text logs
+(BASELINE config 4) that sequential walk is the bottleneck.
+
+Here the *entire* merge is a fixed-shape parallel program:
+
+1. **Causal tree build.**  Every insert references the vertex to its
+   left; with Lamport uids (child.lamport > parent.lamport — guaranteed
+   by RGA's downstream generation) the document order is exactly the
+   preorder of the tree whose siblings are ordered uid-descending.
+   Parent resolution is a sort + searchsorted over packed uids; sibling
+   order is one stable two-key sort.
+
+2. **Euler tour.**  Preorder needs "next sibling of the nearest ancestor
+   with one" — non-local.  The Euler tour successor is *local*: each
+   vertex gets a down-slot (enter) and an up-slot (leave), and
+   ``succ(down v) = down firstchild(v) | up v``,
+   ``succ(up v) = down nextsib(v) | up parent(v)``.
+
+3. **Pointer-doubling list rank** (Wyllie).  ``ceil(log2(2N))`` rounds of
+   ``dist += dist[next]; next = next[next]`` turn the successor list into
+   preorder ranks — O(log N) device steps, every one a dense gather the
+   TPU is happy with.  No sequential splice anywhere.
+
+Shapes are static: N insert lanes + M delete lanes, padding lanes carry
+valid=False.  uids are (lamport, actor) packed into int32 as
+``lamport << actor_bits | actor`` — callers must keep
+``lamport < 2**(31-actor_bits)`` (host asserts in the synth generator;
+at the default 8 actor bits that is 8.3M ops per log).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def pack_uid(lamport, actor, actor_bits: int = 8):
+    """int32 packed uid; (0, 0) (the root sentinel) packs to 0."""
+    return (lamport.astype(jnp.int32) << actor_bits) | actor.astype(jnp.int32)
+
+
+def _lexsort2(primary, secondary):
+    """argsort by (primary, secondary) via two stable argsorts."""
+    o1 = jnp.argsort(secondary, stable=True)
+    o2 = jnp.argsort(primary[o1], stable=True)
+    return o1[o2]
+
+
+@partial(jax.jit, static_argnames=("actor_bits",))
+def rga_merge(
+    ins_lamport: jax.Array,  # int32[N] lamport of inserted vertex
+    ins_actor: jax.Array,    # int32[N] actor (origin DC) of vertex
+    ref_lamport: jax.Array,  # int32[N] lamport of left-neighbour ref (0=head)
+    ref_actor: jax.Array,    # int32[N] actor of ref
+    elem: jax.Array,         # int32[N] interned payload token
+    valid: jax.Array,        # bool[N]
+    del_lamport: jax.Array,  # int32[M] delete targets
+    del_actor: jax.Array,    # int32[M]
+    del_valid: jax.Array,    # bool[M]
+    actor_bits: int = 8,
+):
+    """Merge a full RGA op log in one shot.
+
+    Returns ``(doc, n_visible, rank, visible)``:
+    - ``doc``: int32[N] — ``elem`` of visible vertices in document order,
+      padded with -1;
+    - ``n_visible``: int32 scalar;
+    - ``rank``: int32[N] preorder position of every vertex (1-based;
+      padding lanes get huge ranks);
+    - ``visible``: bool[N] — inserted, not tombstoned, not padding.
+    """
+    n = ins_lamport.shape[0]
+    root = n            # virtual root vertex index
+    parked = n + 1      # where padding / unresolvable lanes go
+
+    uid = pack_uid(ins_lamport, ins_actor, actor_bits)
+    uid = jnp.where(valid, uid, _I32MAX)          # park padding uids
+    ref = pack_uid(ref_lamport, ref_actor, actor_bits)
+
+    # -- parent resolution: uid -> vertex index ---------------------------
+    by_uid = jnp.argsort(uid)                      # [N]
+    sorted_uid = uid[by_uid]
+    pos = jnp.searchsorted(sorted_uid, ref)
+    cpos = jnp.clip(pos, 0, n - 1)
+    hit = (pos < n) & (sorted_uid[cpos] == ref)
+    parent = jnp.where(
+        ref == 0, root, jnp.where(hit, by_uid[cpos], parked))
+    parent = jnp.where(valid, parent, parked)
+
+    # -- sibling lists: sort by (parent, uid desc) ------------------------
+    sperm = _lexsort2(parent, -uid)                # [N] vertex ids
+    sparent = parent[sperm]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sparent[1:] != sparent[:-1]])
+    # first_child over [0..parked]; scatter only segment heads
+    fc_idx = jnp.where(first, sparent, parked + 1)  # OOB -> dropped
+    first_child = jnp.full((n + 2,), -1, jnp.int32).at[fc_idx].set(
+        sperm.astype(jnp.int32), mode="drop")
+    same = sparent[:-1] == sparent[1:]
+    ns_src = jnp.where(same, sperm[:-1], n + 5)     # OOB -> dropped
+    next_sib = jnp.full((n,), -1, jnp.int32).at[ns_src].set(
+        sperm[1:].astype(jnp.int32), mode="drop")
+
+    # -- Euler tour successors -------------------------------------------
+    # slots: down_i = i for i in [0..n] (n = root), up_i = (n+1) + i
+    s = 2 * (n + 1)
+    up = n + 1
+    v = jnp.arange(n + 1, dtype=jnp.int32)         # vertex ids incl. root
+    fc = first_child[v]                            # [n+1]
+    succ_down = jnp.where(fc >= 0, fc, up + v)
+    ns = jnp.concatenate([next_sib, jnp.full((1,), -1, jnp.int32)])  # root
+    par = jnp.concatenate(
+        [parent.astype(jnp.int32), jnp.full((1,), root, jnp.int32)])
+    succ_up = jnp.where(ns[v] >= 0, ns[v], up + par[v])
+    succ_up = succ_up.at[root].set(up + root)      # terminal self-loop
+    # parked vertices: self-loop both slots so they never rank
+    parked_v = par[v] == parked
+    succ_down = jnp.where(parked_v, v, succ_down)
+    succ_up = jnp.where(parked_v, up + v, succ_up)
+    succ = jnp.concatenate([succ_down, succ_up])   # [s]
+
+    # -- Wyllie pointer-doubling list rank --------------------------------
+    slot = jnp.arange(s, dtype=jnp.int32)
+    dist = (succ != slot).astype(jnp.int32)
+    steps = max(1, (s - 1).bit_length())
+
+    def body(_, c):
+        d, nx = c
+        return d + d[nx], nx[nx]
+
+    dist, _ = lax.fori_loop(0, steps, body, (dist, succ))
+    # preorder rank = dist(down_root) - dist(down_v); root -> 0
+    rank = dist[root] - dist[jnp.arange(n, dtype=jnp.int32)]
+    reachable = valid & (parent != parked) & (rank > 0)
+    rank = jnp.where(reachable, rank, _I32MAX)
+
+    # -- tombstones -------------------------------------------------------
+    duid = pack_uid(del_lamport, del_actor, actor_bits)
+    dpos = jnp.searchsorted(sorted_uid, duid)
+    dcpos = jnp.clip(dpos, 0, n - 1)
+    dhit = del_valid & (dpos < n) & (sorted_uid[dcpos] == duid)
+    tgt = jnp.where(dhit, by_uid[dcpos], n)        # OOB -> dropped
+    deleted = jnp.zeros((n,), bool).at[tgt].set(True, mode="drop")
+    visible = reachable & ~deleted
+
+    # -- materialized document -------------------------------------------
+    key = jnp.where(visible, rank, _I32MAX)
+    doc_perm = jnp.argsort(key)
+    doc = jnp.where(
+        visible[doc_perm], elem[doc_perm].astype(jnp.int32), -1)
+    return doc, jnp.sum(visible).astype(jnp.int32), rank, visible
