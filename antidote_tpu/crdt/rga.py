@@ -16,8 +16,8 @@ Client ops (positions index the *visible* sequence at the origin):
 - ``("remove", pos)`` — tombstone the pos-th visible element (1-based,
   matching the head=0 convention of add_right).
 
-The batched device form (segmented merge over padded op arrays) lives in
-antidote_tpu/mat/kernels.py.
+The batched device form (Euler-tour preorder merge over padded op
+arrays) lives in antidote_tpu/mat/rga_kernel.py.
 """
 
 from __future__ import annotations

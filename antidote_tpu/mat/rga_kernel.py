@@ -28,9 +28,13 @@ Here the *entire* merge is a fixed-shape parallel program:
 
 Shapes are static: N insert lanes + M delete lanes, padding lanes carry
 valid=False.  uids are (lamport, actor) packed into int32 as
-``lamport << actor_bits | actor`` — callers must keep
-``lamport < 2**(31-actor_bits)`` (host asserts in the synth generator;
-at the default 8 actor bits that is 8.3M ops per log).
+``lamport << actor_bits | actor`` — callers must keep the packed value
+strictly below INT32_MAX, which the padding sentinel owns (host asserts
+in the synth generator; at the default 8 actor bits that is ~8.3M ops
+per log).  Duplicate delivery of the same uid is tolerated (later copies
+are parked, matching the host RGA's dedup); inserts referencing a uid
+absent from the log are unresolvable and excluded together with their
+subtrees (the host oracle raises instead — feed the kernel closed logs).
 """
 
 from __future__ import annotations
@@ -90,12 +94,17 @@ def rga_merge(
     # -- parent resolution: uid -> vertex index ---------------------------
     by_uid = jnp.argsort(uid)                      # [N]
     sorted_uid = uid[by_uid]
+    # dedup duplicate delivery: all but the first copy of a uid (the one
+    # searchsorted binds to) are parked
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((1,), bool), sorted_uid[1:] == sorted_uid[:-1]])
+    dup = jnp.zeros((n,), bool).at[by_uid].set(dup_sorted)
     pos = jnp.searchsorted(sorted_uid, ref)
     cpos = jnp.clip(pos, 0, n - 1)
     hit = (pos < n) & (sorted_uid[cpos] == ref)
     parent = jnp.where(
         ref == 0, root, jnp.where(hit, by_uid[cpos], parked))
-    parent = jnp.where(valid, parent, parked)
+    parent = jnp.where(valid & ~dup, parent, parked)
 
     # -- sibling lists: sort by (parent, uid desc) ------------------------
     sperm = _lexsort2(parent, -uid)                # [N] vertex ids
@@ -138,10 +147,16 @@ def rga_merge(
         d, nx = c
         return d + d[nx], nx[nx]
 
-    dist, _ = lax.fori_loop(0, steps, body, (dist, succ))
-    # preorder rank = dist(down_root) - dist(down_v); root -> 0
+    dist, fin = lax.fori_loop(0, steps, body, (dist, succ))
+    # After >= log2(s) doublings every chain has collapsed onto its
+    # terminal self-loop, so fin[x] is the chain's terminal: only
+    # vertices whose tour actually ends at up_root are in the document
+    # (a vertex under a parked/unresolvable ancestor terminates at that
+    # ancestor's up-slot instead — excluded, with its whole subtree).
     rank = dist[root] - dist[jnp.arange(n, dtype=jnp.int32)]
-    reachable = valid & (parent != parked) & (rank > 0)
+    reachable = (
+        valid & (parent != parked)
+        & (fin[jnp.arange(n, dtype=jnp.int32)] == up + root))
     rank = jnp.where(reachable, rank, _I32MAX)
 
     # -- tombstones -------------------------------------------------------

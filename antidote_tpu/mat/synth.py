@@ -16,12 +16,16 @@ def rga_trace(rng, n_ops: int, n_actors: int = 8,
     child > parent by construction: lamport_i = i+1, refs point backward)
     plus tombstones on random earlier inserts.
 
-    Returns padded dense fields for rga_kernel.rga_merge.  Vectorized —
+    Returns dense fields for rga_kernel.rga_merge (all lanes valid; the
+    kernel accepts extra padding lanes with valid=False).  Vectorized —
     usable at 100k+ ops (BASELINE config 4).
     """
     n_ins = int(n_ops * (1.0 - p_delete))
     n_del = n_ops - n_ins
-    assert (n_ins + 1) < (1 << (31 - actor_bits)), "lamport overflow"
+    assert n_actors <= (1 << actor_bits), "actor overflow"
+    # packed uid must stay strictly below INT32_MAX (padding sentinel)
+    assert (((n_ins + 1) << actor_bits) | ((1 << actor_bits) - 1)) \
+        < 2**31 - 1, "lamport overflow"
     lam = np.arange(1, n_ins + 1, dtype=np.int32)
     actor = rng.integers(0, n_actors, size=n_ins).astype(np.int32)
     # ref: head with small probability, else a random earlier vertex,

@@ -141,6 +141,22 @@ def test_synth_trace_shapes_and_validity():
     assert len(np.unique(r)) == len(r)
 
 
+def test_unresolvable_ref_excludes_whole_subtree():
+    # A references a uid absent from the log; B is A's child.  Neither
+    # may leak into the document (regression: B's Euler chain used to
+    # terminate at A's up-slot with a bogus colliding rank).
+    head = [(i + 1, 0, i, 0, 100 + i) for i in range(5)]  # chain of 5
+    orphan = [(50, 1, 40, 1, 201), (51, 1, 50, 1, 202)]
+    assert run_kernel(head + orphan, []) == [100, 101, 102, 103, 104]
+
+
+def test_duplicate_delivery_is_deduped():
+    # the same insert delivered twice materializes once (host rga.py
+    # dedups by uid); children still attach to the surviving copy
+    ins = [(1, 0, 0, 0, 100), (1, 0, 0, 0, 100), (2, 0, 1, 0, 101)]
+    assert run_kernel(ins, []) == [100, 101]
+
+
 def test_large_trace_matches_oracle():
     rng = np.random.default_rng(7)
     inserts, deletes = replica_trace(rng, 600, n_replicas=6)
