@@ -1,0 +1,13 @@
+"""BASELINE.md benchmark configs.
+
+bench.py at the repo root is the driver-run headline (config 2: OR-Set
+1M keys).  Each module here covers one of the remaining configs and
+prints the same one-JSON-line shape:
+
+- config1_counter.py  — PN-Counter increment-only, single DC
+- config3_mvreg.py    — MV-Register, 64 simulated DCs (VC-dominance)
+- config4_rga.py      — RGA 100k-op log merge (long-sequence kernel)
+- config5_gst.py      — 256-DC synthetic GST convergence sweep
+
+Run all: python -m benches.run_all [--quick] [--cpu]
+"""

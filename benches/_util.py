@@ -1,0 +1,42 @@
+"""Shared bench plumbing: platform flags, timing, JSON line output."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def setup(argv=None):
+    """Apply --cpu / --quick flags; returns (quick, jax)."""
+    argv = sys.argv if argv is None else argv
+    import jax
+
+    if "--cpu" in argv:
+        jax.config.update("jax_platforms", "cpu")
+    return "--quick" in argv, jax
+
+
+def timed(fn, *args, block=None, warmup=2, iters=5):
+    """Median wall-seconds of fn(*args) after warmup; ``block`` maps the
+    result to an array to block_until_ready on."""
+    import jax
+
+    for _ in range(warmup):
+        r = fn(*args)
+        jax.block_until_ready(block(r) if block else r)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        jax.block_until_ready(block(r) if block else r)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def emit(metric, value, unit, vs_baseline, **detail):
+    print(json.dumps({
+        "metric": metric, "value": value, "unit": unit,
+        "vs_baseline": vs_baseline, "detail": detail,
+    }))

@@ -1,0 +1,80 @@
+"""BASELINE config 1: PN-Counter increment-only, single DC.
+
+Device path: counter shard store (append + GC fold + read) on one chip.
+Baseline: the reference applies one increment at a time through the CRDT
+behaviour inside BEAM (reference src/clocksi_materializer.erl hot loop);
+measured here as the same per-op loop through the host counter_pn type.
+"""
+
+import numpy as np
+
+from benches._util import emit, setup, timed
+
+
+def device_ops_per_sec(jax, K, B, n_steps):
+    import jax.numpy as jnp
+
+    from antidote_tpu.mat import store
+
+    rng = np.random.default_rng(0)
+    st = store.counter_shard_init(K, n_lanes=8, n_dcs=1)
+    steps = []
+    ct = 0
+    for _ in range(n_steps):
+        keys = rng.integers(0, K, size=B).astype(np.int32)
+        delta = np.ones(B, dtype=np.int32)
+        op_ct = (ct + 1 + np.arange(B)).astype(np.int32)
+        ct += B
+        ss = np.maximum(op_ct - 1, 0)[:, None].astype(np.int32)
+        steps.append(dict(
+            keys=jnp.asarray(keys), delta=jnp.asarray(delta),
+            op_dc=jnp.zeros(B, jnp.int32), op_ct=jnp.asarray(op_ct),
+            op_ss=jnp.asarray(ss),
+            frontier=jnp.asarray(np.array([ct], dtype=np.int32)),
+        ))
+
+    def one(st, s):
+        lane_off = jnp.zeros_like(s["keys"])
+        st, _ov = store.counter_append(
+            st, s["keys"], lane_off, s["delta"], s["op_dc"], s["op_ct"],
+            s["op_ss"])
+        return store.counter_gc(st, s["frontier"])
+
+    def run(st):
+        for s in steps:
+            st = one(st, s)
+        return st.value
+
+    dt = timed(run, st, warmup=1, iters=3)
+    return B * n_steps / dt
+
+
+def host_ops_per_sec(n_ops=50_000):
+    from antidote_tpu.crdt import get_type
+
+    cls = get_type("counter_pn")
+    rng = np.random.default_rng(1)
+    K = 4096
+    states = {}
+    keys = rng.integers(0, K, size=n_ops)
+    import time
+    t0 = time.perf_counter()
+    for i in range(n_ops):
+        k = int(keys[i])
+        states[k] = cls.update(1, states.get(k, cls.new()))
+    return n_ops / (time.perf_counter() - t0)
+
+
+def main():
+    quick, jax = setup()
+    K = 1_000_000 if not quick else 65_536
+    B = 65_536 if not quick else 8_192
+    dev = device_ops_per_sec(jax, K, B, n_steps=8 if not quick else 3)
+    host = host_ops_per_sec()
+    emit("counter_pn_increments_per_sec_single_dc", round(dev), "ops/s",
+         round(dev / host, 2), keys=K, batch=B,
+         device=str(jax.devices()[0]), host_baseline=round(host))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""BASELINE config 3: MV-Register at 64 simulated DCs.
+
+The hot math is the VC-dominance matrix: every assign carries an
+observed-VV over 64 DC columns; the merge is a masked [K, L, 64]
+max-reduction deciding which concurrent assigns survive
+(antidote_tpu/mat/kernels.py mvreg_apply).  Baseline: host register_mv
+one-op-at-a-time updates.
+"""
+
+import numpy as np
+
+from benches._util import emit, setup, timed
+
+
+def device_ops_per_sec(jax, K, L, D, iters=5):
+    import jax.numpy as jnp
+
+    from antidote_tpu.mat import kernels
+
+    rng = np.random.default_rng(0)
+    E = 4  # value slots per key
+    base = jnp.zeros((K, E, D), jnp.int32)
+    val_slot = jnp.asarray(rng.integers(0, E, size=(K, L)), jnp.int32)
+    dot_dc = jnp.asarray(rng.integers(0, D, size=(K, L)), jnp.int32)
+    dot_seq = jnp.asarray(
+        rng.integers(1, 1000, size=(K, L)), jnp.int32)
+    obs = jnp.asarray(rng.integers(0, 500, size=(K, L, D)), jnp.int32)
+    mask = jnp.asarray(rng.random((K, L)) < 0.9)
+
+    fn = jax.jit(kernels.mvreg_apply)
+    dt = timed(fn, base, val_slot, dot_dc, dot_seq, obs, mask, iters=iters)
+    return K * L / dt
+
+
+def host_ops_per_sec(n_ops=20_000, D=64):
+    import time
+
+    from antidote_tpu.crdt import get_type
+
+    cls = get_type("register_mv")
+    rng = np.random.default_rng(1)
+    st = cls.new()
+    t0 = time.perf_counter()
+    for i in range(n_ops):
+        dc = int(rng.integers(0, D))
+        obs = tuple(d for d, _v in st)
+        st = cls.update(("asgn", b"v%d" % (i % 7), (dc, i + 1), obs), st)
+    return n_ops / (time.perf_counter() - t0)
+
+
+def main():
+    quick, jax = setup()
+    K = 262_144 if not quick else 16_384
+    L = 8
+    dev = device_ops_per_sec(jax, K, L, D=64)
+    host = host_ops_per_sec()
+    emit("mvreg_assign_merges_per_sec_64dc", round(dev), "ops/s",
+         round(dev / host, 2), keys=K, lanes=L, dcs=64,
+         device=str(jax.devices()[0]), host_baseline=round(host))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""BASELINE config 4: RGA collaborative-text, 100k-op logs.
+
+Device path: the whole log merges in one rga_merge call (causal-tree
+preorder via Euler tour + pointer-doubling list rank,
+antidote_tpu/mat/rga_kernel.py).  Baseline: the host RGA splices one op
+at a time into a Python list (the reference's per-op linked-list walk);
+it is O(n^2)-ish, so the baseline rate is measured at a smaller log and
+reported as ops/sec (which *overstates* the baseline at 100k ops).
+"""
+
+import time
+
+import numpy as np
+
+from benches._util import emit, setup, timed
+from antidote_tpu.mat import rga_kernel
+from antidote_tpu.mat.synth import rga_trace
+
+
+def device_ops_per_sec(jax, n_ops, iters=5):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    t = {k: jnp.asarray(v) for k, v in rga_trace(rng, n_ops).items()}
+
+    def run():
+        return rga_kernel.rga_merge(**t)
+
+    dt = timed(run, block=lambda r: r[0], iters=iters)
+    return n_ops / dt
+
+
+def host_ops_per_sec(n_ops=4_000):
+    from antidote_tpu.crdt.rga import RGA
+
+    rng = np.random.default_rng(1)
+    t = rga_trace(rng, n_ops)
+    n_ins = len(t["ins_lamport"])
+    st = RGA.new()
+    t0 = time.perf_counter()
+    for i in range(n_ins):
+        ref = ((0, "") if t["ref_lamport"][i] == 0
+               else (int(t["ref_lamport"][i]), str(int(t["ref_actor"][i]))))
+        st = RGA.update(
+            ("ins", (int(t["ins_lamport"][i]), str(int(t["ins_actor"][i]))),
+             ref, int(t["elem"][i])), st)
+    for i in range(len(t["del_lamport"])):
+        if t["del_valid"][i]:
+            st = RGA.update(
+                ("rm", (int(t["del_lamport"][i]),
+                        str(int(t["del_actor"][i])))), st)
+    return n_ops / (time.perf_counter() - t0)
+
+
+def main():
+    quick, jax = setup()
+    n_ops = 100_000 if not quick else 10_000
+    dev = device_ops_per_sec(jax, n_ops)
+    host = host_ops_per_sec()
+    emit("rga_merge_ops_per_sec_100k_log", round(dev), "ops/s",
+         round(dev / host, 2), log_ops=n_ops,
+         device=str(jax.devices()[0]), host_baseline=round(host),
+         note="host baseline measured at 4k ops (sequential splice "
+              "does not reach 100k)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""BASELINE config 5: 256-DC synthetic GST convergence sweep.
+
+The reference computes the stable snapshot by gossiping per-partition
+VCs and min-merging dicts in Erlang processes (reference
+src/meta_data_sender.erl:224-339, src/stable_time_functions.erl:39-85).
+Here the whole metadata plane is one dense tensor ``clock[N, P, N]``
+(each DC's per-partition knowledge of all N DC columns) and a gossip
+round is two fused reductions + a ring shift:
+
+    local[N, N]  = min over partitions
+    incoming     = roll(local, 1) (ring gossip neighbour)
+    clock        = elementwise min with broadcast incoming
+
+The sweep measures (a) device time per round at N=256 DCs and (b) rounds
+until every DC's GST equals the true global min (ring diameter).
+Baseline: the per-dict Python min-merge loop (BEAM-style) per round.
+"""
+
+import time
+
+import numpy as np
+
+from benches._util import emit, setup, timed
+
+
+def make_state(rng, N, P):
+    return rng.integers(100, 10_000, size=(N, P, N)).astype(np.int32)
+
+
+def device_round(jax, N, P):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    clock = jnp.asarray(make_state(rng, N, P))
+
+    @jax.jit
+    def gossip_round(clock):
+        local = jnp.min(clock, axis=1)                 # [N, N] per-DC mins
+        incoming = jnp.roll(local, 1, axis=0)          # ring neighbour
+        merged = jnp.minimum(local, incoming)          # received summary
+        # each DC folds the received summary into every partition row
+        clock = jnp.minimum(clock, merged[:, None, :])
+        return clock, jnp.min(local, axis=0)           # (state, true GST ref)
+
+    dt = timed(lambda c: gossip_round(c)[0], clock, iters=5)
+
+    # convergence: iterate until every DC's local min equals the global
+    truth = np.asarray(jnp.min(clock, axis=(0, 1)))
+    c = clock
+    rounds = 0
+    while rounds < 4 * N:
+        c, _ = gossip_round(c)
+        rounds += 1
+        local = np.asarray(np.min(np.asarray(c), axis=1))
+        if (local == truth[None, :]).all():
+            break
+    return dt, rounds
+
+
+def host_round_seconds(N=64, P=8):
+    """Python dict min-merge, one gossip round (meta_data_sender style)."""
+    rng = np.random.default_rng(1)
+    clocks = [[{d: int(rng.integers(100, 10_000)) for d in range(N)}
+               for _ in range(P)] for _ in range(N)]
+    t0 = time.perf_counter()
+    locals_ = []
+    for dc in range(N):
+        m = {}
+        for part in clocks[dc]:
+            for d, v in part.items():
+                m[d] = min(m.get(d, v), v)
+        locals_.append(m)
+    for dc in range(N):
+        inc = locals_[(dc - 1) % N]
+        for part in clocks[dc]:
+            for d in part:
+                part[d] = min(part[d], inc[d])
+    return time.perf_counter() - t0
+
+
+def main():
+    quick, jax = setup()
+    N = 256 if not quick else 64
+    P = 16
+    dt, rounds = device_round(jax, N, P)
+    host_dt = host_round_seconds(N=N, P=P)
+    emit("gst_gossip_round_us_256dc", round(dt * 1e6, 1), "us/round",
+         round(host_dt / dt, 2), dcs=N, partitions=P,
+         rounds_to_convergence=rounds,
+         device=str(jax.devices()[0]),
+         host_round_ms=round(host_dt * 1e3, 3))
+
+
+if __name__ == "__main__":
+    main()

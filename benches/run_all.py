@@ -1,0 +1,16 @@
+"""Run every BASELINE config; one JSON line each (config 2 = bench.py)."""
+
+import runpy
+import sys
+
+
+def main():
+    for mod in ("benches.config1_counter", "bench",
+                "benches.config3_mvreg", "benches.config4_rga",
+                "benches.config5_gst"):
+        sys.stderr.write(f"== {mod}\n")
+        runpy.run_module(mod, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()
