@@ -1,0 +1,144 @@
+"""Pallas TPU kernels for the materializer hot path.
+
+``orset_read_fused`` fuses the whole snapshot-read pipeline — per-op
+commit-VC construction, the Clock-SI inclusion test, the ORSWOT
+dot-table fold, and element presence — into one VMEM-resident pass over
+key blocks.  The jnp reference path (antidote_tpu/mat/kernels.py
+inclusion_mask → orset_apply → orset_present) materializes the [K, L, D]
+commit-VC tensor and the [K, E, D] fold intermediates in HBM between
+XLA fusions; here nothing leaves VMEM but the [TK, E] presence block.
+
+The scatter-max of the jnp path (``.at[elem_slot, dot_dc].max``) does
+not exist on the VPU; it is replaced by one-hot masked max-reductions
+over the (tiny, static) element × DC axes — an unrolled L-step loop of
+[TK, E, D] maxes, which vectorizes cleanly.
+
+All integer inputs are int32 (bool inputs arrive as int32 0/1); shapes
+are the shard-store layouts [K, L], [K, L, D], [K, E, D] with K blocked
+by ``block_k``.  Falls back to interpret mode off-TPU (tests run the
+same kernel code on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# index-map constants must stay int32: the package enables jax x64, and
+# a plain Python 0 traces as i64 there, which mosaic rejects
+_Z = np.int32(0)
+
+
+def _orset_read_kernel(
+    dots_ref,       # [TK, E, D]
+    elem_ref,       # [TK, L]
+    is_add_ref,     # [TK, L]
+    dot_dc_ref,     # [TK, L]
+    dot_seq_ref,    # [TK, L]
+    obs_ref,        # [TK, L, D]
+    op_dc_ref,      # [TK, L]
+    op_ct_ref,      # [TK, L]
+    op_ss_ref,      # [TK, L, D]
+    valid_ref,      # [TK, L]
+    base_ref,       # [1, D]
+    has_base_ref,   # [1, 1] (SMEM)
+    read_ref,       # [1, D]
+    out_ref,        # [TK, E]
+):
+    tk, e, d = dots_ref.shape
+    l = elem_ref.shape[1]
+
+    ss = op_ss_ref[:]                                   # [TK, L, D]
+    dc_cols = jax.lax.broadcasted_iota(jnp.int32, (tk, l, d), 2)
+    at_dc = dc_cols == op_dc_ref[:][:, :, None]
+    cvc = jnp.where(at_dc, jnp.maximum(ss, op_ct_ref[:][:, :, None]), ss)
+
+    base = base_ref[0][None, None, :]                   # [1, 1, D]
+    read = read_ref[0][None, None, :]
+    # bool all-reduce lowers as a float min on this mosaic version; an
+    # int32 min-reduce compiles cleanly
+    all2 = lambda c: jnp.min(
+        jnp.where(c, np.int32(1), _Z), axis=2) == np.int32(1)
+    covered = all2(cvc <= base) & (has_base_ref[0, 0] != _Z)
+    included = all2(cvc <= read)
+    mask = (valid_ref[:] != _Z) & ~covered & included   # [TK, L]
+    add_mask = mask & (is_add_ref[:] != _Z)
+
+    obs = obs_ref[:]
+    elem_slot = elem_ref[:]
+    dot_dc = dot_dc_ref[:]
+    dot_seq = dot_seq_ref[:]
+
+    last_seq = jnp.zeros((tk, e, d), jnp.int32)
+    max_obs = jnp.zeros((tk, e, d), jnp.int32)
+    e_ids = jax.lax.broadcasted_iota(jnp.int32, (tk, e, d), 1)
+    d_ids = jax.lax.broadcasted_iota(jnp.int32, (tk, e, d), 2)
+    for i in range(l):                                  # static unroll
+        at_e = e_ids == elem_slot[:, i][:, None, None]
+        at_d = d_ids == dot_dc[:, i][:, None, None]
+        seq_i = jnp.where(
+            at_e & at_d & add_mask[:, i][:, None, None],
+            dot_seq[:, i][:, None, None], _Z)
+        last_seq = jnp.maximum(last_seq, seq_i)
+        obs_i = jnp.where(
+            at_e & mask[:, i][:, None, None],
+            obs[:, i, :][:, None, :], _Z)
+        max_obs = jnp.maximum(max_obs, obs_i)
+
+    merged = jnp.maximum(dots_ref[:], last_seq)
+    live = jnp.where(merged > max_obs, merged, _Z)
+    out_ref[:] = jnp.max(live, axis=2)                  # >0 iff present
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def orset_read_fused(
+    dots, elem_slot, is_add, dot_dc, dot_seq, obs_vv,
+    op_dc, op_ct, op_ss, valid, base_vc, has_base, read_vc,
+    block_k: int = 2048, interpret: bool = False,
+):
+    """bool[K, E]: element presence at ``read_vc``; semantics identical
+    to kernels.inclusion_mask + orset_apply + orset_present with a
+    shard-wide (unbatched) base_vc/has_base/read_vc."""
+    k, e, d = dots.shape
+    l = elem_slot.shape[1]
+    i32 = lambda a: a.astype(jnp.int32)
+    # non-divisible K: the last block is padded by pallas; rows are
+    # independent, so padded lanes compute garbage that is dropped on
+    # the (bounds-masked) write
+    grid = (pl.cdiv(k, block_k),)
+    row = lambda i: (i, _Z)
+    row3 = lambda i: (i, _Z, _Z)
+    bspec = lambda shp, ix: pl.BlockSpec(shp, ix, memory_space=pltpu.VMEM)
+    rep = lambda shp: pl.BlockSpec(
+        shp, lambda i: (_Z,) * len(shp), memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _orset_read_kernel,
+        grid=grid,
+        in_specs=[
+            bspec((block_k, e, d), row3),
+            bspec((block_k, l), row), bspec((block_k, l), row),
+            bspec((block_k, l), row), bspec((block_k, l), row),
+            bspec((block_k, l, d), row3),
+            bspec((block_k, l), row), bspec((block_k, l), row),
+            bspec((block_k, l, d), row3),
+            bspec((block_k, l), row),
+            rep((1, d)),
+            pl.BlockSpec((1, 1), lambda i: (_Z, _Z),
+                         memory_space=pltpu.SMEM),
+            rep((1, d)),
+        ],
+        out_specs=bspec((block_k, e), row),
+        out_shape=jax.ShapeDtypeStruct((k, e), jnp.int32),
+        interpret=interpret,
+    )(
+        i32(dots), i32(elem_slot), i32(is_add), i32(dot_dc), i32(dot_seq),
+        i32(obs_vv), i32(op_dc), i32(op_ct), i32(op_ss), i32(valid),
+        i32(base_vc)[None, :], i32(has_base).reshape(1, 1),
+        i32(read_vc)[None, :],
+    )
+    return out > 0

@@ -5,18 +5,35 @@ materialized snapshots per key, GC'd by thresholds (reference
 src/materializer_vnode.erl:36-47, 511-647; ring layout doc
 include/antidote.hrl:81-90).  The TPU redesign collapses that to:
 
-- a dense **op ring** ``[K, L]`` per shard (padded, cursor per key), and
+- a dense **op ring** of L lanes per key (padded, free-slot bitmap), and
 - a single **base snapshot per key anchored at the GST**: because the
   batched kernels can materialize at *any* read VC >= base in one call,
   one base snapshot replaces the reference's per-key snapshot list.
   Reads below the GST fall back to log replay, exactly like the
   reference's snapshot-cache miss (src/materializer_vnode.erl:415-419).
 
-The GC step is the reference's op_insert_gc turned into a batched fold:
-every op whose commit VC has become stable (<= GST) is folded into the
-base (an associative lattice join — see mat/kernels.py) and the ring is
-compacted in-place with a cumsum scatter.  No per-key control flow; one
-fused XLA program covers the whole shard.
+TPU-shaped storage decisions (each measured on v5e, 1M keys x 8 lanes):
+- Every per-op field lives in ONE row-major ``ops[K*L, F]`` tensor
+  (row = one ring slot): an append is a single flat row scatter
+  (~13 ms for a 64k-op batch).  Per-field tensors cost a scatter per
+  field (~108 ms total) and [K, L, ...]-shaped scatter targets are ~8x
+  slower than flat row indices (XLA lowers multi-dim scatters badly).
+- Readers get [K, L(, D)] *views* from per-column slices (the
+  properties); the reshape fuses into the consuming fold.  A
+  materialized [K*L, F] <-> [K, L, F] relayout costs ~19-30 ms — never
+  round-trip the layouts.
+- GC does NOT compact lanes.  Folded lanes are simply marked free
+  (``valid &= ~stable`` — elementwise, fused) and appends place ops in
+  free lanes by rank (a [B, L] cumsum over gathered bitmap rows).
+  Lane order carries no meaning: materialization is an associative,
+  commutative lattice fold (mat/kernels.py), so fragmentation is free.
+  The reference compacts because its ring is a sequential Erlang tuple
+  walked oldest-first (include/antidote.hrl:81-90); a batched fold has
+  no such need — compaction cost 1.6 s/step in scatter form.
+- GC is amortized: callers fold every G steps (the reference GCs per
+  key every ``?OPS_THRESHOLD`` = 50 ops, src/materializer_vnode.erl:46
+  — also amortized), sizing L to cover G batches of expected per-key
+  arrivals.
 
 Shapes: K keys, L ring lanes, E element slots, D dc columns.  Appends
 whose key ring is full are reported back (overflow) so the control plane
@@ -37,158 +54,161 @@ import numpy as np
 from antidote_tpu.clocks import dense
 from antidote_tpu.mat import kernels
 
+# packed op-tensor columns (OR-Set): scalars, then obs VV, then op SS
+_ELEM, _ISADD, _DOTDC, _DOTSEQ, _OPDC, _OPCT, _NSCAL = 0, 1, 2, 3, 4, 5, 6
+
+
+def _free_lanes(valid2d: jax.Array, key_idx: jax.Array,
+                lane_off: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Lane for each batch op = its (lane_off+1)-th free slot; lane == L
+    signals overflow.  ``valid2d``: bool[K, L]; key_idx/lane_off: int[B]."""
+    L = valid2d.shape[1]
+    rows = valid2d[key_idx]                            # [B, L] gather
+    free = ~rows
+    rank = jnp.cumsum(free, axis=1) - 1                # rank among free
+    hot = free & (rank == lane_off[:, None])
+    lane = jnp.where(jnp.any(hot, axis=1), jnp.argmax(hot, axis=1), L)
+    return lane.astype(jnp.int32), lane >= L
+
 
 @dataclass
 class OrsetShardState:
-    """Device arrays for one OR-Set shard (a pytree)."""
+    """Device arrays for one OR-Set shard (a pytree).
+
+    ``ops[K*L, 6+2D]`` packs per-op fields column-wise:
+    [elem_slot, is_add, dot_dc, dot_seq, op_dc, op_ct,
+     obs_vv(D), op_ss(D)]; [K, L]-shaped views come from the
+    properties.  ``n_lanes`` is static metadata."""
 
     dots: jax.Array      # int[K, E, D] base snapshot (live dot table)
-    base_vc: jax.Array   # int[D] snapshot time of the base (shard-wide GST)
+    base_vc: jax.Array   # int[D] snapshot time of the base (shard GST)
     has_base: jax.Array  # bool[] whether base_vc is meaningful
-    # --- op ring, [K, L] unless noted ---
-    count: jax.Array     # int32[K] live ops per key
-    elem_slot: jax.Array  # int32
-    is_add: jax.Array    # bool
-    dot_dc: jax.Array    # int32
-    dot_seq: jax.Array   # int
-    obs_vv: jax.Array    # int[K, L, D]
-    op_dc: jax.Array     # int32
-    op_ct: jax.Array     # int
-    op_ss: jax.Array     # int[K, L, D]
-    valid: jax.Array     # bool
+    ops: jax.Array       # int[K*L, 6+2D] packed op ring (flat rows)
+    valid: jax.Array     # bool[K*L] lane occupancy
+    n_lanes: int
+
+    @property
+    def _d(self) -> int:
+        return (self.ops.shape[-1] - _NSCAL) // 2
+
+    def _col(self, c) -> jax.Array:
+        return self.ops[:, c].reshape(-1, self.n_lanes)
+
+    @property
+    def valid2d(self) -> jax.Array:
+        return self.valid.reshape(-1, self.n_lanes)
+
+    @property
+    def count(self) -> jax.Array:
+        """int32[K]: live ops per key (derived from the bitmap)."""
+        return jnp.sum(self.valid2d, axis=1, dtype=jnp.int32)
+
+    @property
+    def elem_slot(self):
+        return self._col(_ELEM)
+
+    @property
+    def is_add(self):
+        return self._col(_ISADD) != 0
+
+    @property
+    def dot_dc(self):
+        return self._col(_DOTDC)
+
+    @property
+    def dot_seq(self):
+        return self._col(_DOTSEQ)
+
+    @property
+    def op_dc(self):
+        return self._col(_OPDC)
+
+    @property
+    def op_ct(self):
+        return self._col(_OPCT)
+
+    @property
+    def obs_vv(self):
+        d = self._d
+        return self.ops[:, _NSCAL:_NSCAL + d].reshape(
+            -1, self.n_lanes, d)
+
+    @property
+    def op_ss(self):
+        d = self._d
+        return self.ops[:, _NSCAL + d:].reshape(-1, self.n_lanes, d)
 
 
 jax.tree_util.register_dataclass(
     OrsetShardState,
-    data_fields=[
-        "dots", "base_vc", "has_base", "count", "elem_slot", "is_add",
-        "dot_dc", "dot_seq", "obs_vv", "op_dc", "op_ct", "op_ss", "valid",
-    ],
-    meta_fields=[],
+    data_fields=["dots", "base_vc", "has_base", "ops", "valid"],
+    meta_fields=["n_lanes"],
 )
 
 
 def orset_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
                      dtype=jnp.int32) -> OrsetShardState:
     K, L, E, D = n_keys, n_lanes, n_slots, n_dcs
-    z = partial(jnp.zeros, dtype=dtype)
+    ops = jnp.zeros((K * L, _NSCAL + 2 * D), dtype=dtype)
+    ops = ops.at[:, _ELEM].set(E)  # empty lanes route to the drop slot
     return OrsetShardState(
-        dots=z((K, E, D)),
-        base_vc=z((D,)),
+        dots=jnp.zeros((K, E, D), dtype=dtype),
+        base_vc=jnp.zeros((D,), dtype=dtype),
         has_base=jnp.zeros((), dtype=bool),
-        count=jnp.zeros((K,), dtype=jnp.int32),
-        elem_slot=jnp.full((K, L), E, dtype=jnp.int32),
-        is_add=jnp.zeros((K, L), dtype=bool),
-        dot_dc=jnp.zeros((K, L), dtype=jnp.int32),
-        dot_seq=z((K, L)),
-        obs_vv=z((K, L, D)),
-        op_dc=jnp.zeros((K, L), dtype=jnp.int32),
-        op_ct=z((K, L)),
-        op_ss=z((K, L, D)),
-        valid=jnp.zeros((K, L), dtype=bool),
+        ops=ops,
+        valid=jnp.zeros((K * L,), dtype=bool),
+        n_lanes=L,
     )
 
 
-def _ring_append(count, valid, key_idx, lane_off, fields: dict):
-    """Shared ring scatter: place B ops at (key, count[key]+lane_off).
-
-    ``fields``: name -> (ring_array, batch_values).  Returns
-    (new_count, new_valid, new_fields, overflow[B]); overflowed ops are
-    NOT stored — the caller must GC or serve those keys from the log."""
-    L = valid.shape[1]
-    lane = count[key_idx] + lane_off
-    overflow = lane >= L
-    lane = jnp.where(overflow, L, lane)  # L = out of range -> dropped
-    new_count = count.at[key_idx].add(
-        jnp.where(overflow, 0, 1).astype(count.dtype), mode="drop")
-    new_valid = valid.at[key_idx, lane].set(
-        jnp.ones_like(overflow), mode="drop")
-    new_fields = {
-        name: a.at[key_idx, lane].set(v, mode="drop")
-        for name, (a, v) in fields.items()
-    }
-    return new_count, new_valid, new_fields, overflow
-
-
-def _ring_compact(keep, fields: dict):
-    """Shared ring compaction: move kept ops to the lane prefix.
-
-    ``fields``: name -> (ring_array, fill_value).  Returns
-    (new_count, new_valid, new_fields)."""
-    L = keep.shape[1]
-    new_pos = jnp.where(keep, jnp.cumsum(keep, axis=1) - 1, L)  # L -> drop
-    k_idx = jnp.broadcast_to(jnp.arange(keep.shape[0])[:, None], keep.shape)
-
-    def compact(a, fill):
-        out = jnp.full_like(a, fill)
-        return out.at[k_idx, new_pos].set(a, mode="drop")
-
-    new_valid = compact(keep, False)
-    new_count = jnp.sum(keep, axis=1, dtype=jnp.int32)
-    new_fields = {name: compact(a, fill) for name, (a, fill) in fields.items()}
-    return new_count, new_valid, new_fields
-
-
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def orset_append(
     st: OrsetShardState,
     key_idx: jax.Array,   # int32[B]
-    lane_off: jax.Array,  # int32[B] occurrence index of the key within batch
+    lane_off: jax.Array,  # int32[B] occurrence index of the key in batch
     elem_slot: jax.Array, is_add: jax.Array,
     dot_dc: jax.Array, dot_seq: jax.Array, obs_vv: jax.Array,
     op_dc: jax.Array, op_ct: jax.Array, op_ss: jax.Array,
 ) -> Tuple[OrsetShardState, jax.Array]:
-    """Scatter a batch of B committed ops into the rings (see _ring_append
-    for the overflow contract)."""
-    count, valid, f, overflow = _ring_append(
-        st.count, st.valid, key_idx, lane_off, {
-            "elem_slot": (st.elem_slot, elem_slot),
-            "is_add": (st.is_add, is_add),
-            "dot_dc": (st.dot_dc, dot_dc),
-            "dot_seq": (st.dot_seq, dot_seq),
-            "obs_vv": (st.obs_vv, obs_vv),
-            "op_dc": (st.op_dc, op_dc),
-            "op_ct": (st.op_ct, op_ct),
-            "op_ss": (st.op_ss, op_ss),
-        })
-    return replace(st, count=count, valid=valid, **f), overflow
+    """Scatter a batch of B committed ops into free ring lanes.  Returns
+    (state, overflow[B]); overflowed ops are NOT stored — the caller
+    must GC and retry or serve those keys from the log."""
+    dt = st.ops.dtype
+    L = st.n_lanes
+    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
+    col = lambda a: a.astype(dt)[:, None]
+    rows = jnp.concatenate([
+        col(elem_slot), col(is_add), col(dot_dc), col(dot_seq),
+        col(op_dc), col(op_ct), obs_vv.astype(dt), op_ss.astype(dt),
+    ], axis=1)                                          # [B, 6+2D]
+    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
+    ops = st.ops.at[flat].set(rows, mode="drop")
+    valid = st.valid.at[flat].set(True, mode="drop")
+    return replace(st, ops=ops, valid=valid), overflow
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def orset_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
     """Fold every ring op with commit VC <= GST into the base snapshot
-    and compact the rings (the batched op_insert_gc/snapshot_insert_gc,
+    and free its lane (the batched op_insert_gc/snapshot_insert_gc,
     reference src/materializer_vnode.erl:511-647).
 
     Safe because the GST is a *stable* time: no op with commit VC <= GST
     can still be in flight (reference dc_utilities:get_stable_snapshot
-    contract), so folding is permanent and base_vc := max(base_vc, gst)."""
+    contract), so folding is permanent and base_vc := max(base_vc, gst).
+    Lanes are freed, not compacted (see module doc)."""
     cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)      # [K, L, D]
-    stable = st.valid & dense.le(cvc, gst[None, None, :])
+    stable = st.valid2d & dense.le(cvc, gst[None, None, :])
     dots = kernels.orset_apply(
         st.dots, st.elem_slot, st.is_add, st.dot_dc, st.dot_seq,
         st.obs_vv, stable,
     )
-    keep = st.valid & ~stable
-    E = st.dots.shape[1]
-    count, valid, f = _ring_compact(keep, {
-        "elem_slot": (st.elem_slot, E),
-        "is_add": (st.is_add, False),
-        "dot_dc": (st.dot_dc, 0),
-        "dot_seq": (st.dot_seq, 0),
-        "obs_vv": (st.obs_vv, 0),
-        "op_dc": (st.op_dc, 0),
-        "op_ct": (st.op_ct, 0),
-        "op_ss": (st.op_ss, 0),
-    })
     return replace(
         st,
         dots=dots,
-        base_vc=jnp.maximum(st.base_vc, gst),
+        base_vc=jnp.maximum(st.base_vc, gst.astype(st.base_vc.dtype)),
         has_base=jnp.ones((), dtype=bool),
-        count=count,
-        valid=valid,
-        **f,
+        valid=st.valid & ~stable.reshape(-1),
     )
 
 
@@ -199,11 +219,12 @@ def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
 
     Requires read_vc >= base_vc (reads under the base fall back to log
     replay at the control plane, as in the reference's cache miss)."""
-    K = st.valid.shape[0]
+    K = st.dots.shape[0]
     base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
     has_base = jnp.broadcast_to(st.has_base, (K,))
     mask = kernels.inclusion_mask(
-        st.op_dc, st.op_ct, st.op_ss, st.valid, base_vc, has_base, read_vc)
+        st.op_dc, st.op_ct, st.op_ss, st.valid2d, base_vc, has_base,
+        read_vc)
     dots = kernels.orset_apply(
         st.dots, st.elem_slot, st.is_add, st.dot_dc, st.dot_seq,
         st.obs_vv, mask)
@@ -211,101 +232,130 @@ def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# counter_pn shard — same ring machinery, scalar state
+# counter_pn shard — same packed-ring machinery, scalar state
+
+# packed columns (counter): [delta, op_dc, op_ct, op_ss(D)]
+_CDELTA, _COPDC, _COPCT, _CNSCAL = 0, 1, 2, 3
 
 
 @dataclass
 class CounterShardState:
+    """``ops[K*L, 3+D]`` packs [delta, op_dc, op_ct, op_ss(D)]."""
+
     value: jax.Array     # int[K] base values
     base_vc: jax.Array   # int[D]
     has_base: jax.Array  # bool[]
-    count: jax.Array     # int32[K]
-    delta: jax.Array     # int[K, L]
-    op_dc: jax.Array     # int32[K, L]
-    op_ct: jax.Array     # int[K, L]
-    op_ss: jax.Array     # int[K, L, D]
-    valid: jax.Array     # bool[K, L]
+    ops: jax.Array       # int[K*L, 3+D]
+    valid: jax.Array     # bool[K*L]
+    n_lanes: int
+
+    @property
+    def _d(self) -> int:
+        return self.ops.shape[-1] - _CNSCAL
+
+    def _col(self, c) -> jax.Array:
+        return self.ops[:, c].reshape(-1, self.n_lanes)
+
+    @property
+    def valid2d(self) -> jax.Array:
+        return self.valid.reshape(-1, self.n_lanes)
+
+    @property
+    def count(self) -> jax.Array:
+        return jnp.sum(self.valid2d, axis=1, dtype=jnp.int32)
+
+    @property
+    def delta(self):
+        return self._col(_CDELTA)
+
+    @property
+    def op_dc(self):
+        return self._col(_COPDC)
+
+    @property
+    def op_ct(self):
+        return self._col(_COPCT)
+
+    @property
+    def op_ss(self):
+        d = self._d
+        return self.ops[:, _CNSCAL:].reshape(-1, self.n_lanes, d)
 
 
 jax.tree_util.register_dataclass(
     CounterShardState,
-    data_fields=["value", "base_vc", "has_base", "count", "delta",
-                 "op_dc", "op_ct", "op_ss", "valid"],
-    meta_fields=[],
+    data_fields=["value", "base_vc", "has_base", "ops", "valid"],
+    meta_fields=["n_lanes"],
 )
 
 
 def counter_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
                        dtype=jnp.int32) -> CounterShardState:
     K, L, D = n_keys, n_lanes, n_dcs
-    z = partial(jnp.zeros, dtype=dtype)
     return CounterShardState(
-        value=z((K,)),
-        base_vc=z((D,)),
+        value=jnp.zeros((K,), dtype=dtype),
+        base_vc=jnp.zeros((D,), dtype=dtype),
         has_base=jnp.zeros((), dtype=bool),
-        count=jnp.zeros((K,), dtype=jnp.int32),
-        delta=z((K, L)),
-        op_dc=jnp.zeros((K, L), dtype=jnp.int32),
-        op_ct=z((K, L)),
-        op_ss=z((K, L, D)),
-        valid=jnp.zeros((K, L), dtype=bool),
+        ops=jnp.zeros((K * L, _CNSCAL + D), dtype=dtype),
+        valid=jnp.zeros((K * L,), dtype=bool),
+        n_lanes=L,
     )
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def counter_append(st: CounterShardState, key_idx, lane_off, delta,
                    op_dc, op_ct, op_ss):
-    count, valid, f, overflow = _ring_append(
-        st.count, st.valid, key_idx, lane_off, {
-            "delta": (st.delta, delta),
-            "op_dc": (st.op_dc, op_dc),
-            "op_ct": (st.op_ct, op_ct),
-            "op_ss": (st.op_ss, op_ss),
-        })
-    return replace(st, count=count, valid=valid, **f), overflow
+    dt = st.ops.dtype
+    L = st.n_lanes
+    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
+    col = lambda a: a.astype(dt)[:, None]
+    rows = jnp.concatenate(
+        [col(delta), col(op_dc), col(op_ct), op_ss.astype(dt)], axis=1)
+    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
+    ops = st.ops.at[flat].set(rows, mode="drop")
+    valid = st.valid.at[flat].set(True, mode="drop")
+    return replace(st, ops=ops, valid=valid), overflow
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def counter_gc(st: CounterShardState, gst: jax.Array) -> CounterShardState:
     cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
-    stable = st.valid & dense.le(cvc, gst[None, None, :])
+    stable = st.valid2d & dense.le(cvc, gst[None, None, :])
     value = kernels.counter_read(st.value, st.delta, stable)
-    keep = st.valid & ~stable
-    count, valid, f = _ring_compact(keep, {
-        "delta": (st.delta, 0),
-        "op_dc": (st.op_dc, 0),
-        "op_ct": (st.op_ct, 0),
-        "op_ss": (st.op_ss, 0),
-    })
     return replace(
         st,
         value=value,
-        base_vc=jnp.maximum(st.base_vc, gst),
+        base_vc=jnp.maximum(st.base_vc, gst.astype(st.base_vc.dtype)),
         has_base=jnp.ones((), dtype=bool),
-        count=count,
-        valid=valid,
-        **f,
+        valid=st.valid & ~stable.reshape(-1),
     )
 
 
 @jax.jit
 def counter_read(st: CounterShardState, read_vc: jax.Array) -> jax.Array:
     """int[K]: counter values at ``read_vc``."""
-    K = st.valid.shape[0]
+    K = st.value.shape[0]
     base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
     has_base = jnp.broadcast_to(st.has_base, (K,))
     mask = kernels.inclusion_mask(
-        st.op_dc, st.op_ct, st.op_ss, st.valid, base_vc, has_base, read_vc)
+        st.op_dc, st.op_ct, st.op_ss, st.valid2d, base_vc, has_base,
+        read_vc)
     return kernels.counter_read(st.value, st.delta, mask)
 
 
 def batch_lane_offsets(key_idx: np.ndarray) -> np.ndarray:
-    """Host helper: occurrence index of each key within the batch (0,1,...)
-    in batch order — disambiguates same-key ops in one append."""
-    out = np.zeros(len(key_idx), dtype=np.int32)
-    seen: dict = {}
-    for i, k in enumerate(key_idx):
-        k = int(k)
-        out[i] = seen.get(k, 0)
-        seen[k] = out[i] + 1
+    """Host helper: occurrence index of each key within the batch
+    (0,1,...) in batch order — disambiguates same-key ops in one append.
+    Vectorized (argsort + run-length ranks)."""
+    key_idx = np.asarray(key_idx)
+    n = len(key_idx)
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    order = np.argsort(key_idx, kind="stable")
+    sk = key_idx[order]
+    starts = np.r_[0, np.flatnonzero(sk[1:] != sk[:-1]) + 1]
+    run_of = np.repeat(np.arange(len(starts)), np.diff(np.r_[starts, n]))
+    occ = np.arange(n) - starts[run_of]
+    out = np.empty(n, dtype=np.int32)
+    out[order] = occ
     return out

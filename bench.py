@@ -11,6 +11,11 @@ no numbers (BASELINE.md), so the baseline is *measured here*: the same op
 stream applied through the host CRDT type (one Python/BEAM-style
 apply-per-op loop) on this machine's CPU.
 
+Timing: dependent-chain methodology (benches/_util.py) — on this
+environment's remote-TPU tunnel, block_until_ready does not truly block,
+so device steps are chained and a final scalar fetch forces completion
+(its round-trip cost measured separately and subtracted).
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
@@ -19,6 +24,8 @@ import sys
 import time
 
 import numpy as np
+
+from benches._util import fetch
 
 
 def build_stream(K, B, n_steps, D, n_dcs, rng):
@@ -31,7 +38,7 @@ def build_stream(K, B, n_steps, D, n_dcs, rng):
             for _ in range(n_steps)]
 
 
-def bench_device(K, B, n_steps, D, n_dcs, warmup=2):
+def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
     import jax
     import jax.numpy as jnp
 
@@ -47,13 +54,17 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2):
 
     dev_steps = [put(s) for s in steps]
 
-    def one_step(st, s):
+    def one_step(st, s, do_gc):
         lane_off = jnp.zeros_like(s["key_idx"])  # see note below
         st, _ov = store.orset_append(
             st, s["key_idx"], lane_off, s["elem_slot"], s["is_add"],
             s["dot_dc"], s["dot_seq"], s["obs_vv"], s["op_dc"], s["op_ct"],
             s["op_ss"])
-        st = store.orset_gc(st, s["frontier"])
+        if do_gc:
+            # amortized fold at the batch frontier (the reference GCs
+            # per key every ?OPS_THRESHOLD ops — also amortized); the
+            # ring's L lanes absorb gc_every batches of per-key arrivals
+            st = store.orset_gc(st, s["frontier"])
         return st
 
     # NOTE on lane_off=0: at K=1M and B=64k the chance of same-key
@@ -63,26 +74,37 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2):
     # path with host-computed offsets is exercised in tests.
 
     for s in dev_steps[:warmup]:
-        st = one_step(st, s)
-    jax.block_until_ready(st.dots)
-
+        st = one_step(st, s, True)
+    fetch(st.dots)
     t0 = time.perf_counter()
-    for s in dev_steps[warmup:]:
-        st = one_step(st, s)
-    jax.block_until_ready(st.dots)
-    dt = time.perf_counter() - t0
+    fetch(st.dots)
+    fetch_oh = time.perf_counter() - t0
 
-    # one full-shard read at the final clock (included in the story, not
-    # the timed loop; reads are measured separately below)
-    present = store.orset_read(st, dev_steps[-1]["frontier"])
-    jax.block_until_ready(present)
-
+    stc = st
     t0 = time.perf_counter()
-    present = store.orset_read(st, dev_steps[-1]["frontier"])
-    jax.block_until_ready(present)
-    read_dt = time.perf_counter() - t0
-
+    for i, s in enumerate(dev_steps[warmup:]):
+        stc = one_step(stc, s, (i + 1) % gc_every == 0)
+    fetch(stc.dots)
+    dt = max(time.perf_counter() - t0 - fetch_oh, 1e-9)
     ops_per_sec = B * n_steps / dt
+
+    # full-shard read, chained on itself so each read depends on the last
+    frontier = dev_steps[-1]["frontier"]
+    n_reads = 10
+
+    def one_read(present):
+        # numerically `frontier` (presence is non-negative) but XLA
+        # cannot prove it, so reads form a dependent chain
+        vc = frontier + jnp.minimum(present[0, 0].astype(jnp.int32), 0)
+        return store.orset_read(stc, vc)
+
+    p = store.orset_read(stc, frontier)
+    fetch(p)
+    t0 = time.perf_counter()
+    for _ in range(n_reads):
+        p = one_read(p)
+    fetch(p)
+    read_dt = max(time.perf_counter() - t0 - fetch_oh, 1e-9) / n_reads
     return ops_per_sec, read_dt
 
 

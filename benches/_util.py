@@ -1,4 +1,13 @@
-"""Shared bench plumbing: platform flags, timing, JSON line output."""
+"""Shared bench plumbing: platform flags, honest timing, JSON output.
+
+IMPORTANT (axon/TPU-tunnel): ``jax.block_until_ready`` does NOT actually
+block on this environment's remote-TPU tunnel — dispatch returns
+immediately and "timings" of single calls measure only Python dispatch
+(we observed 130x physical peak FLOPs with the naive pattern).  The only
+honest clock is: a *dependent chain* of N device steps ended by a small
+device->host fetch (which must wait for the data), minus the fetch's own
+round-trip overhead, divided by N.  ``chain_timer`` implements that.
+"""
 
 from __future__ import annotations
 
@@ -17,22 +26,45 @@ def setup(argv=None):
     return "--quick" in argv, jax
 
 
-def timed(fn, *args, block=None, warmup=2, iters=5):
-    """Median wall-seconds of fn(*args) after warmup; ``block`` maps the
-    result to an array to block_until_ready on."""
+def fetch(x):
+    """Force completion: device->host transfer of one scalar of x."""
     import jax
+    import numpy as np
 
-    for _ in range(warmup):
-        r = fn(*args)
-        jax.block_until_ready(block(r) if block else r)
-    ts = []
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    idx = tuple(0 for _ in leaf.shape)
+    return np.asarray(leaf[idx] if leaf.shape else leaf)
+
+
+def chain_timer(step, init, iters, warmup=2):
+    """Seconds per iteration of ``state = step(state)``, measured as one
+    dependent chain of ``iters`` steps ending in a scalar fetch, with
+    the fetch round-trip measured separately and subtracted."""
+    s = init
+    for _ in range(max(warmup, 1)):
+        s = step(s)
+    fetch(s)
+    t0 = time.perf_counter()
+    fetch(s)
+    fetch_oh = time.perf_counter() - t0
+
+    s = init
+    t0 = time.perf_counter()
     for _ in range(iters):
-        t0 = time.perf_counter()
-        r = fn(*args)
-        jax.block_until_ready(block(r) if block else r)
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
+        s = step(s)
+    fetch(s)
+    total = time.perf_counter() - t0
+    return max(total - fetch_oh, 1e-9) / iters
+
+
+def self_feed(x, scalar):
+    """Data-dependency glue for chaining a fixed-input computation:
+    returns ``x + min(scalar, 0)`` — numerically x (scalar is a
+    non-negative device value) but XLA cannot prove it, so each
+    iteration depends on the previous result."""
+    import jax.numpy as jnp
+
+    return x + jnp.minimum(scalar.astype(x.dtype), 0)
 
 
 def emit(metric, value, unit, vs_baseline, **detail):

@@ -1,0 +1,66 @@
+"""Fused pallas OR-Set read vs the jnp kernels path (interpret mode on
+the CPU mesh; the same mosaic path runs compiled on TPU)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from antidote_tpu.mat import kernels, pallas_kernels, store
+from antidote_tpu.mat.synth import orset_batch
+
+
+def reference_read(st, read_vc):
+    return np.asarray(store.orset_read(st, read_vc))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_matches_jnp_path(seed):
+    K, B, D, n_dcs = 256, 512, 8, 3
+    rng = np.random.default_rng(seed)
+    clock = np.zeros(n_dcs, dtype=np.int32)
+    st = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=D,
+                                dtype=jnp.int32)
+    for _ in range(3):
+        s = orset_batch(rng, K, B, D, n_dcs, clock, obs_lag=2)
+        lane = jnp.asarray(store.batch_lane_offsets(s["key_idx"]))
+        st, _ = store.orset_append(
+            st, jnp.asarray(s["key_idx"]), lane,
+            jnp.asarray(s["elem_slot"]), jnp.asarray(s["is_add"]),
+            jnp.asarray(s["dot_dc"]), jnp.asarray(s["dot_seq"]),
+            jnp.asarray(s["obs_vv"]), jnp.asarray(s["op_dc"]),
+            jnp.asarray(s["op_ct"]), jnp.asarray(s["op_ss"]))
+    read_vc = jnp.asarray(s["frontier"])
+    want = reference_read(st, read_vc)
+    got = pallas_kernels.orset_read_fused(
+        st.dots, st.elem_slot, st.is_add, st.dot_dc, st.dot_seq,
+        st.obs_vv, st.op_dc, st.op_ct, st.op_ss, st.valid2d,
+        st.base_vc, st.has_base, read_vc,
+        block_k=64, interpret=True)
+    assert (np.asarray(got) == want).all()
+
+
+def test_with_base_snapshot_and_gc():
+    K, B, D, n_dcs = 128, 256, 8, 3
+    rng = np.random.default_rng(9)
+    clock = np.zeros(n_dcs, dtype=np.int32)
+    st = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=D,
+                                dtype=jnp.int32)
+    for i in range(4):
+        s = orset_batch(rng, K, B, D, n_dcs, clock, obs_lag=1)
+        lane = jnp.asarray(store.batch_lane_offsets(s["key_idx"]))
+        st, _ = store.orset_append(
+            st, jnp.asarray(s["key_idx"]), lane,
+            jnp.asarray(s["elem_slot"]), jnp.asarray(s["is_add"]),
+            jnp.asarray(s["dot_dc"]), jnp.asarray(s["dot_seq"]),
+            jnp.asarray(s["obs_vv"]), jnp.asarray(s["op_dc"]),
+            jnp.asarray(s["op_ct"]), jnp.asarray(s["op_ss"]))
+        if i == 1:  # fold a base snapshot so has_base/covered paths run
+            st = store.orset_gc(st, jnp.asarray(s["frontier"]))
+    read_vc = jnp.asarray(s["frontier"])
+    want = reference_read(st, read_vc)
+    got = pallas_kernels.orset_read_fused(
+        st.dots, st.elem_slot, st.is_add, st.dot_dc, st.dot_seq,
+        st.obs_vv, st.op_dc, st.op_ct, st.op_ss, st.valid2d,
+        st.base_vc, st.has_base, read_vc,
+        block_k=32, interpret=True)
+    assert (np.asarray(got) == want).all()
