@@ -4,9 +4,10 @@ IMPORTANT (axon/TPU-tunnel): ``jax.block_until_ready`` does NOT actually
 block on this environment's remote-TPU tunnel — dispatch returns
 immediately and "timings" of single calls measure only Python dispatch
 (we observed 130x physical peak FLOPs with the naive pattern).  The only
-honest clock is: a *dependent chain* of N device steps ended by a small
-device->host fetch (which must wait for the data), minus the fetch's own
-round-trip overhead, divided by N.  ``chain_timer`` implements that.
+honest clock is: device work ended by a small device->host fetch (which
+must wait for the data), minus the fetch's own round-trip overhead.
+``timed`` implements that — as one dependent chain of calls in
+``thread=True`` mode (one end fetch), or as fetch-per-call otherwise.
 """
 
 from __future__ import annotations
@@ -36,35 +37,56 @@ def fetch(x):
     return np.asarray(leaf[idx] if leaf.shape else leaf)
 
 
-def chain_timer(step, init, iters, warmup=2):
-    """Seconds per iteration of ``state = step(state)``, measured as one
-    dependent chain of ``iters`` steps ending in a scalar fetch, with
-    the fetch round-trip measured separately and subtracted."""
-    s = init
-    for _ in range(max(warmup, 1)):
-        s = step(s)
-    fetch(s)
-    t0 = time.perf_counter()
-    fetch(s)
-    fetch_oh = time.perf_counter() - t0
+def timed(fn, *args, iters=3, warmup=1, block=None, thread=False):
+    """Seconds per call of ``fn(*args)``.
 
-    s = init
+    Warmup calls absorb compilation; then each timed call is forced to
+    completion by a scalar device->host fetch on ``block(result)``
+    (default: the result itself), which is the only honest completion
+    barrier on this tunnel (see module doc).  The fetch's own round-trip
+    is measured separately and subtracted per call.
+
+    ``thread=True`` runs ``state = fn(state)`` chains (first arg is the
+    initial state) — required when fn donates its input buffers, and the
+    natural shape for steady-state store throughput.
+    """
+    block = block if block is not None else (lambda r: r)
+
+    def probe_fetch_oh(r):
+        # min of several probes: one spiked round-trip sample must not be
+        # amplified by the per-call subtraction below
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fetch(block(r))
+            samples.append(time.perf_counter() - t0)
+        return min(samples)
+
+    if thread:
+        (state,) = args
+        for _ in range(max(warmup, 1)):
+            state = fn(state)
+        fetch(block(state))
+        fetch_oh = probe_fetch_oh(state)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state = fn(state)
+        fetch(block(state))
+        total = time.perf_counter() - t0
+        return max(total - fetch_oh, 1e-9) / iters
+
+    r = None
+    for _ in range(max(warmup, 1)):
+        r = fn(*args)
+    fetch(block(r))
+    fetch_oh = probe_fetch_oh(r)
+
     t0 = time.perf_counter()
     for _ in range(iters):
-        s = step(s)
-    fetch(s)
+        r = fn(*args)
+        fetch(block(r))
     total = time.perf_counter() - t0
-    return max(total - fetch_oh, 1e-9) / iters
-
-
-def self_feed(x, scalar):
-    """Data-dependency glue for chaining a fixed-input computation:
-    returns ``x + min(scalar, 0)`` — numerically x (scalar is a
-    non-negative device value) but XLA cannot prove it, so each
-    iteration depends on the previous result."""
-    import jax.numpy as jnp
-
-    return x + jnp.minimum(scalar.astype(x.dtype), 0)
+    return max(total - iters * fetch_oh, 1e-9) / iters
 
 
 def emit(metric, value, unit, vs_baseline, **detail):

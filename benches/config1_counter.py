@@ -43,9 +43,10 @@ def device_ops_per_sec(jax, K, B, n_steps):
     def run(st):
         for s in steps:
             st = one(st, s)
-        return st.value
+        return st
 
-    dt = timed(run, st, warmup=1, iters=3)
+    dt = timed(run, st, warmup=1, iters=3, thread=True,
+               block=lambda st: st.value)
     return B * n_steps / dt
 
 
