@@ -55,4 +55,22 @@ class Config:
     #: staleness histogram sampling period (reference 10 s,
     #: src/antidote_stats_collector.erl:87-93)
     staleness_sample_s: float = 10.0
+    #: serve supported CRDT types (set_aw, counter_pn) from the device
+    #: shard store — the TPU data plane (antidote_tpu/mat/device_plane.py);
+    #: the reference's materializer_vnode duty
+    device_store: bool = True
+    #: initial key capacity per partition plane (doubles on demand)
+    device_key_capacity: int = 1024
+    #: ring lanes per key (absorbs unstable ops between GC folds)
+    device_lanes: int = 8
+    #: initial element slots per key (OR-set; doubles up to max)
+    device_slots: int = 8
+    #: staged ops per plane that trigger a device append flush
+    device_flush_ops: int = 256
+    #: applied ops per plane that trigger a GST-driven device GC
+    device_gc_ops: int = 2048
+    #: dense DC/actor column cap before a key evicts to the host path
+    device_max_dcs: int = 64
+    #: per-key element-slot cap before an OR-set key evicts
+    device_max_slots: int = 256
     extra: dict = field(default_factory=dict)

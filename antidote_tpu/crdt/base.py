@@ -49,15 +49,23 @@ Effect = Any
 class DownstreamCtx:
     """Source of unique dots/tokens for downstream generation.
 
-    A dot is ``(actor, seq)`` with ``actor`` hashable (the DC id in
-    production; the device path packs ``(dc_index, seq)`` into int64).
+    A dot is ``(actor, seq)`` with ``actor`` hashable.  The live
+    transaction path injects ``mint`` = the node's dot minter, which
+    uses the DC id as the actor and a node-monotone µs sequence — the
+    shape the device data plane's dense ``(dc_column, seq)`` dot tables
+    require (antidote_tpu/mat/device_plane.py).  Standalone contexts
+    (unit tests, tools) fall back to a private actor + local counter.
     """
 
-    def __init__(self, actor: Any = None, seq: int = 0):
+    def __init__(self, actor: Any = None, seq: int = 0,
+                 mint: "Callable[[], Tuple[Any, int]] | None" = None):
         self.actor = actor if actor is not None else os.urandom(8).hex()
         self._seq = int(seq)
+        self._mint = mint
 
     def dot(self) -> Tuple[Any, int]:
+        if self._mint is not None:
+            return self._mint()
         self._seq += 1
         return (self.actor, self._seq)
 

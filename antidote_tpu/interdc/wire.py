@@ -78,7 +78,7 @@ class InterDcTxn:
         src/inter_dc_txn.erl:48-61)."""
         commit = records[-1]
         assert commit.kind() == "commit", "op group must end with a commit"
-        _, (_dc, commit_time), snapshot_vc = commit.payload
+        (_dc, commit_time), snapshot_vc = commit.payload[1], commit.payload[2]
         return InterDcTxn(dc_id=dc_id, partition=partition,
                           prev_log_opid=prev_log_opid,
                           snapshot_vc=snapshot_vc, timestamp=commit_time,

@@ -1,0 +1,615 @@
+"""Live device data plane — the TPU shard store serving the running DC.
+
+This is the integration layer that makes the device materializer
+(antidote_tpu/mat/store.py) the system's spine instead of a benchmark
+sidecar: PartitionManager routes committed effects of supported types
+here (local commits, inter-DC applies, and log recovery all take the
+same path), transaction reads come back from batched device folds, and
+the gossiped stable snapshot (antidote_tpu/meta/gossip.py) drives the
+device GC.  The modelled duty is the reference's materializer_vnode —
+update/read as the running database's data plane (reference
+src/materializer_vnode.erl:56-110), with the per-key gen_server walk
+replaced by padded-batch appends and lattice folds.
+
+Host-side duties (this module): interning arbitrary Python keys,
+elements, and DC ids into dense indices; buffering staged effects into
+padded append blocks (amortizing dispatch); and fallback policy.  A key
+*evicts* to the host path — its device rows purged, its history rebuilt
+into the host store by log replay — when it exceeds its element-slot or
+ring-lane capacity; reads below the device base snapshot replay the log,
+exactly the reference's snapshot-cache miss
+(src/materializer_vnode.erl:415-419).
+
+Correctness contract: the dense dot tables collapse each (element,
+origin-DC) dot set to its max sequence, which is the ORSWOT invariant —
+sound because dots are minted per-DC-monotone (txn/node.py mint_dot) and
+write-write certification serializes same-key commits at a DC.  Ops
+whose dots carry actors that are not DC ids (foreign tooling writing
+through the log) still work: actors get their own dense columns, capped
+by ``max_dcs`` before the key evicts to the host path.
+
+Shapes are static per (capacity, bucket): append batches pad to
+power-of-two buckets so XLA compiles a handful of programs, not one per
+batch size.  Capacity growth (keys / element slots / DC columns) is a
+rare host-side repack (store.orset_grow).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.clocks import VC, ClockDomain
+from antidote_tpu.mat import store
+from antidote_tpu.mat.materializer import Payload
+
+log = logging.getLogger(__name__)
+
+#: "read latest": dominates every real µs timestamp without overflowing
+#: int64 arithmetic in the fold
+_VC_INF = (1 << 62)
+
+_MIN_BUCKET = 64
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+class ReadBelowBase(Exception):
+    """Read snapshot does not dominate the device base — serve from log."""
+
+
+class _PlaneBase:
+    """Shared machinery: key directory, pending rows, flush/gc plumbing."""
+
+    type_name: str = ""
+
+    def __init__(self, domain: ClockDomain, key_capacity: int,
+                 n_lanes: int, flush_ops: int, gc_ops: int,
+                 max_dcs: int):
+        self.domain = domain
+        self.n_lanes = n_lanes
+        self.flush_ops = flush_ops
+        self.gc_ops = gc_ops
+        self.max_dcs = max_dcs
+        self.key_index: Dict[Any, int] = {}
+        self.rev_keys: List[Any] = []
+        #: staged decoded rows (lists of python ints / pair-lists)
+        self.rows: List[tuple] = []
+        self.pending_keys: set = set()
+        self._ops_since_gc = 0
+        self._base_vc = VC()
+        self._has_base = False
+        #: newest stable snapshot seen (GC horizon for overflow retries)
+        self._last_stable: Optional[VC] = None
+        #: set by the owning PartitionManager: evict a key's history to
+        #: the host store (log replay)
+        self.on_evict: Callable[[Any, str], None] = lambda k, t: None
+        self.capacity = key_capacity
+        self.st = self._init_state(key_capacity)
+
+    # -- subclass hooks -----------------------------------------------------
+
+    def _init_state(self, key_capacity: int):
+        raise NotImplementedError
+
+    def _grow_dcs(self, new_d: int) -> None:
+        raise NotImplementedError
+
+    def _grow_keys(self, new_k: int) -> None:
+        raise NotImplementedError
+
+    def _append_rows(self, rows: List[tuple]) -> np.ndarray:
+        """Device-append decoded rows; returns bool[n] overflow."""
+        raise NotImplementedError
+
+    def _purge_idx(self, idx: int) -> None:
+        raise NotImplementedError
+
+    def _device_gc(self, gst_dense: np.ndarray) -> None:
+        raise NotImplementedError
+
+    # -- directories --------------------------------------------------------
+
+    def _dc_col(self, actor) -> Optional[int]:
+        """Dense column for a DC id / dot actor; None = over capacity."""
+        if not self.domain.contains(actor):
+            if len(self.domain) >= self.max_dcs:
+                return None
+            if len(self.domain) >= self.domain.d:
+                self.flush()  # staged rows were decoded at the old width
+                new_d = min(self.domain.d * 2, self.max_dcs)
+                self.domain = self.domain.grow(new_d)
+                self._grow_dcs(new_d)
+        return self.domain.index_of(actor)
+
+    def _key_idx(self, key) -> int:
+        idx = self.key_index.get(key)
+        if idx is None:
+            if len(self.rev_keys) >= self.capacity:
+                self.flush()
+                self.capacity *= 2
+                self._grow_keys(self.capacity)
+            idx = len(self.rev_keys)
+            self.key_index[key] = idx
+            self.rev_keys.append(key)
+        return idx
+
+    def _ss_pairs(self, vc: VC) -> Optional[List[tuple]]:
+        out = []
+        for dc, t in vc.items():
+            if not t:
+                continue
+            col = self._dc_col(dc)
+            if col is None:
+                return None
+            out.append((col, int(t)))
+        return out
+
+    def _dense_vc(self, pairs: List[tuple]) -> np.ndarray:
+        row = np.zeros(self.domain.d, dtype=np.int64)
+        for col, t in pairs:
+            row[col] = max(row[col], t)
+        return row
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def owns(self, key) -> bool:
+        return key in self.key_index
+
+    def evict(self, key) -> None:
+        """Purge the key's device rows and hand its history to the host
+        path (on_evict replays the log into the host store)."""
+        idx = self.key_index.pop(key, None)
+        if idx is None:
+            return
+        self.rows = [r for r in self.rows if r[0] != idx]
+        self.pending_keys.discard(key)
+        self.rev_keys[idx] = _Evicted
+        self._purge_idx(idx)
+        log.debug("device plane: evicted %r (%s)", key, self.type_name)
+        self.on_evict(key, self.type_name)
+
+    def maybe_flush_gc(self, stable_vc: Optional[VC]) -> None:
+        if stable_vc is not None:
+            self._last_stable = (stable_vc if self._last_stable is None
+                                 else self._last_stable.join(stable_vc))
+        if len(self.rows) >= self.flush_ops:
+            self.flush()
+        if stable_vc is not None and self._ops_since_gc >= self.gc_ops:
+            self.gc(stable_vc)
+
+    def flush(self) -> None:
+        """Drain staged rows into the device ring, padded to a bucket.
+        Rows whose key ring is full force a GC at the newest stable
+        snapshot and one retry; still-overflowing keys evict to the
+        host path."""
+        if not self.rows:
+            return
+        rows, self.rows = self.rows, []
+        self.pending_keys.clear()
+        overflow = self._append_rows(rows)
+        self._ops_since_gc += len(rows)
+        if overflow.any():
+            retry = [r for r, o in zip(rows, overflow) if o]
+            gst = None
+            if self._last_stable is not None:
+                pairs = self._ss_pairs(self._last_stable)
+                if pairs is not None:
+                    gst = self._dense_vc(pairs)
+                    self._device_gc(gst)
+                    self._base_vc = self._base_vc.join(self._last_stable)
+                    self._has_base = True
+                    self._ops_since_gc = 0
+            overflow2 = self._append_rows(retry)
+            if gst is not None:
+                # invariant: every ring op with commit VC <= base_vc must
+                # be folded INTO the base — the retried rows landed after
+                # the fold above, so fold once more at the same horizon
+                # (rows above it are untouched)
+                self._device_gc(gst)
+            bad_keys = {self.rev_keys[r[0]]
+                        for r, o in zip(retry, overflow2) if o}
+            for key in bad_keys:
+                if key is not _Evicted:
+                    self.evict(key)
+
+    def gc(self, stable_vc: VC) -> None:
+        """Fold ops at/below the gossiped stable snapshot into the base
+        (store.orset_gc / counter_gc contract: the GST is stable, folding
+        is permanent)."""
+        # let the flush's overflow-retry fold at this horizon too
+        self._last_stable = (stable_vc if self._last_stable is None
+                             else self._last_stable.join(stable_vc))
+        self.flush()
+        pairs = self._ss_pairs(stable_vc)
+        if pairs is None:
+            return
+        self._device_gc(self._dense_vc(pairs))
+        self._base_vc = self._base_vc.join(stable_vc)
+        self._has_base = True
+        self._ops_since_gc = 0
+
+    def _read_vc_dense(self, read_vc: Optional[VC]) -> np.ndarray:
+        """Dense read snapshot; raises ReadBelowBase when the requested
+        snapshot does not dominate the device base (caller replays log)."""
+        if read_vc is None:
+            return np.full(self.domain.d, _VC_INF, dtype=np.int64)
+        if self._has_base and not self._base_vc.le(read_vc):
+            raise ReadBelowBase()
+        pairs = self._ss_pairs(read_vc)
+        if pairs is None:
+            raise ReadBelowBase()  # unknown-DC flood: serve from log
+        return self._dense_vc(pairs)
+
+
+class _Evicted:
+    """Sentinel occupying the rev_keys slot of an evicted key."""
+
+
+class OrsetPlane(_PlaneBase):
+    """Device plane for set_aw.  Row tuple:
+    (key_idx, slot, is_add, dot_col, dot_seq, obs_pairs, op_dc_col,
+    op_ct, ss_pairs)."""
+
+    type_name = "set_aw"
+
+    def __init__(self, domain, key_capacity, n_lanes, n_slots, flush_ops,
+                 gc_ops, max_dcs, max_slots):
+        self.n_slots = n_slots
+        self.max_slots = max_slots
+        #: per key-idx: element -> slot and slot -> element
+        self.elem_index: List[Dict[Any, int]] = []
+        self.rev_elems: List[List[Any]] = []
+        super().__init__(domain, key_capacity, n_lanes, flush_ops,
+                         gc_ops, max_dcs)
+
+    def _init_state(self, key_capacity):
+        return store.orset_shard_init(
+            key_capacity, self.n_lanes, self.n_slots, self.domain.d,
+            dtype=jnp.int64)
+
+    def _grow_dcs(self, new_d):
+        self.st = store.orset_grow(self.st, n_dcs=new_d)
+
+    def _grow_keys(self, new_k):
+        self.st = store.orset_grow(self.st, n_keys=new_k)
+
+    def _grow_slots(self, new_e):
+        self.flush()
+        self.n_slots = new_e
+        self.st = store.orset_grow(self.st, n_slots=new_e)
+
+    def _key_idx(self, key):
+        idx = super()._key_idx(key)
+        while len(self.elem_index) <= idx:
+            self.elem_index.append({})
+            self.rev_elems.append([])
+        return idx
+
+    def _slot(self, idx: int, elem) -> Optional[int]:
+        slots = self.elem_index[idx]
+        s = slots.get(elem)
+        if s is None:
+            if len(slots) >= self.n_slots:
+                if len(slots) >= self.max_slots:
+                    return None
+                self._grow_slots(min(self.n_slots * 2, self.max_slots))
+            s = len(slots)
+            slots[elem] = s
+            self.rev_elems[idx].append(elem)
+        return s
+
+    def stage(self, key, payload: Payload) -> None:
+        """Decode one committed set_aw effect into device rows; evicts
+        the key (host fallback) on any capacity miss."""
+        idx = self._key_idx(key)
+        kind, entries = payload.effect
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        rows = []
+        for entry in entries:
+            if kind == "add":
+                elem, dot, observed = entry
+                actor, seq = dot
+                dot_col = self._dc_col(actor)
+                is_add = 1
+            else:  # "rmv"
+                elem, observed = entry
+                dot_col, seq, is_add = 0, 0, 0
+            slot = self._slot(idx, elem)
+            obs_pairs = []
+            ok = slot is not None and (is_add == 0 or dot_col is not None)
+            if ok:
+                for a, s in observed:
+                    col = self._dc_col(a)
+                    if col is None:
+                        ok = False
+                        break
+                    obs_pairs.append((col, int(s)))
+            if not ok:
+                self.evict(key)
+                return
+            rows.append((idx, slot, is_add, dot_col or 0, int(seq),
+                         obs_pairs, op_dc_col, int(payload.commit_time),
+                         ss_pairs))
+        if self.key_index.get(key) != idx:
+            # a growth-triggered flush evicted this key mid-stage; the
+            # migration replayed the log, which already holds this op —
+            # staging the decoded rows would write into purged lanes
+            return
+        self.rows.extend(rows)
+        self.pending_keys.add(key)
+
+    def _append_rows(self, rows):
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        B = _bucket(n)
+        K = self.capacity
+        d = self.domain.d
+        key_idx = np.full(B, K, dtype=np.int32)
+        elem = np.zeros(B, dtype=np.int64)
+        is_add = np.zeros(B, dtype=np.int64)
+        dot_dc = np.zeros(B, dtype=np.int64)
+        dot_seq = np.zeros(B, dtype=np.int64)
+        obs = np.zeros((B, d), dtype=np.int64)
+        op_dc = np.zeros(B, dtype=np.int64)
+        op_ct = np.zeros(B, dtype=np.int64)
+        ss = np.zeros((B, d), dtype=np.int64)
+        for i, (ki, sl, ia, dc, sq, op_, odc, oct_, ssp) in enumerate(rows):
+            key_idx[i] = ki
+            elem[i] = sl
+            is_add[i] = ia
+            dot_dc[i] = dc
+            dot_seq[i] = sq
+            for col, s in op_:
+                obs[i, col] = max(obs[i, col], s)
+            op_dc[i] = odc
+            op_ct[i] = oct_
+            for col, t in ssp:
+                ss[i, col] = max(ss[i, col], t)
+        lane_off = np.zeros(B, dtype=np.int32)
+        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
+        self.st, overflow = store.orset_append(
+            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
+            jnp.asarray(elem), jnp.asarray(is_add), jnp.asarray(dot_dc),
+            jnp.asarray(dot_seq), jnp.asarray(obs), jnp.asarray(op_dc),
+            jnp.asarray(op_ct), jnp.asarray(ss))
+        return np.asarray(overflow)[:n]
+
+    def _purge_idx(self, idx):
+        self.st = store.orset_purge_keys(
+            self.st, jnp.asarray([idx], dtype=np.int32))
+        self.elem_index[idx] = {}
+        self.rev_elems[idx] = []
+
+    def _device_gc(self, gst_dense):
+        self.st = store.orset_gc(self.st, jnp.asarray(gst_dense))
+
+    def read(self, key, read_vc: Optional[VC]):
+        """set_aw state (element -> live dot frozenset) at ``read_vc``,
+        reconstructed from the device fold — actors are recovered from
+        the dense DC columns, so the state round-trips through the host
+        CRDT (read-your-writes applies its effects on top)."""
+        if self.pending_keys:
+            self.flush()
+        idx = self.key_index.get(key)
+        if idx is None:
+            raise ReadBelowBase()  # evicted during the flush — host path
+        rv = self._read_vc_dense(read_vc)
+        dots = np.asarray(store.orset_read_keys(
+            self.st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))[0])
+        actors = self.domain.dc_ids
+        state = {}
+        for slot, elem in enumerate(self.rev_elems[idx]):
+            live = frozenset(
+                (actors[j], int(s))
+                for j, s in enumerate(dots[slot][:len(actors)]) if s > 0)
+            if live:
+                state[elem] = live
+        return state
+
+    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
+        """Batched variant of read(): one device fold for B keys.
+        Returns {key: state} for the keys still device-owned after the
+        leading flush (a flush can evict keys); callers serve the rest
+        from the host path."""
+        if self.pending_keys:
+            self.flush()
+        owned = [k for k in keys if k in self.key_index]
+        if not owned:
+            return {}
+        rv = self._read_vc_dense(read_vc)
+        idxs = np.asarray([self.key_index[k] for k in owned], dtype=np.int32)
+        B = _bucket(len(idxs))
+        pad = np.full(B, 0, dtype=np.int32)
+        pad[:len(idxs)] = idxs
+        dots = np.asarray(store.orset_read_keys(
+            self.st, jnp.asarray(pad), jnp.asarray(rv)))
+        actors = self.domain.dc_ids
+        out = {}
+        for i, k in enumerate(owned):
+            idx = idxs[i]
+            state = {}
+            for slot, elem in enumerate(self.rev_elems[idx]):
+                live = frozenset(
+                    (actors[j], int(s))
+                    for j, s in enumerate(dots[i, slot][:len(actors)])
+                    if s > 0)
+                if live:
+                    state[elem] = live
+            out[k] = state
+        return out
+
+
+class CounterPlane(_PlaneBase):
+    """Device plane for counter_pn.  Row tuple:
+    (key_idx, delta, op_dc_col, op_ct, ss_pairs)."""
+
+    type_name = "counter_pn"
+
+    def _init_state(self, key_capacity):
+        return store.counter_shard_init(
+            key_capacity, self.n_lanes, self.domain.d, dtype=jnp.int64)
+
+    def _grow_dcs(self, new_d):
+        self.st = store.counter_grow(self.st, n_dcs=new_d)
+
+    def _grow_keys(self, new_k):
+        self.st = store.counter_grow(self.st, n_keys=new_k)
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        if self.key_index.get(key) != idx:
+            return  # evicted by a growth-triggered flush (see OrsetPlane)
+        self.rows.append((idx, int(payload.effect), op_dc_col,
+                          int(payload.commit_time), ss_pairs))
+        self.pending_keys.add(key)
+
+    def _append_rows(self, rows):
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        B = _bucket(n)
+        K = self.capacity
+        d = self.domain.d
+        key_idx = np.full(B, K, dtype=np.int32)
+        delta = np.zeros(B, dtype=np.int64)
+        op_dc = np.zeros(B, dtype=np.int64)
+        op_ct = np.zeros(B, dtype=np.int64)
+        ss = np.zeros((B, d), dtype=np.int64)
+        for i, (ki, dl, odc, oct_, ssp) in enumerate(rows):
+            key_idx[i] = ki
+            delta[i] = dl
+            op_dc[i] = odc
+            op_ct[i] = oct_
+            for col, t in ssp:
+                ss[i, col] = max(ss[i, col], t)
+        lane_off = np.zeros(B, dtype=np.int32)
+        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
+        self.st, overflow = store.counter_append(
+            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
+            jnp.asarray(delta), jnp.asarray(op_dc), jnp.asarray(op_ct),
+            jnp.asarray(ss))
+        return np.asarray(overflow)[:n]
+
+    def _purge_idx(self, idx):
+        self.st = store.counter_purge_keys(
+            self.st, jnp.asarray([idx], dtype=np.int32))
+
+    def _device_gc(self, gst_dense):
+        self.st = store.counter_gc(self.st, jnp.asarray(gst_dense))
+
+    def read(self, key, read_vc: Optional[VC]) -> int:
+        if self.pending_keys:
+            self.flush()
+        idx = self.key_index.get(key)
+        if idx is None:
+            raise ReadBelowBase()  # evicted during the flush — host path
+        rv = self._read_vc_dense(read_vc)
+        return int(store.counter_read_keys(
+            self.st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))[0])
+
+    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
+        """See OrsetPlane.read_many — {key: value} for device-owned keys."""
+        if self.pending_keys:
+            self.flush()
+        owned = [k for k in keys if k in self.key_index]
+        if not owned:
+            return {}
+        rv = self._read_vc_dense(read_vc)
+        idxs = np.asarray([self.key_index[k] for k in owned], dtype=np.int32)
+        B = _bucket(len(idxs))
+        pad = np.full(B, 0, dtype=np.int32)
+        pad[:len(idxs)] = idxs
+        vals = np.asarray(store.counter_read_keys(
+            self.st, jnp.asarray(pad), jnp.asarray(rv)))
+        return {k: int(vals[i]) for i, k in enumerate(owned)}
+
+
+class DevicePlane:
+    """Per-partition facade over the type planes; all calls run under
+    the owning PartitionManager's lock (one-writer discipline, like the
+    reference's single vnode process)."""
+
+    def __init__(self, config=None, key_capacity: int = 1024,
+                 n_lanes: int = 8, n_slots: int = 8,
+                 flush_ops: int = 256, gc_ops: int = 2048,
+                 max_dcs: int = 64, max_slots: int = 256):
+        if config is not None:
+            key_capacity = config.device_key_capacity
+            n_lanes = config.device_lanes
+            n_slots = config.device_slots
+            flush_ops = config.device_flush_ops
+            gc_ops = config.device_gc_ops
+            max_dcs = config.device_max_dcs
+            max_slots = config.device_max_slots
+        self.planes: Dict[str, _PlaneBase] = {
+            "set_aw": OrsetPlane(ClockDomain(8), key_capacity, n_lanes,
+                                 n_slots, flush_ops, gc_ops, max_dcs,
+                                 max_slots),
+            "counter_pn": CounterPlane(ClockDomain(8), key_capacity,
+                                       n_lanes, flush_ops, gc_ops,
+                                       max_dcs),
+        }
+        #: keys evicted to the host path (sticky)
+        self.host_only: set = set()
+        #: types whose dense representation collapses dot sets per DC —
+        #: only sound under write-write certification (module doc)
+        self.dot_collapse_types = frozenset({"set_aw"})
+
+    def set_evict_handler(self, fn: Callable[[Any, str], None]) -> None:
+        def handler(key, type_name):
+            self.host_only.add(key)
+            fn(key, type_name)
+        for p in self.planes.values():
+            p.on_evict = handler
+
+    def accepts(self, type_name: str, key) -> bool:
+        return type_name in self.planes and key not in self.host_only
+
+    def owns(self, type_name: str, key) -> bool:
+        p = self.planes.get(type_name)
+        return p is not None and p.owns(key)
+
+    def stage(self, key, type_name: str, payload: Payload,
+              stable_vc: Optional[VC]) -> None:
+        p = self.planes[type_name]
+        p.stage(key, payload)
+        p.maybe_flush_gc(stable_vc)
+
+    def read(self, key, type_name: str, read_vc: Optional[VC]):
+        return self.planes[type_name].read(key, read_vc)
+
+    def read_many(self, keys: list, type_name: str,
+                  read_vc: Optional[VC]) -> dict:
+        """{key: state} for device-owned keys; callers take the host
+        path for the rest."""
+        return self.planes[type_name].read_many(keys, read_vc)
+
+    def gc(self, stable_vc: VC) -> None:
+        for p in self.planes.values():
+            p.gc(stable_vc)
+
+    def flush(self) -> None:
+        for p in self.planes.values():
+            p.flush()
+
+    def pending(self) -> int:
+        return sum(len(p.rows) for p in self.planes.values())

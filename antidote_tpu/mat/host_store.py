@@ -29,6 +29,7 @@ from antidote_tpu.mat.materializer import (
     Payload,
     SnapshotGetResponse,
     materialize,
+    materialize_from_log,
 )
 
 OPS_THRESHOLD = 50
@@ -124,12 +125,8 @@ class HostStore:
             if self._log_fallback is None:
                 raise LookupError(
                     "read below pruned history and no log fallback")
-            ops = list(reversed(self._log_fallback(key=e.key)))
-            resp = SnapshotGetResponse(
-                snapshot_time=None, ops=ops,
-                materialized=MaterializedSnapshot(
-                    last_op_id=0, value=get_type(e.type_name).new()))
-            res = materialize(e.type_name, txid, read_vc, resp)
+            res = materialize_from_log(
+                e.type_name, self._log_fallback(key=e.key), read_vc, txid)
             return res.value, res.snapshot_vc
         resp = SnapshotGetResponse(
             snapshot_time=base_vc,

@@ -43,6 +43,9 @@ class Payload:
     commit_time: int
     snapshot_vc: VC
     txid: Any = None
+    #: whether write-write certification gated this commit — the device
+    #: plane's dense dot collapse is only sound for certified commits
+    certified: bool = True
 
     def commit_vc(self) -> VC:
         return self.snapshot_vc.set_dc(self.commit_dc, self.commit_time)
@@ -139,6 +142,22 @@ def materialize(type_name: str, txid: Any, min_snapshot_time: VC,
         is_new_snapshot=bool(included),
         ops_applied=len(included),
     )
+
+
+def materialize_from_log(type_name: str, log_payloads: Sequence[Tuple[int, Payload]],
+                         read_vc: Optional[VC], txid: Any = None
+                         ) -> MaterializeResult:
+    """Full log replay for one key from scratch — the snapshot-cache
+    miss path shared by the host store's pruned-history fallback and the
+    device plane's below-base fallback (reference get_from_snapshot_log,
+    src/materializer_vnode.erl:415-419).  ``log_payloads``: [(seq,
+    Payload)] in log order (PartitionLog.committed_payloads)."""
+    ops = list(reversed(log_payloads))
+    resp = SnapshotGetResponse(
+        snapshot_time=None, ops=ops,
+        materialized=MaterializedSnapshot(
+            last_op_id=0, value=get_type(type_name).new()))
+    return materialize(type_name, txid, read_vc, resp)
 
 
 def materialize_eager(type_name: str, value: Any, effects: Sequence[Any]) -> Any:

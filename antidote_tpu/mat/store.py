@@ -231,6 +231,91 @@ def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
     return kernels.orset_present(dots)
 
 
+@jax.jit
+def orset_read_keys(st: OrsetShardState, key_idx: jax.Array,
+                    read_vc: jax.Array) -> jax.Array:
+    """int[B, E, D]: folded live-dot tables for just the requested keys
+    at ``read_vc`` — the transaction read path (B small), vs
+    :func:`orset_read` which folds the whole shard.
+
+    Gathers the B keys' ring rows ([B, L, F]) and base rows, then runs
+    the same inclusion-mask + lattice fold as the full-shard read.
+    Requires read_vc >= base_vc (callers fall back to log replay below
+    the base, the reference's snapshot-cache miss)."""
+    L = st.n_lanes
+    d = st._d
+    flat = key_idx[:, None] * L + jnp.arange(L, dtype=key_idx.dtype)
+    ops = st.ops[flat]                                   # [B, L, F]
+    valid = st.valid[flat]                               # [B, L]
+    elem = ops[..., _ELEM]
+    is_add = ops[..., _ISADD] != 0
+    dot_dc = ops[..., _DOTDC]
+    dot_seq = ops[..., _DOTSEQ]
+    op_dc = ops[..., _OPDC]
+    op_ct = ops[..., _OPCT]
+    obs_vv = ops[..., _NSCAL:_NSCAL + d]
+    op_ss = ops[..., _NSCAL + d:]
+    B = key_idx.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (B, d))
+    has_base = jnp.broadcast_to(st.has_base, (B,))
+    mask = kernels.inclusion_mask(
+        op_dc, op_ct, op_ss, valid, base_vc, has_base, read_vc)
+    return kernels.orset_apply(
+        st.dots[key_idx], elem, is_add, dot_dc, dot_seq, obs_vv, mask)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def orset_purge_keys(st: OrsetShardState,
+                     key_idx: jax.Array) -> OrsetShardState:
+    """Free every ring lane and zero the base rows of the given keys —
+    used when a key is evicted to the host path (element-slot or lane
+    overflow); its history is then served by log replay.  Out-of-range
+    indices (padding) are dropped."""
+    L = st.n_lanes
+    flat = (key_idx[:, None] * L
+            + jnp.arange(L, dtype=key_idx.dtype)).reshape(-1)
+    return replace(
+        st,
+        valid=st.valid.at[flat].set(False, mode="drop"),
+        dots=st.dots.at[key_idx].set(0, mode="drop"),
+    )
+
+
+def orset_grow(st: OrsetShardState, n_keys: int | None = None,
+               n_slots: int | None = None,
+               n_dcs: int | None = None) -> OrsetShardState:
+    """Host-side capacity regrade: widen keys / element slots / DC
+    columns (never shrink).  One host repack + re-upload; rare (called
+    when a directory fills), so simplicity over speed."""
+    K, E, D = st.dots.shape
+    L = st.n_lanes
+    nk, ne, nd = (n_keys or K), (n_slots or E), (n_dcs or D)
+    if (nk, ne, nd) == (K, E, D):
+        return st
+    ops = np.asarray(st.ops).reshape(K, L, -1)
+    scal = ops[..., :_NSCAL]
+    obs = ops[..., _NSCAL:_NSCAL + D]
+    ss = ops[..., _NSCAL + D:]
+    padD = ((0, 0), (0, 0), (0, nd - D))
+    ops = np.concatenate(
+        [scal, np.pad(obs, padD), np.pad(ss, padD)], axis=-1)
+    if nk > K:
+        # invalid-lane sentinel values don't matter (folds mask by
+        # `valid`), so zero rows are fine
+        ops = np.pad(ops, ((0, nk - K), (0, 0), (0, 0)))
+    valid = np.pad(np.asarray(st.valid).reshape(K, L), ((0, nk - K), (0, 0)))
+    dots = np.pad(np.asarray(st.dots),
+                  ((0, nk - K), (0, ne - E), (0, nd - D)))
+    return OrsetShardState(
+        dots=jnp.asarray(dots),
+        base_vc=jnp.asarray(np.pad(np.asarray(st.base_vc), (0, nd - D))),
+        has_base=st.has_base,
+        ops=jnp.asarray(ops.reshape(nk * L, -1)),
+        valid=jnp.asarray(valid.reshape(-1)),
+        n_lanes=L,
+    )
+
+
 # ---------------------------------------------------------------------------
 # counter_pn shard — same packed-ring machinery, scalar state
 
@@ -341,6 +426,70 @@ def counter_read(st: CounterShardState, read_vc: jax.Array) -> jax.Array:
         st.op_dc, st.op_ct, st.op_ss, st.valid2d, base_vc, has_base,
         read_vc)
     return kernels.counter_read(st.value, st.delta, mask)
+
+
+@jax.jit
+def counter_read_keys(st: CounterShardState, key_idx: jax.Array,
+                      read_vc: jax.Array) -> jax.Array:
+    """int[B]: counter values for just the requested keys at ``read_vc``
+    (the transaction read path; see orset_read_keys)."""
+    L = st.n_lanes
+    d = st._d
+    flat = key_idx[:, None] * L + jnp.arange(L, dtype=key_idx.dtype)
+    ops = st.ops[flat]
+    valid = st.valid[flat]
+    delta = ops[..., _CDELTA]
+    op_dc = ops[..., _COPDC]
+    op_ct = ops[..., _COPCT]
+    op_ss = ops[..., _CNSCAL:]
+    B = key_idx.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (B, d))
+    has_base = jnp.broadcast_to(st.has_base, (B,))
+    mask = kernels.inclusion_mask(
+        op_dc, op_ct, op_ss, valid, base_vc, has_base, read_vc)
+    return kernels.counter_read(st.value[key_idx], delta, mask)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def counter_purge_keys(st: CounterShardState,
+                       key_idx: jax.Array) -> CounterShardState:
+    """Free ring lanes and zero base values of the given keys (host
+    eviction; see orset_purge_keys)."""
+    L = st.n_lanes
+    flat = (key_idx[:, None] * L
+            + jnp.arange(L, dtype=key_idx.dtype)).reshape(-1)
+    return replace(
+        st,
+        valid=st.valid.at[flat].set(False, mode="drop"),
+        value=st.value.at[key_idx].set(0, mode="drop"),
+    )
+
+
+def counter_grow(st: CounterShardState, n_keys: int | None = None,
+                 n_dcs: int | None = None) -> CounterShardState:
+    """Host-side capacity regrade for the counter shard (see orset_grow)."""
+    K = st.value.shape[0]
+    D = st._d
+    L = st.n_lanes
+    nk, nd = (n_keys or K), (n_dcs or D)
+    if (nk, nd) == (K, D):
+        return st
+    ops = np.asarray(st.ops).reshape(K, L, -1)
+    scal = ops[..., :_CNSCAL]
+    ss = ops[..., _CNSCAL:]
+    ops = np.concatenate(
+        [scal, np.pad(ss, ((0, 0), (0, 0), (0, nd - D)))], axis=-1)
+    if nk > K:
+        ops = np.pad(ops, ((0, nk - K), (0, 0), (0, 0)))
+    valid = np.pad(np.asarray(st.valid).reshape(K, L), ((0, nk - K), (0, 0)))
+    return CounterShardState(
+        value=jnp.asarray(np.pad(np.asarray(st.value), (0, nk - K))),
+        base_vc=jnp.asarray(np.pad(np.asarray(st.base_vc), (0, nd - D))),
+        has_base=st.has_base,
+        ops=jnp.asarray(ops.reshape(nk * L, -1)),
+        valid=jnp.asarray(valid.reshape(-1)),
+        n_lanes=L,
+    )
 
 
 def batch_lane_offsets(key_idx: np.ndarray) -> np.ndarray:

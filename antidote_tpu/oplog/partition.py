@@ -23,6 +23,7 @@ from antidote_tpu.oplog.records import (
     OpId,
     TxnAssembler,
     abort_record,
+    commit_certified,
     commit_record,
     prepare_record,
     update_record,
@@ -80,12 +81,12 @@ class PartitionLog:
             sync=False)
 
     def append_commit(self, dc, txid, commit_time: int,
-                      snapshot_vc: VC) -> LogRecord:
+                      snapshot_vc: VC, certified: bool = True) -> LogRecord:
         """Commit record; fsyncs when sync_on_commit (reference
         append_commit / ?SYNC_LOG)."""
         return self._append(
             commit_record(self._next_op_id(dc), txid, dc, commit_time,
-                          snapshot_vc),
+                          snapshot_vc, certified),
             sync=self.sync_on_commit)
 
     def append_abort(self, dc, txid) -> LogRecord:
@@ -140,14 +141,15 @@ class PartitionLog:
             if done is None:
                 continue
             commit = done[-1]
-            (_), (dc, ct), svc = commit.payload
+            (dc, ct), svc = commit.payload[1], commit.payload[2]
+            certified = commit_certified(commit.payload)
             for upd in done[:-1]:
                 _, k, type_name, effect = upd.payload
                 if key is not None and k != key:
                     continue
                 p = Payload(key=k, type_name=type_name, effect=effect,
                             commit_dc=dc, commit_time=ct, snapshot_vc=svc,
-                            txid=upd.txid)
+                            txid=upd.txid, certified=certified)
                 if to_vc is not None and not op_in_read_snapshot(to_vc, p):
                     continue
                 if from_vc is not None and p.commit_vc().le(from_vc):
@@ -173,7 +175,7 @@ class PartitionLog:
             if rec.op_id.n > cur:
                 self.op_counters[rec.op_id.dc] = rec.op_id.n
             if rec.kind() == "commit":
-                _, (dc, ct), _svc = rec.payload
+                (dc, ct) = rec.payload[1]
                 if ct > self.max_commit_vc.get_dc(dc):
                     self.max_commit_vc = self.max_commit_vc.set_dc(dc, ct)
 

@@ -10,7 +10,11 @@ monotone per origin DC.
 Payload kinds (reference log_operation types):
 - ``("update", key, type_name, effect)``
 - ``("prepare", prepare_time)``
-- ``("commit", (dc, commit_time), snapshot_vc)``
+- ``("commit", (dc, commit_time), snapshot_vc, certified)`` — the
+  ``certified`` flag records whether write-write certification gated
+  this commit; the device data plane's dense dot collapse is only sound
+  for certified commits (antidote_tpu/mat/device_plane.py), so the flag
+  must survive the log and the inter-DC stream
 - ``("abort",)``
 
 Serialization is pickle (internal durability format, not a wire format).
@@ -59,8 +63,15 @@ def prepare_record(op_id: OpId, txid, prepare_time: int) -> LogRecord:
 
 
 def commit_record(op_id: OpId, txid, dc, commit_time: int,
-                  snapshot_vc: VC) -> LogRecord:
-    return LogRecord(op_id, txid, ("commit", (dc, commit_time), snapshot_vc))
+                  snapshot_vc: VC, certified: bool = True) -> LogRecord:
+    return LogRecord(
+        op_id, txid, ("commit", (dc, commit_time), snapshot_vc, certified))
+
+
+def commit_certified(payload: Tuple) -> bool:
+    """Certified flag of a commit payload (older 3-tuple records
+    default to True)."""
+    return bool(payload[3]) if len(payload) > 3 else True
 
 
 def abort_record(op_id: OpId, txid) -> LogRecord:

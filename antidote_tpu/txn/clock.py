@@ -25,12 +25,27 @@ class HybridClock:
             self._last = t
             return t
 
+    def advance_to(self, ts_us: int) -> None:
+        """Never issue a timestamp at/below ``ts_us`` again — used at
+        recovery so commit times stay monotone across restarts even if
+        the wall clock regressed (the reference relies on BEAM's
+        no_time_warp, config/vm.args:29-31)."""
+        with self._lock:
+            self._last = max(self._last, int(ts_us))
+
     def wait_until(self, ts_us: int) -> None:
         """Block until the local clock passes ``ts_us`` (the reference's
         wait_for_clock spin, src/clocksi_interactive_coord.erl:915-926) —
-        needed when a client clock from another node runs ahead."""
+        needed when a client clock from another node runs ahead.
+
+        Consults the HYBRID clock, not raw wall time: after a recovery
+        ``advance_to`` (or any wall regression) ``_last`` runs ahead of
+        the wall, and timestamps it issued are already safe to read at —
+        waiting for the wall to catch up would stall every read for the
+        regression span."""
         while True:
-            now = time.time_ns() // 1000
+            with self._lock:
+                now = max(time.time_ns() // 1000, self._last)
             if now >= ts_us:
                 return
             time.sleep(min((ts_us - now) / 1e6, 0.01))

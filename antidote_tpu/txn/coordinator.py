@@ -99,7 +99,8 @@ class Coordinator:
         stats.registry.open_transactions.inc()
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
-            ctx=DownstreamCtx(actor=(str(node.dc_id), txid[1])))
+            ctx=DownstreamCtx(actor=(str(node.dc_id), txid[1]),
+                              mint=node.mint_dot))
 
     def _wait_for_clock(self, client_clock: VC) -> VC:
         """Spin until the snapshot (stable GST with the local entry at
@@ -162,7 +163,8 @@ class Coordinator:
         stats.registry.open_transactions.inc()
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
-            ctx=DownstreamCtx(actor=(str(self.node.dc_id), txid[1])))
+            ctx=DownstreamCtx(actor=(str(self.node.dc_id), txid[1]),
+                              mint=self.node.mint_dot))
 
     def _check_active(self, tx: Transaction) -> None:
         if tx.state is not TxnState.ACTIVE:
@@ -276,7 +278,8 @@ class Coordinator:
             ct = max(prepare_times)
             try:
                 for pm in pms:
-                    pm.commit(tx.txid, ct, tx.snapshot_vc)
+                    pm.commit(tx.txid, ct, tx.snapshot_vc,
+                              certified=certify)
             except Exception as e:
                 # post-decision failure: some partitions may hold a
                 # durable commit record — reporting an abort here would

@@ -19,9 +19,15 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from antidote_tpu.clocks import VC
+from antidote_tpu.mat.device_plane import DevicePlane, ReadBelowBase
 from antidote_tpu.mat.host_store import HostStore
-from antidote_tpu.mat.materializer import Payload, materialize_eager
+from antidote_tpu.mat.materializer import (
+    Payload,
+    materialize_eager,
+    materialize_from_log,
+)
 from antidote_tpu.oplog.partition import PartitionLog
+from antidote_tpu.oplog.records import commit_certified
 from antidote_tpu.txn.clock import HybridClock
 
 
@@ -35,12 +41,17 @@ _STABLE_REFRESH_S = 0.05
 
 class PartitionManager:
     def __init__(self, partition: int, dc_id, log: PartitionLog,
-                 clock: HybridClock, read_wait_timeout: float = 5.0):
+                 clock: HybridClock, read_wait_timeout: float = 5.0,
+                 device_plane: Optional[DevicePlane] = None):
         self.partition = partition
         self.dc_id = dc_id
         self.log = log
         self.clock = clock
         self.store = HostStore(log_fallback=log.committed_payloads)
+        #: TPU data plane for supported types (None = host-only node)
+        self.device = device_plane
+        if device_plane is not None:
+            device_plane.set_evict_handler(self._migrate_key_to_host)
         self.read_wait_timeout = read_wait_timeout
         #: GC horizon source (set by Node): a clock no FUTURE commit can
         #: fall below — the GST.  A txn's own snapshot is NOT safe here: a
@@ -118,21 +129,59 @@ class PartitionManager:
             self._stable_cached_at = now
         return self._stable_cache
 
-    def commit(self, txid, commit_time: int, snapshot_vc: VC) -> None:
+    def _publish(self, key, type_name: str, payload: Payload,
+                 stable: Optional[VC]) -> None:
+        """Route one committed effect to its materializer: the device
+        plane for supported types, the host store otherwise (the
+        reference's update_materializer, src/clocksi_vnode.erl:634-657).
+        Must run under self._lock.
+
+        Uncertified commits (txn_cert off / DONT_CERTIFY) may mint
+        concurrent same-key dots at one DC, which the device plane's
+        per-DC dot collapse cannot represent — dot-bearing types from
+        such commits stay on the host path (evicting the key's device
+        history first if it has any)."""
+        if self.device is not None:
+            unsound = (not payload.certified
+                       and type_name in self.device.dot_collapse_types)
+            if not unsound and self.device.accepts(type_name, key):
+                # the plane owns the op from here — including the
+                # eviction path, where the key's whole history (this op
+                # included, it is already in the log) migrates to the
+                # host store
+                self.device.stage(key, type_name, payload, stable)
+                return
+            if unsound and self.device.owns(type_name, key):
+                # eviction migrates the full log history — which already
+                # contains this op — so nothing more to insert
+                self.device.planes[type_name].evict(key)
+                return
+        self.store.insert(key, type_name, payload, stable_vc=stable)
+
+    def _migrate_key_to_host(self, key, type_name: str) -> None:
+        """Device-plane eviction handler: rebuild the key's host-store
+        entry from the durable log (runs under self._lock — the lock is
+        re-entrant)."""
+        for _seq, p in self.log.committed_payloads(key=key):
+            self.store.insert(key, type_name, p)
+
+    def commit(self, txid, commit_time: int, snapshot_vc: VC,
+               certified: bool = True) -> None:
         """Log the commit (fsync per config), publish the effects to the
         materializer store, release prepared state and wake blocked
         readers (reference commit handler src/clocksi_vnode.erl:499-531,
         update_materializer :634-657)."""
         stable = self._stable_for_gc()  # before the lock (see __init__)
         with self._lock:
-            self.log.append_commit(self.dc_id, txid, commit_time, snapshot_vc)
+            self.log.append_commit(self.dc_id, txid, commit_time,
+                                   snapshot_vc, certified)
             for key, type_name, effect in self._staged.pop(txid, []):
                 payload = Payload(
                     key=key, type_name=type_name, effect=effect,
                     commit_dc=self.dc_id, commit_time=commit_time,
-                    snapshot_vc=snapshot_vc, txid=txid)
-                self.store.insert(key, type_name, payload,
-                                  stable_vc=stable)
+                    snapshot_vc=snapshot_vc, txid=txid,
+                    certified=certified)
+                self._publish(key, type_name, payload, stable)
                 if commit_time > self.committed.get(key, 0):
                     self.committed[key] = commit_time
             self.prepared.pop(txid, None)
@@ -148,7 +197,7 @@ class PartitionManager:
                 self.certify(txid, keys, snapshot_vc)
             ct = self.clock.now_us()
             self.prepared[txid] = (ct, keys)
-        self.commit(txid, ct, snapshot_vc)
+        self.commit(txid, ct, snapshot_vc, certified=certify)
         return ct
 
     def abort(self, txid) -> None:
@@ -171,6 +220,8 @@ class PartitionManager:
         certification is local-only; concurrent remote updates resolve by
         CRDT semantics, not aborts."""
         stable = self._stable_for_gc()  # before the lock (see __init__)
+        certified = all(commit_certified(rec.payload) for rec in records
+                        if rec.kind() == "commit")
         with self._lock:
             self.log.append_remote_group(records)
             for rec in records:
@@ -180,8 +231,9 @@ class PartitionManager:
                 payload = Payload(
                     key=key, type_name=type_name, effect=effect,
                     commit_dc=origin_dc, commit_time=commit_time,
-                    snapshot_vc=snapshot_vc, txid=rec.txid)
-                self.store.insert(key, type_name, payload, stable_vc=stable)
+                    snapshot_vc=snapshot_vc, txid=rec.txid,
+                    certified=certified)
+                self._publish(key, type_name, payload, stable)
             self._lock.notify_all()
 
     # --------------------------------------------------------------- reads
@@ -214,9 +266,30 @@ class PartitionManager:
             # store access stays under the partition lock: commit()
             # mutates the same entries (one-writer semantics, like the
             # reference's single vnode process + shared-ETS readers)
-            value, _vc = self.store.read(key, type_name, snapshot_vc,
-                                         txid=txid)
+            value = self._read_store(key, type_name, snapshot_vc, txid)
         return value
+
+    def _read_store(self, key, type_name: str, read_vc: Optional[VC],
+                    txid=None) -> Any:
+        """Materialized value from whichever plane owns the key; must run
+        under self._lock.  Device keys read via the batched fold; reads
+        below the device base (or with clocks outside its DC domain)
+        replay the log — the reference's snapshot-cache miss."""
+        if self.device is not None and self.device.owns(type_name, key):
+            try:
+                return self.device.read(key, type_name, read_vc)
+            except ReadBelowBase:
+                return self._read_from_log(key, type_name, read_vc, txid)
+        value, _vc = self.store.read(key, type_name, read_vc, txid=txid)
+        return value
+
+    def _read_from_log(self, key, type_name: str, read_vc: Optional[VC],
+                       txid=None) -> Any:
+        """Full log replay for one key (reference get_from_snapshot_log,
+        src/materializer_vnode.erl:415-419)."""
+        return materialize_from_log(
+            type_name, self.log.committed_payloads(key=key), read_vc,
+            txid).value
 
     def read_with_writeset(self, key, type_name: str, snapshot_vc,
                            txid, own_effects: List[Any]) -> Any:
@@ -244,5 +317,4 @@ class PartitionManager:
         """Committed value at ``clock`` (None = latest) without Clock-SI
         gating (get_objects path); store access under the partition lock."""
         with self._lock:
-            value, _ = self.store.read(key, type_name, clock)
-        return value
+            return self._read_store(key, type_name, clock)

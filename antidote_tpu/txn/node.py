@@ -43,8 +43,14 @@ class Node:
                 enabled=self.config.enable_logging,
                 on_append=(lambda rec, _p=p: on_log_append(_p, rec))
                 if on_log_append else None)
+            plane = None
+            if self.config.device_store:
+                from antidote_tpu.mat.device_plane import DevicePlane
+
+                plane = DevicePlane(config=self.config)
             self.partitions.append(
-                PartitionManager(p, dc_id, log, self.clock))
+                PartitionManager(p, dc_id, log, self.clock,
+                                 device_plane=plane))
         #: provider of the gossiped stable snapshot (set by the meta
         #: plane / inter-DC layer).  The single-DC default is the node's
         #: own min-prepared time: no future local commit can fall below
@@ -92,6 +98,16 @@ class Node:
         """Node-wide min prepared time (feeds the stable-time gossip)."""
         return min(pm.min_prepared() for pm in self.partitions)
 
+    def mint_dot(self) -> Tuple[Any, int]:
+        """Unique dot for CRDT downstream generation: ``(dc_id, µs)``
+        with the µs sequence strictly monotone node-wide.  One actor per
+        DC (not per transaction) is what lets the device data plane
+        collapse dot sets into dense per-DC-column tables
+        (antidote_tpu/mat/device_plane.py): write-write certification
+        serializes same-key commits at a DC, so per-DC max-seq collapse
+        preserves ORSWOT semantics."""
+        return (self.dc_id, self.clock.now_us())
+
     # ------------------------------------------------------------ normalize
 
     @staticmethod
@@ -125,9 +141,12 @@ class Node:
         """Rebuild materializer caches from the durable logs at boot
         (reference materializer_vnode load_from_log,
         src/materializer_vnode.erl:123-131, 288-319)."""
+        recovered_vc = VC()
         for pm in self.partitions:
             for _seq, payload in pm.log.committed_payloads():
-                pm.store.insert(payload.key, payload.type_name, payload)
+                with pm._lock:
+                    pm._publish(payload.key, payload.type_name, payload,
+                                None)
                 if payload.commit_dc != self.dc_id:
                     # replicated records are durable too, but the
                     # certification tables are local-only — exactly as on
@@ -137,6 +156,19 @@ class Node:
                     continue
                 if payload.commit_time > pm.committed.get(payload.key, 0):
                     pm.committed[payload.key] = payload.commit_time
+            recovered_vc = recovered_vc.join(pm.log.max_commit_vc)
+        # keep commit timestamps monotone across the restart
+        self.clock.advance_to(recovered_vc.get_dc(self.dc_id))
+        if recovered_vc:
+            # the recovered join is a safe fold horizon: every future
+            # op's origin column exceeds its origin's recovered
+            # watermark (FIFO opid continuity / local clock), so nothing
+            # can still commit at/below it.  Folding leaves the device
+            # rings empty — recovery = batch append + one fold.
+            for pm in self.partitions:
+                if pm.device is not None:
+                    with pm._lock:
+                        pm.device.gc(recovered_vc)
 
     def close(self) -> None:
         for pm in self.partitions:

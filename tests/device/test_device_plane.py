@@ -1,0 +1,299 @@
+"""Device data plane vs host materializer — oracle equivalence.
+
+The device plane (antidote_tpu/mat/device_plane.py) must agree with the
+host store on every read the system can pose: random committed op
+streams from several DCs, read at random snapshots, after GCs, across
+evictions, and across restart recovery.  The host path is the semantic
+oracle (antidote_tpu/mat/materializer.py mirrors the reference's
+clocksi_materializer).
+"""
+
+import random
+
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.config import Config
+from antidote_tpu.mat.materializer import Payload
+from antidote_tpu.mat.host_store import HostStore
+from antidote_tpu.oplog.partition import PartitionLog
+from antidote_tpu.txn.clock import HybridClock
+from antidote_tpu.txn.manager import PartitionManager
+from antidote_tpu.mat.device_plane import DevicePlane
+from antidote_tpu.crdt import get_type
+
+
+def make_pm(tmp_path, name="p0", device=True, **plane_kw):
+    log = PartitionLog(str(tmp_path / f"{name}.log"), partition=0)
+    plane = DevicePlane(**plane_kw) if device else None
+    pm = PartitionManager(0, "dc1", log, HybridClock(), device_plane=plane)
+    return pm
+
+
+class StreamGen:
+    """Random committed multi-DC op stream with causally consistent
+    snapshot VCs (each DC's snapshot covers everything it applied)."""
+
+    def __init__(self, seed, dcs=("dc1", "dc2", "dc3"), keys=6, elems=5):
+        self.rng = random.Random(seed)
+        self.dcs = list(dcs)
+        self.keys = [f"k{i}" for i in range(keys)]
+        self.elems = [f"e{i}" for i in range(elems)]
+        self.clock = {d: 0 for d in self.dcs}
+        #: per-DC view of per-key orset state: elem -> set of dots
+        self.state = {d: {k: {} for k in self.keys} for d in self.dcs}
+        self.t = 1000
+
+    def _tick(self, dc):
+        self.t += self.rng.randint(1, 5)
+        self.clock[dc] = self.t
+        return self.t
+
+    def next_op(self, type_name):
+        dc = self.rng.choice(self.dcs)
+        key = self.rng.choice(self.keys)
+        ss = VC({d: t for d, t in self.clock.items() if t})
+        ct = self._tick(dc)
+        if type_name == "counter_pn":
+            eff = self.rng.randint(-5, 5)
+        else:
+            st = self.state[dc][key]
+            if st and self.rng.random() < 0.4:
+                e = self.rng.choice(sorted(st))
+                eff = ("rmv", ((e, tuple(sorted(st[e]))),))
+            else:
+                e = self.rng.choice(self.elems)
+                dot = (dc, ct)
+                eff = ("add", ((e, dot, tuple(sorted(st.get(e, ())))),))
+        p = Payload(key=key, type_name=type_name, effect=eff,
+                    commit_dc=dc, commit_time=ct, snapshot_vc=ss,
+                    txid=f"tx{ct}")
+        # apply to every DC view (causal delivery simulated as immediate)
+        cls = get_type(type_name)
+        for d in self.dcs:
+            if type_name == "set_aw":
+                self.state[d][key] = cls.update(eff, self.state[d][key])
+            self.clock[d] = max(self.clock[d], ct)
+        return p
+
+    def snapshot(self):
+        return VC(dict(self.clock))
+
+
+def publish(pm, p, stable):
+    """Log + publish one committed payload (the apply path's effect,
+    with the log populated so eviction-migration has history to replay)."""
+    with pm._lock:
+        pm.log.append_update(p.commit_dc, p.txid, p.key, p.type_name,
+                             p.effect)
+        pm.log.append_commit(p.commit_dc, p.txid, p.commit_time,
+                             p.snapshot_vc)
+        pm._publish(p.key, p.type_name, p, stable)
+
+
+@pytest.mark.parametrize("type_name", ["counter_pn", "set_aw"])
+def test_stream_oracle_equivalence(tmp_path, type_name):
+    """Random stream through the real publish path: device reads ==
+    host-store reads at the latest snapshot and at historical ones."""
+    gen = StreamGen(seed=7)
+    pm_dev = make_pm(tmp_path, "dev", device=True,
+                     key_capacity=4, n_lanes=4, n_slots=2,
+                     flush_ops=16, gc_ops=48)
+    pm_host = make_pm(tmp_path, "host", device=False)
+    cls = get_type(type_name)
+
+    snapshots = []
+    for i in range(300):
+        p = gen.next_op(type_name)
+        stable = VC({d: max(t - 40, 0) for d, t in gen.clock.items()})
+        for pm in (pm_dev, pm_host):
+            publish(pm, p, stable)
+        if i % 37 == 0:
+            snapshots.append(gen.snapshot())
+
+    read_vcs = [None, gen.snapshot()] + snapshots[-3:]
+    for rv in read_vcs:
+        for key in gen.keys:
+            v_dev = pm_dev.value_snapshot(key, type_name, rv)
+            v_host = pm_host.value_snapshot(key, type_name, rv)
+            assert cls.value(v_dev) == cls.value(v_host), (
+                f"key={key} rv={rv}")
+
+
+def test_orset_device_state_roundtrips_dots(tmp_path):
+    """The reconstructed device state carries real (dc, seq) dots so
+    read-your-writes effect application works on top of it."""
+    gen = StreamGen(seed=3, keys=2)
+    pm = make_pm(tmp_path, "rt", device=True, flush_ops=4)
+    for _ in range(40):
+        p = gen.next_op("set_aw")
+        publish(pm, p, None)
+    st = pm.value_snapshot("k0", "set_aw")
+    for elem, dots in st.items():
+        for actor, seq in dots:
+            assert actor in gen.dcs and seq > 0
+
+
+def test_read_below_base_falls_back_to_log(tmp_path):
+    """After a GC advances the device base, reads at snapshots below it
+    replay the log (the reference's snapshot-cache miss)."""
+    pm = make_pm(tmp_path, "gc", device=True, flush_ops=2, gc_ops=4)
+    early = None
+    for i in range(10):
+        ss = VC({"dc1": 100 + i})
+        ct = 101 + i
+        p = Payload(key="k", type_name="counter_pn", effect=1,
+                    commit_dc="dc1", commit_time=ct, snapshot_vc=ss,
+                    txid=f"t{i}")
+        with pm._lock:
+            pm.log.append_update("dc1", f"t{i}", "k", "counter_pn", 1)
+            pm.log.append_commit("dc1", f"t{i}", ct, ss)
+            pm._publish("k", "counter_pn", p, VC({"dc1": ct}))
+        if i == 4:
+            early = VC({"dc1": ct})
+    plane = pm.device.planes["counter_pn"]
+    pm.device.gc(VC({"dc1": 111}))
+    assert plane._has_base
+    # latest read from device
+    assert pm.value_snapshot("k", "counter_pn") == 10
+    # historical read below the base: log replay
+    assert pm.value_snapshot("k", "counter_pn", early) == 5
+
+
+def test_eviction_migrates_to_host(tmp_path):
+    """A key overflowing its element slots evicts: device rows purged,
+    history rebuilt in the host store from the log, reads stay exact."""
+    pm = make_pm(tmp_path, "ev", device=True, n_slots=2, max_slots=4,
+                 flush_ops=2)
+    vals = [f"elem{i}" for i in range(8)]  # > max_slots forces eviction
+    for i, e in enumerate(vals):
+        ss = VC({"dc1": 100 + i})
+        ct = 101 + i
+        eff = ("add", ((e, ("dc1", ct), ()),))
+        p = Payload(key="k", type_name="set_aw", effect=eff,
+                    commit_dc="dc1", commit_time=ct, snapshot_vc=ss,
+                    txid=f"t{i}")
+        with pm._lock:
+            pm.log.append_update("dc1", f"t{i}", "k", "set_aw", eff)
+            pm.log.append_commit("dc1", f"t{i}", ct, ss)
+            pm._publish("k", "set_aw", p, None)
+    assert "k" in pm.device.host_only
+    assert not pm.device.owns("set_aw", "k")
+    st = pm.value_snapshot("k", "set_aw")
+    assert sorted(st.keys()) == sorted(vals)
+
+
+def test_hot_key_lane_overflow_evicts_and_stays_correct(tmp_path):
+    """More unstable ops than ring lanes with no stable horizon: the key
+    evicts to the host path and every op survives."""
+    pm = make_pm(tmp_path, "hot", device=True, n_lanes=2, flush_ops=2)
+    for i in range(12):
+        ss = VC({"dc1": 100 + i})
+        ct = 101 + i
+        p = Payload(key="k", type_name="counter_pn", effect=1,
+                    commit_dc="dc1", commit_time=ct, snapshot_vc=ss,
+                    txid=f"t{i}")
+        with pm._lock:
+            pm.log.append_update("dc1", f"t{i}", "k", "counter_pn", 1)
+            pm.log.append_commit("dc1", f"t{i}", ct, ss)
+            pm._publish("k", "counter_pn", p, None)  # no stable: no GC
+    assert pm.value_snapshot("k", "counter_pn") == 12
+
+
+def test_capacity_growth_keys_and_dcs(tmp_path):
+    """Key-directory and DC-column growth repack the device arrays
+    without losing state."""
+    pm = make_pm(tmp_path, "grow", device=True, key_capacity=2,
+                 flush_ops=4, max_dcs=32)
+    n_keys, n_dcs = 9, 11  # > capacity 2 keys, > 8 dc columns
+    for i in range(n_keys):
+        for d in range(n_dcs):
+            dc = f"dc{d}"
+            ct = 1000 * d + i + 1
+            p = Payload(key=f"k{i}", type_name="counter_pn", effect=1,
+                        commit_dc=dc, commit_time=ct,
+                        snapshot_vc=VC({dc: ct - 1}), txid=f"t{d}_{i}")
+            publish(pm, p, None)
+    for i in range(n_keys):
+        assert pm.value_snapshot(f"k{i}", "counter_pn") == n_dcs
+
+
+def test_uncertified_orset_commits_stay_on_host_path(tmp_path):
+    """DONT_CERTIFY commits may mint concurrent same-DC dots, which the
+    dense per-DC collapse cannot represent — such set_aw effects must
+    route to the host path (evicting any device history first), while
+    counters (no dots) stay on device."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.txn.coordinator import TxnProperties
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(n_partitions=1, data_dir=str(tmp_path / "nc"))
+    api = AntidoteTPU(node=Node(dc_id="dc1", config=cfg))
+    pm = api.node.partitions[0]
+
+    # certified write puts the key on device
+    ct = api.update_objects_static(None, [(("s", "set_aw", "b"), "add", "a")])
+    pm.device.flush()
+    assert pm.device.owns("set_aw", "s")
+
+    # uncertified write evicts it to the host path
+    props = TxnProperties(certify=False)
+    tx = api.start_transaction(ct, props)
+    api.update_objects([(("s", "set_aw", "b"), "add", "b"),
+                        (("c", "counter_pn", "b"), "increment", 1)], tx)
+    ct2 = api.commit_transaction(tx)
+    assert not pm.device.owns("set_aw", "s")
+    assert "s" in pm.device.host_only
+    vals, _ = api.read_objects_static(ct2, [("s", "set_aw", "b"),
+                                            ("c", "counter_pn", "b")])
+    assert sorted(vals[0]) == ["a", "b"]
+    assert vals[1] == 1
+    # counters have no dot collapse: still device-eligible
+    assert pm.device.accepts("counter_pn", "c")
+    api.close()
+
+
+def test_read_many_skips_evicted_keys(tmp_path):
+    """Batched device reads return only still-owned keys after the
+    leading flush (which can evict)."""
+    pm = make_pm(tmp_path, "rm", device=True, n_lanes=2, flush_ops=64)
+    for i in range(3):
+        for j in range(6 if i == 1 else 2):  # k1 overflows its 2 lanes
+            ct = 100 * i + j + 1
+            p = Payload(key=f"k{i}", type_name="counter_pn", effect=1,
+                        commit_dc="dc1", commit_time=ct,
+                        snapshot_vc=VC({"dc1": ct - 1}), txid=f"t{i}_{j}")
+            publish(pm, p, None)
+    plane = pm.device.planes["counter_pn"]
+    out = plane.read_many(["k0", "k1", "k2"], None)
+    assert "k1" not in out  # evicted during the flush
+    assert out.get("k0") == 2 and out.get("k2") == 2
+    assert pm.value_snapshot("k1", "counter_pn") == 6  # host path exact
+
+
+def test_node_recovery_routes_to_device(tmp_path):
+    """Restarted node rebuilds the device plane from the log and serves
+    the same values (reference load_from_log)."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(n_partitions=2, data_dir=str(tmp_path / "n1"))
+    api = AntidoteTPU(node=Node(dc_id="dc1", config=cfg))
+    ct = None
+    for i in range(10):
+        ct = api.update_objects_static(
+            ct, [(("rk", "counter_pn", "b"), "increment", 2),
+                 (("rs", "set_aw", "b"), "add", f"x{i}")])
+    api.close()
+
+    api2 = AntidoteTPU(node=Node(dc_id="dc1", config=cfg))
+    pm = api2.node.partition_of("rk")
+    assert pm.device is not None
+    vals, _ = api2.read_objects_static(ct, [("rk", "counter_pn", "b"),
+                                            ("rs", "set_aw", "b")])
+    assert vals[0] == 20
+    assert sorted(vals[1]) == sorted(f"x{i}" for i in range(10))
+    # and the device plane (not the host store) owns the keys
+    assert pm.device.owns("counter_pn", "rk") or \
+        api2.node.partition_of("rs").device.owns("set_aw", "rs")
+    api2.close()

@@ -61,7 +61,7 @@ class FakePartition:
                 raise RuntimeError("mocked vnode crash")
         return self.prepare_time
 
-    def commit(self, txid, commit_time, snapshot_vc):
+    def commit(self, txid, commit_time, snapshot_vc, certified=True):
         self.calls.append(("commit", txid, commit_time))
         self.staged.pop(txid, None)
 
@@ -102,6 +102,7 @@ class FakeNode:
         self.bcounter_mgr = None
         self.stable_vc = lambda: VC({self.dc_id: self.clock.t})
         self.wait_hook = lambda: None
+        self.mint_dot = lambda: ("dcM", self.clock.now_us())
 
     def partition_index(self, key):
         if isinstance(key, int):
