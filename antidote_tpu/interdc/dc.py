@@ -16,6 +16,7 @@ processes (SURVEY §2.7, §7).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -30,7 +31,7 @@ from antidote_tpu.interdc import query as idc_query
 from antidote_tpu.interdc.dep import DependencyGate
 from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
-from antidote_tpu.interdc.transport import InboxWorker, Transport
+from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
 from antidote_tpu.interdc.wire import DcDescriptor, InterDcTxn
 from antidote_tpu.meta.gossip import StableTimeTracker
 from antidote_tpu.meta.stable_store import StableMetaData
@@ -96,18 +97,30 @@ class DataCenter(AntidoteTPU):
         self._staleness: Optional[stats.StalenessSampler] = None
         node.bcounter_mgr = BCounterMgr(self)
 
-        # re-join DCs we knew before a restart
+        # re-join DCs we knew before a restart; an unreachable peer must
+        # not kill the boot (whole-cluster crash: someone restarts first)
+        # — the heartbeat ticker retries until it comes back (reference
+        # retry loop, src/inter_dc_manager.erl:87-109)
+        self._retry_descs: List[DcDescriptor] = []
         for desc in (self.meta.get("connected_descriptors") or []):
-            self._connect(desc)
+            try:
+                self._connect(desc)
+            except LinkDown:
+                logging.getLogger(__name__).warning(
+                    "restart re-join: %r unreachable, will retry",
+                    desc.dc_id)
+                self._retry_descs.append(desc)
         self.meta.mark_started()
 
     # ---------------------------------------------------------- membership
 
     def descriptor(self) -> DcDescriptor:
+        addrs = self.bus.local_addrs()
+        pub = addrs[0] if addrs else (self.node.dc_id,)
+        logreader = addrs[1] if addrs else (self.node.dc_id,)
         return DcDescriptor(dc_id=self.node.dc_id,
                             n_partitions=self.node.config.n_partitions,
-                            pub_addrs=(self.node.dc_id,),
-                            logreader_addrs=(self.node.dc_id,))
+                            pub_addrs=pub, logreader_addrs=logreader)
 
     def observe_dc(self, desc: DcDescriptor) -> None:
         """Subscribe to a remote DC (reference inter_dc_manager:observe_dc,
@@ -126,7 +139,11 @@ class DataCenter(AntidoteTPU):
     def _connect(self, desc: DcDescriptor) -> None:
         if desc.dc_id in self.connected_dcs:
             return
-        self.connected_dcs.append(desc.dc_id)
+        # transport-level subscription first (dial + probe for TCP; no-op
+        # in-proc) so a dead peer fails before we commit membership state
+        self.bus.connect(self.node.dc_id, desc)
+        # sub_bufs before connected_dcs: the subscription is live, and a
+        # frame passing the connected-guard must find its buffer
         for p in range(self.node.config.n_partitions):
             self.sub_bufs[(desc.dc_id, p)] = SubBuf(
                 desc.dc_id, p,
@@ -136,6 +153,7 @@ class DataCenter(AntidoteTPU):
                 # left off (reference src/inter_dc_sub_buf.erl:58-76)
                 last_opid=self.node.partitions[p].log.op_counters.get(
                     desc.dc_id, 0))
+        self.connected_dcs.append(desc.dc_id)
         for s in self.senders:
             s.enabled = True
 
@@ -184,7 +202,16 @@ class DataCenter(AntidoteTPU):
 
     def tick_heartbeats(self) -> None:
         """One heartbeat round: each partition broadcasts its min-prepared
-        time (reference 1 s ping, src/inter_dc_log_sender_vnode.erl:133-143)."""
+        time (reference 1 s ping, src/inter_dc_log_sender_vnode.erl:133-143).
+        Also retries peers that were unreachable at restart re-join."""
+        if self._retry_descs:
+            still = []
+            for desc in self._retry_descs:
+                try:
+                    self._connect(desc)
+                except LinkDown:
+                    still.append(desc)
+            self._retry_descs = still
         for p, sender in enumerate(self.senders):
             sender.ping(self.node.partitions[p].min_prepared())
 
@@ -201,7 +228,16 @@ class DataCenter(AntidoteTPU):
     # ----------------------------------------------------------- inbound
 
     def _deliver(self, data: bytes) -> None:
-        txn = InterDcTxn.from_bin(data)
+        try:
+            txn = InterDcTxn.from_bin(data)
+        except ValueError:
+            # frames arrive from other administrative domains over the
+            # network: a malformed one is dropped (and logged), never
+            # allowed to kill the delivery worker — the opid watermark
+            # treats it as loss and gap repair re-fetches
+            logging.getLogger(__name__).warning(
+                "dropping malformed inter-DC frame (%d bytes)", len(data))
+            return
         # one-at-a-time delivery: the background worker and wait-hook
         # pumps may race, but sub_bufs/dep gates assume a single writer
         # (the reference gets this from one gen_server per buffer)
@@ -210,7 +246,10 @@ class DataCenter(AntidoteTPU):
                 return  # not subscribed to this origin
             if txn.is_ping() and self.drop_ping:
                 return
-            self.sub_bufs[(txn.dc_id, txn.partition)].process(txn)
+            buf = self.sub_bufs.get((txn.dc_id, txn.partition))
+            if buf is None:
+                return  # connect raced the stream; repair catches up
+            buf.process(txn)
 
     def _make_gate_deliver(self, p: int):
         def deliver(txn: InterDcTxn) -> None:

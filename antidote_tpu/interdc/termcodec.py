@@ -1,0 +1,224 @@
+"""Safe binary term codec for the inter-DC wire.
+
+The reference ships Erlang external term format over ZeroMQ
+(term_to_binary, reference src/inter_dc_txn.erl:95-105) — safe because
+binary_to_term of data terms executes nothing.  The Python analogue
+pickle is NOT safe (a malicious peer DC frame would be remote code
+execution), so everything that crosses a DC boundary — txn frames, log
+records, query requests/responses — uses this explicit tagged codec
+instead: data in, data out, nothing executable.
+
+Supported terms: None, bool, int (arbitrary precision), float, bytes,
+str, tuple, list, dict, set, frozenset, VC, OpId, LogRecord, InterDcTxn
+— exact round-trip (a frozenset decodes as a frozenset, a VC as a VC),
+which matters because CRDT effects embed these types structurally.
+
+Wire safety limits: frames cap at MAX_TERM_BYTES and nesting at
+MAX_DEPTH so a hostile frame cannot commit the decoder to unbounded
+work before the gap-repair layer even sees it.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List, Tuple
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.oplog.records import LogRecord, OpId
+
+MAX_TERM_BYTES = 64 * 1024 * 1024
+MAX_DEPTH = 64
+
+_T_NONE = b"N"
+_T_TRUE = b"T"
+_T_FALSE = b"F"
+_T_INT = b"i"      # length-prefixed signed big-endian (arbitrary precision)
+_T_FLOAT = b"f"    # IEEE double
+_T_BYTES = b"b"
+_T_STR = b"s"
+_T_TUPLE = b"t"
+_T_LIST = b"l"
+_T_SET = b"e"
+_T_FROZENSET = b"z"
+_T_DICT = b"d"
+_T_VC = b"V"
+_T_OPID = b"O"
+_T_RECORD = b"R"
+_T_TXN = b"X"
+
+
+class TermDecodeError(ValueError):
+    """Malformed or hostile term frame."""
+
+
+def encode(v: Any) -> bytes:
+    out: List[bytes] = []
+    _enc(v, out, 0)
+    return b"".join(out)
+
+
+def _u32(n: int) -> bytes:
+    return struct.pack(">I", n)
+
+
+def _enc(v: Any, out: List[bytes], depth: int) -> None:
+    if depth > MAX_DEPTH:
+        raise ValueError("term nesting too deep to encode")
+    # exact-type dispatch where subclassing matters (VC is a dict, bool
+    # is an int): check the special cases first
+    if v is None:
+        out.append(_T_NONE)
+    elif v is True:
+        out.append(_T_TRUE)
+    elif v is False:
+        out.append(_T_FALSE)
+    elif isinstance(v, VC):
+        out.append(_T_VC)
+        _enc_seq(sorted(v.items(), key=lambda kv: repr(kv[0])), out, depth)
+    elif isinstance(v, int):
+        raw = v.to_bytes((v.bit_length() + 8) // 8 or 1, "big", signed=True)
+        out.append(_T_INT + _u32(len(raw)) + raw)
+    elif isinstance(v, float):
+        out.append(_T_FLOAT + struct.pack(">d", v))
+    elif isinstance(v, bytes):
+        out.append(_T_BYTES + _u32(len(v)) + v)
+    elif isinstance(v, str):
+        raw = v.encode("utf-8")
+        out.append(_T_STR + _u32(len(raw)) + raw)
+    elif isinstance(v, OpId):
+        out.append(_T_OPID)
+        _enc_seq((v.dc, v.n), out, depth)
+    elif isinstance(v, LogRecord):
+        out.append(_T_RECORD)
+        _enc_seq((v.op_id, v.txid, v.payload), out, depth)
+    elif type(v).__name__ == "InterDcTxn":
+        out.append(_T_TXN)
+        _enc_seq((v.dc_id, v.partition, v.prev_log_opid, v.snapshot_vc,
+                  v.timestamp, tuple(v.records)), out, depth)
+    elif isinstance(v, tuple):
+        out.append(_T_TUPLE)
+        _enc_seq(v, out, depth)
+    elif isinstance(v, list):
+        out.append(_T_LIST)
+        _enc_seq(v, out, depth)
+    elif isinstance(v, frozenset):
+        out.append(_T_FROZENSET)
+        _enc_seq(sorted(v, key=repr), out, depth)
+    elif isinstance(v, set):
+        out.append(_T_SET)
+        _enc_seq(sorted(v, key=repr), out, depth)
+    elif isinstance(v, dict):
+        out.append(_T_DICT)
+        _enc_seq([x for kv in sorted(v.items(), key=lambda kv: repr(kv[0]))
+                  for x in kv], out, depth)
+    else:
+        raise TypeError(
+            f"cannot encode {type(v).__name__} for the inter-DC wire")
+
+
+def _enc_seq(items, out: List[bytes], depth: int) -> None:
+    items = list(items)
+    out.append(_u32(len(items)))
+    for item in items:
+        _enc(item, out, depth + 1)
+
+
+def decode(data: bytes) -> Any:
+    if len(data) > MAX_TERM_BYTES:
+        raise TermDecodeError("term frame exceeds size cap")
+    v, pos = _dec(data, 0, 0)
+    if pos != len(data):
+        raise TermDecodeError("trailing bytes after term")
+    return v
+
+
+def _need(data: bytes, pos: int, n: int) -> None:
+    if pos + n > len(data):
+        raise TermDecodeError("truncated term")
+
+
+def _dec(data: bytes, pos: int, depth: int) -> Tuple[Any, int]:
+    if depth > MAX_DEPTH:
+        raise TermDecodeError("term nesting too deep")
+    _need(data, pos, 1)
+    tag = data[pos:pos + 1]
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_FLOAT:
+        _need(data, pos, 8)
+        return struct.unpack(">d", data[pos:pos + 8])[0], pos + 8
+    if tag in (_T_INT, _T_BYTES, _T_STR):
+        _need(data, pos, 4)
+        (n,) = struct.unpack(">I", data[pos:pos + 4])
+        pos += 4
+        _need(data, pos, n)
+        raw = data[pos:pos + n]
+        pos += n
+        if tag == _T_INT:
+            return int.from_bytes(raw, "big", signed=True), pos
+        if tag == _T_BYTES:
+            return bytes(raw), pos
+        try:
+            return raw.decode("utf-8"), pos
+        except UnicodeDecodeError as e:
+            raise TermDecodeError("bad utf-8 in str term") from e
+    if tag in (_T_TUPLE, _T_LIST, _T_SET, _T_FROZENSET, _T_DICT,
+               _T_VC, _T_OPID, _T_RECORD, _T_TXN):
+        _need(data, pos, 4)
+        (n,) = struct.unpack(">I", data[pos:pos + 4])
+        pos += 4
+        if n > len(data) - pos:  # each item needs >= 1 byte
+            raise TermDecodeError("sequence length exceeds frame")
+        items = []
+        for _ in range(n):
+            item, pos = _dec(data, pos, depth + 1)
+            items.append(item)
+        if tag == _T_TUPLE:
+            return tuple(items), pos
+        if tag == _T_LIST:
+            return items, pos
+        if tag == _T_SET:
+            return set(items), pos
+        if tag == _T_FROZENSET:
+            return frozenset(items), pos
+        if tag == _T_DICT:
+            if n % 2:
+                raise TermDecodeError("odd dict item count")
+            return {items[i]: items[i + 1] for i in range(0, n, 2)}, pos
+        if tag == _T_VC:
+            if any(not (isinstance(kv, tuple) and len(kv) == 2
+                        and isinstance(kv[1], int)) for kv in items):
+                raise TermDecodeError("bad VC entry")
+            return VC({k: v for k, v in items}), pos
+        if tag == _T_OPID:
+            if n != 2 or not isinstance(items[1], int):
+                raise TermDecodeError("bad OpId shape")
+            return OpId(items[0], items[1]), pos
+        if tag == _T_RECORD:
+            if n != 3 or not isinstance(items[0], OpId) \
+                    or not isinstance(items[2], tuple):
+                raise TermDecodeError("bad LogRecord shape")
+            return LogRecord(items[0], items[1], items[2]), pos
+        # _T_TXN
+        from antidote_tpu.interdc.wire import InterDcTxn
+
+        if n != 6:
+            raise TermDecodeError("bad InterDcTxn arity")
+        dc_id, partition, prev, svc, ts, records = items
+        if svc is not None and not isinstance(svc, VC):
+            raise TermDecodeError("bad snapshot_vc")
+        if not (isinstance(partition, int) and isinstance(prev, int)
+                and isinstance(ts, int)):
+            raise TermDecodeError("bad InterDcTxn field types")
+        if not isinstance(records, (tuple, list)) or any(
+                not isinstance(r, LogRecord) for r in records):
+            raise TermDecodeError("bad records")
+        return InterDcTxn(dc_id=dc_id, partition=partition,
+                          prev_log_opid=prev, snapshot_vc=svc,
+                          timestamp=ts, records=list(records)), pos
+    raise TermDecodeError(f"unknown term tag {tag!r}")

@@ -41,6 +41,16 @@ class Transport:
         target is unreachable."""
         raise NotImplementedError
 
+    def connect(self, origin, desc: DcDescriptor) -> None:
+        """Subscribe ``origin`` to a peer's streams.  The in-process bus
+        delivers to every registered DC, so this is a no-op there; the
+        TCP transport dials the peer's listeners here."""
+
+    def local_addrs(self):
+        """((pub_addr, ...), (logreader_addr, ...)) for this endpoint's
+        descriptor, or None when addressing is by registry key (in-proc)."""
+        return None
+
 
 class InProcBus(Transport):
     """Registry of DCs in one process.
@@ -156,7 +166,16 @@ class InboxWorker:
                 data = self.inbox.get(timeout=0.05)
             except queue.Empty:
                 continue
-            self.deliver(data)
+            try:
+                self.deliver(data)
+            except Exception:  # noqa: BLE001 — the delivery worker is
+                # the DC's only inbound path; one bad frame or handler
+                # bug must not halt all replication (pump() stays
+                # unguarded so deterministic tests surface errors)
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "inbound frame delivery failed")
 
     def pump(self, max_frames: int = 100000) -> int:
         """Drain synchronously (deterministic mode); returns frames handled."""

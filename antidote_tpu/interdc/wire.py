@@ -19,7 +19,6 @@ Gap repair compares exactly these two numbers
 
 from __future__ import annotations
 
-import pickle
 import struct
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
@@ -94,13 +93,20 @@ class InterDcTxn:
     # -------------------------------------------------------------- bytes
 
     def to_bin(self) -> bytes:
-        """Topic prefix + serialized body (src/inter_dc_txn.erl:95-105)."""
-        return partition_prefix(self.partition) + pickle.dumps(
-            self, protocol=pickle.HIGHEST_PROTOCOL)
+        """Topic prefix + serialized body (src/inter_dc_txn.erl:95-105).
+
+        The body is the safe tagged term codec, NOT pickle: frames
+        arrive from other DCs over the network, and decoding them must
+        never execute anything (antidote_tpu/interdc/termcodec.py)."""
+        from antidote_tpu.interdc import termcodec
+
+        return partition_prefix(self.partition) + termcodec.encode(self)
 
     @staticmethod
     def from_bin(data: bytes) -> "InterDcTxn":
-        txn = pickle.loads(data[PARTITION_PREFIX_LEN:])
+        from antidote_tpu.interdc import termcodec
+
+        txn = termcodec.decode(bytes(data[PARTITION_PREFIX_LEN:]))
         if not isinstance(txn, InterDcTxn):
             raise ValueError("corrupt inter-DC txn frame")
         return txn

@@ -1,0 +1,260 @@
+"""TCP transport: in-process socket cluster + true cross-process DCs.
+
+The reference's multi-DC tier runs ct_slave peers with real ZMQ sockets
+on one host (reference test/utils/test_utils.erl:110-165, TESTING.md);
+here tier 1 forms a cluster of DataCenters over real TCP sockets inside
+one process, and tier 2 spawns separate OS processes (dc_proc.py) and
+exercises replication, crash-kill, restart recovery, and gap repair
+across them.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from antidote_tpu.config import Config
+from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+from antidote_tpu.interdc.tcp import TcpTransport
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def tcp_cluster2(tmp_path):
+    dcs = []
+    for i in range(2):
+        bus = TcpTransport()
+        dc = DataCenter(f"dc{i + 1}", bus,
+                        config=Config(n_partitions=2, heartbeat_s=0.02,
+                                      clock_wait_timeout_s=10.0),
+                        data_dir=str(tmp_path / f"dc{i + 1}"))
+        dcs.append(dc)
+    connect_dcs(dcs)
+    for dc in dcs:
+        dc.start_bg_processes()
+    yield dcs
+    for dc in dcs:
+        dc.close()
+        dc.bus.close()
+
+
+class TestTcpInProcess:
+    def test_descriptor_carries_socket_addrs(self, tcp_cluster2):
+        d = tcp_cluster2[0].descriptor()
+        (host, port), = d.pub_addrs
+        assert host == "127.0.0.1" and port > 0
+
+    def test_counter_replicates_over_sockets(self, tcp_cluster2):
+        dc1, dc2 = tcp_cluster2
+        ct = None
+        for _ in range(5):
+            ct = dc1.update_objects_static(
+                ct, [(("tk", "counter_pn", "b"), "increment", 1)])
+        vals, _ = dc2.read_objects_static(ct, [("tk", "counter_pn", "b")])
+        assert vals[0] == 5
+
+    def test_orset_replicates_and_merges(self, tcp_cluster2):
+        dc1, dc2 = tcp_cluster2
+        ct1 = dc1.update_objects_static(
+            None, [(("ts", "set_aw", "b"), "add_all", ["a", "b"])])
+        ct2 = dc2.update_objects_static(
+            ct1, [(("ts", "set_aw", "b"), "remove", "a")])
+        vals, _ = dc1.read_objects_static(ct2, [("ts", "set_aw", "b")])
+        assert vals[0] == ["b"]
+
+    def test_log_repair_rpc_over_sockets(self, tcp_cluster2):
+        """The request channel answers log-range reads cross-socket."""
+        from antidote_tpu.interdc import query as idc_query
+
+        dc1, dc2 = tcp_cluster2
+        ct = dc1.update_objects_static(
+            None, [(("rk", "counter_pn", "b"), "increment", 7)])
+        # ask dc1 for its whole stream on the partition of "rk"
+        p = dc1.node.partition_index("rk")
+        txns = idc_query.fetch_log_range(
+            dc2.bus, "dc2", "dc1", p, 1, 10 ** 9)
+        assert txns and any(not t.is_ping() for t in txns)
+
+
+class Proc:
+    """Driver for one dc_proc.py subprocess."""
+
+    def __init__(self, dc_id, data_dir, pub_port, query_port):
+        self.args = [sys.executable,
+                     os.path.join(os.path.dirname(__file__), "dc_proc.py"),
+                     dc_id, data_dir, str(pub_port), str(query_port)]
+        self.p = None
+        self.start()
+
+    def start(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        self.p = subprocess.Popen(
+            self.args, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env)
+        assert self.recv().get("ready")
+
+    def send(self, obj, timeout=60):
+        self.p.stdin.write(json.dumps(obj) + "\n")
+        self.p.stdin.flush()
+        return self.recv(timeout)
+
+    def recv(self, timeout=60):
+        line = self.p.stdout.readline()
+        if not line:
+            raise RuntimeError("dc_proc died")
+        return json.loads(line)
+
+    def kill_hard(self):
+        try:
+            self.p.stdin.write(json.dumps({"cmd": "kill"}) + "\n")
+            self.p.stdin.flush()
+        except (BrokenPipeError, OSError):
+            pass
+        self.p.wait(timeout=10)
+
+    def stop(self):
+        if self.p.poll() is None:
+            try:
+                self.send({"cmd": "exit"}, timeout=10)
+            except Exception:
+                self.p.kill()
+            self.p.wait(timeout=10)
+
+
+@pytest.fixture
+def procs2(tmp_path):
+    ports = [(free_port(), free_port()) for _ in range(2)]
+    ps = [Proc(f"dc{i + 1}", str(tmp_path / f"dc{i + 1}"),
+               ports[i][0], ports[i][1])
+          for i in range(2)]
+    yield ps, ports
+    for p in ps:
+        p.stop()
+
+
+def _connect_mesh(ps):
+    descs = [p.send({"cmd": "descriptor"})["desc"] for p in ps]
+    for i, p in enumerate(ps):
+        for j, d in enumerate(descs):
+            if i != j:
+                r = p.send({"cmd": "connect", "desc": d})
+                assert r.get("ok"), r
+
+
+class TestCrossProcess:
+    def test_two_process_cluster_replicates(self, procs2):
+        ps, _ = procs2
+        _connect_mesh(ps)
+        r = ps[0].send({"cmd": "update", "key": "xk", "type": "counter_pn",
+                        "op": "increment", "arg": 3})
+        ct = r["clock"]
+        r = ps[0].send({"cmd": "update", "key": "xs", "type": "set_aw",
+                        "op": "add", "arg": "elem1", "clock": ct})
+        ct = r["clock"]
+        r = ps[1].send({"cmd": "read", "key": "xk", "type": "counter_pn",
+                        "clock": ct})
+        assert r["value"] == 3, r
+        r = ps[1].send({"cmd": "read", "key": "xs", "type": "set_aw",
+                        "clock": ct})
+        assert r["value"] == ["elem1"], r
+
+    def test_kill_restart_recovers_and_repairs_gap(self, procs2):
+        """Crash-kill one DC mid-stream; its restart recovers from the
+        durable log and the opid gap-repair fetches what it missed
+        (reference multiple_dcs_node_failure_SUITE)."""
+        ps, ports = procs2
+        _connect_mesh(ps)
+        r = ps[0].send({"cmd": "update", "key": "gk", "type": "counter_pn",
+                        "op": "increment", "arg": 1})
+        ct = r["clock"]
+        # make sure dc2 saw the first update
+        r = ps[1].send({"cmd": "read", "key": "gk", "type": "counter_pn",
+                        "clock": ct})
+        assert r["value"] == 1
+
+        ps[1].kill_hard()
+        # dc1 keeps committing while dc2 is down — these frames are lost
+        # to dc2's dead subscription and must come back via gap repair
+        for _ in range(4):
+            r = ps[0].send({"cmd": "update", "key": "gk",
+                            "type": "counter_pn", "op": "increment",
+                            "arg": 1, "clock": ct})
+            ct = r["clock"]
+
+        ps[1].start()  # same ports, same data dir
+        _connect_mesh(ps)
+        r = ps[1].send({"cmd": "read", "key": "gk", "type": "counter_pn",
+                        "clock": ct}, timeout=120)
+        assert r["value"] == 5, r
+
+    def test_connect_retry_after_failed_probe(self, procs2):
+        """A connect attempt against a dead peer fails cleanly and a
+        retry after the peer is up establishes live replication (the
+        first failure must leave no stale transport state)."""
+        ps, ports = procs2
+        ps[1].kill_hard()
+        d1 = ps[0].send({"cmd": "descriptor"})["desc"]
+        dead_desc = ["dc2", 2, [["127.0.0.1", ports[1][0]]],
+                     [["127.0.0.1", ports[1][1]]]]
+        r = ps[0].send({"cmd": "connect", "desc": dead_desc})
+        assert "error" in r  # LinkDown surfaced, membership not committed
+        ps[1].start()
+        _connect_mesh(ps)
+        r = ps[0].send({"cmd": "update", "key": "pk", "type": "counter_pn",
+                        "op": "increment", "arg": 1})
+        r = ps[1].send({"cmd": "read", "key": "pk", "type": "counter_pn",
+                        "clock": r["clock"]})
+        assert r["value"] == 1
+
+    def test_restart_with_peer_down_boots_and_reconnects(self, procs2):
+        """Whole-cluster crash: the first DC to restart must boot even
+        though its persisted peer is unreachable, then reconnect once
+        the peer returns (retry via heartbeat ticker)."""
+        ps, _ = procs2
+        _connect_mesh(ps)
+        r = ps[0].send({"cmd": "update", "key": "wk", "type": "counter_pn",
+                        "op": "increment", "arg": 1})
+        ct = r["clock"]
+        ps[1].send({"cmd": "read", "key": "wk", "type": "counter_pn",
+                    "clock": ct})
+        ps[0].kill_hard()
+        ps[1].kill_hard()
+        ps[0].start()  # peer dc2 still down: boot must succeed
+        ps[1].start()
+        deadline = time.time() + 30
+        while True:  # heartbeat retry re-links automatically
+            r = ps[0].send({"cmd": "update", "key": "wk",
+                            "type": "counter_pn", "op": "increment",
+                            "arg": 1, "clock": ct})
+            ct = r["clock"]
+            r = ps[1].send({"cmd": "read", "key": "wk",
+                            "type": "counter_pn"})
+            if isinstance(r.get("value"), int) and r["value"] >= 2:
+                break
+            assert time.time() < deadline, r
+            time.sleep(0.3)
+
+    def test_surviving_dc_keeps_serving_during_peer_death(self, procs2):
+        ps, _ = procs2
+        _connect_mesh(ps)
+        r = ps[0].send({"cmd": "update", "key": "sk", "type": "counter_pn",
+                        "op": "increment", "arg": 2})
+        ct = r["clock"]
+        ps[1].kill_hard()
+        r = ps[0].send({"cmd": "update", "key": "sk", "type": "counter_pn",
+                        "op": "increment", "arg": 2, "clock": ct})
+        ct = r["clock"]
+        r = ps[0].send({"cmd": "read", "key": "sk", "type": "counter_pn",
+                        "clock": ct})
+        assert r["value"] == 4

@@ -1,0 +1,79 @@
+"""Wire term codec: exact round-trip + hostile-frame rejection."""
+
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.interdc import termcodec
+from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.oplog.records import (
+    LogRecord,
+    OpId,
+    commit_record,
+    update_record,
+)
+
+
+CASES = [
+    None, True, False, 0, -1, 2 ** 80, -(2 ** 80), 3.5, b"", b"\x00\xff",
+    "", "héllo", (), (1, "a", (b"x",)), [], [1, [2]], {}, {"k": 1, 2: "v"},
+    set(), {1, 2}, frozenset(), frozenset({("dc1", 5)}),
+    VC({"dc1": 10, "dc2": 3}),
+    OpId("dc1", 7),
+    update_record(OpId("dc1", 1), ("t", "x"), "key", "set_aw",
+                  ("add", (("e", ("dc1", 5), ()),))),
+    commit_record(OpId("dc1", 2), ("t", "x"), "dc1", 123,
+                  VC({"dc1": 120}), False),
+]
+
+
+@pytest.mark.parametrize("value", CASES, ids=[repr(c)[:40] for c in CASES])
+def test_roundtrip_exact(value):
+    out = termcodec.decode(termcodec.encode(value))
+    assert out == value
+    assert type(out) is type(value)
+
+
+def test_interdc_txn_roundtrip():
+    recs = [
+        update_record(OpId("dc1", 1), "t1", "k", "counter_pn", 5),
+        commit_record(OpId("dc1", 2), "t1", "dc1", 99, VC({"dc1": 98})),
+    ]
+    txn = InterDcTxn.from_ops("dc1", 3, 0, recs)
+    out = InterDcTxn.from_bin(txn.to_bin())
+    assert out.dc_id == "dc1" and out.partition == 3
+    assert out.snapshot_vc == VC({"dc1": 98}) and out.timestamp == 99
+    assert out.records == recs
+    assert out.last_opid() == 2
+
+
+def test_nested_effect_roundtrip():
+    eff = ("add", (("elem", ("dc1", 42), (("dc1", 40), ("dc2", 7))),))
+    assert termcodec.decode(termcodec.encode(eff)) == eff
+
+
+@pytest.mark.parametrize("frame", [
+    b"", b"Q", b"i\x00\x00\x00\x08\x01",        # unknown tag / truncated
+    b"t\xff\xff\xff\xff",                        # absurd sequence length
+    b"d\x00\x00\x00\x01N",                       # odd dict arity
+    b"s\x00\x00\x00\x02\xff\xfe",                # bad utf-8
+    b"NN",                                       # trailing bytes
+])
+def test_hostile_frames_rejected(frame):
+    with pytest.raises(ValueError):
+        termcodec.decode(frame)
+
+
+def test_depth_cap():
+    v = ()
+    for _ in range(termcodec.MAX_DEPTH + 2):
+        v = (v,)
+    with pytest.raises(ValueError):
+        termcodec.encode(v)
+
+
+def test_no_pickle_on_the_wire():
+    """A pickle frame must not decode (the RCE vector the codec closes)."""
+    import pickle
+
+    with pytest.raises(ValueError):
+        termcodec.decode(pickle.dumps({"a": 1}))
