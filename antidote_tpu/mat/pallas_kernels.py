@@ -34,6 +34,64 @@ from jax.experimental.pallas import tpu as pltpu
 _Z = np.int32(0)
 
 
+def _orset_read_core(dots, elem_slot, is_add, dot_dc, dot_seq, obs,
+                     op_dc, op_ct, ss, valid, base, has_base, read):
+    """Shared kernel body: inclusion test + ORSWOT fold + presence, all
+    on VMEM-resident [TK, ...] blocks.  ``base``/``read``: [D];
+    ``has_base``: scalar int32."""
+    tk, e, d = dots.shape
+    l = elem_slot.shape[1]
+
+    dc_cols = jax.lax.broadcasted_iota(jnp.int32, (tk, l, d), 2)
+    at_dc = dc_cols == op_dc[:, :, None]
+    cvc = jnp.where(at_dc, jnp.maximum(ss, op_ct[:, :, None]), ss)
+
+    base = base[None, None, :]                          # [1, 1, D]
+    read = read[None, None, :]
+    # bool all-reduce lowers as a float min on this mosaic version; an
+    # int32 min-reduce compiles cleanly
+    all2 = lambda c: jnp.min(
+        jnp.where(c, np.int32(1), _Z), axis=2) == np.int32(1)
+    covered = all2(cvc <= base) & (has_base != _Z)
+    included = all2(cvc <= read)
+    mask = (valid != _Z) & ~covered & included          # [TK, L]
+    add_mask = mask & (is_add != _Z)
+
+    # The fold runs on FLAT [TK, E*D] tiles: mosaic rejects the
+    # (TK,1,1)->(TK,E,D) broadcasts the nested-axis form needs (vpad
+    # {0,0}->{*,*} on both minor dims), while (TK,1)->(TK,E*D) lane
+    # broadcasts and minor-dim concats lower cleanly — and a flat minor
+    # dim of E*D (e.g. 64) uses the 128-lane VPU far better than D=8.
+    ed = e * d
+    d_row = jax.lax.broadcasted_iota(jnp.int32, (tk, d), 1)
+    d_col = jnp.concatenate([d_row] * e, axis=1)        # [TK, E*D]
+    e_col = jnp.concatenate(
+        [jnp.full((tk, d), np.int32(j)) for j in range(e)], axis=1)
+
+    last_seq = jnp.zeros((tk, ed), jnp.int32)
+    max_obs = jnp.zeros((tk, ed), jnp.int32)
+    for i in range(l):                                  # static unroll
+        at_e = e_col == elem_slot[:, i][:, None]
+        at_d = d_col == dot_dc[:, i][:, None]
+        seq_i = jnp.where(at_e & at_d & add_mask[:, i][:, None],
+                          dot_seq[:, i][:, None], _Z)
+        last_seq = jnp.maximum(last_seq, seq_i)
+        obs_i = jnp.concatenate([obs[:, i, :]] * e, axis=1)
+        max_obs = jnp.maximum(
+            max_obs, jnp.where(at_e & mask[:, i][:, None], obs_i, _Z))
+
+    # flatten dots by column-wise concat — mosaic has no 3D->2D reshape
+    dots_flat = jnp.concatenate(
+        [dots[:, j, :] for j in range(e)], axis=1)      # [TK, E*D]
+    merged = jnp.maximum(dots_flat, last_seq)
+    live = jnp.where(merged > max_obs, merged, _Z)
+    # presence = max over each key's d-chunk, assembled column-wise so
+    # every op stays 2D
+    return jnp.concatenate(
+        [jnp.max(live[:, j * d:(j + 1) * d], axis=1, keepdims=True)
+         for j in range(e)], axis=1)                    # >0 iff present
+
+
 def _orset_read_kernel(
     dots_ref,       # [TK, E, D]
     elem_ref,       # [TK, L]
@@ -50,49 +108,11 @@ def _orset_read_kernel(
     read_ref,       # [1, D]
     out_ref,        # [TK, E]
 ):
-    tk, e, d = dots_ref.shape
-    l = elem_ref.shape[1]
-
-    ss = op_ss_ref[:]                                   # [TK, L, D]
-    dc_cols = jax.lax.broadcasted_iota(jnp.int32, (tk, l, d), 2)
-    at_dc = dc_cols == op_dc_ref[:][:, :, None]
-    cvc = jnp.where(at_dc, jnp.maximum(ss, op_ct_ref[:][:, :, None]), ss)
-
-    base = base_ref[0][None, None, :]                   # [1, 1, D]
-    read = read_ref[0][None, None, :]
-    # bool all-reduce lowers as a float min on this mosaic version; an
-    # int32 min-reduce compiles cleanly
-    all2 = lambda c: jnp.min(
-        jnp.where(c, np.int32(1), _Z), axis=2) == np.int32(1)
-    covered = all2(cvc <= base) & (has_base_ref[0, 0] != _Z)
-    included = all2(cvc <= read)
-    mask = (valid_ref[:] != _Z) & ~covered & included   # [TK, L]
-    add_mask = mask & (is_add_ref[:] != _Z)
-
-    obs = obs_ref[:]
-    elem_slot = elem_ref[:]
-    dot_dc = dot_dc_ref[:]
-    dot_seq = dot_seq_ref[:]
-
-    last_seq = jnp.zeros((tk, e, d), jnp.int32)
-    max_obs = jnp.zeros((tk, e, d), jnp.int32)
-    e_ids = jax.lax.broadcasted_iota(jnp.int32, (tk, e, d), 1)
-    d_ids = jax.lax.broadcasted_iota(jnp.int32, (tk, e, d), 2)
-    for i in range(l):                                  # static unroll
-        at_e = e_ids == elem_slot[:, i][:, None, None]
-        at_d = d_ids == dot_dc[:, i][:, None, None]
-        seq_i = jnp.where(
-            at_e & at_d & add_mask[:, i][:, None, None],
-            dot_seq[:, i][:, None, None], _Z)
-        last_seq = jnp.maximum(last_seq, seq_i)
-        obs_i = jnp.where(
-            at_e & mask[:, i][:, None, None],
-            obs[:, i, :][:, None, :], _Z)
-        max_obs = jnp.maximum(max_obs, obs_i)
-
-    merged = jnp.maximum(dots_ref[:], last_seq)
-    live = jnp.where(merged > max_obs, merged, _Z)
-    out_ref[:] = jnp.max(live, axis=2)                  # >0 iff present
+    out_ref[:] = _orset_read_core(
+        dots_ref[:], elem_ref[:], is_add_ref[:], dot_dc_ref[:],
+        dot_seq_ref[:], obs_ref[:], op_dc_ref[:], op_ct_ref[:],
+        op_ss_ref[:], valid_ref[:], base_ref[0], has_base_ref[0, 0],
+        read_ref[0])
 
 
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
@@ -138,6 +158,67 @@ def orset_read_fused(
     )(
         i32(dots), i32(elem_slot), i32(is_add), i32(dot_dc), i32(dot_seq),
         i32(obs_vv), i32(op_dc), i32(op_ct), i32(op_ss), i32(valid),
+        i32(base_vc)[None, :], i32(has_base).reshape(1, 1),
+        i32(read_vc)[None, :],
+    )
+    return out > 0
+
+
+def _orset_read_packed_kernel(
+    dots_ref,       # [TK, E, D]
+    ops_ref,        # [TK, L, F]  packed store rows (F = 6 + 2D)
+    valid_ref,      # [TK, L]
+    base_ref,       # [1, D]
+    has_base_ref,   # [1, 1] (SMEM)
+    read_ref,       # [1, D]
+    out_ref,        # [TK, E]
+):
+    d = dots_ref.shape[2]
+    o = ops_ref[:]
+    # column extraction happens in VMEM — the packed layout is read from
+    # HBM exactly once (the whole point of this variant; the unpacked
+    # entry materializes ten per-field slices in HBM first)
+    out_ref[:] = _orset_read_core(
+        dots_ref[:], o[:, :, 0], o[:, :, 1], o[:, :, 2], o[:, :, 3],
+        o[:, :, 6:6 + d], o[:, :, 4], o[:, :, 5], o[:, :, 6 + d:6 + 2 * d],
+        valid_ref[:], base_ref[0], has_base_ref[0, 0], read_ref[0])
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def orset_read_packed(dots, ops, valid, base_vc, has_base, read_vc,
+                      block_k: int = 2048, interpret: bool = False):
+    """bool[K, E]: full-shard presence read straight off the packed
+    store layout (antidote_tpu/mat/store.py OrsetShardState.ops), one
+    HBM pass.  ``ops``: int[K*L, F] with the store's column order
+    [elem, is_add, dot_dc, dot_seq, op_dc, op_ct, obs(D), ss(D)];
+    ``valid``: bool[K*L]."""
+    k, e, d = dots.shape
+    f = ops.shape[-1]
+    l = ops.shape[0] // k
+    i32 = lambda a: a.astype(jnp.int32)
+    grid = (pl.cdiv(k, block_k),)
+    row = lambda i: (i, _Z)
+    row3 = lambda i: (i, _Z, _Z)
+    bspec = lambda shp, ix: pl.BlockSpec(shp, ix, memory_space=pltpu.VMEM)
+    rep = lambda shp: pl.BlockSpec(
+        shp, lambda i: (_Z,) * len(shp), memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _orset_read_packed_kernel,
+        grid=grid,
+        in_specs=[
+            bspec((block_k, e, d), row3),
+            bspec((block_k, l, f), row3),
+            bspec((block_k, l), row),
+            rep((1, d)),
+            pl.BlockSpec((1, 1), lambda i: (_Z, _Z),
+                         memory_space=pltpu.SMEM),
+            rep((1, d)),
+        ],
+        out_specs=bspec((block_k, e), row),
+        out_shape=jax.ShapeDtypeStruct((k, e), jnp.int32),
+        interpret=interpret,
+    )(
+        i32(dots), i32(ops).reshape(k, l, f), i32(valid).reshape(k, l),
         i32(base_vc)[None, :], i32(has_base).reshape(1, 1),
         i32(read_vc)[None, :],
     )

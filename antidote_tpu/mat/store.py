@@ -231,6 +231,33 @@ def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
     return kernels.orset_present(dots)
 
 
+def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
+                    fused: str | bool = "auto",
+                    block_k: int = 2048) -> jax.Array:
+    """bool[K, E]: full-shard presence read, flag-selecting the Pallas
+    fused kernel (antidote_tpu/mat/pallas_kernels.py orset_read_packed —
+    one HBM pass over the packed rows, nothing but the presence block
+    leaves VMEM) over the jnp reference path (:func:`orset_read`).
+
+    ``fused``: True / False / "auto" (fused on a TPU backend when the
+    shard's timestamps fit int32 — the Pallas path computes in int32, so
+    µs-int64 live shards must use the jnp path).
+    """
+    if fused == "auto":
+        fused = (st.ops.dtype == jnp.int32
+                 and jax.default_backend() == "tpu")
+    if not fused:
+        return orset_read(st, read_vc)
+    from antidote_tpu.mat import pallas_kernels
+
+    K = st.dots.shape[0]
+    interpret = jax.default_backend() != "tpu"
+    return pallas_kernels.orset_read_packed(
+        st.dots, st.ops, st.valid, st.base_vc, st.has_base,
+        read_vc.astype(st.ops.dtype),
+        block_k=min(block_k, K), interpret=interpret)
+
+
 @jax.jit
 def orset_read_keys(st: OrsetShardState, key_idx: jax.Array,
                     read_vc: jax.Array) -> jax.Array:

@@ -39,6 +39,58 @@ def test_matches_jnp_path(seed):
     assert (np.asarray(got) == want).all()
 
 
+def _filled_store(seed=4, K=192, B=384, D=8, n_dcs=3, gc_at=1, rounds=4):
+    rng = np.random.default_rng(seed)
+    clock = np.zeros(n_dcs, dtype=np.int32)
+    st = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=D,
+                                dtype=jnp.int32)
+    for i in range(rounds):
+        s = orset_batch(rng, K, B, D, n_dcs, clock, obs_lag=2)
+        lane = jnp.asarray(store.batch_lane_offsets(s["key_idx"]))
+        st, _ = store.orset_append(
+            st, jnp.asarray(s["key_idx"]), lane,
+            jnp.asarray(s["elem_slot"]), jnp.asarray(s["is_add"]),
+            jnp.asarray(s["dot_dc"]), jnp.asarray(s["dot_seq"]),
+            jnp.asarray(s["obs_vv"]), jnp.asarray(s["op_dc"]),
+            jnp.asarray(s["op_ct"]), jnp.asarray(s["op_ss"]))
+        if i == gc_at:
+            st = store.orset_gc(st, jnp.asarray(s["frontier"]))
+    return st, jnp.asarray(s["frontier"])
+
+
+@pytest.mark.parametrize("block_k", [64, 192])
+def test_store_integrated_fused_read(block_k):
+    """store.orset_read_full(fused=True) — the call the bench and any
+    bulk reader uses — matches the jnp reference path."""
+    st, read_vc = _filled_store()
+    want = reference_read(st, read_vc)
+    got = store.orset_read_full(st, read_vc, fused=True, block_k=block_k)
+    assert (np.asarray(got) == want).all()
+
+
+def test_fused_read_non_divisible_block():
+    """K not a multiple of block_k: the padded tail block's garbage is
+    dropped on the bounds-masked write (pins the padding contract)."""
+    st, read_vc = _filled_store(seed=11, K=200, B=256)
+    want = reference_read(st, read_vc)
+    got = store.orset_read_full(st, read_vc, fused=True, block_k=64)
+    assert np.asarray(got).shape == want.shape
+    assert (np.asarray(got) == want).all()
+
+
+def test_auto_falls_back_for_int64_shards():
+    """µs-int64 live shards must take the jnp path (int32 pallas math
+    would truncate timestamps)."""
+    st, read_vc = _filled_store(seed=2, K=64, B=128)
+    st64 = store.OrsetShardState(
+        dots=st.dots.astype(jnp.int64), base_vc=st.base_vc.astype(jnp.int64),
+        has_base=st.has_base, ops=st.ops.astype(jnp.int64),
+        valid=st.valid, n_lanes=st.n_lanes)
+    want = reference_read(st64, read_vc.astype(jnp.int64))
+    got = store.orset_read_full(st64, read_vc.astype(jnp.int64))
+    assert (np.asarray(got) == want).all()
+
+
 def test_with_base_snapshot_and_gc():
     K, B, D, n_dcs = 128, 256, 8, 3
     rng = np.random.default_rng(9)
