@@ -10,11 +10,15 @@ effects into the materializer store (:144-152).  Heartbeats just advance
 the origin's clock entry (:124-125).  Queues are processed to fixpoint
 whenever the clock advances (:96-117).
 
-``ready_mask`` is the batched device form of the same dominance test:
-at hundreds of DCs the queue-to-fixpoint walk is a dense [N, D] >= [D]
-reduction evaluated for every queued txn at once (the data-parallel
-iterate-until-stable named in SURVEY §7 hard-part (d)); the 256-DC GST
-convergence benchmark drives it.
+At a handful of DCs the fixpoint is a host walk over queue heads.  At
+hundreds of DCs (BASELINE config 5) the walk is the bottleneck, so past
+``batch_threshold`` queued txns the gate switches to the batched device
+form: every queued txn's dependency vector is packed into one dense
+[N, D] tensor and :func:`gate_fixpoint` runs the whole
+iterate-until-stable cascade — dominance test, per-origin FIFO prefix,
+watermark advance — as a ``lax.while_loop`` on device (the data-parallel
+fixpoint named in SURVEY §7 hard-part (d)).  One device round trip
+replaces O(rounds × queued) host VC comparisons.
 """
 
 from __future__ import annotations
@@ -22,12 +26,15 @@ from __future__ import annotations
 from collections import deque
 from typing import Any, Callable, Dict
 
+import numpy as np
+
 from antidote_tpu.clocks import VC
 from antidote_tpu.interdc.wire import InterDcTxn
 
 
 class DependencyGate:
-    def __init__(self, pm, own_dc, now_us: Callable[[], int]):
+    def __init__(self, pm, own_dc, now_us: Callable[[], int],
+                 batch_threshold: int = 48):
         self.pm = pm  # PartitionManager
         self.own_dc = own_dc
         self.now_us = now_us
@@ -40,6 +47,10 @@ class DependencyGate:
         #: tap invoked after the partition VC advances (feeds the
         #: stable-time tracker, throttled by the caller if needed)
         self.on_clock_update: Callable[[], None] = lambda: None
+        #: queued-txn count at which process_queues switches from the
+        #: host head-walk to the one-shot device fixpoint
+        self.batch_threshold = batch_threshold
+        self._last_proc_us = 0
 
     # ------------------------------------------------------------ clocks
 
@@ -55,12 +66,30 @@ class DependencyGate:
     # ------------------------------------------------------------- ingest
 
     def enqueue(self, txn: InterDcTxn) -> None:
-        self.queues.setdefault(txn.dc_id, deque()).append(txn)
+        q = self.queues.setdefault(txn.dc_id, deque())
+        q.append(txn)
+        # a txn landing behind its own origin's blocked head cannot
+        # change the fixpoint (FIFO: it only applies after the head, and
+        # the head's dependencies are unchanged) — skip the full
+        # reprocess for backlogged queues so ingest under a partition
+        # stays O(1) per frame, except for an occasional pass that picks
+        # up heads gated only on the advancing local wall clock
+        if len(q) > 1 and (self.now_us() - self._last_proc_us) < 50_000:
+            return
         self.process_queues()
 
     def process_queues(self) -> None:
         """Drain every origin queue to fixpoint: applying a txn (or ping)
         advances the clock, which may unblock other origins' heads."""
+        self._last_proc_us = self.now_us()
+        if self.pending() >= self.batch_threshold:
+            advanced = self._process_batched()
+        else:
+            advanced = self._process_host()
+        if advanced:
+            self.on_clock_update()
+
+    def _process_host(self) -> bool:
         advanced = False
         progress = True
         while progress:
@@ -80,8 +109,93 @@ class DependencyGate:
                         progress = advanced = True
                     else:
                         break
-        if advanced:
-            self.on_clock_update()
+        return advanced
+
+    def _process_batched(self) -> bool:
+        """One-shot device gating: pack every queued txn into dense
+        tensors, run :func:`gate_fixpoint`, then pop+apply the computed
+        FIFO prefixes in queue order.  Equivalent to the host walk (the
+        device fixpoint is the same monotone cascade, evaluated
+        data-parallel)."""
+        import jax.numpy as jnp
+
+        # dense columns: every DC named by a queued txn, the applied
+        # watermarks, and the local DC (whose entry reads `now`)
+        cols: Dict[Any, int] = {}
+
+        def col_of(dc):
+            if dc not in cols:
+                cols[dc] = len(cols)
+            return cols[dc]
+
+        col_of(self.own_dc)
+        for dc in self.applied_vc:
+            col_of(dc)
+        flat = []  # (origin, pos, txn)
+        for origin, q in self.queues.items():
+            col_of(origin)
+            for pos, txn in enumerate(q):
+                if not txn.is_ping():
+                    for dc in txn.snapshot_vc:
+                        col_of(dc)
+                flat.append((origin, pos, txn))
+        n = len(flat)
+        if n == 0:
+            return False
+        d = len(cols)
+        # pad to stable shapes so the jit cache stays small; padding rows
+        # are never ready (deps=+inf) and never block (pos=+inf/2)
+        n_pad = max(8, 1 << (n - 1).bit_length())
+        d_pad = max(8, 1 << (d - 1).bit_length())
+        BIG = np.int64(2**62)
+        ss = np.zeros((n_pad, d_pad), dtype=np.int64)
+        # padding rows must never be ready: the sentinel sits in column 1
+        # because gate_fixpoint zeroes each row's own origin column
+        # (padding origin_col is 0, which would erase a column-0 sentinel)
+        ss[n:, 1] = BIG
+        origin_col = np.zeros(n_pad, dtype=np.int32)
+        pos_arr = np.full(n_pad, np.iinfo(np.int32).max // 2, np.int32)
+        ts = np.zeros(n_pad, dtype=np.int64)
+        ping = np.zeros(n_pad, dtype=bool)
+        for i, (origin, pos, txn) in enumerate(flat):
+            origin_col[i] = cols[origin]
+            pos_arr[i] = pos
+            ts[i] = txn.timestamp
+            if txn.is_ping():
+                ping[i] = True
+            else:
+                for dc, t in txn.snapshot_vc.items():
+                    ss[i, cols[dc]] = t
+        pvc = np.zeros(d_pad, dtype=np.int64)
+        for dc, c in cols.items():
+            pvc[c] = self.applied_vc.get_dc(dc)
+        # own entry is *replaced* by now, exactly like partition_vc()
+        # (the two gating paths must agree regardless of queue depth)
+        pvc[cols[self.own_dc]] = self.now_us()
+
+        applied, rounds, _new_pvc = gate_fixpoint(
+            jnp.asarray(ss), jnp.asarray(origin_col), jnp.asarray(pos_arr),
+            jnp.asarray(ts), jnp.asarray(ping), jnp.asarray(pvc))
+        applied = np.asarray(applied)
+        rounds = np.asarray(rounds)
+
+        # replay in (round, fifo pos) order: round-r txns depend only on
+        # rounds < r, so this is a causal apply order (see gate_fixpoint)
+        order = sorted(
+            (i for i in range(n) if applied[i]),
+            key=lambda i: (int(rounds[i]), flat[i][1]))
+        advanced = False
+        for i in order:
+            origin, pos, txn = flat[i]
+            q = self.queues[origin]
+            assert q[0] is txn, "device fixpoint applied out of FIFO order"
+            q.popleft()
+            if txn.is_ping():
+                self._advance(origin, txn.timestamp)
+            else:
+                self._apply(txn)
+            advanced = True
+        return advanced
 
     def _advance(self, origin, ts: int) -> None:
         if ts > self.applied_vc.get_dc(origin):
@@ -108,3 +222,82 @@ def ready_mask(queued_ss, queued_origin, partition_vc):
 
     deps = dense.set_dc(queued_ss, queued_origin, 0)
     return dense.ge(partition_vc, deps)
+
+
+_GATE_JIT = None
+
+
+def gate_fixpoint(ss, origin, pos, ts, is_ping, pvc):
+    """Device iterate-until-stable over the whole queued set: returns
+    (applied bool[N], round int32[N], final partition VC int64[D]).
+
+    Each round evaluates, data-parallel over all N queued txns:
+      ready    = ping | (pvc >= deps)           (:func:`ready_mask`)
+      applied  = ready ∧ FIFO-prefix            (a txn applies only if
+                 every earlier txn of its origin queue applies — the
+                 per-origin min position of a not-ready txn bounds it)
+      pvc     |= per-origin max commit ts of applied txns
+    and repeats while pvc still advances — the same monotone cascade the
+    host walk performs head-by-head (reference
+    src/inter_dc_dep_vnode.erl:96-154), as one ``lax.while_loop``.
+    Terminates because applied/pvc are monotone; the round count is
+    bounded by the longest dependency chain through the queues (up to
+    the total queued-txn count for a fully serialized cascade).
+
+    ``round[i]`` is the round at which txn i became applicable.  A
+    round-r txn's dependencies were satisfied by the clock of round r-1,
+    so it cannot depend on any other round-r txn: replaying applies
+    sorted by (round, fifo pos) is causally safe, which is how the host
+    caller restores the reference's apply-in-dependency-order behavior.
+    """
+    global _GATE_JIT
+    if _GATE_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        from antidote_tpu.clocks import dense
+
+        def _fixpoint(ss, origin, pos, ts, is_ping, pvc):
+            d = pvc.shape[0]
+            n = ss.shape[0]
+            big = jnp.asarray(np.iinfo(np.int32).max, jnp.int32)
+
+            def round_(pvc):
+                ready = is_ping | ready_mask(ss, origin, pvc)   # [N]
+                notready_pos = jnp.where(ready, big, pos)
+                blocked_min = jnp.full((d,), big, jnp.int32).at[origin].min(
+                    notready_pos, mode="drop")
+                applied = ready & (pos < blocked_min[origin])
+                wm = jnp.zeros((d,), ts.dtype).at[origin].max(
+                    jnp.where(applied, ts, 0), mode="drop")
+                return applied, jnp.maximum(pvc, wm)
+
+            def note_round(rounds, applied, r):
+                newly = applied & (rounds < 0)
+                return jnp.where(newly, r, rounds)
+
+            def cond(carry):
+                _, _, _, changed = carry
+                return changed
+
+            def body(carry):
+                rounds, pvc, r, _ = carry
+                applied, new_pvc = round_(pvc)
+                rounds = note_round(rounds, applied, r)
+                return (rounds, new_pvc, r + 1,
+                        jnp.any(new_pvc != pvc))
+
+            rounds0 = jnp.full((n,), -1, jnp.int32)
+            rounds, pvc, r, _ = jax.lax.while_loop(
+                cond, body,
+                (rounds0, pvc, jnp.asarray(0, jnp.int32),
+                 jnp.asarray(True)))
+            # the loop exits after a round that did not advance pvc;
+            # evaluate once more at the stable clock (covers the
+            # no-progress-first-round case)
+            applied, _ = round_(pvc)
+            rounds = note_round(rounds, applied, r)
+            return applied, rounds, pvc
+
+        _GATE_JIT = jax.jit(_fixpoint)
+    return _GATE_JIT(ss, origin, pos, ts, is_ping, pvc)

@@ -1,22 +1,39 @@
 """Pallas TPU kernels for the materializer hot path.
 
-``orset_read_fused`` fuses the whole snapshot-read pipeline — per-op
+``orset_read_packed`` fuses the whole snapshot-read pipeline — per-op
 commit-VC construction, the Clock-SI inclusion test, the ORSWOT
 dot-table fold, and element presence — into one VMEM-resident pass over
-key blocks.  The jnp reference path (antidote_tpu/mat/kernels.py
-inclusion_mask → orset_apply → orset_present) materializes the [K, L, D]
-commit-VC tensor and the [K, E, D] fold intermediates in HBM between
-XLA fusions; here nothing leaves VMEM but the [TK, E] presence block.
+key blocks of the *packed* store layout (antidote_tpu/mat/store.py
+``OrsetShardState.ops``).  The jnp reference path
+(antidote_tpu/mat/kernels.py inclusion_mask → orset_apply →
+orset_present) materializes the [K, L, D] commit-VC tensor and the
+[K, E, D] fold intermediates in HBM between XLA fusions; here the packed
+rows are read from HBM exactly once and nothing but the [TK, E] presence
+block leaves VMEM.  This replaces the reference's per-key materialize
+walk (reference src/clocksi_materializer.erl:145-171) for bulk reads.
 
-The scatter-max of the jnp path (``.at[elem_slot, dot_dc].max``) does
-not exist on the VPU; it is replaced by one-hot masked max-reductions
-over the (tiny, static) element × DC axes — an unrolled L-step loop of
-[TK, E, D] maxes, which vectorizes cleanly.
+Mosaic lowering notes (learned against the real v5e compiler — the
+failures are silent under interpret mode, so this kernel restricts
+itself to patterns the hardware compiler accepts):
+- NO 3D refs or values: slicing a middle axis of a 3D vector yields
+  sublane-offset layouts that ``tpu.concatenate``/elementwise ops
+  reject ("result/input offset mismatch on non-concat dimension").
+  All inputs arrive as 2D blocks — the packed rows as [TK, L*F], the
+  dot table flattened to [TK, E*D] (a free row-major bitcast outside
+  the kernel).
+- The scatter-max of the jnp path (``.at[elem_slot, dot_dc].max``) does
+  not exist on the VPU; it is replaced by one-hot masked max-reductions
+  over the (tiny, static) lane × DC axes — fully unrolled loops of
+  [TK, E*D] maxes, which vectorize cleanly on the 128-lane VPU.
+- Per-op scalars are extracted as single columns ``ops[:, j][:, None]``
+  and lane-broadcast against [TK, E*D] tiles — the one relayout mosaic
+  handles well.  Cross-DC reductions are unrolled into scalar compares
+  against SMEM-resident base/read VCs instead of axis reductions over
+  lane-offset slices.
 
-All integer inputs are int32 (bool inputs arrive as int32 0/1); shapes
-are the shard-store layouts [K, L], [K, L, D], [K, E, D] with K blocked
-by ``block_k``.  Falls back to interpret mode off-TPU (tests run the
-same kernel code on CPU).
+All integer inputs are int32 (bool inputs arrive as int32 0/1); K is
+blocked by ``block_k``.  Falls back to interpret mode off-TPU (tests run
+the same kernel code on CPU).
 """
 
 from __future__ import annotations
@@ -33,36 +50,32 @@ from jax.experimental.pallas import tpu as pltpu
 # a plain Python 0 traces as i64 there, which mosaic rejects
 _Z = np.int32(0)
 
+# packed column order (store.py): [elem, is_add, dot_dc, dot_seq, op_dc,
+# op_ct, obs_vv(D), op_ss(D)]
+_NSCAL = 6
 
-def _orset_read_core(dots, elem_slot, is_add, dot_dc, dot_seq, obs,
-                     op_dc, op_ct, ss, valid, base, has_base, read):
-    """Shared kernel body: inclusion test + ORSWOT fold + presence, all
-    on VMEM-resident [TK, ...] blocks.  ``base``/``read``: [D];
-    ``has_base``: scalar int32."""
-    tk, e, d = dots.shape
-    l = elem_slot.shape[1]
 
-    dc_cols = jax.lax.broadcasted_iota(jnp.int32, (tk, l, d), 2)
-    at_dc = dc_cols == op_dc[:, :, None]
-    cvc = jnp.where(at_dc, jnp.maximum(ss, op_ct[:, :, None]), ss)
-
-    base = base[None, None, :]                          # [1, 1, D]
-    read = read[None, None, :]
-    # bool all-reduce lowers as a float min on this mosaic version; an
-    # int32 min-reduce compiles cleanly
-    all2 = lambda c: jnp.min(
-        jnp.where(c, np.int32(1), _Z), axis=2) == np.int32(1)
-    covered = all2(cvc <= base) & (has_base != _Z)
-    included = all2(cvc <= read)
-    mask = (valid != _Z) & ~covered & included          # [TK, L]
-    add_mask = mask & (is_add != _Z)
-
-    # The fold runs on FLAT [TK, E*D] tiles: mosaic rejects the
-    # (TK,1,1)->(TK,E,D) broadcasts the nested-axis form needs (vpad
-    # {0,0}->{*,*} on both minor dims), while (TK,1)->(TK,E*D) lane
-    # broadcasts and minor-dim concats lower cleanly — and a flat minor
-    # dim of E*D (e.g. 64) uses the 128-lane VPU far better than D=8.
+def _orset_read_kernel(
+    dots_ref,       # [TK, E*D] VMEM (flattened dot table)
+    ops_ref,        # [TK, L*F] VMEM (packed store rows)
+    valid_ref,      # [TK, L]   VMEM
+    base_ref,       # [1, D]    SMEM
+    has_base_ref,   # [1, 1]    SMEM
+    read_ref,       # [1, D]    SMEM
+    out_ref,        # [TK, E]   VMEM
+    *, e: int, d: int, l: int,
+):
+    f = _NSCAL + 2 * d
+    tk = out_ref.shape[0]
     ed = e * d
+    ops = ops_ref[:]
+    valid = valid_ref[:]
+    dots = dots_ref[:]
+    has_base = has_base_ref[0, 0] != _Z
+
+    col = lambda j: ops[:, j][:, None]                  # [TK, 1]
+
+    # flat (e, d) coordinate planes, built from offset-0 pieces only
     d_row = jax.lax.broadcasted_iota(jnp.int32, (tk, d), 1)
     d_col = jnp.concatenate([d_row] * e, axis=1)        # [TK, E*D]
     e_col = jnp.concatenate(
@@ -70,156 +83,121 @@ def _orset_read_core(dots, elem_slot, is_add, dot_dc, dot_seq, obs,
 
     last_seq = jnp.zeros((tk, ed), jnp.int32)
     max_obs = jnp.zeros((tk, ed), jnp.int32)
+    true_col = jnp.ones((tk, 1), jnp.bool_)
     for i in range(l):                                  # static unroll
-        at_e = e_col == elem_slot[:, i][:, None]
-        at_d = d_col == dot_dc[:, i][:, None]
-        seq_i = jnp.where(at_e & at_d & add_mask[:, i][:, None],
-                          dot_seq[:, i][:, None], _Z)
-        last_seq = jnp.maximum(last_seq, seq_i)
-        obs_i = jnp.concatenate([obs[:, i, :]] * e, axis=1)
+        off = i * f
+        elem_i = col(off + 0)
+        isadd_i = col(off + 1)
+        dotdc_i = col(off + 2)
+        dotseq_i = col(off + 3)
+        opdc_i = col(off + 4)
+        opct_i = col(off + 5)
+
+        # inclusion test, unrolled across DC columns as scalar compares
+        # (commit VC = op snapshot with the origin column bumped to the
+        # commit time; the Clock-SI read rule, txn/coordinator.py)
+        cov_i = true_col
+        inc_i = true_col
+        for dd in range(d):
+            ss_c = col(off + _NSCAL + d + dd)
+            cvc_c = jnp.where(opdc_i == np.int32(dd),
+                              jnp.maximum(ss_c, opct_i), ss_c)
+            cov_i = cov_i & (cvc_c <= base_ref[0, dd])
+            inc_i = inc_i & (cvc_c <= read_ref[0, dd])
+        mask_i = (valid[:, i][:, None] != _Z) & inc_i \
+            & ~(cov_i & has_base)                       # [TK, 1]
+        add_i = mask_i & (isadd_i != _Z)
+
+        at_e = e_col == elem_i                          # [TK, E*D]
+        at_d = d_col == dotdc_i
+        last_seq = jnp.maximum(
+            last_seq, jnp.where(at_e & at_d & add_i, dotseq_i, _Z))
+
+        # the op's observed VV, tiled across the E axis one DC column at
+        # a time (obs depends only on the flat position's d coordinate)
+        obs_t = jnp.zeros((tk, ed), jnp.int32)
+        for dd in range(d):
+            obs_t = jnp.where(d_col == np.int32(dd),
+                              col(off + _NSCAL + dd), obs_t)
         max_obs = jnp.maximum(
-            max_obs, jnp.where(at_e & mask[:, i][:, None], obs_i, _Z))
+            max_obs, jnp.where(at_e & mask_i, obs_t, _Z))
 
-    # flatten dots by column-wise concat — mosaic has no 3D->2D reshape
-    dots_flat = jnp.concatenate(
-        [dots[:, j, :] for j in range(e)], axis=1)      # [TK, E*D]
-    merged = jnp.maximum(dots_flat, last_seq)
+    # ORSWOT fold: a dot survives iff its seq exceeds every observed VV
+    # that covered its (elem, dc) cell
+    merged = jnp.maximum(dots, last_seq)
     live = jnp.where(merged > max_obs, merged, _Z)
-    # presence = max over each key's d-chunk, assembled column-wise so
-    # every op stays 2D
-    return jnp.concatenate(
-        [jnp.max(live[:, j * d:(j + 1) * d], axis=1, keepdims=True)
-         for j in range(e)], axis=1)                    # >0 iff present
-
-
-def _orset_read_kernel(
-    dots_ref,       # [TK, E, D]
-    elem_ref,       # [TK, L]
-    is_add_ref,     # [TK, L]
-    dot_dc_ref,     # [TK, L]
-    dot_seq_ref,    # [TK, L]
-    obs_ref,        # [TK, L, D]
-    op_dc_ref,      # [TK, L]
-    op_ct_ref,      # [TK, L]
-    op_ss_ref,      # [TK, L, D]
-    valid_ref,      # [TK, L]
-    base_ref,       # [1, D]
-    has_base_ref,   # [1, 1] (SMEM)
-    read_ref,       # [1, D]
-    out_ref,        # [TK, E]
-):
-    out_ref[:] = _orset_read_core(
-        dots_ref[:], elem_ref[:], is_add_ref[:], dot_dc_ref[:],
-        dot_seq_ref[:], obs_ref[:], op_dc_ref[:], op_ct_ref[:],
-        op_ss_ref[:], valid_ref[:], base_ref[0], has_base_ref[0, 0],
-        read_ref[0])
-
-
-@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
-def orset_read_fused(
-    dots, elem_slot, is_add, dot_dc, dot_seq, obs_vv,
-    op_dc, op_ct, op_ss, valid, base_vc, has_base, read_vc,
-    block_k: int = 2048, interpret: bool = False,
-):
-    """bool[K, E]: element presence at ``read_vc``; semantics identical
-    to kernels.inclusion_mask + orset_apply + orset_present with a
-    shard-wide (unbatched) base_vc/has_base/read_vc."""
-    k, e, d = dots.shape
-    l = elem_slot.shape[1]
-    i32 = lambda a: a.astype(jnp.int32)
-    # non-divisible K: the last block is padded by pallas; rows are
-    # independent, so padded lanes compute garbage that is dropped on
-    # the (bounds-masked) write
-    grid = (pl.cdiv(k, block_k),)
-    row = lambda i: (i, _Z)
-    row3 = lambda i: (i, _Z, _Z)
-    bspec = lambda shp, ix: pl.BlockSpec(shp, ix, memory_space=pltpu.VMEM)
-    rep = lambda shp: pl.BlockSpec(
-        shp, lambda i: (_Z,) * len(shp), memory_space=pltpu.VMEM)
-    out = pl.pallas_call(
-        _orset_read_kernel,
-        grid=grid,
-        in_specs=[
-            bspec((block_k, e, d), row3),
-            bspec((block_k, l), row), bspec((block_k, l), row),
-            bspec((block_k, l), row), bspec((block_k, l), row),
-            bspec((block_k, l, d), row3),
-            bspec((block_k, l), row), bspec((block_k, l), row),
-            bspec((block_k, l, d), row3),
-            bspec((block_k, l), row),
-            rep((1, d)),
-            pl.BlockSpec((1, 1), lambda i: (_Z, _Z),
-                         memory_space=pltpu.SMEM),
-            rep((1, d)),
-        ],
-        out_specs=bspec((block_k, e), row),
-        out_shape=jax.ShapeDtypeStruct((k, e), jnp.int32),
-        interpret=interpret,
-    )(
-        i32(dots), i32(elem_slot), i32(is_add), i32(dot_dc), i32(dot_seq),
-        i32(obs_vv), i32(op_dc), i32(op_ct), i32(op_ss), i32(valid),
-        i32(base_vc)[None, :], i32(has_base).reshape(1, 1),
-        i32(read_vc)[None, :],
-    )
-    return out > 0
-
-
-def _orset_read_packed_kernel(
-    dots_ref,       # [TK, E, D]
-    ops_ref,        # [TK, L, F]  packed store rows (F = 6 + 2D)
-    valid_ref,      # [TK, L]
-    base_ref,       # [1, D]
-    has_base_ref,   # [1, 1] (SMEM)
-    read_ref,       # [1, D]
-    out_ref,        # [TK, E]
-):
-    d = dots_ref.shape[2]
-    o = ops_ref[:]
-    # column extraction happens in VMEM — the packed layout is read from
-    # HBM exactly once (the whole point of this variant; the unpacked
-    # entry materializes ten per-field slices in HBM first)
-    out_ref[:] = _orset_read_core(
-        dots_ref[:], o[:, :, 0], o[:, :, 1], o[:, :, 2], o[:, :, 3],
-        o[:, :, 6:6 + d], o[:, :, 4], o[:, :, 5], o[:, :, 6 + d:6 + 2 * d],
-        valid_ref[:], base_ref[0], has_base_ref[0, 0], read_ref[0])
+    # presence per element = max over its D chunk, via column maxes
+    outs = []
+    for j in range(e):
+        m = live[:, j * d][:, None]
+        for dd in range(1, d):
+            m = jnp.maximum(m, live[:, j * d + dd][:, None])
+        outs.append(m)
+    out_ref[:] = jnp.concatenate(outs, axis=1)          # [TK, E]
 
 
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
 def orset_read_packed(dots, ops, valid, base_vc, has_base, read_vc,
-                      block_k: int = 2048, interpret: bool = False):
+                      block_k: int = 256, interpret: bool = False):
     """bool[K, E]: full-shard presence read straight off the packed
-    store layout (antidote_tpu/mat/store.py OrsetShardState.ops), one
-    HBM pass.  ``ops``: int[K*L, F] with the store's column order
-    [elem, is_add, dot_dc, dot_seq, op_dc, op_ct, obs(D), ss(D)];
-    ``valid``: bool[K*L]."""
+    store layout, one HBM pass.  ``dots``: int[K, E, D]; ``ops``:
+    int[K*L, F] with the store's column order; ``valid``: bool[K*L]."""
     k, e, d = dots.shape
     f = ops.shape[-1]
     l = ops.shape[0] // k
     i32 = lambda a: a.astype(jnp.int32)
     grid = (pl.cdiv(k, block_k),)
     row = lambda i: (i, _Z)
-    row3 = lambda i: (i, _Z, _Z)
-    bspec = lambda shp, ix: pl.BlockSpec(shp, ix, memory_space=pltpu.VMEM)
-    rep = lambda shp: pl.BlockSpec(
-        shp, lambda i: (_Z,) * len(shp), memory_space=pltpu.VMEM)
+    bspec = lambda shp: pl.BlockSpec(shp, row, memory_space=pltpu.VMEM)
+    smem = lambda shp: pl.BlockSpec(
+        shp, lambda i: (_Z, _Z), memory_space=pltpu.SMEM)
+    kern = functools.partial(_orset_read_kernel, e=e, d=d, l=l)
     out = pl.pallas_call(
-        _orset_read_packed_kernel,
+        kern,
         grid=grid,
         in_specs=[
-            bspec((block_k, e, d), row3),
-            bspec((block_k, l, f), row3),
-            bspec((block_k, l), row),
-            rep((1, d)),
-            pl.BlockSpec((1, 1), lambda i: (_Z, _Z),
-                         memory_space=pltpu.SMEM),
-            rep((1, d)),
+            bspec((block_k, e * d)),
+            bspec((block_k, l * f)),
+            bspec((block_k, l)),
+            smem((1, d)),
+            smem((1, 1)),
+            smem((1, d)),
         ],
-        out_specs=bspec((block_k, e), row),
+        out_specs=bspec((block_k, e)),
         out_shape=jax.ShapeDtypeStruct((k, e), jnp.int32),
         interpret=interpret,
     )(
-        i32(dots), i32(ops).reshape(k, l, f), i32(valid).reshape(k, l),
+        i32(dots).reshape(k, e * d),        # row-major bitcast, free
+        i32(ops).reshape(k, l * f),
+        i32(valid).reshape(k, l),
         i32(base_vc)[None, :], i32(has_base).reshape(1, 1),
         i32(read_vc)[None, :],
     )
     return out > 0
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def orset_read_fused(
+    dots, elem_slot, is_add, dot_dc, dot_seq, obs_vv,
+    op_dc, op_ct, op_ss, valid, base_vc, has_base, read_vc,
+    block_k: int = 256, interpret: bool = False,
+):
+    """bool[K, E]: presence at ``read_vc`` from per-field [K, L(, D)]
+    views; semantics identical to kernels.inclusion_mask + orset_apply +
+    orset_present with a shard-wide (unbatched) base/read VC.
+
+    Compatibility entry: packs the fields into the store's row layout
+    (one XLA fusion) and runs :func:`orset_read_packed`.  Callers that
+    hold an ``OrsetShardState`` should use store.orset_read_full, which
+    skips the repack."""
+    k, e, d = dots.shape
+    l = elem_slot.shape[1]
+    i32 = lambda a: a.astype(jnp.int32)
+    cols = [i32(elem_slot)[:, :, None], i32(is_add)[:, :, None],
+            i32(dot_dc)[:, :, None], i32(dot_seq)[:, :, None],
+            i32(op_dc)[:, :, None], i32(op_ct)[:, :, None],
+            i32(obs_vv), i32(op_ss)]
+    ops = jnp.concatenate(cols, axis=2).reshape(k * l, -1)
+    return orset_read_packed(
+        dots, ops, valid.reshape(k * l), base_vc, has_base, read_vc,
+        block_k=block_k, interpret=interpret)

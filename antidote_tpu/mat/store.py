@@ -233,7 +233,7 @@ def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
 
 def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
                     fused: str | bool = "auto",
-                    block_k: int = 2048) -> jax.Array:
+                    block_k: int = 256) -> jax.Array:
     """bool[K, E]: full-shard presence read, flag-selecting the Pallas
     fused kernel (antidote_tpu/mat/pallas_kernels.py orset_read_packed —
     one HBM pass over the packed rows, nothing but the presence block

@@ -3,13 +3,24 @@ config 2, the headline metric: CRDT merges/sec/chip).
 
 Device path: the batched shard store (antidote_tpu/mat/store.py) applies
 committed-op batches to a 1M-key OR-Set shard resident on one TPU chip —
-append + GST fold (GC) + read, all as fused XLA programs.
+append + GST fold (GC) + read, all as fused XLA programs.  The append
+uses the exact occurrence-disambiguated lane placement
+(store.batch_lane_offsets, computed host-side outside the timed loop,
+exactly as a deployment amortizes it into batch assembly); the
+full-shard read flag-selects the Pallas fused kernel
+(mat/pallas_kernels.py orset_read_packed) next to the jnp reference
+path so both latencies are recorded.
 
-Baseline: the reference executes this per key per op inside BEAM gen_servers
-(reference src/clocksi_materializer.erl hot loop).  The reference publishes
-no numbers (BASELINE.md), so the baseline is *measured here*: the same op
-stream applied through the host CRDT type (one Python/BEAM-style
-apply-per-op loop) on this machine's CPU.
+Baseline: the reference executes this per key per op inside BEAM
+gen_servers (reference src/clocksi_materializer.erl hot loop).  The
+reference publishes no numbers (BASELINE.md) and this image has no
+Erlang runtime, so the BEAM yardstick is *bounded*, not guessed: the
+same per-op apply loop is measured twice — once through the host Python
+CRDT type, and once as native C++ (antidote_tpu/native/
+orset_baseline.cpp).  BEAM sits between the two (faster than CPython,
+slower than C++ at per-op hash-map work), so ``vs_baseline`` reports the
+device against the *C++* loop — a conservative lower bound on the true
+device-vs-BEAM ratio.  The Python ratio is kept in ``detail``.
 
 Timing: dependent-chain methodology (benches/_util.py) — on this
 environment's remote-TPU tunnel, block_until_ready does not truly block,
@@ -19,6 +30,7 @@ so device steps are chained and a final scalar fetch forces completion
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
+import ctypes
 import json
 import sys
 import time
@@ -30,12 +42,18 @@ from benches._util import fetch
 
 def build_stream(K, B, n_steps, D, n_dcs, rng):
     """Synthetic committed add/remove stream, pre-chunked into batches
-    (shared generator: antidote_tpu/mat/synth.py)."""
+    (shared generator: antidote_tpu/mat/synth.py) with host-precomputed
+    lane offsets (occurrence-disambiguated same-key placement)."""
+    from antidote_tpu.mat import store
     from antidote_tpu.mat.synth import orset_batch
 
     clock = np.zeros(n_dcs, dtype=np.int32)
-    return [orset_batch(rng, K, B, D, n_dcs, clock, obs_lag=2)
-            for _ in range(n_steps)]
+    steps = []
+    for _ in range(n_steps):
+        s = orset_batch(rng, K, B, D, n_dcs, clock, obs_lag=2)
+        s["lane_off"] = store.batch_lane_offsets(s["key_idx"])
+        steps.append(s)
+    return steps
 
 
 def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
@@ -55,9 +73,8 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
     dev_steps = [put(s) for s in steps]
 
     def one_step(st, s, do_gc):
-        lane_off = jnp.zeros_like(s["key_idx"])  # see note below
         st, _ov = store.orset_append(
-            st, s["key_idx"], lane_off, s["elem_slot"], s["is_add"],
+            st, s["key_idx"], s["lane_off"], s["elem_slot"], s["is_add"],
             s["dot_dc"], s["dot_seq"], s["obs_vv"], s["op_dc"], s["op_ct"],
             s["op_ss"])
         if do_gc:
@@ -66,12 +83,6 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
             # ring's L lanes absorb gc_every batches of per-key arrivals
             st = store.orset_gc(st, s["frontier"])
         return st
-
-    # NOTE on lane_off=0: at K=1M and B=64k the chance of same-key
-    # collisions in one batch is real, but colliding lanes only overwrite
-    # within the batch before the GC fold — throughput is unaffected and
-    # the fold math stays valid (it is an op subset).  The correctness
-    # path with host-computed offsets is exercised in tests.
 
     for s in dev_steps[:warmup]:
         st = one_step(st, s, True)
@@ -88,39 +99,57 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
     dt = max(time.perf_counter() - t0 - fetch_oh, 1e-9)
     ops_per_sec = B * n_steps / dt
 
-    # full-shard read, chained on itself so each read depends on the last
+    # full-shard read, chained on itself so each read depends on the
+    # last — measured through both read paths (jnp reference, Pallas
+    # fused packed-row)
     frontier = dev_steps[-1]["frontier"]
     n_reads = 10
 
-    def one_read(present):
-        # numerically `frontier` (presence is non-negative) but XLA
-        # cannot prove it, so reads form a dependent chain
-        vc = frontier + jnp.minimum(present[0, 0].astype(jnp.int32), 0)
-        return store.orset_read(stc, vc)
+    def chain_read(read_fn):
+        def one_read(present):
+            # numerically `frontier` (presence is non-negative) but XLA
+            # cannot prove it, so reads form a dependent chain
+            vc = frontier + jnp.minimum(present[0, 0].astype(jnp.int32), 0)
+            return read_fn(stc, vc)
 
-    p = store.orset_read(stc, frontier)
-    fetch(p)
-    t0 = time.perf_counter()
-    for _ in range(n_reads):
-        p = one_read(p)
-    fetch(p)
-    read_dt = max(time.perf_counter() - t0 - fetch_oh, 1e-9) / n_reads
-    return ops_per_sec, read_dt
+        p = read_fn(stc, frontier)
+        fetch(p)
+        t0 = time.perf_counter()
+        for _ in range(n_reads):
+            p = one_read(p)
+        fetch(p)
+        return max(time.perf_counter() - t0 - fetch_oh, 1e-9) / n_reads
+
+    read_jnp = chain_read(store.orset_read)
+    on_tpu = jax.default_backend() == "tpu"
+    # interpret-mode pallas at 1M keys is minutes — only measure the
+    # fused path where it actually runs (TPU)
+    read_fused = chain_read(
+        lambda s_, vc: store.orset_read_full(s_, vc, fused=True)
+    ) if on_tpu else None
+    return ops_per_sec, read_jnp, read_fused
 
 
-def bench_host_baseline(n_ops=30_000):
-    """BEAM-style apply-one-op-at-a-time loop through the host CRDT type."""
+def _baseline_stream(n_ops, rng, K, n_elems=8, n_dcs=3):
+    keys = rng.integers(0, K, size=n_ops)
+    adds = rng.random(n_ops) < 0.7
+    els = rng.integers(0, n_elems, size=n_ops)
+    dcs = rng.integers(0, n_dcs, size=n_ops)
+    seqs = np.arange(1, n_ops + 1, dtype=np.int64)
+    return keys, adds, els, dcs, seqs
+
+
+def bench_host_baseline(K, n_ops=30_000):
+    """BEAM-style apply-one-op-at-a-time loop through the host CRDT type
+    (CPython: the *lower* bracket of the BEAM bound).  Same K-key space
+    as the device bench, so the hash-map working set is comparable."""
     from antidote_tpu.crdt import get_type
 
     cls = get_type("set_aw")
     rng = np.random.default_rng(1)
-    K = 4096
     states = {}
     elems = [b"a", b"b", b"c", b"d", b"e", b"f", b"g", b"h"]
-    keys = rng.integers(0, K, size=n_ops)
-    adds = rng.random(n_ops) < 0.7
-    els = rng.integers(0, 8, size=n_ops)
-    dots = [(int(rng.integers(0, 3)), i + 1) for i in range(n_ops)]
+    keys, adds, els, dcs, seqs = _baseline_stream(n_ops, rng, K)
     t0 = time.perf_counter()
     for i in range(n_ops):
         k = int(keys[i])
@@ -128,13 +157,50 @@ def bench_host_baseline(n_ops=30_000):
         if stt is None:
             stt = cls.new()
         e = elems[int(els[i])]
+        dot = (int(dcs[i]), int(seqs[i]))
         if adds[i]:
-            eff = ("add", ((e, dots[i], tuple(stt.get(e, ()))),))
+            eff = ("add", ((e, dot, tuple(stt.get(e, ()))),))
         else:
             eff = ("rmv", ((e, tuple(stt.get(e, ()))),))
         states[k] = cls.update(eff, stt)
     dt = time.perf_counter() - t0
     return n_ops / dt
+
+
+def bench_cpp_baseline(K, n_ops=2_000_000):
+    """The same per-op loop as native C++ (the *upper* bracket: BEAM
+    cannot beat this at per-op hash-map work) over the same K-key space
+    as the device bench.  None if g++ is absent."""
+    from antidote_tpu.native.build import ensure_built
+
+    so = ensure_built("orset_baseline")
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    lib.orset_baseline_run.restype = ctypes.c_double
+    lib.orset_baseline_run.argtypes = [
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    rng = np.random.default_rng(1)
+    keys, adds, els, dcs, seqs = _baseline_stream(n_ops, rng, K)
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    adds = np.ascontiguousarray(adds, dtype=np.uint8)
+    els = np.ascontiguousarray(els, dtype=np.int32)
+    dcs = np.ascontiguousarray(dcs, dtype=np.int32)
+    seqs = np.ascontiguousarray(seqs, dtype=np.int64)
+    live = ctypes.c_int64(0)
+    ptr = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+    best = None
+    for _ in range(3):  # min over runs: one-shot timing is noisy
+        dt = lib.orset_baseline_run(
+            n_ops, ptr(keys, ctypes.c_int64), ptr(adds, ctypes.c_uint8),
+            ptr(els, ctypes.c_int32), ptr(dcs, ctypes.c_int32),
+            ptr(seqs, ctypes.c_int64), ctypes.byref(live))
+        best = dt if best is None else min(best, dt)
+    return n_ops / best
 
 
 def main():
@@ -145,18 +211,34 @@ def main():
     K = 1_000_000 if not quick else 65_536
     B = 65_536 if not quick else 8_192
     n_steps = 20 if not quick else 4
-    dev_ops, read_dt = bench_device(K=K, B=B, n_steps=n_steps, D=8, n_dcs=3)
-    host_ops = bench_host_baseline()
+    dev_ops, read_jnp, read_fused = bench_device(
+        K=K, B=B, n_steps=n_steps, D=8, n_dcs=3)
+    host_ops = bench_host_baseline(K)
+    cpp_ops = bench_cpp_baseline(K, 200_000 if quick else 2_000_000)
+    # BEAM sits between CPython and C++ at this workload; the C++ ratio
+    # is the conservative (defensible) headline
+    vs = dev_ops / cpp_ops if cpp_ops else dev_ops / host_ops
+    import os
     print(json.dumps({
         "metric": "orset_update_merges_per_sec_per_chip_1M_keys",
         "value": round(dev_ops),
         "unit": "merges/s",
-        "vs_baseline": round(dev_ops / host_ops, 2),
+        "vs_baseline": round(vs, 2),
         "detail": {
             "device": str(jax.devices()[0]),
             "keys": K, "batch": B, "steps": n_steps,
-            "full_shard_read_ms": round(read_dt * 1e3, 2),
-            "host_baseline_merges_per_sec": round(host_ops),
+            "full_shard_read_ms": round(read_jnp * 1e3, 2),
+            "full_shard_read_fused_ms":
+                round(read_fused * 1e3, 2) if read_fused else None,
+            "host_python_merges_per_sec": round(host_ops),
+            "host_cpp_merges_per_sec": round(cpp_ops) if cpp_ops else None,
+            "vs_python_baseline": round(dev_ops / host_ops, 2),
+            "baseline_note": (
+                "no Erlang runtime in image; BEAM per-op loop is "
+                "bracketed by [CPython, C++] — vs_baseline uses the "
+                + ("C++" if cpp_ops else "CPython (g++ unavailable)")
+                + " bracket (per core; x%d cores for a machine-wide "
+                "bound)" % (os.cpu_count() or 1)),
         },
     }))
 

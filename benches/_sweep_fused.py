@@ -1,0 +1,43 @@
+"""Dev-only: sweep fused-read block_k at 1M keys on TPU."""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from antidote_tpu.mat import store
+from antidote_tpu.mat.synth import orset_batch
+from benches._util import fetch
+
+K = 1_000_000
+rng = np.random.default_rng(0)
+clock = np.zeros(3, dtype=np.int32)
+st = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=8,
+                            dtype=jnp.int32)
+for i in range(6):
+    s = orset_batch(rng, K, 65536, 8, 3, clock, obs_lag=2)
+    lane = jnp.asarray(store.batch_lane_offsets(s["key_idx"]))
+    st, _ = store.orset_append(
+        st, jnp.asarray(s["key_idx"]), lane,
+        jnp.asarray(s["elem_slot"]), jnp.asarray(s["is_add"]),
+        jnp.asarray(s["dot_dc"]), jnp.asarray(s["dot_seq"]),
+        jnp.asarray(s["obs_vv"]), jnp.asarray(s["op_dc"]),
+        jnp.asarray(s["op_ct"]), jnp.asarray(s["op_ss"]))
+    if i == 3:
+        st = store.orset_gc(st, jnp.asarray(s["frontier"]))
+frontier = jnp.asarray(s["frontier"])
+
+for bk in [int(a) for a in sys.argv[1:]]:
+    try:
+        p = store.orset_read_full(st, frontier, fused=True, block_k=bk)
+        fetch(p)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            vc = frontier + jnp.minimum(p[0, 0].astype(jnp.int32), 0)
+            p = store.orset_read_full(st, vc, fused=True, block_k=bk)
+        fetch(p)
+        dt = (time.perf_counter() - t0) / 5
+        print(f"block_k={bk}: read_ms={dt*1e3:.1f}", flush=True)
+    except Exception as ex:
+        print(f"block_k={bk}: FAIL {str(ex)[:180]}", flush=True)

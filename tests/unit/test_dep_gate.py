@@ -1,0 +1,122 @@
+"""Batched device gate fixpoint vs the host head-walk — the two
+DependencyGate.process_queues paths must compute identical applied sets,
+orders, and final clocks on any queue shape (reference semantics:
+src/inter_dc_dep_vnode.erl:96-154)."""
+
+import numpy as np
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.interdc.dep import DependencyGate
+from antidote_tpu.interdc.wire import InterDcTxn
+
+
+class FakePM:
+    def __init__(self):
+        self.applied = []
+
+    def apply_remote(self, records, dc_id, ts, snapshot_vc):
+        self.applied.append((dc_id, ts))
+
+
+def make_txn(origin, ts, snapshot, ping=False):
+    return InterDcTxn(
+        dc_id=origin, partition=0, prev_log_opid=0,
+        snapshot_vc=None if ping else VC(snapshot), timestamp=ts,
+        records=[] if ping else ["r"])
+
+
+def make_gate(threshold):
+    pm = FakePM()
+    gate = DependencyGate(pm, "dc_self", now_us=lambda: 10**9,
+                          batch_threshold=threshold)
+    return gate, pm
+
+
+def random_scenario(seed, n_origins=6, q_len=8):
+    """Queues whose txns depend on other origins' later commits, so
+    applying cascades across origins (the fixpoint case)."""
+    rng = np.random.default_rng(seed)
+    origins = [f"dc{i}" for i in range(n_origins)]
+    queues = {}
+    for oi, origin in enumerate(origins):
+        txns = []
+        base = 100 * (oi + 1)
+        for p in range(q_len):
+            ts = base + 50 * p + int(rng.integers(0, 10))
+            if rng.random() < 0.15:
+                txns.append(make_txn(origin, ts, {}, ping=True))
+                continue
+            snap = {}
+            for dep_oi in rng.choice(n_origins, size=2, replace=False):
+                dep = origins[dep_oi]
+                if dep == origin:
+                    continue
+                # depend on a timestamp another origin's queue reaches
+                # partway through: forces multi-round cascades
+                snap[dep] = 100 * (dep_oi + 1) + 50 * int(
+                    rng.integers(0, q_len // 2))
+            snap[origin] = ts - 1
+            txns.append(make_txn(origin, ts, snap))
+        queues[origin] = txns
+    return queues
+
+
+def run(gate, queues):
+    # enqueue everything before processing: enqueue() itself triggers
+    # process_queues, so feed through the queues dict directly
+    for origin, txns in queues.items():
+        from collections import deque
+        gate.queues[origin] = deque(txns)
+    gate.process_queues()
+    leftover = {o: len(q) for o, q in gate.queues.items() if q}
+    return leftover
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_batched_matches_host_walk(seed):
+    queues = random_scenario(seed)
+    host_gate, host_pm = make_gate(threshold=10**9)
+    dev_gate, dev_pm = make_gate(threshold=0)
+    left_host = run(host_gate, {o: list(q) for o, q in queues.items()})
+    left_dev = run(dev_gate, {o: list(q) for o, q in queues.items()})
+    assert sorted(host_pm.applied) == sorted(dev_pm.applied)
+    # per-origin apply order is FIFO in both
+    for origin in queues:
+        host_seq = [t for o, t in host_pm.applied if o == origin]
+        dev_seq = [t for o, t in dev_pm.applied if o == origin]
+        assert host_seq == dev_seq
+    assert left_host == left_dev
+    assert host_gate.applied_vc == dev_gate.applied_vc
+
+
+def test_blocked_txn_stays_queued_until_dependency_applies():
+    gate, pm = make_gate(threshold=0)
+    # a's txn depends on b@200, which is b's second txn
+    a1 = make_txn("a", 150, {"b": 200})
+    b1 = make_txn("b", 100, {})
+    b2 = make_txn("b", 200, {})
+    run(gate, {"a": [a1], "b": [b1, b2]})
+    assert ("a", 150) in pm.applied
+    assert pm.applied.index(("b", 200)) < pm.applied.index(("a", 150))
+    assert gate.pending() == 0
+
+
+def test_fifo_blocks_later_ready_txns():
+    gate, pm = make_gate(threshold=0)
+    # a's head can never apply; a's second txn is ready but must wait
+    blocked = make_txn("a", 100, {"zz": 10**12})
+    ready = make_txn("a", 200, {})
+    run(gate, {"a": [blocked, ready]})
+    assert pm.applied == []
+    assert gate.pending() == 2
+
+
+def test_pings_advance_clock_and_unblock():
+    gate, pm = make_gate(threshold=0)
+    a1 = make_txn("a", 150, {"b": 500})
+    ping_b = make_txn("b", 500, {}, ping=True)
+    run(gate, {"a": [a1], "b": [ping_b]})
+    assert pm.applied == [("a", 150)]
+    assert gate.applied_vc.get_dc("b") == 500
+    assert gate.pending() == 0
