@@ -159,6 +159,26 @@ class _PlaneBase:
             row[col] = max(row[col], t)
         return row
 
+    def _decode_obs(self, observed) -> Optional[List[tuple]]:
+        """Dense (col, seq) pairs for an observed-dot list; None on a
+        DC-column capacity miss (caller evicts to the host path)."""
+        out = []
+        for a, s in observed:
+            col = self._dc_col(a)
+            if col is None:
+                return None
+            out.append((col, int(s)))
+        return out
+
+    def _commit_rows(self, key, idx: int, rows: List[tuple]) -> None:
+        """Stage decoded rows — unless a growth-triggered flush evicted
+        the key mid-stage (the migration replayed the log, which already
+        holds this op; staging would write into purged lanes)."""
+        if self.key_index.get(key) != idx:
+            return
+        self.rows.extend(rows)
+        self.pending_keys.add(key)
+
     # -- lifecycle ----------------------------------------------------------
 
     def owns(self, key) -> bool:
@@ -328,28 +348,15 @@ class OrsetPlane(_PlaneBase):
                 elem, observed = entry
                 dot_col, seq, is_add = 0, 0, 0
             slot = self._slot(idx, elem)
-            obs_pairs = []
-            ok = slot is not None and (is_add == 0 or dot_col is not None)
-            if ok:
-                for a, s in observed:
-                    col = self._dc_col(a)
-                    if col is None:
-                        ok = False
-                        break
-                    obs_pairs.append((col, int(s)))
-            if not ok:
+            obs_pairs = self._decode_obs(observed)
+            if slot is None or obs_pairs is None or (
+                    is_add and dot_col is None):
                 self.evict(key)
                 return
             rows.append((idx, slot, is_add, dot_col or 0, int(seq),
                          obs_pairs, op_dc_col, int(payload.commit_time),
                          ss_pairs))
-        if self.key_index.get(key) != idx:
-            # a growth-triggered flush evicted this key mid-stage; the
-            # migration replayed the log, which already holds this op —
-            # staging the decoded rows would write into purged lanes
-            return
-        self.rows.extend(rows)
-        self.pending_keys.add(key)
+        self._commit_rows(key, idx, rows)
 
     def _append_rows(self, rows):
         n = len(rows)
@@ -476,11 +483,9 @@ class CounterPlane(_PlaneBase):
         if op_dc_col is None or ss_pairs is None:
             self.evict(key)
             return
-        if self.key_index.get(key) != idx:
-            return  # evicted by a growth-triggered flush (see OrsetPlane)
-        self.rows.append((idx, int(payload.effect), op_dc_col,
-                          int(payload.commit_time), ss_pairs))
-        self.pending_keys.add(key)
+        self._commit_rows(key, idx, [
+            (idx, int(payload.effect), op_dc_col,
+             int(payload.commit_time), ss_pairs)])
 
     def _append_rows(self, rows):
         n = len(rows)
@@ -543,6 +548,307 @@ class CounterPlane(_PlaneBase):
         return {k: int(vals[i]) for i, k in enumerate(owned)}
 
 
+class MvregPlane(OrsetPlane):
+    """Device plane for register_mv — the OR-Set ring with value slots
+    (see store.py mvreg notes).  Row tuple identical to OrsetPlane's
+    with elem := interned value; a reset row carries slot=n_slots (the
+    drop slot) and seq=0, contributing only its observed VV."""
+
+    type_name = "register_mv"
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        eff = payload.effect
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        if eff[0] == "asgn":
+            _, v, dot, observed = eff
+            try:
+                slot = self._slot(idx, v)
+            except TypeError:  # unhashable value — host path
+                slot = None
+            actor, seq = dot
+            dot_col = self._dc_col(actor)
+            ok = slot is not None and dot_col is not None
+        else:  # "reset"
+            _, observed = eff
+            slot, dot_col, seq, ok = self.n_slots, 0, 0, True
+        obs_pairs = self._decode_obs(observed) if ok else None
+        if obs_pairs is None:
+            self.evict(key)
+            return
+        self._commit_rows(key, idx, [
+            (idx, slot, 1 if eff[0] == "asgn" else 0, dot_col or 0,
+             int(seq), obs_pairs, op_dc_col, int(payload.commit_time),
+             ss_pairs)])
+
+    def _grow_slots(self, new_e):
+        # flush first: staged reset rows encode the drop slot as the OLD
+        # n_slots; appending them after the grow would land them in a
+        # real slot
+        self.flush()
+        super()._grow_slots(new_e)
+
+    def _device_gc(self, gst_dense):
+        self.st = store.mvreg_gc(self.st, jnp.asarray(gst_dense))
+
+    def read(self, key, read_vc: Optional[VC]):
+        """register_mv host state (frozenset of (dot, value)) at
+        ``read_vc``."""
+        out = self.read_many([key], read_vc)
+        if key not in out:
+            raise ReadBelowBase()  # evicted during the flush — host path
+        return out[key]
+
+    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
+        if self.pending_keys:
+            self.flush()
+        owned = [k for k in keys if k in self.key_index]
+        if not owned:
+            return {}
+        rv = self._read_vc_dense(read_vc)
+        idxs = np.asarray([self.key_index[k] for k in owned],
+                          dtype=np.int32)
+        B = _bucket(len(idxs))
+        pad = np.full(B, 0, dtype=np.int32)
+        pad[:len(idxs)] = idxs
+        dots = np.asarray(store.mvreg_read_keys(
+            self.st, jnp.asarray(pad), jnp.asarray(rv)))
+        actors = self.domain.dc_ids
+        out = {}
+        for i, k in enumerate(owned):
+            idx = idxs[i]
+            pairs = set()
+            for slot, v in enumerate(self.rev_elems[idx]):
+                for j, s in enumerate(dots[i, slot][:len(actors)]):
+                    if s > 0:
+                        pairs.add(((actors[j], int(s)), v))
+            out[k] = frozenset(pairs)
+        return out
+
+
+class FlagEwPlane(OrsetPlane):
+    """Device plane for flag_ew — an OR-Set with one implicit element
+    (slot 0 holds the enable dots; crdt/flags.py FlagEW)."""
+
+    type_name = "flag_ew"
+
+    def __init__(self, domain, key_capacity, n_lanes, flush_ops, gc_ops,
+                 max_dcs):
+        super().__init__(domain, key_capacity, n_lanes, 1, flush_ops,
+                         gc_ops, max_dcs, max_slots=1)
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        eff = payload.effect
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        if eff[0] == "en":
+            _, dot, observed = eff
+            actor, seq = dot
+            dot_col = self._dc_col(actor)
+            is_add, ok = 1, dot_col is not None
+        else:  # "dis"
+            _, observed = eff
+            dot_col, seq, is_add, ok = 0, 0, 0, True
+        obs_pairs = self._decode_obs(observed) if ok else None
+        if obs_pairs is None:
+            self.evict(key)
+            return
+        self._commit_rows(key, idx, [
+            (idx, 0, is_add, dot_col or 0, int(seq), obs_pairs,
+             op_dc_col, int(payload.commit_time), ss_pairs)])
+
+    def read(self, key, read_vc: Optional[VC]):
+        """flag_ew host state (frozenset of enable dots) at ``read_vc``."""
+        out = self.read_many([key], read_vc)
+        if key not in out:
+            raise ReadBelowBase()  # evicted during the flush — host path
+        return out[key]
+
+    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
+        if self.pending_keys:
+            self.flush()
+        owned = [k for k in keys if k in self.key_index]
+        if not owned:
+            return {}
+        rv = self._read_vc_dense(read_vc)
+        idxs = np.asarray([self.key_index[k] for k in owned],
+                          dtype=np.int32)
+        B = _bucket(len(idxs))
+        pad = np.full(B, 0, dtype=np.int32)
+        pad[:len(idxs)] = idxs
+        dots = np.asarray(store.orset_read_keys(
+            self.st, jnp.asarray(pad), jnp.asarray(rv)))
+        actors = self.domain.dc_ids
+        return {
+            k: frozenset(
+                (actors[j], int(s))
+                for j, s in enumerate(dots[i, 0][:len(actors)]) if s > 0)
+            for i, k in enumerate(owned)
+        }
+
+
+#: tiebreak packing: rank << _TIE_SHIFT | seq (seq must fit the low bits)
+_TIE_SHIFT = 40
+_TIE_SEQ_MAX = (1 << _TIE_SHIFT) - 1
+
+
+class LwwPlane(_PlaneBase):
+    """Device plane for register_lww.  Row tuple:
+    (key_idx, ts, tie, val_id, op_dc_col, op_ct, ss_pairs).
+
+    The host oracle's tiebreak is (actor string, seq) compared
+    lexicographically (crdt/registers.py RegisterLWW); the device
+    compares packed int64s, so the plane keeps a *sorted* actor-rank
+    directory and repacks stored ties (store.lww_retie) on first sight
+    of a new actor — rare, host-side, and exact."""
+
+    type_name = "register_lww"
+
+    def __init__(self, domain, key_capacity, n_lanes, flush_ops, gc_ops,
+                 max_dcs):
+        #: sorted actor strings; rank = index in this list
+        self.actors_sorted: List[str] = []
+        self._rank: Dict[str, int] = {}
+        #: interned values (value -> id, id -> value)
+        self.val_index: Dict[Any, int] = {}
+        self.rev_vals: List[Any] = []
+        super().__init__(domain, key_capacity, n_lanes, flush_ops,
+                         gc_ops, max_dcs)
+
+    def _init_state(self, key_capacity):
+        return store.lww_shard_init(
+            key_capacity, self.n_lanes, self.domain.d, dtype=jnp.int64)
+
+    def _grow_dcs(self, new_d):
+        self.st = store.lww_grow(self.st, n_dcs=new_d)
+
+    def _grow_keys(self, new_k):
+        self.st = store.lww_grow(self.st, n_keys=new_k)
+
+    def _tie(self, actor: str, seq: int) -> Optional[int]:
+        if seq > _TIE_SEQ_MAX:
+            return None
+        rank = self._rank.get(actor)
+        if rank is None:
+            self.flush()  # staged rows carry old-rank packed ties
+            new_sorted = sorted(self.actors_sorted + [actor])
+            remap = np.asarray(
+                [new_sorted.index(a) for a in self.actors_sorted],
+                dtype=np.int64)
+            if len(remap):
+                self.st = store.lww_retie(self.st, remap, _TIE_SHIFT)
+            self.actors_sorted = new_sorted
+            self._rank = {a: i for i, a in enumerate(new_sorted)}
+            rank = self._rank[actor]
+        return (rank << _TIE_SHIFT) | int(seq)
+
+    def _val_id(self, v) -> Optional[int]:
+        try:
+            vid = self.val_index.get(v)
+        except TypeError:
+            return None  # unhashable value — host path
+        if vid is None:
+            vid = len(self.rev_vals)
+            self.val_index[v] = vid
+            self.rev_vals.append(v)
+        return vid
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        ts, tie_pair, v = payload.effect
+        actor, seq = tie_pair
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        tie = self._tie(str(actor), int(seq))
+        vid = self._val_id(v)
+        if op_dc_col is None or ss_pairs is None or tie is None \
+                or vid is None:
+            self.evict(key)
+            return
+        self._commit_rows(key, idx, [
+            (idx, int(ts), tie, vid, op_dc_col,
+             int(payload.commit_time), ss_pairs)])
+
+    def _append_rows(self, rows):
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        B = _bucket(n)
+        K = self.capacity
+        d = self.domain.d
+        key_idx = np.full(B, K, dtype=np.int32)
+        ts = np.zeros(B, dtype=np.int64)
+        tie = np.zeros(B, dtype=np.int64)
+        val = np.zeros(B, dtype=np.int64)
+        op_dc = np.zeros(B, dtype=np.int64)
+        op_ct = np.zeros(B, dtype=np.int64)
+        ss = np.zeros((B, d), dtype=np.int64)
+        for i, (ki, t, ti, vi, odc, oct_, ssp) in enumerate(rows):
+            key_idx[i] = ki
+            ts[i] = t
+            tie[i] = ti
+            val[i] = vi
+            op_dc[i] = odc
+            op_ct[i] = oct_
+            for col, tt in ssp:
+                ss[i, col] = max(ss[i, col], tt)
+        lane_off = np.zeros(B, dtype=np.int32)
+        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
+        self.st, overflow = store.lww_append(
+            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
+            jnp.asarray(ts), jnp.asarray(tie), jnp.asarray(val),
+            jnp.asarray(op_dc), jnp.asarray(op_ct), jnp.asarray(ss))
+        return np.asarray(overflow)[:n]
+
+    def _purge_idx(self, idx):
+        self.st = store.lww_purge_keys(
+            self.st, jnp.asarray([idx], dtype=np.int32))
+
+    def _device_gc(self, gst_dense):
+        self.st = store.lww_gc(self.st, jnp.asarray(gst_dense))
+
+    def read(self, key, read_vc: Optional[VC]):
+        """register_lww host state (ts, (actor, seq), value)."""
+        out = self.read_many([key], read_vc)
+        if key not in out:
+            raise ReadBelowBase()  # evicted during the flush — host path
+        return out[key]
+
+    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
+        if self.pending_keys:
+            self.flush()
+        owned = [k for k in keys if k in self.key_index]
+        if not owned:
+            return {}
+        rv = self._read_vc_dense(read_vc)
+        idxs = np.asarray([self.key_index[k] for k in owned],
+                          dtype=np.int32)
+        B = _bucket(len(idxs))
+        pad = np.full(B, 0, dtype=np.int32)
+        pad[:len(idxs)] = idxs
+        ts, tie, val = (np.asarray(a) for a in store.lww_read_keys(
+            self.st, jnp.asarray(pad), jnp.asarray(rv)))
+        out = {}
+        for i, k in enumerate(owned):
+            if val[i] < 0:
+                out[k] = (0, (), None)  # unwritten at this snapshot
+            else:
+                rank = int(tie[i]) >> _TIE_SHIFT
+                seq = int(tie[i]) & _TIE_SEQ_MAX
+                out[k] = (int(ts[i]),
+                          (self.actors_sorted[rank], seq),
+                          self.rev_vals[int(val[i])])
+        return out
+
+
 class DevicePlane:
     """Per-partition facade over the type planes; all calls run under
     the owning PartitionManager's lock (one-writer discipline, like the
@@ -567,12 +873,21 @@ class DevicePlane:
             "counter_pn": CounterPlane(ClockDomain(8), key_capacity,
                                        n_lanes, flush_ops, gc_ops,
                                        max_dcs),
+            "register_mv": MvregPlane(ClockDomain(8), key_capacity,
+                                      n_lanes, n_slots, flush_ops,
+                                      gc_ops, max_dcs, max_slots),
+            "register_lww": LwwPlane(ClockDomain(8), key_capacity,
+                                     n_lanes, flush_ops, gc_ops,
+                                     max_dcs),
+            "flag_ew": FlagEwPlane(ClockDomain(8), key_capacity,
+                                   n_lanes, flush_ops, gc_ops, max_dcs),
         }
         #: keys evicted to the host path (sticky)
         self.host_only: set = set()
         #: types whose dense representation collapses dot sets per DC —
         #: only sound under write-write certification (module doc)
-        self.dot_collapse_types = frozenset({"set_aw"})
+        self.dot_collapse_types = frozenset(
+            {"set_aw", "register_mv", "flag_ew"})
 
     def set_evict_handler(self, fn: Callable[[Any, str], None]) -> None:
         def handler(key, type_name):

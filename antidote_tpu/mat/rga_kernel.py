@@ -60,8 +60,7 @@ def _lexsort2(primary, secondary):
     return o1[o2]
 
 
-@partial(jax.jit, static_argnames=("actor_bits",))
-def rga_merge(
+def _merge_impl(
     ins_lamport: jax.Array,  # int32[N] lamport of inserted vertex
     ins_actor: jax.Array,    # int32[N] actor (origin DC) of vertex
     ref_lamport: jax.Array,  # int32[N] lamport of left-neighbour ref (0=head)
@@ -73,16 +72,7 @@ def rga_merge(
     del_valid: jax.Array,    # bool[M]
     actor_bits: int = 8,
 ):
-    """Merge a full RGA op log in one shot.
-
-    Returns ``(doc, n_visible, rank, visible)``:
-    - ``doc``: int32[N] — ``elem`` of visible vertices in document order,
-      padded with -1;
-    - ``n_visible``: int32 scalar;
-    - ``rank``: int32[N] preorder position of every vertex (1-based;
-      padding lanes get huge ranks);
-    - ``visible``: bool[N] — inserted, not tombstoned, not padding.
-    """
+    """Shared merge body; see :func:`rga_merge` / :func:`rga_merge_full`."""
     n = ins_lamport.shape[0]
     root = n            # virtual root vertex index
     parked = n + 1      # where padding / unresolvable lanes go
@@ -153,11 +143,16 @@ def rga_merge(
     # vertices whose tour actually ends at up_root are in the document
     # (a vertex under a parked/unresolvable ancestor terminates at that
     # ancestor's up-slot instead — excluded, with its whole subtree).
-    rank = dist[root] - dist[jnp.arange(n, dtype=jnp.int32)]
+    vv = jnp.arange(n, dtype=jnp.int32)
+    rank = dist[root] - dist[vv]
     reachable = (
         valid & (parent != parked)
-        & (fin[jnp.arange(n, dtype=jnp.int32)] == up + root))
+        & (fin[vv] == up + root))
     rank = jnp.where(reachable, rank, _I32MAX)
+    # subtree size: the tour walks 2*size-1 steps from down(v) to up(v)
+    subtree = jnp.where(
+        reachable, (dist[vv] - dist[up + vv] + 1) // 2, 0
+    ).astype(jnp.int32)
 
     # -- tombstones -------------------------------------------------------
     duid = pack_uid(del_lamport, del_actor, actor_bits)
@@ -173,4 +168,50 @@ def rga_merge(
     doc_perm = jnp.argsort(key)
     doc = jnp.where(
         visible[doc_perm], elem[doc_perm].astype(jnp.int32), -1)
-    return doc, jnp.sum(visible).astype(jnp.int32), rank, visible
+    return dict(doc=doc, n_visible=jnp.sum(visible).astype(jnp.int32),
+                rank=rank, visible=visible, reachable=reachable,
+                deleted=deleted, subtree=subtree, parent=parent, uid=uid)
+
+
+@partial(jax.jit, static_argnames=("actor_bits",))
+def rga_merge(
+    ins_lamport: jax.Array,  # int32[N] lamport of inserted vertex
+    ins_actor: jax.Array,    # int32[N] actor (origin DC) of vertex
+    ref_lamport: jax.Array,  # int32[N] lamport of left-neighbour ref (0=head)
+    ref_actor: jax.Array,    # int32[N] actor of ref
+    elem: jax.Array,         # int32[N] interned payload token
+    valid: jax.Array,        # bool[N]
+    del_lamport: jax.Array,  # int32[M] delete targets
+    del_actor: jax.Array,    # int32[M]
+    del_valid: jax.Array,    # bool[M]
+    actor_bits: int = 8,
+):
+    """Merge a full RGA op log in one shot.
+
+    Returns ``(doc, n_visible, rank, visible)``:
+    - ``doc``: int32[N] — ``elem`` of visible vertices in document order,
+      padded with -1;
+    - ``n_visible``: int32 scalar;
+    - ``rank``: int32[N] preorder position of every vertex (1-based;
+      padding lanes get huge ranks);
+    - ``visible``: bool[N] — inserted, not tombstoned, not padding.
+    """
+    r = _merge_impl(ins_lamport, ins_actor, ref_lamport, ref_actor,
+                    elem, valid, del_lamport, del_actor, del_valid,
+                    actor_bits)
+    return r["doc"], r["n_visible"], r["rank"], r["visible"]
+
+
+@partial(jax.jit, static_argnames=("actor_bits",))
+def rga_merge_full(ins_lamport, ins_actor, ref_lamport, ref_actor,
+                   elem, valid, del_lamport, del_actor, del_valid,
+                   actor_bits: int = 8):
+    """:func:`rga_merge` variant for the incremental store's fold path
+    (antidote_tpu/mat/rga_store.py).  Returns the full internals dict:
+    ``rank`` (preorder, 1-based, tombstones ranked — they stay in the
+    folded base as splice anchors), ``reachable``, ``deleted``,
+    ``subtree`` sizes (preorder sub_end = rank-1 + size, the child-
+    splice bound), ``visible``, ``doc``, ``n_visible``."""
+    return _merge_impl(ins_lamport, ins_actor, ref_lamport, ref_actor,
+                       elem, valid, del_lamport, del_actor, del_valid,
+                       actor_bits)

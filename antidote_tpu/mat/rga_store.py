@@ -1,0 +1,471 @@
+"""Incremental RGA store — steady-state collaborative editing on device.
+
+The one-shot kernel (antidote_tpu/mat/rga_kernel.py) re-merges the whole
+op log per call: O(history) per edit burst, unusable for a living
+document (the reference's RGA materializes incrementally inside its
+gen_server; SURVEY §5.7 names the long-log case a first-class target).
+This store splits the document into
+
+- a **base**: the stable prefix, materialized once into a frozen
+  preorder (uid, parent-uid, element, live flag, subtree extent), and
+- a **window**: the unstable op tail, kept as dense op lanes.
+
+Reads merge only the window — O(window · log) for the tree/rank work —
+then splice each window subtree into the base by binary search and
+assemble the document with one O(doc) sort.  Steady-state cost drops
+from "re-run the full multi-round merge over all history" to "tiny
+merge + one sort", and the fold (the only full-history pass) amortizes
+over its GC cadence.  The splice is exact RGA order, not an
+approximation: a window vertex anchored at base vertex V must sit among
+V's already-folded children in uid-descending order, so the base keeps a
+child-search index sorted by ``(parent_uid, uid desc)`` and the splice
+position for a root with uid *u* is the preorder position of V's first
+child with uid < u (else the end of V's subtree).  Sibling-order
+correctness against folded siblings is exactly what naive
+"append-after-anchor" schemes get wrong.
+
+Folding (at a stability threshold, the GST analogue) runs the full
+merge ONCE over base + newly-stable window ops — tombstones keep their
+rows (they remain splice anchors) but drop their live flag — and
+rebuilds the preorder/search arrays; the window compacts to its
+unstable suffix.  Fold cost is O(doc) but amortized at GC cadence, like
+the reference's ``?OPS_THRESHOLD`` materializer GC.
+
+Stability gives the two invariants the split relies on (same GST
+contract as the OR-Set store, mat/store.py):
+- causal closure: a stable vertex's parent is stable (or base), so the
+  stable set folds as whole subtrees hanging off the base;
+- no stable op is still in flight, so folded positions are final.
+
+All shapes are static (PB base rows, NW window lanes, MD delete lanes);
+capacity growth is a host-side repack.  Commit stamps are scalar int32
+(the caller maps its VC-stability horizon to a scalar frontier, as the
+config-4 bench does with per-op commit indices).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.mat import rga_kernel
+from antidote_tpu.mat.rga_kernel import _I32MAX, pack_uid
+
+_I64MAX = jnp.iinfo(jnp.int64).max
+
+
+@dataclass
+class RgaStoreState:
+    """Device arrays for one RGA document (a pytree).
+
+    Base rows sit in document preorder; ``bsort_*`` is the uid-sorted
+    view for lookups and ``ckey/cpos`` the (parent, uid-desc) child
+    index for splices.  ``actor_bits`` is the uid packing width."""
+
+    # base, in preorder (padding rows: buid = _I32MAX)
+    buid: jax.Array       # int32[PB] packed uids
+    bparent: jax.Array    # int32[PB] parent uid (0 = document head)
+    belem: jax.Array      # int32[PB]
+    blive: jax.Array      # bool[PB] (False = tombstone kept as anchor)
+    bsub_end: jax.Array   # int32[PB] preorder index one past the subtree
+    bn: jax.Array         # int32[] used rows
+    # uid-sorted base view
+    bsort_uid: jax.Array  # int32[PB]
+    bsort_pos: jax.Array  # int32[PB] preorder index of that uid
+    # child-search index, sorted by packed (parent_uid, uid desc)
+    ckey: jax.Array       # int64[PB]
+    cpos: jax.Array       # int32[PB]
+    # window op lanes
+    wlam: jax.Array       # int32[NW]
+    wact: jax.Array       # int32[NW]
+    wrlam: jax.Array      # int32[NW] left-neighbour ref (0 = head)
+    wract: jax.Array      # int32[NW]
+    welem: jax.Array      # int32[NW]
+    wcommit: jax.Array    # int32[NW] scalar commit stamp
+    wn: jax.Array         # int32[]
+    # pending delete lanes
+    dlam: jax.Array       # int32[MD]
+    dact: jax.Array       # int32[MD]
+    dcommit: jax.Array    # int32[MD]
+    dn: jax.Array         # int32[]
+    actor_bits: int
+
+    @property
+    def pb(self) -> int:
+        return self.buid.shape[0]
+
+    @property
+    def nw(self) -> int:
+        return self.wlam.shape[0]
+
+    @property
+    def md(self) -> int:
+        return self.dlam.shape[0]
+
+
+jax.tree_util.register_dataclass(
+    RgaStoreState,
+    data_fields=["buid", "bparent", "belem", "blive", "bsub_end", "bn",
+                 "bsort_uid", "bsort_pos", "ckey", "cpos",
+                 "wlam", "wact", "wrlam", "wract", "welem", "wcommit",
+                 "wn", "dlam", "dact", "dcommit", "dn"],
+    meta_fields=["actor_bits"],
+)
+
+
+def rga_store_init(pb: int, nw: int, md: int,
+                   actor_bits: int = 8) -> RgaStoreState:
+    i32 = lambda shape, fill=0: jnp.full(shape, fill, jnp.int32)
+    return RgaStoreState(
+        buid=i32((pb,), _I32MAX), bparent=i32((pb,)), belem=i32((pb,)),
+        blive=jnp.zeros((pb,), bool), bsub_end=i32((pb,)),
+        bn=jnp.zeros((), jnp.int32),
+        bsort_uid=i32((pb,), _I32MAX), bsort_pos=i32((pb,)),
+        ckey=jnp.full((pb,), _I64MAX, jnp.int64), cpos=i32((pb,)),
+        wlam=i32((nw,)), wact=i32((nw,)), wrlam=i32((nw,)),
+        wract=i32((nw,)), welem=i32((nw,)), wcommit=i32((nw,)),
+        wn=jnp.zeros((), jnp.int32),
+        dlam=i32((md,)), dact=i32((md,)), dcommit=i32((md,)),
+        dn=jnp.zeros((), jnp.int32),
+        actor_bits=actor_bits,
+    )
+
+
+def _ckey_pack(parent_uid, uid):
+    """int64 child-search key: (parent asc, uid desc)."""
+    return ((parent_uid.astype(jnp.int64) << 32)
+            | (jnp.int64(_I32MAX) - uid.astype(jnp.int64)))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def rga_append(st: RgaStoreState, ins_lamport, ins_actor, ref_lamport,
+               ref_actor, elem, ins_commit, del_lamport, del_actor,
+               del_commit):
+    """Append one op block (B insert lanes + C delete lanes) into the
+    window.  Returns (state, ok) — ok=False means the window or delete
+    lanes are full: the caller folds (or grows) and retries."""
+    b = ins_lamport.shape[0]
+    c = del_lamport.shape[0]
+    ok = (st.wn + b <= st.nw) & (st.dn + c <= st.md)
+    i32 = lambda a: a.astype(jnp.int32)
+
+    def put(dst, src):
+        upd = jax.lax.dynamic_update_slice(
+            dst, i32(src), (jnp.where(ok, st.wn, 0),))
+        return jnp.where(ok, upd, dst)
+
+    def putd(dst, src):
+        upd = jax.lax.dynamic_update_slice(
+            dst, i32(src), (jnp.where(ok, st.dn, 0),))
+        return jnp.where(ok, upd, dst)
+
+    return replace(
+        st,
+        wlam=put(st.wlam, ins_lamport), wact=put(st.wact, ins_actor),
+        wrlam=put(st.wrlam, ref_lamport), wract=put(st.wract, ref_actor),
+        welem=put(st.welem, elem), wcommit=put(st.wcommit, ins_commit),
+        wn=jnp.where(ok, st.wn + b, st.wn),
+        dlam=putd(st.dlam, del_lamport), dact=putd(st.dact, del_actor),
+        dcommit=putd(st.dcommit, del_commit),
+        dn=jnp.where(ok, st.dn + c, st.dn),
+    ), ok
+
+
+@jax.jit
+def rga_read(st: RgaStoreState):
+    """Materialize the document: merge the window forest and splice it
+    into the base preorder.  Returns (doc int32[PB+NW] padded with -1,
+    n_visible int32)."""
+    nw, pb = st.nw, st.pb
+    bits = st.actor_bits
+    lanes = jnp.arange(nw, dtype=jnp.int32)
+    in_window = lanes < st.wn
+
+    wuid = pack_uid(st.wlam, st.wact, bits)
+    # park invalid lanes, duplicates of base rows, and in-window dups
+    in_base = _bsearch_hit(st.bsort_uid, wuid)[0]
+    wuid = jnp.where(in_window & ~in_base, wuid, _I32MAX)
+    by_uid = jnp.argsort(wuid)
+    sorted_uid = wuid[by_uid]
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((1,), bool), sorted_uid[1:] == sorted_uid[:-1]])
+    dup = jnp.zeros((nw,), bool).at[by_uid].set(dup_sorted)
+    wuid = jnp.where(dup, _I32MAX, wuid)
+    valid = wuid != _I32MAX
+
+    ref = pack_uid(st.wrlam, st.wract, bits)
+    # parent resolution: window first, then base anchor, else parked
+    wpos = jnp.searchsorted(sorted_uid, ref)
+    wcp = jnp.clip(wpos, 0, nw - 1)
+    whit = (wpos < nw) & (sorted_uid[wcp] == ref) & ~dup[by_uid[wcp]]
+    parent_w = by_uid[wcp]
+    bhit, bidx = _bsearch_hit(st.bsort_uid, ref)
+    is_root = valid & ~whit & (bhit | (ref == 0))
+    parked_v = valid & ~whit & ~is_root
+    valid = valid & ~parked_v  # unresolvable: excluded with subtree
+
+    parked = nw  # sentinel vertex
+    # segment key: real parent / unique per root / parked bucket
+    parent_key = jnp.where(
+        whit & valid, parent_w,
+        jnp.where(is_root, nw + 1 + lanes, parked))
+
+    rank, reachable, root_of, fin_ok = _window_tour(
+        parent_key, wuid, valid, is_root, nw)
+
+    # splice position for each root (gathered for every vertex via
+    # root_of): first base child of the anchor with uid < root uid,
+    # else the end of the anchor's subtree (head anchors end at bn)
+    q = _ckey_pack(ref, wuid)
+    ci = jnp.searchsorted(st.ckey, q)
+    cic = jnp.clip(ci, 0, pb - 1)
+    chit = (ci < pb) & ((st.ckey[cic] >> 32) == ref.astype(jnp.int64))
+    anchor_pos = st.bsort_pos[bidx]
+    sub_end = jnp.where(
+        ref == 0, st.bn, st.bsub_end[jnp.clip(anchor_pos, 0, pb - 1)])
+    splice = jnp.where(chit, st.cpos[cic], sub_end)       # [NW] (roots)
+
+    # pending deletes: hide window and base targets
+    duid = pack_uid(st.dlam, st.dact, bits)
+    dvalid = jnp.arange(st.md, dtype=jnp.int32) < st.dn
+    dwp = jnp.searchsorted(sorted_uid, duid)
+    dwc = jnp.clip(dwp, 0, nw - 1)
+    dwhit = dvalid & (dwp < nw) & (sorted_uid[dwc] == duid)
+    deleted_w = jnp.zeros((nw,), bool).at[
+        jnp.where(dwhit, by_uid[dwc], nw)].set(True, mode="drop")
+    dbhit, dbidx = _bsearch_hit(st.bsort_uid, duid)
+    hidden_b = jnp.zeros((pb,), bool).at[
+        jnp.where(dvalid & dbhit, st.bsort_pos[dbidx], pb)
+    ].set(True, mode="drop")
+
+    visible_w = reachable & ~deleted_w
+    bpos_arr = jnp.arange(pb, dtype=jnp.int32)
+    visible_b = st.blive & (bpos_arr < st.bn) & ~hidden_b
+
+    # final order: (splice_pos, tier, uid desc among roots, tour rank)
+    rshift = max(1, (2 * (nw + 1)).bit_length())
+    ruid = wuid[root_of]
+    w_primary = (splice[root_of].astype(jnp.int64) << 1)
+    b_primary = (bpos_arr.astype(jnp.int64) << 1) | 1
+    w_secondary = ((jnp.int64(_I32MAX) - ruid.astype(jnp.int64))
+                   << rshift) | rank.astype(jnp.int64)
+    primary = jnp.concatenate([
+        jnp.where(visible_b, b_primary, _I64MAX),
+        jnp.where(visible_w, w_primary, _I64MAX)])
+    secondary = jnp.concatenate(
+        [jnp.zeros((pb,), jnp.int64), w_secondary])
+    perm = rga_kernel._lexsort2(primary, secondary)
+    elems = jnp.concatenate([st.belem, st.welem])
+    vis = jnp.concatenate([visible_b, visible_w])[perm]
+    doc = jnp.where(vis, elems[perm], -1)
+    return doc, (jnp.sum(visible_b) + jnp.sum(visible_w)).astype(jnp.int32)
+
+
+def _bsearch_hit(sorted_arr, q):
+    """(hit bool[...], index) of q in a sorted int array."""
+    n = sorted_arr.shape[0]
+    p = jnp.searchsorted(sorted_arr, q)
+    c = jnp.clip(p, 0, n - 1)
+    return (p < n) & (sorted_arr[c] == q), c
+
+
+def _window_tour(parent_key, uid, valid, is_root, nw):
+    """Euler tour + Wyllie rank over the window forest.  Returns
+    (rank, reachable, root_of, fin) — rank orders vertices within their
+    subtree (tour distance: order-exact, not dense)."""
+    parked = nw
+    sperm = rga_kernel._lexsort2(parent_key, -uid)
+    sparent = parent_key[sperm]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sparent[1:] != sparent[:-1]])
+    fc_idx = jnp.where(first, sparent, 2 * nw + 3)
+    first_child = jnp.full((nw + 1,), -1, jnp.int32).at[fc_idx].set(
+        sperm.astype(jnp.int32), mode="drop")
+    same = sparent[:-1] == sparent[1:]
+    ns_src = jnp.where(same, sperm[:-1], 2 * nw + 5)
+    next_sib = jnp.full((nw,), -1, jnp.int32).at[ns_src].set(
+        sperm[1:].astype(jnp.int32), mode="drop")
+
+    up = nw + 1
+    s = 2 * (nw + 1)
+    v = jnp.arange(nw + 1, dtype=jnp.int32)
+    fc = first_child[v]
+    succ_down = jnp.where(fc >= 0, fc, up + v)
+    ns = jnp.concatenate([next_sib, jnp.full((1,), -1, jnp.int32)])
+    pk = jnp.concatenate(
+        [parent_key.astype(jnp.int32), jnp.full((1,), parked, jnp.int32)])
+    # non-root, non-parked: up -> next sib | parent's up.  pk < nw is a
+    # real parent; roots/parked handled below
+    par_clip = jnp.clip(pk, 0, nw)
+    succ_up = jnp.where(ns[v] >= 0, ns[v], up + par_clip[v])
+    root_mask = jnp.concatenate([is_root, jnp.zeros((1,), bool)])
+    succ_up = jnp.where(root_mask, up + v, succ_up)  # terminal self-loop
+    parked_mask = jnp.concatenate(
+        [~valid, jnp.ones((1,), bool)])  # incl. sentinel vertex
+    succ_down = jnp.where(parked_mask, v, succ_down)
+    succ_up = jnp.where(parked_mask, up + v, succ_up)
+    succ = jnp.concatenate([succ_down, succ_up])
+
+    slot = jnp.arange(s, dtype=jnp.int32)
+    dist = (succ != slot).astype(jnp.int32)
+    steps = max(1, (s - 1).bit_length())
+
+    def body(_, c):
+        d, nx = c
+        return d + d[nx], nx[nx]
+
+    dist, fin = jax.lax.fori_loop(0, steps, body, (dist, succ))
+    vw = jnp.arange(nw, dtype=jnp.int32)
+    # reachable iff the chain terminates at an anchored root's up-slot
+    is_root_up = jnp.concatenate(
+        [jnp.zeros((nw + 1,), bool), root_mask])
+    term = fin[vw]
+    reachable = valid & is_root_up[jnp.clip(term, 0, s - 1)]
+    root_of = jnp.clip(term - up, 0, nw - 1)
+    rank = dist[root_of] - dist[vw]          # 0 at the root, tour order
+    rank = jnp.where(reachable, rank, 0)
+    return rank, reachable, root_of, fin
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=())
+def rga_fold(st: RgaStoreState, threshold):
+    """Fold window ops with commit <= threshold into the base: one full
+    merge over base + stable window (the amortized GC; tombstoned
+    vertices keep their rows as anchors), then compact the window to its
+    unstable suffix.  Requires the folded base to fit PB rows (the host
+    wrapper grows first; see rga_fold_host)."""
+    nw, pb, md = st.nw, st.pb, st.md
+    bits = st.actor_bits
+    mask32 = (1 << bits) - 1
+
+    lanes = jnp.arange(nw, dtype=jnp.int32)
+    in_window = lanes < st.wn
+    stable_w = in_window & (st.wcommit <= threshold)
+    # duplicate deliveries of base rows must not re-enter the merge (a
+    # kept window copy would shadow the base row's tombstone flag);
+    # they are dropped from the window instead
+    wuid_w = pack_uid(st.wlam, st.wact, bits)
+    base_dup = in_window & _bsearch_hit(st.bsort_uid, wuid_w)[0]
+    stable_w = stable_w & ~base_dup
+    dlanes = jnp.arange(md, dtype=jnp.int32)
+    stable_d = (dlanes < st.dn) & (st.dcommit <= threshold)
+
+    bpos = jnp.arange(pb, dtype=jnp.int32)
+    in_base = bpos < st.bn
+    blam = (st.buid >> bits).astype(jnp.int32)
+    bact = (st.buid & mask32).astype(jnp.int32)
+    bplam = (st.bparent >> bits).astype(jnp.int32)
+    bpact = (st.bparent & mask32).astype(jnp.int32)
+
+    ins_lam = jnp.concatenate([jnp.where(in_base, blam, 0), st.wlam])
+    ins_act = jnp.concatenate([jnp.where(in_base, bact, 0), st.wact])
+    ref_lam = jnp.concatenate([bplam, st.wrlam])
+    ref_act = jnp.concatenate([bpact, st.wract])
+    elem = jnp.concatenate([st.belem, st.welem])
+    valid = jnp.concatenate([in_base, stable_w])
+    prev_live = jnp.concatenate(
+        [st.blive, jnp.ones((nw,), bool)])
+
+    r = rga_kernel.rga_merge_full(
+        ins_lam, ins_act, ref_lam, ref_act, elem, valid,
+        st.dlam, st.dact, stable_d, actor_bits=bits)
+
+    t = pb + nw
+    rank = jnp.where(r["reachable"], r["rank"], _I32MAX)
+    perm = jnp.argsort(rank)
+    n_new = jnp.sum(r["reachable"]).astype(jnp.int32)
+    live = prev_live & ~r["deleted"]
+    parent = r["parent"]
+    parent_uid = jnp.where(
+        parent >= t, 0,
+        r["uid"][jnp.clip(parent, 0, t - 1)]).astype(jnp.int32)
+
+    take = lambda a: a[perm][:pb]
+    reach_s = take(r["reachable"])
+    new_pos = jnp.arange(pb, dtype=jnp.int32)
+    buid = jnp.where(reach_s, take(r["uid"]).astype(jnp.int32), _I32MAX)
+    bparent = jnp.where(reach_s, take(parent_uid), 0)
+    belem = jnp.where(reach_s, take(elem), 0)
+    blive = reach_s & take(live)
+    bsub_end = jnp.where(
+        reach_s, new_pos + take(r["subtree"]), 0)
+
+    sort_perm = jnp.argsort(buid)
+    bsort_uid = buid[sort_perm]
+    bsort_pos = new_pos[sort_perm]
+
+    ck = jnp.where(reach_s.astype(jnp.int64) > 0,
+                   _ckey_pack(bparent, buid), _I64MAX)
+    ck_perm = jnp.argsort(ck)
+    ckey = ck[ck_perm]
+    cpos = new_pos[ck_perm]
+
+    # compact the window to the unstable suffix (stable order
+    # preserved); folded ops and base duplicates both drop
+    keep_w = in_window & ~stable_w & ~base_dup
+    worder = jnp.argsort(~keep_w, stable=True)
+    wn_new = jnp.sum(keep_w).astype(jnp.int32)
+    cw = lambda a: jnp.where(jnp.arange(nw) < wn_new, a[worder], 0)
+    keep_d = (dlanes < st.dn) & ~stable_d
+    dorder = jnp.argsort(~keep_d, stable=True)
+    dn_new = jnp.sum(keep_d).astype(jnp.int32)
+    cd = lambda a: jnp.where(jnp.arange(md) < dn_new, a[dorder], 0)
+
+    return replace(
+        st,
+        buid=buid, bparent=bparent, belem=belem, blive=blive,
+        bsub_end=bsub_end, bn=n_new,
+        bsort_uid=bsort_uid, bsort_pos=bsort_pos, ckey=ckey, cpos=cpos,
+        wlam=cw(st.wlam), wact=cw(st.wact), wrlam=cw(st.wrlam),
+        wract=cw(st.wract), welem=cw(st.welem), wcommit=cw(st.wcommit),
+        wn=wn_new,
+        dlam=cd(st.dlam), dact=cd(st.dact), dcommit=cd(st.dcommit),
+        dn=dn_new,
+    ), n_new
+
+
+def rga_grow(st: RgaStoreState, pb: int | None = None,
+             nw: int | None = None, md: int | None = None) -> RgaStoreState:
+    """Host-side capacity regrade (never shrinks); rare."""
+    pb = max(pb or st.pb, st.pb)
+    nw = max(nw or st.nw, st.nw)
+    md = max(md or st.md, st.md)
+    if (pb, nw, md) == (st.pb, st.nw, st.md):
+        return st
+
+    def pad(a, n, fill=0):
+        a = np.asarray(a)
+        return jnp.asarray(np.pad(a, (0, n - len(a)),
+                                  constant_values=fill))
+
+    return RgaStoreState(
+        buid=pad(st.buid, pb, _I32MAX), bparent=pad(st.bparent, pb),
+        belem=pad(st.belem, pb), blive=pad(st.blive, pb, False),
+        bsub_end=pad(st.bsub_end, pb), bn=st.bn,
+        bsort_uid=pad(st.bsort_uid, pb, _I32MAX),
+        bsort_pos=pad(st.bsort_pos, pb),
+        ckey=pad(st.ckey, pb, int(_I64MAX)), cpos=pad(st.cpos, pb),
+        wlam=pad(st.wlam, nw), wact=pad(st.wact, nw),
+        wrlam=pad(st.wrlam, nw), wract=pad(st.wract, nw),
+        welem=pad(st.welem, nw), wcommit=pad(st.wcommit, nw), wn=st.wn,
+        dlam=pad(st.dlam, md), dact=pad(st.dact, md),
+        dcommit=pad(st.dcommit, md), dn=st.dn,
+        actor_bits=st.actor_bits,
+    )
+
+
+def rga_fold_host(st: RgaStoreState, threshold: int):
+    """Host wrapper around :func:`rga_fold`: grows the base first when
+    the folded document might not fit (worst case bn + stable window)."""
+    need = int(st.bn) + int(st.wn)
+    if need > st.pb:
+        new_pb = st.pb
+        while new_pb < need:
+            new_pb *= 2
+        st = rga_grow(st, pb=new_pb)
+    st, _bn = rga_fold(st, jnp.asarray(threshold, jnp.int32))
+    return st

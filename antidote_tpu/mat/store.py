@@ -58,6 +58,26 @@ from antidote_tpu.mat import kernels
 _ELEM, _ISADD, _DOTDC, _DOTSEQ, _OPDC, _OPCT, _NSCAL = 0, 1, 2, 3, 4, 5, 6
 
 
+def _gather_key_rows(st, key_idx: jax.Array, read_vc: jax.Array,
+                     dc_col: int, ct_col: int, ss_off: int):
+    """Shared transaction-read gather: the B requested keys' ring rows
+    plus their Clock-SI inclusion mask at ``read_vc``.  Returns
+    (ops[B, L, F], mask[B, L]).  Every per-type ``*_read_keys`` is this
+    gather + that type's fold over its own columns."""
+    L = st.n_lanes
+    d = st._d
+    flat = key_idx[:, None] * L + jnp.arange(L, dtype=key_idx.dtype)
+    ops = st.ops[flat]                                   # [B, L, F]
+    valid = st.valid[flat]                               # [B, L]
+    B = key_idx.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (B, d))
+    has_base = jnp.broadcast_to(st.has_base, (B,))
+    mask = kernels.inclusion_mask(
+        ops[..., dc_col], ops[..., ct_col], ops[..., ss_off:ss_off + d],
+        valid, base_vc, has_base, read_vc)
+    return ops, mask
+
+
 def _free_lanes(valid2d: jax.Array, key_idx: jax.Array,
                 lane_off: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Lane for each batch op = its (lane_off+1)-th free slot; lane == L
@@ -269,26 +289,13 @@ def orset_read_keys(st: OrsetShardState, key_idx: jax.Array,
     the same inclusion-mask + lattice fold as the full-shard read.
     Requires read_vc >= base_vc (callers fall back to log replay below
     the base, the reference's snapshot-cache miss)."""
-    L = st.n_lanes
     d = st._d
-    flat = key_idx[:, None] * L + jnp.arange(L, dtype=key_idx.dtype)
-    ops = st.ops[flat]                                   # [B, L, F]
-    valid = st.valid[flat]                               # [B, L]
-    elem = ops[..., _ELEM]
-    is_add = ops[..., _ISADD] != 0
-    dot_dc = ops[..., _DOTDC]
-    dot_seq = ops[..., _DOTSEQ]
-    op_dc = ops[..., _OPDC]
-    op_ct = ops[..., _OPCT]
-    obs_vv = ops[..., _NSCAL:_NSCAL + d]
-    op_ss = ops[..., _NSCAL + d:]
-    B = key_idx.shape[0]
-    base_vc = jnp.broadcast_to(st.base_vc, (B, d))
-    has_base = jnp.broadcast_to(st.has_base, (B,))
-    mask = kernels.inclusion_mask(
-        op_dc, op_ct, op_ss, valid, base_vc, has_base, read_vc)
+    ops, mask = _gather_key_rows(st, key_idx, read_vc,
+                                 _OPDC, _OPCT, _NSCAL + d)
     return kernels.orset_apply(
-        st.dots[key_idx], elem, is_add, dot_dc, dot_seq, obs_vv, mask)
+        st.dots[key_idx], ops[..., _ELEM], ops[..., _ISADD] != 0,
+        ops[..., _DOTDC], ops[..., _DOTSEQ], ops[..., _NSCAL:_NSCAL + d],
+        mask)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -340,6 +347,282 @@ def orset_grow(st: OrsetShardState, n_keys: int | None = None,
         ops=jnp.asarray(ops.reshape(nk * L, -1)),
         valid=jnp.asarray(valid.reshape(-1)),
         n_lanes=L,
+    )
+
+
+# ---------------------------------------------------------------------------
+# register_mv shard — the OR-Set ring layout with a cross-slot fold
+#
+# An MV-register is structurally an OR-Set over *value slots*: an assign
+# mints a dot for its value and cancels the dots it observed, concurrent
+# assigns keep multiple live slots (reference antidote_crdt_register_mv
+# semantics, crdt/registers.py host oracle).  The one difference is the
+# cancellation scope: an assign's observed VV kills dots in EVERY slot
+# (it observed the whole register), not just its own slot — which is
+# exactly kernels.mvreg_apply vs kernels.orset_apply.  The ring layout,
+# append, purge, and grow are therefore shared with the OR-Set
+# (OrsetShardState; a reset is a row with val_slot=E, dot_seq=0 — it
+# contributes only its observed VV).
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def mvreg_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
+    """Fold stable assigns into the base dot table (same stability
+    contract as orset_gc)."""
+    cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
+    stable = st.valid2d & dense.le(cvc, gst[None, None, :])
+    dots = kernels.mvreg_apply(
+        st.dots, st.elem_slot, st.dot_dc, st.dot_seq, st.obs_vv, stable)
+    return replace(
+        st,
+        dots=dots,
+        base_vc=jnp.maximum(st.base_vc, gst.astype(st.base_vc.dtype)),
+        has_base=jnp.ones((), dtype=bool),
+        valid=st.valid & ~stable.reshape(-1),
+    )
+
+
+@jax.jit
+def mvreg_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
+    """int[K, E, D]: live value-slot dot tables at ``read_vc``."""
+    K = st.dots.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
+    has_base = jnp.broadcast_to(st.has_base, (K,))
+    mask = kernels.inclusion_mask(
+        st.op_dc, st.op_ct, st.op_ss, st.valid2d, base_vc, has_base,
+        read_vc)
+    return kernels.mvreg_apply(
+        st.dots, st.elem_slot, st.dot_dc, st.dot_seq, st.obs_vv, mask)
+
+
+@jax.jit
+def mvreg_read_keys(st: OrsetShardState, key_idx: jax.Array,
+                    read_vc: jax.Array) -> jax.Array:
+    """int[B, E, D]: live dot tables for just the requested keys (the
+    transaction read path; see orset_read_keys)."""
+    d = st._d
+    ops, mask = _gather_key_rows(st, key_idx, read_vc,
+                                 _OPDC, _OPCT, _NSCAL + d)
+    return kernels.mvreg_apply(
+        st.dots[key_idx], ops[..., _ELEM], ops[..., _DOTDC],
+        ops[..., _DOTSEQ], ops[..., _NSCAL:_NSCAL + d], mask)
+
+
+# ---------------------------------------------------------------------------
+# register_lww shard — packed ring over (ts, tiebreak, value-id) rows
+#
+# Last-writer-wins needs no dot algebra: the fold is a lexicographic max
+# over (ts, tie) among the base and every included op
+# (kernels.lww_read), which is commutative/idempotent, so GC folding and
+# ring fragmentation are free exactly as for the OR-Set.  The tiebreak
+# is a host-packed int64 (actor rank << seq bits | seq; the device plane
+# owns the rank directory and repacks on actor arrival) so the device
+# compare matches the host oracle's (ts, (actor, seq)) order
+# (crdt/registers.py RegisterLWW.update).
+
+# packed columns (lww): [ts, tie, val, op_dc, op_ct, op_ss(D)]
+_LTS, _LTIE, _LVAL, _LOPDC, _LOPCT, _LNSCAL = 0, 1, 2, 3, 4, 5
+
+
+@dataclass
+class LwwShardState:
+    """``ops[K*L, 5+D]`` packs [ts, tie, val, op_dc, op_ct, op_ss(D)];
+    base value id -1 = unwritten (host maps to the empty register)."""
+
+    base_ts: jax.Array   # int[K]
+    base_tie: jax.Array  # int[K]
+    base_val: jax.Array  # int[K] interned value ids (-1 = none)
+    base_vc: jax.Array   # int[D]
+    has_base: jax.Array  # bool[]
+    ops: jax.Array       # int[K*L, 5+D]
+    valid: jax.Array     # bool[K*L]
+    n_lanes: int
+
+    @property
+    def _d(self) -> int:
+        return self.ops.shape[-1] - _LNSCAL
+
+    def _col(self, c) -> jax.Array:
+        return self.ops[:, c].reshape(-1, self.n_lanes)
+
+    @property
+    def valid2d(self) -> jax.Array:
+        return self.valid.reshape(-1, self.n_lanes)
+
+    @property
+    def op_ts(self):
+        return self._col(_LTS)
+
+    @property
+    def op_tie(self):
+        return self._col(_LTIE)
+
+    @property
+    def op_val(self):
+        return self._col(_LVAL)
+
+    @property
+    def op_dc(self):
+        return self._col(_LOPDC)
+
+    @property
+    def op_ct(self):
+        return self._col(_LOPCT)
+
+    @property
+    def op_ss(self):
+        return self.ops[:, _LNSCAL:].reshape(-1, self.n_lanes, self._d)
+
+
+jax.tree_util.register_dataclass(
+    LwwShardState,
+    data_fields=["base_ts", "base_tie", "base_val", "base_vc",
+                 "has_base", "ops", "valid"],
+    meta_fields=["n_lanes"],
+)
+
+
+def lww_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
+                   dtype=jnp.int64) -> LwwShardState:
+    K, L, D = n_keys, n_lanes, n_dcs
+    return LwwShardState(
+        base_ts=jnp.zeros((K,), dtype=dtype),
+        base_tie=jnp.zeros((K,), dtype=dtype),
+        base_val=jnp.full((K,), -1, dtype=dtype),
+        base_vc=jnp.zeros((D,), dtype=dtype),
+        has_base=jnp.zeros((), dtype=bool),
+        ops=jnp.zeros((K * L, _LNSCAL + D), dtype=dtype),
+        valid=jnp.zeros((K * L,), dtype=bool),
+        n_lanes=L,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def lww_append(st: LwwShardState, key_idx, lane_off, ts, tie, val,
+               op_dc, op_ct, op_ss):
+    dt = st.ops.dtype
+    L = st.n_lanes
+    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
+    col = lambda a: a.astype(dt)[:, None]
+    rows = jnp.concatenate(
+        [col(ts), col(tie), col(val), col(op_dc), col(op_ct),
+         op_ss.astype(dt)], axis=1)
+    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
+    ops = st.ops.at[flat].set(rows, mode="drop")
+    valid = st.valid.at[flat].set(True, mode="drop")
+    return replace(st, ops=ops, valid=valid), overflow
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def lww_gc(st: LwwShardState, gst: jax.Array) -> LwwShardState:
+    cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
+    stable = st.valid2d & dense.le(cvc, gst[None, None, :])
+    bts, btie, bval = kernels.lww_read(
+        st.base_ts, st.base_tie, st.base_val,
+        st.op_ts, st.op_tie, st.op_val, stable)
+    return replace(
+        st,
+        base_ts=bts, base_tie=btie, base_val=bval,
+        base_vc=jnp.maximum(st.base_vc, gst.astype(st.base_vc.dtype)),
+        has_base=jnp.ones((), dtype=bool),
+        valid=st.valid & ~stable.reshape(-1),
+    )
+
+
+@jax.jit
+def lww_read(st: LwwShardState, read_vc: jax.Array):
+    """(ts, tie, val)[K] at ``read_vc``."""
+    K = st.base_ts.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
+    has_base = jnp.broadcast_to(st.has_base, (K,))
+    mask = kernels.inclusion_mask(
+        st.op_dc, st.op_ct, st.op_ss, st.valid2d, base_vc, has_base,
+        read_vc)
+    return kernels.lww_read(
+        st.base_ts, st.base_tie, st.base_val,
+        st.op_ts, st.op_tie, st.op_val, mask)
+
+
+@jax.jit
+def lww_read_keys(st: LwwShardState, key_idx: jax.Array,
+                  read_vc: jax.Array):
+    """(ts, tie, val)[B] for just the requested keys."""
+    ops, mask = _gather_key_rows(st, key_idx, read_vc,
+                                 _LOPDC, _LOPCT, _LNSCAL)
+    return kernels.lww_read(
+        st.base_ts[key_idx], st.base_tie[key_idx], st.base_val[key_idx],
+        ops[..., _LTS], ops[..., _LTIE], ops[..., _LVAL], mask)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def lww_purge_keys(st: LwwShardState, key_idx: jax.Array) -> LwwShardState:
+    L = st.n_lanes
+    flat = (key_idx[:, None] * L
+            + jnp.arange(L, dtype=key_idx.dtype)).reshape(-1)
+    return replace(
+        st,
+        valid=st.valid.at[flat].set(False, mode="drop"),
+        base_ts=st.base_ts.at[key_idx].set(0, mode="drop"),
+        base_tie=st.base_tie.at[key_idx].set(0, mode="drop"),
+        base_val=st.base_val.at[key_idx].set(-1, mode="drop"),
+    )
+
+
+def lww_grow(st: LwwShardState, n_keys: int | None = None,
+             n_dcs: int | None = None) -> LwwShardState:
+    """Host-side capacity regrade (see orset_grow)."""
+    K = st.base_ts.shape[0]
+    D = st._d
+    L = st.n_lanes
+    nk, nd = (n_keys or K), (n_dcs or D)
+    if (nk, nd) == (K, D):
+        return st
+    ops = np.asarray(st.ops).reshape(K, L, -1)
+    scal = ops[..., :_LNSCAL]
+    ss = ops[..., _LNSCAL:]
+    ops = np.concatenate(
+        [scal, np.pad(ss, ((0, 0), (0, 0), (0, nd - D)))], axis=-1)
+    if nk > K:
+        ops = np.pad(ops, ((0, nk - K), (0, 0), (0, 0)))
+    valid = np.pad(np.asarray(st.valid).reshape(K, L), ((0, nk - K), (0, 0)))
+    pad1 = lambda a, fill: np.pad(np.asarray(a), (0, nk - K),
+                                  constant_values=fill)
+    return LwwShardState(
+        base_ts=jnp.asarray(pad1(st.base_ts, 0)),
+        base_tie=jnp.asarray(pad1(st.base_tie, 0)),
+        base_val=jnp.asarray(pad1(st.base_val, -1)),
+        base_vc=jnp.asarray(np.pad(np.asarray(st.base_vc), (0, nd - D))),
+        has_base=st.has_base,
+        ops=jnp.asarray(ops.reshape(nk * L, -1)),
+        valid=jnp.asarray(valid.reshape(-1)),
+        n_lanes=L,
+    )
+
+
+def lww_retie(st: LwwShardState, remap: np.ndarray,
+              rank_shift: int) -> LwwShardState:
+    """Host-side tiebreak repack after the actor-rank directory grows:
+    every stored tie (rank << rank_shift | seq) has its rank remapped
+    through ``remap`` (old rank -> new rank).  Rare (first sight of a
+    new actor), so simplicity over speed."""
+    mask = (1 << rank_shift) - 1
+
+    def repack(packed, live):
+        packed = np.asarray(packed)
+        rank = (packed >> rank_shift).astype(np.int64)
+        seq = packed & mask
+        rank = np.where(live, remap[np.clip(rank, 0, len(remap) - 1)], rank)
+        return (rank << rank_shift) | seq
+
+    K = st.base_ts.shape[0]
+    L = st.n_lanes
+    base_live = np.asarray(st.base_val) >= 0
+    ops = np.array(np.asarray(st.ops))
+    ops[:, _LTIE] = repack(ops[:, _LTIE], np.asarray(st.valid))
+    return replace(
+        st,
+        base_tie=jnp.asarray(repack(st.base_tie, base_live)),
+        ops=jnp.asarray(ops),
     )
 
 
@@ -460,21 +743,9 @@ def counter_read_keys(st: CounterShardState, key_idx: jax.Array,
                       read_vc: jax.Array) -> jax.Array:
     """int[B]: counter values for just the requested keys at ``read_vc``
     (the transaction read path; see orset_read_keys)."""
-    L = st.n_lanes
-    d = st._d
-    flat = key_idx[:, None] * L + jnp.arange(L, dtype=key_idx.dtype)
-    ops = st.ops[flat]
-    valid = st.valid[flat]
-    delta = ops[..., _CDELTA]
-    op_dc = ops[..., _COPDC]
-    op_ct = ops[..., _COPCT]
-    op_ss = ops[..., _CNSCAL:]
-    B = key_idx.shape[0]
-    base_vc = jnp.broadcast_to(st.base_vc, (B, d))
-    has_base = jnp.broadcast_to(st.has_base, (B,))
-    mask = kernels.inclusion_mask(
-        op_dc, op_ct, op_ss, valid, base_vc, has_base, read_vc)
-    return kernels.counter_read(st.value[key_idx], delta, mask)
+    ops, mask = _gather_key_rows(st, key_idx, read_vc,
+                                 _COPDC, _COPCT, _CNSCAL)
+    return kernels.counter_read(st.value[key_idx], ops[..., _CDELTA], mask)
 
 
 @partial(jax.jit, donate_argnums=(0,))

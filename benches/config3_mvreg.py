@@ -1,40 +1,69 @@
 """BASELINE config 3: MV-Register at 64 simulated DCs.
 
-The hot math is the VC-dominance matrix: every assign carries an
-observed-VV over 64 DC columns; the merge is a masked [K, L, 64]
-max-reduction deciding which concurrent assigns survive
-(antidote_tpu/mat/kernels.py mvreg_apply).  Baseline: host register_mv
-one-op-at-a-time updates.
+Device path: the *shard store* (antidote_tpu/mat/store.py — the MV
+register shares the OR-Set packed ring; mvreg_gc/mvreg_read are the
+cross-slot folds), driven like the live data plane: batched appends,
+amortized GC folds at the batch frontier, and a full-shard read.  The
+hot math is the VC-dominance matrix: every assign carries an observed
+VV over 64 DC columns (kernels.mvreg_apply).  Baseline: host
+register_mv one-op-at-a-time updates.
 """
+
+import time
 
 import numpy as np
 
-from benches._util import emit, setup, timed
+from benches._util import emit, fetch, setup
+from antidote_tpu.mat.synth import orset_batch
 
 
-def device_ops_per_sec(jax, K, L, D, iters=5):
+def device_ops_per_sec(jax, K, B, D, n_steps=8, warmup=2, gc_every=2):
     import jax.numpy as jnp
 
-    from antidote_tpu.mat import kernels
+    from antidote_tpu.mat import store
 
     rng = np.random.default_rng(0)
-    E = 4  # value slots per key
-    base = jnp.zeros((K, E, D), jnp.int32)
-    val_slot = jnp.asarray(rng.integers(0, E, size=(K, L)), jnp.int32)
-    dot_dc = jnp.asarray(rng.integers(0, D, size=(K, L)), jnp.int32)
-    dot_seq = jnp.asarray(
-        rng.integers(1, 1000, size=(K, L)), jnp.int32)
-    obs = jnp.asarray(rng.integers(0, 500, size=(K, L, D)), jnp.int32)
-    mask = jnp.asarray(rng.random((K, L)) < 0.9)
+    clock = np.zeros(D, dtype=np.int32)
+    # the orset stream generator provides causally-plausible assigns
+    # (elem_slot = value slot, obs_vv = observed VV); lane offsets are
+    # host-precomputed exactly as the device plane amortizes them
+    steps = []
+    for _ in range(n_steps + warmup):
+        s = orset_batch(rng, K, B, D, n_dcs=D, clock=clock,
+                        n_elems=4, obs_lag=2)  # match the shard's slots
+        s["lane_off"] = store.batch_lane_offsets(s["key_idx"])
+        steps.append({k: jax.device_put(jnp.asarray(v))
+                      for k, v in s.items()})
 
-    fn = jax.jit(kernels.mvreg_apply)
-    dt = timed(fn, base, val_slot, dot_dc, dot_seq, obs, mask, iters=iters)
-    return K * L / dt
+    st = store.orset_shard_init(K, n_lanes=8, n_slots=4, n_dcs=D,
+                                dtype=jnp.int32)
+
+    def one_step(st, s, do_gc):
+        st, _ov = store.orset_append(
+            st, s["key_idx"], s["lane_off"], s["elem_slot"], s["is_add"],
+            s["dot_dc"], s["dot_seq"], s["obs_vv"], s["op_dc"],
+            s["op_ct"], s["op_ss"])
+        if do_gc:
+            st = store.mvreg_gc(st, s["frontier"])
+        return st
+
+    for i, s in enumerate(steps[:warmup]):
+        st = one_step(st, s, True)
+    fetch(st.dots)
+    t0 = time.perf_counter()
+    fetch(st.dots)
+    oh = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i, s in enumerate(steps[warmup:]):
+        st = one_step(st, s, (i + 1) % gc_every == 0)
+    dots = store.mvreg_read(st, steps[-1]["frontier"])
+    fetch(dots)
+    dt = max(time.perf_counter() - t0 - oh, 1e-9)
+    return B * n_steps / dt
 
 
 def host_ops_per_sec(n_ops=20_000, D=64):
-    import time
-
     from antidote_tpu.crdt import get_type
 
     cls = get_type("register_mv")
@@ -51,11 +80,12 @@ def host_ops_per_sec(n_ops=20_000, D=64):
 def main():
     quick, jax = setup()
     K = 262_144 if not quick else 16_384
-    L = 8
-    dev = device_ops_per_sec(jax, K, L, D=64)
+    B = 32_768 if not quick else 4_096
+    dev = device_ops_per_sec(jax, K, B, D=64)
     host = host_ops_per_sec()
     emit("mvreg_assign_merges_per_sec_64dc", round(dev), "ops/s",
-         round(dev / host, 2), keys=K, lanes=L, dcs=64,
+         round(dev / host, 2), keys=K, batch=B, dcs=64,
+         path="shard store (append + mvreg_gc + mvreg_read)",
          device=str(jax.devices()[0]), host_baseline=round(host))
 
 

@@ -54,10 +54,11 @@ class StreamGen:
         key = self.rng.choice(self.keys)
         ss = VC({d: t for d, t in self.clock.items() if t})
         ct = self._tick(dc)
+        cls = get_type(type_name)
+        st = self.state[dc][key]
         if type_name == "counter_pn":
             eff = self.rng.randint(-5, 5)
-        else:
-            st = self.state[dc][key]
+        elif type_name == "set_aw":
             if st and self.rng.random() < 0.4:
                 e = self.rng.choice(sorted(st))
                 eff = ("rmv", ((e, tuple(sorted(st[e]))),))
@@ -65,14 +66,37 @@ class StreamGen:
                 e = self.rng.choice(self.elems)
                 dot = (dc, ct)
                 eff = ("add", ((e, dot, tuple(sorted(st.get(e, ())))),))
+        elif type_name == "register_mv":
+            st = st if isinstance(st, frozenset) else frozenset()
+            observed = tuple(sorted(d_ for d_, _v in st))
+            if st and self.rng.random() < 0.15:
+                eff = ("reset", observed)
+            else:
+                eff = ("asgn", self.rng.choice(self.elems), (dc, ct),
+                       observed)
+        elif type_name == "flag_ew":
+            st = st if isinstance(st, frozenset) else frozenset()
+            observed = tuple(sorted(st))
+            if self.rng.random() < 0.4:
+                eff = ("dis", observed)
+            else:
+                eff = ("en", (dc, ct), observed)
+        elif type_name == "register_lww":
+            # coarse ts buckets force ties so the tiebreak path runs
+            eff = (ct // 8, (dc, ct), self.rng.choice(self.elems))
+        else:
+            raise AssertionError(type_name)
         p = Payload(key=key, type_name=type_name, effect=eff,
                     commit_dc=dc, commit_time=ct, snapshot_vc=ss,
                     txid=f"tx{ct}")
         # apply to every DC view (causal delivery simulated as immediate)
-        cls = get_type(type_name)
         for d in self.dcs:
-            if type_name == "set_aw":
-                self.state[d][key] = cls.update(eff, self.state[d][key])
+            if type_name in ("set_aw", "register_mv", "flag_ew"):
+                base = self.state[d][key]
+                if type_name != "set_aw" and not isinstance(
+                        base, frozenset):
+                    base = cls.new()
+                self.state[d][key] = cls.update(eff, base)
             self.clock[d] = max(self.clock[d], ct)
         return p
 
@@ -91,7 +115,8 @@ def publish(pm, p, stable):
         pm._publish(p.key, p.type_name, p, stable)
 
 
-@pytest.mark.parametrize("type_name", ["counter_pn", "set_aw"])
+@pytest.mark.parametrize("type_name", [
+    "counter_pn", "set_aw", "register_mv", "register_lww", "flag_ew"])
 def test_stream_oracle_equivalence(tmp_path, type_name):
     """Random stream through the real publish path: device reads ==
     host-store reads at the latest snapshot and at historical ones."""
@@ -297,3 +322,80 @@ def test_node_recovery_routes_to_device(tmp_path):
     assert pm.device.owns("counter_pn", "rk") or \
         api2.node.partition_of("rs").device.owns("set_aw", "rs")
     api2.close()
+
+
+def test_lww_actor_arrival_repacks_ties(tmp_path):
+    """A later-arriving actor that sorts *before* known actors forces a
+    rank repack (store.lww_retie); device order must still match the
+    host oracle's (ts, (actor, seq)) lexicographic rule."""
+    pm_dev = make_pm(tmp_path, "lwwdev", device=True, flush_ops=1)
+    pm_host = make_pm(tmp_path, "lwwhost", device=False)
+    # same ts everywhere: winner decided purely by (actor, seq)
+    ops = [("zz", 10, "v-zz"), ("mm", 11, "v-mm"), ("aa", 12, "v-aa")]
+    for i, (actor, seq, v) in enumerate(ops):
+        p = Payload(key="k", type_name="register_lww",
+                    effect=(500, (actor, seq), v),
+                    commit_dc="dc1", commit_time=1000 + i,
+                    snapshot_vc=VC({"dc1": 999 + i}), txid=f"t{i}")
+        for pm in (pm_dev, pm_host):
+            publish(pm, p, None)
+    cls = get_type("register_lww")
+    v_dev = pm_dev.value_snapshot("k", "register_lww")
+    v_host = pm_host.value_snapshot("k", "register_lww")
+    assert cls.value(v_dev) == cls.value(v_host) == "v-zz"
+
+
+def test_mvreg_concurrent_assigns_both_survive(tmp_path):
+    """Two assigns that observed disjoint histories keep both values —
+    the device's cross-slot observed fold must not kill either."""
+    pm = make_pm(tmp_path, "mv2", device=True, flush_ops=1)
+    a = Payload(key="k", type_name="register_mv",
+                effect=("asgn", "va", ("dc1", 5), ()),
+                commit_dc="dc1", commit_time=100,
+                snapshot_vc=VC({"dc1": 99}), txid="ta")
+    b = Payload(key="k", type_name="register_mv",
+                effect=("asgn", "vb", ("dc2", 7), ()),
+                commit_dc="dc2", commit_time=101,
+                snapshot_vc=VC({"dc2": 99}), txid="tb")
+    for p in (a, b):
+        publish(pm, p, None)
+    cls = get_type("register_mv")
+    st = pm.value_snapshot("k", "register_mv")
+    assert cls.value(st) == ["va", "vb"]
+    # a third assign observing both collapses to one value
+    c = Payload(key="k", type_name="register_mv",
+                effect=("asgn", "vc", ("dc1", 8),
+                        (("dc1", 5), ("dc2", 7))),
+                commit_dc="dc1", commit_time=102,
+                snapshot_vc=VC({"dc1": 101, "dc2": 101}), txid="tc")
+    publish(pm, c, None)
+    assert cls.value(pm.value_snapshot("k", "register_mv")) == ["vc"]
+
+
+def test_flag_ew_enable_wins_on_device(tmp_path):
+    """Concurrent enable survives a disable that did not observe it."""
+    pm = make_pm(tmp_path, "few", device=True, flush_ops=1)
+    en1 = Payload(key="f", type_name="flag_ew",
+                  effect=("en", ("dc1", 5), ()),
+                  commit_dc="dc1", commit_time=100,
+                  snapshot_vc=VC({"dc1": 99}), txid="t1")
+    # disable observed only dc1's dot; dc2's concurrent enable survives
+    en2 = Payload(key="f", type_name="flag_ew",
+                  effect=("en", ("dc2", 6), ()),
+                  commit_dc="dc2", commit_time=101,
+                  snapshot_vc=VC({"dc2": 99}), txid="t2")
+    dis = Payload(key="f", type_name="flag_ew",
+                  effect=("dis", (("dc1", 5),)),
+                  commit_dc="dc3", commit_time=102,
+                  snapshot_vc=VC({"dc1": 100}), txid="t3")
+    cls = get_type("flag_ew")
+    for p in (en1, en2, dis):
+        publish(pm, p, None)
+    assert cls.value(pm.value_snapshot("f", "flag_ew")) is True
+    # a disable observing everything turns it off
+    dis2 = Payload(key="f", type_name="flag_ew",
+                   effect=("dis", (("dc1", 5), ("dc2", 6))),
+                   commit_dc="dc3", commit_time=103,
+                   snapshot_vc=VC({"dc1": 102, "dc2": 102}), txid="t4")
+    publish(pm, dis2, None)
+    assert cls.value(pm.value_snapshot("f", "flag_ew")) is False
