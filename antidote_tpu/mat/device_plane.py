@@ -179,6 +179,32 @@ class _PlaneBase:
         self.rows.extend(rows)
         self.pending_keys.add(key)
 
+    # -- lock-free read split ------------------------------------------------
+
+    def read_begin(self, key, read_vc: Optional[VC]):
+        """MUST run under the partition lock: flush the key's staged
+        rows, resolve directories, and capture the (immutable) device
+        state.  Returns a zero-arg closure that materializes the value
+        and may run OUTSIDE the lock — the shard state is a functional
+        pytree, so a concurrent flush/GC only swaps ``self.st`` with a
+        new value and never mutates what the closure captured.  This is
+        the read-concurrency analogue of the reference's shared-ETS
+        readers next to the vnode process (reference
+        src/clocksi_readitem_server.erl:95-110)."""
+        if key in self.pending_keys:
+            self.flush()
+        idx = self.key_index.get(key)
+        if idx is None:
+            raise ReadBelowBase()  # evicted during the flush — host path
+        rv = self._read_vc_dense(read_vc)
+        st = self.st
+        return self._reader(st, idx, rv)
+
+    def _reader(self, st, idx: int, rv: np.ndarray):
+        """Subclass hook: closure materializing key ``idx`` of the
+        captured state at dense snapshot ``rv``."""
+        raise NotImplementedError
+
     # -- lifecycle ----------------------------------------------------------
 
     def owns(self, key) -> bool:
@@ -409,30 +435,39 @@ class OrsetPlane(_PlaneBase):
         reconstructed from the device fold — actors are recovered from
         the dense DC columns, so the state round-trips through the host
         CRDT (read-your-writes applies its effects on top)."""
-        if self.pending_keys:
-            self.flush()
-        idx = self.key_index.get(key)
-        if idx is None:
-            raise ReadBelowBase()  # evicted during the flush — host path
-        rv = self._read_vc_dense(read_vc)
-        dots = np.asarray(store.orset_read_keys(
-            self.st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))[0])
-        actors = self.domain.dc_ids
-        state = {}
-        for slot, elem in enumerate(self.rev_elems[idx]):
-            live = frozenset(
-                (actors[j], int(s))
-                for j, s in enumerate(dots[slot][:len(actors)]) if s > 0)
-            if live:
-                state[elem] = live
-        return state
+        return self.read_begin(key, read_vc)()
+
+    def _reader(self, st, idx, rv):
+        # captured under the lock; safe after release (see read_begin):
+        # rev_elems[idx] / dc_ids are append-only, st is immutable
+        elems = self.rev_elems[idx]
+        domain = self.domain
+
+        def run():
+            dots = np.asarray(store.orset_read_keys(
+                st, jnp.asarray([idx], dtype=np.int32),
+                jnp.asarray(rv))[0])
+            actors = domain.dc_ids
+            state = {}
+            for slot, elem in enumerate(list(elems)):
+                if slot >= dots.shape[0]:
+                    break  # slot grown after the capture: no dots yet
+                live = frozenset(
+                    (actors[j], int(s))
+                    for j, s in enumerate(dots[slot][:len(actors)])
+                    if s > 0)
+                if live:
+                    state[elem] = live
+            return state
+
+        return run
 
     def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
         """Batched variant of read(): one device fold for B keys.
         Returns {key: state} for the keys still device-owned after the
         leading flush (a flush can evict keys); callers serve the rest
         from the host path."""
-        if self.pending_keys:
+        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
             self.flush()
         owned = [k for k in keys if k in self.key_index]
         if not owned:
@@ -522,18 +557,15 @@ class CounterPlane(_PlaneBase):
         self.st = store.counter_gc(self.st, jnp.asarray(gst_dense))
 
     def read(self, key, read_vc: Optional[VC]) -> int:
-        if self.pending_keys:
-            self.flush()
-        idx = self.key_index.get(key)
-        if idx is None:
-            raise ReadBelowBase()  # evicted during the flush — host path
-        rv = self._read_vc_dense(read_vc)
-        return int(store.counter_read_keys(
-            self.st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))[0])
+        return self.read_begin(key, read_vc)()
+
+    def _reader(self, st, idx, rv):
+        return lambda: int(store.counter_read_keys(
+            st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))[0])
 
     def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
         """See OrsetPlane.read_many — {key: value} for device-owned keys."""
-        if self.pending_keys:
+        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
             self.flush()
         owned = [k for k in keys if k in self.key_index]
         if not owned:
@@ -585,26 +617,36 @@ class MvregPlane(OrsetPlane):
              int(seq), obs_pairs, op_dc_col, int(payload.commit_time),
              ss_pairs)])
 
-    def _grow_slots(self, new_e):
-        # flush first: staged reset rows encode the drop slot as the OLD
-        # n_slots; appending them after the grow would land them in a
-        # real slot
-        self.flush()
-        super()._grow_slots(new_e)
-
     def _device_gc(self, gst_dense):
         self.st = store.mvreg_gc(self.st, jnp.asarray(gst_dense))
 
     def read(self, key, read_vc: Optional[VC]):
         """register_mv host state (frozenset of (dot, value)) at
         ``read_vc``."""
-        out = self.read_many([key], read_vc)
-        if key not in out:
-            raise ReadBelowBase()  # evicted during the flush — host path
-        return out[key]
+        return self.read_begin(key, read_vc)()
+
+    def _reader(self, st, idx, rv):
+        vals = self.rev_elems[idx]
+        domain = self.domain
+
+        def run():
+            dots = np.asarray(store.mvreg_read_keys(
+                st, jnp.asarray([idx], dtype=np.int32),
+                jnp.asarray(rv))[0])
+            actors = domain.dc_ids
+            pairs = set()
+            for slot, v in enumerate(list(vals)):
+                if slot >= dots.shape[0]:
+                    break
+                for j, s in enumerate(dots[slot][:len(actors)]):
+                    if s > 0:
+                        pairs.add(((actors[j], int(s)), v))
+            return frozenset(pairs)
+
+        return run
 
     def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        if self.pending_keys:
+        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
             self.flush()
         owned = [k for k in keys if k in self.key_index]
         if not owned:
@@ -667,13 +709,24 @@ class FlagEwPlane(OrsetPlane):
 
     def read(self, key, read_vc: Optional[VC]):
         """flag_ew host state (frozenset of enable dots) at ``read_vc``."""
-        out = self.read_many([key], read_vc)
-        if key not in out:
-            raise ReadBelowBase()  # evicted during the flush — host path
-        return out[key]
+        return self.read_begin(key, read_vc)()
+
+    def _reader(self, st, idx, rv):
+        domain = self.domain
+
+        def run():
+            dots = np.asarray(store.orset_read_keys(
+                st, jnp.asarray([idx], dtype=np.int32),
+                jnp.asarray(rv))[0])
+            actors = domain.dc_ids
+            return frozenset(
+                (actors[j], int(s))
+                for j, s in enumerate(dots[0][:len(actors)]) if s > 0)
+
+        return run
 
     def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        if self.pending_keys:
+        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
             self.flush()
         owned = [k for k in keys if k in self.key_index]
         if not owned:
@@ -750,16 +803,46 @@ class LwwPlane(_PlaneBase):
             rank = self._rank[actor]
         return (rank << _TIE_SHIFT) | int(seq)
 
+    #: value-directory compaction threshold: dead interned values (every
+    #: assign with a fresh payload leaves one behind) are dropped once
+    #: the directory outgrows this
+    _val_compact_at = 1 << 16
+
     def _val_id(self, v) -> Optional[int]:
         try:
             vid = self.val_index.get(v)
         except TypeError:
             return None  # unhashable value — host path
         if vid is None:
+            if len(self.rev_vals) >= self._val_compact_at:
+                self._compact_vals()
             vid = len(self.rev_vals)
             self.val_index[v] = vid
             self.rev_vals.append(v)
         return vid
+
+    def _compact_vals(self) -> None:
+        """Drop interned values no stored row references any more
+        (superseded assigns): flush, host-scan the live val columns,
+        rebuild the directory, and remap the device columns
+        (store.lww_reval).  Keeps register-heavy workloads from leaking
+        one value object per assign forever."""
+        self.flush()
+        ops_val = np.asarray(self.st.ops[:, store._LVAL])
+        valid = np.asarray(self.st.valid)
+        bval = np.asarray(self.st.base_val)
+        live = set(np.unique(ops_val[valid]).tolist())
+        live.update(np.unique(bval[bval >= 0]).tolist())
+        remap = np.full(len(self.rev_vals), -1, dtype=np.int64)
+        new_vals: List[Any] = []
+        for old in sorted(live):
+            remap[old] = len(new_vals)
+            new_vals.append(self.rev_vals[old])
+        self.st = store.lww_reval(self.st, remap)
+        self.rev_vals = new_vals
+        self.val_index = {v: i for i, v in enumerate(new_vals)}
+        log.debug("lww plane: value directory compacted to %d entries",
+                  len(new_vals))
 
     def stage(self, key, payload: Payload) -> None:
         idx = self._key_idx(key)
@@ -817,13 +900,28 @@ class LwwPlane(_PlaneBase):
 
     def read(self, key, read_vc: Optional[VC]):
         """register_lww host state (ts, (actor, seq), value)."""
-        out = self.read_many([key], read_vc)
-        if key not in out:
-            raise ReadBelowBase()  # evicted during the flush — host path
-        return out[key]
+        return self.read_begin(key, read_vc)()
+
+    def _reader(self, st, idx, rv):
+        # actors_sorted is REPLACED wholesale on a rank repack (which
+        # also repacks st under the same lock) — capturing the list here
+        # keeps ranks and state consistent after the lock is released
+        acts = self.actors_sorted
+        vals = self.rev_vals
+
+        def run():
+            ts, tie, val = (np.asarray(a) for a in store.lww_read_keys(
+                st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv)))
+            if val[0] < 0:
+                return (0, (), None)  # unwritten at this snapshot
+            rank = int(tie[0]) >> _TIE_SHIFT
+            seq = int(tie[0]) & _TIE_SEQ_MAX
+            return (int(ts[0]), (acts[rank], seq), vals[int(val[0])])
+
+        return run
 
     def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        if self.pending_keys:
+        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
             self.flush()
         owned = [k for k in keys if k in self.key_index]
         if not owned:

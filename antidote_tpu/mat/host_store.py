@@ -56,11 +56,16 @@ class _KeyEntry:
 class HostStore:
     """One partition's in-memory versioned store."""
 
-    def __init__(self, log_fallback: Optional[Callable[..., list]] = None):
+    def __init__(self, log_fallback: Optional[Callable[..., list]] = None,
+                 has_history: Optional[Callable[[Any], bool]] = None):
         #: key -> entry
         self._data: Dict[Any, _KeyEntry] = {}
         #: optional PartitionLog.committed_payloads for cache misses
         self._log_fallback = log_fallback
+        #: optional O(1) "does this key have any logged history" probe —
+        #: without it, a read of a never-written key scans the whole log
+        #: just to find nothing, every time
+        self._has_history = has_history
 
     def entry_count(self) -> int:
         return len(self._data)
@@ -105,7 +110,8 @@ class HostStore:
         e = self._data.get(key)
         if e is None:
             e = _KeyEntry(key, type_name)
-            if self._log_fallback is not None:
+            if self._log_fallback is not None and (
+                    self._has_history is None or self._has_history(key)):
                 for i, p in self._log_fallback(key=key):
                     e.next_seq += 1
                     e.ops.insert(0, (e.next_seq, p))

@@ -599,6 +599,22 @@ def lww_grow(st: LwwShardState, n_keys: int | None = None,
     )
 
 
+def lww_reval(st: LwwShardState, remap: np.ndarray) -> LwwShardState:
+    """Host-side value-id remap after the plane compacts its value
+    directory (dead interned values dropped): every stored val column
+    maps through ``remap`` (old id -> new id; dead ids map to -1 but are
+    only present on invalid lanes).  Rare, host-side."""
+    ops = np.array(np.asarray(st.ops))
+    valid = np.asarray(st.valid)
+    v = ops[:, _LVAL]
+    ops[:, _LVAL] = np.where(
+        valid, remap[np.clip(v, 0, len(remap) - 1)], v)
+    bval = np.asarray(st.base_val)
+    live = bval >= 0
+    bval = np.where(live, remap[np.clip(bval, 0, len(remap) - 1)], bval)
+    return replace(st, ops=jnp.asarray(ops), base_val=jnp.asarray(bval))
+
+
 def lww_retie(st: LwwShardState, remap: np.ndarray,
               rank_shift: int) -> LwwShardState:
     """Host-side tiebreak repack after the actor-rank directory grows:

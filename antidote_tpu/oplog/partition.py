@@ -46,6 +46,11 @@ class PartitionLog:
         self.log = DurableLog(path, backend=backend) if enabled else None
         #: next op number per origin DC (recovered from the log at boot)
         self.op_counters: Dict[Any, int] = {}
+        #: keys with at least one logged update — lets readers skip the
+        #: full-log scan for keys that have no history at all (the
+        #: reference's ETS cache answers this implicitly; a miss there
+        #: scans only the per-key log via its key index)
+        self.keys_seen: set = set()
         #: max committed time seen per DC (recovered; seeds the dependency
         #: clock on restart, reference src/logging_vnode.erl:301-322)
         self.max_commit_vc = VC()
@@ -71,6 +76,7 @@ class PartitionLog:
         return rec
 
     def append_update(self, dc, txid, key, type_name, effect) -> LogRecord:
+        self.keys_seen.add(key)
         return self._append(
             update_record(self._next_op_id(dc), txid, key, type_name, effect),
             sync=False)
@@ -100,6 +106,8 @@ class PartitionLog:
         for rec in records:
             self.op_counters[rec.op_id.dc] = max(
                 self.op_counters.get(rec.op_id.dc, 0), rec.op_id.n)
+            if rec.kind() == "update":
+                self.keys_seen.add(rec.payload[1])
             self._append(rec, sync=False)
         if self.sync_on_commit and records and self.enabled:
             self.log.sync()
@@ -174,6 +182,8 @@ class PartitionLog:
             cur = self.op_counters.get(rec.op_id.dc, 0)
             if rec.op_id.n > cur:
                 self.op_counters[rec.op_id.dc] = rec.op_id.n
+            if rec.kind() == "update":
+                self.keys_seen.add(rec.payload[1])
             if rec.kind() == "commit":
                 (dc, ct) = rec.payload[1]
                 if ct > self.max_commit_vc.get_dc(dc):

@@ -47,7 +47,8 @@ class PartitionManager:
         self.dc_id = dc_id
         self.log = log
         self.clock = clock
-        self.store = HostStore(log_fallback=log.committed_payloads)
+        self.store = HostStore(log_fallback=log.committed_payloads,
+                               has_history=log.keys_seen.__contains__)
         #: TPU data plane for supported types (None = host-only node)
         self.device = device_plane
         if device_plane is not None:
@@ -74,6 +75,22 @@ class PartitionManager:
         #: ops staged per txid before commit (the txn's effects on this
         #: partition, already in the durable log)
         self._staged: Dict[Any, List[Tuple[Any, str, Any]]] = {}
+        #: per-key commit frontier (join of every published op's
+        #: (commit_dc, commit_time)) and a latest-value cache keyed on
+        #: it — the materializer snapshot cache in front of the device
+        #: plane (reference materializer_vnode ETS snapshot_cache,
+        #: src/materializer_vnode.erl:36-47).  A cached value is served
+        #: only to reads that dominate the key's whole frontier, and a
+        #: new arrival moves the frontier, so staleness is impossible.
+        self.key_frontier: Dict[Any, VC] = {}
+        self._val_cache: Dict[Any, Tuple[VC, Any]] = {}
+        self._val_cache_cap = 65536
+        #: device reads in flight outside the lock (see read()): the
+        #: append/gc kernels DONATE their input buffers, so a device
+        #: mutation while a reader still holds the captured shard state
+        #: would hand the reader deleted buffers — writers wait for
+        #: readers to drain (readers share; mutations exclusive)
+        self._dev_readers = 0
 
     # ----------------------------------------------------------- log scans
 
@@ -141,6 +158,12 @@ class PartitionManager:
         per-DC dot collapse cannot represent — dot-bearing types from
         such commits stay on the host path (evicting the key's device
         history first if it has any)."""
+        fr = self.key_frontier.get(key) or VC()
+        # join the FULL commit VC (snapshot deps included): covers_all
+        # must imply the read's inclusion mask admits this op, and the
+        # mask tests the whole commit VC, not just the commit entry
+        self.key_frontier[key] = fr.join(payload.commit_vc())
+        self._val_cache.pop(key, None)
         if self.device is not None:
             unsound = (not payload.certified
                        and type_name in self.device.dot_collapse_types)
@@ -149,14 +172,23 @@ class PartitionManager:
                 # eviction path, where the key's whole history (this op
                 # included, it is already in the log) migrates to the
                 # host store
+                self._wait_device_quiesce()
                 self.device.stage(key, type_name, payload, stable)
                 return
             if unsound and self.device.owns(type_name, key):
                 # eviction migrates the full log history — which already
                 # contains this op — so nothing more to insert
+                self._wait_device_quiesce()
                 self.device.planes[type_name].evict(key)
                 return
         self.store.insert(key, type_name, payload, stable_vc=stable)
+
+    def _wait_device_quiesce(self) -> None:
+        """Block (under self._lock) until no lock-free device reader is
+        in flight: device mutations donate buffers a reader may still
+        hold.  Must run under self._lock."""
+        while self._dev_readers:
+            self._lock.wait()
 
     def _migrate_key_to_host(self, key, type_name: str) -> None:
         """Device-plane eviction handler: rebuild the key's host-store
@@ -255,6 +287,7 @@ class PartitionManager:
             # clock wait happens outside the lock (it can be long and
             # must not stall commits on this partition)
             self.clock.wait_until(snapshot_vc.get_dc(self.dc_id))
+        reader = None
         with self._lock:
             if snapshot_vc is not None:
                 deadline = time.monotonic() + self.read_wait_timeout
@@ -263,10 +296,52 @@ class PartitionManager:
                     if remaining <= 0 or not self._lock.wait(timeout=remaining):
                         raise TimeoutError(
                             f"read of {key!r} blocked on prepared txn")
-            # store access stays under the partition lock: commit()
-            # mutates the same entries (one-writer semantics, like the
-            # reference's single vnode process + shared-ETS readers)
-            value = self._read_store(key, type_name, snapshot_vc, txid)
+            if self.device is not None and self.device.owns(type_name, key):
+                # the device fold runs OUTSIDE the lock on the captured
+                # immutable shard state (plane.read_begin) — the
+                # read-concurrency analogue of the reference's read
+                # servers next to the vnode (src/clocksi_readitem_server
+                # .erl:95-110).  Host-store reads stay under the lock:
+                # they are dict lookups, and commit() mutates the same
+                # entries.
+                fr = self.key_frontier.get(key)
+                covers_all = fr is not None and (
+                    snapshot_vc is None or fr.le(snapshot_vc))
+                if covers_all:
+                    ent = self._val_cache.get(key)
+                    if ent is not None and ent[0] is fr:
+                        return ent[1]
+                plane = self.device.planes[type_name]
+                if key in plane.pending_keys:
+                    # read_begin will flush (donating buffers): drain
+                    # in-flight readers of older captures first
+                    self._wait_device_quiesce()
+                try:
+                    reader = plane.read_begin(key, snapshot_vc)
+                except ReadBelowBase:
+                    reader = False  # sentinel: log replay below
+                else:
+                    self._dev_readers += 1
+            else:
+                value = self._read_store(key, type_name, snapshot_vc, txid)
+                return value
+        if reader is False:
+            with self._lock:  # log scans serialize with appenders
+                return self._read_from_log(key, type_name, snapshot_vc,
+                                           txid)
+        try:
+            value = reader()
+        finally:
+            with self._lock:
+                self._dev_readers -= 1
+                self._lock.notify_all()
+        if covers_all:
+            with self._lock:
+                # re-check: a publish while we folded moved the frontier
+                if self.key_frontier.get(key) is fr:
+                    if len(self._val_cache) >= self._val_cache_cap:
+                        self._val_cache.clear()
+                    self._val_cache[key] = (fr, value)
         return value
 
     def _read_store(self, key, type_name: str, read_vc: Optional[VC],
@@ -275,12 +350,25 @@ class PartitionManager:
         under self._lock.  Device keys read via the batched fold; reads
         below the device base (or with clocks outside its DC domain)
         replay the log — the reference's snapshot-cache miss."""
+        fr = self.key_frontier.get(key)
+        covers_all = fr is not None and (read_vc is None or fr.le(read_vc))
+        if covers_all:
+            ent = self._val_cache.get(key)
+            # frontier identity (not just dominance) guarantees no op
+            # arrived since the entry was materialized
+            if ent is not None and ent[0] is fr:
+                return ent[1]
         if self.device is not None and self.device.owns(type_name, key):
             try:
-                return self.device.read(key, type_name, read_vc)
+                value = self.device.read(key, type_name, read_vc)
             except ReadBelowBase:
                 return self._read_from_log(key, type_name, read_vc, txid)
-        value, _vc = self.store.read(key, type_name, read_vc, txid=txid)
+        else:
+            value, _vc = self.store.read(key, type_name, read_vc, txid=txid)
+        if covers_all:
+            if len(self._val_cache) >= self._val_cache_cap:
+                self._val_cache.clear()
+            self._val_cache[key] = (fr, value)
         return value
 
     def _read_from_log(self, key, type_name: str, read_vc: Optional[VC],

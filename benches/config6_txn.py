@@ -1,0 +1,166 @@
+"""End-to-end transaction benchmark (basho_bench-style, the
+reference's own yardstick: an update-heavy PB workload, reference
+README "Benchmarking" + test/singledc/pb_client_SUITE.erl shapes).
+
+Measures txn/s and latency percentiles through the *full* stack:
+
+- ``direct``: concurrent client threads driving the public API
+  (antidote_tpu/api.py) with interactive transactions — 80% update
+  txns (1 read + 2 updates), 20% read txns (3 reads) over counters and
+  add-wins sets.
+- ``pb``: the same mix through the wire protocol (pb/server.py +
+  pb/client.py over loopback TCP), static API variants (the
+  antidotec_pb usage pattern).
+
+The emitted value is direct multi-thread txn/s; ``vs_baseline`` is the
+thread-scaling factor (threads=T vs threads=1) — the reference's
+concurrency story is 20 read servers + shared-ETS reads per vnode
+(reference include/antidote.hrl:28, src/clocksi_readitem_server.erl),
+so scaling with client concurrency is the honest comparable."""
+
+import json
+import shutil
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from benches._util import emit, setup
+from antidote_tpu.txn.coordinator import TransactionAborted
+
+
+def _percentiles(lat):
+    a = np.asarray(sorted(lat))
+    return (round(float(np.percentile(a, 50)) * 1e3, 2),
+            round(float(np.percentile(a, 99)) * 1e3, 2))
+
+
+def run_direct(db, n_threads, txns_per_thread, K, seed=0):
+    from antidote_tpu.clocks import VC
+
+    lat = []
+    lat_lock = threading.Lock()
+    aborts = [0]
+
+    def worker(tid):
+        rng = np.random.default_rng(seed + tid)
+        my_lat = []
+        for i in range(txns_per_thread):
+            c_key = (f"c{rng.integers(0, K)}", "counter_pn", "bucket")
+            s_key = (f"s{rng.integers(0, K)}", "set_aw", "bucket")
+            t0 = time.perf_counter()
+            try:
+                tx = db.start_transaction()
+                if rng.random() < 0.8:  # update txn
+                    db.read_objects([c_key], tx)
+                    db.update_objects(
+                        [(c_key, "increment", 1),
+                         (s_key, "add", b"e%d" % int(rng.integers(8)))],
+                        tx)
+                else:  # read txn
+                    db.read_objects([c_key, s_key,
+                                     (f"c{rng.integers(0, K)}",
+                                      "counter_pn", "bucket")], tx)
+                db.commit_transaction(tx)
+            except TransactionAborted:
+                # write-write certification conflict: counted, like a
+                # basho_bench error row, not a crash
+                with lat_lock:
+                    aborts[0] += 1
+                continue
+            my_lat.append(time.perf_counter() - t0)
+        with lat_lock:
+            lat.extend(my_lat)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    return len(lat) / dt, lat, aborts[0]
+
+
+def run_pb(db, n_threads, txns_per_thread, K, port, seed=100):
+    from antidote_tpu.pb.client import PbClient
+    from antidote_tpu.pb.server import PbServer
+
+    server = PbServer(db, port=port).start()
+    lat = []
+    lat_lock = threading.Lock()
+    try:
+        def worker(tid):
+            rng = np.random.default_rng(seed + tid)
+            my_lat = []
+            with PbClient(port=port) as cl:
+                for i in range(txns_per_thread):
+                    c_key = (f"c{rng.integers(0, K)}", "counter_pn",
+                             "bucket")
+                    s_key = (f"s{rng.integers(0, K)}", "set_aw", "bucket")
+                    t0 = time.perf_counter()
+                    if rng.random() < 0.8:
+                        cl.update_objects_static(
+                            None,
+                            [(c_key, "increment", 1),
+                             (s_key, "add",
+                              b"e%d" % int(rng.integers(8)))])
+                    else:
+                        cl.read_objects_static(None, [c_key, s_key])
+                    my_lat.append(time.perf_counter() - t0)
+            with lat_lock:
+                lat.extend(my_lat)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    finally:
+        server.stop()
+    return len(lat) / dt, lat
+
+
+def main():
+    quick, _jax = setup()
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.config import Config
+
+    K = 2048
+    n_threads = 8
+    txns = 250 if quick else 1500
+    tmp = tempfile.mkdtemp(prefix="txnbench")
+    try:
+        cfg = Config(n_partitions=8, sync_log=False, data_dir=tmp)
+        db = AntidoteTPU(config=cfg)
+        # warm (interning, jit on the device plane paths)
+        run_direct(db, 2, 30, K, seed=999)
+
+        tput_1, _, _ = run_direct(db, 1, txns, K, seed=1)
+        tput_n, lat, aborts = run_direct(db, n_threads, txns, K, seed=2)
+        p50, p99 = _percentiles(lat)
+        pb_tput, pb_lat = run_pb(db, n_threads,
+                                 max(txns // 4, 50), K, port=18087)
+        pb50, pb99 = _percentiles(pb_lat)
+        db.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    emit("txn_per_sec_update_heavy_8clients", round(tput_n), "txn/s",
+         round(tput_n / tput_1, 2),
+         threads=n_threads, txns_per_thread=txns, keys=K,
+         p50_ms=p50, p99_ms=p99,
+         single_thread_txn_per_sec=round(tput_1),
+         pb_txn_per_sec=round(pb_tput), pb_p50_ms=pb50, pb_p99_ms=pb99,
+         abort_rate=round(aborts / max(aborts + len(lat), 1), 4),
+         mix="80% update (1r+2w), 20% read (3r); pb variant static",
+         note="vs_baseline = thread-scaling factor (8 clients vs 1)")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,7 @@ import sys
 def main():
     for mod in ("benches.config1_counter", "bench",
                 "benches.config3_mvreg", "benches.config4_rga",
-                "benches.config5_gst"):
+                "benches.config5_gst", "benches.config6_txn"):
         sys.stderr.write(f"== {mod}\n")
         runpy.run_module(mod, run_name="__main__")
 

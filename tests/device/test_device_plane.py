@@ -399,3 +399,28 @@ def test_flag_ew_enable_wins_on_device(tmp_path):
                    snapshot_vc=VC({"dc1": 102, "dc2": 102}), txid="t4")
     publish(pm, dis2, None)
     assert cls.value(pm.value_snapshot("f", "flag_ew")) is False
+
+
+def test_lww_value_directory_compacts(tmp_path):
+    """Unique-value assigns must not grow the intern directory without
+    bound: past the threshold, dead values are dropped and the device
+    columns remapped, with reads unchanged."""
+    pm = make_pm(tmp_path, "lwwcompact", device=True, flush_ops=4)
+    plane = pm.device.planes["register_lww"]
+    plane._val_compact_at = 16
+    n = 80
+    for i in range(n):
+        p = Payload(key=f"k{i % 3}", type_name="register_lww",
+                    effect=(1000 + i, ("dc1", i + 1), f"payload-{i}"),
+                    commit_dc="dc1", commit_time=1000 + i,
+                    snapshot_vc=VC({"dc1": 999 + i}), txid=f"t{i}")
+        publish(pm, p, None)
+    cls = get_type("register_lww")
+    # directory stays near the live set (3 keys' worth + slack), far
+    # below the n unique values interned along the way
+    assert len(plane.rev_vals) < 40
+    for k in range(3):
+        want = f"payload-{n - 3 + k}"
+        got = cls.value(pm.value_snapshot(f"k{(n - 3 + k) % 3}",
+                                          "register_lww"))
+        assert got == want
