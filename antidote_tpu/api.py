@@ -105,6 +105,61 @@ class AntidoteTPU:
             out.append([p for _i, p in ops])
         return out
 
+    # ------------------------------------------------------------ admin plane
+
+    def set_flag(self, name: str, value) -> None:
+        """Toggle a runtime flag node-wide (reference replicated env
+        flags, src/logging_vnode.erl:247-258); DataCenter adds the
+        durable + replicated layer."""
+        self.node.set_flag(name, value)
+
+    def get_flag(self, name: str):
+        return self.node.get_flag(name)
+
+    def create_dc(self, nodes: Optional[List[str]] = None) -> None:
+        """Form the DC (reference antidote_dc_manager:create_dc via the
+        PB dispatcher, src/antidote_pb_process.erl:102-116).  The
+        reference joins the given Erlang nodes into one riak ring; this
+        rebuild's DC is a single process that scales through partitions
+        and device shards, so forming is recording the membership — a
+        list naming anything but this node is rejected rather than
+        silently half-honored."""
+        me = str(self.node.dc_id)
+        nodes = [str(n) for n in (nodes or [me])]
+        others = [n for n in nodes if n != me]
+        if others:
+            raise ValueError(
+                f"multi-node DCs are not supported (got {others}); this "
+                "DC scales via partitions/device shards — connect "
+                "separate DCs with connect_to_dcs instead")
+
+    def admin_status(self) -> dict:
+        """Operator status snapshot (the antidote_console duty,
+        reference src/antidote_console.erl:31-60)."""
+        node = self.node
+        parts = []
+        for pm in node.partitions:
+            with pm._lock:  # writers mutate these dicts concurrently
+                dev = {}
+                if pm.device is not None:
+                    dev = {t: len(p.key_index)
+                           for t, p in pm.device.planes.items()}
+                parts.append({
+                    "partition": pm.partition,
+                    "host_keys": pm.store.entry_count(),
+                    "device_keys": dev,
+                    "prepared_txns": len(pm.prepared),
+                    "log_ops": dict(pm.log.op_counters),
+                })
+        return {
+            "dc_id": node.dc_id,
+            "n_partitions": node.config.n_partitions,
+            "clock_us": node.clock.now_us(),
+            "stable_vc": dict(node.stable_vc()),
+            "flags": {n: node.get_flag(n) for n in node.RUNTIME_FLAGS},
+            "partitions": parts,
+        }
+
     # ----------------------------------------------------------------- hooks
 
     def register_pre_hook(self, bucket, hook) -> None:

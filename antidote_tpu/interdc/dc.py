@@ -110,7 +110,35 @@ class DataCenter(AntidoteTPU):
                     "restart re-join: %r unreachable, will retry",
                     desc.dc_id)
                 self._retry_descs.append(desc)
+        # re-apply runtime flags persisted before the restart (reference
+        # recovers replicated env flags from stable metadata,
+        # src/dc_meta_data_utilities.erl:79-104)
+        for name, value in (self.meta.get("runtime_flags") or {}).items():
+            try:
+                node.set_flag(name, value)
+            except (KeyError, ValueError):
+                logging.getLogger(__name__).warning(
+                    "ignoring persisted unknown flag %r", name)
         self.meta.mark_started()
+
+    # ---------------------------------------------------------- admin plane
+
+    def set_flag(self, name: str, value) -> None:
+        """Apply + persist a runtime flag: survives restarts via the
+        stable meta store (the reference's replicated-then-stored env
+        flag path, src/dc_meta_data_utilities.erl:79-104)."""
+        self.node.set_flag(name, value)
+        flags = dict(self.meta.get("runtime_flags") or {})
+        flags[name] = self.node.get_flag(name)
+        self.meta.put("runtime_flags", flags)
+
+    def admin_status(self) -> dict:
+        st = super().admin_status()
+        st["connected_dcs"] = [str(d) for d in self.connected_dcs]
+        with self._rx_lock:  # the delivery worker grows gate queues
+            st["pending_interdc"] = sum(
+                g.pending() for g in self.dep_gates)
+        return st
 
     # ---------------------------------------------------------- membership
 

@@ -138,3 +138,24 @@ class PbClient:
         req = pb.ApbConnectToDcs(
             descriptors=[codec.descriptor_to_bytes(d) for d in descriptors])
         self._check(self._call(req))
+
+    def create_dc(self, nodes: Optional[List[str]] = None) -> None:
+        """Form the DC (reference antidote_pb_process create_dc,
+        src/antidote_pb_process.erl:102-116)."""
+        self._check(self._call(pb.ApbCreateDc(nodes=nodes or [])))
+
+    # -------------------------------------------------------- admin plane
+
+    def admin_status(self) -> dict:
+        resp = self._check(self._call(pb.ApbAdminStatus()))
+        return codec.term_from_pb(resp.info)
+
+    def get_flag(self, name: str):
+        resp = self._check(self._call(pb.ApbGetFlag(name=name)))
+        return codec.term_from_pb(resp.value)
+
+    def set_flag(self, name: str, value):
+        req = pb.ApbSetFlag(name=name)
+        codec.term_to_pb(value, req.value)
+        resp = self._check(self._call(req))
+        return codec.term_from_pb(resp.value)

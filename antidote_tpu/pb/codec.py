@@ -31,6 +31,10 @@ MSG_CODES = {
     pb.ApbStaticUpdateObjects: 16,
     pb.ApbGetConnectionDescriptor: 17,
     pb.ApbConnectToDcs: 18,
+    pb.ApbCreateDc: 19,
+    pb.ApbAdminStatus: 20,
+    pb.ApbGetFlag: 21,
+    pb.ApbSetFlag: 22,
     pb.ApbErrorResp: 100,
     pb.ApbStartTransactionResp: 101,
     pb.ApbOperationResp: 102,
@@ -38,6 +42,8 @@ MSG_CODES = {
     pb.ApbReadObjectsResp: 104,
     pb.ApbStaticReadObjectsResp: 105,
     pb.ApbGetConnectionDescriptorResp: 106,
+    pb.ApbAdminStatusResp: 107,
+    pb.ApbFlagResp: 108,
 }
 
 CODE_TO_MSG = {code: cls for cls, code in MSG_CODES.items()}

@@ -219,6 +219,41 @@ class _Connection:
             return pb.ApbOperationResp(success=False, error=str(e))
         return pb.ApbOperationResp(success=True)
 
+    def _create_dc(self, req: pb.ApbCreateDc):
+        try:
+            self.db.create_dc(list(req.nodes))
+        except Exception as e:  # noqa: BLE001
+            return pb.ApbOperationResp(success=False, error=str(e))
+        return pb.ApbOperationResp(success=True)
+
+    def _admin_status(self, req: pb.ApbAdminStatus):
+        try:
+            info = self.db.admin_status()
+        except Exception as e:  # noqa: BLE001
+            return pb.ApbAdminStatusResp(success=False, error=str(e))
+        resp = pb.ApbAdminStatusResp(success=True)
+        codec.term_to_pb(info, resp.info)
+        return resp
+
+    def _get_flag(self, req: pb.ApbGetFlag):
+        try:
+            value = self.db.get_flag(req.name)
+        except Exception as e:  # noqa: BLE001
+            return pb.ApbFlagResp(success=False, error=str(e))
+        resp = pb.ApbFlagResp(success=True)
+        codec.term_to_pb(value, resp.value)
+        return resp
+
+    def _set_flag(self, req: pb.ApbSetFlag):
+        try:
+            self.db.set_flag(req.name, codec.term_from_pb(req.value))
+            value = self.db.get_flag(req.name)
+        except Exception as e:  # noqa: BLE001
+            return pb.ApbFlagResp(success=False, error=str(e))
+        resp = pb.ApbFlagResp(success=True)
+        codec.term_to_pb(value, resp.value)
+        return resp
+
     _HANDLERS = {
         pb.ApbStartTransaction: _start_transaction,
         pb.ApbReadObjects: _read_objects,
@@ -229,4 +264,8 @@ class _Connection:
         pb.ApbStaticUpdateObjects: _static_update,
         pb.ApbGetConnectionDescriptor: _get_descriptor,
         pb.ApbConnectToDcs: _connect_to_dcs,
+        pb.ApbCreateDc: _create_dc,
+        pb.ApbAdminStatus: _admin_status,
+        pb.ApbGetFlag: _get_flag,
+        pb.ApbSetFlag: _set_flag,
     }

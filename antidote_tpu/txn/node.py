@@ -70,6 +70,37 @@ class Node:
         if self.config.recover_from_log:
             self._recover_stores()
 
+    # ------------------------------------------------------- runtime flags
+
+    #: flags togglable at runtime (the reference replicates these
+    #: DC-wide through its stable metadata and every vnode re-reads
+    #: them, reference src/logging_vnode.erl:247-258,
+    #: src/dc_meta_data_utilities.erl:79-104; this node is a whole DC,
+    #: so "DC-wide" is the node plus the durable meta store — see
+    #: DataCenter.set_flag for the persisted layer)
+    RUNTIME_FLAGS = ("sync_log", "certify", "txn_prot")
+
+    def set_flag(self, name: str, value) -> None:
+        if name not in self.RUNTIME_FLAGS:
+            raise KeyError(f"unknown runtime flag {name!r}; "
+                           f"togglable: {self.RUNTIME_FLAGS}")
+        if name == "sync_log":
+            value = bool(value)
+            self.config.sync_log = value
+            for pm in self.partitions:
+                pm.log.sync_on_commit = value
+        elif name == "certify":
+            self.config.certify = bool(value)
+        elif name == "txn_prot":
+            if value not in ("clocksi", "gr"):
+                raise ValueError(f"txn_prot must be clocksi|gr, got {value!r}")
+            self.config.txn_prot = value
+
+    def get_flag(self, name: str):
+        if name not in self.RUNTIME_FLAGS:
+            raise KeyError(f"unknown runtime flag {name!r}")
+        return getattr(self.config, name)
+
     # ----------------------------------------------------------- placement
 
     def partition_index(self, key) -> int:

@@ -1,0 +1,133 @@
+"""Admin plane over the wire: create_dc, status, runtime flags, and the
+operator console (reference antidote_pb_process.erl:102-130 cluster
+build + antidote_console.erl).
+"""
+
+import json
+
+import pytest
+
+from antidote_tpu.api import AntidoteTPU
+from antidote_tpu.config import Config
+from antidote_tpu.interdc.dc import DataCenter
+from antidote_tpu.interdc.transport import InProcBus
+from antidote_tpu.pb import PbClient, PbError, PbServer
+from antidote_tpu import console
+
+
+@pytest.fixture
+def server(tmp_path):
+    db = AntidoteTPU(dc_id="dc1", data_dir=str(tmp_path / "data"))
+    srv = PbServer(db, port=0).start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+@pytest.fixture
+def client(server):
+    with PbClient(port=server.port) as c:
+        yield c
+
+
+def test_create_dc_over_wire(client):
+    client.create_dc()            # defaults to this node
+    client.create_dc(["dc1"])     # explicit self is fine
+    with pytest.raises(PbError, match="multi-node"):
+        client.create_dc(["dc1", "other@host"])
+
+
+def test_admin_status_shape(client):
+    client.update_objects_static(
+        None, [(("k", "counter_pn", "b"), "increment", 4)])
+    st = client.admin_status()
+    assert st["dc_id"] == "dc1"
+    assert st["n_partitions"] == len(st["partitions"])
+    assert {"sync_log", "certify", "txn_prot"} <= set(st["flags"])
+    assert sum(p["host_keys"] for p in st["partitions"]) + sum(
+        sum(dict(p["device_keys"]).values()) for p in st["partitions"]
+    ) >= 1
+
+
+def test_runtime_flag_toggle_applies_to_logs(client, server):
+    assert client.get_flag("sync_log") is False
+    assert client.set_flag("sync_log", True) is True
+    for pm in server.db.node.partitions:
+        assert pm.log.sync_on_commit is True
+    client.set_flag("sync_log", False)
+    for pm in server.db.node.partitions:
+        assert pm.log.sync_on_commit is False
+    with pytest.raises(PbError, match="unknown runtime flag"):
+        client.get_flag("nope")
+    with pytest.raises(PbError, match="txn_prot"):
+        client.set_flag("txn_prot", "bogus")
+
+
+def test_flag_persists_across_dc_restart(tmp_path):
+    data = str(tmp_path / "dcdata")
+    cfg = Config(n_partitions=2, data_dir=data)
+    bus = InProcBus()
+    dc = DataCenter("dcA", bus, config=cfg)
+    try:
+        assert dc.get_flag("sync_log") is False
+        dc.set_flag("sync_log", True)
+    finally:
+        dc.close()
+    bus2 = InProcBus()
+    dc2 = DataCenter("dcA", bus2, config=Config(n_partitions=2,
+                                                data_dir=data))
+    try:
+        assert dc2.get_flag("sync_log") is True
+        for pm in dc2.node.partitions:
+            assert pm.log.sync_on_commit is True
+    finally:
+        dc2.close()
+
+
+def test_console_commands(server, tmp_path, capsys):
+    port = str(server.port)
+    assert console.main(["--port", port, "status"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["dc_id"] == "dc1"
+
+    assert console.main(["--port", port, "ring"]) == 0
+    out = capsys.readouterr().out
+    assert "partitions" in out and "p0:" in out
+
+    assert console.main(["--port", port, "create-dc"]) == 0
+    capsys.readouterr()
+
+    assert console.main(
+        ["--port", port, "flag", "set", "sync_log", "on"]) == 0
+    assert json.loads(capsys.readouterr().out) == {"sync_log": True}
+    assert console.main(["--port", port, "flag", "get", "sync_log"]) == 0
+    assert json.loads(capsys.readouterr().out) == {"sync_log": True}
+
+
+def test_console_connect_via_descriptor_files(tmp_path):
+    bus = InProcBus()
+    cfg = lambda n: Config(n_partitions=2, data_dir=str(tmp_path / n))
+    a = DataCenter("dcA", bus, config=cfg("a"))
+    b = DataCenter("dcB", bus, config=cfg("b"))
+    a.start_bg_processes()  # heartbeats drive the connect-sync wait
+    b.start_bg_processes()
+    sa = PbServer(a, port=0).start()
+    sb = PbServer(b, port=0).start()
+    try:
+        fa = str(tmp_path / "a.desc")
+        fb = str(tmp_path / "b.desc")
+        assert console.main(
+            ["--port", str(sa.port), "descriptor", fa]) == 0
+        assert console.main(
+            ["--port", str(sb.port), "descriptor", fb]) == 0
+        assert console.main(
+            ["--port", str(sa.port), "connect", fb]) == 0
+        assert console.main(
+            ["--port", str(sb.port), "connect", fa]) == 0
+        assert "dcB" in [str(d) for d in a.connected_dcs]
+        assert "dcA" in [str(d) for d in b.connected_dcs]
+    finally:
+        sa.stop()
+        sb.stop()
+        a.close()
+        b.close()
