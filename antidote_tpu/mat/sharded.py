@@ -1,0 +1,213 @@
+"""Multi-chip sharded shard-store — the device-mesh ring.
+
+The reference scales a DC by spreading vnodes over a riak_core ring of
+Erlang nodes (SURVEY §2.7); the TPU rebuild scales by sharding ONE
+shard-store over a ``jax.sharding.Mesh`` of chips: the key axis is
+partitioned over the mesh's ``part`` axis, appends route to the owning
+chip by key range, and the stable-time fold runs as an XLA collective
+over ICI (the ``stable_time_functions:min_merge`` duty as a ``pmin``,
+not a gossip of Erlang dicts).
+
+Design (per "How to Scale Your Model" recipe: pick a mesh, annotate
+shardings, let XLA insert collectives):
+
+- **State**: one global :class:`~antidote_tpu.mat.store.OrsetShardState`
+  whose [K, ...] / [K*L, ...] arrays carry ``PartitionSpec("part")`` —
+  contiguous key ranges per chip, the ring made literal.
+- **Append**: the committed batch is replicated to every chip; each chip
+  masks to its own key range and scatters locally (``shard_map``).  No
+  all-to-all: for B ≪ K the duplicated decode is cheaper than routing,
+  and every chip sees the batch anyway when it rides the replication
+  stream.
+- **GST fold**: each chip reduces its own applied frontier, then
+  ``lax.pmin`` over ``part`` merges them — the cross-shard collective
+  VERDICT/SURVEY name as the scaling hard-part — and the fold (GC) runs
+  locally at the collective horizon.
+- **Point reads**: each chip folds its own keys, foreign keys produce
+  zeros, and a ``psum`` assembles the replicated result.
+
+Exercised on the virtual 8-device CPU mesh by
+tests/device/test_sharded_store.py and by the driver's
+``dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from antidote_tpu.clocks import dense
+from antidote_tpu.mat import store
+from antidote_tpu.mat.store import OrsetShardState
+
+
+class ShardedOrsetStore:
+    """An OR-Set store whose key space is partitioned over a mesh.
+
+    ``n_keys`` must divide evenly by the mesh size; keys
+    ``[i*K/n, (i+1)*K/n)`` live on chip i (contiguous ranges keep the
+    ops rows aligned to shard boundaries: row = key*L + lane)."""
+
+    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
+                 n_slots: int, n_dcs: int, dtype=jnp.int64):
+        assert "part" in mesh.axis_names
+        self.mesh = mesh
+        self.n_shards = mesh.shape["part"]
+        assert n_keys % self.n_shards == 0, (
+            f"{n_keys} keys not divisible by {self.n_shards} shards")
+        self.n_keys = n_keys
+        self.keys_per_shard = n_keys // self.n_shards
+        self.key_sh = NamedSharding(mesh, P("part"))
+        self.rep = NamedSharding(mesh, P())
+        st = store.orset_shard_init(n_keys, n_lanes, n_slots, n_dcs,
+                                    dtype=dtype)
+        self.st = OrsetShardState(
+            dots=jax.device_put(st.dots, self.key_sh),
+            base_vc=jax.device_put(st.base_vc, self.rep),
+            has_base=jax.device_put(st.has_base, self.rep),
+            ops=jax.device_put(st.ops, self.key_sh),
+            valid=jax.device_put(st.valid, self.key_sh),
+            n_lanes=st.n_lanes,
+        )
+        self._jits = {}
+
+    # ------------------------------------------------------------ specs
+
+    @property
+    def _state_spec(self):
+        return OrsetShardState(
+            dots=P("part"), base_vc=P(), has_base=P(), ops=P("part"),
+            valid=P("part"), n_lanes=self.st.n_lanes)
+
+    def _sm(self, fn, in_specs, out_specs, donate: bool = False):
+        key = fn.__name__
+        if key not in self._jits:
+            self._jits[key] = jax.jit(
+                jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False),
+                # state-updating entries alias the multi-hundred-MB ops
+                # tensor in place, like the single-device store's
+                # donate_argnums (an inner donation is ignored under an
+                # outer trace)
+                donate_argnums=(0,) if donate else ())
+        return self._jits[key]
+
+    def _rep_put(self, *arrays):
+        return tuple(
+            jax.device_put(jnp.asarray(a), self.rep) for a in arrays)
+
+    # ----------------------------------------------------------- append
+
+    def append(self, key_idx, lane_off, elem_slot, is_add, dot_dc,
+               dot_seq, obs_vv, op_dc, op_ct, op_ss) -> jax.Array:
+        """Scatter a committed batch (GLOBAL key indices); returns
+        bool[B] overflow (a key's owning shard ran out of ring lanes)."""
+        kps = self.keys_per_shard
+
+        def local_append(st, key_idx, lane_off, elem_slot, is_add,
+                         dot_dc, dot_seq, obs_vv, op_dc, op_ct, op_ss):
+            shard = jax.lax.axis_index("part")
+            lo = shard.astype(key_idx.dtype) * kps
+            local = key_idx - lo
+            mine = (local >= 0) & (local < kps)
+            st, overflow = store.orset_append(
+                st, jnp.where(mine, local, kps), lane_off, elem_slot,
+                is_add, dot_dc, dot_seq, obs_vv, op_dc, op_ct, op_ss,
+                active=mine)
+            # orset_append's active-mask contract keeps foreign lanes'
+            # overflow False, so a max-reduce assembles the global view
+            return st, jax.lax.pmax(overflow, "part")
+
+        fn = self._sm(
+            local_append,
+            in_specs=(self._state_spec,) + (P(),) * 10,
+            out_specs=(self._state_spec, P()), donate=True)
+        self.st, overflow = fn(
+            self.st, *self._rep_put(key_idx, lane_off, elem_slot,
+                                    is_add, dot_dc, dot_seq, obs_vv,
+                                    op_dc, op_ct, op_ss))
+        return overflow
+
+    # ------------------------------------------------------- stable fold
+
+    def gc_collective(self, local_frontiers: Optional[jax.Array] = None
+                      ) -> jax.Array:
+        """Fold at the cross-shard stable horizon and return it.
+
+        ``local_frontiers``: int[n_shards, D] per-shard applied
+        frontiers (each shard's view of how far every origin's stream
+        has applied — in the live DC this is the dependency gate's
+        watermark row per partition).  None derives each shard's
+        frontier from its own ring (max applied commit VC), which is
+        exact in the closed single-stream setting.
+
+        The horizon is ``pmin`` over shards — no key can still receive
+        an op at-or-below every shard's applied frontier — computed ON
+        DEVICE over the mesh (ICI), exactly the
+        stable_time_functions:min_merge duty (reference
+        src/stable_time_functions.erl:39-85)."""
+        if local_frontiers is None:
+            def local_gc(st):
+                cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
+                valid3 = st.valid2d[..., None]
+                frontier = jnp.max(
+                    jnp.where(valid3, cvc, 0), axis=(0, 1))
+                base = jnp.where(st.has_base, st.base_vc, 0)
+                frontier = jnp.maximum(frontier, base)
+                gst = jax.lax.pmin(frontier, "part")
+                return store.orset_gc(st, gst), gst
+
+            fn = self._sm(local_gc, in_specs=(self._state_spec,),
+                          out_specs=(self._state_spec, P()),
+                          donate=True)
+            self.st, gst = fn(self.st)
+            return gst
+
+        def local_gc_given(st, fr):
+            gst = jax.lax.pmin(fr[jax.lax.axis_index("part")], "part")
+            return store.orset_gc(st, gst), gst
+
+        fn = self._sm(local_gc_given,
+                      in_specs=(self._state_spec, P()),
+                      out_specs=(self._state_spec, P()), donate=True)
+        self.st, gst = fn(self.st, *self._rep_put(local_frontiers))
+        return gst
+
+    # ------------------------------------------------------------- reads
+
+    def read(self, read_vc) -> jax.Array:
+        """bool[K, E] presence at ``read_vc`` (output sharded by key)."""
+        (rv,) = self._rep_put(read_vc)
+
+        def local_read(st, rv):
+            return store.orset_read(st, rv)
+
+        fn = self._sm(local_read, in_specs=(self._state_spec, P()),
+                      out_specs=P("part"))
+        return fn(self.st, rv)
+
+    def read_keys(self, key_idx, read_vc) -> jax.Array:
+        """int[B, E, D] folded dot tables for GLOBAL key indices,
+        replicated to every chip (foreign shards contribute zeros; a
+        psum assembles the answer)."""
+        kps = self.keys_per_shard
+        key_idx, rv = self._rep_put(key_idx, read_vc)
+
+        def local_read_keys(st, key_idx, rv):
+            shard = jax.lax.axis_index("part")
+            lo = shard.astype(key_idx.dtype) * kps
+            local = key_idx - lo
+            mine = (local >= 0) & (local < kps)
+            dots = store.orset_read_keys(
+                st, jnp.where(mine, local, 0), rv)
+            dots = jnp.where(mine[:, None, None], dots, 0)
+            return jax.lax.psum(dots, "part")
+
+        fn = self._sm(local_read_keys,
+                      in_specs=(self._state_spec, P(), P()),
+                      out_specs=P())
+        return fn(self.st, key_idx, rv)

@@ -189,19 +189,27 @@ def orset_append(
     elem_slot: jax.Array, is_add: jax.Array,
     dot_dc: jax.Array, dot_seq: jax.Array, obs_vv: jax.Array,
     op_dc: jax.Array, op_ct: jax.Array, op_ss: jax.Array,
+    active: jax.Array | None = None,
 ) -> Tuple[OrsetShardState, jax.Array]:
     """Scatter a batch of B committed ops into free ring lanes.  Returns
     (state, overflow[B]); overflowed ops are NOT stored — the caller
-    must GC and retry or serve those keys from the log."""
+    must GC and retry or serve those keys from the log.
+
+    ``active`` (bool[B], optional) drops masked-off ops entirely (no
+    scatter, no overflow) — the sharded store's this-chip's-keys filter
+    (antidote_tpu/mat/sharded.py)."""
     dt = st.ops.dtype
     L = st.n_lanes
     lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
+    if active is not None:
+        overflow = overflow & active
     col = lambda a: a.astype(dt)[:, None]
     rows = jnp.concatenate([
         col(elem_slot), col(is_add), col(dot_dc), col(dot_seq),
         col(op_dc), col(op_ct), obs_vv.astype(dt), op_ss.astype(dt),
     ], axis=1)                                          # [B, 6+2D]
-    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
+    drop = (lane >= L) if active is None else ((lane >= L) | ~active)
+    flat = jnp.where(drop, st.ops.shape[0], key_idx * L + lane)
     ops = st.ops.at[flat].set(rows, mode="drop")
     valid = st.valid.at[flat].set(True, mode="drop")
     return replace(st, ops=ops, valid=valid), overflow
