@@ -57,37 +57,10 @@ class DataCenter(AntidoteTPU):
         self.drop_ping = False
         self.connected_dcs: List[Any] = []
 
-        self.senders = [
-            InterDcLogSender(dc_id, p, bus, enabled=False)
-            for p in range(cfg.n_partitions)
-        ]
-        self.dep_gates = [
-            DependencyGate(pm, dc_id, node.clock.now_us)
-            for pm in node.partitions
-        ]
         #: (origin_dc, partition) -> SubBuf
         self.sub_bufs: Dict[Any, SubBuf] = {}
-
-        # stable-time sources: per partition, dep-gate watermarks + own
-        # min-prepared (the quantity the outbound ping carries)
-        def _source(p):
-            def pull():
-                gate = self.dep_gates[p]
-                return VC(gate.applied_vc).set_dc(
-                    dc_id, self.node.partitions[p].min_prepared())
-            return pull
-
-        self.stable.sources = [_source(p) for p in range(cfg.n_partitions)]
-        node.stable_vc_provider = self.stable.get_stable_snapshot
+        self._build_interdc_plumbing()
         node.wait_hook = self._wait_hook
-
-        # restart recovery (reference check_node_restart,
-        # src/inter_dc_manager.erl:156-201 + logging_vnode {start_timer}
-        # src/logging_vnode.erl:301-322): seed sender watermarks and
-        # dependency clocks from the recovered logs
-        for p, pm in enumerate(node.partitions):
-            self.senders[p].seed_watermark(pm.log.op_counters.get(dc_id, 0))
-            self.dep_gates[p].seed_clock(pm.log.max_commit_vc)
 
         self._rx_lock = threading.Lock()
         self._inbox = bus.register(self.descriptor(), self._handle_query)
@@ -110,6 +83,13 @@ class DataCenter(AntidoteTPU):
                     "restart re-join: %r unreachable, will retry",
                     desc.dc_id)
                 self._retry_descs.append(desc)
+        # restore the stable-snapshot floor persisted at shutdown (see
+        # close()): stability is a permanent local fact.  The meta store
+        # itself loads nothing under recover_meta_data_on_start=False,
+        # so that flag implicitly gates this too — merely conservative
+        last_stable = self.meta.get("last_stable_vc")
+        if last_stable:
+            self.stable.seed_floor(VC(last_stable))
         # re-apply runtime flags persisted before the restart (reference
         # recovers replicated env flags from stable metadata,
         # src/dc_meta_data_utilities.erl:79-104)
@@ -140,6 +120,85 @@ class DataCenter(AntidoteTPU):
                 g.pending() for g in self.dep_gates)
         return st
 
+    def repartition(self, new_n: int) -> None:
+        """Resize the DC's ring (Node.repartition) and rebuild the
+        inter-DC plumbing at the new width.  Only a *disconnected* DC
+        may resize: partition counts are part of the cluster contract
+        (observe_dc refuses mismatched descriptors), so every DC of a
+        federation resizes separately and the cluster re-forms with
+        fresh descriptors afterwards."""
+        # stop the background workers first: the heartbeat ticker's
+        # retry path calls _connect concurrently, and the staleness
+        # sampler stays bound to the old tracker — both must be rebuilt
+        # against the resized plumbing
+        was_running = self._hb_worker is not None
+        self._stop_bg_processes()
+        self._retry_descs = []  # stale partition counts must not relink
+        if self.connected_dcs or self.sub_bufs:
+            if was_running:
+                self.start_bg_processes()
+            raise RuntimeError(
+                "repartition requires a disconnected DC: drop inter-DC "
+                "links first; peers must resize to the same count "
+                "before the cluster re-forms")
+        with self._rx_lock:
+            floor = self.stable.get_stable_snapshot()
+            self.node.repartition(new_n)
+            self.stable = StableTimeTracker(
+                self.node.dc_id, self.node.config.n_partitions)
+            # stability is permanent: the resized tracker keeps the old
+            # published floor (same rule as the restart restore above)
+            self.stable.seed_floor(floor)
+            self._build_interdc_plumbing()
+            # the quiesced pre-resize node had applied every record in
+            # its logs; the redistribution preserves that set, so every
+            # resized partition's dependency clock may start at the
+            # node-wide frontier (per-partition seeds alone would
+            # under-state it: each new log holds only a re-cut slice)
+            node_frontier = VC()
+            for pm in self.node.partitions:
+                node_frontier = node_frontier.join(pm.log.max_commit_vc)
+            for g in self.dep_gates:
+                g.seed_clock(node_frontier)
+            # persisted peers carry the old partition count — stale
+            self.meta.delete("connected_descriptors")
+        if was_running:
+            self.start_bg_processes()
+
+    def _build_interdc_plumbing(self) -> None:
+        """Senders, dependency gates, stable-time sources, and the
+        recovered watermark/clock seeds for the node's CURRENT partition
+        list — shared by boot and repartition (restart recovery:
+        reference check_node_restart, src/inter_dc_manager.erl:156-201 +
+        logging_vnode {start_timer}, src/logging_vnode.erl:301-322)."""
+        node = self.node
+        dc_id = node.dc_id
+        n = node.config.n_partitions
+        self.senders = [
+            InterDcLogSender(dc_id, p, self.bus, enabled=False)
+            for p in range(n)
+        ]
+        self.dep_gates = [
+            DependencyGate(pm, dc_id, node.clock.now_us)
+            for pm in node.partitions
+        ]
+
+        # stable-time sources: per partition, dep-gate watermarks + own
+        # min-prepared (the quantity the outbound ping carries)
+        def _source(p):
+            def pull():
+                gate = self.dep_gates[p]
+                return VC(gate.applied_vc).set_dc(
+                    dc_id, node.partitions[p].min_prepared())
+            return pull
+
+        self.stable.sources = [_source(p) for p in range(n)]
+        node.stable_vc_provider = self.stable.get_stable_snapshot
+        for p, pm in enumerate(node.partitions):
+            self.senders[p].seed_watermark(
+                pm.log.op_counters.get(dc_id, 0))
+            self.dep_gates[p].seed_clock(pm.log.max_commit_vc)
+
     # ---------------------------------------------------------- membership
 
     def descriptor(self) -> DcDescriptor:
@@ -167,6 +226,14 @@ class DataCenter(AntidoteTPU):
     def _connect(self, desc: DcDescriptor) -> None:
         if desc.dc_id in self.connected_dcs:
             return
+        if desc.n_partitions != self.node.config.n_partitions:
+            # observe_dc checks this too, but _connect is also reached
+            # by the restart/retry path — a stale descriptor (e.g. from
+            # before a repartition) must never half-link
+            raise ValueError(
+                f"descriptor {desc.dc_id} has {desc.n_partitions} "
+                f"partitions, local DC has "
+                f"{self.node.config.n_partitions}")
         # transport-level subscription first (dial + probe for TCP; no-op
         # in-proc) so a dead peer fails before we commit membership state
         self.bus.connect(self.node.dc_id, desc)
@@ -239,6 +306,10 @@ class DataCenter(AntidoteTPU):
                     self._connect(desc)
                 except LinkDown:
                     still.append(desc)
+                except ValueError:
+                    logging.getLogger(__name__).warning(
+                        "dropping stale descriptor %r (partition-count "
+                        "mismatch)", desc.dc_id)
             self._retry_descs = still
         for p, sender in enumerate(self.senders):
             sender.ping(self.node.partitions[p].min_prepared())
@@ -315,7 +386,7 @@ class DataCenter(AntidoteTPU):
 
     # ----------------------------------------------------------- shutdown
 
-    def close(self) -> None:
+    def _stop_bg_processes(self) -> None:
         if self._hb_worker is not None:
             self._hb_worker.stop()
             self._hb_worker = None
@@ -325,7 +396,16 @@ class DataCenter(AntidoteTPU):
         if self._staleness is not None:
             self._staleness.stop()
             self._staleness = None
+
+    def close(self) -> None:
+        self._stop_bg_processes()
         self._worker.stop()
+        # persist the published stable snapshot: stability is permanent,
+        # and the restarted tracker floors itself here so None-clock
+        # reads keep seeing everything that was stable before the
+        # shutdown (heartbeat advancement is not logged)
+        self.meta.put("last_stable_vc",
+                      dict(self.stable.get_stable_snapshot()))
         self.bus.unregister(self.node.dc_id)
         super().close()
 

@@ -63,6 +63,17 @@ class StableTimeTracker:
         for p, src in enumerate(self.sources):
             self.put(p, src())
 
+    def seed_floor(self, vc: VC) -> None:
+        """Restore a previously-published stable snapshot (restart
+        recovery): stability is permanent, so a time once published as
+        stable may floor the published clock forever — without this the
+        GST regresses across a restart to whatever the logs alone can
+        prove, hiding committed-but-remote-dependent history until the
+        peers gossip again (the reference persists its stable meta for
+        the same reason, recover_meta_data_on_start)."""
+        with self._lock:
+            self._published = self._published.join(vc)
+
     def get_stable_snapshot(self) -> VC:
         """Column-wise min over partitions, published monotonically
         (reference dc_utilities:get_stable_snapshot,

@@ -101,14 +101,14 @@ class DurableLog:
     def flush(self) -> None:
         if self._native:
             self._native[0].oplog_flush(self._native[1])
-        else:
+        elif self._py is not None:  # no-op on a closed log
             self._py.flush()
 
     def sync(self) -> None:
         """Flush + fsync — the commit-path durability barrier."""
         if self._native:
             self._native[0].oplog_sync(self._native[1])
-        else:
+        elif self._py is not None:  # no-op on a closed log
             self._py.sync()
 
     def end_offset(self) -> int:

@@ -188,6 +188,15 @@ class PartitionLog:
                 (dc, ct) = rec.payload[1]
                 if ct > self.max_commit_vc.get_dc(dc):
                     self.max_commit_vc = self.max_commit_vc.set_dc(dc, ct)
+                # join the commit's full snapshot VC: an applied commit's
+                # dependencies were covered when it applied, so the
+                # recovered dependency clock may include them — without
+                # this, a restarted DC whose local commits depended on a
+                # now-unreachable peer cannot cover its OWN history in
+                # the stable snapshot (the reference recovers its stable
+                # meta for the same reason, recover_meta_data_on_start)
+                self.max_commit_vc = self.max_commit_vc.join(
+                    rec.payload[2])
 
     def close(self) -> None:
         if self.enabled:

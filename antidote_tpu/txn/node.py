@@ -20,6 +20,7 @@ from antidote_tpu.clocks import VC
 from antidote_tpu.config import Config
 from antidote_tpu.hooks import HookRegistry
 from antidote_tpu.oplog.partition import PartitionLog
+from antidote_tpu.oplog.records import commit_certified
 from antidote_tpu.txn.clock import HybridClock
 from antidote_tpu.txn.coordinator import Coordinator
 from antidote_tpu.txn.manager import PartitionManager
@@ -35,22 +36,13 @@ class Node:
         self.hooks = HookRegistry()
         base = data_dir or self.config.data_dir
         os.makedirs(base, exist_ok=True)
-        self.partitions: List[PartitionManager] = []
-        for p in range(self.config.n_partitions):
-            log = PartitionLog(
-                os.path.join(base, f"{dc_id}_p{p}.log"), partition=p,
-                sync_on_commit=self.config.sync_log,
-                enabled=self.config.enable_logging,
-                on_append=(lambda rec, _p=p: on_log_append(_p, rec))
-                if on_log_append else None)
-            plane = None
-            if self.config.device_store:
-                from antidote_tpu.mat.device_plane import DevicePlane
-
-                plane = DevicePlane(config=self.config)
-            self.partitions.append(
-                PartitionManager(p, dc_id, log, self.clock,
-                                 device_plane=plane))
+        self.data_dir = base
+        self._on_log_append = on_log_append
+        self._resume_interrupted_resize()
+        self.partitions: List[PartitionManager] = [
+            self._build_partition(p)
+            for p in range(self.config.n_partitions)
+        ]
         #: provider of the gossiped stable snapshot (set by the meta
         #: plane / inter-DC layer).  The single-DC default is the node's
         #: own min-prepared time: no future local commit can fall below
@@ -58,8 +50,6 @@ class Node:
         #: stable snapshot.
         self.stable_vc_provider: Callable[[], VC] = (
             lambda: VC({dc_id: self.min_prepared_vc()}))
-        for pm in self.partitions:
-            pm.stable_vc_source = self.stable_vc
         #: called inside causal clock-wait spins; the inter-DC layer
         #: points this at its inbound pump so waiting makes progress
         self.wait_hook: Callable[[], None] = lambda: time.sleep(0.002)
@@ -69,6 +59,172 @@ class Node:
         self.bcounter_mgr = None
         if self.config.recover_from_log:
             self._recover_stores()
+
+    # ------------------------------------------------------------ elasticity
+
+    def repartition(self, new_n: int) -> None:
+        """Ring resize: redistribute every committed transaction across
+        ``new_n`` partitions and rebuild the materializer planes — the
+        riak_core handoff fold duty (reference logging_vnode.erl:781-812
+        folds the log, materializer_vnode.erl:221-246 folds the cache
+        across a vnode move), generalized to a resize the reference's
+        fixed ring cannot do.
+
+        Requires a quiesced node (no in-flight transactions).  The fold
+        collects every committed transaction across ALL old logs (a txn
+        that spanned old partitions reassembles into one group), then
+        replays each group once: updates route to their key's new
+        owner, each participating new partition gets its own commit
+        copy — the same per-participant commit layout the live protocol
+        writes — and EVERY origin's stream is renumbered densely on its
+        new partitions.  Dense renumbering is what keeps inter-DC
+        watermarks meaningful after a whole-federation resize: two DCs
+        folding the same replicated history produce the same per-origin
+        record multiset per new partition, hence identical stream
+        counts, so reseeded sub/sender watermarks agree (tested by the
+        resize-rejoin case in tests/multidc/test_elasticity.py).
+        Materializer state (host + device planes) is rebuilt by the
+        standard recovery replay — handoff IS recovery from a
+        redistributed log."""
+        if new_n < 1:
+            raise ValueError(f"new_n must be >= 1, got {new_n}")
+        old_parts = self.partitions
+        for pm in old_parts:
+            with pm._lock:
+                if pm.prepared or pm._staged:
+                    raise RuntimeError(
+                        "repartition requires a quiesced node "
+                        "(in-flight transactions present)")
+        old_n = self.config.n_partitions
+        if new_n == old_n:
+            return
+        if not self.config.enable_logging:
+            raise RuntimeError(
+                "repartition folds the durable logs; enable_logging=False "
+                "leaves nothing to redistribute")
+
+        # 1. reassemble committed txn groups across ALL old logs (the
+        #    whole history fits one host pass; resizes are rare)
+        updates: dict = {}   # txid -> [update records]
+        commits: dict = {}   # txid -> commit record (first copy wins)
+        commit_order: list = []
+        for pm in old_parts:
+            for rec in pm.log.records():
+                kind = rec.kind()
+                if kind == "update":
+                    updates.setdefault(rec.txid, []).append(rec)
+                elif kind == "commit" and rec.txid not in commits:
+                    commits[rec.txid] = rec
+                    commit_order.append(rec.txid)
+                # prepares of committed txns are implied; dangling
+                # prepares/aborted txns do not survive the resize
+
+        # 2. replay each group once into fresh per-partition logs
+        #    (staged files never fsync per commit: they are discarded on
+        #    any crash before the journaled swap below)
+        resize_paths = [self._log_path(p) + ".resize"
+                        for p in range(new_n)]
+        for path in resize_paths:
+            if os.path.exists(path):
+                os.remove(path)
+        new_logs = [
+            PartitionLog(path, partition=p, sync_on_commit=False,
+                         enabled=self.config.enable_logging)
+            for p, path in enumerate(resize_paths)
+        ]
+        for txid in commit_order:
+            rec = commits[txid]
+            dests: dict = {}
+            for u in updates.get(txid, ()):
+                dest = self.partition_index(u.payload[1], new_n)
+                dests.setdefault(dest, []).append(u)
+            (dc, ct) = rec.payload[1]
+            svc = rec.payload[2]
+            cert = commit_certified(rec.payload)
+            for p, ups in dests.items():
+                lg = new_logs[p]
+                for u in ups:
+                    lg.append_update(dc, txid, u.payload[1],
+                                     u.payload[2], u.payload[3])
+                lg.append_commit(dc, txid, ct, svc, certified=cert)
+        for lg in new_logs:
+            lg.close()
+
+        # 3. journaled swap: the per-file renames are not atomic as a
+        #    group, so a journal marks the transition — a crash mid-swap
+        #    resumes it at the next boot (_complete_resize_swap) instead
+        #    of silently booting with empty/mixed logs
+        for pm in old_parts:
+            pm.log.close()
+        journal = self._resize_journal_path()
+        tmp = journal + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{old_n} {new_n}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, journal)
+        self._complete_resize_swap(old_n, new_n)
+
+        # 4. rebuild partitions + materializer via standard recovery
+        self.config.n_partitions = new_n
+        self.partitions = [self._build_partition(p)
+                           for p in range(new_n)]
+        self._recover_stores()
+
+    def _resize_journal_path(self) -> str:
+        return os.path.join(self.data_dir, f"{self.dc_id}_resize.journal")
+
+    def _complete_resize_swap(self, old_n: int, new_n: int) -> None:
+        """Idempotently finish a journaled log swap: every remaining
+        ``.resize`` file moves into place (displacing the old log to
+        ``.pre-resize``), then the journal clears.  Called by
+        repartition and by boot-time crash recovery."""
+        for p in range(new_n):
+            live = self._log_path(p)
+            staged = live + ".resize"
+            if not os.path.exists(staged):
+                continue  # this slot's swap already completed
+            if os.path.exists(live):
+                os.replace(live, live + ".pre-resize")
+            os.replace(staged, live)
+        for p in range(new_n, old_n):  # shrink: retire extra old logs
+            live = self._log_path(p)
+            if os.path.exists(live):
+                os.replace(live, live + ".pre-resize")
+        os.remove(self._resize_journal_path())
+
+    def _resume_interrupted_resize(self) -> None:
+        """Boot-time check: a journal on disk means a crash interrupted
+        a repartition after its staged logs were complete — finish the
+        swap and adopt the journal's partition count (the caller's
+        config may still carry the old one)."""
+        journal = self._resize_journal_path()
+        if not os.path.exists(journal):
+            return
+        with open(journal) as f:
+            old_n, new_n = (int(x) for x in f.read().split())
+        self._complete_resize_swap(old_n, new_n)
+        self.config.n_partitions = new_n
+
+    def _log_path(self, p: int) -> str:
+        return os.path.join(self.data_dir, f"{self.dc_id}_p{p}.log")
+
+    def _build_partition(self, p: int) -> PartitionManager:
+        log = PartitionLog(
+            self._log_path(p), partition=p,
+            sync_on_commit=self.config.sync_log,
+            enabled=self.config.enable_logging,
+            on_append=(lambda rec, _p=p: self._on_log_append(_p, rec))
+            if self._on_log_append else None)
+        plane = None
+        if self.config.device_store:
+            from antidote_tpu.mat.device_plane import DevicePlane
+
+            plane = DevicePlane(config=self.config)
+        pm = PartitionManager(p, self.dc_id, log, self.clock,
+                              device_plane=plane)
+        pm.stable_vc_source = self.stable_vc
+        return pm
 
     # ------------------------------------------------------- runtime flags
 
@@ -103,8 +259,8 @@ class Node:
 
     # ----------------------------------------------------------- placement
 
-    def partition_index(self, key) -> int:
-        n = self.config.n_partitions
+    def partition_index(self, key, n: Optional[int] = None) -> int:
+        n = n if n is not None else self.config.n_partitions
         if isinstance(key, int):
             return key % n
         # stable across restarts (Python's hash() is salted per process,
