@@ -37,6 +37,13 @@ class AntidoteTPU:
     def start_transaction(self, clock: Optional[VC] = None,
                           properties: Optional[TxnProperties] = None
                           ) -> Transaction:
+        """Under txn_prot="gr" interactive transactions snapshot at the
+        GentleRain scalar GST (reference cure.erl:233-257 applies the
+        protocol to every transaction start, not only static reads);
+        Clock-SI otherwise."""
+        if self.node.config.txn_prot == "gr":
+            return self.node.coordinator.start_transaction_gr(
+                clock, properties)
         return self.node.coordinator.start_transaction(clock, properties)
 
     def read_objects(self, objects: List, tx: Transaction) -> List[Any]:
@@ -61,11 +68,7 @@ class AntidoteTPU:
         takes the same txn properties).  Under txn_prot="gr" the
         snapshot is the GentleRain scalar-GST wait instead of the
         Clock-SI max(stable, client) rule (reference src/cure.erl:233-257)."""
-        if self.node.config.txn_prot == "gr":
-            tx = self.node.coordinator.start_transaction_gr(
-                clock, properties)
-        else:
-            tx = self.start_transaction(clock, properties)
+        tx = self.start_transaction(clock, properties)
         values = self.read_objects(objects, tx)
         commit_vc = self.commit_transaction(tx)
         return values, commit_vc
@@ -132,6 +135,18 @@ class AntidoteTPU:
                 f"multi-node DCs are not supported (got {others}); this "
                 "DC scales via partitions/device shards — connect "
                 "separate DCs with connect_to_dcs instead")
+
+    def start_profiling(self, log_dir: str) -> None:
+        """Begin a JAX profiler capture of the node's device work
+        (SURVEY §5.1; inspect with TensorBoard/XProf)."""
+        from antidote_tpu import tracing
+
+        tracing.start(log_dir)
+
+    def stop_profiling(self) -> str:
+        from antidote_tpu import tracing
+
+        return tracing.stop()
 
     def admin_status(self) -> dict:
         """Operator status snapshot (the antidote_console duty,

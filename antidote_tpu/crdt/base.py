@@ -40,7 +40,7 @@ so reads never crash on heterogeneous data.
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Dict, Tuple
 
 Op = Tuple[str, Any]
 Effect = Any

@@ -133,7 +133,6 @@ class DataCenter(AntidoteTPU):
         # against the resized plumbing
         was_running = self._hb_worker is not None
         self._stop_bg_processes()
-        self._retry_descs = []  # stale partition counts must not relink
         if self.connected_dcs or self.sub_bufs:
             if was_running:
                 self.start_bg_processes()
@@ -141,6 +140,9 @@ class DataCenter(AntidoteTPU):
                 "repartition requires a disconnected DC: drop inter-DC "
                 "links first; peers must resize to the same count "
                 "before the cluster re-forms")
+        # only once the resize actually proceeds: pending re-join
+        # retries carry the OLD partition count and must not relink
+        self._retry_descs = []
         with self._rx_lock:
             floor = self.stable.get_stable_snapshot()
             self.node.repartition(new_n)

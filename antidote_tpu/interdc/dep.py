@@ -173,9 +173,13 @@ class DependencyGate:
         # (the two gating paths must agree regardless of queue depth)
         pvc[cols[self.own_dc]] = self.now_us()
 
-        applied, rounds, _new_pvc = gate_fixpoint(
-            jnp.asarray(ss), jnp.asarray(origin_col), jnp.asarray(pos_arr),
-            jnp.asarray(ts), jnp.asarray(ping), jnp.asarray(pvc))
+        from antidote_tpu import tracing
+
+        with tracing.annotate("gate_fixpoint"):
+            applied, rounds, _new_pvc = gate_fixpoint(
+                jnp.asarray(ss), jnp.asarray(origin_col),
+                jnp.asarray(pos_arr), jnp.asarray(ts), jnp.asarray(ping),
+                jnp.asarray(pvc))
         applied = np.asarray(applied)
         rounds = np.asarray(rounds)
 

@@ -10,7 +10,7 @@ check can consume them like live frames.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import List, Optional
 
 from antidote_tpu.interdc.transport import LinkDown, Transport
 from antidote_tpu.interdc.wire import InterDcTxn

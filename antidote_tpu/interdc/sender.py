@@ -12,7 +12,6 @@ include/antidote.hrl:55).
 from __future__ import annotations
 
 import threading
-from typing import Any, Optional
 
 from antidote_tpu.interdc.transport import Transport
 from antidote_tpu.interdc.wire import InterDcTxn

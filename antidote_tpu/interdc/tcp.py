@@ -32,7 +32,6 @@ import queue
 import socket
 import struct
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from antidote_tpu.interdc import termcodec

@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from antidote_tpu import tracing
 from antidote_tpu.clocks import VC, ClockDomain
 from antidote_tpu.mat import store
 from antidote_tpu.mat.materializer import Payload
@@ -241,7 +242,8 @@ class _PlaneBase:
             return
         rows, self.rows = self.rows, []
         self.pending_keys.clear()
-        overflow = self._append_rows(rows)
+        with tracing.annotate(f"device_flush:{self.type_name}"):
+            overflow = self._append_rows(rows)
         self._ops_since_gc += len(rows)
         if overflow.any():
             retry = [r for r, o in zip(rows, overflow) if o]
@@ -278,7 +280,8 @@ class _PlaneBase:
         pairs = self._ss_pairs(stable_vc)
         if pairs is None:
             return
-        self._device_gc(self._dense_vc(pairs))
+        with tracing.annotate(f"device_gc:{self.type_name}"):
+            self._device_gc(self._dense_vc(pairs))
         self._base_vc = self._base_vc.join(stable_vc)
         self._has_base = True
         self._ops_since_gc = 0

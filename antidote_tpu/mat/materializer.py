@@ -24,7 +24,7 @@ This host path is the semantic oracle: the batched TPU path
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
 
 from antidote_tpu.clocks import VC, vc_max

@@ -1,4 +1,5 @@
-"""Stable-snapshot (GST) computation — the meta_data_sender equivalent.
+"""Stable-snapshot (GST) computation — the stable-time instance of the
+generic metadata merge plane.
 
 The reference gossips each partition's vector clock once a second and
 publishes the column-wise min, monotonically (reference
@@ -8,6 +9,9 @@ pins that column to zero).  In one process the gossip network collapses
 to a dense ``int64[P, D]`` matrix and the GST is a single min-reduce —
 the dense kernel path (antidote_tpu/clocks/dense.min_merge) that scales
 the same computation to 256 simulated DCs on device (BASELINE config 5).
+The fold + monotone publish run through the generic
+:class:`antidote_tpu.meta.sender.MetaDataSender` framework, exactly as
+the reference registers `stable` with stable_time_functions callbacks.
 
 The node dimension of the reference's gossip (partitions live on many
 BEAM nodes per DC) maps to the device mesh in this rebuild: sharded
@@ -23,21 +27,52 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from antidote_tpu.clocks import VC, ClockDomain
+from antidote_tpu.meta.sender import MetaDataSender
 
 
 class StableTimeTracker:
     """Per-partition VC rows -> monotone published GST for one DC."""
 
-    def __init__(self, dc_id, n_partitions: int, domain: Optional[ClockDomain] = None):
+    def __init__(self, dc_id, n_partitions: int,
+                 domain: Optional[ClockDomain] = None,
+                 sender: Optional[MetaDataSender] = None):
         self.dc_id = dc_id
         self.n_partitions = n_partitions
         self.domain = domain or ClockDomain(8)
-        self._rows = np.zeros((n_partitions, self.domain.d), dtype=np.int64)
-        self._published = VC()
+        self.sender = sender or MetaDataSender()
         self._lock = threading.Lock()
+        self.sender.register(
+            "stable", n_partitions,
+            initial=lambda: np.zeros(self.domain.d, dtype=np.int64),
+            merge=self._merge_rows,
+            publish=self._publish_monotone,
+        )
+        # restart-recovery floor (see seed_floor): a single-slot entry
+        # whose publish is the same monotone join
+        self.sender.register(
+            "stable_floor", 1, initial=lambda: None,
+            merge=lambda vs: vs[0],
+            publish=lambda prev, new:
+                new if prev is None
+                else (prev if new is None else prev.join(new)),
+        )
         #: pull sources: partition -> () -> VC; set by the DC assembly
         #: (dep-gate applied watermarks + own min-prepared)
         self.sources: List[Callable[[], VC]] = []
+
+    # -- merge callbacks (the stable_time_functions role) ----------------
+
+    def _merge_rows(self, rows: List[np.ndarray]) -> VC:
+        if len(self.domain) == 0:
+            return VC()
+        gst = np.stack(rows).min(axis=0)
+        return self.domain.from_dense(gst)
+
+    @staticmethod
+    def _publish_monotone(prev: Optional[VC], new: VC) -> VC:
+        return new if prev is None else prev.join(new)
+
+    # -- per-partition inputs --------------------------------------------
 
     def _grow_if_needed(self, vc: VC) -> None:
         unseen = [dc for dc, t in vc.items()
@@ -45,9 +80,9 @@ class StableTimeTracker:
         if len(self.domain) + len(unseen) > self.domain.d:
             new_d = max(self.domain.d * 2, len(self.domain) + len(unseen))
             self.domain = self.domain.grow(new_d)
-            rows = np.zeros((self.n_partitions, new_d), dtype=np.int64)
-            rows[:, : self._rows.shape[1]] = self._rows
-            self._rows = rows
+            pad = lambda row: np.pad(row, (0, new_d - len(row)))
+            for p in range(self.n_partitions):
+                self.sender.update("stable", p, pad)
 
     def put(self, partition: int, vc: VC) -> None:
         """Advance one partition's row (entries never regress — gossip
@@ -56,7 +91,8 @@ class StableTimeTracker:
         with self._lock:
             self._grow_if_needed(vc)
             row = self.domain.to_dense(vc)
-            np.maximum(self._rows[partition], row, out=self._rows[partition])
+            self.sender.update(
+                "stable", partition, lambda cur: np.maximum(cur, row))
 
     def refresh(self) -> None:
         """Pull every partition's current VC from its source."""
@@ -72,7 +108,9 @@ class StableTimeTracker:
         peers gossip again (the reference persists its stable meta for
         the same reason, recover_meta_data_on_start)."""
         with self._lock:
-            self._published = self._published.join(vc)
+            self._grow_if_needed(vc)
+        self.sender.put("stable_floor", 0, vc)
+        self.sender.merged("stable_floor")
 
     def get_stable_snapshot(self) -> VC:
         """Column-wise min over partitions, published monotonically
@@ -81,11 +119,9 @@ class StableTimeTracker:
         if self.sources:
             self.refresh()
         with self._lock:
-            if len(self.domain) == 0:
-                return VC(self._published)
-            gst = self._rows.min(axis=0)
-            self._published = self._published.join(self.domain.from_dense(gst))
-            return VC(self._published)
+            stable = self.sender.merged("stable")
+            floor = self.sender.peek("stable_floor")
+            return VC(stable if floor is None else stable.join(floor))
 
     def get_scalar_stable_time(self):
         """GentleRain form: (GST scalar, vector stable time) — the min

@@ -12,7 +12,7 @@ term_to_binary blobs (reference src/antidote_pb_process.erl:41-46).
 from __future__ import annotations
 
 import struct
-from typing import Any, Optional, Tuple
+from typing import Optional, Tuple
 
 from antidote_tpu.clocks import VC
 from antidote_tpu.pb import antidote_pb2 as pb

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import http.server
 import logging
+import os
 import threading
 import time
 from typing import Dict, Iterable, Optional, Tuple
@@ -164,7 +165,51 @@ class Registry:
         lines = []
         for m in self.metrics():
             lines.extend(m.expose())
+        lines.extend(process_metrics())
         return "\n".join(lines) + "\n"
+
+
+def process_metrics() -> list:
+    """Process-level gauges from /proc — the
+    prometheus_process_collector role (reference rebar.config dep;
+    standard process_* metric names).  Empty off Linux."""
+    out = []
+    try:
+        with open("/proc/self/stat") as f:
+            parts = f.read().split()
+        tick = os.sysconf("SC_CLK_TCK")
+        page = os.sysconf("SC_PAGE_SIZE")
+        utime, stime = int(parts[13]), int(parts[14])
+        vsize, rss_pages = int(parts[22]), int(parts[23])
+        start_ticks = int(parts[21])
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+        out += [
+            "# TYPE process_cpu_seconds_total counter",
+            f"process_cpu_seconds_total {(utime + stime) / tick:.3f}",
+            "# TYPE process_virtual_memory_bytes gauge",
+            f"process_virtual_memory_bytes {vsize}",
+            "# TYPE process_resident_memory_bytes gauge",
+            f"process_resident_memory_bytes {rss_pages * page}",
+            "# TYPE process_start_time_seconds gauge",
+            f"process_start_time_seconds "
+            f"{time.time() - uptime + start_ticks / tick:.3f}",
+        ]
+        out += [
+            "# TYPE process_open_fds gauge",
+            f"process_open_fds {len(os.listdir('/proc/self/fd'))}",
+        ]
+        with open("/proc/self/limits") as f:
+            for line in f:
+                if line.startswith("Max open files"):
+                    out += [
+                        "# TYPE process_max_fds gauge",
+                        f"process_max_fds {line.split()[3]}",
+                    ]
+                    break
+    except (OSError, ValueError, IndexError):
+        return []
+    return out
 
 
 #: process-wide registry (the reference's metrics are BEAM-node-global)

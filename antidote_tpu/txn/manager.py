@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from antidote_tpu.clocks import VC
 from antidote_tpu.mat.device_plane import DevicePlane, ReadBelowBase

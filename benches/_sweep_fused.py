@@ -3,7 +3,6 @@ import sys
 import time
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from antidote_tpu.mat import store

@@ -18,7 +18,6 @@ concurrency story is 20 read servers + shared-ETS reads per vnode
 (reference include/antidote.hrl:28, src/clocksi_readitem_server.erl),
 so scaling with client concurrency is the honest comparable."""
 
-import json
 import shutil
 import tempfile
 import threading

@@ -142,9 +142,11 @@ def test_both_dcs_resize_and_refederate(tmp_path):
     a.close()
     b.close()
 
-    # maintenance reboot: auto-rejoin off (the operator is resizing),
-    # but the persisted stable floor still restores — None-clock reads
-    # keep seeing everything that was stable before the shutdown
+    # maintenance reboot: recover_meta_data_on_start=False skips both
+    # auto-rejoin AND the stable-floor restore (the meta store loads
+    # nothing), so the post-resize checks below read at the explicit
+    # commit clock; the floor round-trip itself is covered by
+    # test_stable_floor_restores_on_recovering_restart
     bus2 = InProcBus()
     a2 = DataCenter("dcA", bus2,
                     config=cfg(2, recover_meta_data_on_start=False),
@@ -214,3 +216,34 @@ def test_crash_mid_swap_resumes_at_boot(tmp_path):
     assert not os.path.exists(os.path.join(data, "dc1_resize.journal"))
     check(db2, want)
     db2.close()
+
+
+def test_stable_floor_restores_on_recovering_restart(tmp_path):
+    """With recover_meta_data_on_start=True the persisted stable floor
+    round-trips: a restarted DC whose peer is down still serves its
+    full history to None-clock reads (the GST would otherwise regress
+    below commits that carried remote dependencies)."""
+    cfg = lambda n, **kw: Config(n_partitions=n, heartbeat_s=0.02,
+                                 clock_wait_timeout_s=10.0, **kw)
+    bus = InProcBus()
+    a = DataCenter("dcA", bus, config=cfg(2),
+                   data_dir=str(tmp_path / "a"))
+    b = DataCenter("dcB", bus, config=cfg(2),
+                   data_dir=str(tmp_path / "b"))
+    connect_dcs([a, b])
+    a.start_bg_processes()
+    b.start_bg_processes()
+    want, ct = seed(a, n_keys=6)
+    a.close()
+    b.close()
+
+    # restart ONLY A; B stays down (rejoin goes to the retry list)
+    a2 = DataCenter("dcA", InProcBus(), config=cfg(2),
+                    data_dir=str(tmp_path / "a"))
+    try:
+        floor = a2.stable.get_stable_snapshot()
+        # the floor restored dcB's pre-shutdown coverage
+        assert floor.get_dc("dcB") >= ct.get_dc("dcB")
+        check(a2, want)  # None-clock reads see everything
+    finally:
+        a2.close()

@@ -66,3 +66,19 @@ def test_gr_timeout_on_unreachable_clock(tmp_path):
             db.read_objects_static(future, [("k", "counter_pn")])
     finally:
         db.close()
+
+
+def test_interactive_txn_uses_gr_snapshot(db):
+    """Interactive transactions honor txn_prot=gr: the snapshot is the
+    GentleRain all-GST vector (every known DC at the scalar GST), and
+    update/commit/read round-trips work through it."""
+    bo = ("gr_inter", "counter_pn", "b")
+    tx = db.start_transaction()
+    # GR snapshots carry the own-DC entry at the scalar GST
+    assert tx.snapshot_vc.get_dc("dc1") > 0
+    db.update_objects([(bo, "increment", 5)], tx)
+    ct = db.commit_transaction(tx)
+    tx2 = db.start_transaction(ct)
+    vals = db.read_objects([bo], tx2)
+    db.commit_transaction(tx2)
+    assert vals == [5]

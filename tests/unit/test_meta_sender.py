@@ -1,0 +1,58 @@
+"""Generic metadata merge framework (antidote_tpu/meta/sender.py — the
+meta_data_sender duty) + its stable-time flagship instance."""
+
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.meta.gossip import StableTimeTracker
+from antidote_tpu.meta.sender import MetaDataSender
+
+
+def test_register_put_merge_publish():
+    s = MetaDataSender()
+    seen = []
+    s.register("mins", 3, initial=lambda: 100,
+               merge=min, publish=lambda prev, new: new,
+               on_update=seen.append)
+    assert s.merged("mins") == 100
+    s.put("mins", 1, 40)
+    s.put("mins", 2, 60)
+    assert s.merged("mins") == 40
+    assert seen == [100, 40]  # callback fires only on change
+    assert s.merged("mins") == 40
+    assert seen == [100, 40]
+    assert s.peek("mins") == 40
+    assert s.names() == ["mins"]
+
+
+def test_update_read_modify_write():
+    s = MetaDataSender()
+    s.register("sum", 2, initial=lambda: 0, merge=sum)
+    s.update("sum", 0, lambda v: v + 5)
+    s.update("sum", 0, lambda v: v + 5)
+    s.update("sum", 1, lambda v: v + 1)
+    assert s.merged("sum") == 11
+
+
+def test_duplicate_registration_rejected():
+    s = MetaDataSender()
+    s.register("x", 1, initial=lambda: 0, merge=min)
+    with pytest.raises(KeyError):
+        s.register("x", 1, initial=lambda: 0, merge=min)
+
+
+def test_stable_tracker_is_a_sender_instance():
+    """The GST plane runs through the generic framework: min-merge over
+    partition rows, monotone publish, and the restart floor."""
+    t = StableTimeTracker("dcA", n_partitions=2)
+    assert set(t.sender.names()) == {"stable", "stable_floor"}
+    t.put(0, VC({"dcA": 100, "dcB": 50}))
+    t.put(1, VC({"dcA": 80, "dcB": 90}))
+    st = t.get_stable_snapshot()
+    assert st == VC({"dcA": 80, "dcB": 50})
+    # monotone publish: a regressing row cannot pull the GST back
+    t.put(1, VC({"dcA": 70}))
+    assert t.get_stable_snapshot() == VC({"dcA": 80, "dcB": 50})
+    # the floor joins in (restart recovery)
+    t.seed_floor(VC({"dcC": 7}))
+    assert t.get_stable_snapshot().get_dc("dcC") == 7

@@ -1,0 +1,34 @@
+"""JAX profiler integration (antidote_tpu/tracing.py, SURVEY §5.1)."""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from antidote_tpu import tracing
+
+
+def test_profile_captures_trace(tmp_path):
+    with tracing.profile(str(tmp_path)):
+        assert tracing.active_dir() == str(tmp_path)
+        with tracing.annotate("antidote_test_op"):
+            jnp.arange(512.0).sum().block_until_ready()
+    assert tracing.active_dir() is None
+    files = [f for _r, _d, fs in os.walk(tmp_path) for f in fs]
+    assert files, "profiler produced no trace files"
+
+
+def test_double_start_rejected(tmp_path):
+    tracing.start(str(tmp_path))
+    try:
+        with pytest.raises(RuntimeError, match="already capturing"):
+            tracing.start(str(tmp_path))
+    finally:
+        tracing.stop()
+    with pytest.raises(RuntimeError, match="no profiler"):
+        tracing.stop()
+
+
+def test_annotation_without_capture_is_noop():
+    with tracing.annotate("idle"):
+        pass

@@ -1,0 +1,177 @@
+"""Static analysis gate — the dialyzer/elvis stage of the reference's
+build (reference Makefile:95-96) rebuilt on the stdlib (no lint
+packages ship in this environment).
+
+Checks, per file:
+- syntax (ast parse)
+- unused module-level imports   [unused-import]
+- bare ``except:``              [bare-except]
+- mutable default arguments     [mutable-default]
+- duplicate def/class names in one scope  [duplicate-def]
+- ``== True`` / ``== None`` comparisons   [literal-compare]
+
+``# noqa`` on the offending line suppresses it.  Exit status 1 on any
+finding; run as:  python -m tools.analysis_gate [paths...]
+The test suite runs this over the whole package
+(tests/unit/test_analysis_gate.py), so the gate is part of CI the same
+way the reference wires dialyzer into `make test`.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ("antidote_tpu", "benches", "tools",
+                 "bench.py", "__graft_entry__.py")
+
+
+def _noqa_lines(src: str) -> set:
+    return {i for i, line in enumerate(src.splitlines(), 1)
+            if "# noqa" in line}
+
+
+class _Scope(ast.NodeVisitor):
+    """One file's findings."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.noqa = _noqa_lines(src)
+        self.findings: list = []
+        #: alias -> (lineno, name) for module-level imports
+        self.imports: dict = {}
+        self.used: set = set()
+
+    def add(self, node, code: str, msg: str) -> None:
+        if node.lineno in self.noqa:
+            return
+        self.findings.append((self.path, node.lineno, code, msg))
+
+    # imports (module level only: function-local lazy imports are a
+    # deliberate pattern here for jax-lazy modules)
+    def collect_imports(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    self.imports[alias] = (node.lineno, a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    alias = a.asname or a.name
+                    self.imports[alias] = (node.lineno, a.name)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            self.used.add(root.id)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.add(node, "bare-except",
+                     "bare `except:` swallows KeyboardInterrupt/SystemExit")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.add(d, "mutable-default",
+                         "mutable default argument is shared across calls")
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self._dup_check(node.body, f"{node.name}()")
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._dup_check(node.body, f"class {node.name}")
+        self.generic_visit(node)
+
+    def visit_Module(self, node):
+        self._dup_check(node.body, "module")
+        self.generic_visit(node)
+
+    def _dup_check(self, body, where: str) -> None:
+        seen: dict = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                decorated = bool(stmt.decorator_list)
+                if stmt.name in seen and not decorated \
+                        and not seen[stmt.name]:
+                    self.add(stmt, "duplicate-def",
+                             f"{stmt.name!r} shadows an earlier "
+                             f"definition in {where}")
+                seen[stmt.name] = decorated
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, cmp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                    isinstance(cmp, ast.Constant)
+                    and (cmp.value is None or cmp.value is True
+                         or cmp.value is False)):
+                self.add(node, "literal-compare",
+                         "compare to None/bool with `is`, not ==/!=")
+        self.generic_visit(node)
+
+
+def check_file(path: Path) -> list:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [(str(path), e.lineno or 0, "syntax", str(e.msg))]
+    scope = _Scope(str(path), src)
+    scope.collect_imports(tree)
+    scope.visit(tree)
+    # __init__ re-exports and __future__ are legitimate "unused" imports
+    if path.name != "__init__.py":
+        for alias, (lineno, name) in scope.imports.items():
+            if name == "__future__" or alias.startswith("_"):
+                continue
+            if alias not in scope.used and lineno not in scope.noqa:
+                scope.findings.append(
+                    (str(path), lineno, "unused-import",
+                     f"{name!r} imported but unused"))
+    return scope.findings
+
+
+def run(paths=DEFAULT_PATHS, root: Path | None = None) -> list:
+    root = root or Path(__file__).resolve().parent.parent
+    findings = []
+    for p in paths:
+        target = root / p
+        files = ([target] if target.suffix == ".py"
+                 else sorted(target.rglob("*.py")))
+        for f in files:
+            if "_pb2" in f.name or "_build" in f.parts:
+                continue  # generated code
+            findings.extend(check_file(f))
+    return sorted(findings)
+
+
+def main(argv=None) -> int:
+    paths = (argv or sys.argv[1:]) or list(DEFAULT_PATHS)
+    findings = run(paths)
+    for path, line, code, msg in findings:
+        print(f"{path}:{line}: [{code}] {msg}")
+    print(f"analysis gate: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
