@@ -176,6 +176,101 @@ def orset_read_packed(dots, ops, valid, base_vc, has_base, read_vc,
     return out > 0
 
 
+def _orset_fold_kernel(
+    dots_ref,       # [TK, E*D] VMEM (flattened dot table)
+    ops_ref,        # [TK, L*F] VMEM (packed store rows)
+    mask_ref,       # [TK, L]   VMEM (inclusion ∧ valid, precomputed)
+    out_ref,        # [TK, E]   VMEM
+    *, e: int, d: int, l: int,
+):
+    """Fold-only variant: the Clock-SI inclusion test runs OUTSIDE the
+    kernel (one fused XLA pass producing mask[K, L]), so the unrolled
+    per-lane × per-DC scalar-compare chains — the tiny-op cost that
+    bounds the fully-fused kernel's block size — disappear.  ~60% fewer
+    vector ops per block at the price of one extra HBM read of the op
+    rows by the XLA mask pass."""
+    f = _NSCAL + 2 * d
+    tk = out_ref.shape[0]
+    ed = e * d
+    ops = ops_ref[:]
+    mask = mask_ref[:]
+    dots = dots_ref[:]
+    col = lambda j: ops[:, j][:, None]
+
+    d_row = jax.lax.broadcasted_iota(jnp.int32, (tk, d), 1)
+    d_col = jnp.concatenate([d_row] * e, axis=1)
+    e_col = jnp.concatenate(
+        [jnp.full((tk, d), np.int32(j)) for j in range(e)], axis=1)
+
+    last_seq = jnp.zeros((tk, ed), jnp.int32)
+    max_obs = jnp.zeros((tk, ed), jnp.int32)
+    for i in range(l):
+        off = i * f
+        mask_i = mask[:, i][:, None] != _Z
+        add_i = mask_i & (col(off + 1) != _Z)
+        at_e = e_col == col(off + 0)
+        at_d = d_col == col(off + 2)
+        last_seq = jnp.maximum(
+            last_seq, jnp.where(at_e & at_d & add_i, col(off + 3), _Z))
+        obs_t = jnp.zeros((tk, ed), jnp.int32)
+        for dd in range(d):
+            obs_t = jnp.where(d_col == np.int32(dd),
+                              col(off + _NSCAL + dd), obs_t)
+        max_obs = jnp.maximum(
+            max_obs, jnp.where(at_e & mask_i, obs_t, _Z))
+
+    merged = jnp.maximum(dots, last_seq)
+    live = jnp.where(merged > max_obs, merged, _Z)
+    outs = []
+    for j in range(e):
+        m = live[:, j * d][:, None]
+        for dd in range(1, d):
+            m = jnp.maximum(m, live[:, j * d + dd][:, None])
+        outs.append(m)
+    out_ref[:] = jnp.concatenate(outs, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def orset_read_hybrid(dots, ops, valid, base_vc, has_base, read_vc,
+                      block_k: int = 512, interpret: bool = False):
+    """bool[K, E]: like :func:`orset_read_packed` but with the
+    inclusion mask computed by XLA outside the kernel and only the
+    ORSWOT fold in Pallas (see _orset_fold_kernel)."""
+    from antidote_tpu.mat import kernels
+
+    k, e, d = dots.shape
+    f = ops.shape[-1]
+    l = ops.shape[0] // k
+    i32 = lambda a: a.astype(jnp.int32)
+    opsv = i32(ops).reshape(k, l, f)
+    base_b = jnp.broadcast_to(i32(base_vc), (k, d))
+    has_b = jnp.broadcast_to(has_base.astype(bool), (k,))
+    mask = kernels.inclusion_mask(
+        opsv[..., 4], opsv[..., 5], opsv[..., _NSCAL + d:],
+        valid.reshape(k, l), base_b, has_b, i32(read_vc))
+    grid = (pl.cdiv(k, block_k),)
+    row = lambda i: (i, _Z)
+    bspec = lambda shp: pl.BlockSpec(shp, row, memory_space=pltpu.VMEM)
+    kern = functools.partial(_orset_fold_kernel, e=e, d=d, l=l)
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            bspec((block_k, e * d)),
+            bspec((block_k, l * f)),
+            bspec((block_k, l)),
+        ],
+        out_specs=bspec((block_k, e)),
+        out_shape=jax.ShapeDtypeStruct((k, e), jnp.int32),
+        interpret=interpret,
+    )(
+        i32(dots).reshape(k, e * d),
+        i32(ops).reshape(k, l * f),
+        mask.astype(jnp.int32),
+    )
+    return out > 0
+
+
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
 def orset_read_fused(
     dots, elem_slot, is_add, dot_dc, dot_seq, obs_vv,

@@ -267,9 +267,10 @@ def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
     one HBM pass over the packed rows, nothing but the presence block
     leaves VMEM) over the jnp reference path (:func:`orset_read`).
 
-    ``fused``: True / False / "auto" (fused on a TPU backend when the
-    shard's timestamps fit int32 — the Pallas path computes in int32, so
-    µs-int64 live shards must use the jnp path).
+    ``fused``: True / False / "auto" / "hybrid" (fused on a TPU backend
+    when the shard's timestamps fit int32 — the Pallas path computes in
+    int32, so µs-int64 live shards must use the jnp path; "hybrid" runs
+    the inclusion mask in XLA and only the fold in Pallas).
     """
     if fused == "auto":
         fused = (st.ops.dtype == jnp.int32
@@ -280,7 +281,9 @@ def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
 
     K = st.dots.shape[0]
     interpret = jax.default_backend() != "tpu"
-    return pallas_kernels.orset_read_packed(
+    fn = (pallas_kernels.orset_read_hybrid if fused == "hybrid"
+          else pallas_kernels.orset_read_packed)
+    return fn(
         st.dots, st.ops, st.valid, st.base_vc, st.has_base,
         read_vc.astype(st.ops.dtype),
         block_k=min(block_k, K), interpret=interpret)

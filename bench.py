@@ -123,11 +123,14 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
     read_jnp = chain_read(store.orset_read)
     on_tpu = jax.default_backend() == "tpu"
     # interpret-mode pallas at 1M keys is minutes — only measure the
-    # fused path where it actually runs (TPU)
+    # fused paths where they actually run (TPU)
     read_fused = chain_read(
         lambda s_, vc: store.orset_read_full(s_, vc, fused=True)
     ) if on_tpu else None
-    return ops_per_sec, read_jnp, read_fused
+    read_hybrid = chain_read(
+        lambda s_, vc: store.orset_read_full(s_, vc, fused="hybrid")
+    ) if on_tpu else None
+    return ops_per_sec, read_jnp, read_fused, read_hybrid
 
 
 def _baseline_stream(n_ops, rng, K, n_elems=8, n_dcs=3):
@@ -211,7 +214,7 @@ def main():
     K = 1_000_000 if not quick else 65_536
     B = 65_536 if not quick else 8_192
     n_steps = 20 if not quick else 4
-    dev_ops, read_jnp, read_fused = bench_device(
+    dev_ops, read_jnp, read_fused, read_hybrid = bench_device(
         K=K, B=B, n_steps=n_steps, D=8, n_dcs=3)
     host_ops = bench_host_baseline(K)
     cpp_ops = bench_cpp_baseline(K, 200_000 if quick else 2_000_000)
@@ -230,6 +233,8 @@ def main():
             "full_shard_read_ms": round(read_jnp * 1e3, 2),
             "full_shard_read_fused_ms":
                 round(read_fused * 1e3, 2) if read_fused else None,
+            "full_shard_read_hybrid_ms":
+                round(read_hybrid * 1e3, 2) if read_hybrid else None,
             "host_python_merges_per_sec": round(host_ops),
             "host_cpp_merges_per_sec": round(cpp_ops) if cpp_ops else None,
             "vs_python_baseline": round(dev_ops / host_ops, 2),

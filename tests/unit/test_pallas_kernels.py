@@ -116,3 +116,14 @@ def test_with_base_snapshot_and_gc():
         st.base_vc, st.has_base, read_vc,
         block_k=32, interpret=True)
     assert (np.asarray(got) == want).all()
+
+
+@pytest.mark.parametrize("block_k", [64, 192])
+def test_hybrid_read_matches_jnp_path(block_k):
+    """fused="hybrid" (XLA inclusion mask + Pallas fold) must equal the
+    reference path."""
+    st, read_vc = _filled_store(seed=6)
+    want = reference_read(st, read_vc)
+    got = store.orset_read_full(st, read_vc, fused="hybrid",
+                                block_k=block_k)
+    assert (np.asarray(got) == want).all()
