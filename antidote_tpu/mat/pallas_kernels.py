@@ -55,6 +55,54 @@ _Z = np.int32(0)
 _NSCAL = 6
 
 
+def _fold_presence(dots, ops, lane_mask, e: int, d: int, l: int):
+    """Shared ORSWOT fold + presence body (both kernels): a dot
+    survives iff its seq exceeds every observed VV that covered its
+    (elem, dc) cell.  ``lane_mask(i)`` yields lane i's inclusion∧valid
+    column ([TK, 1] bool) — computed inline by the fully-fused kernel,
+    read from a precomputed ref by the hybrid one."""
+    f = _NSCAL + 2 * d
+    tk = dots.shape[0]
+    ed = e * d
+    col = lambda j: ops[:, j][:, None]                  # [TK, 1]
+
+    # flat (e, d) coordinate planes, built from offset-0 pieces only
+    d_row = jax.lax.broadcasted_iota(jnp.int32, (tk, d), 1)
+    d_col = jnp.concatenate([d_row] * e, axis=1)        # [TK, E*D]
+    e_col = jnp.concatenate(
+        [jnp.full((tk, d), np.int32(j)) for j in range(e)], axis=1)
+
+    last_seq = jnp.zeros((tk, ed), jnp.int32)
+    max_obs = jnp.zeros((tk, ed), jnp.int32)
+    for i in range(l):                                  # static unroll
+        off = i * f
+        mask_i = lane_mask(i)
+        add_i = mask_i & (col(off + 1) != _Z)
+        at_e = e_col == col(off + 0)                    # [TK, E*D]
+        at_d = d_col == col(off + 2)
+        last_seq = jnp.maximum(
+            last_seq, jnp.where(at_e & at_d & add_i, col(off + 3), _Z))
+        # the op's observed VV, tiled across the E axis one DC column at
+        # a time (obs depends only on the flat position's d coordinate)
+        obs_t = jnp.zeros((tk, ed), jnp.int32)
+        for dd in range(d):
+            obs_t = jnp.where(d_col == np.int32(dd),
+                              col(off + _NSCAL + dd), obs_t)
+        max_obs = jnp.maximum(
+            max_obs, jnp.where(at_e & mask_i, obs_t, _Z))
+
+    merged = jnp.maximum(dots, last_seq)
+    live = jnp.where(merged > max_obs, merged, _Z)
+    # presence per element = max over its D chunk, via column maxes
+    outs = []
+    for j in range(e):
+        m = live[:, j * d][:, None]
+        for dd in range(1, d):
+            m = jnp.maximum(m, live[:, j * d + dd][:, None])
+        outs.append(m)
+    return jnp.concatenate(outs, axis=1)                # [TK, E]
+
+
 def _orset_read_kernel(
     dots_ref,       # [TK, E*D] VMEM (flattened dot table)
     ops_ref,        # [TK, L*F] VMEM (packed store rows)
@@ -67,35 +115,19 @@ def _orset_read_kernel(
 ):
     f = _NSCAL + 2 * d
     tk = out_ref.shape[0]
-    ed = e * d
     ops = ops_ref[:]
     valid = valid_ref[:]
-    dots = dots_ref[:]
     has_base = has_base_ref[0, 0] != _Z
-
-    col = lambda j: ops[:, j][:, None]                  # [TK, 1]
-
-    # flat (e, d) coordinate planes, built from offset-0 pieces only
-    d_row = jax.lax.broadcasted_iota(jnp.int32, (tk, d), 1)
-    d_col = jnp.concatenate([d_row] * e, axis=1)        # [TK, E*D]
-    e_col = jnp.concatenate(
-        [jnp.full((tk, d), np.int32(j)) for j in range(e)], axis=1)
-
-    last_seq = jnp.zeros((tk, ed), jnp.int32)
-    max_obs = jnp.zeros((tk, ed), jnp.int32)
+    col = lambda j: ops[:, j][:, None]
     true_col = jnp.ones((tk, 1), jnp.bool_)
-    for i in range(l):                                  # static unroll
-        off = i * f
-        elem_i = col(off + 0)
-        isadd_i = col(off + 1)
-        dotdc_i = col(off + 2)
-        dotseq_i = col(off + 3)
-        opdc_i = col(off + 4)
-        opct_i = col(off + 5)
 
+    def lane_mask(i):
         # inclusion test, unrolled across DC columns as scalar compares
         # (commit VC = op snapshot with the origin column bumped to the
         # commit time; the Clock-SI read rule, txn/coordinator.py)
+        off = i * f
+        opdc_i = col(off + 4)
+        opct_i = col(off + 5)
         cov_i = true_col
         inc_i = true_col
         for dd in range(d):
@@ -104,36 +136,9 @@ def _orset_read_kernel(
                               jnp.maximum(ss_c, opct_i), ss_c)
             cov_i = cov_i & (cvc_c <= base_ref[0, dd])
             inc_i = inc_i & (cvc_c <= read_ref[0, dd])
-        mask_i = (valid[:, i][:, None] != _Z) & inc_i \
-            & ~(cov_i & has_base)                       # [TK, 1]
-        add_i = mask_i & (isadd_i != _Z)
+        return (valid[:, i][:, None] != _Z) & inc_i & ~(cov_i & has_base)
 
-        at_e = e_col == elem_i                          # [TK, E*D]
-        at_d = d_col == dotdc_i
-        last_seq = jnp.maximum(
-            last_seq, jnp.where(at_e & at_d & add_i, dotseq_i, _Z))
-
-        # the op's observed VV, tiled across the E axis one DC column at
-        # a time (obs depends only on the flat position's d coordinate)
-        obs_t = jnp.zeros((tk, ed), jnp.int32)
-        for dd in range(d):
-            obs_t = jnp.where(d_col == np.int32(dd),
-                              col(off + _NSCAL + dd), obs_t)
-        max_obs = jnp.maximum(
-            max_obs, jnp.where(at_e & mask_i, obs_t, _Z))
-
-    # ORSWOT fold: a dot survives iff its seq exceeds every observed VV
-    # that covered its (elem, dc) cell
-    merged = jnp.maximum(dots, last_seq)
-    live = jnp.where(merged > max_obs, merged, _Z)
-    # presence per element = max over its D chunk, via column maxes
-    outs = []
-    for j in range(e):
-        m = live[:, j * d][:, None]
-        for dd in range(1, d):
-            m = jnp.maximum(m, live[:, j * d + dd][:, None])
-        outs.append(m)
-    out_ref[:] = jnp.concatenate(outs, axis=1)          # [TK, E]
+    out_ref[:] = _fold_presence(dots_ref[:], ops, lane_mask, e, d, l)
 
 
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
@@ -189,45 +194,10 @@ def _orset_fold_kernel(
     bounds the fully-fused kernel's block size — disappear.  ~60% fewer
     vector ops per block at the price of one extra HBM read of the op
     rows by the XLA mask pass."""
-    f = _NSCAL + 2 * d
-    tk = out_ref.shape[0]
-    ed = e * d
-    ops = ops_ref[:]
     mask = mask_ref[:]
-    dots = dots_ref[:]
-    col = lambda j: ops[:, j][:, None]
-
-    d_row = jax.lax.broadcasted_iota(jnp.int32, (tk, d), 1)
-    d_col = jnp.concatenate([d_row] * e, axis=1)
-    e_col = jnp.concatenate(
-        [jnp.full((tk, d), np.int32(j)) for j in range(e)], axis=1)
-
-    last_seq = jnp.zeros((tk, ed), jnp.int32)
-    max_obs = jnp.zeros((tk, ed), jnp.int32)
-    for i in range(l):
-        off = i * f
-        mask_i = mask[:, i][:, None] != _Z
-        add_i = mask_i & (col(off + 1) != _Z)
-        at_e = e_col == col(off + 0)
-        at_d = d_col == col(off + 2)
-        last_seq = jnp.maximum(
-            last_seq, jnp.where(at_e & at_d & add_i, col(off + 3), _Z))
-        obs_t = jnp.zeros((tk, ed), jnp.int32)
-        for dd in range(d):
-            obs_t = jnp.where(d_col == np.int32(dd),
-                              col(off + _NSCAL + dd), obs_t)
-        max_obs = jnp.maximum(
-            max_obs, jnp.where(at_e & mask_i, obs_t, _Z))
-
-    merged = jnp.maximum(dots, last_seq)
-    live = jnp.where(merged > max_obs, merged, _Z)
-    outs = []
-    for j in range(e):
-        m = live[:, j * d][:, None]
-        for dd in range(1, d):
-            m = jnp.maximum(m, live[:, j * d + dd][:, None])
-        outs.append(m)
-    out_ref[:] = jnp.concatenate(outs, axis=1)
+    out_ref[:] = _fold_presence(
+        dots_ref[:], ops_ref[:],
+        lambda i: mask[:, i][:, None] != _Z, e, d, l)
 
 
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))

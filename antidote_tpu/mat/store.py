@@ -261,7 +261,7 @@ def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
 
 def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
                     fused: str | bool = "auto",
-                    block_k: int = 256) -> jax.Array:
+                    block_k: int | None = None) -> jax.Array:
     """bool[K, E]: full-shard presence read, flag-selecting the Pallas
     fused kernel (antidote_tpu/mat/pallas_kernels.py orset_read_packed —
     one HBM pass over the packed rows, nothing but the presence block
@@ -273,20 +273,23 @@ def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
     the inclusion mask in XLA and only the fold in Pallas).
     """
     if fused == "auto":
-        fused = (st.ops.dtype == jnp.int32
-                 and jax.default_backend() == "tpu")
-    if not fused:
+        fused = jax.default_backend() == "tpu"
+    # the Pallas fold computes in int32; µs-int64 shards would truncate
+    # their timestamps, so even an explicit fused request falls back
+    if not fused or st.ops.dtype != jnp.int32:
         return orset_read(st, read_vc)
     from antidote_tpu.mat import pallas_kernels
 
     K = st.dots.shape[0]
     interpret = jax.default_backend() != "tpu"
-    fn = (pallas_kernels.orset_read_hybrid if fused == "hybrid"
-          else pallas_kernels.orset_read_packed)
+    if fused == "hybrid":
+        fn, default_bk = pallas_kernels.orset_read_hybrid, 512
+    else:
+        fn, default_bk = pallas_kernels.orset_read_packed, 256
     return fn(
         st.dots, st.ops, st.valid, st.base_vc, st.has_base,
         read_vc.astype(st.ops.dtype),
-        block_k=min(block_k, K), interpret=interpret)
+        block_k=min(block_k or default_bk, K), interpret=interpret)
 
 
 @jax.jit
