@@ -77,7 +77,8 @@ class TcpTransport(Transport):
 
     def __init__(self, host: str = "127.0.0.1", pub_port: int = 0,
                  query_port: int = 0, connect_timeout: float = 5.0,
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0,
+                 native_pub: "bool | str" = "auto"):
         self.host = host
         self._pub_port = pub_port
         self._query_port = query_port
@@ -86,7 +87,7 @@ class TcpTransport(Transport):
         self._dc_id: Any = None
         self._inbox: "queue.Queue[bytes]" = queue.Queue()
         self._handler: Optional[Callable[[Any, str, Any], Any]] = None
-        #: live subscriber connections to OUR pub listener
+        #: live subscriber connections to OUR pub listener (Python mode)
         self._subscribers: List[socket.socket] = []
         #: target dc_id -> (addr, persistent request socket or None)
         self._peers: Dict[Any, Dict[str, Any]] = {}
@@ -95,6 +96,14 @@ class TcpTransport(Transport):
         self._threads: List[threading.Thread] = []
         self._pub_srv: Optional[socket.socket] = None
         self._query_srv: Optional[socket.socket] = None
+        #: native C++ publish hub (the erlzmq PUB role,
+        #: antidote_tpu/native/fabric.cpp): the commit path only copies
+        #: the frame into per-subscriber bounded queues; a stalled or
+        #: overflowing peer is dropped by the event thread without ever
+        #: blocking the publisher.  "auto" = use it when g++ built it.
+        self._native_pub = native_pub
+        self._hub = None
+        self._hub_lib = None
 
     # ------------------------------------------------------------ registry
 
@@ -103,11 +112,42 @@ class TcpTransport(Transport):
                  ) -> "queue.Queue[bytes]":
         self._dc_id = desc.dc_id
         self._handler = query_handler
-        self._pub_srv = self._bind(self._pub_port)
+        if self._native_pub:
+            self._hub = self._open_native_hub()
+        if self._hub is None:
+            if self._native_pub is True:
+                raise RuntimeError("native pub hub unavailable "
+                                   "(g++ missing or build failed)")
+            self._pub_srv = self._bind(self._pub_port)
+            self._spawn(self._accept_pub_loop)
         self._query_srv = self._bind(self._query_port)
-        self._spawn(self._accept_pub_loop)
         self._spawn(self._accept_query_loop)
         return self._inbox
+
+    def _open_native_hub(self):
+        import ctypes
+
+        from antidote_tpu.native.build import ensure_built
+
+        so = ensure_built("fabric")
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.fab_create.restype = ctypes.c_void_p
+        lib.fab_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.fab_port.restype = ctypes.c_int
+        lib.fab_port.argtypes = [ctypes.c_void_p]
+        lib.fab_publish.restype = ctypes.c_int
+        lib.fab_publish.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.fab_sub_count.restype = ctypes.c_int
+        lib.fab_sub_count.argtypes = [ctypes.c_void_p]
+        lib.fab_close.argtypes = [ctypes.c_void_p]
+        hub = lib.fab_create(self.host.encode(), self._pub_port)
+        if not hub:
+            return None
+        self._hub_lib = lib
+        return hub
 
     def unregister(self, dc_id) -> None:
         self.close()
@@ -115,9 +155,16 @@ class TcpTransport(Transport):
     def local_addrs(self) -> Optional[Tuple[Tuple, Tuple]]:
         """((host, pub_port),), ((host, query_port),) once the listeners
         are bound (register) — what goes into this DC's descriptor."""
-        if self._pub_srv is None or self._query_srv is None:
+        if self._query_srv is None:
             return None
-        return (((self.host, self._pub_srv.getsockname()[1]),),
+        with self._lock:
+            if self._hub is not None:
+                pub_port = self._hub_lib.fab_port(self._hub)
+            elif self._pub_srv is not None:
+                pub_port = self._pub_srv.getsockname()[1]
+            else:
+                return None
+        return (((self.host, pub_port),),
                 ((self.host, self._query_srv.getsockname()[1]),))
 
     def _bind(self, port: int) -> socket.socket:
@@ -161,6 +208,13 @@ class TcpTransport(Transport):
 
     def publish(self, origin, data: bytes) -> None:
         with self._lock:
+            # under the lock: close() frees the hub (fab_close deletes
+            # the C++ object), so an unlocked fab_publish could race a
+            # teardown into freed memory.  fab_publish itself never
+            # blocks (queue copy only), so the hold is short.
+            if self._hub is not None:
+                self._hub_lib.fab_publish(self._hub, data, len(data))
+                return
             conns = list(self._subscribers)
         dead = []
         for conn in conns:
@@ -300,6 +354,13 @@ class TcpTransport(Transport):
 
     def close(self) -> None:
         self._stop.set()
+        with self._lock:
+            hub, self._hub = self._hub, None
+        if hub is not None:
+            # freed outside the lock (joins the event thread); no
+            # publisher can hold the pointer: they read it under the
+            # lock and call through while still holding it
+            self._hub_lib.fab_close(hub)
         for srv in (self._pub_srv, self._query_srv):
             if srv is not None:
                 try:

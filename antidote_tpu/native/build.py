@@ -25,7 +25,8 @@ def ensure_built(name: str) -> str | None:
         if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
             return out
         os.makedirs(_BUILD_DIR, exist_ok=True)
-        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", src, "-o", out]
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               "-pthread", src, "-o", out]
         try:
             subprocess.run(cmd, check=True, capture_output=True)
         except (FileNotFoundError, subprocess.CalledProcessError):
