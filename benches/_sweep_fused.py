@@ -27,16 +27,19 @@ for i in range(6):
         st = store.orset_gc(st, jnp.asarray(s["frontier"]))
 frontier = jnp.asarray(s["frontier"])
 
-for bk in [int(a) for a in sys.argv[1:]]:
+for spec in sys.argv[1:]:
+    variant, bk = ("hybrid", int(spec[1:])) if spec.startswith("h") \
+        else (True, int(spec))
     try:
-        p = store.orset_read_full(st, frontier, fused=True, block_k=bk)
+        p = store.orset_read_full(st, frontier, fused=variant,
+                                  block_k=bk)
         fetch(p)
         t0 = time.perf_counter()
         for _ in range(5):
             vc = frontier + jnp.minimum(p[0, 0].astype(jnp.int32), 0)
-            p = store.orset_read_full(st, vc, fused=True, block_k=bk)
+            p = store.orset_read_full(st, vc, fused=variant, block_k=bk)
         fetch(p)
         dt = (time.perf_counter() - t0) / 5
-        print(f"block_k={bk}: read_ms={dt*1e3:.1f}", flush=True)
+        print(f"{spec}: read_ms={dt*1e3:.1f}", flush=True)
     except Exception as ex:
-        print(f"block_k={bk}: FAIL {str(ex)[:180]}", flush=True)
+        print(f"{spec}: FAIL {str(ex)[:180]}", flush=True)
