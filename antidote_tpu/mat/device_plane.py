@@ -206,6 +206,36 @@ class _PlaneBase:
         captured state at dense snapshot ``rv``."""
         raise NotImplementedError
 
+    def read_many_begin(self, keys: list, read_vc: Optional[VC]):
+        """Batched :meth:`read_begin`: one captured state + one device
+        fold for every device-owned key in ``keys``.  Returns a closure
+        yielding {key: value} (non-owned keys absent — callers serve
+        them from the host path); safe to run outside the lock like
+        read_begin's closure."""
+        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
+            self.flush()
+        owned = [k for k in keys if k in self.key_index]
+        if not owned:
+            return dict
+        rv = self._read_vc_dense(read_vc)
+        idxs = np.asarray([self.key_index[k] for k in owned],
+                          dtype=np.int32)
+        pad = np.zeros(_bucket(len(idxs)), dtype=np.int32)
+        pad[:len(idxs)] = idxs
+        return self._many_reader(self.st, owned, idxs, pad, rv)
+
+    def _many_reader(self, st, owned: list, idxs: np.ndarray,
+                     pad: np.ndarray, rv: np.ndarray):
+        """Subclass hook: closure materializing the owned keys in one
+        batched fold of the captured state (``pad`` = idxs padded to
+        the dispatch bucket)."""
+        raise NotImplementedError
+
+    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
+        """{key: state} for device-owned keys; callers take the host
+        path for the rest."""
+        return self.read_many_begin(keys, read_vc)()
+
     # -- lifecycle ----------------------------------------------------------
 
     def owns(self, key) -> bool:
@@ -465,37 +495,30 @@ class OrsetPlane(_PlaneBase):
 
         return run
 
-    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        """Batched variant of read(): one device fold for B keys.
-        Returns {key: state} for the keys still device-owned after the
-        leading flush (a flush can evict keys); callers serve the rest
-        from the host path."""
-        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
-            self.flush()
-        owned = [k for k in keys if k in self.key_index]
-        if not owned:
-            return {}
-        rv = self._read_vc_dense(read_vc)
-        idxs = np.asarray([self.key_index[k] for k in owned], dtype=np.int32)
-        B = _bucket(len(idxs))
-        pad = np.full(B, 0, dtype=np.int32)
-        pad[:len(idxs)] = idxs
-        dots = np.asarray(store.orset_read_keys(
-            self.st, jnp.asarray(pad), jnp.asarray(rv)))
-        actors = self.domain.dc_ids
-        out = {}
-        for i, k in enumerate(owned):
-            idx = idxs[i]
-            state = {}
-            for slot, elem in enumerate(self.rev_elems[idx]):
-                live = frozenset(
-                    (actors[j], int(s))
-                    for j, s in enumerate(dots[i, slot][:len(actors)])
-                    if s > 0)
-                if live:
-                    state[elem] = live
-            out[k] = state
-        return out
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        elem_lists = [self.rev_elems[i] for i in idxs]
+        domain = self.domain
+
+        def run():
+            dots = np.asarray(store.orset_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv)))
+            actors = domain.dc_ids
+            out = {}
+            for i, k in enumerate(owned):
+                state = {}
+                for slot, elem in enumerate(list(elem_lists[i])):
+                    if slot >= dots.shape[1]:
+                        break  # slot grown after the capture
+                    live = frozenset(
+                        (actors[j], int(s))
+                        for j, s in enumerate(dots[i, slot][:len(actors)])
+                        if s > 0)
+                    if live:
+                        state[elem] = live
+                out[k] = state
+            return out
+
+        return run
 
 
 class CounterPlane(_PlaneBase):
@@ -566,21 +589,13 @@ class CounterPlane(_PlaneBase):
         return lambda: int(store.counter_read_keys(
             st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))[0])
 
-    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        """See OrsetPlane.read_many — {key: value} for device-owned keys."""
-        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
-            self.flush()
-        owned = [k for k in keys if k in self.key_index]
-        if not owned:
-            return {}
-        rv = self._read_vc_dense(read_vc)
-        idxs = np.asarray([self.key_index[k] for k in owned], dtype=np.int32)
-        B = _bucket(len(idxs))
-        pad = np.full(B, 0, dtype=np.int32)
-        pad[:len(idxs)] = idxs
-        vals = np.asarray(store.counter_read_keys(
-            self.st, jnp.asarray(pad), jnp.asarray(rv)))
-        return {k: int(vals[i]) for i, k in enumerate(owned)}
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        def run():
+            vals = np.asarray(store.counter_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv)))
+            return {k: int(vals[i]) for i, k in enumerate(owned)}
+
+        return run
 
 
 class MvregPlane(OrsetPlane):
@@ -648,31 +663,27 @@ class MvregPlane(OrsetPlane):
 
         return run
 
-    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
-            self.flush()
-        owned = [k for k in keys if k in self.key_index]
-        if not owned:
-            return {}
-        rv = self._read_vc_dense(read_vc)
-        idxs = np.asarray([self.key_index[k] for k in owned],
-                          dtype=np.int32)
-        B = _bucket(len(idxs))
-        pad = np.full(B, 0, dtype=np.int32)
-        pad[:len(idxs)] = idxs
-        dots = np.asarray(store.mvreg_read_keys(
-            self.st, jnp.asarray(pad), jnp.asarray(rv)))
-        actors = self.domain.dc_ids
-        out = {}
-        for i, k in enumerate(owned):
-            idx = idxs[i]
-            pairs = set()
-            for slot, v in enumerate(self.rev_elems[idx]):
-                for j, s in enumerate(dots[i, slot][:len(actors)]):
-                    if s > 0:
-                        pairs.add(((actors[j], int(s)), v))
-            out[k] = frozenset(pairs)
-        return out
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        val_lists = [self.rev_elems[i] for i in idxs]
+        domain = self.domain
+
+        def run():
+            dots = np.asarray(store.mvreg_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv)))
+            actors = domain.dc_ids
+            out = {}
+            for i, k in enumerate(owned):
+                pairs = set()
+                for slot, v in enumerate(list(val_lists[i])):
+                    if slot >= dots.shape[1]:
+                        break  # slot grown after the capture
+                    for j, s in enumerate(dots[i, slot][:len(actors)]):
+                        if s > 0:
+                            pairs.add(((actors[j], int(s)), v))
+                out[k] = frozenset(pairs)
+            return out
+
+        return run
 
 
 class FlagEwPlane(OrsetPlane):
@@ -728,27 +739,22 @@ class FlagEwPlane(OrsetPlane):
 
         return run
 
-    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
-            self.flush()
-        owned = [k for k in keys if k in self.key_index]
-        if not owned:
-            return {}
-        rv = self._read_vc_dense(read_vc)
-        idxs = np.asarray([self.key_index[k] for k in owned],
-                          dtype=np.int32)
-        B = _bucket(len(idxs))
-        pad = np.full(B, 0, dtype=np.int32)
-        pad[:len(idxs)] = idxs
-        dots = np.asarray(store.orset_read_keys(
-            self.st, jnp.asarray(pad), jnp.asarray(rv)))
-        actors = self.domain.dc_ids
-        return {
-            k: frozenset(
-                (actors[j], int(s))
-                for j, s in enumerate(dots[i, 0][:len(actors)]) if s > 0)
-            for i, k in enumerate(owned)
-        }
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        domain = self.domain
+
+        def run():
+            dots = np.asarray(store.orset_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv)))
+            actors = domain.dc_ids
+            return {
+                k: frozenset(
+                    (actors[j], int(s))
+                    for j, s in enumerate(dots[i, 0][:len(actors)])
+                    if s > 0)
+                for i, k in enumerate(owned)
+            }
+
+        return run
 
 
 #: tiebreak packing: rank << _TIE_SHIFT | seq (seq must fit the low bits)
@@ -923,31 +929,26 @@ class LwwPlane(_PlaneBase):
 
         return run
 
-    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
-        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
-            self.flush()
-        owned = [k for k in keys if k in self.key_index]
-        if not owned:
-            return {}
-        rv = self._read_vc_dense(read_vc)
-        idxs = np.asarray([self.key_index[k] for k in owned],
-                          dtype=np.int32)
-        B = _bucket(len(idxs))
-        pad = np.full(B, 0, dtype=np.int32)
-        pad[:len(idxs)] = idxs
-        ts, tie, val = (np.asarray(a) for a in store.lww_read_keys(
-            self.st, jnp.asarray(pad), jnp.asarray(rv)))
-        out = {}
-        for i, k in enumerate(owned):
-            if val[i] < 0:
-                out[k] = (0, (), None)  # unwritten at this snapshot
-            else:
-                rank = int(tie[i]) >> _TIE_SHIFT
-                seq = int(tie[i]) & _TIE_SEQ_MAX
-                out[k] = (int(ts[i]),
-                          (self.actors_sorted[rank], seq),
-                          self.rev_vals[int(val[i])])
-        return out
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        # consistent with the captured state (see LwwPlane._reader)
+        acts = self.actors_sorted
+        vals = self.rev_vals
+
+        def run():
+            ts, tie, val = (np.asarray(a) for a in store.lww_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv)))
+            out = {}
+            for i, k in enumerate(owned):
+                if val[i] < 0:
+                    out[k] = (0, (), None)  # unwritten at this snapshot
+                else:
+                    rank = int(tie[i]) >> _TIE_SHIFT
+                    seq = int(tie[i]) & _TIE_SEQ_MAX
+                    out[k] = (int(ts[i]), (acts[rank], seq),
+                              vals[int(val[i])])
+            return out
+
+        return run
 
 
 class DevicePlane:

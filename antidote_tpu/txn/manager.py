@@ -389,6 +389,109 @@ class PartitionManager:
             value = materialize_eager(type_name, value, own_effects)
         return value
 
+    def read_many(self, items: List[Tuple[Any, str]], snapshot_vc,
+                  txid=None) -> Dict[Tuple[Any, str], Any]:
+        """Batched Clock-SI reads for THIS partition: one lock pass
+        gates and splits the keys (cache / device / host), then one
+        device fold PER TYPE runs outside the lock for all its keys —
+        the async-batched-reads pipelining of the reference coordinator
+        (src/clocksi_interactive_coord.erl:731-747) fused with the
+        read-server concurrency split of :meth:`read`."""
+        if snapshot_vc is not None:
+            self.clock.wait_until(snapshot_vc.get_dc(self.dc_id))
+        out: Dict[Tuple[Any, str], Any] = {}
+        dev_batches = []  # (type, [(key, cacheable_frontier)], closure)
+        with self._lock:
+            if snapshot_vc is not None:
+                deadline = time.monotonic() + self.read_wait_timeout
+                while any(self._blocking_prepared(k, snapshot_vc, txid)
+                          for k, _t in items):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._lock.wait(
+                            timeout=remaining):
+                        raise TimeoutError(
+                            "batched read blocked on prepared txn")
+            by_type: Dict[str, list] = {}
+            for key, type_name in items:
+                fr = self.key_frontier.get(key)
+                covers = fr is not None and (
+                    snapshot_vc is None or fr.le(snapshot_vc))
+                if covers:
+                    ent = self._val_cache.get(key)
+                    if ent is not None and ent[0] is fr:
+                        out[(key, type_name)] = ent[1]
+                        continue
+                if self.device is not None and self.device.owns(
+                        type_name, key):
+                    by_type.setdefault(type_name, []).append(
+                        (key, fr if covers else None))
+                else:
+                    out[(key, type_name)] = self._read_store(
+                        key, type_name, snapshot_vc, txid)
+            # flush EVERY type first, then create closures: a flush is
+            # a buffer-donating device mutation, and quiescing for a
+            # later type would deadlock on our own earlier closure's
+            # reader count
+            for type_name, pairs in by_type.items():
+                plane = self.device.planes[type_name]
+                if not plane.pending_keys.isdisjoint(
+                        [k for k, _fr in pairs]):
+                    self._wait_device_quiesce()
+                    plane.flush()
+            for type_name, pairs in by_type.items():
+                plane = self.device.planes[type_name]
+                keys_t = [k for k, _fr in pairs]
+                try:
+                    closure = plane.read_many_begin(keys_t, snapshot_vc)
+                except ReadBelowBase:
+                    closure = None  # whole batch from the log
+                else:
+                    self._dev_readers += 1
+                dev_batches.append((type_name, pairs, closure))
+        pending_readers = sum(1 for _t, _p, c in dev_batches
+                              if c is not None)
+        try:
+            for type_name, pairs, closure in dev_batches:
+                if closure is None:
+                    with self._lock:
+                        for key, _fr in pairs:
+                            out[(key, type_name)] = self._read_from_log(
+                                key, type_name, snapshot_vc, txid)
+                    continue
+                try:
+                    got = closure()
+                finally:
+                    with self._lock:
+                        self._dev_readers -= 1
+                        pending_readers -= 1
+                        self._lock.notify_all()
+                cacheable = []
+                with self._lock:
+                    for key, fr in pairs:
+                        if key in got:
+                            value = got[key]
+                            if fr is not None and \
+                                    self.key_frontier.get(key) is fr:
+                                cacheable.append((key, fr, value))
+                        else:
+                            # evicted during the begin-flush — host path
+                            value = self._read_store(
+                                key, type_name, snapshot_vc, txid)
+                        out[(key, type_name)] = value
+                    for key, fr, value in cacheable:
+                        if len(self._val_cache) >= self._val_cache_cap:
+                            self._val_cache.clear()
+                        self._val_cache[key] = (fr, value)
+        finally:
+            # an escaping exception must not leak the not-yet-drained
+            # batches' reader counts: a leak would wedge
+            # _wait_device_quiesce (and every publish) forever
+            if pending_readers:
+                with self._lock:
+                    self._dev_readers -= pending_readers
+                    self._lock.notify_all()
+        return out
+
     # ------------------------------------------------------- stable plane
 
     def min_prepared(self) -> int:

@@ -52,6 +52,17 @@ class FakePartition:
                 state = cls.update(eff, state)
         return state
 
+    def read_many(self, items, snapshot_vc, txid=None):
+        # the coordinator's batched read path (own effects are applied
+        # by the coordinator, so the fake returns fresh state only)
+        out = {}
+        for key, type_name in items:
+            self.calls.append(("read", key))
+            if str(key).startswith("read_fail"):
+                raise RuntimeError("mocked read failure")
+            out[(key, type_name)] = get_type(type_name).new()
+        return out
+
     def prepare(self, txid, snapshot_vc, certify=True):
         self.calls.append(("prepare", txid))
         for key, _t, _e in self.staged.get(txid, []):
