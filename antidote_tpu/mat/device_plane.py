@@ -236,6 +236,13 @@ class _PlaneBase:
         path for the rest."""
         return self.read_many_begin(keys, read_vc)()
 
+    def read(self, key, read_vc: Optional[VC]):
+        """The key's host-CRDT state at ``read_vc``, materialized by
+        this plane's device fold (state shape documented on each
+        subclass's ``_reader`` hook)."""
+        return self.read_begin(key, read_vc)()
+
+
     # -- lifecycle ----------------------------------------------------------
 
     def owns(self, key) -> bool:
@@ -463,12 +470,6 @@ class OrsetPlane(_PlaneBase):
     def _device_gc(self, gst_dense):
         self.st = store.orset_gc(self.st, jnp.asarray(gst_dense))
 
-    def read(self, key, read_vc: Optional[VC]):
-        """set_aw state (element -> live dot frozenset) at ``read_vc``,
-        reconstructed from the device fold — actors are recovered from
-        the dense DC columns, so the state round-trips through the host
-        CRDT (read-your-writes applies its effects on top)."""
-        return self.read_begin(key, read_vc)()
 
     def _reader(self, st, idx, rv):
         # captured under the lock; safe after release (see read_begin):
@@ -582,8 +583,6 @@ class CounterPlane(_PlaneBase):
     def _device_gc(self, gst_dense):
         self.st = store.counter_gc(self.st, jnp.asarray(gst_dense))
 
-    def read(self, key, read_vc: Optional[VC]) -> int:
-        return self.read_begin(key, read_vc)()
 
     def _reader(self, st, idx, rv):
         return lambda: int(store.counter_read_keys(
@@ -638,10 +637,6 @@ class MvregPlane(OrsetPlane):
     def _device_gc(self, gst_dense):
         self.st = store.mvreg_gc(self.st, jnp.asarray(gst_dense))
 
-    def read(self, key, read_vc: Optional[VC]):
-        """register_mv host state (frozenset of (dot, value)) at
-        ``read_vc``."""
-        return self.read_begin(key, read_vc)()
 
     def _reader(self, st, idx, rv):
         vals = self.rev_elems[idx]
@@ -721,9 +716,6 @@ class FlagEwPlane(OrsetPlane):
             (idx, 0, is_add, dot_col or 0, int(seq), obs_pairs,
              op_dc_col, int(payload.commit_time), ss_pairs)])
 
-    def read(self, key, read_vc: Optional[VC]):
-        """flag_ew host state (frozenset of enable dots) at ``read_vc``."""
-        return self.read_begin(key, read_vc)()
 
     def _reader(self, st, idx, rv):
         domain = self.domain
@@ -751,6 +743,352 @@ class FlagEwPlane(OrsetPlane):
                     (actors[j], int(s))
                     for j, s in enumerate(dots[i, 0][:len(actors)])
                     if s > 0)
+                for i, k in enumerate(owned)
+            }
+
+        return run
+
+
+class RwsetPlane(OrsetPlane):
+    """Device plane for set_rw (remove-wins) — two dot tables with
+    cross-cancellation (store.rwset_*; host oracle crdt/sets.py SetRW).
+    Row tuple: (key_idx, slot, kind, dot_col, dot_seq, obs_add_pairs,
+    obs_rmv_pairs, op_dc_col, op_ct, ss_pairs).
+
+    The reconstructed state collapses each (element, plane, DC) dot set
+    to its max seq.  Unlike set_aw, the host oracle's add set CAN hold
+    several live dots per DC column (adds don't cancel adds), so the
+    reconstruction under-reports stale older dots — *value*-exact
+    nonetheless: presence needs an empty remove plane, which requires a
+    fresh add dot that the collapse always retains (see the kernel doc,
+    mat/kernels.py rwset_apply).  Oracle tests therefore compare at
+    value level for this type."""
+
+    type_name = "set_rw"
+
+    def _init_state(self, key_capacity):
+        return store.rwset_shard_init(
+            key_capacity, self.n_lanes, self.n_slots, self.domain.d,
+            dtype=jnp.int64)
+
+    def _grow_dcs(self, new_d):
+        self.st = store.rwset_grow(self.st, n_dcs=new_d)
+
+    def _grow_keys(self, new_k):
+        self.st = store.rwset_grow(self.st, n_keys=new_k)
+
+    def _grow_slots(self, new_e):
+        self.flush()
+        self.n_slots = new_e
+        self.st = store.rwset_grow(self.st, n_slots=new_e)
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        kind_name, entries = payload.effect
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        rows = []
+        for entry in entries:
+            if kind_name == "add":
+                elem, dot, obs_rmvs = entry
+                kind, obs_adds = 0, ()
+            elif kind_name == "rmv":
+                elem, dot, obs_adds = entry
+                kind, obs_rmvs = 1, ()
+            else:  # "reset": mints nothing, cancels both planes
+                elem, obs_adds, obs_rmvs = entry
+                kind, dot = 2, (None, 0)
+            actor, seq = dot
+            dot_col = 0 if actor is None else self._dc_col(actor)
+            slot = self._slot(idx, elem)
+            oa = self._decode_obs(obs_adds)
+            orm = self._decode_obs(obs_rmvs)
+            if slot is None or oa is None or orm is None \
+                    or dot_col is None:
+                self.evict(key)
+                return
+            rows.append((idx, slot, kind, dot_col, int(seq), oa, orm,
+                         op_dc_col, int(payload.commit_time), ss_pairs))
+        self._commit_rows(key, idx, rows)
+
+    def _append_rows(self, rows):
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        B = _bucket(n)
+        K = self.capacity
+        d = self.domain.d
+        key_idx = np.full(B, K, dtype=np.int32)
+        elem = np.zeros(B, dtype=np.int64)
+        kind = np.zeros(B, dtype=np.int64)
+        dot_dc = np.zeros(B, dtype=np.int64)
+        dot_seq = np.zeros(B, dtype=np.int64)
+        obs_a = np.zeros((B, d), dtype=np.int64)
+        obs_r = np.zeros((B, d), dtype=np.int64)
+        op_dc = np.zeros(B, dtype=np.int64)
+        op_ct = np.zeros(B, dtype=np.int64)
+        ss = np.zeros((B, d), dtype=np.int64)
+        for i, (ki, sl, kn, dc, sq, oa, orm, odc, oct_, ssp) in \
+                enumerate(rows):
+            key_idx[i] = ki
+            elem[i] = sl
+            kind[i] = kn
+            dot_dc[i] = dc
+            dot_seq[i] = sq
+            for col, s in oa:
+                obs_a[i, col] = max(obs_a[i, col], s)
+            for col, s in orm:
+                obs_r[i, col] = max(obs_r[i, col], s)
+            op_dc[i] = odc
+            op_ct[i] = oct_
+            for col, t in ssp:
+                ss[i, col] = max(ss[i, col], t)
+        lane_off = np.zeros(B, dtype=np.int32)
+        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
+        self.st, overflow = store.rwset_append(
+            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
+            jnp.asarray(elem), jnp.asarray(kind), jnp.asarray(dot_dc),
+            jnp.asarray(dot_seq), jnp.asarray(obs_a), jnp.asarray(obs_r),
+            jnp.asarray(op_dc), jnp.asarray(op_ct), jnp.asarray(ss))
+        return np.asarray(overflow)[:n]
+
+    def _purge_idx(self, idx):
+        self.st = store.rwset_purge_keys(
+            self.st, jnp.asarray([idx], dtype=np.int32))
+        self.elem_index[idx] = {}
+        self.rev_elems[idx] = []
+
+    def _device_gc(self, gst_dense):
+        self.st = store.rwset_gc(self.st, jnp.asarray(gst_dense))
+
+    @staticmethod
+    def _dots_of(row, actors):
+        return frozenset(
+            (actors[j], int(s))
+            for j, s in enumerate(row[:len(actors)]) if s > 0)
+
+
+    def _reader(self, st, idx, rv):
+        elems = self.rev_elems[idx]
+        domain = self.domain
+
+        def run():
+            adds, rmvs = store.rwset_read_keys(
+                st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))
+            adds, rmvs = np.asarray(adds)[0], np.asarray(rmvs)[0]
+            actors = domain.dc_ids
+            state = {}
+            for slot, elem in enumerate(list(elems)):
+                if slot >= adds.shape[0]:
+                    break  # slot grown after the capture
+                a = self._dots_of(adds[slot], actors)
+                r = self._dots_of(rmvs[slot], actors)
+                if a or r:
+                    state[elem] = (a, r)
+            return state
+
+        return run
+
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        elem_lists = [self.rev_elems[i] for i in idxs]
+        domain = self.domain
+
+        def run():
+            adds, rmvs = store.rwset_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv))
+            adds, rmvs = np.asarray(adds), np.asarray(rmvs)
+            actors = domain.dc_ids
+            out = {}
+            for i, k in enumerate(owned):
+                state = {}
+                for slot, elem in enumerate(list(elem_lists[i])):
+                    if slot >= adds.shape[1]:
+                        break
+                    a = self._dots_of(adds[i, slot], actors)
+                    r = self._dots_of(rmvs[i, slot], actors)
+                    if a or r:
+                        state[elem] = (a, r)
+                out[k] = state
+            return out
+
+        return run
+
+
+class FlagDwPlane(RwsetPlane):
+    """Device plane for flag_dw — the remove-wins lattice with one
+    implicit element (slot 0; crdt/flags.py FlagDW).  State tuple
+    (enable_dots, disable_dots)."""
+
+    type_name = "flag_dw"
+
+    def __init__(self, domain, key_capacity, n_lanes, flush_ops, gc_ops,
+                 max_dcs):
+        super().__init__(domain, key_capacity, n_lanes, 1, flush_ops,
+                         gc_ops, max_dcs, max_slots=1)
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        eff = payload.effect
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        if eff[0] == "en":       # enable = add-plane dot, cancels dis
+            _, dot, obs_dis = eff
+            kind, obs_en = 0, ()
+        elif eff[0] == "dis":    # disable = rmv-plane dot, cancels en
+            _, dot, obs_en = eff
+            kind, obs_dis = 1, ()
+        else:                    # "reset": cancels both, mints nothing
+            _, obs_en, obs_dis = eff
+            kind, dot = 2, (None, 0)
+        actor, seq = dot
+        dot_col = 0 if actor is None else self._dc_col(actor)
+        oa = self._decode_obs(obs_en)
+        orm = self._decode_obs(obs_dis)
+        if oa is None or orm is None or dot_col is None:
+            self.evict(key)
+            return
+        self._commit_rows(key, idx, [
+            (idx, 0, kind, dot_col, int(seq), oa, orm, op_dc_col,
+             int(payload.commit_time), ss_pairs)])
+
+
+    def _reader(self, st, idx, rv):
+        domain = self.domain
+
+        def run():
+            adds, rmvs = store.rwset_read_keys(
+                st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))
+            actors = domain.dc_ids
+            return (self._dots_of(np.asarray(adds)[0, 0], actors),
+                    self._dots_of(np.asarray(rmvs)[0, 0], actors))
+
+        return run
+
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        domain = self.domain
+
+        def run():
+            adds, rmvs = store.rwset_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv))
+            adds, rmvs = np.asarray(adds), np.asarray(rmvs)
+            actors = domain.dc_ids
+            return {
+                k: (self._dots_of(adds[i, 0], actors),
+                    self._dots_of(rmvs[i, 0], actors))
+                for i, k in enumerate(owned)
+            }
+
+        return run
+
+
+class SetGoPlane(OrsetPlane):
+    """Device plane for set_go — monotone presence, no dot algebra
+    (store.setgo_*; host oracle crdt/sets.py SetGO).  Effect = tuple of
+    elements; row tuple: (key_idx, slot, op_dc_col, op_ct, ss_pairs).
+    Dot-collapse soundness is moot (no dots), so uncertified commits may
+    stay on the device path (like counter_pn)."""
+
+    type_name = "set_go"
+
+    def _init_state(self, key_capacity):
+        return store.setgo_shard_init(
+            key_capacity, self.n_lanes, self.n_slots, self.domain.d,
+            dtype=jnp.int64)
+
+    def _grow_dcs(self, new_d):
+        self.st = store.setgo_grow(self.st, n_dcs=new_d)
+
+    def _grow_keys(self, new_k):
+        self.st = store.setgo_grow(self.st, n_keys=new_k)
+
+    def _grow_slots(self, new_e):
+        self.flush()
+        self.n_slots = new_e
+        self.st = store.setgo_grow(self.st, n_slots=new_e)
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        rows = []
+        for elem in payload.effect:
+            slot = self._slot(idx, elem)
+            if slot is None:
+                self.evict(key)
+                return
+            rows.append((idx, slot, op_dc_col,
+                         int(payload.commit_time), ss_pairs))
+        self._commit_rows(key, idx, rows)
+
+    def _append_rows(self, rows):
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        B = _bucket(n)
+        K = self.capacity
+        d = self.domain.d
+        key_idx = np.full(B, K, dtype=np.int32)
+        elem = np.zeros(B, dtype=np.int64)
+        op_dc = np.zeros(B, dtype=np.int64)
+        op_ct = np.zeros(B, dtype=np.int64)
+        ss = np.zeros((B, d), dtype=np.int64)
+        for i, (ki, sl, odc, oct_, ssp) in enumerate(rows):
+            key_idx[i] = ki
+            elem[i] = sl
+            op_dc[i] = odc
+            op_ct[i] = oct_
+            for col, t in ssp:
+                ss[i, col] = max(ss[i, col], t)
+        lane_off = np.zeros(B, dtype=np.int32)
+        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
+        self.st, overflow = store.setgo_append(
+            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
+            jnp.asarray(elem), jnp.asarray(op_dc), jnp.asarray(op_ct),
+            jnp.asarray(ss))
+        return np.asarray(overflow)[:n]
+
+    def _purge_idx(self, idx):
+        self.st = store.setgo_purge_keys(
+            self.st, jnp.asarray([idx], dtype=np.int32))
+        self.elem_index[idx] = {}
+        self.rev_elems[idx] = []
+
+    def _device_gc(self, gst_dense):
+        self.st = store.setgo_gc(self.st, jnp.asarray(gst_dense))
+
+
+    def _reader(self, st, idx, rv):
+        elems = self.rev_elems[idx]
+
+        def run():
+            present = np.asarray(store.setgo_read_keys(
+                st, jnp.asarray([idx], dtype=np.int32),
+                jnp.asarray(rv))[0])
+            return frozenset(
+                e for slot, e in enumerate(list(elems))
+                if slot < present.shape[0] and present[slot])
+
+        return run
+
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        elem_lists = [self.rev_elems[i] for i in idxs]
+
+        def run():
+            present = np.asarray(store.setgo_read_keys(
+                st, jnp.asarray(pad), jnp.asarray(rv)))
+            return {
+                k: frozenset(
+                    e for slot, e in enumerate(list(elem_lists[i]))
+                    if slot < present.shape[1] and present[i, slot])
                 for i, k in enumerate(owned)
             }
 
@@ -907,9 +1245,6 @@ class LwwPlane(_PlaneBase):
     def _device_gc(self, gst_dense):
         self.st = store.lww_gc(self.st, jnp.asarray(gst_dense))
 
-    def read(self, key, read_vc: Optional[VC]):
-        """register_lww host state (ts, (actor, seq), value)."""
-        return self.read_begin(key, read_vc)()
 
     def _reader(self, st, idx, rv):
         # actors_sorted is REPLACED wholesale on a rank repack (which
@@ -983,13 +1318,28 @@ class DevicePlane:
                                      max_dcs),
             "flag_ew": FlagEwPlane(ClockDomain(8), key_capacity,
                                    n_lanes, flush_ops, gc_ops, max_dcs),
+            "set_rw": RwsetPlane(ClockDomain(8), key_capacity, n_lanes,
+                                 n_slots, flush_ops, gc_ops, max_dcs,
+                                 max_slots),
+            "flag_dw": FlagDwPlane(ClockDomain(8), key_capacity,
+                                   n_lanes, flush_ops, gc_ops, max_dcs),
+            "set_go": SetGoPlane(ClockDomain(8), key_capacity, n_lanes,
+                                 n_slots, flush_ops, gc_ops, max_dcs,
+                                 max_slots),
         }
         #: keys evicted to the host path (sticky)
         self.host_only: set = set()
         #: types whose dense representation collapses dot sets per DC —
-        #: only sound under write-write certification (module doc)
+        #: only sound under write-write certification (module doc).
+        #: counter_pn and set_go mint no dots and are exempt.
+        #: counter_fat stays host-served entirely: its value is a SUM
+        #: over live dots, so the per-column collapse cannot reproduce
+        #: the exact per-dot state a reset's downstream generation
+        #: needs (a lossy observed list would under-cancel at exact
+        #: replicas — a value divergence, not just a representation
+        #: one).  Maps are host-served pending field-composite routing.
         self.dot_collapse_types = frozenset(
-            {"set_aw", "register_mv", "flag_ew"})
+            {"set_aw", "register_mv", "flag_ew", "set_rw", "flag_dw"})
 
     def set_evict_handler(self, fn: Callable[[Any, str], None]) -> None:
         def handler(key, type_name):

@@ -179,6 +179,78 @@ def flag_ew_read(base_dots, dot_dc, dot_seq, is_enable, obs_vv, mask):
 
 
 # ---------------------------------------------------------------------------
+# set_rw (remove-wins) / flag_dw — the two-plane dotted lattice
+#
+# Remove-wins is the OR-Set algebra run twice with *cross*-cancellation:
+# adds and removes each mint dots into their own table; an add's observed
+# VV cancels remove-dots, a remove's cancels add-dots, a reset's cancels
+# both (host oracle: crdt/sets.py SetRW).  Presence = any live add dot
+# AND no live remove dot.  The per-DC max collapse is prefix-cancel
+# insensitive exactly as for the OR-Set: an observed-VV gap at a column
+# implies an included earlier op already canceled below the gap (causal
+# delivery), so watermark-cancel agrees with exact dot-cancel on
+# liveness.  (Reference semantics: antidote_crdt_set_rw, exercised at
+# test/singledc/pb_client_SUITEs.erl:360.)
+
+#: op kinds in the packed ring
+RW_ADD, RW_RMV, RW_RESET = 0, 1, 2
+
+
+def rwset_apply(
+    base_adds: jax.Array,  # int[K, E, D] live add-dot table
+    base_rmvs: jax.Array,  # int[K, E, D] live remove-dot table
+    elem_slot: jax.Array,  # int32[K, L]
+    kind: jax.Array,       # int[K, L] RW_ADD / RW_RMV / RW_RESET
+    dot_dc: jax.Array,     # int32[K, L] minting DC (add/rmv rows)
+    dot_seq: jax.Array,    # int[K, L] minted seq (0 = no dot)
+    obs_add: jax.Array,    # int[K, L, D] observed add-VV (rmv/reset rows)
+    obs_rmv: jax.Array,    # int[K, L, D] observed rmv-VV (add/reset rows)
+    mask: jax.Array,       # bool[K, L] inclusion mask
+):
+    """Returns the new (adds, rmvs) dot tables [K, E, D].  Rows carry a
+    zero observed-VV on the plane they do not cancel (an add's obs_add is
+    0), so each plane takes its max-observed over ALL included rows."""
+    k, e, d = base_adds.shape
+    dt = base_adds.dtype
+    e_hot = elem_slot[..., None] == jnp.arange(e, dtype=elem_slot.dtype)
+    d_hot = dot_dc[..., None] == jnp.arange(d, dtype=dot_dc.dtype)
+    obs_sel = (mask[..., None] & e_hot)[..., None]           # [K, L, E, 1]
+
+    def plane(mint_kind, base, obs):
+        sel = ((mask & (kind == mint_kind))[..., None, None]
+               & e_hot[..., :, None] & d_hot[..., None, :])  # [K, L, E, D]
+        seqs = dot_seq.astype(dt)[..., None, None]
+        last = jnp.max(jnp.where(sel, seqs, jnp.zeros((), dt)), axis=1)
+        o = obs.astype(dt)[:, :, None, :]                    # [K, L, 1, D]
+        max_obs = jnp.max(jnp.where(obs_sel, o, jnp.zeros((), dt)), axis=1)
+        merged = jnp.maximum(base, last)
+        return jnp.where(merged > max_obs, merged, jnp.zeros((), dt))
+
+    return plane(RW_ADD, base_adds, obs_add), \
+        plane(RW_RMV, base_rmvs, obs_rmv)
+
+
+def rwset_present(adds: jax.Array, rmvs: jax.Array) -> jax.Array:
+    """bool[K, E]: element visible iff some live add dot and no live
+    remove dot (remove wins over concurrency)."""
+    return jnp.any(adds > 0, axis=-1) & ~jnp.any(rmvs > 0, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# set_go — grow-only presence (no dots, no cancellation)
+
+
+def setgo_apply(base_present: jax.Array,  # bool[K, E]
+                elem_slot: jax.Array,     # int32[K, L]
+                mask: jax.Array):         # bool[K, L]
+    """bool[K, E]: presence after applying the included add rows (the
+    whole CRDT is a monotone OR; reference antidote_crdt_set_go)."""
+    e = base_present.shape[1]
+    e_hot = elem_slot[..., None] == jnp.arange(e, dtype=elem_slot.dtype)
+    return base_present | jnp.any(mask[..., None] & e_hot, axis=1)
+
+
+# ---------------------------------------------------------------------------
 # register_lww
 
 

@@ -657,6 +657,376 @@ def lww_retie(st: LwwShardState, remap: np.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# set_rw shard — the remove-wins two-plane dot lattice
+#
+# Two dot tables per key (adds / removes) with cross-cancellation
+# (kernels.rwset_apply; host oracle crdt/sets.py SetRW).  Ring, append,
+# GC-fold, purge, and grow follow the OR-Set machinery; rows carry TWO
+# observed VVs (the add-plane one zeroed on add rows and vice versa) so
+# the fold needs no per-row kind test for cancellation.  flag_dw shares
+# this store with a single implicit element slot (crdt/flags.py FlagDW).
+
+# packed columns (set_rw): scalars, then obs_add VV, obs_rmv VV, op SS
+_RELEM, _RKIND, _RDOTDC, _RDOTSEQ, _ROPDC, _ROPCT, _RNSCAL = \
+    0, 1, 2, 3, 4, 5, 6
+
+
+@dataclass
+class RwsetShardState:
+    """``ops[K*L, 6+3D]`` packs [elem_slot, kind, dot_dc, dot_seq,
+    op_dc, op_ct, obs_add(D), obs_rmv(D), op_ss(D)]."""
+
+    adds: jax.Array      # int[K, E, D] base add-dot table
+    rmvs: jax.Array      # int[K, E, D] base remove-dot table
+    base_vc: jax.Array   # int[D]
+    has_base: jax.Array  # bool[]
+    ops: jax.Array       # int[K*L, 6+3D]
+    valid: jax.Array     # bool[K*L]
+    n_lanes: int
+
+    @property
+    def _d(self) -> int:
+        return (self.ops.shape[-1] - _RNSCAL) // 3
+
+    def _col(self, c) -> jax.Array:
+        return self.ops[:, c].reshape(-1, self.n_lanes)
+
+    @property
+    def valid2d(self) -> jax.Array:
+        return self.valid.reshape(-1, self.n_lanes)
+
+    @property
+    def elem_slot(self):
+        return self._col(_RELEM)
+
+    @property
+    def kind(self):
+        return self._col(_RKIND)
+
+    @property
+    def dot_dc(self):
+        return self._col(_RDOTDC)
+
+    @property
+    def dot_seq(self):
+        return self._col(_RDOTSEQ)
+
+    @property
+    def op_dc(self):
+        return self._col(_ROPDC)
+
+    @property
+    def op_ct(self):
+        return self._col(_ROPCT)
+
+    @property
+    def obs_add(self):
+        d = self._d
+        return self.ops[:, _RNSCAL:_RNSCAL + d].reshape(
+            -1, self.n_lanes, d)
+
+    @property
+    def obs_rmv(self):
+        d = self._d
+        return self.ops[:, _RNSCAL + d:_RNSCAL + 2 * d].reshape(
+            -1, self.n_lanes, d)
+
+    @property
+    def op_ss(self):
+        d = self._d
+        return self.ops[:, _RNSCAL + 2 * d:].reshape(-1, self.n_lanes, d)
+
+
+jax.tree_util.register_dataclass(
+    RwsetShardState,
+    data_fields=["adds", "rmvs", "base_vc", "has_base", "ops", "valid"],
+    meta_fields=["n_lanes"],
+)
+
+
+def rwset_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
+                     dtype=jnp.int64) -> RwsetShardState:
+    K, L, E, D = n_keys, n_lanes, n_slots, n_dcs
+    ops = jnp.zeros((K * L, _RNSCAL + 3 * D), dtype=dtype)
+    ops = ops.at[:, _RELEM].set(E)  # empty lanes route to the drop slot
+    return RwsetShardState(
+        adds=jnp.zeros((K, E, D), dtype=dtype),
+        rmvs=jnp.zeros((K, E, D), dtype=dtype),
+        base_vc=jnp.zeros((D,), dtype=dtype),
+        has_base=jnp.zeros((), dtype=bool),
+        ops=ops,
+        valid=jnp.zeros((K * L,), dtype=bool),
+        n_lanes=L,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def rwset_append(st: RwsetShardState, key_idx, lane_off, elem_slot, kind,
+                 dot_dc, dot_seq, obs_add, obs_rmv, op_dc, op_ct, op_ss):
+    dt = st.ops.dtype
+    L = st.n_lanes
+    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
+    col = lambda a: a.astype(dt)[:, None]
+    rows = jnp.concatenate([
+        col(elem_slot), col(kind), col(dot_dc), col(dot_seq),
+        col(op_dc), col(op_ct), obs_add.astype(dt), obs_rmv.astype(dt),
+        op_ss.astype(dt),
+    ], axis=1)
+    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
+    ops = st.ops.at[flat].set(rows, mode="drop")
+    valid = st.valid.at[flat].set(True, mode="drop")
+    return replace(st, ops=ops, valid=valid), overflow
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def rwset_gc(st: RwsetShardState, gst: jax.Array) -> RwsetShardState:
+    """Fold stable ops into the base planes (orset_gc stability
+    contract; max-collapse is prefix-cancel insensitive on both planes,
+    so folding commutes with later cancellation)."""
+    cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
+    stable = st.valid2d & dense.le(cvc, gst[None, None, :])
+    adds, rmvs = kernels.rwset_apply(
+        st.adds, st.rmvs, st.elem_slot, st.kind, st.dot_dc, st.dot_seq,
+        st.obs_add, st.obs_rmv, stable)
+    return replace(
+        st,
+        adds=adds, rmvs=rmvs,
+        base_vc=jnp.maximum(st.base_vc, gst.astype(st.base_vc.dtype)),
+        has_base=jnp.ones((), dtype=bool),
+        valid=st.valid & ~stable.reshape(-1),
+    )
+
+
+@jax.jit
+def rwset_read(st: RwsetShardState, read_vc: jax.Array):
+    """(adds, rmvs)[K, E, D]: live dot tables for every key at
+    ``read_vc`` (requires read_vc >= base_vc, as orset_read)."""
+    K = st.adds.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
+    has_base = jnp.broadcast_to(st.has_base, (K,))
+    mask = kernels.inclusion_mask(
+        st.op_dc, st.op_ct, st.op_ss, st.valid2d, base_vc, has_base,
+        read_vc)
+    return kernels.rwset_apply(
+        st.adds, st.rmvs, st.elem_slot, st.kind, st.dot_dc, st.dot_seq,
+        st.obs_add, st.obs_rmv, mask)
+
+
+@jax.jit
+def rwset_read_keys(st: RwsetShardState, key_idx: jax.Array,
+                    read_vc: jax.Array):
+    """(adds, rmvs)[B, E, D] for just the requested keys (transaction
+    read path; see orset_read_keys)."""
+    d = st._d
+    ops, mask = _gather_key_rows(st, key_idx, read_vc,
+                                 _ROPDC, _ROPCT, _RNSCAL + 2 * d)
+    return kernels.rwset_apply(
+        st.adds[key_idx], st.rmvs[key_idx], ops[..., _RELEM],
+        ops[..., _RKIND], ops[..., _RDOTDC], ops[..., _RDOTSEQ],
+        ops[..., _RNSCAL:_RNSCAL + d],
+        ops[..., _RNSCAL + d:_RNSCAL + 2 * d], mask)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def rwset_purge_keys(st: RwsetShardState,
+                     key_idx: jax.Array) -> RwsetShardState:
+    L = st.n_lanes
+    flat = (key_idx[:, None] * L
+            + jnp.arange(L, dtype=key_idx.dtype)).reshape(-1)
+    return replace(
+        st,
+        valid=st.valid.at[flat].set(False, mode="drop"),
+        adds=st.adds.at[key_idx].set(0, mode="drop"),
+        rmvs=st.rmvs.at[key_idx].set(0, mode="drop"),
+    )
+
+
+def rwset_grow(st: RwsetShardState, n_keys: int | None = None,
+               n_slots: int | None = None,
+               n_dcs: int | None = None) -> RwsetShardState:
+    """Host-side capacity regrade (see orset_grow)."""
+    K, E, D = st.adds.shape
+    L = st.n_lanes
+    nk, ne, nd = (n_keys or K), (n_slots or E), (n_dcs or D)
+    if (nk, ne, nd) == (K, E, D):
+        return st
+    ops = np.asarray(st.ops).reshape(K, L, -1)
+    scal = ops[..., :_RNSCAL]
+    blocks = [ops[..., _RNSCAL + i * D:_RNSCAL + (i + 1) * D]
+              for i in range(3)]
+    padD = ((0, 0), (0, 0), (0, nd - D))
+    ops = np.concatenate(
+        [scal] + [np.pad(b, padD) for b in blocks], axis=-1)
+    if nk > K:
+        ops = np.pad(ops, ((0, nk - K), (0, 0), (0, 0)))
+    valid = np.pad(np.asarray(st.valid).reshape(K, L),
+                   ((0, nk - K), (0, 0)))
+    pad3 = ((0, nk - K), (0, ne - E), (0, nd - D))
+    return RwsetShardState(
+        adds=jnp.asarray(np.pad(np.asarray(st.adds), pad3)),
+        rmvs=jnp.asarray(np.pad(np.asarray(st.rmvs), pad3)),
+        base_vc=jnp.asarray(np.pad(np.asarray(st.base_vc), (0, nd - D))),
+        has_base=st.has_base,
+        ops=jnp.asarray(ops.reshape(nk * L, -1)),
+        valid=jnp.asarray(valid.reshape(-1)),
+        n_lanes=L,
+    )
+
+
+# ---------------------------------------------------------------------------
+# set_go shard — monotone presence ring (no dots, no cancellation)
+
+# packed columns (set_go): [elem_slot, op_dc, op_ct, op_ss(D)]
+_GELEM, _GOPDC, _GOPCT, _GNSCAL = 0, 1, 2, 3
+
+
+@dataclass
+class SetGoShardState:
+    """``ops[K*L, 3+D]`` packs [elem_slot, op_dc, op_ct, op_ss(D)];
+    the base is a plain presence bitmap (grow-only union)."""
+
+    present: jax.Array   # bool[K, E] base presence
+    base_vc: jax.Array   # int[D]
+    has_base: jax.Array  # bool[]
+    ops: jax.Array       # int[K*L, 3+D]
+    valid: jax.Array     # bool[K*L]
+    n_lanes: int
+
+    @property
+    def _d(self) -> int:
+        return self.ops.shape[-1] - _GNSCAL
+
+    def _col(self, c) -> jax.Array:
+        return self.ops[:, c].reshape(-1, self.n_lanes)
+
+    @property
+    def valid2d(self) -> jax.Array:
+        return self.valid.reshape(-1, self.n_lanes)
+
+    @property
+    def elem_slot(self):
+        return self._col(_GELEM)
+
+    @property
+    def op_dc(self):
+        return self._col(_GOPDC)
+
+    @property
+    def op_ct(self):
+        return self._col(_GOPCT)
+
+    @property
+    def op_ss(self):
+        d = self._d
+        return self.ops[:, _GNSCAL:].reshape(-1, self.n_lanes, d)
+
+
+jax.tree_util.register_dataclass(
+    SetGoShardState,
+    data_fields=["present", "base_vc", "has_base", "ops", "valid"],
+    meta_fields=["n_lanes"],
+)
+
+
+def setgo_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
+                     dtype=jnp.int64) -> SetGoShardState:
+    K, L, E, D = n_keys, n_lanes, n_slots, n_dcs
+    ops = jnp.zeros((K * L, _GNSCAL + D), dtype=dtype)
+    ops = ops.at[:, _GELEM].set(E)
+    return SetGoShardState(
+        present=jnp.zeros((K, E), dtype=bool),
+        base_vc=jnp.zeros((D,), dtype=dtype),
+        has_base=jnp.zeros((), dtype=bool),
+        ops=ops,
+        valid=jnp.zeros((K * L,), dtype=bool),
+        n_lanes=L,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def setgo_append(st: SetGoShardState, key_idx, lane_off, elem_slot,
+                 op_dc, op_ct, op_ss):
+    dt = st.ops.dtype
+    L = st.n_lanes
+    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
+    col = lambda a: a.astype(dt)[:, None]
+    rows = jnp.concatenate(
+        [col(elem_slot), col(op_dc), col(op_ct), op_ss.astype(dt)],
+        axis=1)
+    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
+    ops = st.ops.at[flat].set(rows, mode="drop")
+    valid = st.valid.at[flat].set(True, mode="drop")
+    return replace(st, ops=ops, valid=valid), overflow
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def setgo_gc(st: SetGoShardState, gst: jax.Array) -> SetGoShardState:
+    cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
+    stable = st.valid2d & dense.le(cvc, gst[None, None, :])
+    present = kernels.setgo_apply(st.present, st.elem_slot, stable)
+    return replace(
+        st,
+        present=present,
+        base_vc=jnp.maximum(st.base_vc, gst.astype(st.base_vc.dtype)),
+        has_base=jnp.ones((), dtype=bool),
+        valid=st.valid & ~stable.reshape(-1),
+    )
+
+
+@jax.jit
+def setgo_read_keys(st: SetGoShardState, key_idx: jax.Array,
+                    read_vc: jax.Array) -> jax.Array:
+    """bool[B, E]: element presence for the requested keys."""
+    ops, mask = _gather_key_rows(st, key_idx, read_vc,
+                                 _GOPDC, _GOPCT, _GNSCAL)
+    return kernels.setgo_apply(
+        st.present[key_idx], ops[..., _GELEM], mask)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def setgo_purge_keys(st: SetGoShardState,
+                     key_idx: jax.Array) -> SetGoShardState:
+    L = st.n_lanes
+    flat = (key_idx[:, None] * L
+            + jnp.arange(L, dtype=key_idx.dtype)).reshape(-1)
+    return replace(
+        st,
+        valid=st.valid.at[flat].set(False, mode="drop"),
+        present=st.present.at[key_idx].set(False, mode="drop"),
+    )
+
+
+def setgo_grow(st: SetGoShardState, n_keys: int | None = None,
+               n_slots: int | None = None,
+               n_dcs: int | None = None) -> SetGoShardState:
+    """Host-side capacity regrade (see orset_grow)."""
+    K, E = st.present.shape
+    D = st._d
+    L = st.n_lanes
+    nk, ne, nd = (n_keys or K), (n_slots or E), (n_dcs or D)
+    if (nk, ne, nd) == (K, E, D):
+        return st
+    ops = np.asarray(st.ops).reshape(K, L, -1)
+    scal = ops[..., :_GNSCAL]
+    ss = ops[..., _GNSCAL:]
+    ops = np.concatenate(
+        [scal, np.pad(ss, ((0, 0), (0, 0), (0, nd - D)))], axis=-1)
+    if nk > K:
+        ops = np.pad(ops, ((0, nk - K), (0, 0), (0, 0)))
+    valid = np.pad(np.asarray(st.valid).reshape(K, L),
+                   ((0, nk - K), (0, 0)))
+    return SetGoShardState(
+        present=jnp.asarray(np.pad(np.asarray(st.present),
+                                   ((0, nk - K), (0, ne - E)))),
+        base_vc=jnp.asarray(np.pad(np.asarray(st.base_vc), (0, nd - D))),
+        has_base=st.has_base,
+        ops=jnp.asarray(ops.reshape(nk * L, -1)),
+        valid=jnp.asarray(valid.reshape(-1)),
+        n_lanes=L,
+    )
+
+
+# ---------------------------------------------------------------------------
 # counter_pn shard — same packed-ring machinery, scalar state
 
 # packed columns (counter): [delta, op_dc, op_ct, op_ss(D)]

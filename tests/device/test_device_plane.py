@@ -84,17 +84,46 @@ class StreamGen:
         elif type_name == "register_lww":
             # coarse ts buckets force ties so the tiebreak path runs
             eff = (ct // 8, (dc, ct), self.rng.choice(self.elems))
+        elif type_name == "set_rw":
+            st = st if isinstance(st, dict) else {}
+            r = self.rng.random()
+            if st and r < 0.15:
+                eff = ("reset", tuple(
+                    (e, tuple(sorted(a)), tuple(sorted(rm)))
+                    for e, (a, rm) in sorted(st.items())))
+            elif r < 0.55:
+                e = self.rng.choice(self.elems)
+                obs_rmvs = tuple(sorted(st.get(e, ((), ()))[1]))
+                eff = ("add", ((e, (dc, ct), obs_rmvs),))
+            else:
+                e = self.rng.choice(self.elems)
+                obs_adds = tuple(sorted(st.get(e, ((), ()))[0]))
+                eff = ("rmv", ((e, (dc, ct), obs_adds),))
+        elif type_name == "flag_dw":
+            en, dis = st if isinstance(st, tuple) else cls.new()
+            r = self.rng.random()
+            if r < 0.15:
+                eff = ("reset", tuple(sorted(en)), tuple(sorted(dis)))
+            elif r < 0.6:
+                eff = ("en", (dc, ct), tuple(sorted(dis)))
+            else:
+                eff = ("dis", (dc, ct), tuple(sorted(en)))
+        elif type_name == "set_go":
+            n = self.rng.randint(1, 3)
+            eff = tuple(self.rng.choice(self.elems) for _ in range(n))
         else:
             raise AssertionError(type_name)
         p = Payload(key=key, type_name=type_name, effect=eff,
                     commit_dc=dc, commit_time=ct, snapshot_vc=ss,
                     txid=f"tx{ct}")
         # apply to every DC view (causal delivery simulated as immediate)
+        stateful = ("set_aw", "set_rw", "set_go", "register_mv",
+                    "flag_ew", "flag_dw")
         for d in self.dcs:
-            if type_name in ("set_aw", "register_mv", "flag_ew"):
+            if type_name in stateful:
                 base = self.state[d][key]
-                if type_name != "set_aw" and not isinstance(
-                        base, frozenset):
+                if type_name not in ("set_aw", "set_rw") and not \
+                        isinstance(base, (frozenset, tuple)):
                     base = cls.new()
                 self.state[d][key] = cls.update(eff, base)
             self.clock[d] = max(self.clock[d], ct)
@@ -116,7 +145,8 @@ def publish(pm, p, stable):
 
 
 @pytest.mark.parametrize("type_name", [
-    "counter_pn", "set_aw", "register_mv", "register_lww", "flag_ew"])
+    "counter_pn", "set_aw", "register_mv", "register_lww", "flag_ew",
+    "set_rw", "flag_dw", "set_go"])
 def test_stream_oracle_equivalence(tmp_path, type_name):
     """Random stream through the real publish path: device reads ==
     host-store reads at the latest snapshot and at historical ones."""

@@ -205,3 +205,106 @@ def test_orset_store_roundtrip_with_gc():
         host_elems = set(cls.value(host[key]))
         dev = {e for e, s in intern[key].items() if present[key, s]}
         assert dev == host_elems, f"key {key}"
+
+
+def _rw_append(st, key, slot, kind, dot, obs_add, obs_rmv, dc, ct, ss):
+    """One-op rwset append with dense [1, D] observed VVs."""
+    keys = np.array([key], dtype=np.int32)
+    st, ov = store.rwset_append(
+        st, jnp.asarray(keys),
+        jnp.asarray(store.batch_lane_offsets(keys)),
+        jnp.asarray([slot], dtype=jnp.int32),
+        jnp.asarray([kind], dtype=jnp.int32),
+        jnp.asarray([int(dot[0])], dtype=jnp.int32),
+        jnp.asarray([int(dot[1])], dtype=jnp.int64),
+        jnp.asarray(np.asarray(obs_add, dtype=np.int64)[None, :]),
+        jnp.asarray(np.asarray(obs_rmv, dtype=np.int64)[None, :]),
+        jnp.asarray([dc], dtype=jnp.int32),
+        jnp.asarray([ct], dtype=jnp.int64),
+        jnp.asarray(np.asarray(ss, dtype=np.int64)[None, :]))
+    assert not bool(ov.any())
+    return st
+
+
+def _rw_present(st, rv):
+    adds, rmvs = store.rwset_read(st, jnp.asarray(
+        np.asarray(rv, dtype=np.int64)))
+    from antidote_tpu.mat import kernels
+    return np.asarray(kernels.rwset_present(adds, rmvs))
+
+
+def test_rwset_remove_wins_over_concurrent_add():
+    """The defining semantic: concurrent add/remove of the same element
+    -> absent (the add-wins store would keep it).  A later add that
+    OBSERVED the remove's dot resurrects the element, and a GC fold of
+    the concurrent pair leaves every read unchanged (crdt/sets.py SetRW;
+    reference antidote_crdt_set_rw)."""
+    st = store.rwset_shard_init(4, L, 2, D, dtype=jnp.int64)
+    z = np.zeros(D)
+    # concurrent: add by dc0 (ct 1), remove by dc1 (ct 1), neither observed
+    st = _rw_append(st, 0, 0, 0, (0, 1), z, z, 0, 1, [0, 0, 0, 0])
+    st = _rw_append(st, 0, 0, 1, (1, 1), z, z, 1, 1, [0, 0, 0, 0])
+    assert not _rw_present(st, [1, 1, 0, 0])[0, 0]  # remove wins
+    # add at dc0 ct2 that observed the remove dot (1,1): cancels it
+    st = _rw_append(st, 0, 0, 0, (0, 2), z, [0, 1, 0, 0], 0, 2,
+                    [1, 1, 0, 0])
+    assert _rw_present(st, [2, 1, 0, 0])[0, 0]       # resurrected
+    assert not _rw_present(st, [1, 1, 0, 0])[0, 0]   # historical read
+    # fold the stable concurrent pair; reads must not move
+    st = store.rwset_gc(st, jnp.asarray(np.array([1, 1, 0, 0],
+                                                 dtype=np.int64)))
+    assert bool(np.asarray(st.has_base))
+    assert _rw_present(st, [2, 1, 0, 0])[0, 0]
+
+
+def test_rwset_reset_clears_both_planes():
+    """A reset cancels every observed dot on both planes; a concurrent
+    (unobserved) add survives it."""
+    st = store.rwset_shard_init(4, L, 2, D, dtype=jnp.int64)
+    z = np.zeros(D)
+    st = _rw_append(st, 0, 0, 0, (0, 1), z, z, 0, 1, [0, 0, 0, 0])
+    st = _rw_append(st, 0, 1, 1, (1, 1), z, z, 1, 1, [0, 0, 0, 0])
+    # reset by dc2 at ct 1: observed the add (0,1) and rmv (1,1);
+    # concurrent add (0,2) is NOT observed
+    st = _rw_append(st, 0, 0, 0, (0, 2), z, z, 0, 2, [1, 0, 0, 0])
+    st = _rw_append(st, 0, 0, 2, (0, 0), [1, 0, 0, 0], [0, 1, 0, 0],
+                    2, 1, [1, 1, 0, 0])
+    p = _rw_present(st, [2, 1, 1, 0])
+    assert p[0, 0]          # the unobserved concurrent add survives
+    assert not p[0, 1]      # slot 1's rmv dot was reset away, no adds
+
+
+def test_setgo_store_gc_and_snapshots():
+    """Grow-only presence: elements appear at their commit snapshots and
+    a GC fold never loses them."""
+    st = store.setgo_shard_init(4, L, 4, D, dtype=jnp.int64)
+
+    def add(st, key, slot, dc, ct, ss):
+        keys = np.array([key], dtype=np.int32)
+        st, ov = store.setgo_append(
+            st, jnp.asarray(keys),
+            jnp.asarray(store.batch_lane_offsets(keys)),
+            jnp.asarray([slot], dtype=jnp.int32),
+            jnp.asarray([dc], dtype=jnp.int32),
+            jnp.asarray([ct], dtype=jnp.int64),
+            jnp.asarray(np.asarray(ss, dtype=np.int64)[None, :]))
+        assert not bool(ov.any())
+        return st
+
+    st = add(st, 0, 0, 0, 1, [0, 0, 0, 0])
+    st = add(st, 0, 1, 1, 1, [1, 0, 0, 0])
+    st = add(st, 2, 3, 0, 2, [1, 1, 0, 0])
+
+    def present(st, rv, key):
+        return np.asarray(store.setgo_read_keys(
+            st, jnp.asarray([key], dtype=np.int32),
+            jnp.asarray(np.asarray(rv, dtype=np.int64))))[0]
+
+    assert list(present(st, [1, 0, 0, 0], 0)[:2]) == [True, False]
+    assert list(present(st, [1, 1, 0, 0], 0)[:2]) == [True, True]
+    assert present(st, [2, 1, 0, 0], 2)[3]
+    st = store.setgo_gc(st, jnp.asarray(np.array([1, 1, 0, 0],
+                                                 dtype=np.int64)))
+    assert int(np.asarray(st.valid).sum()) == 1  # only the ct=2 op left
+    assert list(present(st, [2, 1, 0, 0], 0)[:2]) == [True, True]
+    assert present(st, [2, 1, 0, 0], 2)[3]
