@@ -37,6 +37,7 @@ rare host-side repack (store.orset_grow).
 from __future__ import annotations
 
 import logging
+from dataclasses import replace as dc_replace
 from typing import Any, Callable, Dict, List, Optional
 
 import jax.numpy as jnp
@@ -65,6 +66,32 @@ def _bucket(n: int) -> int:
 
 class ReadBelowBase(Exception):
     """Read snapshot does not dominate the device base — serve from log."""
+
+
+def _pack_rows(rows: List[tuple], capacity: int, d: int,
+               cols: tuple) -> tuple:
+    """Shared append packing: pad decoded rows to a power-of-two bucket
+    and split them into per-column arrays.  ``cols`` tags each row field
+    after the leading key index: "s" = int64 scalar, "vv" = (col, seq)
+    pair list max-merged into a dense [B, d] vector clock.  Returns
+    (key_idx[B], lane_off[B], arrays) in ``cols`` order — the exact
+    argument order of the matching store ``*_append``."""
+    n = len(rows)
+    B = _bucket(n)
+    key_idx = np.full(B, capacity, dtype=np.int32)
+    arrays = [np.zeros((B, d) if tag == "vv" else B, dtype=np.int64)
+              for tag in cols]
+    for i, row in enumerate(rows):
+        key_idx[i] = row[0]
+        for a, tag, v in zip(arrays, cols, row[1:]):
+            if tag == "vv":
+                for col, s in v:
+                    a[i, col] = max(a[i, col], s)
+            else:
+                a[i] = v
+    lane_off = np.zeros(B, dtype=np.int32)
+    lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
+    return key_idx, lane_off, arrays
 
 
 class _PlaneBase:
@@ -107,9 +134,24 @@ class _PlaneBase:
     def _grow_keys(self, new_k: int) -> None:
         raise NotImplementedError
 
+    #: row-field tags after the leading key index ("s" scalar / "vv"
+    #: pair list) — must match the argument order of ``_append_fn``
+    _row_cols: tuple = ()
+    #: the store's ``*_append`` for this plane's shard state
+    _append_fn = None
+
     def _append_rows(self, rows: List[tuple]) -> np.ndarray:
-        """Device-append decoded rows; returns bool[n] overflow."""
-        raise NotImplementedError
+        """Device-append decoded rows via the shared packing
+        (:func:`_pack_rows`); returns bool[n] overflow."""
+        n = len(rows)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        ki, lo, arrays = _pack_rows(rows, self.capacity, self.domain.d,
+                                    self._row_cols)
+        self.st, overflow = type(self)._append_fn(
+            self.st, jnp.asarray(ki), jnp.asarray(lo),
+            *(jnp.asarray(a) for a in arrays))
+        return np.asarray(overflow)[:n]
 
     def _purge_idx(self, idx: int) -> None:
         raise NotImplementedError
@@ -346,6 +388,9 @@ class OrsetPlane(_PlaneBase):
     op_ct, ss_pairs)."""
 
     type_name = "set_aw"
+    # (slot, is_add, dot_dc, dot_seq, obs_vv, op_dc, op_ct, op_ss)
+    _row_cols = ("s", "s", "s", "s", "vv", "s", "s", "vv")
+    _append_fn = staticmethod(store.orset_append)
 
     def __init__(self, domain, key_capacity, n_lanes, n_slots, flush_ops,
                  gc_ops, max_dcs, max_slots):
@@ -424,42 +469,6 @@ class OrsetPlane(_PlaneBase):
                          ss_pairs))
         self._commit_rows(key, idx, rows)
 
-    def _append_rows(self, rows):
-        n = len(rows)
-        if n == 0:
-            return np.zeros(0, dtype=bool)
-        B = _bucket(n)
-        K = self.capacity
-        d = self.domain.d
-        key_idx = np.full(B, K, dtype=np.int32)
-        elem = np.zeros(B, dtype=np.int64)
-        is_add = np.zeros(B, dtype=np.int64)
-        dot_dc = np.zeros(B, dtype=np.int64)
-        dot_seq = np.zeros(B, dtype=np.int64)
-        obs = np.zeros((B, d), dtype=np.int64)
-        op_dc = np.zeros(B, dtype=np.int64)
-        op_ct = np.zeros(B, dtype=np.int64)
-        ss = np.zeros((B, d), dtype=np.int64)
-        for i, (ki, sl, ia, dc, sq, op_, odc, oct_, ssp) in enumerate(rows):
-            key_idx[i] = ki
-            elem[i] = sl
-            is_add[i] = ia
-            dot_dc[i] = dc
-            dot_seq[i] = sq
-            for col, s in op_:
-                obs[i, col] = max(obs[i, col], s)
-            op_dc[i] = odc
-            op_ct[i] = oct_
-            for col, t in ssp:
-                ss[i, col] = max(ss[i, col], t)
-        lane_off = np.zeros(B, dtype=np.int32)
-        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
-        self.st, overflow = store.orset_append(
-            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
-            jnp.asarray(elem), jnp.asarray(is_add), jnp.asarray(dot_dc),
-            jnp.asarray(dot_seq), jnp.asarray(obs), jnp.asarray(op_dc),
-            jnp.asarray(op_ct), jnp.asarray(ss))
-        return np.asarray(overflow)[:n]
 
     def _purge_idx(self, idx):
         self.st = store.orset_purge_keys(
@@ -527,6 +536,9 @@ class CounterPlane(_PlaneBase):
     (key_idx, delta, op_dc_col, op_ct, ss_pairs)."""
 
     type_name = "counter_pn"
+    # (delta, op_dc, op_ct, op_ss)
+    _row_cols = ("s", "s", "s", "vv")
+    _append_fn = staticmethod(store.counter_append)
 
     def _init_state(self, key_capacity):
         return store.counter_shard_init(
@@ -549,32 +561,6 @@ class CounterPlane(_PlaneBase):
             (idx, int(payload.effect), op_dc_col,
              int(payload.commit_time), ss_pairs)])
 
-    def _append_rows(self, rows):
-        n = len(rows)
-        if n == 0:
-            return np.zeros(0, dtype=bool)
-        B = _bucket(n)
-        K = self.capacity
-        d = self.domain.d
-        key_idx = np.full(B, K, dtype=np.int32)
-        delta = np.zeros(B, dtype=np.int64)
-        op_dc = np.zeros(B, dtype=np.int64)
-        op_ct = np.zeros(B, dtype=np.int64)
-        ss = np.zeros((B, d), dtype=np.int64)
-        for i, (ki, dl, odc, oct_, ssp) in enumerate(rows):
-            key_idx[i] = ki
-            delta[i] = dl
-            op_dc[i] = odc
-            op_ct[i] = oct_
-            for col, t in ssp:
-                ss[i, col] = max(ss[i, col], t)
-        lane_off = np.zeros(B, dtype=np.int32)
-        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
-        self.st, overflow = store.counter_append(
-            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
-            jnp.asarray(delta), jnp.asarray(op_dc), jnp.asarray(op_ct),
-            jnp.asarray(ss))
-        return np.asarray(overflow)[:n]
 
     def _purge_idx(self, idx):
         self.st = store.counter_purge_keys(
@@ -765,6 +751,9 @@ class RwsetPlane(OrsetPlane):
     value level for this type."""
 
     type_name = "set_rw"
+    # (slot, kind, dot_dc, dot_seq, obs_add, obs_rmv, op_dc, op_ct, op_ss)
+    _row_cols = ("s", "s", "s", "s", "vv", "vv", "s", "s", "vv")
+    _append_fn = staticmethod(store.rwset_append)
 
     def _init_state(self, key_capacity):
         return store.rwset_shard_init(
@@ -814,46 +803,6 @@ class RwsetPlane(OrsetPlane):
                          op_dc_col, int(payload.commit_time), ss_pairs))
         self._commit_rows(key, idx, rows)
 
-    def _append_rows(self, rows):
-        n = len(rows)
-        if n == 0:
-            return np.zeros(0, dtype=bool)
-        B = _bucket(n)
-        K = self.capacity
-        d = self.domain.d
-        key_idx = np.full(B, K, dtype=np.int32)
-        elem = np.zeros(B, dtype=np.int64)
-        kind = np.zeros(B, dtype=np.int64)
-        dot_dc = np.zeros(B, dtype=np.int64)
-        dot_seq = np.zeros(B, dtype=np.int64)
-        obs_a = np.zeros((B, d), dtype=np.int64)
-        obs_r = np.zeros((B, d), dtype=np.int64)
-        op_dc = np.zeros(B, dtype=np.int64)
-        op_ct = np.zeros(B, dtype=np.int64)
-        ss = np.zeros((B, d), dtype=np.int64)
-        for i, (ki, sl, kn, dc, sq, oa, orm, odc, oct_, ssp) in \
-                enumerate(rows):
-            key_idx[i] = ki
-            elem[i] = sl
-            kind[i] = kn
-            dot_dc[i] = dc
-            dot_seq[i] = sq
-            for col, s in oa:
-                obs_a[i, col] = max(obs_a[i, col], s)
-            for col, s in orm:
-                obs_r[i, col] = max(obs_r[i, col], s)
-            op_dc[i] = odc
-            op_ct[i] = oct_
-            for col, t in ssp:
-                ss[i, col] = max(ss[i, col], t)
-        lane_off = np.zeros(B, dtype=np.int32)
-        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
-        self.st, overflow = store.rwset_append(
-            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
-            jnp.asarray(elem), jnp.asarray(kind), jnp.asarray(dot_dc),
-            jnp.asarray(dot_seq), jnp.asarray(obs_a), jnp.asarray(obs_r),
-            jnp.asarray(op_dc), jnp.asarray(op_ct), jnp.asarray(ss))
-        return np.asarray(overflow)[:n]
 
     def _purge_idx(self, idx):
         self.st = store.rwset_purge_keys(
@@ -995,6 +944,9 @@ class SetGoPlane(OrsetPlane):
     stay on the device path (like counter_pn)."""
 
     type_name = "set_go"
+    # (slot, op_dc, op_ct, op_ss)
+    _row_cols = ("s", "s", "s", "vv")
+    _append_fn = staticmethod(store.setgo_append)
 
     def _init_state(self, key_capacity):
         return store.setgo_shard_init(
@@ -1029,32 +981,6 @@ class SetGoPlane(OrsetPlane):
                          int(payload.commit_time), ss_pairs))
         self._commit_rows(key, idx, rows)
 
-    def _append_rows(self, rows):
-        n = len(rows)
-        if n == 0:
-            return np.zeros(0, dtype=bool)
-        B = _bucket(n)
-        K = self.capacity
-        d = self.domain.d
-        key_idx = np.full(B, K, dtype=np.int32)
-        elem = np.zeros(B, dtype=np.int64)
-        op_dc = np.zeros(B, dtype=np.int64)
-        op_ct = np.zeros(B, dtype=np.int64)
-        ss = np.zeros((B, d), dtype=np.int64)
-        for i, (ki, sl, odc, oct_, ssp) in enumerate(rows):
-            key_idx[i] = ki
-            elem[i] = sl
-            op_dc[i] = odc
-            op_ct[i] = oct_
-            for col, t in ssp:
-                ss[i, col] = max(ss[i, col], t)
-        lane_off = np.zeros(B, dtype=np.int32)
-        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
-        self.st, overflow = store.setgo_append(
-            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
-            jnp.asarray(elem), jnp.asarray(op_dc), jnp.asarray(op_ct),
-            jnp.asarray(ss))
-        return np.asarray(overflow)[:n]
 
     def _purge_idx(self, idx):
         self.st = store.setgo_purge_keys(
@@ -1111,6 +1037,9 @@ class LwwPlane(_PlaneBase):
     of a new actor — rare, host-side, and exact."""
 
     type_name = "register_lww"
+    # (ts, tie, val_id, op_dc, op_ct, op_ss)
+    _row_cols = ("s", "s", "s", "s", "s", "vv")
+    _append_fn = staticmethod(store.lww_append)
 
     def __init__(self, domain, key_capacity, n_lanes, flush_ops, gc_ops,
                  max_dcs):
@@ -1207,36 +1136,6 @@ class LwwPlane(_PlaneBase):
             (idx, int(ts), tie, vid, op_dc_col,
              int(payload.commit_time), ss_pairs)])
 
-    def _append_rows(self, rows):
-        n = len(rows)
-        if n == 0:
-            return np.zeros(0, dtype=bool)
-        B = _bucket(n)
-        K = self.capacity
-        d = self.domain.d
-        key_idx = np.full(B, K, dtype=np.int32)
-        ts = np.zeros(B, dtype=np.int64)
-        tie = np.zeros(B, dtype=np.int64)
-        val = np.zeros(B, dtype=np.int64)
-        op_dc = np.zeros(B, dtype=np.int64)
-        op_ct = np.zeros(B, dtype=np.int64)
-        ss = np.zeros((B, d), dtype=np.int64)
-        for i, (ki, t, ti, vi, odc, oct_, ssp) in enumerate(rows):
-            key_idx[i] = ki
-            ts[i] = t
-            tie[i] = ti
-            val[i] = vi
-            op_dc[i] = odc
-            op_ct[i] = oct_
-            for col, tt in ssp:
-                ss[i, col] = max(ss[i, col], tt)
-        lane_off = np.zeros(B, dtype=np.int32)
-        lane_off[:n] = store.batch_lane_offsets(key_idx[:n])
-        self.st, overflow = store.lww_append(
-            self.st, jnp.asarray(key_idx), jnp.asarray(lane_off),
-            jnp.asarray(ts), jnp.asarray(tie), jnp.asarray(val),
-            jnp.asarray(op_dc), jnp.asarray(op_ct), jnp.asarray(ss))
-        return np.asarray(overflow)[:n]
 
     def _purge_idx(self, idx):
         self.st = store.lww_purge_keys(
@@ -1286,6 +1185,243 @@ class LwwPlane(_PlaneBase):
         return run
 
 
+#: bottom (empty) nested states as the planes reconstruct them — used by
+#: the map_rr visibility rule (entry invisible iff nested state is
+#: bottom, crdt/maps.py MapRR.update)
+_BOTTOM = {
+    "counter_pn": 0,
+    "set_aw": {},
+    "set_rw": {},
+    "set_go": frozenset(),
+    "register_mv": frozenset(),
+    "register_lww": (0, (), None),
+    "flag_ew": frozenset(),
+    "flag_dw": (frozenset(), frozenset()),
+}
+
+
+class MapPlane:
+    """Field-composite device plane for map_go / map_rr.
+
+    A map effect is a bag of nested effects keyed by ``key_t = (field,
+    nested_type)`` (crdt/maps.py; reference antidote_crdt_map_rr
+    semantics).  Each nested effect routes to a PRIVATE sub-plane of the
+    nested type under the synthetic key ``(map_key, key_t)`` — the map
+    rides the existing per-type ring/fold/GC machinery instead of
+    needing its own kernels.  Reads fan back out: one batched sub-fold
+    per nested type reassembles ``{key_t: nested_state}``.
+
+    Visibility: map_go entries exist from their first update onward, a
+    snapshot-dependent fact tracked by a private set_go presence plane
+    over fields; map_rr entries are visible iff the nested state is not
+    bottom (MapRR.update pops bottoms), checked on the reconstructed
+    state.
+
+    Fallback is map-granular: any capacity miss in any sub-plane evicts
+    the WHOLE map key to the host path (log replay of the map's effects
+    rebuilds it there — synthetic keys never appear in the log).  Nested
+    types without a device plane (maps-in-maps, counter_fat, counter_b)
+    evict the same way."""
+
+    SUPPORTED = frozenset(_BOTTOM)
+
+    def __init__(self, type_name: str, make_sub,
+                 make_presence=None):
+        self.type_name = type_name
+        self._make_sub = make_sub
+        self._subs: Dict[str, _PlaneBase] = {}
+        self._presence = make_presence() if make_presence else None
+        if self._presence is not None:
+            self._presence.on_evict = \
+                lambda mkey, t: self._sub_evicted(mkey)
+        #: map_key -> set of key_t ever staged on device.  Doubles as
+        #: the plane's key directory (``key_index`` below) so operator
+        #: surfaces can treat every plane uniformly.
+        self.fields: Dict[Any, set] = {}
+        self.pending_keys: set = set()
+        self.on_evict: Callable[[Any, str], None] = lambda k, t: None
+        self._evicting = None
+
+    # -- plumbing shared with _PlaneBase's interface ------------------------
+
+    @property
+    def rows(self):
+        out = []
+        for s in self._all_planes():
+            out.extend(s.rows)
+        return out
+
+    def _all_planes(self):
+        planes = list(self._subs.values())
+        if self._presence is not None:
+            planes.append(self._presence)
+        return planes
+
+    def owns(self, key) -> bool:
+        return key in self.fields
+
+    @property
+    def key_index(self) -> Dict[Any, set]:
+        """Key directory (uniform with _PlaneBase.key_index: len() =
+        device-resident keys, ``in`` = ownership)."""
+        return self.fields
+
+    def _sub(self, ntype: str) -> _PlaneBase:
+        sub = self._subs.get(ntype)
+        if sub is None:
+            sub = self._make_sub(ntype)
+            sub.on_evict = lambda skey, t: self._sub_evicted(skey[0])
+            self._subs[ntype] = sub
+        return sub
+
+    def _sub_evicted(self, mkey) -> None:
+        if self._evicting == mkey:
+            return  # our own purge loop
+        self.evict(mkey)
+
+    # -- write path ---------------------------------------------------------
+
+    def stage(self, key, payload: Payload) -> None:
+        """Decode one committed map effect into sub-plane stages; evicts
+        the whole map on any nested capacity miss."""
+        _kind, entries = payload.effect
+        # register the key BEFORE any reject so evict() always runs the
+        # migration (the op is already in the log, like _PlaneBase.stage)
+        self.fields.setdefault(key, set())
+        if any(kt[1] not in self.SUPPORTED for kt, _ in entries):
+            self.evict(key)           # nested map / counter_fat / b
+            return
+        staged = []
+        for key_t, neff in entries:
+            sub = self._sub(key_t[1])
+            skey = (key, key_t)
+            sub.stage(skey, dc_replace(
+                payload, key=skey, type_name=key_t[1], effect=neff))
+            if key not in self.fields:
+                return                # a sub capacity miss evicted us
+            self.fields[key].add(key_t)
+            staged.append(key_t)
+        if self._presence is not None and staged:
+            self._presence.stage(key, dc_replace(
+                payload, type_name="set_go", effect=tuple(staged)))
+            if key not in self.fields:
+                return
+        self.pending_keys.add(key)
+
+    def maybe_flush_gc(self, stable_vc: Optional[VC]) -> None:
+        for p in self._all_planes():
+            p.maybe_flush_gc(stable_vc)
+        if not any(p.rows for p in self._all_planes()):
+            self.pending_keys.clear()
+
+    def flush(self) -> None:
+        for p in self._all_planes():
+            p.flush()
+        self.pending_keys.clear()
+
+    def gc(self, stable_vc: VC) -> None:
+        for p in self._all_planes():
+            p.gc(stable_vc)
+
+    def evict(self, key) -> None:
+        """Purge every synthetic key of the map and hand its history to
+        the host path (on_evict replays the map's log records)."""
+        if key not in self.fields:
+            return
+        self._evicting = key
+        try:
+            for key_t in self.fields.pop(key):
+                sub = self._subs.get(key_t[1])
+                if sub is not None:
+                    sub.evict((key, key_t))
+            if self._presence is not None:
+                self._presence.evict(key)
+        finally:
+            self._evicting = None
+        self.pending_keys.discard(key)
+        log.debug("device plane: evicted %r (%s)", key, self.type_name)
+        self.on_evict(key, self.type_name)
+
+    # -- read path ----------------------------------------------------------
+
+    def read_many_begin(self, keys: list, read_vc: Optional[VC]):
+        """Lock-held capture (see _PlaneBase.read_begin): synthetic keys
+        of ALL requested maps are grouped so each nested type costs ONE
+        batched sub-fold (plus one presence fold for map_go) regardless
+        of how many maps the transaction reads — the same
+        one-fold-per-type batching the flat planes get from
+        read_many_begin.  The closure reassembles per-map states outside
+        the lock."""
+        owned = [k for k in keys if k in self.fields]
+        if not owned:
+            return dict
+
+        def group(ks):
+            bt: Dict[str, list] = {}
+            for k in ks:
+                for kt in self.fields[k]:
+                    bt.setdefault(kt[1], []).append((k, kt))
+            return bt
+
+        # Pre-flush BEFORE any capture: a flush inside a sub-capture
+        # could overflow -> evict the map -> purge SIBLING subs, which
+        # deletes (donated) arrays already captured for an earlier type.
+        # After this loop the captures below cannot trigger a flush.
+        for ntype, pairs in group(owned).items():
+            sub = self._sub(ntype)
+            if not sub.pending_keys.isdisjoint(pairs):
+                sub.flush()
+        if self._presence is not None and not \
+                self._presence.pending_keys.isdisjoint(owned):
+            self._presence.flush()
+        owned = [k for k in owned if k in self.fields]  # flush may evict
+        if not owned:
+            return dict
+        parts = []
+        for ntype, pairs in group(owned).items():
+            parts.append((pairs,
+                          self._sub(ntype).read_many_begin(pairs, read_vc)))
+        pres = (self._presence.read_many_begin(owned, read_vc)
+                if self._presence is not None else None)
+
+        def run():
+            states: Dict[Any, dict] = {k: {} for k in owned}
+            for pairs, cl in parts:
+                got = cl()
+                for k, kt in pairs:
+                    ns = got.get((k, kt))
+                    if ns is None:
+                        continue
+                    if pres is None and ns == _BOTTOM[kt[1]]:
+                        continue      # map_rr: bottom => invisible
+                    states[k][kt] = ns
+            if pres is not None:
+                vis = pres()
+                for k in owned:
+                    v = vis.get(k, frozenset())
+                    states[k] = {kt: ns for kt, ns in states[k].items()
+                                 if kt in v}
+            return states
+
+        return run
+
+    def read_begin(self, key, read_vc: Optional[VC]):
+        cl = self.read_many_begin([key], read_vc)
+        if key not in self.fields:
+            # evicted during the begin-flush — host/log path, exactly
+            # the flat planes' contract (_PlaneBase.read_begin)
+            raise ReadBelowBase()
+        return lambda: cl()[key]
+
+    def read(self, key, read_vc: Optional[VC]):
+        """Map host state ({(field, nested_type): nested_state}) at
+        ``read_vc``."""
+        return self.read_begin(key, read_vc)()
+
+    def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
+        return self.read_many_begin(keys, read_vc)()
+
+
 class DevicePlane:
     """Per-partition facade over the type planes; all calls run under
     the owning PartitionManager's lock (one-writer discipline, like the
@@ -1303,30 +1439,26 @@ class DevicePlane:
             gc_ops = config.device_gc_ops
             max_dcs = config.device_max_dcs
             max_slots = config.device_max_slots
-        self.planes: Dict[str, _PlaneBase] = {
-            "set_aw": OrsetPlane(ClockDomain(8), key_capacity, n_lanes,
-                                 n_slots, flush_ops, gc_ops, max_dcs,
-                                 max_slots),
-            "counter_pn": CounterPlane(ClockDomain(8), key_capacity,
-                                       n_lanes, flush_ops, gc_ops,
-                                       max_dcs),
-            "register_mv": MvregPlane(ClockDomain(8), key_capacity,
-                                      n_lanes, n_slots, flush_ops,
-                                      gc_ops, max_dcs, max_slots),
-            "register_lww": LwwPlane(ClockDomain(8), key_capacity,
-                                     n_lanes, flush_ops, gc_ops,
-                                     max_dcs),
-            "flag_ew": FlagEwPlane(ClockDomain(8), key_capacity,
-                                   n_lanes, flush_ops, gc_ops, max_dcs),
-            "set_rw": RwsetPlane(ClockDomain(8), key_capacity, n_lanes,
-                                 n_slots, flush_ops, gc_ops, max_dcs,
-                                 max_slots),
-            "flag_dw": FlagDwPlane(ClockDomain(8), key_capacity,
-                                   n_lanes, flush_ops, gc_ops, max_dcs),
-            "set_go": SetGoPlane(ClockDomain(8), key_capacity, n_lanes,
-                                 n_slots, flush_ops, gc_ops, max_dcs,
-                                 max_slots),
-        }
+        slotted = {"set_aw": OrsetPlane, "register_mv": MvregPlane,
+                   "set_rw": RwsetPlane, "set_go": SetGoPlane}
+        flat = {"counter_pn": CounterPlane, "register_lww": LwwPlane,
+                "flag_ew": FlagEwPlane, "flag_dw": FlagDwPlane}
+
+        def make(tn: str):
+            """Fresh plane instance for a type (top level, or a map's
+            private sub-plane)."""
+            if tn in slotted:
+                return slotted[tn](ClockDomain(8), key_capacity, n_lanes,
+                                   n_slots, flush_ops, gc_ops, max_dcs,
+                                   max_slots)
+            return flat[tn](ClockDomain(8), key_capacity, n_lanes,
+                            flush_ops, gc_ops, max_dcs)
+
+        self.planes: Dict[str, Any] = {
+            tn: make(tn) for tn in (*slotted, *flat)}
+        self.planes["map_go"] = MapPlane(
+            "map_go", make, make_presence=lambda: make("set_go"))
+        self.planes["map_rr"] = MapPlane("map_rr", make)
         #: keys evicted to the host path (sticky)
         self.host_only: set = set()
         #: types whose dense representation collapses dot sets per DC —
@@ -1337,9 +1469,11 @@ class DevicePlane:
         #: the exact per-dot state a reset's downstream generation
         #: needs (a lossy observed list would under-cancel at exact
         #: replicas — a value divergence, not just a representation
-        #: one).  Maps are host-served pending field-composite routing.
+        #: one).  Maps count as dot-collapsing because their nested
+        #: entries may (conservative for an all-counter map_go).
         self.dot_collapse_types = frozenset(
-            {"set_aw", "register_mv", "flag_ew", "set_rw", "flag_dw"})
+            {"set_aw", "register_mv", "flag_ew", "set_rw", "flag_dw",
+             "map_go", "map_rr"})
 
     def set_evict_handler(self, fn: Callable[[Any, str], None]) -> None:
         def handler(key, type_name):

@@ -111,6 +111,35 @@ class StreamGen:
         elif type_name == "set_go":
             n = self.rng.randint(1, 3)
             eff = tuple(self.rng.choice(self.elems) for _ in range(n))
+        elif type_name in ("map_go", "map_rr"):
+            # nested effects via the real CRDT downstream so dots come
+            # out as (dc, ct) like every other generator arm
+            from antidote_tpu.crdt import DownstreamCtx
+
+            st = st if isinstance(st, dict) else {}
+            ctx = DownstreamCtx(dc, seq=ct - 1)
+            if type_name == "map_go":
+                fields = [("hits", "counter_pn"), ("tags", "set_aw"),
+                          ("on", "flag_ew")]
+            else:
+                fields = [("tags", "set_aw"), ("who", "register_mv"),
+                          ("on", "flag_dw")]
+            r = self.rng.random()
+            if type_name == "map_rr" and st and r < 0.15:
+                kt = self.rng.choice(sorted(st.keys()))
+                eff = cls.downstream(("remove", kt), st, ctx)
+            else:
+                f = self.rng.choice(fields)
+                if f[1] == "counter_pn":
+                    nop = ("increment", self.rng.randint(1, 4))
+                elif f[1] == "set_aw":
+                    nop = (self.rng.choice(["add", "remove"]),
+                           self.rng.choice(self.elems))
+                elif f[1] == "register_mv":
+                    nop = ("assign", self.rng.choice(self.elems))
+                else:  # flags
+                    nop = (self.rng.choice(["enable", "disable"]), ())
+                eff = cls.downstream(("update", (f, nop)), st, ctx)
         else:
             raise AssertionError(type_name)
         p = Payload(key=key, type_name=type_name, effect=eff,
@@ -118,11 +147,12 @@ class StreamGen:
                     txid=f"tx{ct}")
         # apply to every DC view (causal delivery simulated as immediate)
         stateful = ("set_aw", "set_rw", "set_go", "register_mv",
-                    "flag_ew", "flag_dw")
+                    "flag_ew", "flag_dw", "map_go", "map_rr")
         for d in self.dcs:
             if type_name in stateful:
                 base = self.state[d][key]
-                if type_name not in ("set_aw", "set_rw") and not \
+                dict_state = ("set_aw", "set_rw", "map_go", "map_rr")
+                if type_name not in dict_state and not \
                         isinstance(base, (frozenset, tuple)):
                     base = cls.new()
                 self.state[d][key] = cls.update(eff, base)
@@ -146,7 +176,7 @@ def publish(pm, p, stable):
 
 @pytest.mark.parametrize("type_name", [
     "counter_pn", "set_aw", "register_mv", "register_lww", "flag_ew",
-    "set_rw", "flag_dw", "set_go"])
+    "set_rw", "flag_dw", "set_go", "map_go", "map_rr"])
 def test_stream_oracle_equivalence(tmp_path, type_name):
     """Random stream through the real publish path: device reads ==
     host-store reads at the latest snapshot and at historical ones."""
@@ -454,3 +484,88 @@ def test_lww_value_directory_compacts(tmp_path):
         got = cls.value(pm.value_snapshot(f"k{(n - 3 + k) % 3}",
                                           "register_lww"))
         assert got == want
+
+
+def _commit_map(api, key, map_type, op_name, arg):
+    from antidote_tpu.api import AntidoteTPU  # noqa: F401 (doc anchor)
+    return api.update_objects_static(
+        None, [((key, map_type, "b"), op_name, arg)])
+
+
+def test_map_planes_through_api(tmp_path):
+    """Maps ride the device path end-to-end: nested counter/set/flag
+    updates, map_rr remove, exact-snapshot invisibility before a
+    field's creation."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.txn.node import Node
+
+    api = AntidoteTPU(node=Node(dc_id="dc1", config=Config(
+        n_partitions=1, data_dir=str(tmp_path / "m"))))
+    pm = api.node.partitions[0]
+
+    _commit_map(api, "m", "map_go", "update",
+                [(("hits", "counter_pn"), ("increment", 3)),
+                 (("tags", "set_aw"), ("add", "x"))])
+    ct0 = _commit_map(api, "m", "map_go", "update",
+                      (("hits", "counter_pn"), ("increment", 2)))
+    [v], _ = api.read_objects_static(None, [("m", "map_go", "b")])
+    assert v == {("hits", "counter_pn"): 5, ("tags", "set_aw"): ["x"]}
+    assert pm.device.planes["map_go"].owns("m")
+
+    _commit_map(api, "r", "map_rr", "update",
+                [(("tags", "set_aw"), ("add_all", ["a", "b"])),
+                 (("on", "flag_ew"), ("enable", ()))])
+    _commit_map(api, "r", "map_rr", "remove", ("tags", "set_aw"))
+    [v], _ = api.read_objects_static(None, [("r", "map_rr", "b")])
+    assert v == {("on", "flag_ew"): True}
+    assert pm.device.planes["map_rr"].owns("r")
+
+    # exact-snapshot read below a field's creation: invisible
+    _commit_map(api, "m2", "map_go", "update",
+                (("n", "counter_pn"), ("increment", 1)))
+    assert pm.value_snapshot("m2", "map_go", ct0) == {}
+
+
+def test_map_nested_unsupported_evicts_to_host(tmp_path):
+    """A nested type without a device plane (a map-in-map here) evicts
+    the whole map key to the host path; values stay exact via log
+    replay."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.txn.node import Node
+
+    api = AntidoteTPU(node=Node(dc_id="dc1", config=Config(
+        n_partitions=1, data_dir=str(tmp_path / "n"))))
+    pm = api.node.partitions[0]
+    _commit_map(api, "deep", "map_go", "update",
+                (("inner", "map_go"),
+                 ("update", (("c", "counter_pn"), ("increment", 7)))))
+    [v], _ = api.read_objects_static(None, [("deep", "map_go", "b")])
+    assert v == {("inner", "map_go"): {("c", "counter_pn"): 7}}
+    assert not pm.device.planes["map_go"].owns("deep")
+    assert "deep" in pm.device.host_only
+
+
+def test_map_field_capacity_eviction(tmp_path):
+    """More distinct fields than the element-slot cap: the map evicts
+    (presence/sub-plane slot overflow) and every field survives on the
+    host path."""
+    pm = make_pm(tmp_path, "cap", device=True, n_slots=2, max_slots=4,
+                 flush_ops=2)
+    from antidote_tpu.crdt import DownstreamCtx, get_type as gt
+
+    cls = gt("map_go")
+    state = {}
+    for i in range(8):  # > max_slots distinct counter fields
+        ct = 101 + i
+        ctx = DownstreamCtx("dc1", seq=ct - 1)
+        eff = cls.downstream(
+            ("update", ((f"f{i}", "counter_pn"), ("increment", 1))),
+            state, ctx)
+        state = cls.update(eff, state)
+        p = Payload(key="k", type_name="map_go", effect=eff,
+                    commit_dc="dc1", commit_time=ct,
+                    snapshot_vc=VC({"dc1": ct - 1}), txid=f"t{i}")
+        publish(pm, p, None)
+    assert "k" in pm.device.host_only
+    got = pm.value_snapshot("k", "map_go")
+    assert got == state

@@ -258,20 +258,32 @@ def test_rwset_remove_wins_over_concurrent_add():
 
 
 def test_rwset_reset_clears_both_planes():
-    """A reset cancels every observed dot on both planes; a concurrent
-    (unobserved) add survives it."""
+    """A reset cancels every observed dot on both planes *at each
+    element's own slot* (RwsetPlane.stage emits one reset row per
+    element); a concurrent (unobserved) add survives it, and a later
+    add with nothing left to cancel proves the rmv plane really was
+    cleared (were the rmv dot still live, remove-wins would suppress
+    it)."""
     st = store.rwset_shard_init(4, L, 2, D, dtype=jnp.int64)
     z = np.zeros(D)
     st = _rw_append(st, 0, 0, 0, (0, 1), z, z, 0, 1, [0, 0, 0, 0])
     st = _rw_append(st, 0, 1, 1, (1, 1), z, z, 1, 1, [0, 0, 0, 0])
-    # reset by dc2 at ct 1: observed the add (0,1) and rmv (1,1);
-    # concurrent add (0,2) is NOT observed
+    # concurrent with the reset: add (0,2) at slot 0, NOT observed by it
     st = _rw_append(st, 0, 0, 0, (0, 2), z, z, 0, 2, [1, 0, 0, 0])
-    st = _rw_append(st, 0, 0, 2, (0, 0), [1, 0, 0, 0], [0, 1, 0, 0],
+    # reset by dc2 at ct 1 observed slot 0's add (0,1) and slot 1's rmv
+    # (1,1): one reset row per element at that element's slot
+    st = _rw_append(st, 0, 0, 2, (0, 0), [1, 0, 0, 0], z,
+                    2, 1, [1, 1, 0, 0])
+    st = _rw_append(st, 0, 1, 2, (0, 0), z, [0, 1, 0, 0],
                     2, 1, [1, 1, 0, 0])
     p = _rw_present(st, [2, 1, 1, 0])
     assert p[0, 0]          # the unobserved concurrent add survives
-    assert not p[0, 1]      # slot 1's rmv dot was reset away, no adds
+    assert not p[0, 1]      # no adds at slot 1 yet
+    # a fresh add at slot 1 that observed NOTHING becomes visible IFF
+    # the reset really cleared slot 1's rmv dot (remove-wins otherwise)
+    st = _rw_append(st, 0, 1, 0, (0, 3), z, z, 0, 3, [2, 1, 1, 0])
+    p = _rw_present(st, [3, 1, 1, 0])
+    assert p[0, 1]
 
 
 def test_setgo_store_gc_and_snapshots():
