@@ -158,12 +158,28 @@ class PartitionManager:
         per-DC dot collapse cannot represent — dot-bearing types from
         such commits stay on the host path (evicting the key's device
         history first if it has any)."""
-        fr = self.key_frontier.get(key) or VC()
         # join the FULL commit VC (snapshot deps included): covers_all
         # must imply the read's inclusion mask admits this op, and the
         # mask tests the whole commit VC, not just the commit entry
-        self.key_frontier[key] = fr.join(payload.commit_vc())
-        self._val_cache.pop(key, None)
+        fr_old = self.key_frontier.get(key)
+        fr_new = (fr_old or VC()).join(payload.commit_vc())
+        self.key_frontier[key] = fr_new
+        # keep the commit-frontier value cache WARM instead of popping
+        # it: apply the committed effect to the cached state (the
+        # reference materializer applies updates onto its cached
+        # snapshot rather than rematerializing, src/materializer_vnode
+        # .erl:620-647).  Sound because effects commute and _publish
+        # serializes per key under the lock; identity of the stored
+        # frontier object is what readers re-check.
+        ent = self._val_cache.get(key)
+        if ent is not None and ent[0] is fr_old:
+            try:
+                self._val_cache[key] = (fr_new, materialize_eager(
+                    type_name, ent[1], [payload.effect]))
+            except Exception:
+                self._val_cache.pop(key, None)
+        else:
+            self._val_cache.pop(key, None)
         if self.device is not None:
             unsound = (not payload.certified
                        and type_name in self.device.dot_collapse_types)

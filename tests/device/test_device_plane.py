@@ -199,6 +199,12 @@ def test_stream_oracle_equivalence(tmp_path, type_name):
     read_vcs = [None, gen.snapshot()] + snapshots[-3:]
     for rv in read_vcs:
         for key in gen.keys:
+            # drop the commit-frontier value cache so every compare
+            # exercises the actual device fold vs the host materializer
+            # (the warm cache would otherwise answer rv=None reads on
+            # both sides with eagerly-applied host CRDT states)
+            pm_dev._val_cache.clear()
+            pm_host._val_cache.clear()
             v_dev = pm_dev.value_snapshot(key, type_name, rv)
             v_host = pm_host.value_snapshot(key, type_name, rv)
             assert cls.value(v_dev) == cls.value(v_host), (
@@ -569,3 +575,22 @@ def test_map_field_capacity_eviction(tmp_path):
     assert "k" in pm.device.host_only
     got = pm.value_snapshot("k", "map_go")
     assert got == state
+
+
+def test_warm_value_cache_matches_cold_fold(tmp_path):
+    """_publish applies committed effects onto the cached state instead
+    of invalidating it (the reference materializer's
+    update-onto-cached-snapshot, src/materializer_vnode.erl:620-647);
+    the warm entry must equal a cold device fold after every commit."""
+    gen = StreamGen(seed=21)
+    pm = make_pm(tmp_path, "warm", device=True, flush_ops=4)
+    for i in range(120):
+        p = gen.next_op("set_aw")
+        publish(pm, p, None)
+        if i == 10:
+            pm.value_snapshot("k0", "set_aw")  # populate the cache
+        if i % 17 == 0 and i > 10:
+            warm = pm.value_snapshot("k0", "set_aw")
+            pm._val_cache.clear()
+            cold = pm.value_snapshot("k0", "set_aw")
+            assert warm == cold, f"step {i}"
