@@ -117,6 +117,9 @@ class _PlaneBase:
         self._has_base = False
         #: newest stable snapshot seen (GC horizon for overflow retries)
         self._last_stable: Optional[VC] = None
+        #: cached device-resident "read latest" snapshot (one device_put
+        #: per domain width instead of one per read)
+        self._inf_rv = None
         #: set by the owning PartitionManager: evict a key's history to
         #: the host store (log replay)
         self.on_evict: Callable[[Any, str], None] = lambda k, t: None
@@ -243,7 +246,7 @@ class _PlaneBase:
         st = self.st
         return self._reader(st, idx, rv)
 
-    def _reader(self, st, idx: int, rv: np.ndarray):
+    def _reader(self, st, idx: int, rv):
         """Subclass hook: closure materializing key ``idx`` of the
         captured state at dense snapshot ``rv``."""
         raise NotImplementedError
@@ -267,7 +270,7 @@ class _PlaneBase:
         return self._many_reader(self.st, owned, idxs, pad, rv)
 
     def _many_reader(self, st, owned: list, idxs: np.ndarray,
-                     pad: np.ndarray, rv: np.ndarray):
+                     pad: np.ndarray, rv):
         """Subclass hook: closure materializing the owned keys in one
         batched fold of the captured state (``pad`` = idxs padded to
         the dispatch bucket)."""
@@ -365,11 +368,17 @@ class _PlaneBase:
         self._has_base = True
         self._ops_since_gc = 0
 
-    def _read_vc_dense(self, read_vc: Optional[VC]) -> np.ndarray:
-        """Dense read snapshot; raises ReadBelowBase when the requested
-        snapshot does not dominate the device base (caller replays log)."""
+    def _read_vc_dense(self, read_vc: Optional[VC]):
+        """Dense read snapshot (np for explicit VCs, the cached device
+        array for read-latest — treat as immutable); raises
+        ReadBelowBase when the requested snapshot does not dominate the
+        device base (caller replays log)."""
         if read_vc is None:
-            return np.full(self.domain.d, _VC_INF, dtype=np.int64)
+            if self._inf_rv is None or \
+                    self._inf_rv.shape[0] != self.domain.d:
+                self._inf_rv = jnp.full((self.domain.d,), _VC_INF,
+                                        dtype=jnp.int64)
+            return self._inf_rv
         if self._has_base and not self._base_vc.le(read_vc):
             raise ReadBelowBase()
         pairs = self._ss_pairs(read_vc)

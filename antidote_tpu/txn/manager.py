@@ -38,6 +38,33 @@ class CertificationError(Exception):
 #: stable-horizon sampling throttle (seconds); see PartitionManager
 _STABLE_REFRESH_S = 0.05
 
+#: warm-apply guard: CRDT update copies containers, so a large cached
+#: state would pay O(|state|) per commit under the partition lock —
+#: beyond this size the entry retires and reads pay the device fold
+#: instead (the cheaper side of the trade flips)
+_WARM_STATE_MAX = 512
+
+_CONTAINERS = (dict, tuple, list, set, frozenset)
+
+
+def _approx_size(state, budget: int) -> int:
+    """Element count including nested containers (a map field wrapping
+    a huge set must count as huge), early-exiting once past ``budget``
+    so the guard itself stays O(budget), not O(|state|)."""
+    if not isinstance(state, _CONTAINERS):
+        return 1
+    total = len(state)
+    for v in (state.values() if isinstance(state, dict) else state):
+        if total > budget:
+            break
+        if isinstance(v, _CONTAINERS):
+            total += _approx_size(v, budget - total)
+    return total
+
+
+def _warm_cheap(state) -> bool:
+    return _approx_size(state, _WARM_STATE_MAX) <= _WARM_STATE_MAX
+
 
 class PartitionManager:
     def __init__(self, partition: int, dc_id, log: PartitionLog,
@@ -83,8 +110,14 @@ class PartitionManager:
         #: only to reads that dominate the key's whole frontier, and a
         #: new arrival moves the frontier, so staleness is impossible.
         self.key_frontier: Dict[Any, VC] = {}
-        self._val_cache: Dict[Any, Tuple[VC, Any]] = {}
+        #: key -> [frontier, state, writes_since_read]: _publish applies
+        #: committed effects onto the cached state (warm cache) until
+        #: ``_warm_writes_cap`` commits pass with no read — then the
+        #: entry retires, so write-only keys don't pay a host CRDT
+        #: materialization per commit forever
+        self._val_cache: Dict[Any, list] = {}
         self._val_cache_cap = 65536
+        self._warm_writes_cap = 32
         #: device reads in flight outside the lock (see read()): the
         #: append/gc kernels DONATE their input buffers, so a device
         #: mutation while a reader still holds the captured shard state
@@ -172,13 +205,18 @@ class PartitionManager:
         # serializes per key under the lock; identity of the stored
         # frontier object is what readers re-check.
         ent = self._val_cache.get(key)
-        if ent is not None and ent[0] is fr_old:
+        if ent is not None and ent[0] is fr_old \
+                and ent[2] < self._warm_writes_cap \
+                and _warm_cheap(ent[1]):
             try:
-                self._val_cache[key] = (fr_new, materialize_eager(
-                    type_name, ent[1], [payload.effect]))
+                self._val_cache[key] = [fr_new, materialize_eager(
+                    type_name, ent[1], [payload.effect]), ent[2] + 1]
             except Exception:
                 self._val_cache.pop(key, None)
         else:
+            # entry cold (stale frontier) or write-only hot (nobody has
+            # read it for _warm_writes_cap commits): retire it instead
+            # of paying a host materialization per commit forever
             self._val_cache.pop(key, None)
         if self.device is not None:
             unsound = (not payload.certified
@@ -326,6 +364,7 @@ class PartitionManager:
                 if covers_all:
                     ent = self._val_cache.get(key)
                     if ent is not None and ent[0] is fr:
+                        ent[2] = 0
                         return ent[1]
                 plane = self.device.planes[type_name]
                 if key in plane.pending_keys:
@@ -357,7 +396,7 @@ class PartitionManager:
                 if self.key_frontier.get(key) is fr:
                     if len(self._val_cache) >= self._val_cache_cap:
                         self._val_cache.clear()
-                    self._val_cache[key] = (fr, value)
+                    self._val_cache[key] = [fr, value, 0]
         return value
 
     def _read_store(self, key, type_name: str, read_vc: Optional[VC],
@@ -373,6 +412,7 @@ class PartitionManager:
             # frontier identity (not just dominance) guarantees no op
             # arrived since the entry was materialized
             if ent is not None and ent[0] is fr:
+                ent[2] = 0
                 return ent[1]
         if self.device is not None and self.device.owns(type_name, key):
             try:
@@ -384,7 +424,7 @@ class PartitionManager:
         if covers_all:
             if len(self._val_cache) >= self._val_cache_cap:
                 self._val_cache.clear()
-            self._val_cache[key] = (fr, value)
+            self._val_cache[key] = [fr, value, 0]
         return value
 
     def _read_from_log(self, key, type_name: str, read_vc: Optional[VC],
@@ -435,6 +475,7 @@ class PartitionManager:
                 if covers:
                     ent = self._val_cache.get(key)
                     if ent is not None and ent[0] is fr:
+                        ent[2] = 0
                         out[(key, type_name)] = ent[1]
                         continue
                 if self.device is not None and self.device.owns(
@@ -497,7 +538,7 @@ class PartitionManager:
                     for key, fr, value in cacheable:
                         if len(self._val_cache) >= self._val_cache_cap:
                             self._val_cache.clear()
-                        self._val_cache[key] = (fr, value)
+                        self._val_cache[key] = [fr, value, 0]
         finally:
             # an escaping exception must not leak the not-yet-drained
             # batches' reader counts: a leak would wedge
