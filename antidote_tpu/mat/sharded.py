@@ -11,9 +11,10 @@ not a gossip of Erlang dicts).
 Design (per "How to Scale Your Model" recipe: pick a mesh, annotate
 shardings, let XLA insert collectives):
 
-- **State**: one global :class:`~antidote_tpu.mat.store.OrsetShardState`
-  whose [K, ...] / [K*L, ...] arrays carry ``PartitionSpec("part")`` —
-  contiguous key ranges per chip, the ring made literal.
+- **State**: one global shard state (e.g.
+  :class:`~antidote_tpu.mat.store.OrsetShardState`) whose [K, ...] /
+  [K*L, ...] arrays carry ``PartitionSpec("part")`` — contiguous key
+  ranges per chip, the ring made literal.
 - **Append**: the committed batch is replicated to every chip; each chip
   masks to its own key range and scatters locally (``shard_map``).  No
   all-to-all: for B ≪ K the duplicated decode is cheaper than routing,
@@ -26,6 +27,11 @@ shardings, let XLA insert collectives):
 - **Point reads**: each chip folds its own keys, foreign keys produce
   zeros, and a ``psum`` assembles the replicated result.
 
+The recipe is type-agnostic: :class:`_ShardedBase` owns the mesh
+bookkeeping, state sharding, and the collective GC (every shard state
+exposes the same op_ss/op_dc/op_ct/valid2d/base_vc/has_base surface);
+subclasses contribute only their store's append/read calls.
+
 Exercised on the virtual 8-device CPU mesh by
 tests/device/test_sharded_store.py and by the driver's
 ``dryrun_multichip``.
@@ -33,6 +39,7 @@ tests/device/test_sharded_store.py and by the driver's
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -41,18 +48,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from antidote_tpu.clocks import dense
 from antidote_tpu.mat import store
-from antidote_tpu.mat.store import OrsetShardState
 
 
-class ShardedOrsetStore:
-    """An OR-Set store whose key space is partitioned over a mesh.
+class _ShardedBase:
+    """Mesh bookkeeping + sharded state + collective GC, shared by the
+    per-type stores.  ``n_keys`` must divide evenly by the mesh size;
+    keys ``[i*K/n, (i+1)*K/n)`` live on chip i (contiguous ranges keep
+    the ops rows aligned to shard boundaries: row = key*L + lane)."""
 
-    ``n_keys`` must divide evenly by the mesh size; keys
-    ``[i*K/n, (i+1)*K/n)`` live on chip i (contiguous ranges keep the
-    ops rows aligned to shard boundaries: row = key*L + lane)."""
+    #: the single-device store's GC fold for this state type
+    _gc_fn = None
+    #: names of state fields partitioned over the key axis (everything
+    #: else — clock rows, scalars — replicates).  Explicit per class:
+    #: a shape heuristic would misroute e.g. a [D] base_vc whenever
+    #: n_dcs coincides with n_keys.
+    _key_fields: frozenset = frozenset()
 
-    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
-                 n_slots: int, n_dcs: int, dtype=jnp.int64):
+    def __init__(self, mesh: Mesh, n_keys: int, st):
         assert "part" in mesh.axis_names
         self.mesh = mesh
         self.n_shards = mesh.shape["part"]
@@ -62,25 +74,30 @@ class ShardedOrsetStore:
         self.keys_per_shard = n_keys // self.n_shards
         self.key_sh = NamedSharding(mesh, P("part"))
         self.rep = NamedSharding(mesh, P())
-        st = store.orset_shard_init(n_keys, n_lanes, n_slots, n_dcs,
-                                    dtype=dtype)
-        self.st = OrsetShardState(
-            dots=jax.device_put(st.dots, self.key_sh),
-            base_vc=jax.device_put(st.base_vc, self.rep),
-            has_base=jax.device_put(st.has_base, self.rep),
-            ops=jax.device_put(st.ops, self.key_sh),
-            valid=jax.device_put(st.valid, self.key_sh),
-            n_lanes=st.n_lanes,
-        )
+        self.st = self._shard_state(st)
         self._jits = {}
 
     # ------------------------------------------------------------ specs
 
+    def _field_spec(self, name: str):
+        return P("part") if name in self._key_fields else P()
+
+    def _shard_state(self, st):
+        data = {
+            f.name: jax.device_put(
+                getattr(st, f.name),
+                NamedSharding(self.mesh, self._field_spec(f.name)))
+            for f in dataclasses.fields(st) if f.name != "n_lanes"
+        }
+        return type(st)(**data, n_lanes=st.n_lanes)
+
     @property
     def _state_spec(self):
-        return OrsetShardState(
-            dots=P("part"), base_vc=P(), has_base=P(), ops=P("part"),
-            valid=P("part"), n_lanes=self.st.n_lanes)
+        data = {
+            f.name: self._field_spec(f.name)
+            for f in dataclasses.fields(self.st) if f.name != "n_lanes"
+        }
+        return type(self.st)(**data, n_lanes=self.st.n_lanes)
 
     def _sm(self, fn, in_specs, out_specs, donate: bool = False):
         key = fn.__name__
@@ -99,37 +116,13 @@ class ShardedOrsetStore:
         return tuple(
             jax.device_put(jnp.asarray(a), self.rep) for a in arrays)
 
-    # ----------------------------------------------------------- append
-
-    def append(self, key_idx, lane_off, elem_slot, is_add, dot_dc,
-               dot_seq, obs_vv, op_dc, op_ct, op_ss) -> jax.Array:
-        """Scatter a committed batch (GLOBAL key indices); returns
-        bool[B] overflow (a key's owning shard ran out of ring lanes)."""
+    def _local_mask(self, key_idx):
+        """(local_idx, mine) for a replicated batch of GLOBAL keys in a
+        shard_map body."""
         kps = self.keys_per_shard
-
-        def local_append(st, key_idx, lane_off, elem_slot, is_add,
-                         dot_dc, dot_seq, obs_vv, op_dc, op_ct, op_ss):
-            shard = jax.lax.axis_index("part")
-            lo = shard.astype(key_idx.dtype) * kps
-            local = key_idx - lo
-            mine = (local >= 0) & (local < kps)
-            st, overflow = store.orset_append(
-                st, jnp.where(mine, local, kps), lane_off, elem_slot,
-                is_add, dot_dc, dot_seq, obs_vv, op_dc, op_ct, op_ss,
-                active=mine)
-            # orset_append's active-mask contract keeps foreign lanes'
-            # overflow False, so a max-reduce assembles the global view
-            return st, jax.lax.pmax(overflow, "part")
-
-        fn = self._sm(
-            local_append,
-            in_specs=(self._state_spec,) + (P(),) * 10,
-            out_specs=(self._state_spec, P()), donate=True)
-        self.st, overflow = fn(
-            self.st, *self._rep_put(key_idx, lane_off, elem_slot,
-                                    is_add, dot_dc, dot_seq, obs_vv,
-                                    op_dc, op_ct, op_ss))
-        return overflow
+        shard = jax.lax.axis_index("part")
+        local = key_idx - shard.astype(key_idx.dtype) * kps
+        return local, (local >= 0) & (local < kps)
 
     # ------------------------------------------------------- stable fold
 
@@ -149,6 +142,7 @@ class ShardedOrsetStore:
         DEVICE over the mesh (ICI), exactly the
         stable_time_functions:min_merge duty (reference
         src/stable_time_functions.erl:39-85)."""
+        gc = type(self)._gc_fn
         if local_frontiers is None:
             def local_gc(st):
                 cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
@@ -158,7 +152,7 @@ class ShardedOrsetStore:
                 base = jnp.where(st.has_base, st.base_vc, 0)
                 frontier = jnp.maximum(frontier, base)
                 gst = jax.lax.pmin(frontier, "part")
-                return store.orset_gc(st, gst), gst
+                return gc(st, gst), gst
 
             fn = self._sm(local_gc, in_specs=(self._state_spec,),
                           out_specs=(self._state_spec, P()),
@@ -168,13 +162,54 @@ class ShardedOrsetStore:
 
         def local_gc_given(st, fr):
             gst = jax.lax.pmin(fr[jax.lax.axis_index("part")], "part")
-            return store.orset_gc(st, gst), gst
+            return gc(st, gst), gst
 
         fn = self._sm(local_gc_given,
                       in_specs=(self._state_spec, P()),
                       out_specs=(self._state_spec, P()), donate=True)
         self.st, gst = fn(self.st, *self._rep_put(local_frontiers))
         return gst
+
+
+class ShardedOrsetStore(_ShardedBase):
+    """An OR-Set store whose key space is partitioned over a mesh."""
+
+    _gc_fn = staticmethod(store.orset_gc)
+    _key_fields = frozenset({"dots", "ops", "valid"})
+
+    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
+                 n_slots: int, n_dcs: int, dtype=jnp.int32):
+        super().__init__(mesh, n_keys, store.orset_shard_init(
+            n_keys, n_lanes, n_slots, n_dcs, dtype=dtype))
+
+    # ----------------------------------------------------------- append
+
+    def append(self, key_idx, lane_off, elem_slot, is_add, dot_dc,
+               dot_seq, obs_vv, op_dc, op_ct, op_ss) -> jax.Array:
+        """Scatter a committed batch (GLOBAL key indices); returns
+        bool[B] overflow (a key's owning shard ran out of ring lanes)."""
+        base = self
+
+        def local_append(st, key_idx, lane_off, elem_slot, is_add,
+                         dot_dc, dot_seq, obs_vv, op_dc, op_ct, op_ss):
+            local, mine = base._local_mask(key_idx)
+            st, overflow = store.orset_append(
+                st, jnp.where(mine, local, base.keys_per_shard),
+                lane_off, elem_slot, is_add, dot_dc, dot_seq, obs_vv,
+                op_dc, op_ct, op_ss, active=mine)
+            # orset_append's active-mask contract keeps foreign lanes'
+            # overflow False, so a max-reduce assembles the global view
+            return st, jax.lax.pmax(overflow, "part")
+
+        fn = self._sm(
+            local_append,
+            in_specs=(self._state_spec,) + (P(),) * 10,
+            out_specs=(self._state_spec, P()), donate=True)
+        self.st, overflow = fn(
+            self.st, *self._rep_put(key_idx, lane_off, elem_slot,
+                                    is_add, dot_dc, dot_seq, obs_vv,
+                                    op_dc, op_ct, op_ss))
+        return overflow
 
     # ------------------------------------------------------------- reads
 
@@ -193,20 +228,86 @@ class ShardedOrsetStore:
         """int[B, E, D] folded dot tables for GLOBAL key indices,
         replicated to every chip (foreign shards contribute zeros; a
         psum assembles the answer)."""
-        kps = self.keys_per_shard
+        base = self
         key_idx, rv = self._rep_put(key_idx, read_vc)
 
         def local_read_keys(st, key_idx, rv):
-            shard = jax.lax.axis_index("part")
-            lo = shard.astype(key_idx.dtype) * kps
-            local = key_idx - lo
-            mine = (local >= 0) & (local < kps)
+            local, mine = base._local_mask(key_idx)
             dots = store.orset_read_keys(
                 st, jnp.where(mine, local, 0), rv)
             dots = jnp.where(mine[:, None, None], dots, 0)
             return jax.lax.psum(dots, "part")
 
         fn = self._sm(local_read_keys,
+                      in_specs=(self._state_spec, P(), P()),
+                      out_specs=P())
+        return fn(self.st, key_idx, rv)
+
+
+class ShardedCounterStore(_ShardedBase):
+    """The counter shard over the same mesh ring — the shared recipe
+    (ranges over ``part``, replicated batches masked to the owning
+    chip, GST fold as cross-shard ``pmin``) with counter store calls."""
+
+    _gc_fn = staticmethod(store.counter_gc)
+    _key_fields = frozenset({"value", "ops", "valid"})
+
+    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
+                 n_dcs: int, dtype=jnp.int32):
+        super().__init__(mesh, n_keys, store.counter_shard_init(
+            n_keys, n_lanes, n_dcs, dtype=dtype))
+
+    def append(self, key_idx, lane_off, delta, op_dc, op_ct,
+               op_ss) -> jax.Array:
+        """Scatter a committed delta batch (GLOBAL key indices)."""
+        base = self
+
+        def local_cnt_append(st, key_idx, lane_off, delta, op_dc,
+                             op_ct, op_ss):
+            local, mine = base._local_mask(key_idx)
+            # counter_append has no active mask; foreign rows are
+            # dropped by forcing lane >= L (the drop-slot route).  Key
+            # kps alone would be OUT of range for the local state —
+            # only the forced overflow lane makes the row a no-op.
+            st, overflow = store.counter_append(
+                st, jnp.where(mine, local, base.keys_per_shard),
+                jnp.where(mine, lane_off, st.n_lanes), delta, op_dc,
+                op_ct, op_ss)
+            return st, jax.lax.pmax(overflow & mine, "part")
+
+        fn = self._sm(
+            local_cnt_append,
+            in_specs=(self._state_spec,) + (P(),) * 6,
+            out_specs=(self._state_spec, P()), donate=True)
+        self.st, overflow = fn(
+            self.st, *self._rep_put(key_idx, lane_off, delta, op_dc,
+                                    op_ct, op_ss))
+        return overflow
+
+    def read(self, read_vc) -> jax.Array:
+        """int[K] counter values at ``read_vc`` (sharded by key)."""
+        (rv,) = self._rep_put(read_vc)
+
+        def local_cnt_read(st, rv):
+            return store.counter_read(st, rv)
+
+        fn = self._sm(local_cnt_read, in_specs=(self._state_spec, P()),
+                      out_specs=P("part"))
+        return fn(self.st, rv)
+
+    def read_keys(self, key_idx, read_vc) -> jax.Array:
+        """int[B] values for GLOBAL key indices, replicated (foreign
+        shards contribute zeros; psum assembles)."""
+        base = self
+        key_idx, rv = self._rep_put(key_idx, read_vc)
+
+        def local_cnt_read_keys(st, key_idx, rv):
+            local, mine = base._local_mask(key_idx)
+            vals = store.counter_read_keys(
+                st, jnp.where(mine, local, 0), rv)
+            return jax.lax.psum(jnp.where(mine, vals, 0), "part")
+
+        fn = self._sm(local_cnt_read_keys,
                       in_specs=(self._state_spec, P(), P()),
                       out_specs=P())
         return fn(self.st, key_idx, rv)

@@ -627,3 +627,39 @@ def test_warm_cache_retires_write_only_keys(tmp_path):
     assert "k0" not in pm._val_cache  # retired at the cap
     assert pm.value_snapshot("k0", "counter_pn") == total
     assert "k0" in pm._val_cache      # read re-populated it
+
+
+def test_node_recovery_new_types_route_to_device(tmp_path):
+    """Restart recovery rebuilds set_rw / flag_dw / set_go / map device
+    state from the log through the same _publish path the live system
+    uses, and the device plane (not the host store) serves it."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(n_partitions=2, data_dir=str(tmp_path / "n2"))
+    api = AntidoteTPU(node=Node(dc_id="dc1", config=cfg))
+    api.update_objects_static(None, [
+        (("team", "set_rw", "b"), "add_all", ["a", "b"]),
+        (("gate", "flag_dw", "b"), "enable", ()),
+        (("log", "set_go", "b"), "add_all", ["x", "y"])])
+    api.update_objects_static(None, [
+        (("team", "set_rw", "b"), "remove", "b"),
+        (("m", "map_rr", "b"), "update",
+         [(("tags", "set_aw"), ("add", "t1")),
+          (("on", "flag_ew"), ("enable", ()))])])
+    ct = api.update_objects_static(None, [
+        (("m", "map_rr", "b"), "remove", ("on", "flag_ew"))])
+    api.close()
+
+    api2 = AntidoteTPU(node=Node(dc_id="dc1", config=cfg))
+    vals, _ = api2.read_objects_static(ct, [
+        ("team", "set_rw", "b"), ("gate", "flag_dw", "b"),
+        ("log", "set_go", "b"), ("m", "map_rr", "b")])
+    assert vals[0] == ["a"]
+    assert vals[1] is True
+    assert vals[2] == ["x", "y"]
+    assert vals[3] == {("tags", "set_aw"): ["t1"]}
+    for key, tn in [("team", "set_rw"), ("gate", "flag_dw"),
+                    ("log", "set_go"), ("m", "map_rr")]:
+        assert api2.node.partition_of(key).device.owns(tn, key), (key, tn)
+    api2.close()
