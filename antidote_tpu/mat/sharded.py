@@ -63,6 +63,14 @@ class _ShardedBase:
     #: a shape heuristic would misroute e.g. a [D] base_vc whenever
     #: n_dcs coincides with n_keys.
     _key_fields: frozenset = frozenset()
+    #: the store's full-shard read (st, rv) -> key-sharded array
+    _read_fn = None
+    #: the store's point read (st, key_idx, rv) -> single [B, ...] array
+    #: (tuple-returning reads like lww's need a bespoke override)
+    _read_keys_fn = None
+    #: the store's append; must accept ``active=`` (the this-chip's-keys
+    #: filter: masked-off rows scatter nowhere and report no overflow)
+    _append_store_fn = None
 
     def __init__(self, mesh: Mesh, n_keys: int, st):
         assert "part" in mesh.axis_names
@@ -170,11 +178,73 @@ class _ShardedBase:
         self.st, gst = fn(self.st, *self._rep_put(local_frontiers))
         return gst
 
+    # ----------------------------------------------------------- append
+
+    def append(self, key_idx, lane_off, *payload) -> jax.Array:
+        """Scatter a committed batch (GLOBAL key indices + the store's
+        per-op payload columns); returns bool[B] overflow (a key's
+        owning shard ran out of ring lanes)."""
+        base = self
+        ap = type(self)._append_store_fn
+
+        def local_append(st, key_idx, lane_off, *payload):
+            local, mine = base._local_mask(key_idx)
+            st, overflow = ap(
+                st, jnp.where(mine, local, base.keys_per_shard),
+                lane_off, *payload, active=mine)
+            # the active-mask contract keeps foreign lanes' overflow
+            # False, so a max-reduce assembles the global view
+            return st, jax.lax.pmax(overflow, "part")
+
+        fn = self._sm(
+            local_append,
+            in_specs=(self._state_spec,) + (P(),) * (2 + len(payload)),
+            out_specs=(self._state_spec, P()), donate=True)
+        self.st, overflow = fn(
+            self.st, *self._rep_put(key_idx, lane_off, *payload))
+        return overflow
+
+    # ------------------------------------------------------------- reads
+
+    def read(self, read_vc) -> jax.Array:
+        """Full-shard materialization at ``read_vc`` (sharded by key)."""
+        (rv,) = self._rep_put(read_vc)
+        read = type(self)._read_fn
+
+        def local_read(st, rv):
+            return read(st, rv)
+
+        fn = self._sm(local_read, in_specs=(self._state_spec, P()),
+                      out_specs=P("part"))
+        return fn(self.st, rv)
+
+    def read_keys(self, key_idx, read_vc) -> jax.Array:
+        """Point reads for GLOBAL key indices, replicated to every chip
+        (foreign shards contribute zeros; a psum assembles the
+        answer — the mask broadcast adapts to the result rank)."""
+        base = self
+        read_keys = type(self)._read_keys_fn
+        key_idx, rv = self._rep_put(key_idx, read_vc)
+
+        def local_read_keys(st, key_idx, rv):
+            local, mine = base._local_mask(key_idx)
+            out = read_keys(st, jnp.where(mine, local, 0), rv)
+            m = mine.reshape(mine.shape + (1,) * (out.ndim - 1))
+            return jax.lax.psum(jnp.where(m, out, 0), "part")
+
+        fn = self._sm(local_read_keys,
+                      in_specs=(self._state_spec, P(), P()),
+                      out_specs=P())
+        return fn(self.st, key_idx, rv)
+
 
 class ShardedOrsetStore(_ShardedBase):
     """An OR-Set store whose key space is partitioned over a mesh."""
 
     _gc_fn = staticmethod(store.orset_gc)
+    _read_fn = staticmethod(store.orset_read)
+    _read_keys_fn = staticmethod(store.orset_read_keys)
+    _append_store_fn = staticmethod(store.orset_append)
     _key_fields = frozenset({"dots", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
@@ -182,66 +252,7 @@ class ShardedOrsetStore(_ShardedBase):
         super().__init__(mesh, n_keys, store.orset_shard_init(
             n_keys, n_lanes, n_slots, n_dcs, dtype=dtype))
 
-    # ----------------------------------------------------------- append
 
-    def append(self, key_idx, lane_off, elem_slot, is_add, dot_dc,
-               dot_seq, obs_vv, op_dc, op_ct, op_ss) -> jax.Array:
-        """Scatter a committed batch (GLOBAL key indices); returns
-        bool[B] overflow (a key's owning shard ran out of ring lanes)."""
-        base = self
-
-        def local_append(st, key_idx, lane_off, elem_slot, is_add,
-                         dot_dc, dot_seq, obs_vv, op_dc, op_ct, op_ss):
-            local, mine = base._local_mask(key_idx)
-            st, overflow = store.orset_append(
-                st, jnp.where(mine, local, base.keys_per_shard),
-                lane_off, elem_slot, is_add, dot_dc, dot_seq, obs_vv,
-                op_dc, op_ct, op_ss, active=mine)
-            # orset_append's active-mask contract keeps foreign lanes'
-            # overflow False, so a max-reduce assembles the global view
-            return st, jax.lax.pmax(overflow, "part")
-
-        fn = self._sm(
-            local_append,
-            in_specs=(self._state_spec,) + (P(),) * 10,
-            out_specs=(self._state_spec, P()), donate=True)
-        self.st, overflow = fn(
-            self.st, *self._rep_put(key_idx, lane_off, elem_slot,
-                                    is_add, dot_dc, dot_seq, obs_vv,
-                                    op_dc, op_ct, op_ss))
-        return overflow
-
-    # ------------------------------------------------------------- reads
-
-    def read(self, read_vc) -> jax.Array:
-        """bool[K, E] presence at ``read_vc`` (output sharded by key)."""
-        (rv,) = self._rep_put(read_vc)
-
-        def local_read(st, rv):
-            return store.orset_read(st, rv)
-
-        fn = self._sm(local_read, in_specs=(self._state_spec, P()),
-                      out_specs=P("part"))
-        return fn(self.st, rv)
-
-    def read_keys(self, key_idx, read_vc) -> jax.Array:
-        """int[B, E, D] folded dot tables for GLOBAL key indices,
-        replicated to every chip (foreign shards contribute zeros; a
-        psum assembles the answer)."""
-        base = self
-        key_idx, rv = self._rep_put(key_idx, read_vc)
-
-        def local_read_keys(st, key_idx, rv):
-            local, mine = base._local_mask(key_idx)
-            dots = store.orset_read_keys(
-                st, jnp.where(mine, local, 0), rv)
-            dots = jnp.where(mine[:, None, None], dots, 0)
-            return jax.lax.psum(dots, "part")
-
-        fn = self._sm(local_read_keys,
-                      in_specs=(self._state_spec, P(), P()),
-                      out_specs=P())
-        return fn(self.st, key_idx, rv)
 
 
 class ShardedCounterStore(_ShardedBase):
@@ -250,6 +261,9 @@ class ShardedCounterStore(_ShardedBase):
     chip, GST fold as cross-shard ``pmin``) with counter store calls."""
 
     _gc_fn = staticmethod(store.counter_gc)
+    _read_fn = staticmethod(store.counter_read)
+    _read_keys_fn = staticmethod(store.counter_read_keys)
+    _append_store_fn = staticmethod(store.counter_append)
     _key_fields = frozenset({"value", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
@@ -257,57 +271,4 @@ class ShardedCounterStore(_ShardedBase):
         super().__init__(mesh, n_keys, store.counter_shard_init(
             n_keys, n_lanes, n_dcs, dtype=dtype))
 
-    def append(self, key_idx, lane_off, delta, op_dc, op_ct,
-               op_ss) -> jax.Array:
-        """Scatter a committed delta batch (GLOBAL key indices)."""
-        base = self
 
-        def local_cnt_append(st, key_idx, lane_off, delta, op_dc,
-                             op_ct, op_ss):
-            local, mine = base._local_mask(key_idx)
-            # counter_append has no active mask; foreign rows are
-            # dropped by forcing lane >= L (the drop-slot route).  Key
-            # kps alone would be OUT of range for the local state —
-            # only the forced overflow lane makes the row a no-op.
-            st, overflow = store.counter_append(
-                st, jnp.where(mine, local, base.keys_per_shard),
-                jnp.where(mine, lane_off, st.n_lanes), delta, op_dc,
-                op_ct, op_ss)
-            return st, jax.lax.pmax(overflow & mine, "part")
-
-        fn = self._sm(
-            local_cnt_append,
-            in_specs=(self._state_spec,) + (P(),) * 6,
-            out_specs=(self._state_spec, P()), donate=True)
-        self.st, overflow = fn(
-            self.st, *self._rep_put(key_idx, lane_off, delta, op_dc,
-                                    op_ct, op_ss))
-        return overflow
-
-    def read(self, read_vc) -> jax.Array:
-        """int[K] counter values at ``read_vc`` (sharded by key)."""
-        (rv,) = self._rep_put(read_vc)
-
-        def local_cnt_read(st, rv):
-            return store.counter_read(st, rv)
-
-        fn = self._sm(local_cnt_read, in_specs=(self._state_spec, P()),
-                      out_specs=P("part"))
-        return fn(self.st, rv)
-
-    def read_keys(self, key_idx, read_vc) -> jax.Array:
-        """int[B] values for GLOBAL key indices, replicated (foreign
-        shards contribute zeros; psum assembles)."""
-        base = self
-        key_idx, rv = self._rep_put(key_idx, read_vc)
-
-        def local_cnt_read_keys(st, key_idx, rv):
-            local, mine = base._local_mask(key_idx)
-            vals = store.counter_read_keys(
-                st, jnp.where(mine, local, 0), rv)
-            return jax.lax.psum(jnp.where(mine, vals, 0), "part")
-
-        fn = self._sm(local_cnt_read_keys,
-                      in_specs=(self._state_spec, P(), P()),
-                      out_specs=P())
-        return fn(self.st, key_idx, rv)

@@ -91,6 +91,24 @@ def _free_lanes(valid2d: jax.Array, key_idx: jax.Array,
     return lane.astype(jnp.int32), lane >= L
 
 
+def _scatter_rows(st, key_idx: jax.Array, lane_off: jax.Array,
+                  rows: jax.Array, active: jax.Array | None = None):
+    """Shared append epilogue: place each packed row in its key's next
+    free ring lane and mark it live.  ``active`` (bool[B], optional)
+    drops masked-off ops entirely — no scatter, no overflow — the
+    sharded stores' this-chip's-keys filter.  Returns (state,
+    overflow[B]); overflowed ops are NOT stored."""
+    L = st.n_lanes
+    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
+    if active is not None:
+        overflow = overflow & active
+    drop = (lane >= L) if active is None else ((lane >= L) | ~active)
+    flat = jnp.where(drop, st.ops.shape[0], key_idx * L + lane)
+    ops = st.ops.at[flat].set(rows, mode="drop")
+    valid = st.valid.at[flat].set(True, mode="drop")
+    return replace(st, ops=ops, valid=valid), overflow
+
+
 @dataclass
 class OrsetShardState:
     """Device arrays for one OR-Set shard (a pytree).
@@ -199,20 +217,12 @@ def orset_append(
     scatter, no overflow) — the sharded store's this-chip's-keys filter
     (antidote_tpu/mat/sharded.py)."""
     dt = st.ops.dtype
-    L = st.n_lanes
-    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
-    if active is not None:
-        overflow = overflow & active
     col = lambda a: a.astype(dt)[:, None]
     rows = jnp.concatenate([
         col(elem_slot), col(is_add), col(dot_dc), col(dot_seq),
         col(op_dc), col(op_ct), obs_vv.astype(dt), op_ss.astype(dt),
     ], axis=1)                                          # [B, 6+2D]
-    drop = (lane >= L) if active is None else ((lane >= L) | ~active)
-    flat = jnp.where(drop, st.ops.shape[0], key_idx * L + lane)
-    ops = st.ops.at[flat].set(rows, mode="drop")
-    valid = st.valid.at[flat].set(True, mode="drop")
-    return replace(st, ops=ops, valid=valid), overflow
+    return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -513,18 +523,13 @@ def lww_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
 
 @partial(jax.jit, donate_argnums=(0,))
 def lww_append(st: LwwShardState, key_idx, lane_off, ts, tie, val,
-               op_dc, op_ct, op_ss):
+               op_dc, op_ct, op_ss, active: jax.Array | None = None):
     dt = st.ops.dtype
-    L = st.n_lanes
-    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
     col = lambda a: a.astype(dt)[:, None]
     rows = jnp.concatenate(
         [col(ts), col(tie), col(val), col(op_dc), col(op_ct),
          op_ss.astype(dt)], axis=1)
-    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
-    ops = st.ops.at[flat].set(rows, mode="drop")
-    valid = st.valid.at[flat].set(True, mode="drop")
-    return replace(st, ops=ops, valid=valid), overflow
+    return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -762,20 +767,16 @@ def rwset_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
 
 @partial(jax.jit, donate_argnums=(0,))
 def rwset_append(st: RwsetShardState, key_idx, lane_off, elem_slot, kind,
-                 dot_dc, dot_seq, obs_add, obs_rmv, op_dc, op_ct, op_ss):
+                 dot_dc, dot_seq, obs_add, obs_rmv, op_dc, op_ct, op_ss,
+                 active: jax.Array | None = None):
     dt = st.ops.dtype
-    L = st.n_lanes
-    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
     col = lambda a: a.astype(dt)[:, None]
     rows = jnp.concatenate([
         col(elem_slot), col(kind), col(dot_dc), col(dot_seq),
         col(op_dc), col(op_ct), obs_add.astype(dt), obs_rmv.astype(dt),
         op_ss.astype(dt),
     ], axis=1)
-    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
-    ops = st.ops.at[flat].set(rows, mode="drop")
-    valid = st.valid.at[flat].set(True, mode="drop")
-    return replace(st, ops=ops, valid=valid), overflow
+    return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -945,18 +946,13 @@ def setgo_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
 
 @partial(jax.jit, donate_argnums=(0,))
 def setgo_append(st: SetGoShardState, key_idx, lane_off, elem_slot,
-                 op_dc, op_ct, op_ss):
+                 op_dc, op_ct, op_ss, active: jax.Array | None = None):
     dt = st.ops.dtype
-    L = st.n_lanes
-    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
     col = lambda a: a.astype(dt)[:, None]
     rows = jnp.concatenate(
         [col(elem_slot), col(op_dc), col(op_ct), op_ss.astype(dt)],
         axis=1)
-    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
-    ops = st.ops.at[flat].set(rows, mode="drop")
-    valid = st.valid.at[flat].set(True, mode="drop")
-    return replace(st, ops=ops, valid=valid), overflow
+    return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -1099,17 +1095,16 @@ def counter_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
 
 @partial(jax.jit, donate_argnums=(0,))
 def counter_append(st: CounterShardState, key_idx, lane_off, delta,
-                   op_dc, op_ct, op_ss):
+                   op_dc, op_ct, op_ss,
+                   active: jax.Array | None = None):
+    """``active`` (bool[B], optional) drops masked-off ops entirely (no
+    scatter, no overflow) — the sharded store's this-chip's-keys filter
+    (same contract as orset_append)."""
     dt = st.ops.dtype
-    L = st.n_lanes
-    lane, overflow = _free_lanes(st.valid2d, key_idx, lane_off)
     col = lambda a: a.astype(dt)[:, None]
     rows = jnp.concatenate(
         [col(delta), col(op_dc), col(op_ct), op_ss.astype(dt)], axis=1)
-    flat = jnp.where(lane >= L, st.ops.shape[0], key_idx * L + lane)
-    ops = st.ops.at[flat].set(rows, mode="drop")
-    valid = st.valid.at[flat].set(True, mode="drop")
-    return replace(st, ops=ops, valid=valid), overflow
+    return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
 @partial(jax.jit, donate_argnums=(0,))

@@ -7,7 +7,8 @@ Regenerate ``antidote_pb2.py`` after editing ``antidote.proto``:
 ``protoc --python_out=. antidote.proto`` in this directory.
 """
 
-from antidote_tpu.pb.client import PbClient, PbError
+from antidote_tpu.pb.client import PbClient, PbError, PbServerError
 from antidote_tpu.pb.server import DEFAULT_PORT, PbServer
 
-__all__ = ["PbClient", "PbError", "PbServer", "DEFAULT_PORT"]
+__all__ = ["PbClient", "PbError", "PbServerError", "PbServer",
+           "DEFAULT_PORT"]

@@ -18,7 +18,14 @@ from antidote_tpu.pb import codec
 
 
 class PbError(Exception):
-    pass
+    """Any protocol-level failure (transport faults AND server-reported
+    errors — catch this to handle both)."""
+
+
+class PbServerError(PbError):
+    """The server processed the request and reported an error (e.g. a
+    write-write certification abort).  The connection stays usable —
+    unlike a transport-level :class:`PbError`, which marks it broken."""
 
 
 class PbClient:
@@ -61,13 +68,13 @@ class PbClient:
             self._broken = True
             raise PbError(f"transport failure: {e}") from e
         if isinstance(resp, pb.ApbErrorResp):
-            raise PbError(resp.message)
+            raise PbServerError(resp.message)
         return resp
 
     @staticmethod
     def _check(resp):
         if not resp.success:
-            raise PbError(resp.error)
+            raise PbServerError(resp.error)
         return resp
 
     # -------------------------------------------------------- transactions

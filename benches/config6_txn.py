@@ -35,6 +35,31 @@ def _percentiles(lat):
             round(float(np.percentile(a, 99)) * 1e3, 2))
 
 
+def _run_threads(worker, n_threads):
+    """Run workers concurrently; re-raise the first worker error after
+    join (a dead backend must fail the bench loudly, not report numbers
+    truncated to the surviving threads' samples).  Returns wall time."""
+    errs = []
+
+    def guarded(tid):
+        try:
+            worker(tid)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+
+    threads = [threading.Thread(target=guarded, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    return dt
+
+
 def run_direct(db, n_threads, txns_per_thread, K, seed=0):
     from antidote_tpu.clocks import VC
 
@@ -72,24 +97,18 @@ def run_direct(db, n_threads, txns_per_thread, K, seed=0):
         with lat_lock:
             lat.extend(my_lat)
 
-    threads = [threading.Thread(target=worker, args=(t,))
-               for t in range(n_threads)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    dt = time.perf_counter() - t0
+    dt = _run_threads(worker, n_threads)
     return len(lat) / dt, lat, aborts[0]
 
 
 def run_pb(db, n_threads, txns_per_thread, K, port, seed=100):
-    from antidote_tpu.pb.client import PbClient
+    from antidote_tpu.pb.client import PbClient, PbServerError
     from antidote_tpu.pb.server import PbServer
 
     server = PbServer(db, port=port).start()
     lat = []
     lat_lock = threading.Lock()
+    aborts = [0]
     try:
         def worker(tid):
             rng = np.random.default_rng(seed + tid)
@@ -100,29 +119,32 @@ def run_pb(db, n_threads, txns_per_thread, K, port, seed=100):
                              "bucket")
                     s_key = (f"s{rng.integers(0, K)}", "set_aw", "bucket")
                     t0 = time.perf_counter()
-                    if rng.random() < 0.8:
-                        cl.update_objects_static(
-                            None,
-                            [(c_key, "increment", 1),
-                             (s_key, "add",
-                              b"e%d" % int(rng.integers(8)))])
-                    else:
-                        cl.read_objects_static(None, [c_key, s_key])
+                    try:
+                        if rng.random() < 0.8:
+                            cl.update_objects_static(
+                                None,
+                                [(c_key, "increment", 1),
+                                 (s_key, "add",
+                                  b"e%d" % int(rng.integers(8)))])
+                        else:
+                            cl.read_objects_static(None, [c_key, s_key])
+                    except PbServerError:
+                        # server-reported certification abort: counted
+                        # like the direct variant's error rows.  A
+                        # transport-level PbError still propagates —
+                        # a dead server must fail the bench, not
+                        # produce silent garbage numbers.
+                        with lat_lock:
+                            aborts[0] += 1
+                        continue
                     my_lat.append(time.perf_counter() - t0)
             with lat_lock:
                 lat.extend(my_lat)
 
-        threads = [threading.Thread(target=worker, args=(t,))
-                   for t in range(n_threads)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        dt = time.perf_counter() - t0
+        dt = _run_threads(worker, n_threads)
     finally:
         server.stop()
-    return len(lat) / dt, lat
+    return len(lat) / dt, lat, aborts[0]
 
 
 def main():
@@ -143,8 +165,8 @@ def main():
         tput_1, _, _ = run_direct(db, 1, txns, K, seed=1)
         tput_n, lat, aborts = run_direct(db, n_threads, txns, K, seed=2)
         p50, p99 = _percentiles(lat)
-        pb_tput, pb_lat = run_pb(db, n_threads,
-                                 max(txns // 4, 50), K, port=18087)
+        pb_tput, pb_lat, pb_aborts = run_pb(
+            db, n_threads, max(txns // 4, 50), K, port=18087)
         pb50, pb99 = _percentiles(pb_lat)
         db.close()
     finally:
@@ -156,6 +178,8 @@ def main():
          p50_ms=p50, p99_ms=p99,
          single_thread_txn_per_sec=round(tput_1),
          pb_txn_per_sec=round(pb_tput), pb_p50_ms=pb50, pb_p99_ms=pb99,
+         pb_abort_rate=round(
+             pb_aborts / max(pb_aborts + len(pb_lat), 1), 4),
          abort_rate=round(aborts / max(aborts + len(lat), 1), 4),
          mix="80% update (1r+2w), 20% read (3r); pb variant static",
          note="vs_baseline = thread-scaling factor (8 clients vs 1)")
