@@ -253,8 +253,6 @@ class ShardedOrsetStore(_ShardedBase):
             n_keys, n_lanes, n_slots, n_dcs, dtype=dtype))
 
 
-
-
 class ShardedCounterStore(_ShardedBase):
     """The counter shard over the same mesh ring — the shared recipe
     (ranges over ``part``, replicated batches masked to the owning

@@ -183,3 +183,65 @@ class TestGapRepair:
         bus.set_link("dc1", "dc2", up=True)
         ct = update_counter(dc1, key, clock=ct)
         assert read_counter(dc2, key, ct) == 5
+
+
+class TestReplicatedNewTypes:
+    """Cross-DC semantics of the types that joined the device plane in
+    this round: remove-wins conflict resolution, disable-wins flags,
+    and recursive-reset maps, through the full replication stack."""
+
+    def test_rwset_concurrent_add_remove_remove_wins(self, cluster3):
+        dc1, dc2, dc3 = cluster3
+        key = ("rw_conflict", "set_rw", "b")
+        ct = dc1.update_objects_static(None, [(key, "add", "x")])
+        # make both DCs observe the same baseline, then write
+        # concurrently: dc1 re-adds (observing nothing new), dc2
+        # removes — remove must win at every replica
+        vals, _ = dc2.read_objects_static(ct, [key])
+        assert vals[0] == ["x"]
+        ct1 = dc1.update_objects_static(ct, [(key, "add", "x")])
+        ct2 = dc2.update_objects_static(ct, [(key, "remove", "x")])
+        merged = vc_max([ct1, ct2])
+        for dc in cluster3:
+            vals, _ = dc.read_objects_static(merged, [key])
+            assert vals[0] == [], f"{dc.dc_id}: {vals[0]}"
+        # a remove-observing re-add resurrects everywhere
+        ct3 = dc3.update_objects_static(merged, [(key, "add", "x")])
+        for dc in cluster3:
+            vals, _ = dc.read_objects_static(ct3, [key])
+            assert vals[0] == ["x"]
+
+    def test_flag_dw_concurrent_enable_disable(self, cluster3):
+        dc1, dc2, dc3 = cluster3
+        key = ("dw_conflict", "flag_dw", "b")
+        ct = dc1.update_objects_static(None, [(key, "enable", ())])
+        dc2.read_objects_static(ct, [key])
+        ct1 = dc1.update_objects_static(ct, [(key, "enable", ())])
+        ct2 = dc2.update_objects_static(ct, [(key, "disable", ())])
+        merged = vc_max([ct1, ct2])
+        for dc in cluster3:
+            vals, _ = dc.read_objects_static(merged, [key])
+            assert vals[0] is False, dc.dc_id  # disable wins
+
+    def test_map_rr_replicates_and_removes(self, cluster3):
+        dc1, dc2, dc3 = cluster3
+        key = ("rr_map", "map_rr", "b")
+        ct = dc1.update_objects_static(None, [
+            (key, "update", [(("tags", "set_aw"), ("add_all", ["a", "b"])),
+                             (("on", "flag_ew"), ("enable", ()))])])
+        vals, _ = dc2.read_objects_static(ct, [key])
+        assert vals[0] == {("tags", "set_aw"): ["a", "b"],
+                           ("on", "flag_ew"): True}
+        ct2 = dc2.update_objects_static(ct, [
+            (key, "remove", ("tags", "set_aw"))])
+        for dc in cluster3:
+            vals, _ = dc.read_objects_static(ct2, [key])
+            assert vals[0] == {("on", "flag_ew"): True}, dc.dc_id
+
+    def test_set_go_replicates(self, cluster3):
+        dc1, dc2, _ = cluster3
+        key = ("go_set", "set_go", "b")
+        ct = dc1.update_objects_static(None, [(key, "add_all", ["p", "q"])])
+        ct2 = dc2.update_objects_static(ct, [(key, "add", "r")])
+        vals, _ = dc1.read_objects_static(ct2, [key])
+        assert vals[0] == ["p", "q", "r"]
