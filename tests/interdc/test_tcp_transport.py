@@ -347,3 +347,36 @@ class TestNativeHub:
             sub.close()
         finally:
             bus.close()
+
+
+class TestTcpNewTypes:
+    """The round's new device-served types over the REAL socket
+    transport: effects cross DC boundaries through the safe term codec
+    (interdc/termcodec.py), not just the in-proc bus."""
+
+    def test_rwset_and_map_replicate_over_sockets(self, tcp_cluster2):
+        dc1, dc2 = tcp_cluster2
+        rk = ("trw", "set_rw", "b")
+        mk = ("tmap", "map_rr", "b")
+        ct = dc1.update_objects_static(None, [
+            (rk, "add_all", ["x", "y"]),
+            (mk, "update", [(("tags", "set_aw"), ("add", "t1")),
+                            (("on", "flag_ew"), ("enable", ()))])])
+        ct2 = dc2.update_objects_static(ct, [
+            (rk, "remove", "y"),
+            (mk, "remove", ("on", "flag_ew"))])
+        vals, _ = dc1.read_objects_static(ct2, [rk, mk])
+        assert vals[0] == ["x"]
+        assert vals[1] == {("tags", "set_aw"): ["t1"]}
+
+    def test_flag_dw_and_set_go_replicate_over_sockets(self, tcp_cluster2):
+        dc1, dc2 = tcp_cluster2
+        fk = ("tdw", "flag_dw", "b")
+        gk = ("tgo", "set_go", "b")
+        ct = dc1.update_objects_static(None, [(fk, "enable", ()),
+                                              (gk, "add", "p")])
+        ct2 = dc2.update_objects_static(ct, [(fk, "disable", ()),
+                                             (gk, "add", "q")])
+        vals, _ = dc1.read_objects_static(ct2, [fk, gk])
+        assert vals[0] is False
+        assert vals[1] == ["p", "q"]

@@ -212,7 +212,7 @@ class TestReplicatedNewTypes:
             assert vals[0] == ["x"]
 
     def test_flag_dw_concurrent_enable_disable(self, cluster3):
-        dc1, dc2, dc3 = cluster3
+        dc1, dc2, _ = cluster3
         key = ("dw_conflict", "flag_dw", "b")
         ct = dc1.update_objects_static(None, [(key, "enable", ())])
         dc2.read_objects_static(ct, [key])
@@ -224,7 +224,7 @@ class TestReplicatedNewTypes:
             assert vals[0] is False, dc.dc_id  # disable wins
 
     def test_map_rr_replicates_and_removes(self, cluster3):
-        dc1, dc2, dc3 = cluster3
+        dc1, dc2, _ = cluster3
         key = ("rr_map", "map_rr", "b")
         ct = dc1.update_objects_static(None, [
             (key, "update", [(("tags", "set_aw"), ("add_all", ["a", "b"])),
