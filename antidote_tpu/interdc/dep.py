@@ -80,13 +80,35 @@ class DependencyGate:
 
     def process_queues(self) -> None:
         """Drain every origin queue to fixpoint: applying a txn (or ping)
-        advances the clock, which may unblock other origins' heads."""
+        advances the clock, which may unblock other origins' heads.
+
+        A BLOCKED head still advances its origin's clock to
+        ``timestamp - 1`` (the reference's blocked-txn rule,
+        src/inter_dc_dep_vnode.erl:137-143): delivery is FIFO and
+        gap-repaired, so the origin's stream is complete below the
+        head's commit time, and another origin's head may depend on a
+        time up to it.  Without this, three DCs can cross-deadlock
+        after a partition window whose heartbeats were lost — each
+        head waiting on a clock entry only another blocked head's
+        stream can provide (caught by the multidc chaos test)."""
         self._last_proc_us = self.now_us()
-        if self.pending() >= self.batch_threshold:
-            advanced = self._process_batched()
-        else:
-            advanced = self._process_host()
-        if advanced:
+        advanced_any = False
+        while True:
+            if self.pending() >= self.batch_threshold:
+                advanced_any |= self._process_batched()
+            else:
+                advanced_any |= self._process_host()
+            head_advanced = False
+            for origin, q in self.queues.items():
+                if q and not q[0].is_ping() and \
+                        self.applied_vc.get_dc(origin) < \
+                        q[0].timestamp - 1:
+                    self._advance(origin, q[0].timestamp - 1)
+                    head_advanced = True
+            if not head_advanced:
+                break
+            advanced_any = True  # clock moved: rerun, it may unblock
+        if advanced_any:
             self.on_clock_update()
 
     def _process_host(self) -> bool:
@@ -176,12 +198,13 @@ class DependencyGate:
         from antidote_tpu import tracing
 
         with tracing.annotate("gate_fixpoint"):
-            applied, rounds, _new_pvc = gate_fixpoint(
+            applied, rounds, new_pvc = gate_fixpoint(
                 jnp.asarray(ss), jnp.asarray(origin_col),
                 jnp.asarray(pos_arr), jnp.asarray(ts), jnp.asarray(ping),
                 jnp.asarray(pvc))
         applied = np.asarray(applied)
         rounds = np.asarray(rounds)
+        new_pvc = np.asarray(new_pvc)
 
         # replay in (round, fifo pos) order: round-r txns depend only on
         # rounds < r, so this is a causal apply order (see gate_fixpoint)
@@ -199,6 +222,18 @@ class DependencyGate:
             else:
                 self._apply(txn)
             advanced = True
+        # fold the kernel's final clock back AFTER the replay (it
+        # includes the blocked-head ts-1 advances; advancing before the
+        # records hit the materializer would let a concurrent
+        # partition_vc() reader see a stable time covering unapplied
+        # txns).  Applied watermarks are already in via _apply, so only
+        # the ts-1 component is new; the own column carried `now`, not
+        # an applied watermark — skip it.
+        for dc, c in cols.items():
+            if dc != self.own_dc and int(new_pvc[c]) > \
+                    self.applied_vc.get_dc(dc):
+                self._advance(dc, int(new_pvc[c]))
+                advanced = True
         return advanced
 
     def _advance(self, origin, ts: int) -> None:
@@ -274,7 +309,17 @@ def gate_fixpoint(ss, origin, pos, ts, is_ping, pvc):
                 applied = ready & (pos < blocked_min[origin])
                 wm = jnp.zeros((d,), ts.dtype).at[origin].max(
                     jnp.where(applied, ts, 0), mode="drop")
-                return applied, jnp.maximum(pvc, wm)
+                # blocked-head rule (reference
+                # src/inter_dc_dep_vnode.erl:137-143): a head that
+                # cannot apply still advances its origin's clock to
+                # ts-1 — FIFO + gap repair mean the origin's stream is
+                # complete below it, and other origins' heads may
+                # depend on a time up to it.  Padding rows contribute
+                # ts-1 = -1, which the max-with-0 init discards.
+                head_blocked = (~ready) & (pos == blocked_min[origin])
+                hb = jnp.zeros((d,), ts.dtype).at[origin].max(
+                    jnp.where(head_blocked, ts - 1, 0), mode="drop")
+                return applied, jnp.maximum(pvc, jnp.maximum(wm, hb))
 
             def note_round(rounds, applied, r):
                 newly = applied & (rounds < 0)

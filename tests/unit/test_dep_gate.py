@@ -120,3 +120,41 @@ def test_pings_advance_clock_and_unblock():
     assert pm.applied == [("a", 150)]
     assert gate.applied_vc.get_dc("b") == 500
     assert gate.pending() == 0
+
+
+def test_blocked_head_advances_clock_breaks_cross_block():
+    """The reference's blocked-txn rule (src/inter_dc_dep_vnode.erl:
+    137-143): a head that cannot apply still advances its origin's
+    clock to ts-1 — without it, two origins whose heads each need a
+    time only the other's blocked stream can provide deadlock forever
+    (the 3-DC variant is the chaos test's partition-window race).
+    Exercised through BOTH gating paths via the batch threshold."""
+    from collections import deque
+
+    from antidote_tpu.clocks import VC
+    from antidote_tpu.interdc.dep import DependencyGate
+    from antidote_tpu.interdc.wire import InterDcTxn
+
+    def txn(origin, ts, deps):
+        return InterDcTxn(dc_id=origin, partition=0, prev_log_opid=0,
+                          snapshot_vc=VC(deps), timestamp=ts,
+                          records=[object()])
+
+    for threshold in (4, 100):  # device fixpoint / host head-walk
+        applied = []
+
+        class FakePM:
+            def apply_remote(self, records, dc, ts, ss):
+                applied.append((dc, ts))
+
+        g = DependencyGate(FakePM(), "dc0", lambda: 10 ** 9,
+                           batch_threshold=threshold)
+        g.queues["dcA"] = deque([txn("dcA", 61, {"dcB": 50}),
+                                 txn("dcA", 70, {"dcB": 50})])
+        g.queues["dcB"] = deque([txn("dcB", 55, {"dcA": 60}),
+                                 txn("dcB", 66, {"dcA": 60})])
+        g.process_queues()
+        assert len(applied) == 4, (threshold, applied)
+        assert g.applied_vc.get_dc("dcA") == 70
+        assert g.applied_vc.get_dc("dcB") == 66
+        assert not g.pending()
