@@ -23,10 +23,26 @@ def seed(db, n_keys=24):
         rk = (f"r{i}", "register_lww", "b")
         db.update_objects_static(None, [(ck, "increment", i + 1)])
         db.update_objects_static(None, [(sk, "add", b"x%d" % i)])
-        ct = db.update_objects_static(None, [(rk, "assign", f"v{i}")])
+        db.update_objects_static(None, [(rk, "assign", f"v{i}")])
         want[ck] = i + 1
         want[sk] = [b"x%d" % i]
         want[rk] = f"v{i}"
+    # one of each newer device-served type: their log records must
+    # survive the repartition fold and re-materialize exactly
+    wk = ("w", "set_rw", "b")
+    db.update_objects_static(None, [(wk, "add_all", ["p", "q"])])
+    db.update_objects_static(None, [(wk, "remove", "q")])
+    want[wk] = ["p"]
+    fk = ("f", "flag_dw", "b")
+    db.update_objects_static(None, [(fk, "enable", ())])
+    want[fk] = True
+    mk = ("m", "map_rr", "b")
+    db.update_objects_static(None, [
+        (mk, "update", [(("tags", "set_aw"), ("add", "t")),
+                        (("on", "flag_ew"), ("enable", ()))])])
+    ct = db.update_objects_static(None, [
+        (mk, "remove", ("on", "flag_ew"))])
+    want[mk] = {("tags", "set_aw"): ["t"]}
     return want, ct
 
 
