@@ -8,6 +8,8 @@ heal_cluster, test/utils/test_utils.erl:239-256).
 
 import time
 
+import pytest
+
 from antidote_tpu.config import Config
 from antidote_tpu.interdc.dc import DataCenter
 
@@ -118,18 +120,21 @@ def test_network_partition_and_heal(bus, tmp_path):
             dc.close()
 
 
-def test_chaos_all_types_converge(bus, tmp_path):
+@pytest.mark.parametrize("seed", [11, 23, 37])
+def test_chaos_all_types_converge(bus, tmp_path, seed):
     """Randomized workload over (almost) every CRDT type across 3 DCs
-    with a link flap and a mid-stream DC restart: all replicas converge
-    to identical values at the merged causal clock — dependency gating,
-    gap repair, recovery, and every materializer path exercised at
-    once.  (counter_b is excluded: its decrements legitimately abort on
-    rights, covered by its own suite.)"""
+    with a link flap, a lost-frames window (drop_rx), and a mid-stream
+    DC restart: all replicas converge to identical values at the merged
+    causal clock — dependency gating, gap repair, recovery, and every
+    materializer path exercised at once.  (counter_b is excluded: its
+    decrements legitimately abort on rights, covered by its own suite.)
+    This harness found the cross-origin dependency-gate deadlock the
+    blocked-head rule now fixes (interdc/dep.py)."""
     import random
 
     from antidote_tpu.clocks import vc_max
 
-    rng = random.Random(11)
+    rng = random.Random(seed)
     dcs = make_cluster(bus, tmp_path, 3)
     try:
         elems = ["a", "b", "c", "d"]
@@ -179,6 +184,13 @@ def test_chaos_all_types_converge(bus, tmp_path):
         burst(20, causal=False)
         bus.set_link("dc1", "dc2", True)   # heal: gap repair refetches
         burst(20)
+        # silently drop frames INBOUND to dc2 (lost messages without a
+        # link cut: the senders see nothing; only opid gap repair can
+        # recover the stream)
+        bus.set_drop_rx("dc2", True)
+        burst(15, causal=False)
+        bus.set_drop_rx("dc2", False)
+        burst(15)
         # hard restart dc3 from its data dir mid-workload
         dcs[2].close()
         dcs[2] = DataCenter(
