@@ -222,18 +222,30 @@ class PartitionManager:
             unsound = (not payload.certified
                        and type_name in self.device.dot_collapse_types)
             if not unsound and self.device.accepts(type_name, key):
-                # the plane owns the op from here — including the
-                # eviction path, where the key's whole history (this op
-                # included, it is already in the log) migrates to the
-                # host store
+                # _wait_device_quiesce WAITS ON THE CONDITION, releasing
+                # self._lock: another publisher can run a whole
+                # stage-overflow-EVICT cycle in the window, so the
+                # accepts() decision above may be stale when we resume.
+                # Staging anyway would re-register the evicted key with
+                # only this op's history — a silently diverging replica
+                # (caught by the concurrent-writers chaos test).
                 self._wait_device_quiesce()
-                self.device.stage(key, type_name, payload, stable)
+                if self.device.accepts(type_name, key):
+                    # the plane owns the op from here — including the
+                    # eviction path, where the key's whole history (this
+                    # op included, it is already in the log) migrates to
+                    # the host store
+                    self.device.stage(key, type_name, payload, stable)
+                # else: evicted while we waited — the migration replayed
+                # the log, which already holds this op (every caller
+                # appends before publishing), so nothing more to insert
                 return
             if unsound and self.device.owns(type_name, key):
                 # eviction migrates the full log history — which already
                 # contains this op — so nothing more to insert
                 self._wait_device_quiesce()
-                self.device.planes[type_name].evict(key)
+                if self.device.owns(type_name, key):  # see re-check above
+                    self.device.planes[type_name].evict(key)
                 return
         self.store.insert(key, type_name, payload, stable_vc=stable)
 

@@ -663,3 +663,58 @@ def test_node_recovery_new_types_route_to_device(tmp_path):
                     ("log", "set_go"), ("m", "map_rr")]:
         assert api2.node.partition_of(key).device.owns(tn, key), (key, tn)
     api2.close()
+
+
+def test_publish_recheck_after_quiesce_wait(tmp_path):
+    """_wait_device_quiesce releases the partition lock (condition
+    wait); an eviction can run in the window, so _publish must re-check
+    accepts() on resume instead of re-registering the evicted key with
+    only the new op's history (the concurrent-writers chaos race)."""
+    import threading
+
+    pm = make_pm(tmp_path, "qr", device=True, flush_ops=1)
+    key, tn = "k", "counter_pn"
+    # seed two committed ops so device owns the key
+    for i in range(2):
+        ss = VC({"dc1": 100 + i})
+        p = Payload(key=key, type_name=tn, effect=1, commit_dc="dc1",
+                    commit_time=101 + i, snapshot_vc=ss, txid=f"t{i}")
+        publish(pm, p, None)
+    assert pm.device.owns(tn, key)
+
+    # hold a fake in-flight device reader so the next publish waits
+    with pm._lock:
+        pm._dev_readers += 1
+
+    blocked_entered = threading.Event()
+
+    def publisher():
+        ss = VC({"dc1": 110})
+        p = Payload(key=key, type_name=tn, effect=1, commit_dc="dc1",
+                    commit_time=111, snapshot_vc=ss, txid="t9")
+        with pm._lock:
+            pm.log.append_update("dc1", "t9", key, tn, 1)
+            pm.log.append_commit("dc1", "t9", 111, ss)
+            blocked_entered.set()
+            pm._publish(key, tn, p, None)   # waits in quiesce
+
+    t = threading.Thread(target=publisher, daemon=True)
+    t.start()
+    blocked_entered.wait(5)
+    import time as _t
+    _t.sleep(0.1)  # let the publisher reach the condition wait
+
+    # evict the key while the publisher is parked in the wait window
+    with pm._lock:
+        pm.device.planes[tn].evict(key)
+        assert key in pm.device.host_only
+        # release the fake reader: the publisher resumes
+        pm._dev_readers -= 1
+        pm._lock.notify_all()
+    t.join(10)
+    assert not t.is_alive()
+
+    # the key must NOT have been re-registered on the plane, and the
+    # value must include every committed op exactly once
+    assert not pm.device.owns(tn, key)
+    assert pm.value_snapshot(key, tn) == 3

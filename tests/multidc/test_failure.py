@@ -120,6 +120,33 @@ def test_network_partition_and_heal(bus, tmp_path):
             dc.close()
 
 
+def _wait_converged(dcs, merged, objs, types, timeout=30.0):
+    """Poll until every replica reads identical values at ``merged``;
+    clock-wait timeouts keep polling (a replica may still be
+    gap-repairing), so only true divergence — reported per type —
+    fails."""
+    deadline = time.monotonic() + timeout
+    while True:
+        views = []
+        for dc in dcs:
+            try:
+                vals, _ = dc.read_objects_static(merged, objs)
+            except TimeoutError:
+                views = None
+                break
+            views.append(vals)
+        if views is not None and all(v == views[0] for v in views[1:]):
+            return views
+        assert time.monotonic() < deadline, (
+            "replicas did not converge: "
+            + ("a replica's clock wait kept timing out"
+               if views is None else
+               "; ".join(f"{t}: " + "/".join(repr(v[i]) for v in views)
+                         for i, t in enumerate(types)
+                         if any(v[i] != views[0][i] for v in views))))
+        time.sleep(0.05)
+
+
 @pytest.mark.parametrize("seed", [11, 23, 37])
 def test_chaos_all_types_converge(bus, tmp_path, seed):
     """Randomized workload over (almost) every CRDT type across 3 DCs
@@ -204,32 +231,97 @@ def test_chaos_all_types_converge(bus, tmp_path, seed):
 
         merged = vc_max([c for c in clocks if c is not None])
         objs = [(f"chaos_{t}", t, "bkt") for t in types]
-        deadline = time.monotonic() + 30.0
-        while True:
-            views = []
-            for dc in dcs:
-                try:
-                    vals, _ = dc.read_objects_static(merged, objs)
-                except TimeoutError:
-                    # a replica still gap-repairing / resubscribing can
-                    # miss one clock-wait window; keep polling until
-                    # the loop's own deadline so divergence (not
-                    # slowness) is what fails the test
-                    views = None
-                    break
-                views.append(vals)
-            if views is not None and views[0] == views[1] == views[2]:
-                break
-            assert time.monotonic() < deadline, (
-                "replicas did not converge: "
-                + ("a replica's clock wait kept timing out"
-                   if views is None else
-                   "; ".join(f"{t}: {v0!r}/{v1!r}/{v2!r}"
-                             for t, v0, v1, v2 in zip(
-                                 types, *views) if not v0 == v1 == v2)))
-            time.sleep(0.05)
+        views = _wait_converged(dcs, merged, objs, types)
         # sanity: the workload actually produced state everywhere
         assert any(v not in (0, [], {}, False, None) for v in views[0])
+    finally:
+        for dc in dcs:
+            dc.close()
+
+
+def test_chaos_concurrent_writers_converge(bus, tmp_path):
+    """Three writer THREADS (one per DC) run causal chains of mixed-type
+    updates while the main thread injects a link flap and a lost-frames
+    window; afterwards every replica converges at the merged clock.
+    Exercises the locking seams the sequential chaos cannot: concurrent
+    publish vs device flush/GC quiesce, warm-cache applies under the
+    partition lock, and gate processing against live appenders."""
+    import random
+    import threading
+
+    from antidote_tpu.clocks import vc_max
+
+    dcs = make_cluster(bus, tmp_path, 3)
+    try:
+        types = ["counter_pn", "set_aw", "set_rw", "flag_dw", "map_rr",
+                 "register_mv"]
+        elems = ["a", "b", "c"]
+        finals = [None, None, None]
+        errs = []
+
+        def writer(i):
+            rng = random.Random(100 + i)
+            dc = dcs[i]
+            ct = None
+            try:
+                for _ in range(240):
+                    t = rng.choice(types)
+                    key = (f"cc_{t}", t, "bkt")
+                    if t == "counter_pn":
+                        op = ("increment", 1)
+                    elif t in ("set_aw", "set_rw"):
+                        op = (rng.choice(["add", "remove"]),
+                              rng.choice(elems))
+                    elif t == "flag_dw":
+                        op = (rng.choice(["enable", "disable"]), ())
+                    elif t == "map_rr":
+                        op = ("update", ((("s", "set_aw"),
+                                          ("add", rng.choice(elems)))))
+                    else:
+                        op = ("assign", rng.choice(elems))
+                    try:
+                        ct = dc.update_objects_static(ct, [(key, *op)])
+                        # record every successful commit: the merged
+                        # convergence clock must cover this DC's tail
+                        # even if a LATER op times out
+                        finals[i] = ct
+                    except TimeoutError:
+                        # a causal floor straddling an injected fault
+                        # window blocks (correct Clock-SI); shed the
+                        # floor and continue like a reconnecting client
+                        ct = None
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=writer, args=(i,),
+                                    daemon=True)  # a wedged writer must
+                   for i in range(3)]             # not hang the process
+        for t in threads:
+            t.start()
+        # fault injection against the live writers; assert the windows
+        # actually overlapped live writes (otherwise the test passes
+        # vacuously on a fast machine)
+        time.sleep(0.3)
+        assert any(t.is_alive() for t in threads), \
+            "writers finished before fault injection began"
+        bus.set_link("dc1", "dc2", False)
+        time.sleep(0.4)
+        bus.set_link("dc1", "dc2", True)
+        time.sleep(0.2)
+        bus.set_drop_rx("dc3", True)
+        time.sleep(0.4)
+        overlapped = any(t.is_alive() for t in threads)
+        bus.set_drop_rx("dc3", False)
+        assert overlapped, \
+            "writers finished before the drop window ended"
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "writer wedged"
+        assert not errs, errs
+
+        merged = vc_max([c for c in finals if c is not None])
+        objs = [(f"cc_{t}", t, "bkt") for t in types]
+        _wait_converged(dcs, merged, objs, types)
     finally:
         for dc in dcs:
             dc.close()
