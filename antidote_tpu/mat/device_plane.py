@@ -757,7 +757,10 @@ class RwsetPlane(OrsetPlane):
     nonetheless: presence needs an empty remove plane, which requires a
     fresh add dot that the collapse always retains (see the kernel doc,
     mat/kernels.py rwset_apply).  Oracle tests therefore compare at
-    value level for this type."""
+    value level for this type.  Because of the collapse the type is in
+    DevicePlane.STATE_LOSSY: downstream generation never reads this
+    fold — require_state_downstream reads take an exact log replay
+    (PartitionManager.read(exact_state=True))."""
 
     type_name = "set_rw"
     # (slot, kind, dot_dc, dot_seq, obs_add, obs_rmv, op_dc, op_ct, op_ss)
@@ -1483,6 +1486,28 @@ class DevicePlane:
         self.dot_collapse_types = frozenset(
             {"set_aw", "register_mv", "flag_ew", "set_rw", "flag_dw",
              "map_go", "map_rr"})
+
+    #: types whose HOST state can hold several live dots per
+    #: (element, plane, DC) column — their update has no self-supersede
+    #: (crdt/sets.py SetRW.update does ``adds | {dot}``) — so the device
+    #: fold's per-column max-seq collapse is value-exact but NOT
+    #: state-exact.  An effect generated from the collapsed state lists
+    #: only the newest observed dot and under-cancels at exact replicas
+    #: (permanent divergence); set_aw / register_mv / flag_ew are immune
+    #: because their ops supersede every observed same-column dot.
+    STATE_LOSSY = frozenset({"set_rw", "flag_dw"})
+
+    def state_exact(self, type_name: str, key) -> bool:
+        """True iff the device fold reconstructs this key's EXACT host
+        state, safe to feed downstream generation
+        (require_state_downstream reads, reference call site
+        src/clocksi_downstream.erl:43-67).  Maps are exact iff no
+        device-resident field has a lossy nested type."""
+        if type_name in ("map_go", "map_rr"):
+            flds = self.planes[type_name].fields.get(key)
+            return flds is None or all(
+                kt[1] not in self.STATE_LOSSY for kt in flds)
+        return type_name not in self.STATE_LOSSY
 
     def set_evict_handler(self, fn: Callable[[Any, str], None]) -> None:
         def handler(key, type_name):

@@ -248,7 +248,10 @@ class ShardedOrsetStore(_ShardedBase):
     _key_fields = frozenset({"dots", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
-                 n_slots: int, n_dcs: int, dtype=jnp.int32):
+                 n_slots: int, n_dcs: int, dtype=jnp.int64):
+        # int64 default like the other public shard inits: op_ct/op_ss
+        # columns carry epoch-µs timestamps, which silently truncate in
+        # int32 (callers that bench int32 pass it explicitly)
         super().__init__(mesh, n_keys, store.orset_shard_init(
             n_keys, n_lanes, n_slots, n_dcs, dtype=dtype))
 
@@ -265,7 +268,7 @@ class ShardedCounterStore(_ShardedBase):
     _key_fields = frozenset({"value", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
-                 n_dcs: int, dtype=jnp.int32):
+                 n_dcs: int, dtype=jnp.int64):
         super().__init__(mesh, n_keys, store.counter_shard_init(
             n_keys, n_lanes, n_dcs, dtype=dtype))
 

@@ -185,7 +185,7 @@ jax.tree_util.register_dataclass(
 
 
 def orset_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
-                     dtype=jnp.int32) -> OrsetShardState:
+                     dtype=jnp.int64) -> OrsetShardState:
     K, L, E, D = n_keys, n_lanes, n_slots, n_dcs
     ops = jnp.zeros((K * L, _NSCAL + 2 * D), dtype=dtype)
     ops = ops.at[:, _ELEM].set(E)  # empty lanes route to the drop slot
@@ -1081,7 +1081,7 @@ jax.tree_util.register_dataclass(
 
 
 def counter_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
-                       dtype=jnp.int32) -> CounterShardState:
+                       dtype=jnp.int64) -> CounterShardState:
     K, L, D = n_keys, n_lanes, n_dcs
     return CounterShardState(
         value=jnp.zeros((K,), dtype=dtype),

@@ -238,9 +238,12 @@ class Coordinator:
             try:
                 state = None
                 if cls.require_state_downstream(op):
+                    # exact_state: an effect built from the device fold's
+                    # per-DC dot collapse would under-cancel at exact
+                    # replicas (set_rw/flag_dw) — see DevicePlane.state_exact
                     state = pm.read_with_writeset(
                         key2, cls.name, tx.snapshot_vc, tx.txid,
-                        tx.own_effects(key2))
+                        tx.own_effects(key2), exact_state=True)
                 effect = self.node.gen_downstream(
                     cls, op, state, tx.ctx, key=key2, bucket=bucket)
             except DownstreamError as e:

@@ -58,7 +58,8 @@ def _approx_size(state, budget: int) -> int:
         if total > budget:
             break
         if isinstance(v, _CONTAINERS):
-            total += _approx_size(v, budget - total)
+            # minus 1: the child already counted once in len(state)
+            total += _approx_size(v, budget - total) - 1
     return total
 
 
@@ -110,11 +111,15 @@ class PartitionManager:
         #: only to reads that dominate the key's whole frontier, and a
         #: new arrival moves the frontier, so staleness is impossible.
         self.key_frontier: Dict[Any, VC] = {}
-        #: key -> [frontier, state, writes_since_read]: _publish applies
-        #: committed effects onto the cached state (warm cache) until
-        #: ``_warm_writes_cap`` commits pass with no read — then the
-        #: entry retires, so write-only keys don't pay a host CRDT
-        #: materialization per commit forever
+        #: key -> [frontier, state, writes_since_read, exact]: _publish
+        #: applies committed effects onto the cached state (warm cache)
+        #: until ``_warm_writes_cap`` commits pass with no read — then
+        #: the entry retires, so write-only keys don't pay a host CRDT
+        #: materialization per commit forever.  ``exact`` records whether
+        #: the state's lineage is host-exact (host store / log replay /
+        #: state-exact device fold) — downstream-generation reads of
+        #: STATE_LOSSY device types may only use exact entries
+        #: (DevicePlane.state_exact)
         self._val_cache: Dict[Any, list] = {}
         self._val_cache_cap = 65536
         self._warm_writes_cap = 32
@@ -210,7 +215,8 @@ class PartitionManager:
                 and _warm_cheap(ent[1]):
             try:
                 self._val_cache[key] = [fr_new, materialize_eager(
-                    type_name, ent[1], [payload.effect]), ent[2] + 1]
+                    type_name, ent[1], [payload.effect]), ent[2] + 1,
+                    ent[3]]
             except Exception:
                 self._val_cache.pop(key, None)
         else:
@@ -259,7 +265,11 @@ class PartitionManager:
     def _migrate_key_to_host(self, key, type_name: str) -> None:
         """Device-plane eviction handler: rebuild the key's host-store
         entry from the durable log (runs under self._lock — the lock is
-        re-entrant)."""
+        re-entrant).  Drops the key's value-cache entry: a fold-derived
+        inexact state must not survive the move to the host path, where
+        the cache-hit checks no longer guard exactness (the host store
+        itself is exact by construction)."""
+        self._val_cache.pop(key, None)
         for _seq, p in self.log.committed_payloads(key=key):
             self.store.insert(key, type_name, p)
 
@@ -344,11 +354,18 @@ class PartitionManager:
         return False
 
     def read(self, key, type_name: str, snapshot_vc: Optional[VC],
-             txid=None) -> Any:
+             txid=None, exact_state: bool = False) -> Any:
         """Clock-SI safe read: wait until the local clock passed the
         snapshot and no conflicting prepared txn may commit below it
         (reference check_clock/check_prepared,
-        src/clocksi_readitem_server.erl:236-264), then materialize."""
+        src/clocksi_readitem_server.erl:236-264), then materialize.
+
+        ``exact_state``: the caller will feed the state to downstream
+        generation (require_state_downstream) — device folds of
+        STATE_LOSSY types (whose reconstruction collapses per-DC dot
+        sets) are refused and replaced by an exact log replay; an effect
+        built from a collapsed state would under-cancel at exact
+        replicas, diverging the federation permanently."""
         if snapshot_vc is not None:
             # clock wait happens outside the lock (it can be long and
             # must not stall commits on this partition)
@@ -363,6 +380,8 @@ class PartitionManager:
                         raise TimeoutError(
                             f"read of {key!r} blocked on prepared txn")
             if self.device is not None and self.device.owns(type_name, key):
+                fold_exact = self.device.state_exact(type_name, key)
+                need_exact = exact_state and not fold_exact
                 # the device fold runs OUTSIDE the lock on the captured
                 # immutable shard state (plane.read_begin) — the
                 # read-concurrency analogue of the reference's read
@@ -375,9 +394,16 @@ class PartitionManager:
                     snapshot_vc is None or fr.le(snapshot_vc))
                 if covers_all:
                     ent = self._val_cache.get(key)
-                    if ent is not None and ent[0] is fr:
+                    if ent is not None and ent[0] is fr \
+                            and (ent[3] or not need_exact):
                         ent[2] = 0
                         return ent[1]
+                if need_exact:
+                    value = self._read_from_log(key, type_name,
+                                                snapshot_vc, txid)
+                    if covers_all:
+                        self._cache_put(key, fr, value, True)
+                    return value
                 plane = self.device.planes[type_name]
                 if key in plane.pending_keys:
                     # read_begin will flush (donating buffers): drain
@@ -390,7 +416,8 @@ class PartitionManager:
                 else:
                     self._dev_readers += 1
             else:
-                value = self._read_store(key, type_name, snapshot_vc, txid)
+                value = self._read_store(key, type_name, snapshot_vc, txid,
+                                         exact_state=exact_state)
                 return value
         if reader is False:
             with self._lock:  # log scans serialize with appenders
@@ -406,13 +433,17 @@ class PartitionManager:
             with self._lock:
                 # re-check: a publish while we folded moved the frontier
                 if self.key_frontier.get(key) is fr:
-                    if len(self._val_cache) >= self._val_cache_cap:
-                        self._val_cache.clear()
-                    self._val_cache[key] = [fr, value, 0]
+                    self._cache_put(key, fr, value, fold_exact)
         return value
 
+    def _cache_put(self, key, fr, value, exact: bool) -> None:
+        """Store a value-cache entry (under self._lock)."""
+        if len(self._val_cache) >= self._val_cache_cap:
+            self._val_cache.clear()
+        self._val_cache[key] = [fr, value, 0, exact]
+
     def _read_store(self, key, type_name: str, read_vc: Optional[VC],
-                    txid=None) -> Any:
+                    txid=None, exact_state: bool = False) -> Any:
         """Materialized value from whichever plane owns the key; must run
         under self._lock.  Device keys read via the batched fold; reads
         below the device base (or with clocks outside its DC domain)
@@ -423,20 +454,23 @@ class PartitionManager:
             ent = self._val_cache.get(key)
             # frontier identity (not just dominance) guarantees no op
             # arrived since the entry was materialized
-            if ent is not None and ent[0] is fr:
+            if ent is not None and ent[0] is fr \
+                    and (ent[3] or not exact_state):
                 ent[2] = 0
                 return ent[1]
         if self.device is not None and self.device.owns(type_name, key):
+            exact = self.device.state_exact(type_name, key)
+            if exact_state and not exact:
+                return self._read_from_log(key, type_name, read_vc, txid)
             try:
                 value = self.device.read(key, type_name, read_vc)
             except ReadBelowBase:
                 return self._read_from_log(key, type_name, read_vc, txid)
         else:
+            exact = True
             value, _vc = self.store.read(key, type_name, read_vc, txid=txid)
         if covers_all:
-            if len(self._val_cache) >= self._val_cache_cap:
-                self._val_cache.clear()
-            self._val_cache[key] = [fr, value, 0]
+            self._cache_put(key, fr, value, exact)
         return value
 
     def _read_from_log(self, key, type_name: str, read_vc: Optional[VC],
@@ -448,11 +482,15 @@ class PartitionManager:
             txid).value
 
     def read_with_writeset(self, key, type_name: str, snapshot_vc,
-                           txid, own_effects: List[Any]) -> Any:
+                           txid, own_effects: List[Any],
+                           exact_state: bool = False) -> Any:
         """Read + replay the transaction's own uncommitted effects
         (read-your-writes, reference apply_tx_updates_to_snapshot,
-        src/clocksi_interactive_coord.erl:880-894)."""
-        value = self.read(key, type_name, snapshot_vc, txid=txid)
+        src/clocksi_interactive_coord.erl:880-894).  ``exact_state`` as
+        in :meth:`read`; the own-effect replay preserves exactness (it
+        runs the host oracle's update)."""
+        value = self.read(key, type_name, snapshot_vc, txid=txid,
+                          exact_state=exact_state)
         if own_effects:
             value = materialize_eager(type_name, value, own_effects)
         return value
@@ -493,7 +531,8 @@ class PartitionManager:
                 if self.device is not None and self.device.owns(
                         type_name, key):
                     by_type.setdefault(type_name, []).append(
-                        (key, fr if covers else None))
+                        (key, fr if covers else None,
+                         self.device.state_exact(type_name, key)))
                 else:
                     out[(key, type_name)] = self._read_store(
                         key, type_name, snapshot_vc, txid)
@@ -504,12 +543,12 @@ class PartitionManager:
             for type_name, pairs in by_type.items():
                 plane = self.device.planes[type_name]
                 if not plane.pending_keys.isdisjoint(
-                        [k for k, _fr in pairs]):
+                        [k for k, _fr, _ex in pairs]):
                     self._wait_device_quiesce()
                     plane.flush()
             for type_name, pairs in by_type.items():
                 plane = self.device.planes[type_name]
-                keys_t = [k for k, _fr in pairs]
+                keys_t = [k for k, _fr, _ex in pairs]
                 try:
                     closure = plane.read_many_begin(keys_t, snapshot_vc)
                 except ReadBelowBase:
@@ -523,7 +562,7 @@ class PartitionManager:
             for type_name, pairs, closure in dev_batches:
                 if closure is None:
                     with self._lock:
-                        for key, _fr in pairs:
+                        for key, _fr, _ex in pairs:
                             out[(key, type_name)] = self._read_from_log(
                                 key, type_name, snapshot_vc, txid)
                     continue
@@ -536,21 +575,19 @@ class PartitionManager:
                         self._lock.notify_all()
                 cacheable = []
                 with self._lock:
-                    for key, fr in pairs:
+                    for key, fr, exact in pairs:
                         if key in got:
                             value = got[key]
                             if fr is not None and \
                                     self.key_frontier.get(key) is fr:
-                                cacheable.append((key, fr, value))
+                                cacheable.append((key, fr, value, exact))
                         else:
                             # evicted during the begin-flush — host path
                             value = self._read_store(
                                 key, type_name, snapshot_vc, txid)
                         out[(key, type_name)] = value
-                    for key, fr, value in cacheable:
-                        if len(self._val_cache) >= self._val_cache_cap:
-                            self._val_cache.clear()
-                        self._val_cache[key] = [fr, value, 0]
+                    for key, fr, value, exact in cacheable:
+                        self._cache_put(key, fr, value, exact)
         finally:
             # an escaping exception must not leak the not-yet-drained
             # batches' reader counts: a leak would wedge

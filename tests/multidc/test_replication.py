@@ -245,3 +245,95 @@ class TestReplicatedNewTypes:
         ct2 = dc2.update_objects_static(ct, [(key, "add", "r")])
         vals, _ = dc1.read_objects_static(ct2, [key])
         assert vals[0] == ["p", "q", "r"]
+
+
+class TestExactDownstreamState:
+    """Downstream effects must be generated from EXACT CRDT state (full
+    per-DC dot sets), never from the device fold's per-(elem, plane, DC)
+    max-seq collapse.
+
+    set_rw / flag_dw accumulate multiple live dots per DC column (their
+    host update does ``adds | {dot}`` with no self-supersede), so an
+    effect generated from a collapsed state observes only the newest dot
+    and under-cancels at any exact replica — permanent cross-DC value
+    divergence (round-2 advisor finding, mat/device_plane.py RwsetPlane).
+    Each test forces a cold value cache between ops so the downstream
+    read cannot ride a warm exact state, then compares the device-served
+    origin against a host-exact replica (key evicted to the host store,
+    which rebuilds from a full log replay)."""
+
+    @staticmethod
+    def _chill(dc):
+        """Drop every warm value-cache entry (restart / retirement / cache
+        -pressure stand-in)."""
+        for pm in dc.node.partitions:
+            with pm._lock:
+                pm._val_cache.clear()
+
+    @staticmethod
+    def _host_serve(dc, key, type_name):
+        """Force the key onto the host path at this DC: the migration
+        replays the full log, so the host state is exact by construction."""
+        pm = dc.node.partition_of(key)
+        with pm._lock:
+            if pm.device is not None and pm.device.owns(type_name, key):
+                pm._wait_device_quiesce()
+                pm.device.planes[type_name].evict(key)
+
+    def test_set_rw_remove_remove_add_converges(self, cluster3):
+        dc1, dc2, _ = cluster3
+        bo = ("exact_rw", "set_rw", "b")
+        ct = dc1.update_objects_static(None, [(bo, "remove", "x")])
+        self._chill(dc1)
+        ct = dc1.update_objects_static(ct, [(bo, "remove", "x")])
+        self._chill(dc1)
+        # the add must observe BOTH remove dots; a collapsed read lists
+        # only the newest, leaving the older one live at exact replicas
+        ct = dc1.update_objects_static(ct, [(bo, "add", "x")])
+        self._host_serve(dc2, "exact_rw", "set_rw")
+        v1, _ = dc1.read_objects_static(ct, [bo])
+        v2, _ = dc2.read_objects_static(ct, [bo])
+        assert v1[0] == v2[0] == ["x"]
+
+    def test_set_rw_reset_converges(self, cluster3):
+        dc1, dc2, _ = cluster3
+        bo = ("exact_rw_reset", "set_rw", "b")
+        ct = dc1.update_objects_static(None, [(bo, "add", "x")])
+        self._chill(dc1)
+        ct = dc1.update_objects_static(ct, [(bo, "add", "x")])
+        self._chill(dc1)
+        ct = dc1.update_objects_static(ct, [(bo, "reset", ())])
+        self._host_serve(dc2, "exact_rw_reset", "set_rw")
+        v1, _ = dc1.read_objects_static(ct, [bo])
+        v2, _ = dc2.read_objects_static(ct, [bo])
+        assert v1[0] == v2[0] == []
+
+    def test_flag_dw_disable_disable_enable_converges(self, cluster3):
+        dc1, dc2, _ = cluster3
+        bo = ("exact_dw", "flag_dw", "b")
+        ct = dc1.update_objects_static(None, [(bo, "disable", ())])
+        self._chill(dc1)
+        ct = dc1.update_objects_static(ct, [(bo, "disable", ())])
+        self._chill(dc1)
+        ct = dc1.update_objects_static(ct, [(bo, "enable", ())])
+        self._host_serve(dc2, "exact_dw", "flag_dw")
+        v1, _ = dc1.read_objects_static(ct, [bo])
+        v2, _ = dc2.read_objects_static(ct, [bo])
+        assert v1[0] is True and v2[0] is True
+
+    def test_map_nested_set_rw_converges(self, cluster3):
+        dc1, dc2, _ = cluster3
+        bo = ("exact_map", "map_rr", "b")
+        fld = ("s", "set_rw")
+        ct = dc1.update_objects_static(
+            None, [(bo, "update", (fld, ("remove", "x")))])
+        self._chill(dc1)
+        ct = dc1.update_objects_static(
+            ct, [(bo, "update", (fld, ("remove", "x")))])
+        self._chill(dc1)
+        ct = dc1.update_objects_static(
+            ct, [(bo, "update", (fld, ("add", "x")))])
+        self._host_serve(dc2, "exact_map", "map_rr")
+        v1, _ = dc1.read_objects_static(ct, [bo])
+        v2, _ = dc2.read_objects_static(ct, [bo])
+        assert v1[0] == v2[0] == {fld: ["x"]}

@@ -41,7 +41,7 @@ class FakePartition:
         self.staged.setdefault(txid, []).append((key, type_name, effect))
 
     def read_with_writeset(self, key, type_name, snapshot_vc, txid,
-                           own_effects):
+                           own_effects, exact_state=False):
         self.calls.append(("read", key))
         if str(key).startswith("read_fail"):
             raise RuntimeError("mocked read failure")
