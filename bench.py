@@ -206,36 +206,91 @@ def bench_cpp_baseline(K, n_ops=2_000_000):
     return n_ops / best
 
 
-def _probe_device() -> bool:
+def _probe_device(window_s: float = 600.0, attempt_timeout: float = 120.0,
+                  retry_sleep: float = 20.0) -> bool:
     """Run a trivial jit in a KILLABLE subprocess: a wedged accelerator
     tunnel hangs inside native code (no Python timeout can interrupt
-    it), and a bench that hangs forever records nothing.  2 minutes is
-    far above a healthy first-compile."""
+    it), and a bench that hangs forever records nothing.  Each attempt
+    gets 2 minutes — far above a healthy first-compile — and attempts
+    retry with a pause over a ~10-minute window, so a transient tunnel
+    blip cannot zero a whole round's hardware evidence (round-2
+    post-mortem: one 120 s probe gave up on a recovering tunnel)."""
     import subprocess
 
+    deadline = time.monotonic() + window_s
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "print(jax.jit(lambda a: (a*2).sum())(jnp.arange(8.0)))"],
+                timeout=attempt_timeout, capture_output=True)
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            print(f"bench: device probe failed ({attempt} attempts over "
+                  f"{window_s:.0f}s); falling back to CPU logic validation",
+                  file=sys.stderr)
+            return False
+        time.sleep(min(retry_sleep, max(remaining, 0)))
+
+
+def _config_extras(quick_cpu: bool) -> dict:
+    """Driver-visible summaries of the other BASELINE configs, folded
+    into the single JSON line's detail (round-2 verdict: configs 5/6
+    were invisible to the driver).
+
+    - config 5 (GST at 256 DCs) runs in-process on the bench platform —
+      on TPU this IS the headline's second half.
+    - config 6 (end-to-end txn/s) runs in a subprocess pinned to CPU:
+      the control plane is a CPU measure, and isolating it keeps a
+      crash or hang from zeroing the headline metric."""
+    import subprocess
+
+    out = {}
     try:
+        import jax
+
+        from benches.config5_gst import summary as gst_summary
+
+        out.update(gst_summary(jax, N=64 if quick_cpu else 256))
+        out.pop("vs_host_round", None)
+    except Exception as e:  # never let an extra kill the headline
+        out["gst_error"] = repr(e)
+    try:
+        import os as _os
         r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "print(jax.jit(lambda a: (a*2).sum())(jnp.arange(8.0)))"],
-            timeout=120, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+            [sys.executable, "-m", "benches.config6_txn", "--cpu",
+             "--quick"],
+            timeout=900, capture_output=True, text=True,
+            cwd=_os.path.dirname(_os.path.abspath(__file__)))
+        line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+        cfg6 = json.loads(line)
+        out["txn_per_sec_8client_cpu_quick"] = cfg6["value"]
+        out["txn_p50_ms"] = cfg6["detail"].get("p50_ms")
+        out["txn_p99_ms"] = cfg6["detail"].get("p99_ms")
+        out["txn_pb_per_sec"] = cfg6["detail"].get("pb_txn_per_sec")
+    except Exception as e:
+        out["txn_error"] = repr(e)
+    return out
 
 
 def main():
     quick = "--quick" in sys.argv
+    degraded = False
     if "--cpu" not in sys.argv and not _probe_device():
-        print(json.dumps({
-            "metric": "orset_update_merges_per_sec_per_chip_1M_keys",
-            "value": 0, "unit": "merges/s", "vs_baseline": 0,
-            "detail": {"error": "accelerator backend unreachable "
-                                "(probe jit timed out after 120s)"},
-        }))
-        return
+        # The tunnel stayed wedged through the whole retry window.  Do
+        # NOT record a zero (round-2's official number): run the same
+        # bench as CPU logic validation at reduced scale and say so.
+        degraded = True
+        quick = True
     import jax
-    if "--cpu" in sys.argv:  # logic validation without the TPU tunnel
+    if "--cpu" in sys.argv or degraded:  # logic validation w/o the tunnel
         jax.config.update("jax_platforms", "cpu")
     K = 1_000_000 if not quick else 65_536
     B = 65_536 if not quick else 8_192
@@ -248,12 +303,18 @@ def main():
     # is the conservative (defensible) headline
     vs = dev_ops / cpp_ops if cpp_ops else dev_ops / host_ops
     import os
+    extras = _config_extras(quick_cpu=degraded or "--cpu" in sys.argv)
     print(json.dumps({
         "metric": "orset_update_merges_per_sec_per_chip_1M_keys",
         "value": round(dev_ops),
         "unit": "merges/s",
         "vs_baseline": round(vs, 2),
         "detail": {
+            "degraded": degraded,
+            **({"degraded_note":
+                "TPU tunnel unreachable for the whole ~10min probe "
+                "window; values are CPU logic-validation at reduced "
+                "scale, NOT hardware numbers"} if degraded else {}),
             "device": str(jax.devices()[0]),
             "keys": K, "batch": B, "steps": n_steps,
             "full_shard_read_ms": round(read_jnp * 1e3, 2),
@@ -270,6 +331,7 @@ def main():
                 + ("C++" if cpp_ops else "CPython (g++ unavailable)")
                 + " bracket (per core; x%d cores for a machine-wide "
                 "bound)" % (os.cpu_count() or 1)),
+            **extras,
         },
     }))
 
