@@ -1,0 +1,25 @@
+"""Multi-process DC: one data center spanning several OS processes.
+
+The reference's DC spans many BEAM nodes — riak_core places partitions
+across them, distributed Erlang carries vnode calls and metadata gossip
+(reference src/antidote_dc_manager.erl:53-81 staged joins,
+src/meta_data_sender.erl:224-255 cross-node gossip,
+src/meta_data_manager.erl:64-94 receive side).  This package is the
+rebuild's node dimension: a :class:`NodeServer` per OS process, a ring
+mapping partitions to nodes, cross-node partition RPC over the node
+fabric, and a two-level stable-time plane (per-node tracker fold +
+cross-node summary gossip).
+"""
+
+from antidote_tpu.cluster.link import NodeLink  # noqa: F401
+from antidote_tpu.cluster.node import (  # noqa: F401
+    ClusterNode,
+    ClusterStablePlane,
+    NodeServer,
+    create_dc_cluster,
+    plan_ring,
+)
+from antidote_tpu.cluster.remote import (  # noqa: F401
+    RemoteCallError,
+    RemotePartition,
+)

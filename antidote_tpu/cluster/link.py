@@ -1,0 +1,237 @@
+"""Node fabric: framed request/response RPC between the OS processes of
+ONE data center.
+
+The reference's intra-DC transport is distributed Erlang — synchronous
+gen_server calls for vnode commands and metadata broadcast (reference
+src/meta_data_sender.erl:241-243, src/stable_meta_data_server.erl:103-135).
+Here each node process binds one TCP listener; peers hold a persistent
+connection per target, re-dialed once on failure, with typed errors
+carried back so a remote certification failure aborts the coordinator's
+transaction exactly like a local one.
+
+Framing and codec are shared with the inter-DC fabric
+(antidote_tpu/interdc/tcp.py, termcodec.py): 4-byte big-endian length
+frames of safe tagged terms — never pickle, even inside one DC (a
+compromised node must not get arbitrary code execution on its peers).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from antidote_tpu.interdc import termcodec
+from antidote_tpu.interdc.tcp import _recv_frame, _send_frame
+from antidote_tpu.interdc.transport import LinkDown
+
+log = logging.getLogger(__name__)
+
+
+def _err_kind(exc: Exception) -> str:
+    from antidote_tpu.txn.manager import CertificationError
+
+    if isinstance(exc, CertificationError):
+        return "certification"
+    if isinstance(exc, TimeoutError):
+        return "timeout"
+    return "generic"
+
+
+def _raise_remote(kind: str, msg: str):
+    from antidote_tpu.txn.manager import CertificationError
+
+    if kind == "certification":
+        raise CertificationError(msg)
+    if kind == "timeout":
+        raise TimeoutError(msg)
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    raise RemoteCallError(msg)
+
+
+#: replies remembered per origin for at-most-once retries (a retry
+#: follows its first attempt immediately, so a small window suffices)
+_DEDUP_CAP = 256
+
+
+class NodeLink:
+    """One node's endpoint of the DC's node fabric."""
+
+    def __init__(self, node_id, host: str = "127.0.0.1", port: int = 0,
+                 connect_timeout: float = 5.0,
+                 request_timeout: float = 30.0):
+        self.node_id = node_id
+        self.host = host
+        self._port = port
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+        self._handler: Optional[Callable[[Any, str, Any], Any]] = None
+        self._srv: Optional[socket.socket] = None
+        #: peer node_id -> {"addr", "sock", "lock"}
+        self._peers: Dict[Any, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        #: client-side request ids: (boot_token, n).  The token makes
+        #: ids unique ACROSS process incarnations — a restarted node
+        #: must not collide with its predecessor's entries in peers'
+        #: at-most-once caches and be served stale cached replies.
+        self._boot = int.from_bytes(os.urandom(8), "big")
+        self._rid = 0
+        #: server-side at-most-once cache: origin -> {rid: reply bytes}.
+        #: A reconnecting client re-sends its last request with the SAME
+        #: rid; answering from here instead of re-executing is what
+        #: keeps non-idempotent RPCs (stage_update, commit) exactly-once
+        #: across a reply lost to a dropped connection.
+        self._seen: Dict[Any, "dict"] = {}
+
+    # ------------------------------------------------------------- server
+
+    def serve(self, handler: Callable[[Any, str, Any], Any]
+              ) -> Tuple[str, int]:
+        """Bind the listener and answer requests with
+        ``handler(origin_node, kind, payload)``; returns the bound
+        address for the node's descriptor."""
+        self._handler = handler
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self._port))
+        srv.listen(64)
+        self._srv = srv
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return srv.getsockname()[:2]
+
+    def local_addr(self) -> Tuple[str, int]:
+        if self._srv is None:
+            raise RuntimeError("serve() first")
+        return self._srv.getsockname()[:2]
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    frame = _recv_frame(conn)
+                except ValueError:
+                    return
+                if frame is None:
+                    return
+                kind = "?"
+                try:
+                    origin, rid, kind, payload = termcodec.decode(frame)
+                    reply = self._answer(origin, rid, kind, payload)
+                except Exception as e:  # noqa: BLE001 — must answer
+                    if _err_kind(e) == "generic":
+                        log.exception("node RPC handler failed (%s)",
+                                      kind)
+                    reply = termcodec.encode(
+                        ("error", _err_kind(e), str(e)))
+                try:
+                    _send_frame(conn, reply)
+                except OSError:
+                    return
+
+    def _answer(self, origin, rid, kind: str, payload) -> bytes:
+        """Run the handler at most once per (origin, rid): a client that
+        lost the reply re-sends the same rid on a fresh connection and
+        gets the remembered answer, not a re-execution."""
+        with self._lock:
+            cache = self._seen.setdefault(origin, {})
+            if rid in cache:
+                return cache[rid]
+        result = self._handler(origin, kind, payload)
+        reply = termcodec.encode(("ok", result))
+        with self._lock:
+            cache = self._seen.setdefault(origin, {})
+            while len(cache) >= _DEDUP_CAP:
+                cache.pop(next(iter(cache)))
+            cache[rid] = reply
+        return reply
+
+    # ------------------------------------------------------------- client
+
+    def connect(self, peer_id, addr: Tuple[str, int]) -> None:
+        """Remember a peer's address (the dial is lazy; a dead peer
+        surfaces as LinkDown on the first request)."""
+        with self._lock:
+            peer = self._peers.get(peer_id)
+            if peer is None:
+                self._peers[peer_id] = {
+                    "addr": tuple(addr), "sock": None,
+                    "lock": threading.Lock()}
+            else:
+                peer["addr"] = tuple(addr)
+
+    def peers(self):
+        with self._lock:
+            return list(self._peers)
+
+    def request(self, peer_id, kind: str, payload) -> Any:
+        """Synchronous RPC; LinkDown when the peer is unreachable,
+        remote exceptions re-raised with their kind preserved.  The
+        retry after a transport error re-sends the SAME request id, so
+        the server's at-most-once cache answers without re-executing a
+        request whose reply was lost (non-idempotent RPCs stay
+        exactly-once)."""
+        with self._lock:
+            peer = self._peers.get(peer_id)
+            self._rid += 1
+            rid = (self._boot, self._rid)
+        if peer is None:
+            raise LinkDown(f"unknown node {peer_id!r}")
+        with peer["lock"]:
+            for attempt in (0, 1):
+                sock = peer["sock"]
+                reply = None
+                try:
+                    if sock is None:
+                        sock = socket.create_connection(
+                            peer["addr"], timeout=self.connect_timeout)
+                        sock.settimeout(self.request_timeout)
+                        peer["sock"] = sock
+                    _send_frame(sock, termcodec.encode(
+                        (self.node_id, rid, kind, payload)))
+                    frame = _recv_frame(sock)
+                    if frame is None:
+                        raise OSError("connection closed mid-request")
+                    reply = termcodec.decode(frame)
+                except (OSError, ValueError) as e:
+                    if peer["sock"] is not None:
+                        peer["sock"].close()
+                        peer["sock"] = None
+                    if attempt == 1:
+                        raise LinkDown(
+                            f"node {peer_id!r} unreachable: {e}") from e
+                    continue
+                # raised OUTSIDE the try: TimeoutError subclasses
+                # OSError, and a remote protocol timeout must reach the
+                # caller typed, not tear the socket down as "unreachable"
+                if reply[0] == "error":
+                    _, ekind, msg = reply
+                    _raise_remote(ekind, f"{peer_id!r}: {msg}")
+                return reply[1]
+
+    # ----------------------------------------------------------- shutdown
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        with self._lock:
+            for peer in self._peers.values():
+                if peer["sock"] is not None:
+                    peer["sock"].close()
+                    peer["sock"] = None

@@ -1,0 +1,348 @@
+"""ClusterNode + NodeServer: one DC spanning several OS processes.
+
+Roles, mapped from the reference:
+
+- **ClusterNode** — the riak_core placement duty: a ring maps every
+  partition index to an owning node; this process instantiates real
+  PartitionManagers for its slice and RemotePartition proxies for the
+  rest, so the unchanged Coordinator transparently spans nodes exactly
+  as `riak_core_vnode_master` routes vnode commands across BEAM nodes
+  (reference src/clocksi_vnode.erl:99-209 call sites).
+- **ClusterStablePlane** — the cross-node half of the stable-time
+  protocol: each node min-folds its own partitions (meta_data_sender's
+  per-node merge, reference src/meta_data_sender.erl:224-255), gossips
+  the summary to every peer, stores peer summaries
+  (meta_data_manager's remote-node table, src/meta_data_manager.erl:
+  64-94), and publishes the min-of-mins monotonically; a member that
+  has never reported pins the snapshot to zero (reference
+  src/stable_time_functions.erl:78-85).
+- **NodeServer** — the per-process assembly + antidote_dc_manager's
+  staged join (reference src/antidote_dc_manager.erl:53-81): nodes
+  boot empty, a coordinator pushes the cluster plan (ring + member
+  addresses) to each, every node persists it and assembles; a
+  restarted process reloads the plan, recovers its partitions from
+  their logs, and re-joins the gossip.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from antidote_tpu.clocks import VC, vc_min
+from antidote_tpu.cluster.link import NodeLink
+from antidote_tpu.cluster.remote import (
+    PARTITION_METHODS,
+    RemoteCallError,
+    RemotePartition,
+)
+from antidote_tpu.config import Config
+from antidote_tpu.meta.gossip import StableTimeTracker
+from antidote_tpu.meta.sender import MetaDataSender
+from antidote_tpu.meta.stable_store import StableMetaData
+from antidote_tpu.txn.manager import PartitionManager
+from antidote_tpu.txn.node import Node
+
+log = logging.getLogger(__name__)
+
+
+def plan_ring(n_partitions: int, node_ids: List[Any]) -> Dict[int, Any]:
+    """Round-robin partition placement — the cluster plan the reference
+    computes via riak_core claim (reference antidote_dc_manager's
+    plan/commit staged join).  Every member must own at least one
+    partition: a slotless member would contribute an eternally-bottom
+    stable summary, pinning the DC's snapshot at zero."""
+    if n_partitions < len(node_ids):
+        raise ValueError(
+            f"{len(node_ids)} members need >= {len(node_ids)} "
+            f"partitions (got {n_partitions}): a member owning no "
+            "partition pins the cluster stable snapshot at zero")
+    ids = sorted(node_ids, key=repr)
+    return {p: ids[p % len(ids)] for p in range(n_partitions)}
+
+
+class ClusterNode(Node):
+    """A Node owning only its ring slice; other slots are RPC proxies."""
+
+    def __init__(self, node_id, ring: Dict[int, Any], link: NodeLink,
+                 dc_id="dc1", config: Optional[Config] = None,
+                 data_dir: Optional[str] = None, on_log_append=None):
+        if sorted(ring) != list(range(len(ring))):
+            raise ValueError("ring must map every partition 0..N-1")
+        self.node_id = node_id
+        self.ring = dict(ring)
+        self.link = link
+        cfg = config or Config()
+        cfg.n_partitions = len(ring)
+        super().__init__(dc_id=dc_id, config=cfg, data_dir=data_dir,
+                         on_log_append=on_log_append)
+
+    def _build_partition(self, p: int):
+        if self.ring[p] == self.node_id:
+            return super()._build_partition(p)
+        return RemotePartition(self.link, self.ring[p], p)
+
+    def _local_partitions(self) -> List[PartitionManager]:
+        return [pm for pm in self.partitions
+                if isinstance(pm, PartitionManager)]
+
+    def local_partition_indices(self) -> List[int]:
+        return [p for p, owner in sorted(self.ring.items())
+                if owner == self.node_id]
+
+    def mint_dot(self) -> Tuple[Any, int]:
+        """Dots are NODE-scoped in a multi-node DC: the device plane's
+        per-actor-column max-seq collapse needs same-column dots minted
+        under ONE monotone clock in observation order, which only this
+        process's clock guarantees (Node.mint_dot documents the single-
+        node argument).  Cross-node same-key commits still serialize at
+        the key's owner partition, so per-column collapse stays sound
+        per column; cross-column concurrency is what ORSWOT handles
+        anyway."""
+        return ((self.dc_id, self.node_id), self.clock.now_us())
+
+    def repartition(self, new_n: int) -> None:
+        raise RuntimeError(
+            "repartition of a multi-node DC is a cluster-level plan "
+            "(every member folds its slice against the new ring); "
+            "resize single-node DCs or re-plan the cluster instead")
+
+
+class ClusterStablePlane:
+    """Two-level stable time: local partition fold + node-summary gossip."""
+
+    def __init__(self, dc_id, node_id, member_ids: List[Any],
+                 local: StableTimeTracker):
+        self.dc_id = dc_id
+        self.node_id = node_id
+        self.members = sorted(member_ids, key=repr)
+        self._idx = {nid: i for i, nid in enumerate(self.members)}
+        self.local = local
+        self.sender = MetaDataSender()
+        self.sender.register(
+            "stable_nodes", len(self.members), initial=lambda: None,
+            merge=self._merge_nodes,
+            publish=lambda prev, new: new if prev is None
+            else prev.join(new))
+
+    def _merge_nodes(self, vals: List[Optional[VC]]) -> VC:
+        if any(v is None for v in vals):
+            # an unheard-from member pins every column to zero — the
+            # published view stays at its previous floor (monotone)
+            return VC()
+        return vc_min(vals)
+
+    def put_node(self, node_id, vc: VC) -> None:
+        """Store one node's summary (gossip receive side); per-source
+        entries never regress."""
+        i = self._idx.get(node_id)
+        if i is None:
+            log.warning("gossip from unknown node %r ignored", node_id)
+            return
+        self.sender.update(
+            "stable_nodes", i,
+            lambda cur: vc if cur is None else cur.join(vc))
+
+    def local_summary(self) -> VC:
+        """This node's contribution: the min-fold over its partitions."""
+        s = self.local.get_stable_snapshot()
+        self.put_node(self.node_id, s)
+        return s
+
+    def get_stable_snapshot(self) -> VC:
+        self.local_summary()
+        return VC(self.sender.merged("stable_nodes"))
+
+    def seed_floor(self, vc: VC) -> None:
+        self.local.seed_floor(vc)
+
+
+class NodeServer:
+    """One OS process of a multi-node DC: fabric endpoint, cluster-plan
+    persistence, gossip ticker, and the client API once assembled."""
+
+    def __init__(self, node_id, host: str = "127.0.0.1", port: int = 0,
+                 data_dir: str = ".", config: Optional[Config] = None):
+        self.node_id = node_id
+        self.config = config or Config()
+        os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
+        self.meta = StableMetaData(
+            os.path.join(data_dir, f"node_{node_id}_meta.pkl"),
+            recover=self.config.recover_meta_data_on_start)
+        self.link = NodeLink(node_id, host=host, port=port)
+        self.addr = self.link.serve(self._handle)
+        self.node: Optional[ClusterNode] = None
+        self.api = None
+        self.plane: Optional[ClusterStablePlane] = None
+        self._gossip: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._assembled = threading.Event()
+        #: peer -> monotonic time before which gossip skips it
+        self._peer_backoff: Dict[Any, float] = {}
+        plan = self.meta.get("cluster_plan")
+        if plan is not None:
+            # restart: reload the committed plan and re-join (reference
+            # check_node_restart, src/inter_dc_manager.erl:156-201)
+            self._assemble(*plan)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def descriptor(self) -> Tuple[Any, Tuple[str, int]]:
+        return (self.node_id, self.addr)
+
+    def install_cluster(self, dc_id, ring: Dict[int, Any],
+                        members: Dict[Any, Tuple[str, int]]) -> None:
+        """Commit the cluster plan on this node (the staged-join
+        plan/commit step).  Persisted first: a crash between commit and
+        assembly re-runs assembly at the next boot."""
+        if self.node is not None:
+            raise RuntimeError("node already belongs to a cluster")
+        if self.node_id not in members:
+            raise ValueError(f"plan does not include {self.node_id!r}")
+        owners = set(ring.values())
+        if owners != set(members):
+            raise ValueError(
+                f"every member must own >= 1 partition and every owner "
+                f"must be a member (owners {owners!r} vs members "
+                f"{set(members)!r})")
+        self.meta.put("cluster_plan", (dc_id, dict(ring), dict(members)))
+        self._assemble(dc_id, dict(ring), dict(members))
+
+    def _assemble(self, dc_id, ring, members) -> None:
+        from antidote_tpu.api import AntidoteTPU
+
+        for nid, addr in members.items():
+            if nid != self.node_id:
+                self.link.connect(nid, tuple(addr))
+        node = ClusterNode(self.node_id, ring, self.link, dc_id=dc_id,
+                           config=self.config, data_dir=self.data_dir)
+        local_idx = node.local_partition_indices()
+        tracker = StableTimeTracker(dc_id, len(local_idx))
+
+        def _source(pm):
+            return lambda: VC({dc_id: pm.min_prepared()})
+
+        tracker.sources = [_source(node.partitions[p]) for p in local_idx]
+        plane = ClusterStablePlane(dc_id, self.node_id,
+                                   list(members), tracker)
+        last = self.meta.get("last_stable_vc")
+        if last:
+            plane.seed_floor(VC(last))
+        node.stable_vc_provider = plane.get_stable_snapshot
+        node.wait_hook = self._wait_hook
+        self.plane = plane
+        self.node = node
+        self.api = AntidoteTPU(node=node)
+        self._gossip = threading.Thread(target=self._gossip_loop,
+                                        daemon=True)
+        self._gossip.start()
+        self._assembled.set()
+        self.meta.mark_started()
+
+    def _wait_hook(self) -> None:
+        # a causal wait is released by PEER summaries arriving at their
+        # gossip cadence — nothing to push from here, and dialing peers
+        # synchronously would stall the 2ms spin behind connect
+        # timeouts when one is down
+        self._stop.wait(0.002)
+
+    # -------------------------------------------------------------- gossip
+
+    def _gossip_loop(self) -> None:
+        period = self.config.heartbeat_s
+        while not self._stop.wait(period):
+            try:
+                self.gossip_tick()
+            except Exception:  # noqa: BLE001 — the ticker must not die
+                log.exception("gossip tick failed")
+
+    def gossip_tick(self) -> None:
+        """Broadcast this node's summary to every peer (reference
+        meta_data_sender loop, src/meta_data_sender.erl:224-255); an
+        unreachable peer is skipped — its entry simply stops advancing,
+        holding the published snapshot, until it returns.  A peer that
+        just failed is backed off for a few seconds so one dead member's
+        connect timeouts don't delay the live members' gossip."""
+        if self.plane is None:
+            return
+        summary = self.plane.local_summary()
+        now = time.monotonic()
+        for peer in self.link.peers():
+            if self._peer_backoff.get(peer, 0) > now:
+                continue
+            try:
+                self.link.request(peer, "gossip",
+                                  (self.node_id, summary))
+                self._peer_backoff.pop(peer, None)
+            except Exception:  # noqa: BLE001 — down peer
+                self._peer_backoff[peer] = now + 2.0
+
+    # ----------------------------------------------------------- RPC server
+
+    def _handle(self, origin, kind: str, payload) -> Any:
+        if kind == "check_up":
+            return True
+        if kind == "join":
+            dc_id, ring_pairs, member_pairs = payload
+            self.install_cluster(
+                dc_id, {int(p): nid for p, nid in ring_pairs},
+                {nid: tuple(addr) for nid, addr in member_pairs})
+            return True
+        if kind == "gossip":
+            nid, vc = payload
+            if self.plane is not None:
+                self.plane.put_node(nid, vc)
+            return None
+        if kind == "part":
+            if self.node is None:
+                raise RemoteCallError("node not assembled yet")
+            p, method, args, kwargs = payload
+            if method not in PARTITION_METHODS:
+                raise RemoteCallError(f"method {method!r} not allowed")
+            pm = self.node.partitions[p]
+            if not isinstance(pm, PartitionManager):
+                raise RemoteCallError(
+                    f"partition {p} not owned by {self.node_id!r} "
+                    f"(stale ring at {origin!r}?)")
+            return getattr(pm, method)(*args, **kwargs)
+        if kind == "status":
+            return {
+                "node_id": self.node_id,
+                "assembled": self.node is not None,
+                "local_partitions":
+                    self.node.local_partition_indices()
+                    if self.node else [],
+                "stable": dict(self.plane.get_stable_snapshot())
+                    if self.plane else {},
+            }
+        raise RemoteCallError(f"unknown node RPC kind {kind!r}")
+
+    # ------------------------------------------------------------ shutdown
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._gossip is not None:
+            self._gossip.join(timeout=2.0)
+        if self.plane is not None:
+            self.meta.put("last_stable_vc",
+                          dict(self.plane.get_stable_snapshot()))
+        self.link.close()
+        if self.node is not None:
+            self.node.close()
+
+
+def create_dc_cluster(dc_id, n_partitions: int,
+                      servers: List[NodeServer]) -> Dict[int, Any]:
+    """In-process cluster build: plan the ring over the given servers
+    and commit it on each (the antidote_dc_manager:create_dc flow,
+    reference src/antidote_dc_manager.erl:53-81).  For cross-process
+    builds, push the same plan via the "join" RPC instead."""
+    members = {s.node_id: s.addr for s in servers}
+    ring = plan_ring(n_partitions, list(members))
+    for s in servers:
+        s.install_cluster(dc_id, ring, members)
+    return ring

@@ -1481,7 +1481,11 @@ class DevicePlane:
         #: the exact per-dot state a reset's downstream generation
         #: needs (a lossy observed list would under-cancel at exact
         #: replicas — a value divergence, not just a representation
-        #: one).  Maps count as dot-collapsing because their nested
+        #: one).  The ambiguity is pinned by oracle tests: two
+        #: histories with identical per-column collapse give different
+        #: values under the same prefix reset
+        #: (tests/unit/test_counter_fat_collapse.py).  Maps count as
+        #: dot-collapsing because their nested
         #: entries may (conservative for an all-counter map_go).
         self.dot_collapse_types = frozenset(
             {"set_aw", "register_mv", "flag_ew", "set_rw", "flag_dw",

@@ -226,6 +226,16 @@ class Node:
         pm.stable_vc_source = self.stable_vc
         return pm
 
+    # ---------------------------------------------------------- node scope
+
+    def _local_partitions(self) -> List[PartitionManager]:
+        """The partitions THIS process owns.  A single-process node owns
+        all of them; a ClusterNode (antidote_tpu/cluster/node.py)
+        narrows this to its ring slice — everything that folds over
+        \"my\" partitions (recovery, min-prepared, flags, close) goes
+        through here."""
+        return self.partitions
+
     # ------------------------------------------------------- runtime flags
 
     #: flags togglable at runtime (the reference replicates these
@@ -243,7 +253,7 @@ class Node:
         if name == "sync_log":
             value = bool(value)
             self.config.sync_log = value
-            for pm in self.partitions:
+            for pm in self._local_partitions():
                 pm.log.sync_on_commit = value
         elif name == "certify":
             self.config.certify = bool(value)
@@ -282,8 +292,9 @@ class Node:
         return self.stable_vc_provider()
 
     def min_prepared_vc(self) -> int:
-        """Node-wide min prepared time (feeds the stable-time gossip)."""
-        return min(pm.min_prepared() for pm in self.partitions)
+        """Node-wide min prepared time (feeds the stable-time gossip);
+        folds this process's own partitions."""
+        return min(pm.min_prepared() for pm in self._local_partitions())
 
     def mint_dot(self) -> Tuple[Any, int]:
         """Unique dot for CRDT downstream generation: ``(dc_id, µs)``
@@ -329,7 +340,7 @@ class Node:
         (reference materializer_vnode load_from_log,
         src/materializer_vnode.erl:123-131, 288-319)."""
         recovered_vc = VC()
-        for pm in self.partitions:
+        for pm in self._local_partitions():
             for _seq, payload in pm.log.committed_payloads():
                 with pm._lock:
                     pm._publish(payload.key, payload.type_name, payload,
@@ -352,13 +363,13 @@ class Node:
             # watermark (FIFO opid continuity / local clock), so nothing
             # can still commit at/below it.  Folding leaves the device
             # rings empty — recovery = batch append + one fold.
-            for pm in self.partitions:
+            for pm in self._local_partitions():
                 if pm.device is not None:
                     with pm._lock:
                         pm.device.gc(recovered_vc)
 
     def close(self) -> None:
-        for pm in self.partitions:
+        for pm in self._local_partitions():
             pm.log.close()
 
 

@@ -1,0 +1,280 @@
+"""Multi-process DC: partitions spread over node processes, cross-node
+transactions, one merged stable snapshot, kill/restart recovery.
+
+The reference's analogue is a riak_core cluster of ct_slave BEAM nodes
+in one DC (reference test/utils/test_utils.erl:110-165, staged join
+src/antidote_dc_manager.erl:53-81, cross-node gossip
+src/meta_data_sender.erl:224-255).  Tier 1 forms the cluster inside one
+process over real TCP; tier 2 spawns separate OS processes
+(node_proc.py) and kills/restarts one mid-run.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.cluster import (
+    NodeServer,
+    create_dc_cluster,
+    plan_ring,
+)
+from antidote_tpu.config import Config
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def cluster2(tmp_path):
+    servers = [
+        NodeServer(f"n{i + 1}", data_dir=str(tmp_path / f"n{i + 1}"),
+                   config=Config(heartbeat_s=0.02,
+                                 clock_wait_timeout_s=10.0))
+        for i in range(2)
+    ]
+    create_dc_cluster("dc1", 4, servers)
+    yield servers
+    for s in servers:
+        s.close()
+
+
+class TestRingPlacement:
+    def test_plan_covers_all_partitions(self):
+        ring = plan_ring(5, ["b", "a"])
+        assert sorted(ring) == [0, 1, 2, 3, 4]
+        assert set(ring.values()) == {"a", "b"}
+
+    def test_partitions_split_between_nodes(self, cluster2):
+        n1, n2 = cluster2
+        own1 = n1.node.local_partition_indices()
+        own2 = n2.node.local_partition_indices()
+        assert sorted(own1 + own2) == [0, 1, 2, 3]
+        assert own1 and own2
+        # both nodes agree on the ring
+        assert n1.node.ring == n2.node.ring
+
+
+class TestCrossNodeTransactions:
+    def test_writes_on_both_nodes_one_view(self, cluster2):
+        n1, n2 = cluster2
+        # integer keys map to partitions by modulo: key 0 lives on n1's
+        # slice, key 1 on n2's (round-robin ring over sorted node ids)
+        ct = n1.api.update_objects_static(
+            None, [((0, "counter_pn", "b"), "increment", 1)])
+        ct = n2.api.update_objects_static(
+            ct, [((1, "counter_pn", "b"), "increment", 2)])
+        # each node reads BOTH keys — one local, one via the proxy
+        for srv in cluster2:
+            vals, _ = srv.api.read_objects_static(
+                ct, [(0, "counter_pn", "b"), (1, "counter_pn", "b")])
+            assert vals == [1, 2], srv.node_id
+
+    def test_remote_coordinator_writes_remote_partition(self, cluster2):
+        n1, n2 = cluster2
+        remote_key = n2.node.local_partition_indices()[0]
+        # n1 coordinates a txn whose only partition is owned by n2
+        ct = n1.api.update_objects_static(
+            None, [((remote_key, "set_aw", "b"), "add", "x")])
+        vals, _ = n2.api.read_objects_static(
+            ct, [(remote_key, "set_aw", "b")])
+        assert vals[0] == ["x"]
+        # the durable record lives at the owner
+        pm = n2.node.partitions[remote_key]
+        assert remote_key in pm.log.keys_seen
+
+    def test_cross_node_multipartition_2pc(self, cluster2):
+        n1, n2 = cluster2
+        k1 = n1.node.local_partition_indices()[0]
+        k2 = n2.node.local_partition_indices()[0]
+        tx = n1.api.start_transaction()
+        n1.api.update_objects(
+            [((k1, "counter_pn", "b"), "increment", 10),
+             ((k2, "counter_pn", "b"), "increment", 20)], tx)
+        ct = n1.api.commit_transaction(tx)
+        for srv in cluster2:
+            vals, _ = srv.api.read_objects_static(
+                ct, [(k1, "counter_pn", "b"), (k2, "counter_pn", "b")])
+            assert vals == [10, 20]
+
+    def test_remote_certification_aborts(self, cluster2):
+        from antidote_tpu.txn.coordinator import TransactionAborted
+
+        n1, n2 = cluster2
+        key = n2.node.local_partition_indices()[0]
+        tx1 = n1.api.start_transaction()
+        tx2 = n1.api.start_transaction()
+        n1.api.update_objects(
+            [((key, "counter_pn", "b"), "increment", 1)], tx1)
+        n1.api.update_objects(
+            [((key, "counter_pn", "b"), "increment", 1)], tx2)
+        n1.api.commit_transaction(tx1)
+        with pytest.raises(TransactionAborted):
+            n1.api.commit_transaction(tx2)
+
+    def test_exact_downstream_state_crosses_nodes(self, cluster2):
+        """The exact-state rule must survive the RPC hop: remove,
+        remove, add on a remote set_rw with cold caches."""
+        n1, n2 = cluster2
+        key = n2.node.local_partition_indices()[0]
+        bo = (key, "set_rw", "b")
+        ct = n1.api.update_objects_static(None, [(bo, "remove", "x")])
+        for pm in n2.node._local_partitions():
+            with pm._lock:
+                pm._val_cache.clear()
+        ct = n1.api.update_objects_static(ct, [(bo, "remove", "x")])
+        for pm in n2.node._local_partitions():
+            with pm._lock:
+                pm._val_cache.clear()
+        ct = n1.api.update_objects_static(ct, [(bo, "add", "x")])
+        v1, _ = n1.api.read_objects_static(ct, [bo])
+        v2, _ = n2.api.read_objects_static(ct, [bo])
+        assert v1[0] == v2[0] == ["x"]
+
+
+class TestClusterStablePlane:
+    def test_one_stable_snapshot_covers_both_nodes(self, cluster2):
+        n1, n2 = cluster2
+        ct1 = n1.api.update_objects_static(
+            None, [((0, "counter_pn", "b"), "increment", 1)])
+        ct2 = n2.api.update_objects_static(
+            None, [((1, "counter_pn", "b"), "increment", 1)])
+        want = max(ct1.get_dc("dc1"), ct2.get_dc("dc1"))
+        deadline = time.monotonic() + 10.0
+        while True:
+            st1 = n1.plane.get_stable_snapshot().get_dc("dc1")
+            st2 = n2.plane.get_stable_snapshot().get_dc("dc1")
+            if st1 >= want and st2 >= want:
+                break
+            assert time.monotonic() < deadline, (st1, st2, want)
+            time.sleep(0.01)
+
+    def test_snapshot_zero_until_peer_reports(self, tmp_path):
+        """A member that never gossiped pins the snapshot to zero
+        (reference stable_time_functions:78-85)."""
+        srv = NodeServer("n1", data_dir=str(tmp_path / "solo"),
+                         config=Config(heartbeat_s=0.02))
+        try:
+            # plan includes an unreachable ghost member
+            ring = plan_ring(2, ["n1", "ghost"])
+            srv.install_cluster(
+                "dc1", ring,
+                {"n1": srv.addr, "ghost": ("127.0.0.1", free_port())})
+            assert srv.plane.get_stable_snapshot().get_dc("dc1") == 0
+        finally:
+            srv.close()
+
+
+# --------------------------------------------------------------- tier 2
+
+
+class NodeProc:
+    def __init__(self, node_id, data_dir, port):
+        self.proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "node_proc.py"),
+             node_id, data_dir, str(port)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        self.node_id = node_id
+        ready = json.loads(self.proc.stdout.readline())
+        assert ready.get("ready"), ready
+        self.addr = ready["addr"]
+        self.assembled = ready.get("assembled", False)
+
+    def cmd(self, **req):
+        self.proc.stdin.write(json.dumps(req) + "\n")
+        self.proc.stdin.flush()
+        resp = json.loads(self.proc.stdout.readline())
+        assert "error" not in resp, resp
+        return resp
+
+    def kill(self):
+        try:
+            self.proc.stdin.write(json.dumps({"cmd": "kill"}) + "\n")
+            self.proc.stdin.flush()
+        except OSError:
+            pass
+        self.proc.wait(timeout=10)
+
+    def stop(self):
+        if self.proc.poll() is not None:
+            return
+        try:
+            self.cmd(cmd="exit")
+        except Exception:  # noqa: BLE001
+            pass
+        self.proc.wait(timeout=10)
+
+
+class TestCrossProcessDC:
+    def test_two_process_dc_kill_restart(self, tmp_path):
+        ports = [free_port(), free_port()]
+        dirs = [str(tmp_path / "n1"), str(tmp_path / "n2")]
+        procs = [NodeProc(f"n{i + 1}", dirs[i], ports[i])
+                 for i in range(2)]
+        try:
+            members = {p.node_id: p.addr for p in procs}
+            ring = {str(i): f"n{(i % 2) + 1}" for i in range(4)}
+            for p in procs:
+                p.cmd(cmd="join", dc="dc1", ring=ring, members=members)
+
+            # writes on both nodes; cross-process reads see both
+            ct = procs[0].cmd(cmd="update", key=0, type="counter_pn",
+                              op="increment", arg=1)["clock"]
+            ct = procs[1].cmd(cmd="update", key=1, type="counter_pn",
+                              op="increment", arg=2, clock=ct)["clock"]
+            r = procs[0].cmd(cmd="read", key=1, type="counter_pn",
+                             clock=ct)
+            assert r["value"] == 2
+            r = procs[1].cmd(cmd="read", key=0, type="counter_pn",
+                             clock=ct)
+            assert r["value"] == 1
+
+            # ONE stable snapshot: both processes converge past the
+            # writes' commit point
+            want = ct["dc1"]
+            deadline = time.monotonic() + 15.0
+            while True:
+                st = [p.cmd(cmd="stable")["stable"].get("dc1", 0)
+                      for p in procs]
+                if all(s >= want for s in st):
+                    break
+                assert time.monotonic() < deadline, (st, want)
+                time.sleep(0.05)
+
+            # kill node 2 hard; node 1's snapshot holds (stability is
+            # permanent) and its own partitions keep serving
+            procs[1].kill()
+            r = procs[0].cmd(cmd="read", key=0, type="counter_pn")
+            assert r["value"] == 1
+            st1 = procs[0].cmd(cmd="stable")["stable"].get("dc1", 0)
+            assert st1 >= want
+
+            # restart node 2 from its data dir: it reloads the
+            # persisted plan, recovers partitions from its logs, and
+            # re-joins the gossip
+            procs[1] = NodeProc("n2", dirs[1], ports[1])
+            assert procs[1].assembled
+            r = procs[1].cmd(cmd="read", key=1, type="counter_pn",
+                             clock=ct)
+            assert r["value"] == 2
+
+            # the DC keeps accepting cross-node transactions
+            ct = procs[1].cmd(cmd="update", key=0, type="counter_pn",
+                              op="increment", arg=5, clock=ct)["clock"]
+            r = procs[0].cmd(cmd="read", key=0, type="counter_pn",
+                             clock=ct)
+            assert r["value"] == 6
+        finally:
+            for p in procs:
+                p.stop()

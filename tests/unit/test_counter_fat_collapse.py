@@ -1,0 +1,89 @@
+"""counter_fat stays host-served: the oracle proof (round-2 verdict #9).
+
+The device planes represent each (key, DC column) as a collapsed scalar
+row (sum / max-seq).  counter_fat's value is a sum over LIVE per-dot
+deltas, and a reset cancels exactly the dots it observed
+(crdt/counters.py CounterFat).  Causal FIFO delivery makes a reset's
+observed set per column a *prefix* of mint order — but a prefix that can
+end strictly below dots the local replica has already folded below its
+GST base (the origin's announcements can outrun the reset within one
+FIFO stream, advancing the local GST past the reset's snapshot).  A
+folded base therefore needs the sum of an *arbitrary prefix complement*
+of per-dot deltas — information a per-column scalar collapse has
+destroyed.  These tests pin the divergence concretely and assert the
+plane routing: no device plane accepts counter_fat keys.
+"""
+
+from antidote_tpu.crdt import get_type
+
+Fat = get_type("counter_fat")
+
+
+def apply_all(effects, state=None):
+    st = Fat.new() if state is None else state
+    for e in effects:
+        st = Fat.update(e, st)
+    return st
+
+
+class TestCollapseDiverges:
+    def test_partial_reset_needs_per_dot_deltas(self):
+        """Two same-column dots (+5 then +3); a reset observed only the
+        first.  Exact: value 8 -> 3.  Any per-column collapse holds only
+        (sum=8, max_seq=2): cancel-all gives 0, cancel-none gives 8 —
+        both wrong.  No scalar f(sum, max_seq, reset_seq) can produce 3:
+        the answer depends on how the sum splits across dots."""
+        d1, d2 = ("dc1", 1), ("dc1", 2)
+        inc5 = ("dot", d1, 5)
+        inc3 = ("dot", d2, 3)
+        reset_saw_first = ("reset", (d1,))
+
+        exact = apply_all([inc5, inc3, reset_saw_first])
+        assert Fat.value(exact) == 3
+
+        # the two states a collapsed representation can reach
+        collapsed_cancel_all = 0        # treats reset as column wipe
+        collapsed_cancel_none = 5 + 3   # ignores sub-column resets
+        assert Fat.value(exact) not in (collapsed_cancel_all,
+                                        collapsed_cancel_none)
+
+    def test_split_ambiguity_same_collapse_different_values(self):
+        """Two histories with IDENTICAL per-column collapse (sum=8,
+        max_seq=2) but different delta splits give different exact
+        values under the same prefix-1 reset — the collapse is not
+        merely lossy, it is value-ambiguous."""
+        hist_a = [("dot", ("dc1", 1), 5), ("dot", ("dc1", 2), 3)]
+        hist_b = [("dot", ("dc1", 1), 3), ("dot", ("dc1", 2), 5)]
+        reset = ("reset", (("dc1", 1),))
+        va = Fat.value(apply_all(hist_a + [reset]))
+        vb = Fat.value(apply_all(hist_b + [reset]))
+        assert (va, vb) == (3, 5)
+        assert va != vb
+
+    def test_concurrent_increment_survives_reset(self):
+        """The semantics the collapse must (and cannot) preserve: a
+        reset only cancels what it saw; the unobserved concurrent dot
+        survives on every replica, in either application order."""
+        inc_seen = ("dot", ("dc1", 1), 10)
+        inc_concurrent = ("dot", ("dc2", 1), 7)
+        reset = ("reset", (("dc1", 1),))
+        one = apply_all([inc_seen, reset, inc_concurrent])
+        two = apply_all([inc_seen, inc_concurrent, reset])
+        assert Fat.value(one) == Fat.value(two) == 7
+
+
+class TestPlaneRouting:
+    def test_device_plane_never_accepts_counter_fat(self):
+        from antidote_tpu.mat.device_plane import DevicePlane
+
+        plane = DevicePlane(key_capacity=16)
+        assert "counter_fat" not in plane.planes
+        assert not plane.accepts("counter_fat", "k")
+
+    def test_map_with_counter_fat_field_evicts_to_host(self):
+        """Maps route nested effects to sub-planes; a counter_fat field
+        must evict the whole map key to the host path."""
+        from antidote_tpu.mat.device_plane import DevicePlane
+
+        plane = DevicePlane(key_capacity=16)
+        assert "counter_fat" not in plane.planes["map_rr"].SUPPORTED

@@ -133,3 +133,57 @@ def test_http_exposition():
                 f"http://127.0.0.1:{srv.port}/nope", timeout=5)
     finally:
         srv.stop()
+
+
+class TestMonitoringStack:
+    """The packaged monitoring/ stack (reference monitoring/prometheus.yml
+    + Antidote-Dashboard.json) must stay wired to the node's actual
+    exposition: every metric the dashboard queries exists in the text a
+    live registry exposes."""
+
+    def _base_metrics(self):
+        import json
+        import os
+        import re
+
+        path = os.path.join(os.path.dirname(__file__), "..", "..",
+                            "monitoring", "antidote-tpu-dashboard.json")
+        dash = json.load(open(path))
+        names = set()
+        for p in dash["panels"]:
+            for t in p["targets"]:
+                names |= set(re.findall(
+                    r"\b(antidote_\w+|process_\w+)", t["expr"]))
+        return names, dash
+
+    def test_dashboard_metrics_exist_in_exposition(self):
+        from antidote_tpu import stats
+
+        text = stats.registry.exposition()
+        exposed = {line.split()[0].split("{")[0]
+                   for line in text.splitlines()
+                   if line and not line.startswith("#")}
+        names, _dash = self._base_metrics()
+        missing = set()
+        for n in names:
+            # histogram queries use _sum/_count series of the base name
+            base = n.removesuffix("_sum").removesuffix("_count")
+            if not any(e == n or e.startswith(base) for e in exposed):
+                missing.add(n)
+        assert not missing, f"dashboard queries unexposed metrics: {missing}"
+
+    def test_prometheus_config_names_the_node_job(self):
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..", "..",
+                            "monitoring", "prometheus.yml")
+        text = open(path).read()
+        assert "antidote_tpu" in text and "3001" in text
+
+    def test_dashboard_is_valid_grafana_schema(self):
+        names, dash = self._base_metrics()
+        assert dash["title"] and dash["panels"]
+        assert any("antidote_staleness" in n for n in names)
+        for p in dash["panels"]:
+            assert p["type"] in ("timeseries", "stat")
+            assert p["targets"], p["title"]
