@@ -144,18 +144,56 @@ class NodeLink:
     def _answer(self, origin, rid, kind: str, payload) -> bytes:
         """Run the handler at most once per (origin, rid): a client that
         lost the reply re-sends the same rid on a fresh connection and
-        gets the remembered answer, not a re-execution."""
+        gets the remembered answer, not a re-execution.  A retry that
+        lands while the FIRST execution is still running (connection
+        dropped mid-handler) parks on its in-flight marker instead of
+        re-executing concurrently."""
         with self._lock:
             cache = self._seen.setdefault(origin, {})
-            if rid in cache:
-                return cache[rid]
-        result = self._handler(origin, kind, payload)
-        reply = termcodec.encode(("ok", result))
+            entry = cache.get(rid)
+            if isinstance(entry, bytes):
+                return entry
+            owner = entry is None
+            if owner:
+                entry = threading.Event()
+                cache[rid] = entry
+        if not owner:
+            # a duplicate while the first execution is still running:
+            # park on its marker, then serve the owner's reply
+            entry.wait(timeout=self.request_timeout)
+            with self._lock:
+                got = cache.get(rid)
+            if isinstance(got, bytes):
+                return got
+            from antidote_tpu.cluster.remote import RemoteCallError
+
+            raise RemoteCallError(
+                "duplicate request: first execution failed or timed out")
+        try:
+            result = self._handler(origin, kind, payload)
+            reply = termcodec.encode(("ok", result))
+        except Exception:
+            with self._lock:
+                cache.pop(rid, None)  # errors are not cached (typed
+                # protocol errors are deterministic; infra errors should
+                # retry fresh)
+            entry.set()
+            raise
         with self._lock:
-            cache = self._seen.setdefault(origin, {})
-            while len(cache) >= _DEDUP_CAP:
-                cache.pop(next(iter(cache)))
+            # evict oldest COMPLETED replies only — popping another
+            # request's in-flight marker would orphan its waiters
+            if len(cache) >= _DEDUP_CAP:
+                stale = [k for k, v in cache.items()
+                         if isinstance(v, bytes)]
+                for k in stale[:len(cache) - _DEDUP_CAP + 1]:
+                    cache.pop(k)
+            # re-insert at the dict tail: overwriting the in-flight
+            # marker in place would leave a SLOW request's reply at its
+            # request-START position — first in line for eviction,
+            # exactly for the requests most likely to be retried
+            cache.pop(rid, None)
             cache[rid] = reply
+        entry.set()
         return reply
 
     # ------------------------------------------------------------- client

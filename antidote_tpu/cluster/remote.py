@@ -27,6 +27,7 @@ class RemoteCallError(RuntimeError):
 #: getattr-anything: the fabric is intra-DC but still a network surface.
 PARTITION_METHODS = frozenset({
     "read", "read_many", "read_with_writeset", "stage_update",
+    "stage_prepare", "stage_single_commit",
     "prepare", "commit", "abort", "single_commit", "min_prepared",
     "value_snapshot",
 })
@@ -34,6 +35,12 @@ PARTITION_METHODS = frozenset({
 
 class RemotePartition:
     """Duck-typed stand-in for PartitionManager on non-owned ring slots."""
+
+    #: the coordinator buffers this partition's writeset locally and
+    #: ships it WITH prepare / single-commit (one fabric round trip per
+    #: remote participant instead of one per update — the reference's
+    #: async-append shape, src/clocksi_interactive_coord.erl:514-577)
+    deferred_stage = True
 
     def __init__(self, link, owner_node, partition: int):
         self.link = link
@@ -72,6 +79,16 @@ class RemotePartition:
 
     def stage_update(self, txid, key, type_name: str, effect) -> None:
         self._call("stage_update", txid, key, type_name, effect)
+
+    def stage_prepare(self, txid, ops, snapshot_vc: VC,
+                      certify: bool = True) -> int:
+        return self._call("stage_prepare", txid,
+                          [tuple(o) for o in ops], snapshot_vc, certify)
+
+    def stage_single_commit(self, txid, ops, snapshot_vc: VC,
+                            certify: bool = True) -> int:
+        return self._call("stage_single_commit", txid,
+                          [tuple(o) for o in ops], snapshot_vc, certify)
 
     def prepare(self, txid, snapshot_vc: VC, certify: bool = True) -> int:
         return self._call("prepare", txid, snapshot_vc, certify)

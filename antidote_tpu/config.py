@@ -32,6 +32,14 @@ class Config:
     meta_sleep_s: float = 1.0
     #: partition VC push throttle, seconds (reference 100 ms)
     vc_push_s: float = 0.1
+    #: stable-snapshot read cache TTL, seconds.  Every transaction start
+    #: reads the stable snapshot; computing it sweeps all partitions'
+    #: min-prepared (a lock per partition — a convoy under concurrent
+    #: clients).  A stale-by-milliseconds stable snapshot is always
+    #: safe: stability is monotone, and the snapshot's own-DC entry is
+    #: bumped to `now` regardless (the reference reads a 1 s-cadence
+    #: gossiped value, far staler than this)
+    stable_ttl_s: float = 0.002
     #: inter-DC heartbeat period, seconds (reference ?HEARTBEAT_PERIOD
     #: 1 s, include/antidote.hrl:55)
     heartbeat_s: float = 1.0

@@ -34,7 +34,7 @@ from antidote_tpu.interdc.wire import InterDcTxn
 
 class DependencyGate:
     def __init__(self, pm, own_dc, now_us: Callable[[], int],
-                 batch_threshold: int = 48):
+                 batch_threshold: int = 48, adapt: bool = True):
         self.pm = pm  # PartitionManager
         self.own_dc = own_dc
         self.now_us = now_us
@@ -47,9 +47,20 @@ class DependencyGate:
         #: tap invoked after the partition VC advances (feeds the
         #: stable-time tracker, throttled by the caller if needed)
         self.on_clock_update: Callable[[], None] = lambda: None
-        #: queued-txn count at which process_queues switches from the
-        #: host head-walk to the one-shot device fixpoint
+        #: queued-txn count below which the host head-walk always runs
+        #: (dense packing overhead can never pay off on a few txns)
         self.batch_threshold = batch_threshold
+        #: above the threshold, pick the path by MEASURED per-txn cost
+        #: (EWMA), re-probing the out-of-favor path periodically — the
+        #: host/device crossover depends on platform and queue shape
+        #: (round-2 verdict: the fixed threshold lost to the host walk
+        #: in the measured CPU regime), so it is learned, not guessed.
+        #: ``adapt=False`` pins the path by threshold alone (benches).
+        self.adapt = adapt
+        self._cost_host: float | None = None
+        self._cost_batched: float | None = None
+        self._batched_warm = False
+        self._path_calls = 0
         self._last_proc_us = 0
 
     # ------------------------------------------------------------ clocks
@@ -94,8 +105,9 @@ class DependencyGate:
         self._last_proc_us = self.now_us()
         advanced_any = False
         while True:
-            if self.pending() >= self.batch_threshold:
-                advanced_any |= self._process_batched()
+            pend = self.pending()
+            if pend >= self.batch_threshold:
+                advanced_any |= self._timed_pass(pend)
             else:
                 advanced_any |= self._process_host()
             head_advanced = False
@@ -110,6 +122,44 @@ class DependencyGate:
             advanced_any = True  # clock moved: rerun, it may unblock
         if advanced_any:
             self.on_clock_update()
+
+    def _timed_pass(self, pend: int) -> bool:
+        """One above-threshold gating pass via the currently-favored
+        path, timing it to keep the per-txn cost estimates honest."""
+        import time as _time
+
+        use_batched = self._pick_batched()
+        t0 = _time.perf_counter()
+        advanced = (self._process_batched() if use_batched
+                    else self._process_host())
+        per = (_time.perf_counter() - t0) / pend
+        if use_batched:
+            if not self._batched_warm:
+                # the first batched pass pays the one-time XLA compile;
+                # seeding the EWMA with it would misjudge the device
+                # path by orders of magnitude
+                self._batched_warm = True
+                return advanced
+            self._cost_batched = per if self._cost_batched is None \
+                else 0.7 * self._cost_batched + 0.3 * per
+        else:
+            self._cost_host = per if self._cost_host is None \
+                else 0.7 * self._cost_host + 0.3 * per
+        return advanced
+
+    def _pick_batched(self) -> bool:
+        if not self.adapt:
+            return True
+        self._path_calls += 1
+        if self._cost_batched is None:
+            return True   # learn the device path first
+        if self._cost_host is None:
+            return False  # then the host path at the same scale
+        if self._path_calls % 32 == 0:
+            # periodic probe of the out-of-favor path: the crossover
+            # moves with queue depth and platform load
+            return self._cost_batched >= self._cost_host
+        return self._cost_batched < self._cost_host
 
     def _process_host(self) -> bool:
         advanced = False

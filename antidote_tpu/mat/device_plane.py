@@ -58,9 +58,13 @@ _MIN_BUCKET = 64
 
 
 def _bucket(n: int) -> int:
+    # powers of FOUR: each padded shape is a distinct XLA program, and
+    # concurrent clients produce arbitrary flush sizes — quantizing
+    # coarser keeps the program count (hence in-run compiles) small at
+    # the cost of ≤4x padding on the rare odd-sized batch
     b = _MIN_BUCKET
     while b < n:
-        b *= 2
+        b *= 4
     return b
 
 
@@ -324,8 +328,16 @@ class _PlaneBase:
             return
         rows, self.rows = self.rows, []
         self.pending_keys.clear()
+        # chunk at the configured batch size: a backlog above flush_ops
+        # would otherwise pad to a LARGER bucket and compile a fresh XLA
+        # program mid-run (one 700ms stall per new shape on CPU); the
+        # chunk size is the intended steady-state batch anyway
+        step = max(self.flush_ops, _MIN_BUCKET)
+        overflow = np.zeros(len(rows), dtype=bool)
         with tracing.annotate(f"device_flush:{self.type_name}"):
-            overflow = self._append_rows(rows)
+            for i in range(0, len(rows), step):
+                overflow[i:i + step] = self._append_rows(
+                    rows[i:i + step])
         self._ops_since_gc += len(rows)
         if overflow.any():
             retry = [r for r, o in zip(rows, overflow) if o]
@@ -1212,6 +1224,294 @@ _BOTTOM = {
 }
 
 
+class RgaPlane(_PlaneBase):
+    """Device plane for rga — one VC-aware incremental store per key
+    (antidote_tpu/mat/rga_store.py: folded base + op window with full
+    commit-VC lanes).
+
+    Documents are independent trees, so unlike the slotted planes there
+    is no cross-key shard array: ``self.st`` maps key index -> its
+    RgaStoreState, and a read folds exactly one document.  The
+    reconstruction is EXACT host-oracle state — ``(uid, elem, visible)``
+    tuples in RGA order including tombstones (crdt/rga.py) — so value
+    reads AND downstream generation (positions over visible vertices,
+    lamport max) are served from the device; rga is therefore NOT in
+    STATE_LOSSY.
+
+    Host directories per key: actor strings intern into the uid's
+    ``actor_bits`` field (ids from 1; 0 is the root sentinel), elements
+    into int32 ids.  A key evicts to the host path when its actors
+    exceed 2^bits - 1 or a lamport would overflow the packed-uid width
+    (reference materializer serves every type through one path,
+    src/materializer_vnode.erl:56-110 — eviction is this plane's
+    capacity escape hatch, like the slotted planes')."""
+
+    type_name = "rga"
+
+    def __init__(self, domain, key_capacity, flush_ops, gc_ops, max_dcs,
+                 pb: int = 256, nw: int = 256, md: int = 64,
+                 actor_bits: int = 8):
+        self.pb0, self.nw0, self.md0 = pb, nw, md
+        self.actor_bits = actor_bits
+        self._max_lam = 1 << (31 - actor_bits)
+        #: per-key interning (index-aligned with rev_keys)
+        self.actor_index: List[dict] = []
+        self.rev_actors: List[list] = []
+        self.elem_index: List[dict] = []
+        self.rev_elems: List[list] = []
+        super().__init__(domain, key_capacity, 1, flush_ops, gc_ops,
+                         max_dcs)
+
+    # -- storage hooks ------------------------------------------------------
+
+    def _init_state(self, key_capacity):
+        return {}  # key idx -> RgaStoreState
+
+    def _grow_keys(self, new_k):
+        pass  # dict-backed: nothing to repack
+
+    def _grow_dcs(self, new_d):
+        from antidote_tpu.mat import rga_store
+
+        self.st = {i: rga_store.rga_grow(s, n_dcs=new_d)
+                   for i, s in self.st.items()}
+
+    def _key_idx(self, key):
+        idx = self.key_index.get(key)
+        if idx is None:
+            from antidote_tpu.mat import rga_store
+
+            idx = len(self.rev_keys)
+            self.key_index[key] = idx
+            self.rev_keys.append(key)
+            self.actor_index.append({})
+            self.rev_actors.append([])
+            self.elem_index.append({})
+            self.rev_elems.append([])
+            self.st[idx] = rga_store.rga_store_init(
+                self.pb0, self.nw0, self.md0, n_dcs=self.domain.d,
+                actor_bits=self.actor_bits)
+        return idx
+
+    def _purge_idx(self, idx):
+        self.st.pop(idx, None)
+        self.actor_index[idx] = {}
+        self.rev_actors[idx] = []
+        self.elem_index[idx] = {}
+        self.rev_elems[idx] = []
+
+    # -- interning ----------------------------------------------------------
+
+    def _actor_id(self, idx, actor) -> Optional[int]:
+        """Interned actor id, kept in ACTOR-STRING order: sibling order
+        is packed-uid-desc and the host oracle breaks lamport ties by
+        the actor string, so ids must sort like the strings or replicas
+        interning in different arrival orders diverge on concurrent
+        same-lamport inserts (caught by the chaos suite).  An
+        out-of-order arrival re-interns and remaps the document
+        (rga_store.rga_remap_actors)."""
+        d = self.actor_index[idx]
+        a = d.get(actor)
+        if a is not None:
+            return a
+        if len(d) >= (1 << self.actor_bits) - 1:
+            return None  # uid width exhausted — evict
+        rev = self.rev_actors[idx]
+        if not rev or actor > rev[-1]:
+            a = len(d) + 1
+            d[actor] = a
+            rev.append(actor)
+            return a
+        # re-intern in sorted order and remap the device state + any
+        # staged rows of this key
+        from antidote_tpu.mat import rga_store
+
+        new_rev = sorted(rev + [actor])
+        perm = np.zeros(1 << self.actor_bits, dtype=np.int32)
+        new_ids = {s: i + 1 for i, s in enumerate(new_rev)}
+        for s, old in d.items():
+            perm[old] = new_ids[s]
+        self.actor_index[idx] = new_ids
+        self.rev_actors[idx] = new_rev
+        st = self.st.get(idx)
+        if st is not None:
+            self.st[idx] = rga_store.rga_remap_actors(st, perm)
+        remapped = []
+        for r in self.rows:
+            if r[0] == idx:
+                r = (r[0], r[1], r[2], int(perm[r[3]]), r[4],
+                     int(perm[r[5]]), *r[6:])
+            remapped.append(r)
+        self.rows = remapped
+        return new_ids[actor]
+
+    def _elem_id(self, idx, elem) -> int:
+        d = self.elem_index[idx]
+        e = d.get(elem)
+        if e is None:
+            e = len(self.rev_elems[idx])
+            d[elem] = e
+            self.rev_elems[idx].append(elem)
+        return e
+
+    # -- write path ---------------------------------------------------------
+
+    def stage(self, key, payload: Payload) -> None:
+        idx = self._key_idx(key)
+        eff = payload.effect
+        op_dc_col = self._dc_col(payload.commit_dc)
+        ss_pairs = self._ss_pairs(payload.snapshot_vc)
+        if op_dc_col is None or ss_pairs is None:
+            self.evict(key)
+            return
+        if eff[0] == "ins":
+            _, uid, ref, elem = eff
+            lam, actor = uid
+            rlam, ract_raw = (0, 0) if ref == (0, "") else ref
+            act = self._actor_id(idx, actor)
+            ract = 0 if rlam == 0 and ract_raw == 0 \
+                else self._actor_id(idx, ract_raw)
+            if act is None or ract is None \
+                    or lam >= self._max_lam or rlam >= self._max_lam:
+                self.evict(key)
+                return
+            row = (idx, 0, int(lam), act, int(rlam), ract,
+                   self._elem_id(idx, elem), op_dc_col,
+                   int(payload.commit_time), ss_pairs)
+        elif eff[0] == "rm":
+            _, uid = eff
+            lam, actor = uid
+            act = self._actor_id(idx, actor)
+            if act is None or lam >= self._max_lam:
+                self.evict(key)
+                return
+            row = (idx, 1, int(lam), act, 0, 0, 0, op_dc_col,
+                   int(payload.commit_time), ss_pairs)
+        else:
+            self.evict(key)
+            return
+        self._commit_rows(key, idx, [row])
+
+    def _append_rows(self, rows: List[tuple]) -> np.ndarray:
+        """Per-key grouped append into each document's window; a full
+        window folds at the newest stable horizon and/or grows — this
+        plane's appends never report overflow (capacity misses evict at
+        stage time)."""
+        from antidote_tpu.mat import rga_store
+
+        overflow = np.zeros(len(rows), dtype=bool)
+        by_idx: Dict[int, list] = {}
+        for r in rows:
+            by_idx.setdefault(r[0], []).append(r)
+        d = self.domain.d
+        for idx, group in by_idx.items():
+            st = self.st.get(idx)
+            if st is None:
+                continue  # evicted while staged; log replay covers it
+            ins = [r for r in group if r[1] == 0]
+            dels = [r for r in group if r[1] == 1]
+
+            def col(rs, j, dt=np.int32):
+                return jnp.asarray(np.asarray([r[j] for r in rs],
+                                              dtype=dt))
+
+            def ss(rs):
+                m = np.zeros((len(rs), d), dtype=np.int64)
+                for i, r in enumerate(rs):
+                    for c, t in r[9]:
+                        m[i, c] = max(m[i, c], t)
+                return jnp.asarray(m)
+
+            args = (col(ins, 2), col(ins, 3), col(ins, 4), col(ins, 5),
+                    col(ins, 6), col(ins, 7), col(ins, 8, np.int64),
+                    ss(ins),
+                    col(dels, 2), col(dels, 3), col(dels, 7),
+                    col(dels, 8, np.int64), ss(dels))
+            st, ok = rga_store.rga_append(st, *args)
+            if not bool(ok):
+                # fold what is stable, then grow to fit the backlog
+                if self._last_stable is not None:
+                    pairs = self._ss_pairs(self._last_stable)
+                    if pairs is not None:
+                        st = rga_store.rga_fold_host(
+                            st, self._dense_vc(pairs))
+                        # the physical base advanced: reads below this
+                        # horizon must take the log-replay path from now
+                        # on (_read_vc_dense checks _base_vc)
+                        self._base_vc = self._base_vc.join(
+                            self._last_stable)
+                        self._has_base = True
+                need_w = int(st.wn) + len(ins)
+                need_d = int(st.dn) + len(dels)
+                nw = st.nw
+                while nw < need_w:
+                    nw *= 2
+                md = st.md
+                while md < need_d:
+                    md *= 2
+                st = rga_store.rga_grow(st, nw=nw, md=md)
+                st, ok = rga_store.rga_append(st, *args)
+                assert bool(ok), "rga append must fit after grow"
+            self.st[idx] = st
+        return overflow
+
+    def _device_gc(self, gst_dense):
+        from antidote_tpu.mat import rga_store
+
+        for idx, st in list(self.st.items()):
+            if int(st.wn) == 0 and int(st.dn) == 0:
+                continue  # quiescent document: nothing to fold
+            self.st[idx] = rga_store.rga_fold_host(st, gst_dense)
+
+    # -- read path ----------------------------------------------------------
+
+    def _reader(self, st, idx, rv):
+        from antidote_tpu.mat import rga_store
+
+        sti = st[idx]
+        actors = list(self.rev_actors[idx])
+        elems = list(self.rev_elems[idx])
+
+        def run():
+            lam, act, elem, vis, n = rga_store.rga_read(
+                sti, jnp.asarray(rv))
+            lam = np.asarray(lam)
+            act = np.asarray(act)
+            elem = np.asarray(elem)
+            vis = np.asarray(vis)
+            n = int(n)
+            # present vertices sort to the front in document order
+            return tuple(
+                ((int(lam[i]), actors[int(act[i]) - 1]),
+                 elems[int(elem[i])], bool(vis[i]))
+                for i in range(n))
+
+        return run
+
+    def _many_reader(self, st, owned, idxs, pad, rv):
+        readers = [(k, self._reader(st, int(i), rv))
+                   for k, i in zip(owned, idxs)]
+
+        def run():
+            return {k: r() for k, r in readers}
+
+        return run
+
+    def read_many_begin(self, keys: list, read_vc: Optional[VC]):
+        """Documents fold one device call each (independent trees — no
+        cross-key batching), so the base's padded-idx plumbing reduces
+        to a reader per owned key."""
+        if self.pending_keys and not self.pending_keys.isdisjoint(keys):
+            self.flush()
+        owned = [k for k in keys if k in self.key_index]
+        if not owned:
+            return dict
+        rv = self._read_vc_dense(read_vc)
+        idxs = np.asarray([self.key_index[k] for k in owned],
+                          dtype=np.int32)
+        return self._many_reader(self.st, owned, idxs, idxs, rv)
+
+
 class MapPlane:
     """Field-composite device plane for map_go / map_rr.
 
@@ -1471,6 +1771,8 @@ class DevicePlane:
         self.planes["map_go"] = MapPlane(
             "map_go", make, make_presence=lambda: make("set_go"))
         self.planes["map_rr"] = MapPlane("map_rr", make)
+        self.planes["rga"] = RgaPlane(
+            ClockDomain(8), key_capacity, flush_ops, gc_ops, max_dcs)
         #: keys evicted to the host path (sticky)
         self.host_only: set = set()
         #: types whose dense representation collapses dot sets per DC —

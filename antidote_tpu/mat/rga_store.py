@@ -38,9 +38,14 @@ contract as the OR-Set store, mat/store.py):
 - no stable op is still in flight, so folded positions are final.
 
 All shapes are static (PB base rows, NW window lanes, MD delete lanes);
-capacity growth is a host-side repack.  Commit stamps are scalar int32
-(the caller maps its VC-stability horizon to a scalar frontier, as the
-config-4 bench does with per-op commit indices).
+capacity growth is a host-side repack.  Window and delete lanes carry
+FULL commit vector clocks (origin column, commit time, snapshot VC
+columns), so a read materializes exactly the snapshot's inclusion set —
+``op in snapshot iff commit_vc(op) <= read_vc`` (the reference
+materializer rule, src/materializer.erl:101-106) — and the fold horizon
+is the gossiped dense GST, the same contract as the OR-Set store
+(mat/store.py orset_gc).  Reads below the folded base are the caller's
+log-replay case (DevicePlane ReadBelowBase).
 """
 
 from __future__ import annotations
@@ -52,6 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from antidote_tpu.clocks import dense
 from antidote_tpu.mat import rga_kernel
 from antidote_tpu.mat.rga_kernel import _I32MAX, pack_uid
 
@@ -85,12 +91,16 @@ class RgaStoreState:
     wrlam: jax.Array      # int32[NW] left-neighbour ref (0 = head)
     wract: jax.Array      # int32[NW]
     welem: jax.Array      # int32[NW]
-    wcommit: jax.Array    # int32[NW] scalar commit stamp
+    wdc: jax.Array        # int32[NW] origin DC column
+    wct: jax.Array        # int64[NW] commit time
+    wss: jax.Array        # int64[NW, D] snapshot VC columns
     wn: jax.Array         # int32[]
     # pending delete lanes
     dlam: jax.Array       # int32[MD]
     dact: jax.Array       # int32[MD]
-    dcommit: jax.Array    # int32[MD]
+    ddc: jax.Array        # int32[MD]
+    dct: jax.Array        # int64[MD]
+    dss: jax.Array        # int64[MD, D]
     dn: jax.Array         # int32[]
     actor_bits: int
 
@@ -106,20 +116,26 @@ class RgaStoreState:
     def md(self) -> int:
         return self.dlam.shape[0]
 
+    @property
+    def d(self) -> int:
+        return self.wss.shape[1]
+
 
 jax.tree_util.register_dataclass(
     RgaStoreState,
     data_fields=["buid", "bparent", "belem", "blive", "bsub_end", "bn",
                  "bsort_uid", "bsort_pos", "ckey", "cpos",
-                 "wlam", "wact", "wrlam", "wract", "welem", "wcommit",
-                 "wn", "dlam", "dact", "dcommit", "dn"],
+                 "wlam", "wact", "wrlam", "wract", "welem",
+                 "wdc", "wct", "wss", "wn",
+                 "dlam", "dact", "ddc", "dct", "dss", "dn"],
     meta_fields=["actor_bits"],
 )
 
 
-def rga_store_init(pb: int, nw: int, md: int,
+def rga_store_init(pb: int, nw: int, md: int, n_dcs: int = 1,
                    actor_bits: int = 8) -> RgaStoreState:
     i32 = lambda shape, fill=0: jnp.full(shape, fill, jnp.int32)
+    i64 = lambda shape, fill=0: jnp.full(shape, fill, jnp.int64)
     return RgaStoreState(
         buid=i32((pb,), _I32MAX), bparent=i32((pb,)), belem=i32((pb,)),
         blive=jnp.zeros((pb,), bool), bsub_end=i32((pb,)),
@@ -127,9 +143,11 @@ def rga_store_init(pb: int, nw: int, md: int,
         bsort_uid=i32((pb,), _I32MAX), bsort_pos=i32((pb,)),
         ckey=jnp.full((pb,), _I64MAX, jnp.int64), cpos=i32((pb,)),
         wlam=i32((nw,)), wact=i32((nw,)), wrlam=i32((nw,)),
-        wract=i32((nw,)), welem=i32((nw,)), wcommit=i32((nw,)),
+        wract=i32((nw,)), welem=i32((nw,)),
+        wdc=i32((nw,)), wct=i64((nw,)), wss=i64((nw, n_dcs)),
         wn=jnp.zeros((), jnp.int32),
-        dlam=i32((md,)), dact=i32((md,)), dcommit=i32((md,)),
+        dlam=i32((md,)), dact=i32((md,)),
+        ddc=i32((md,)), dct=i64((md,)), dss=i64((md, n_dcs)),
         dn=jnp.zeros((), jnp.int32),
         actor_bits=actor_bits,
     )
@@ -143,47 +161,69 @@ def _ckey_pack(parent_uid, uid):
 
 @partial(jax.jit, donate_argnums=(0,))
 def rga_append(st: RgaStoreState, ins_lamport, ins_actor, ref_lamport,
-               ref_actor, elem, ins_commit, del_lamport, del_actor,
-               del_commit):
+               ref_actor, elem, ins_dc, ins_ct, ins_ss,
+               del_lamport, del_actor, del_dc, del_ct, del_ss):
     """Append one op block (B insert lanes + C delete lanes) into the
-    window.  Returns (state, ok) — ok=False means the window or delete
-    lanes are full: the caller folds (or grows) and retries."""
+    window, each lane carrying its full commit VC (origin column,
+    commit time, snapshot columns).  Returns (state, ok) — ok=False
+    means the window or delete lanes are full: the caller folds (or
+    grows) and retries."""
     b = ins_lamport.shape[0]
     c = del_lamport.shape[0]
     ok = (st.wn + b <= st.nw) & (st.dn + c <= st.md)
     i32 = lambda a: a.astype(jnp.int32)
+    i64 = lambda a: a.astype(jnp.int64)
 
-    def put(dst, src):
-        upd = jax.lax.dynamic_update_slice(
-            dst, i32(src), (jnp.where(ok, st.wn, 0),))
+    def put_at(dst, src, n, cast):
+        zero = jnp.zeros((), n.dtype)
+        start = (jnp.where(ok, n, zero),) + (zero,) * (dst.ndim - 1)
+        upd = jax.lax.dynamic_update_slice(dst, cast(src), start)
         return jnp.where(ok, upd, dst)
 
-    def putd(dst, src):
-        upd = jax.lax.dynamic_update_slice(
-            dst, i32(src), (jnp.where(ok, st.dn, 0),))
-        return jnp.where(ok, upd, dst)
+    put = lambda dst, src: put_at(dst, src, st.wn, i32)
+    put64 = lambda dst, src: put_at(dst, src, st.wn, i64)
+    putd = lambda dst, src: put_at(dst, src, st.dn, i32)
+    putd64 = lambda dst, src: put_at(dst, src, st.dn, i64)
 
     return replace(
         st,
         wlam=put(st.wlam, ins_lamport), wact=put(st.wact, ins_actor),
         wrlam=put(st.wrlam, ref_lamport), wract=put(st.wract, ref_actor),
-        welem=put(st.welem, elem), wcommit=put(st.wcommit, ins_commit),
+        welem=put(st.welem, elem),
+        wdc=put(st.wdc, ins_dc), wct=put64(st.wct, ins_ct),
+        wss=put64(st.wss, ins_ss),
         wn=jnp.where(ok, st.wn + b, st.wn),
         dlam=putd(st.dlam, del_lamport), dact=putd(st.dact, del_actor),
-        dcommit=putd(st.dcommit, del_commit),
+        ddc=putd(st.ddc, del_dc), dct=putd64(st.dct, del_ct),
+        dss=putd64(st.dss, del_ss),
         dn=jnp.where(ok, st.dn + c, st.dn),
     ), ok
 
 
+def _included(ss, dc, ct, rv):
+    """bool[N]: commit_vc(op) <= rv columnwise (the materializer
+    inclusion rule over dense lanes)."""
+    cvc = dense.commit_vc(ss, dc, ct)
+    return jnp.all(cvc <= rv[None, :].astype(jnp.int64), axis=1)
+
+
 @jax.jit
-def rga_read(st: RgaStoreState):
-    """Materialize the document: merge the window forest and splice it
-    into the base preorder.  Returns (doc int32[PB+NW] padded with -1,
-    n_visible int32)."""
+def rga_read(st: RgaStoreState, read_vc):
+    """Materialize the full RGA state at dense snapshot ``read_vc``
+    (int64[D]): merge the snapshot-included window forest and splice it
+    into the base preorder.  Returns ``(lam, act, elem, vis, n)`` —
+    int32[PB+NW] arrays in document order INCLUDING tombstones (vis
+    False), n = number of present vertices — i.e. exactly the host
+    oracle's state tuple (crdt/rga.py), so downstream generation can
+    read this reconstruction (positions index visible vertices; lamport
+    max ranges over all).  Requires read_vc >= the fold horizon (the
+    caller's ReadBelowBase contract): every base row is in-snapshot by
+    construction."""
     nw, pb = st.nw, st.pb
     bits = st.actor_bits
     lanes = jnp.arange(nw, dtype=jnp.int32)
-    in_window = lanes < st.wn
+    winc = _included(st.wss, st.wdc, st.wct, read_vc)
+    in_window = (lanes < st.wn) & winc
 
     wuid = pack_uid(st.wlam, st.wact, bits)
     # park invalid lanes, duplicates of base rows, and in-window dups
@@ -229,9 +269,12 @@ def rga_read(st: RgaStoreState):
         ref == 0, st.bn, st.bsub_end[jnp.clip(anchor_pos, 0, pb - 1)])
     splice = jnp.where(chit, st.cpos[cic], sub_end)       # [NW] (roots)
 
-    # pending deletes: hide window and base targets
+    # pending deletes: hide window and base targets (snapshot-included
+    # deletes only — a tombstone newer than the read snapshot must not
+    # hide its target yet)
     duid = pack_uid(st.dlam, st.dact, bits)
-    dvalid = jnp.arange(st.md, dtype=jnp.int32) < st.dn
+    dvalid = (jnp.arange(st.md, dtype=jnp.int32) < st.dn) \
+        & _included(st.dss, st.ddc, st.dct, read_vc)
     dwp = jnp.searchsorted(sorted_uid, duid)
     dwc = jnp.clip(dwp, 0, nw - 1)
     dwhit = dvalid & (dwp < nw) & (sorted_uid[dwc] == duid)
@@ -242,9 +285,13 @@ def rga_read(st: RgaStoreState):
         jnp.where(dvalid & dbhit, st.bsort_pos[dbidx], pb)
     ].set(True, mode="drop")
 
-    visible_w = reachable & ~deleted_w
     bpos_arr = jnp.arange(pb, dtype=jnp.int32)
-    visible_b = st.blive & (bpos_arr < st.bn) & ~hidden_b
+    # presence = in the RGA state (tombstones included, as the host
+    # oracle keeps them); visibility = live and not hidden at snapshot
+    present_b = bpos_arr < st.bn
+    present_w = reachable
+    visible_w = present_w & ~deleted_w
+    visible_b = st.blive & present_b & ~hidden_b
 
     # final order: (splice_pos, tier, uid desc among roots, tour rank)
     rshift = max(1, (2 * (nw + 1)).bit_length())
@@ -254,15 +301,35 @@ def rga_read(st: RgaStoreState):
     w_secondary = ((jnp.int64(_I32MAX) - ruid.astype(jnp.int64))
                    << rshift) | rank.astype(jnp.int64)
     primary = jnp.concatenate([
-        jnp.where(visible_b, b_primary, _I64MAX),
-        jnp.where(visible_w, w_primary, _I64MAX)])
+        jnp.where(present_b, b_primary, _I64MAX),
+        jnp.where(present_w, w_primary, _I64MAX)])
     secondary = jnp.concatenate(
         [jnp.zeros((pb,), jnp.int64), w_secondary])
     perm = rga_kernel._lexsort2(primary, secondary)
+    mask32 = (1 << bits) - 1
+    lam_all = jnp.concatenate(
+        [(st.buid >> bits) & (_I32MAX >> bits), st.wlam])
+    act_all = jnp.concatenate([st.buid & mask32, st.wact])
     elems = jnp.concatenate([st.belem, st.welem])
-    vis = jnp.concatenate([visible_b, visible_w])[perm]
-    doc = jnp.where(vis, elems[perm], -1)
-    return doc, (jnp.sum(visible_b) + jnp.sum(visible_w)).astype(jnp.int32)
+    present = jnp.concatenate([present_b, present_w])[perm]
+    vis = jnp.concatenate([visible_b, visible_w])[perm] & present
+    lam = jnp.where(present, lam_all[perm], 0)
+    act = jnp.where(present, act_all[perm], 0)
+    elem_out = jnp.where(present, elems[perm], 0)
+    n = jnp.sum(present).astype(jnp.int32)
+    return lam, act, elem_out, vis, n
+
+
+@jax.jit
+def rga_read_doc(st: RgaStoreState, read_vc):
+    """Visible document only: (doc int32[PB+NW] padded with -1,
+    n_visible) — the bench-facing view over :func:`rga_read`."""
+    lam, act, elem, vis, _n = rga_read(st, read_vc)
+    order = jnp.argsort(~vis, stable=True)
+    n_vis = jnp.sum(vis).astype(jnp.int32)
+    doc = jnp.where(jnp.arange(vis.shape[0]) < n_vis,
+                    elem[order], -1)
+    return doc, n_vis
 
 
 def _bsearch_hit(sorted_arr, q):
@@ -332,19 +399,19 @@ def _window_tour(parent_key, uid, valid, is_root, nw):
 
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=())
-def rga_fold(st: RgaStoreState, threshold):
-    """Fold window ops with commit <= threshold into the base: one full
-    merge over base + stable window (the amortized GC; tombstoned
-    vertices keep their rows as anchors), then compact the window to its
-    unstable suffix.  Requires the folded base to fit PB rows (the host
-    wrapper grows first; see rga_fold_host)."""
+def rga_fold(st: RgaStoreState, gst):
+    """Fold window ops whose commit VC <= the dense GST (int64[D]) into
+    the base: one full merge over base + stable window (the amortized
+    GC; tombstoned vertices keep their rows as anchors), then compact
+    the window to its unstable suffix.  Requires the folded base to fit
+    PB rows (the host wrapper grows first; see rga_fold_host)."""
     nw, pb, md = st.nw, st.pb, st.md
     bits = st.actor_bits
     mask32 = (1 << bits) - 1
 
     lanes = jnp.arange(nw, dtype=jnp.int32)
     in_window = lanes < st.wn
-    stable_w = in_window & (st.wcommit <= threshold)
+    stable_w = in_window & _included(st.wss, st.wdc, st.wct, gst)
     # duplicate deliveries of base rows must not re-enter the merge (a
     # kept window copy would shadow the base row's tombstone flag);
     # they are dropped from the window instead
@@ -352,7 +419,7 @@ def rga_fold(st: RgaStoreState, threshold):
     base_dup = in_window & _bsearch_hit(st.bsort_uid, wuid_w)[0]
     stable_w = stable_w & ~base_dup
     dlanes = jnp.arange(md, dtype=jnp.int32)
-    stable_d = (dlanes < st.dn) & (st.dcommit <= threshold)
+    stable_d = (dlanes < st.dn) & _included(st.dss, st.ddc, st.dct, gst)
 
     bpos = jnp.arange(pb, dtype=jnp.int32)
     in_base = bpos < st.bn
@@ -409,11 +476,18 @@ def rga_fold(st: RgaStoreState, threshold):
     keep_w = in_window & ~stable_w & ~base_dup
     worder = jnp.argsort(~keep_w, stable=True)
     wn_new = jnp.sum(keep_w).astype(jnp.int32)
-    cw = lambda a: jnp.where(jnp.arange(nw) < wn_new, a[worder], 0)
+    def _compact(order, n_new, size):
+        def go(a):
+            live = jnp.arange(size) < n_new
+            m = live.reshape((size,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, a[order], 0)
+        return go
+
+    cw = _compact(worder, wn_new, nw)
     keep_d = (dlanes < st.dn) & ~stable_d
     dorder = jnp.argsort(~keep_d, stable=True)
     dn_new = jnp.sum(keep_d).astype(jnp.int32)
-    cd = lambda a: jnp.where(jnp.arange(md) < dn_new, a[dorder], 0)
+    cd = _compact(dorder, dn_new, md)
 
     return replace(
         st,
@@ -421,26 +495,35 @@ def rga_fold(st: RgaStoreState, threshold):
         bsub_end=bsub_end, bn=n_new,
         bsort_uid=bsort_uid, bsort_pos=bsort_pos, ckey=ckey, cpos=cpos,
         wlam=cw(st.wlam), wact=cw(st.wact), wrlam=cw(st.wrlam),
-        wract=cw(st.wract), welem=cw(st.welem), wcommit=cw(st.wcommit),
+        wract=cw(st.wract), welem=cw(st.welem),
+        wdc=cw(st.wdc), wct=cw(st.wct), wss=cw(st.wss),
         wn=wn_new,
-        dlam=cd(st.dlam), dact=cd(st.dact), dcommit=cd(st.dcommit),
+        dlam=cd(st.dlam), dact=cd(st.dact),
+        ddc=cd(st.ddc), dct=cd(st.dct), dss=cd(st.dss),
         dn=dn_new,
     ), n_new
 
 
 def rga_grow(st: RgaStoreState, pb: int | None = None,
-             nw: int | None = None, md: int | None = None) -> RgaStoreState:
+             nw: int | None = None, md: int | None = None,
+             n_dcs: int | None = None) -> RgaStoreState:
     """Host-side capacity regrade (never shrinks); rare."""
     pb = max(pb or st.pb, st.pb)
     nw = max(nw or st.nw, st.nw)
     md = max(md or st.md, st.md)
-    if (pb, nw, md) == (st.pb, st.nw, st.md):
+    d = max(n_dcs or st.d, st.d)
+    if (pb, nw, md, d) == (st.pb, st.nw, st.md, st.d):
         return st
 
     def pad(a, n, fill=0):
         a = np.asarray(a)
         return jnp.asarray(np.pad(a, (0, n - len(a)),
                                   constant_values=fill))
+
+    def pad2(a, n, cols):
+        a = np.asarray(a)
+        return jnp.asarray(np.pad(
+            a, ((0, n - a.shape[0]), (0, cols - a.shape[1]))))
 
     return RgaStoreState(
         buid=pad(st.buid, pb, _I32MAX), bparent=pad(st.bparent, pb),
@@ -451,21 +534,71 @@ def rga_grow(st: RgaStoreState, pb: int | None = None,
         ckey=pad(st.ckey, pb, int(_I64MAX)), cpos=pad(st.cpos, pb),
         wlam=pad(st.wlam, nw), wact=pad(st.wact, nw),
         wrlam=pad(st.wrlam, nw), wract=pad(st.wract, nw),
-        welem=pad(st.welem, nw), wcommit=pad(st.wcommit, nw), wn=st.wn,
+        welem=pad(st.welem, nw),
+        wdc=pad(st.wdc, nw), wct=pad(st.wct, nw),
+        wss=pad2(st.wss, nw, d), wn=st.wn,
         dlam=pad(st.dlam, md), dact=pad(st.dact, md),
-        dcommit=pad(st.dcommit, md), dn=st.dn,
+        ddc=pad(st.ddc, md), dct=pad(st.dct, md),
+        dss=pad2(st.dss, md, d), dn=st.dn,
         actor_bits=st.actor_bits,
     )
 
 
-def rga_fold_host(st: RgaStoreState, threshold: int):
+def rga_remap_actors(st: RgaStoreState, perm) -> RgaStoreState:
+    """Rewrite every packed actor id through ``perm`` (int32[2^bits],
+    old id -> new id, 0 -> 0) and re-derive the base order.
+
+    Needed because sibling order is uid-DESC and the host oracle breaks
+    lamport ties by ACTOR STRING: the device's interned ids must order
+    like the strings, so when a new actor arrives that does not sort
+    after all existing ones, the owner re-interns in sorted order and
+    remaps the document (actors per document are few — DC/node ids — so
+    this is rare and bounded).  The base preorder depends on sibling
+    order, hence the re-merge via a zero-horizon fold after the id
+    rewrite."""
+    bits = st.actor_bits
+    mask = (1 << bits) - 1
+    pm = jnp.asarray(perm, jnp.int32)
+
+    def remap_uid(uid_arr):
+        out = ((uid_arr >> bits) << bits) | pm[uid_arr & mask]
+        return jnp.where(uid_arr == _I32MAX, _I32MAX, out)
+
+    buid = remap_uid(st.buid)
+    bparent = remap_uid(st.bparent)
+    pos = jnp.arange(st.pb, dtype=jnp.int32)
+    sort_perm = jnp.argsort(buid)
+    in_base = pos < st.bn
+    ck = jnp.where(in_base.astype(jnp.int64) > 0,
+                   _ckey_pack(bparent, buid), _I64MAX)
+    ck_perm = jnp.argsort(ck)
+    st = replace(
+        st,
+        buid=buid, bparent=bparent,
+        bsort_uid=buid[sort_perm], bsort_pos=pos[sort_perm],
+        ckey=ck[ck_perm], cpos=pos[ck_perm],
+        wact=pm[st.wact], wract=pm[st.wract], dact=pm[st.dact],
+    )
+    # zero-horizon fold: folds nothing from the window (commit times are
+    # positive) but re-merges the base rows, rebuilding the preorder and
+    # subtree extents under the remapped sibling order
+    st, _bn = rga_fold(st, jnp.zeros((st.d,), jnp.int64))
+    return st
+
+
+def rga_fold_host(st: RgaStoreState, gst) -> RgaStoreState:
     """Host wrapper around :func:`rga_fold`: grows the base first when
-    the folded document might not fit (worst case bn + stable window)."""
+    the folded document might not fit (worst case bn + stable window).
+    ``gst`` is the dense stable VC (int64[D]); a scalar is treated as a
+    single-column horizon for the simulation benches."""
+    gst = np.asarray(gst, dtype=np.int64).reshape(-1)
+    if gst.shape[0] != st.d:
+        gst = np.pad(gst, (0, st.d - gst.shape[0]))
     need = int(st.bn) + int(st.wn)
     if need > st.pb:
         new_pb = st.pb
         while new_pb < need:
             new_pb *= 2
         st = rga_grow(st, pb=new_pb)
-    st, _bn = rga_fold(st, jnp.asarray(threshold, jnp.int32))
+    st, _bn = rga_fold(st, jnp.asarray(gst))
     return st

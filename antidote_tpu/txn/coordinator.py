@@ -16,7 +16,8 @@ protocol is a plain object driven synchronously by the caller:
 
 from __future__ import annotations
 
-import uuid
+import itertools
+import os
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
@@ -70,11 +71,68 @@ class Transaction:
     partitions: List[int] = field(default_factory=list)
     #: (bucket, key, type_name, op) for post-commit hooks
     client_ops: List[Tuple] = field(default_factory=list)
+    #: partition -> [(key, type_name, effect)] buffered for DEFERRED
+    #: staging (remote partitions: shipped with prepare/single-commit
+    #: in one fabric round trip)
+    deferred_ops: Dict[int, List[Tuple]] = field(default_factory=dict)
+    #: True while this txn holds the node's TxnGate shared (from first
+    #: staged mutation to commit/abort) — live handoff drains these
+    gated: bool = False
     commit_vc: Optional[VC] = None
 
     def own_effects(self, key) -> List[Any]:
         entry = self.writeset.get(key)
         return entry[1] if entry else []
+
+
+#: process-unique txid suffix source: one random prefix per process +
+#: a monotone counter — globally unique like uuid4 but without a
+#: urandom syscall per transaction (the txn path runs thousands/s)
+_TXID_PREFIX = os.urandom(6).hex()
+_TXID_SEQ = itertools.count(1)
+
+
+def _fresh_txid_suffix() -> str:
+    return f"{_TXID_PREFIX}{next(_TXID_SEQ):x}"
+
+
+def _fan_out(pairs, fn):
+    """Run ``fn(p, pm)`` for every 2PC participant, overlapping the
+    REMOTE ones in threads (their cost is a fabric round trip whose
+    wait releases the GIL — the reference broadcasts prepare/commit and
+    collects replies, src/clocksi_vnode.erl:168-200).  Local calls run
+    inline; results return in participant order; the first exception
+    re-raises after every call finished (a half-collected prepare round
+    must not leak in-flight RPC threads)."""
+    import threading as _threading
+
+    remote = [(i, p, pm) for i, (p, pm) in enumerate(pairs)
+              if getattr(pm, "deferred_stage", False)]
+    results: list = [None] * len(pairs)
+    if len(remote) <= 1:
+        for i, (p, pm) in enumerate(pairs):
+            results[i] = fn(p, pm)
+        return results
+    errs: list = []
+
+    def run(i, p, pm):
+        try:
+            results[i] = fn(p, pm)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+
+    threads = [_threading.Thread(target=run, args=(i, p, pm))
+               for i, p, pm in remote]
+    for t in threads:
+        t.start()
+    for i, (p, pm) in enumerate(pairs):
+        if not getattr(pm, "deferred_stage", False):
+            run(i, p, pm)
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+    return results
 
 
 class Coordinator:
@@ -96,7 +154,7 @@ class Coordinator:
             snap = VC(node.stable_vc())
         snap = snap.set_dc(node.dc_id, max(snap.get_dc(node.dc_id),
                                            node.clock.now_us()))
-        txid = (snap.get_dc(node.dc_id), uuid.uuid4().hex[:12])
+        txid = (snap.get_dc(node.dc_id), _fresh_txid_suffix())
         stats.registry.open_transactions.inc()
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
@@ -160,7 +218,7 @@ class Coordinator:
         props = properties or TxnProperties()
         snap = self.gr_snapshot_wait(
             client_clock if props.update_clock else None)
-        txid = (snap.get_dc(self.node.dc_id), uuid.uuid4().hex[:12])
+        txid = (snap.get_dc(self.node.dc_id), _fresh_txid_suffix())
         stats.registry.open_transactions.inc()
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
@@ -181,6 +239,11 @@ class Coordinator:
         instead of one per key."""
         self._check_active(tx)
         stats.registry.operations.inc(len(bound_objects), type="read")
+        # hold the handoff gate for the batch unless the txn already
+        # does: a cutover swaps the partition objects out mid-resolve
+        gate = None if tx.gated else self.node.txn_gate
+        if gate is not None:
+            gate.enter()
         try:
             metas = []
             by_pm: dict = {}
@@ -207,6 +270,9 @@ class Coordinator:
             # receive_read_objects_result error path)
             self.abort_transaction(tx)
             raise TransactionAborted(f"read failed: {e}") from e
+        finally:
+            if gate is not None:
+                gate.exit()
         return out
 
     # -------------------------------------------------------------- updates
@@ -216,6 +282,24 @@ class Coordinator:
         generate downstream, log, stage."""
         self._check_active(tx)
         stats.registry.operations.inc(len(updates), type="update")
+        if not tx.gated:
+            # shared handoff gate, held to commit/abort: a cutover must
+            # never swap the logs out from under a txn's staged records
+            self.node.txn_gate.enter()
+            tx.gated = True
+        try:
+            self._apply_updates(tx, updates)
+        except TransactionAborted:
+            raise  # abort paths already released the gate
+        except BaseException:
+            # an unexpected escape (bad op shape, a remote fabric
+            # error) must not leak the shared gate — callers like the
+            # PB server report generic errors without aborting
+            if tx.state is TxnState.ACTIVE:
+                self.abort_transaction(tx)
+            raise
+
+    def _apply_updates(self, tx: Transaction, updates: List) -> None:
         for upd in updates:
             bo, op_name, op_param = self.node.normalize_update(upd)
             key, type_name, bucket = self.node.normalize_bound(bo)
@@ -249,7 +333,11 @@ class Coordinator:
             except DownstreamError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(f"downstream failed: {e}") from e
-            pm.stage_update(tx.txid, key2, cls.name, effect)
+            if getattr(pm, "deferred_stage", False):
+                tx.deferred_ops.setdefault(pm.partition, []).append(
+                    (key2, cls.name, effect))
+            else:
+                pm.stage_update(tx.txid, key2, cls.name, effect)
             entry = tx.writeset.setdefault(key2, (cls.name, []))
             entry[1].append(effect)
             if pm.partition not in tx.partitions:
@@ -267,8 +355,14 @@ class Coordinator:
             commit_vc = tx.snapshot_vc
         elif len(tx.partitions) == 1:
             pm = node.partitions[tx.partitions[0]]
+            deferred = tx.deferred_ops.get(tx.partitions[0])
             try:
-                ct = pm.single_commit(tx.txid, tx.snapshot_vc, certify)
+                if deferred is not None:
+                    ct = pm.stage_single_commit(
+                        tx.txid, deferred, tx.snapshot_vc, certify)
+                else:
+                    ct = pm.single_commit(tx.txid, tx.snapshot_vc,
+                                          certify)
             except CertificationError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(str(e)) from e
@@ -283,10 +377,17 @@ class Coordinator:
             commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
         else:
             pms = [node.partitions[p] for p in tx.partitions]
+
+            def _prepare(p, pm):
+                if p in tx.deferred_ops:
+                    return pm.stage_prepare(tx.txid, tx.deferred_ops[p],
+                                            tx.snapshot_vc, certify)
+                return pm.prepare(tx.txid, tx.snapshot_vc, certify)
+
             try:
-                prepare_times = [
-                    pm.prepare(tx.txid, tx.snapshot_vc, certify) for pm in pms
-                ]
+                prepare_times = _fan_out(
+                    [(p, pm) for p, pm in zip(tx.partitions, pms)],
+                    _prepare)
             except CertificationError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(str(e)) from e
@@ -296,15 +397,17 @@ class Coordinator:
                 raise TransactionAborted(f"prepare failed: {e}") from e
             ct = max(prepare_times)
             try:
-                for pm in pms:
-                    pm.commit(tx.txid, ct, tx.snapshot_vc,
-                              certified=certify)
+                _fan_out(
+                    [(p, pm) for p, pm in zip(tx.partitions, pms)],
+                    lambda _p, pm: pm.commit(tx.txid, ct, tx.snapshot_vc,
+                                             certified=certify))
             except Exception as e:
                 # post-decision failure: some partitions may hold a
                 # durable commit record — reporting an abort here would
                 # invite a retry and double-apply
                 tx.state = TxnState.UNKNOWN
                 stats.registry.open_transactions.dec()
+                self._release_gate(tx)
                 raise CommitOutcomeUnknown(
                     f"commit decided at {ct} but applying it failed: {e}"
                 ) from e
@@ -312,9 +415,15 @@ class Coordinator:
         tx.state = TxnState.COMMITTED
         tx.commit_vc = commit_vc
         stats.registry.open_transactions.dec()
+        self._release_gate(tx)
         for bucket, key, type_name, op in tx.client_ops:
             node.hooks.run_post(bucket, key, type_name, op)
         return commit_vc
+
+    def _release_gate(self, tx: Transaction) -> None:
+        if tx.gated:
+            tx.gated = False
+            self.node.txn_gate.exit()
 
     def abort_transaction(self, tx: Transaction) -> None:
         if tx.state is not TxnState.ACTIVE:
@@ -324,3 +433,4 @@ class Coordinator:
         tx.state = TxnState.ABORTED
         stats.registry.open_transactions.dec()
         stats.registry.aborted_transactions.inc()
+        self._release_gate(tx)

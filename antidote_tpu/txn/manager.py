@@ -123,6 +123,15 @@ class PartitionManager:
         self._val_cache: Dict[Any, list] = {}
         self._val_cache_cap = 65536
         self._warm_writes_cap = 32
+        #: seed the cache from bottom on a key's FIRST publish (the
+        #: reference materializer stores the snapshot it builds at
+        #: update time, src/materializer_vnode.erl:620-647) — freshly
+        #: written keys then serve reads warm instead of paying a cold
+        #: device fold each.  The Node disables this when recovery is
+        #: off while logging is on: there the log may hold history this
+        #: process never published, and a bottom-seeded state would
+        #: disagree with the log-fallback read.
+        self.seed_cache_on_first_publish = True
         #: device reads in flight outside the lock (see read()): the
         #: append/gc kernels DONATE their input buffers, so a device
         #: mutation while a reader still holds the captured shard state
@@ -148,6 +157,36 @@ class PartitionManager:
         with self._lock:
             self.log.append_update(self.dc_id, txid, key, type_name, effect)
             self._staged.setdefault(txid, []).append((key, type_name, effect))
+
+    def stage_group(self, txid, ops: List[Tuple[Any, str, Any]]) -> None:
+        """Stage a transaction's whole op list for this partition in one
+        lock pass (the deferred-staging form a remote coordinator ships
+        with prepare — see stage_prepare)."""
+        with self._lock:
+            staged = self._staged.setdefault(txid, [])
+            for key, type_name, effect in ops:
+                self.log.append_update(self.dc_id, txid, key, type_name,
+                                       effect)
+                staged.append((key, type_name, effect))
+
+    def stage_prepare(self, txid, ops, snapshot_vc: VC,
+                      certify: bool = True) -> int:
+        """Stage + prepare in one call — one fabric round trip per
+        remote 2PC participant.  The reference ships update records
+        asynchronously and prepares after the log acks
+        (src/clocksi_interactive_coord.erl:514-577, 1043-1075); the
+        deferred coordinator buffers its remote writeset locally and
+        this call preserves the same contract: everything durable at
+        the owner before the prepare ack."""
+        self.stage_group(txid, ops)
+        return self.prepare(txid, snapshot_vc, certify)
+
+    def stage_single_commit(self, txid, ops, snapshot_vc: VC,
+                            certify: bool = True) -> int:
+        """Stage + single-partition fast-path commit in one call (one
+        round trip for a remote single-partition transaction)."""
+        self.stage_group(txid, ops)
+        return self.single_commit(txid, snapshot_vc, certify)
 
     # -------------------------------------------------------- 2PC on this partition
 
@@ -219,6 +258,20 @@ class PartitionManager:
                     ent[3]]
             except Exception:
                 self._val_cache.pop(key, None)
+        elif ent is None and fr_old is None \
+                and self.seed_cache_on_first_publish \
+                and len(self._val_cache) < self._val_cache_cap:
+            # first committed op ever for this key: seed warm from the
+            # type's bottom (exact host-oracle lineage — fr_old None
+            # means nothing else has been published for it)
+            from antidote_tpu.crdt import get_type
+
+            try:
+                self._val_cache[key] = [fr_new, materialize_eager(
+                    type_name, get_type(type_name).new(),
+                    [payload.effect]), 0, True]
+            except Exception:  # noqa: BLE001 — cache stays cold
+                pass
         else:
             # entry cold (stale frontier) or write-only hot (nobody has
             # read it for _warm_writes_cap commits): retire it instead
@@ -273,6 +326,32 @@ class PartitionManager:
         for _seq, p in self.log.committed_payloads(key=key):
             self.store.insert(key, type_name, p)
 
+    def _mid_batch_migrated(self, pre_hosted: Optional[set], key) -> bool:
+        """True when ``key`` was evicted to the host DURING the current
+        publish batch: the eviction's migration replayed the key's FULL
+        log — which already contains every op of this batch (callers
+        append before publishing) — so publishing the key's remaining
+        batch items would double-apply them in the host store.  ``pre_
+        hosted`` is the host_only snapshot taken before the batch."""
+        return (pre_hosted is not None and key not in pre_hosted
+                and key in self.device.host_only)
+
+    def _note_skipped_publish(self, key, payload: Payload) -> None:
+        """Bookkeeping for a batch item whose STATE application was
+        covered by a mid-batch migration: the commit frontier must
+        still advance (an understated frontier lets an old snapshot
+        read pass covers_all, cache a stale value keyed by the stale
+        frontier object, and serve it to every later read), and any
+        cache entry pinned to the pre-skip frontier must drop."""
+        fr_old = self.key_frontier.get(key)
+        self.key_frontier[key] = (fr_old or VC()).join(
+            payload.commit_vc())
+        self._val_cache.pop(key, None)
+
+    def _pre_hosted(self) -> Optional[set]:
+        return set(self.device.host_only) if self.device is not None \
+            else None
+
     def commit(self, txid, commit_time: int, snapshot_vc: VC,
                certified: bool = True) -> None:
         """Log the commit (fsync per config), publish the effects to the
@@ -283,13 +362,17 @@ class PartitionManager:
         with self._lock:
             self.log.append_commit(self.dc_id, txid, commit_time,
                                    snapshot_vc, certified)
+            pre_hosted = self._pre_hosted()
             for key, type_name, effect in self._staged.pop(txid, []):
                 payload = Payload(
                     key=key, type_name=type_name, effect=effect,
                     commit_dc=self.dc_id, commit_time=commit_time,
                     snapshot_vc=snapshot_vc, txid=txid,
                     certified=certified)
-                self._publish(key, type_name, payload, stable)
+                if self._mid_batch_migrated(pre_hosted, key):
+                    self._note_skipped_publish(key, payload)
+                else:
+                    self._publish(key, type_name, payload, stable)
                 if commit_time > self.committed.get(key, 0):
                     self.committed[key] = commit_time
             self.prepared.pop(txid, None)
@@ -332,6 +415,7 @@ class PartitionManager:
                         if rec.kind() == "commit")
         with self._lock:
             self.log.append_remote_group(records)
+            pre_hosted = self._pre_hosted()
             for rec in records:
                 if rec.kind() != "update":
                     continue
@@ -341,7 +425,12 @@ class PartitionManager:
                     commit_dc=origin_dc, commit_time=commit_time,
                     snapshot_vc=snapshot_vc, txid=rec.txid,
                     certified=certified)
-                self._publish(key, type_name, payload, stable)
+                if self._mid_batch_migrated(pre_hosted, key):
+                    # eviction replayed the whole group's state; the
+                    # frontier still advances
+                    self._note_skipped_publish(key, payload)
+                else:
+                    self._publish(key, type_name, payload, stable)
             self._lock.notify_all()
 
     # --------------------------------------------------------------- reads
@@ -421,8 +510,11 @@ class PartitionManager:
                 return value
         if reader is False:
             with self._lock:  # log scans serialize with appenders
-                return self._read_from_log(key, type_name, snapshot_vc,
-                                           txid)
+                value = self._read_from_log(key, type_name, snapshot_vc,
+                                            txid)
+                if covers_all and self.key_frontier.get(key) is fr:
+                    self._cache_put(key, fr, value, True)
+                return value
         try:
             value = reader()
         finally:
@@ -460,12 +552,16 @@ class PartitionManager:
                 return ent[1]
         if self.device is not None and self.device.owns(type_name, key):
             exact = self.device.state_exact(type_name, key)
-            if exact_state and not exact:
-                return self._read_from_log(key, type_name, read_vc, txid)
             try:
+                if exact_state and not exact:
+                    raise ReadBelowBase()  # lossy fold: exact replay
                 value = self.device.read(key, type_name, read_vc)
             except ReadBelowBase:
-                return self._read_from_log(key, type_name, read_vc, txid)
+                # log replay is host-oracle exact — cacheable like any
+                # other frontier-covering read
+                value = self._read_from_log(key, type_name, read_vc,
+                                            txid)
+                exact = True
         else:
             exact = True
             value, _vc = self.store.read(key, type_name, read_vc, txid=txid)

@@ -12,6 +12,7 @@ integer keys map by modulo, everything else by hash.
 from __future__ import annotations
 
 import os
+import threading
 import time
 import zlib
 from typing import Any, Callable, List, Optional, Tuple
@@ -24,6 +25,67 @@ from antidote_tpu.oplog.records import commit_certified
 from antidote_tpu.txn.clock import HybridClock
 from antidote_tpu.txn.coordinator import Coordinator
 from antidote_tpu.txn.manager import PartitionManager
+
+
+class TxnGate:
+    """Node-level shared/exclusive gate for live handoff.
+
+    Transactions hold the gate SHARED from their first mutation (or for
+    the span of a read batch) to commit/abort; a live repartition's
+    cutover takes it EXCLUSIVE, which drains every in-flight
+    transaction and briefly blocks new ones.  Reader-preference while
+    no exclusive is pending; once one is pending, only transactions
+    that already hold the gate proceed (a blocked new transaction can
+    retry) — holders must be able to finish or the drain deadlocks."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._active = 0
+        self._blocking = False
+
+    def enter(self, timeout: float = 30.0) -> None:
+        with self._cond:
+            deadline = time.monotonic() + timeout
+            while self._blocking:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    raise TimeoutError(
+                        "transaction admission blocked by a cutover")
+            self._active += 1
+
+    def exit(self) -> None:
+        with self._cond:
+            self._active -= 1
+            if self._active <= 0:
+                self._cond.notify_all()
+
+    def exclusive(self, drain_timeout: float = 60.0):
+        gate = self
+
+        class _Exclusive:
+            def __enter__(self):
+                with gate._cond:
+                    if gate._blocking:
+                        raise RuntimeError("cutover already in progress")
+                    gate._blocking = True
+                    deadline = time.monotonic() + drain_timeout
+                    while gate._active:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0 or not gate._cond.wait(
+                                remaining):
+                            gate._blocking = False
+                            gate._cond.notify_all()
+                            raise TimeoutError(
+                                "in-flight transactions never drained")
+                return self
+
+            def __exit__(self, *exc):
+                with gate._cond:
+                    gate._blocking = False
+                    gate._cond.notify_all()
+                return False
+
+        return _Exclusive()
 
 
 class Node:
@@ -50,6 +112,8 @@ class Node:
         #: stable snapshot.
         self.stable_vc_provider: Callable[[], VC] = (
             lambda: VC({dc_id: self.min_prepared_vc()}))
+        #: (monotonic time, VC) pair backing stable_vc()'s TTL cache
+        self._stable_read_cache = (0.0, None)
         #: called inside causal clock-wait spins; the inter-DC layer
         #: points this at its inbound pump so waiting makes progress
         self.wait_hook: Callable[[], None] = lambda: time.sleep(0.002)
@@ -57,6 +121,8 @@ class Node:
         #: optional detour for bounded-counter downstream generation
         #: (reference clocksi_downstream's bcounter_mgr hop)
         self.bcounter_mgr = None
+        #: shared/exclusive gate live handoff cuts over under
+        self.txn_gate = TxnGate()
         if self.config.recover_from_log:
             self._recover_stores()
 
@@ -171,6 +237,137 @@ class Node:
                            for p in range(new_n)]
         self._recover_stores()
 
+    def repartition_live(self, new_n: int, max_passes: int = 6,
+                         delta_threshold: int = 256) -> None:
+        """Ring resize WHILE SERVING — riak_core's handoff-under-traffic
+        duty (reference logging_vnode handoff folds run while the vnode
+        keeps serving, src/logging_vnode.erl:781-812).
+
+        Phases:
+        1. *Incremental fold (serving)*: repeated passes copy committed
+           transaction groups from the live logs into staged new logs;
+           each pass only scans the records appended since the last
+           (per-partition cursors), so passes shrink toward the live
+           frontier while clients keep committing.
+        2. *Cutover (short exclusive window)*: the node's TxnGate
+           drains in-flight transactions and briefly blocks new ones;
+           the final delta folds (bounded by ``delta_threshold``-ish),
+           the logs swap under the existing crash-safe journal, and
+           partitions + materializer rebuild by standard recovery.
+
+        Emission safety: a transaction's update records always precede
+        its FIRST commit copy in wall order (stage -> prepare ->
+        commit), so any commit seen by pass k has all its updates below
+        pass k+1's cursors — groups emit one pass after their commit is
+        first seen, and the quiesced final pass emits the rest.
+
+        Like Node.repartition, this resizes a DC that is not currently
+        federated (partition counts are part of the inter-DC contract);
+        unlike it, the node stays open for business throughout."""
+        if new_n < 1:
+            raise ValueError(f"new_n must be >= 1, got {new_n}")
+        old_n = self.config.n_partitions
+        if new_n == old_n:
+            return
+        if not self.config.enable_logging:
+            raise RuntimeError(
+                "repartition folds the durable logs; enable_logging="
+                "False leaves nothing to redistribute")
+
+        resize_paths = [self._log_path(p) + ".resize"
+                        for p in range(new_n)]
+        for path in resize_paths:
+            if os.path.exists(path):
+                os.remove(path)
+        new_logs = [
+            PartitionLog(path, partition=p, sync_on_commit=False,
+                         enabled=True)
+            for p, path in enumerate(resize_paths)
+        ]
+        cursors = [0] * old_n
+        updates: dict = {}     # txid -> [update records]
+        commits: dict = {}     # txid -> commit record (first copy wins)
+        ready: list = []       # commit order, not yet emitted
+        emitted: set = set()
+
+        def scan_pass() -> int:
+            """One cursor pass over every live log; returns the number
+            of new records seen."""
+            seen = 0
+            for p, pm in enumerate(self.partitions):
+                def scan(log, _p=p):
+                    # byte cursors: records(offset) scans from a FILE
+                    # offset, and under the partition lock nothing
+                    # appends between the iteration and end_offset()
+                    new = list(log.records(offset=cursors[_p]))
+                    cursors[_p] = log.log.end_offset()
+                    return new
+                for rec in pm.scan_log(scan):
+                    seen += 1
+                    kind = rec.kind()
+                    if kind == "update":
+                        updates.setdefault(rec.txid, []).append(rec)
+                    elif kind == "commit" and rec.txid not in commits \
+                            and rec.txid not in emitted:
+                        commits[rec.txid] = rec
+                        ready.append(rec.txid)
+            return seen
+
+        def emit(txids) -> None:
+            for txid in txids:
+                rec = commits.pop(txid)
+                dests: dict = {}
+                for u in updates.pop(txid, ()):
+                    dest = self.partition_index(u.payload[1], new_n)
+                    dests.setdefault(dest, []).append(u)
+                (dc, ct) = rec.payload[1]
+                svc = rec.payload[2]
+                cert = commit_certified(rec.payload)
+                for p, ups in dests.items():
+                    lg = new_logs[p]
+                    for u in ups:
+                        lg.append_update(dc, txid, u.payload[1],
+                                         u.payload[2], u.payload[3])
+                    lg.append_commit(dc, txid, ct, svc, certified=cert)
+                emitted.add(txid)
+
+        # phase 1: fold toward the live frontier while serving
+        scan_pass()
+        for _ in range(max_passes):
+            emittable, ready[:] = ready[:], []
+            seen = scan_pass()
+            # commits collected before this pass now have every update
+            # below the cursors — safe to emit
+            emit(emittable)
+            if seen <= delta_threshold:
+                break
+
+        # phase 2: cutover — drain in-flight txns, fold the remainder,
+        # swap under the journal, rebuild via recovery
+        with self.txn_gate.exclusive():
+            scan_pass()
+            emit(ready)
+            ready.clear()
+            # dangling updates without commits are aborted/in-doubt
+            # transactions — they do not survive the resize (same rule
+            # as the quiesced fold)
+            for lg in new_logs:
+                lg.close()
+            for pm in self.partitions:
+                pm.log.close()
+            journal = self._resize_journal_path()
+            tmp = journal + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{old_n} {new_n}\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, journal)
+            self._complete_resize_swap(old_n, new_n)
+            self.config.n_partitions = new_n
+            self.partitions = [self._build_partition(p)
+                               for p in range(new_n)]
+            self._recover_stores()
+
     def _resize_journal_path(self) -> str:
         return os.path.join(self.data_dir, f"{self.dc_id}_resize.journal")
 
@@ -224,6 +421,11 @@ class Node:
         pm = PartitionManager(p, self.dc_id, log, self.clock,
                               device_plane=plane)
         pm.stable_vc_source = self.stable_vc
+        # recovery-off + logging-on: the log may hold history this
+        # process never published — a bottom-seeded warm cache would
+        # disagree with log-fallback reads (see PartitionManager)
+        pm.seed_cache_on_first_publish = (
+            self.config.recover_from_log or not self.config.enable_logging)
         return pm
 
     # ---------------------------------------------------------- node scope
@@ -289,7 +491,18 @@ class Node:
     # --------------------------------------------------------------- clocks
 
     def stable_vc(self) -> VC:
-        return self.stable_vc_provider()
+        """The provider's stable snapshot behind a short TTL cache (see
+        Config.stable_ttl_s; benign data race — both racers store a
+        freshly computed value)."""
+        ttl = self.config.stable_ttl_s
+        if ttl <= 0:
+            return self.stable_vc_provider()
+        t, v = self._stable_read_cache
+        now = time.monotonic()
+        if v is None or now - t > ttl:
+            v = self.stable_vc_provider()
+            self._stable_read_cache = (now, v)
+        return v
 
     def min_prepared_vc(self) -> int:
         """Node-wide min prepared time (feeds the stable-time gossip);
@@ -341,10 +554,14 @@ class Node:
         src/materializer_vnode.erl:123-131, 288-319)."""
         recovered_vc = VC()
         for pm in self._local_partitions():
+            pre_hosted = pm._pre_hosted()
             for _seq, payload in pm.log.committed_payloads():
                 with pm._lock:
-                    pm._publish(payload.key, payload.type_name, payload,
-                                None)
+                    if pm._mid_batch_migrated(pre_hosted, payload.key):
+                        pm._note_skipped_publish(payload.key, payload)
+                    else:
+                        pm._publish(payload.key, payload.type_name,
+                                    payload, None)
                 if payload.commit_dc != self.dc_id:
                     # replicated records are durable too, but the
                     # certification tables are local-only — exactly as on

@@ -1,0 +1,148 @@
+"""Subprocess worker for config6's cluster mode: one NodeServer plus a
+self-driving client loop, stdio-controlled by the parent bench.
+
+The GIL caps any ONE Python process's control plane; the framework's
+scale-out axis is the multi-process DC (antidote_tpu/cluster/).  Each
+worker drives the update-heavy mix against its own node — mostly its
+own ring slice, with a cross-node fraction so the fabric RPC stays in
+the measured path (like riak smart clients routing by key while some
+requests still hop).
+
+Protocol (JSON lines):
+  {"cmd": "join", "dc": d, "ring": {...}, "members": {...}}
+  {"cmd": "run", "txns": N, "slice": i, "n_nodes": k, "keys": K,
+   "cross": 0.1, "seed": s}      -> {"txns": n, "secs": t, "aborts": a}
+  {"cmd": "exit"}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+# a node process serves fabric RPCs from threads while its own workload
+# runs: the default 5 ms GIL switch interval turns every cross-node
+# round trip into a multi-ms scheduling stall
+sys.setswitchinterval(0.0005)
+
+import numpy as np  # noqa: E402
+
+from antidote_tpu.cluster import NodeServer  # noqa: E402
+from antidote_tpu.config import Config  # noqa: E402
+from antidote_tpu.txn.coordinator import TransactionAborted  # noqa: E402
+
+
+def run_mix(api, rng, txns, own_keys, other_keys, cross):
+    """The config6 update-heavy mix (80% 1r+2w, 20% 3r) over this
+    node's key slice, with a ``cross`` fraction of remote-owned keys —
+    the same fresh-transaction pattern as run_direct (comparable
+    numbers; smart clients route by owner, like riak's)."""
+    own = np.asarray(own_keys, dtype=np.int64)
+    other = np.asarray(other_keys if other_keys else own_keys,
+                       dtype=np.int64)
+    aborts = 0
+    done = 0
+    t0 = time.perf_counter()
+    for _ in range(txns):
+        def pick():
+            if rng.random() < cross:
+                return int(other[int(rng.integers(len(other)))])
+            return int(own[int(rng.integers(len(own)))])
+
+        try:
+            if rng.random() < 0.8:
+                tx = api.start_transaction()
+                api.read_objects([(pick(), "counter_pn", "b")], tx)
+                # set keys offset by a multiple of the partition count:
+                # disjoint from the counter keyspace (one key = one
+                # type), same ring owner (affinity preserved)
+                api.update_objects(
+                    [((pick(), "counter_pn", "b"), "increment", 1),
+                     ((pick() + (1 << 20), "set_aw", "b"), "add", "x")],
+                    tx)
+                api.commit_transaction(tx)
+            else:
+                tx = api.start_transaction()
+                api.read_objects(
+                    [(pick(), "counter_pn", "b") for _ in range(3)], tx)
+                api.commit_transaction(tx)
+            done += 1
+        except TransactionAborted:
+            aborts += 1
+    return done, aborts, time.perf_counter() - t0
+
+
+def main():
+    node_id = sys.argv[1]
+    data_dir = sys.argv[2]
+    port = int(sys.argv[3])
+    # gossip at 0.2 s: each tick costs a peer RPC (~ms under GIL load),
+    # and the workload's fresh transactions only need the stable plane
+    # for causal floors, not throughput
+    srv = NodeServer(node_id, port=port, data_dir=data_dir,
+                     config=Config(n_partitions=8, sync_log=False,
+                                   heartbeat_s=0.2))
+
+    def out(obj):
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    out({"ready": True, "addr": list(srv.addr)})
+    for line in sys.stdin:
+        req = json.loads(line)
+        cmd = req["cmd"]
+        try:
+            if cmd == "join":
+                srv.install_cluster(
+                    req["dc"],
+                    {int(p): nid for p, nid in req["ring"].items()},
+                    {nid: tuple(a)
+                     for nid, a in req["members"].items()})
+                out({"ok": True})
+            elif cmd == "run":
+                prof = None
+                if os.environ.get("CLUSTER_NODE_PROFILE"):
+                    import cProfile
+
+                    prof = cProfile.Profile()
+                    prof.enable()
+                rng = np.random.default_rng(req["seed"])
+                k, n_nodes, K = req["slice"], req["n_nodes"], req["keys"]
+                # integer keys map to partitions by modulo and the ring
+                # is round-robin, so key % n_partitions % ... — derive
+                # ownership from the node's own ring instead
+                ring = srv.node.ring
+                npart = len(ring)
+                own = [x for x in range(K)
+                       if ring[x % npart] == srv.node_id]
+                other = [x for x in range(K)
+                         if ring[x % npart] != srv.node_id]
+                done, aborts, secs = run_mix(
+                    srv.api, rng, req["txns"], own, other,
+                    req.get("cross", 0.1))
+                if prof is not None:
+                    import pstats
+
+                    prof.disable()
+                    pstats.Stats(prof, stream=sys.stderr).sort_stats(
+                        "cumulative").print_stats(14)
+                    sys.stderr.flush()
+                out({"txns": done, "secs": secs, "aborts": aborts})
+            elif cmd == "exit":
+                srv.close()
+                out({"ok": True})
+                return
+        except Exception as e:  # noqa: BLE001
+            out({"error": f"{type(e).__name__}: {e}"})
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,16 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
     dorder = np.argsort(tr["del_lamport"], kind="stable")
     dlam = tr["del_lamport"][dorder]
     dact = tr["del_actor"][dorder]
-    empty = jnp.asarray(np.zeros(0, np.int32))
+
+    def vc_cols(stamps):
+        # single-DC commit-VC columns (the VC-aware store's lanes; the
+        # DC federation benches drive multi-column VCs via the plane)
+        s = np.asarray(stamps, dtype=np.int64)
+        return (jnp.asarray(np.zeros(len(s), np.int32)),
+                jnp.asarray(s),
+                jnp.asarray(np.zeros((len(s), 1), np.int64)))
+
+    latest = jnp.asarray([np.iinfo(np.int64).max // 2])
 
     st = rga_store.rga_store_init(
         pb=1 << (n_ins - 1).bit_length(), nw=16 * block, md=4 * block)
@@ -60,9 +69,9 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
             jnp.asarray(tr["ref_lamport"][sl]),
             jnp.asarray(tr["ref_actor"][sl]),
             jnp.asarray(tr["elem"][sl]),
-            jnp.asarray(np.arange(lo + 1, hi + 1, dtype=np.int32)),
+            *vc_cols(np.arange(lo + 1, hi + 1)),
             jnp.asarray(dlam[dsl]), jnp.asarray(dact[dsl]),
-            jnp.asarray(np.full(dhi - dptr, hi, np.int32)))
+            *vc_cols(np.full(dhi - dptr, hi)))
         assert bool(ok)
         dptr = dhi
         return st
@@ -74,15 +83,15 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
         hi = min(fed + build_block, n_base)
         st = append(st, fed, hi)
         fed = hi
-        st = rga_store.rga_fold_host(st, threshold=fed)
+        st = rga_store.rga_fold_host(st, fed)
 
     # steady state (timed): append block -> read -> fold every F blocks
     def step(st, fed, do_fold):
         hi = fed + block
         st = append(st, fed, hi)
-        doc, n_vis = rga_store.rga_read(st)
+        doc, n_vis = rga_store.rga_read_doc(st, latest)
         if do_fold:
-            st = rga_store.rga_fold_host(st, threshold=hi - block)
+            st = rga_store.rga_fold_host(st, hi - block)
         return st, hi, n_vis
 
     # warm the jit caches

@@ -18,7 +18,10 @@ concurrency story is 20 read servers + shared-ETS reads per vnode
 (reference include/antidote.hrl:28, src/clocksi_readitem_server.erl),
 so scaling with client concurrency is the honest comparable."""
 
+import json
+import os
 import shutil
+import sys
 import tempfile
 import threading
 import time
@@ -147,6 +150,78 @@ def run_pb(db, n_threads, txns_per_thread, K, port, seed=100):
     return len(lat) / dt, lat, aborts[0]
 
 
+def run_cluster(n_nodes, txns_per_node, K, tmp, cross=0.1):
+    """Aggregate txn/s of a DC spanning ``n_nodes`` OS processes — the
+    scale-out axis past one interpreter's GIL (the reference's BEAM
+    node gets parallelism for free; this rebuild gets it from the
+    multi-process DC, antidote_tpu/cluster/).  Each worker self-drives
+    the same mix against its node, mostly on its own ring slice with a
+    ``cross`` fraction of cross-node transactions."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs = []
+    try:
+        for i in range(n_nodes):
+            # port 0: each node binds an OS-assigned port and reports
+            # it in its ready line (no pick-then-rebind port race)
+            p = subprocess.Popen(
+                [sys.executable, os.path.join(here, "_cluster_node.py"),
+                 f"n{i + 1}", os.path.join(tmp, f"n{i + 1}"), "0"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+            procs.append(p)
+        addrs = {}
+        for i, p in enumerate(procs):
+            ready = json.loads(p.stdout.readline())
+            addrs[f"n{i + 1}"] = ready["addr"]
+
+        def cmd(p, **req):
+            p.stdin.write(json.dumps(req) + "\n")
+            p.stdin.flush()
+            resp = json.loads(p.stdout.readline())
+            assert "error" not in resp, resp
+            return resp
+
+        npart = 8
+        ring = {str(x): f"n{(x % n_nodes) + 1}" for x in range(npart)}
+        for p in procs:
+            cmd(p, cmd="join", dc="dc1", ring=ring, members=addrs)
+        # warm (jit + interning) then measure: all workers run
+        # concurrently, wall time = max of the workers' spans.  The
+        # warmup must cross the device flush cadence (flush_ops=256
+        # staged ops) or the first XLA compiles land inside the
+        # measured window of a fresh process
+        for p in procs:
+            p.stdin.write(json.dumps(
+                {"cmd": "run", "txns": 400, "slice": 0,
+                 "n_nodes": n_nodes, "keys": K, "cross": cross,
+                 "seed": 99}) + "\n")
+            p.stdin.flush()
+        for p in procs:
+            json.loads(p.stdout.readline())
+        t0 = time.perf_counter()
+        for i, p in enumerate(procs):
+            p.stdin.write(json.dumps(
+                {"cmd": "run", "txns": txns_per_node, "slice": i,
+                 "n_nodes": n_nodes, "keys": K, "cross": cross,
+                 "seed": i}) + "\n")
+            p.stdin.flush()
+        total = aborts = 0
+        for p in procs:
+            resp = json.loads(p.stdout.readline())
+            assert "error" not in resp, resp
+            total += resp["txns"]
+            aborts += resp["aborts"]
+        wall = time.perf_counter() - t0
+        for p in procs:
+            cmd(p, cmd="exit")
+        return total / wall, aborts
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 def main():
     quick, _jax = setup()
     from antidote_tpu.api import AntidoteTPU
@@ -159,8 +234,11 @@ def main():
     try:
         cfg = Config(n_partitions=8, sync_log=False, data_dir=tmp)
         db = AntidoteTPU(config=cfg)
-        # warm (interning, jit on the device plane paths)
-        run_direct(db, 2, 30, K, seed=999)
+        # warm (interning, jit on the device plane paths) at the
+        # measured concurrency: flush batch sizes — hence XLA program
+        # shapes — depend on thread interleaving, and a compile inside
+        # the timed region would swamp it
+        run_direct(db, n_threads, 60, K, seed=999)
 
         tput_1, _, _ = run_direct(db, 1, txns, K, seed=1)
         tput_n, lat, aborts = run_direct(db, n_threads, txns, K, seed=2)
@@ -169,6 +247,9 @@ def main():
             db, n_threads, max(txns // 4, 50), K, port=18087)
         pb50, pb99 = _percentiles(pb_lat)
         db.close()
+        n_nodes = 4 if not quick else 2
+        cluster_tput, cluster_aborts = run_cluster(
+            n_nodes, txns_per_node=txns, K=K, tmp=tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -180,6 +261,11 @@ def main():
          pb_txn_per_sec=round(pb_tput), pb_p50_ms=pb50, pb_p99_ms=pb99,
          pb_abort_rate=round(
              pb_aborts / max(pb_aborts + len(pb_lat), 1), 4),
+         cluster_txn_per_sec=round(cluster_tput),
+         cluster_nodes=n_nodes,
+         cluster_abort_rate=round(
+             cluster_aborts
+             / max(cluster_aborts + n_nodes * txns, 1), 4),
          abort_rate=round(aborts / max(aborts + len(lat), 1), 4),
          mix="80% update (1r+2w), 20% read (3r); pb variant static",
          note="vs_baseline = thread-scaling factor (8 clients vs 1)")

@@ -111,6 +111,20 @@ class StreamGen:
         elif type_name == "set_go":
             n = self.rng.randint(1, 3)
             eff = tuple(self.rng.choice(self.elems) for _ in range(n))
+        elif type_name == "rga":
+            from antidote_tpu.crdt import DownstreamCtx
+
+            st = st if isinstance(st, tuple) else ()
+            ctx = DownstreamCtx(dc)
+            vis = sum(1 for _u, _e, v in st if v)
+            if vis and self.rng.random() < 0.3:
+                eff = cls.downstream(
+                    ("remove", self.rng.randint(1, vis)), st, ctx)
+            else:
+                pos = self.rng.randint(0, vis)
+                eff = cls.downstream(
+                    ("add_right", (pos, self.rng.choice(self.elems))),
+                    st, ctx)
         elif type_name in ("map_go", "map_rr"):
             # nested effects via the real CRDT downstream so dots come
             # out as (dc, ct) like every other generator arm
@@ -147,7 +161,7 @@ class StreamGen:
                     txid=f"tx{ct}")
         # apply to every DC view (causal delivery simulated as immediate)
         stateful = ("set_aw", "set_rw", "set_go", "register_mv",
-                    "flag_ew", "flag_dw", "map_go", "map_rr")
+                    "flag_ew", "flag_dw", "map_go", "map_rr", "rga")
         for d in self.dcs:
             if type_name in stateful:
                 base = self.state[d][key]
@@ -176,7 +190,7 @@ def publish(pm, p, stable):
 
 @pytest.mark.parametrize("type_name", [
     "counter_pn", "set_aw", "register_mv", "register_lww", "flag_ew",
-    "set_rw", "flag_dw", "set_go", "map_go", "map_rr"])
+    "set_rw", "flag_dw", "set_go", "map_go", "map_rr", "rga"])
 def test_stream_oracle_equivalence(tmp_path, type_name):
     """Random stream through the real publish path: device reads ==
     host-store reads at the latest snapshot and at historical ones."""
@@ -579,7 +593,7 @@ def test_map_field_capacity_eviction(tmp_path):
 
 @pytest.mark.parametrize("type_name", [
     "counter_pn", "set_aw", "register_mv", "register_lww", "flag_ew",
-    "set_rw", "flag_dw", "set_go", "map_go", "map_rr"])
+    "set_rw", "flag_dw", "set_go", "map_go", "map_rr", "rga"])
 def test_warm_value_cache_matches_cold_fold(tmp_path, type_name):
     """_publish applies committed effects onto the cached state instead
     of invalidating it (the reference materializer's
@@ -718,3 +732,126 @@ def test_publish_recheck_after_quiesce_wait(tmp_path):
     # value must include every committed op exactly once
     assert not pm.device.owns(tn, key)
     assert pm.value_snapshot(key, tn) == 3
+
+
+class TestRgaActorTieOrder:
+    """Concurrent same-lamport inserts order by ACTOR STRING on every
+    replica regardless of actor arrival order at each plane — the
+    canonical-interning remap (RgaPlane._actor_id), which the sequential
+    stream generator cannot exercise (its lamports never tie)."""
+
+    @staticmethod
+    def _ins(key, uid, ref, elem, dc, ct, ss):
+        return Payload(key=key, type_name="rga",
+                       effect=("ins", uid, ref, elem),
+                       commit_dc=dc, commit_time=ct,
+                       snapshot_vc=ss, txid=f"tx{ct}")
+
+    def _drive(self, tmp_path, name, order):
+        """Three concurrent head inserts (lamport tie) + a causally
+        later insert, delivered in the given order."""
+        pm = make_pm(tmp_path, name, device=True, flush_ops=1)
+        root = (0, "")
+        base = self._ins("d", (1, "dcB"), root, "s", "dcB", 100, VC())
+        ties = {
+            "A": self._ins("d", (2, "dcA"), root, "a", "dcA", 201,
+                           VC({"dcB": 100})),
+            "C": self._ins("d", (2, "dcC"), root, "c", "dcC", 202,
+                           VC({"dcB": 100})),
+            "Z": self._ins("d", (2, "dcZ"), root, "z", "dcZ", 203,
+                           VC({"dcB": 100})),
+        }
+        publish(pm, base, None)
+        for o in order:
+            publish(pm, ties[o], None)
+        with pm._lock:
+            st = pm._read_store("d", "rga", None)
+        from antidote_tpu.crdt import get_type
+
+        return get_type("rga").value(st)
+
+    def test_arrival_order_does_not_change_document(self, tmp_path):
+        want = None
+        for i, order in enumerate(["ACZ", "ZCA", "CZA", "AZC"]):
+            got = self._drive(tmp_path, f"o{i}", order)
+            if want is None:
+                want = got
+            assert got == want, (order, got, want)
+        # uid-desc tie order: dcZ > dcC > dcA by string
+        assert want == ["z", "c", "a", "s"]
+
+    def test_remap_preserves_folded_base(self, tmp_path):
+        """An out-of-order actor arriving AFTER a fold must remap the
+        folded base, not just the window."""
+        pm = make_pm(tmp_path, "fold", device=True, flush_ops=1)
+        root = (0, "")
+        publish(pm, self._ins("d", (1, "dcM"), root, "m", "dcM", 100,
+                              VC()), None)
+        publish(pm, self._ins("d", (2, "dcM"), root, "x", "dcM", 150,
+                              VC({"dcM": 100})), None)
+        # fold everything into the base
+        plane = pm.device.planes["rga"]
+        with pm._lock:
+            plane.gc(VC({"dcM": 200}))
+        # now an actor sorting BEFORE dcM arrives with a lamport tie
+        publish(pm, self._ins("d", (2, "dcA"), root, "a", "dcA", 300,
+                              VC({"dcM": 150})), None)
+        with pm._lock:
+            st = pm._read_store("d", "rga", None)
+        from antidote_tpu.crdt import get_type
+
+        # host oracle order: (2,dcM)=x > (2,dcA)=a > (1,dcM)=m
+        assert get_type("rga").value(st) == ["x", "a", "m"]
+
+
+class TestMidBatchEviction:
+    """A key evicted to the host MID-publish-batch had its whole log
+    replayed by the migration; the batch's remaining items for that key
+    must not publish again (double-apply in the host store).  Caught
+    live by the handoff test: recovery bursts overflow small rings,
+    evict mid-replay, and every op after the eviction point was applied
+    twice."""
+
+    def test_recovery_burst_with_tiny_rings_is_exact(self, tmp_path):
+        from antidote_tpu.txn.node import Node
+
+        cfg = Config(n_partitions=1, data_dir=str(tmp_path / "r"),
+                     device_lanes=2, device_flush_ops=4, device_gc_ops=10**9)
+        node = Node(dc_id="dc1", config=cfg)
+        n = 40  # >> 2 lanes: recovery replay must overflow and evict
+        for i in range(n):
+            node.coordinator.commit_transaction(
+                (lambda tx: (node.coordinator.update_objects(
+                    tx, [((("k", "counter_pn", "b")), "increment", 1)]),
+                    tx)[1])(node.coordinator.start_transaction()))
+        node.close()
+        node2 = Node(dc_id="dc1", config=cfg)
+        pm = node2.partition_of("k")
+        with pm._lock:
+            pm._val_cache.clear()
+        with pm._lock:
+            v = pm._read_store("k", "counter_pn", None)
+        assert v == n, f"recovery replayed {v} increments, committed {n}"
+        node2.close()
+
+    def test_multi_effect_commit_with_eviction_is_exact(self, tmp_path):
+        """One transaction, many effects on one key, ring too small:
+        the commit loop's publishes trigger eviction midway."""
+        from antidote_tpu.txn.node import Node
+
+        cfg = Config(n_partitions=1, data_dir=str(tmp_path / "m"),
+                     device_lanes=2, device_flush_ops=2,
+                     device_gc_ops=10**9)
+        node = Node(dc_id="dc1", config=cfg)
+        tx = node.coordinator.start_transaction()
+        node.coordinator.update_objects(
+            tx, [(("k", "counter_pn", "b"), "increment", 1)
+                 for _ in range(12)])
+        node.coordinator.commit_transaction(tx)
+        pm = node.partition_of("k")
+        with pm._lock:
+            pm._val_cache.clear()
+        with pm._lock:
+            v = pm._read_store("k", "counter_pn", None)
+        assert v == 12, f"commit published {v} of 12 increments"
+        node.close()

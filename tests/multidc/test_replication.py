@@ -337,3 +337,53 @@ class TestExactDownstreamState:
         v1, _ = dc1.read_objects_static(ct, [bo])
         v2, _ = dc2.read_objects_static(ct, [bo])
         assert v1[0] == v2[0] == {fld: ["x"]}
+
+
+class TestReplicatedRGA:
+    """Live device-served RGA across DCs (round-3: rga joined the device
+    plane; reference serves every type through one materializer path,
+    src/materializer_vnode.erl:56-110)."""
+
+    def test_collaborative_edits_replicate(self, cluster3):
+        dc1, dc2, dc3 = cluster3
+        key = ("doc", "rga", "b")
+        ct = dc1.update_objects_static(
+            None, [(key, "add_right", (0, "h"))])
+        ct = dc1.update_objects_static(ct, [(key, "add_right", (1, "i"))])
+        # dc2 extends causally after seeing dc1's edits
+        ct = dc2.update_objects_static(ct, [(key, "add_right", (2, "!"))])
+        for dc in cluster3:
+            vals, _ = dc.read_objects_static(ct, [key])
+            assert vals[0] == ["h", "i", "!"], dc.dc_id
+
+    def test_remove_tombstones_replicate(self, cluster3):
+        dc1, dc2, _ = cluster3
+        key = ("doc_rm", "rga", "b")
+        ct = None
+        for i, ch in enumerate("abcd"):
+            ct = dc1.update_objects_static(
+                ct, [(key, "add_right", (i, ch))])
+        ct = dc2.update_objects_static(ct, [(key, "remove", 2)])
+        for dc in cluster3:
+            vals, _ = dc.read_objects_static(ct, [key])
+            assert vals[0] == ["a", "c", "d"], dc.dc_id
+        # a later insert anchored right of the tombstoned position
+        ct = dc1.update_objects_static(ct, [(key, "add_right", (1, "X"))])
+        vals, _ = dc2.read_objects_static(ct, [key])
+        assert vals[0] == ["a", "X", "c", "d"]
+
+    def test_concurrent_inserts_converge(self, cluster3):
+        dc1, dc2, dc3 = cluster3
+        key = ("doc_cc", "rga", "b")
+        base = dc1.update_objects_static(
+            None, [(key, "add_right", (0, "s"))])
+        # both DCs insert at the head concurrently (same causal base)
+        ct1 = dc1.update_objects_static(base, [(key, "add_right", (0, "1"))])
+        ct2 = dc2.update_objects_static(base, [(key, "add_right", (0, "2"))])
+        merged = vc_max([ct1, ct2])
+        views = []
+        for dc in cluster3:
+            vals, _ = dc.read_objects_static(merged, [key])
+            views.append(vals[0])
+        assert views[0] == views[1] == views[2]
+        assert sorted(views[0]) == ["1", "2", "s"]

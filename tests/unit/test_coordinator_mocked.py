@@ -114,6 +114,9 @@ class FakeNode:
         self.stable_vc = lambda: VC({self.dc_id: self.clock.t})
         self.wait_hook = lambda: None
         self.mint_dot = lambda: ("dcM", self.clock.now_us())
+        from antidote_tpu.txn.node import TxnGate
+
+        self.txn_gate = TxnGate()
 
     def partition_index(self, key):
         if isinstance(key, int):

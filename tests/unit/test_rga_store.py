@@ -1,7 +1,8 @@
 """Incremental RGA store vs the one-shot merge kernel — the split
 base+window materialization (antidote_tpu/mat/rga_store.py) must produce
 the identical document at every step of a block-appended, periodically
-folded edit stream."""
+folded edit stream, and a VC-snapshot read must materialize exactly the
+snapshot's inclusion set (commit_vc <= read_vc)."""
 
 import numpy as np
 import jax.numpy as jnp
@@ -9,6 +10,18 @@ import pytest
 
 from antidote_tpu.mat import rga_kernel, rga_store
 from antidote_tpu.mat.synth import rga_trace
+
+_LATEST = jnp.asarray([np.iinfo(np.int64).max // 2], jnp.int64)
+
+
+def vc_cols(stamps):
+    """Single-DC commit-VC columns from scalar stamps (dc=0, ct=stamp,
+    empty snapshot): commit_vc = [stamp], so inclusion against a dense
+    [T] horizon is the scalar compare the simulation benches use."""
+    s = np.asarray(stamps, dtype=np.int64)
+    return (jnp.asarray(np.zeros(len(s), np.int32)),
+            jnp.asarray(s),
+            jnp.asarray(np.zeros((len(s), 1), np.int64)))
 
 
 def oracle_doc(tr, n_ins, n_del):
@@ -30,17 +43,34 @@ def oracle_doc(tr, n_ins, n_del):
     return doc[doc >= 0]
 
 
-def store_doc(st):
-    doc, n_vis = rga_store.rga_read(st)
+def store_doc(st, rv=_LATEST):
+    doc, n_vis = rga_store.rga_read_doc(st, rv)
     doc = np.asarray(doc)
     out = doc[doc >= 0]
     assert len(out) == int(n_vis)
     return out
 
 
+def append_block(st, tr, ins, dl, fed_i, fed_d, n):
+    bi = ins.stop - ins.start
+    bd = dl.stop - dl.start
+    return rga_store.rga_append(
+        st,
+        jnp.asarray(tr["ins_lamport"][ins]),
+        jnp.asarray(tr["ins_actor"][ins]),
+        jnp.asarray(tr["ref_lamport"][ins]),
+        jnp.asarray(tr["ref_actor"][ins]),
+        jnp.asarray(tr["elem"][ins]),
+        *vc_cols(np.arange(fed_i + 1, fed_i + bi + 1)),
+        jnp.asarray(tr["del_lamport"][dl]),
+        jnp.asarray(tr["del_actor"][dl]),
+        *vc_cols(np.arange(n + fed_d + 1, n + fed_d + bd + 1)))
+
+
 def drive(seed, n_ops, block, fold_every, p_delete=0.15, nw=None):
     """Feed the trace block-wise; fold at a commit frontier that lags by
-    one block; compare against the oracle after every block."""
+    one block; compare against the oracle after every block — both the
+    read-latest view and a strict-past snapshot read."""
     rng = np.random.default_rng(seed)
     tr = rga_trace(rng, n_ops, n_actors=6, p_delete=p_delete)
     n = len(tr["ins_lamport"])
@@ -51,53 +81,38 @@ def drive(seed, n_ops, block, fold_every, p_delete=0.15, nw=None):
         pb=8, nw=nw or max(64, 2 * block), md=max(16, m + 1))
     fed_i = fed_d = 0
     step = 0
+    prev_i = 0
     while fed_i < n or fed_d < m:
         bi = min(block, n - fed_i)
         bd = min(max(1, block // 8), m - fed_d) if fed_i >= n // 2 else 0
         ins = slice(fed_i, fed_i + bi)
         dl = slice(fed_d, fed_d + bd)
-        st, ok = rga_store.rga_append(
-            st,
-            jnp.asarray(tr["ins_lamport"][ins]),
-            jnp.asarray(tr["ins_actor"][ins]),
-            jnp.asarray(tr["ref_lamport"][ins]),
-            jnp.asarray(tr["ref_actor"][ins]),
-            jnp.asarray(tr["elem"][ins]),
-            jnp.asarray(np.arange(fed_i + 1, fed_i + bi + 1,
-                                  dtype=np.int32)),
-            jnp.asarray(tr["del_lamport"][dl]),
-            jnp.asarray(tr["del_actor"][dl]),
-            jnp.asarray(np.arange(n + fed_d + 1, n + fed_d + bd + 1,
-                                  dtype=np.int32)))
+        st, ok = append_block(st, tr, ins, dl, fed_i, fed_d, n)
         if not bool(ok):
-            st = rga_store.rga_fold_host(st, threshold=fed_i)
-            st, ok = rga_store.rga_append(
-                st,
-                jnp.asarray(tr["ins_lamport"][ins]),
-                jnp.asarray(tr["ins_actor"][ins]),
-                jnp.asarray(tr["ref_lamport"][ins]),
-                jnp.asarray(tr["ref_actor"][ins]),
-                jnp.asarray(tr["elem"][ins]),
-                jnp.asarray(np.arange(fed_i + 1, fed_i + bi + 1,
-                                      dtype=np.int32)),
-                jnp.asarray(tr["del_lamport"][dl]),
-                jnp.asarray(tr["del_actor"][dl]),
-                jnp.asarray(np.arange(n + fed_d + 1, n + fed_d + bd + 1,
-                                      dtype=np.int32)))
+            st = rga_store.rga_fold_host(st, fed_i)
+            st, ok = append_block(st, tr, ins, dl, fed_i, fed_d, n)
             assert bool(ok), "append must fit after a fold"
+        prev_i = fed_i
         fed_i += bi
         fed_d += bd
         step += 1
         if step % fold_every == 0:
             # frontier lags: only ops up to the previous block are stable
-            st = rga_store.rga_fold_host(
-                st, threshold=max(fed_i - block, 0))
+            st = rga_store.rga_fold_host(st, max(fed_i - block, 0))
         want = oracle_doc(tr, fed_i, fed_d)
         got = store_doc(st)
         assert np.array_equal(got, want), (
             f"step {step}: {len(got)} vs {len(want)} visible")
+        # VC-snapshot read strictly in the past: only ops with commit
+        # stamp <= prev_i are included (deletes stamped past n are out)
+        if prev_i and prev_i >= int(np.asarray(0)):
+            want_snap = oracle_doc(tr, prev_i, 0)
+            got_snap = store_doc(
+                st, jnp.asarray([prev_i], jnp.int64))
+            assert np.array_equal(got_snap, want_snap), (
+                f"step {step}: snapshot read at {prev_i} diverges")
     # final: fold everything, read again
-    st = rga_store.rga_fold_host(st, threshold=n + m + 1)
+    st = rga_store.rga_fold_host(st, n + m + 1)
     assert int(st.wn) == 0 and int(st.dn) == 0
     assert np.array_equal(store_doc(st), oracle_doc(tr, n, m))
 
@@ -115,36 +130,58 @@ def test_fold_every_block():
     drive(12, n_ops=160, block=16, fold_every=1)
 
 
-def test_deletes_on_folded_base_hide_at_read():
-    """A pending (unstable) delete whose target is already folded must
-    hide the base row at read time, before any fold sees the delete."""
+def test_full_state_read_exposes_tombstones():
+    """rga_read returns the host oracle's state shape: tombstoned
+    vertices stay present (vis False) in document order."""
+    rng = np.random.default_rng(3)
+    tr = rga_trace(rng, 30, n_actors=3, p_delete=0.0)
+    n = len(tr["ins_lamport"])
+    st = rga_store.rga_store_init(pb=64, nw=64, md=8)
+    st, ok = append_block(st, tr, slice(0, n), slice(0, 0), 0, 0, n)
+    assert bool(ok)
+    # tombstone vertex 4 via a delete lane
+    empty = jnp.asarray(np.zeros(0, np.int32))
+    st, ok = rga_store.rga_append(
+        st, empty, empty, empty, empty, empty, *vc_cols([]),
+        jnp.asarray(tr["ins_lamport"][4:5]),
+        jnp.asarray(tr["ins_actor"][4:5]),
+        *vc_cols([n + 1]))
+    assert bool(ok)
+    lam, act, elem, vis, cnt = rga_store.rga_read(st, _LATEST)
+    lam, act, vis = np.asarray(lam), np.asarray(act), np.asarray(vis)
+    assert int(cnt) == n               # tombstone still present
+    assert int(np.sum(vis)) == n - 1   # but not visible
+    # the tombstoned row carries its uid
+    hidden = [(l, a) for l, a, v in zip(lam[:n], act[:n], vis[:n])
+              if not v]
+    assert hidden == [(int(tr["ins_lamport"][4]),
+                       int(tr["ins_actor"][4]))]
+
+
+def test_snapshot_excludes_unstable_delete():
+    """A delete newer than the read snapshot must not hide its target,
+    whether the target is in the window or folded into the base."""
     rng = np.random.default_rng(5)
     tr = rga_trace(rng, 40, n_actors=3, p_delete=0.0)
     n = len(tr["ins_lamport"])
     st = rga_store.rga_store_init(pb=64, nw=64, md=8)
-    st, ok = rga_store.rga_append(
-        st, *(jnp.asarray(tr[k]) for k in (
-            "ins_lamport", "ins_actor", "ref_lamport", "ref_actor",
-            "elem")),
-        jnp.asarray(np.arange(1, n + 1, dtype=np.int32)),
-        jnp.asarray(np.zeros(0, np.int32)),
-        jnp.asarray(np.zeros(0, np.int32)),
-        jnp.asarray(np.zeros(0, np.int32)))
+    st, ok = append_block(st, tr, slice(0, n), slice(0, 0), 0, 0, n)
     assert bool(ok)
-    st = rga_store.rga_fold_host(st, threshold=n)  # all folded
-    before = store_doc(st)
-    assert len(before) == n
-    # delete vertex 7 (still unstable delete)
+    st = rga_store.rga_fold_host(st, n)  # all folded
+    assert len(store_doc(st)) == n
+    # delete vertex 7 (stamp n+1, still unstable)
+    empty = jnp.asarray(np.zeros(0, np.int32))
     st, ok = rga_store.rga_append(
-        st, *(jnp.asarray(np.zeros(0, np.int32)) for _ in range(5)),
-        jnp.asarray(np.zeros(0, np.int32)),
+        st, empty, empty, empty, empty, empty, *vc_cols([]),
         jnp.asarray(tr["ins_lamport"][7:8]),
         jnp.asarray(tr["ins_actor"][7:8]),
-        jnp.asarray(np.asarray([n + 1], np.int32)))
+        *vc_cols([n + 1]))
     assert bool(ok)
     assert len(store_doc(st)) == n - 1
+    # a snapshot below the delete's stamp still sees the vertex
+    assert len(store_doc(st, jnp.asarray([n], jnp.int64))) == n
     # folding the delete gives the same document
-    st = rga_store.rga_fold_host(st, threshold=n + 1)
+    st = rga_store.rga_fold_host(st, n + 1)
     assert len(store_doc(st)) == n - 1
 
 
@@ -154,17 +191,13 @@ def test_duplicate_redelivery_of_folded_ops_is_noop():
     rng = np.random.default_rng(9)
     tr = rga_trace(rng, 60, n_actors=4, p_delete=0.0)
     n = len(tr["ins_lamport"])
-    empty = jnp.asarray(np.zeros(0, np.int32))
     st = rga_store.rga_store_init(pb=128, nw=128, md=8)
-    args = tuple(jnp.asarray(tr[k]) for k in (
-        "ins_lamport", "ins_actor", "ref_lamport", "ref_actor", "elem"))
-    commits = jnp.asarray(np.arange(1, n + 1, dtype=np.int32))
-    st, ok = rga_store.rga_append(st, *args, commits, empty, empty, empty)
-    st = rga_store.rga_fold_host(st, threshold=n)
+    st, ok = append_block(st, tr, slice(0, n), slice(0, 0), 0, 0, n)
+    st = rga_store.rga_fold_host(st, n)
     want = store_doc(st)
-    st, ok = rga_store.rga_append(st, *args, commits, empty, empty, empty)
+    st, ok = append_block(st, tr, slice(0, n), slice(0, 0), 0, 0, n)
     assert bool(ok)
     assert np.array_equal(store_doc(st), want)
-    st = rga_store.rga_fold_host(st, threshold=n)
+    st = rga_store.rga_fold_host(st, n)
     assert np.array_equal(store_doc(st), want)
     assert int(st.wn) == 0  # duplicates pruned at fold
