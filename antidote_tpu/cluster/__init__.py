@@ -19,6 +19,12 @@ from antidote_tpu.cluster.node import (  # noqa: F401
     create_dc_cluster,
     plan_ring,
 )
+from antidote_tpu.cluster.federation import (  # noqa: F401
+    FederatedDescriptor,
+    NodeInterDc,
+    connect_federation,
+    dc_descriptor,
+)
 from antidote_tpu.cluster.remote import (  # noqa: F401
     RemoteCallError,
     RemotePartition,

@@ -243,7 +243,11 @@ class Coordinator:
         # does: a cutover swaps the partition objects out mid-resolve
         gate = None if tx.gated else self.node.txn_gate
         if gate is not None:
-            gate.enter()
+            try:
+                gate.enter()
+            except TimeoutError:
+                self.abort_transaction(tx)  # see update_objects
+                raise
         try:
             metas = []
             by_pm: dict = {}
@@ -285,7 +289,13 @@ class Coordinator:
         if not tx.gated:
             # shared handoff gate, held to commit/abort: a cutover must
             # never swap the logs out from under a txn's staged records
-            self.node.txn_gate.enter()
+            try:
+                self.node.txn_gate.enter()
+            except TimeoutError:
+                # admission blocked by a cutover: the txn dies here —
+                # without the abort, the open-transactions gauge leaks
+                self.abort_transaction(tx)
+                raise
             tx.gated = True
         try:
             self._apply_updates(tx, updates)

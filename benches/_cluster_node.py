@@ -10,8 +10,8 @@ requests still hop).
 
 Protocol (JSON lines):
   {"cmd": "join", "dc": d, "ring": {...}, "members": {...}}
-  {"cmd": "run", "txns": N, "slice": i, "n_nodes": k, "keys": K,
-   "cross": 0.1, "seed": s}      -> {"txns": n, "secs": t, "aborts": a}
+  {"cmd": "run", "txns": N, "keys": K, "cross": 0.1, "seed": s}
+      -> {"txns": n, "secs": t, "aborts": a}
   {"cmd": "exit"}
 """
 
@@ -115,10 +115,8 @@ def main():
                     prof = cProfile.Profile()
                     prof.enable()
                 rng = np.random.default_rng(req["seed"])
-                k, n_nodes, K = req["slice"], req["n_nodes"], req["keys"]
-                # integer keys map to partitions by modulo and the ring
-                # is round-robin, so key % n_partitions % ... — derive
-                # ownership from the node's own ring instead
+                K = req["keys"]
+                # key ownership derives from the node's own ring
                 ring = srv.node.ring
                 npart = len(ring)
                 own = [x for x in range(K)

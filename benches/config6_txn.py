@@ -193,8 +193,7 @@ def run_cluster(n_nodes, txns_per_node, K, tmp, cross=0.1):
         # measured window of a fresh process
         for p in procs:
             p.stdin.write(json.dumps(
-                {"cmd": "run", "txns": 400, "slice": 0,
-                 "n_nodes": n_nodes, "keys": K, "cross": cross,
+                {"cmd": "run", "txns": 400, "keys": K, "cross": cross,
                  "seed": 99}) + "\n")
             p.stdin.flush()
         for p in procs:
@@ -202,9 +201,8 @@ def run_cluster(n_nodes, txns_per_node, K, tmp, cross=0.1):
         t0 = time.perf_counter()
         for i, p in enumerate(procs):
             p.stdin.write(json.dumps(
-                {"cmd": "run", "txns": txns_per_node, "slice": i,
-                 "n_nodes": n_nodes, "keys": K, "cross": cross,
-                 "seed": i}) + "\n")
+                {"cmd": "run", "txns": txns_per_node, "keys": K,
+                 "cross": cross, "seed": i}) + "\n")
             p.stdin.flush()
         total = aborts = 0
         for p in procs:
@@ -264,8 +262,8 @@ def main():
          cluster_txn_per_sec=round(cluster_tput),
          cluster_nodes=n_nodes,
          cluster_abort_rate=round(
-             cluster_aborts
-             / max(cluster_aborts + n_nodes * txns, 1), 4),
+             # each worker makes exactly `txns` attempts (done+aborted)
+             cluster_aborts / max(n_nodes * txns, 1), 4),
          abort_rate=round(aborts / max(aborts + len(lat), 1), 4),
          mix="80% update (1r+2w), 20% read (3r); pb variant static",
          note="vs_baseline = thread-scaling factor (8 clients vs 1)")

@@ -105,7 +105,7 @@ def drive(seed, n_ops, block, fold_every, p_delete=0.15, nw=None):
             f"step {step}: {len(got)} vs {len(want)} visible")
         # VC-snapshot read strictly in the past: only ops with commit
         # stamp <= prev_i are included (deletes stamped past n are out)
-        if prev_i and prev_i >= int(np.asarray(0)):
+        if prev_i:
             want_snap = oracle_doc(tr, prev_i, 0)
             got_snap = store_doc(
                 st, jnp.asarray([prev_i], jnp.int64))
