@@ -262,21 +262,41 @@ def _config_extras(quick_cpu: bool) -> dict:
         out.pop("vs_host_round", None)
     except Exception as e:  # never let an extra kill the headline
         out["gst_error"] = repr(e)
-    try:
-        import os as _os
+    import os as _os
+
+    here = _os.path.dirname(_os.path.abspath(__file__))
+
+    def run_config(mod, *flags, timeout=900):
         r = subprocess.run(
-            [sys.executable, "-m", "benches.config6_txn", "--cpu",
-             "--quick"],
-            timeout=900, capture_output=True, text=True,
-            cwd=_os.path.dirname(_os.path.abspath(__file__)))
-        line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
-        cfg6 = json.loads(line)
+            [sys.executable, "-m", mod, *flags],
+            timeout=timeout, capture_output=True, text=True, cwd=here)
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("{")][-1]
+        return json.loads(line)
+
+    try:
+        cfg6 = run_config("benches.config6_txn", "--cpu", "--quick")
         out["txn_per_sec_8client_cpu_quick"] = cfg6["value"]
         out["txn_p50_ms"] = cfg6["detail"].get("p50_ms")
         out["txn_p99_ms"] = cfg6["detail"].get("p99_ms")
         out["txn_pb_per_sec"] = cfg6["detail"].get("pb_txn_per_sec")
+        out["txn_cluster_per_sec"] = cfg6["detail"].get(
+            "cluster_txn_per_sec")
     except Exception as e:
         out["txn_error"] = repr(e)
+    # configs 1/3/4 quick, on the bench platform (hardware when the
+    # chip is up): every BASELINE config lands in the driver record
+    flags = ("--cpu", "--quick") if quick_cpu else ("--quick",)
+    for key, mod in (("counter", "benches.config1_counter"),
+                     ("mvreg_64dc", "benches.config3_mvreg"),
+                     ("rga_steady", "benches.config4_rga")):
+        try:
+            cfg = run_config(mod, *flags)
+            out[f"{key}_value"] = cfg["value"]
+            out[f"{key}_unit"] = cfg["unit"]
+            out[f"{key}_vs_baseline"] = cfg["vs_baseline"]
+        except Exception as e:
+            out[f"{key}_error"] = repr(e)
     return out
 
 
