@@ -72,6 +72,9 @@ class NodeLink:
         self._srv: Optional[socket.socket] = None
         #: peer node_id -> {"addr", "sock", "lock"}
         self._peers: Dict[Any, Dict[str, Any]] = {}
+        #: accepted server-side connections (closed on shutdown so a
+        #: restarted process can rebind the advertised port)
+        self._accepted: List[socket.socket] = []
         self._lock = threading.RLock()
         self._stop = threading.Event()
         #: client-side request ids: (boot_token, n).  The token makes
@@ -114,6 +117,8 @@ class NodeLink:
                 conn, _addr = self._srv.accept()
             except OSError:
                 return
+            with self._lock:
+                self._accepted.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -265,10 +270,24 @@ class NodeLink:
         self._stop.set()
         if self._srv is not None:
             try:
+                # wake the thread blocked in accept(): close() alone
+                # leaves the kernel file (and the LISTEN entry) alive
+                # until the in-syscall accept returns, so a restarted
+                # process could never rebind the advertised port
+                self._srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._srv.close()
             except OSError:
                 pass
         with self._lock:
+            for conn in self._accepted:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._accepted.clear()
             for peer in self._peers.values():
                 if peer["sock"] is not None:
                     peer["sock"].close()

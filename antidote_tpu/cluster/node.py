@@ -173,6 +173,15 @@ class NodeServer:
         self.meta = StableMetaData(
             os.path.join(data_dir, f"node_{node_id}_meta.pkl"),
             recover=self.config.recover_meta_data_on_start)
+        plan = self.meta.get("cluster_plan")
+        if plan is not None and port == 0:
+            # a restarted member must come back at its ADVERTISED
+            # address: peers' persisted member tables (and federated
+            # descriptors) point there, and a fresh random port would
+            # leave their gossip/RPC dialing a dead socket forever
+            planned = dict(plan[2]).get(node_id)
+            if planned is not None:
+                host, port = planned
         self.link = NodeLink(node_id, host=host, port=port)
         self.addr = self.link.serve(self._handle)
         self.node: Optional[ClusterNode] = None
