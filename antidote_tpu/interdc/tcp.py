@@ -364,6 +364,14 @@ class TcpTransport(Transport):
         for srv in (self._pub_srv, self._query_srv):
             if srv is not None:
                 try:
+                    # wake the accept() thread: close() alone leaves the
+                    # kernel file (and the LISTEN entry) alive until the
+                    # in-syscall accept returns, blocking an in-process
+                    # rebind of the port (see cluster/link.py close)
+                    srv.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
                     srv.close()
                 except OSError:
                     pass
