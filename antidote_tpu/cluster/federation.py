@@ -128,6 +128,14 @@ class NodeInterDc:
 
         tracker.sources = [_source(p) for p in local_sorted]
         node.wait_hook = self._wait_hook
+        # restart re-join: re-observe the federations this node knew
+        # (reference check_node_restart reconnects its DCs,
+        # src/inter_dc_manager.erl:156-201)
+        for t in (srv.meta.get("federated_descriptors") or []):
+            try:
+                self.observe_dc(FederatedDescriptor.from_wire(t))
+            except Exception:  # noqa: BLE001 — a dead peer at boot
+                log.warning("restart re-observe of %r failed", t[0])
 
     # ---------------------------------------------------------- membership
 
@@ -151,6 +159,11 @@ class NodeInterDc:
         nodes, src/inter_dc_manager.erl:87-109)."""
         if desc.dc_id == self.dc_id:
             return
+        if desc.dc_id in self.remote:
+            # already subscribed (e.g. restart re-observe + a manual
+            # call): refresh the descriptor, keep the live buffers
+            self.remote[desc.dc_id] = desc
+            return
         if desc.n_partitions != self.node.config.n_partitions:
             raise ValueError(
                 f"{desc.dc_id!r} has {desc.n_partitions} partitions, "
@@ -168,6 +181,12 @@ class NodeInterDc:
         self.remote[desc.dc_id] = desc
         for s in self.senders.values():
             s.enabled = True
+        # persist for restart re-observe
+        kept = [t for t in
+                (self.srv.meta.get("federated_descriptors") or [])
+                if t[0] != desc.dc_id]
+        self.srv.meta.put("federated_descriptors",
+                          kept + [desc.to_wire()])
 
     # --------------------------------------------------------- background
 

@@ -221,9 +221,10 @@ class TestFederatedMemberRestart:
                                            heartbeat_s=0.02,
                                            clock_wait_timeout_s=10.0))
             assert sb0.node is not None  # plan reloaded from disk
+            # NodeInterDc auto-re-observes the persisted federation
+            # descriptors (reference check_node_restart reconnects DCs)
             nb0 = NodeInterDc(sb0, bus)
-            for desc in (dc_descriptor(na), dc_descriptor(nb)):
-                nb0.observe_dc(desc)
+            assert "dcA" in nb0.remote
             nb0.start()
             sb[0], nb[0] = sb0, nb0
             # the restarted member serves its slice at the causal clock
