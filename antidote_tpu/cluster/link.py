@@ -57,6 +57,75 @@ def _raise_remote(kind: str, msg: str):
 _DEDUP_CAP = 256
 
 
+class AtMostOnceCache:
+    """Server-side at-most-once request cache, shared by the Python
+    NodeLink and the native-transport link (cluster/nativelink.py): the
+    execute-once / remember-reply semantics are protocol, not transport,
+    so both fabrics answer retries identically."""
+
+    def __init__(self, request_timeout: float = 30.0):
+        self.request_timeout = request_timeout
+        self._lock = threading.RLock()
+        #: origin -> {rid: reply bytes | in-flight Event}
+        self._seen: Dict[Any, "dict"] = {}
+
+    def answer(self, origin, rid, kind: str, payload,
+               handler: Callable[[Any, str, Any], Any]) -> bytes:
+        """Run the handler at most once per (origin, rid): a client that
+        lost the reply re-sends the same rid on a fresh connection and
+        gets the remembered answer, not a re-execution.  A retry that
+        lands while the FIRST execution is still running (connection
+        dropped mid-handler) parks on its in-flight marker instead of
+        re-executing concurrently."""
+        with self._lock:
+            cache = self._seen.setdefault(origin, {})
+            entry = cache.get(rid)
+            if isinstance(entry, bytes):
+                return entry
+            owner = entry is None
+            if owner:
+                entry = threading.Event()
+                cache[rid] = entry
+        if not owner:
+            # a duplicate while the first execution is still running:
+            # park on its marker, then serve the owner's reply
+            entry.wait(timeout=self.request_timeout)
+            with self._lock:
+                got = cache.get(rid)
+            if isinstance(got, bytes):
+                return got
+            from antidote_tpu.cluster.remote import RemoteCallError
+
+            raise RemoteCallError(
+                "duplicate request: first execution failed or timed out")
+        try:
+            result = handler(origin, kind, payload)
+            reply = termcodec.encode(("ok", result))
+        except Exception:
+            with self._lock:
+                cache.pop(rid, None)  # errors are not cached (typed
+                # protocol errors are deterministic; infra errors should
+                # retry fresh)
+            entry.set()
+            raise
+        with self._lock:
+            # evict oldest COMPLETED replies only — popping another
+            # request's in-flight marker would orphan its waiters
+            if len(cache) >= _DEDUP_CAP:
+                stale = [k for k, v in cache.items()
+                         if isinstance(v, bytes)]
+                for k in stale[:len(cache) - _DEDUP_CAP + 1]:
+                    cache.pop(k)
+            # re-insert at the dict tail: overwriting the in-flight
+            # marker in place would leave a SLOW request's reply at its
+            # request-START position — first in line for eviction,
+            # exactly for the requests most likely to be retried
+            cache.pop(rid, None)
+            cache[rid] = reply
+        entry.set()
+        return reply
+
+
 class NodeLink:
     """One node's endpoint of the DC's node fabric."""
 
@@ -83,12 +152,11 @@ class NodeLink:
         #: at-most-once caches and be served stale cached replies.
         self._boot = int.from_bytes(os.urandom(8), "big")
         self._rid = 0
-        #: server-side at-most-once cache: origin -> {rid: reply bytes}.
-        #: A reconnecting client re-sends its last request with the SAME
-        #: rid; answering from here instead of re-executing is what
-        #: keeps non-idempotent RPCs (stage_update, commit) exactly-once
-        #: across a reply lost to a dropped connection.
-        self._seen: Dict[Any, "dict"] = {}
+        #: server-side at-most-once cache — a reconnecting client
+        #: re-sends its last request with the SAME rid; answering from
+        #: the cache instead of re-executing keeps non-idempotent RPCs
+        #: (stage_update, commit) exactly-once across a lost reply
+        self._amo = AtMostOnceCache(request_timeout=request_timeout)
 
     # ------------------------------------------------------------- server
 
@@ -147,59 +215,8 @@ class NodeLink:
                     return
 
     def _answer(self, origin, rid, kind: str, payload) -> bytes:
-        """Run the handler at most once per (origin, rid): a client that
-        lost the reply re-sends the same rid on a fresh connection and
-        gets the remembered answer, not a re-execution.  A retry that
-        lands while the FIRST execution is still running (connection
-        dropped mid-handler) parks on its in-flight marker instead of
-        re-executing concurrently."""
-        with self._lock:
-            cache = self._seen.setdefault(origin, {})
-            entry = cache.get(rid)
-            if isinstance(entry, bytes):
-                return entry
-            owner = entry is None
-            if owner:
-                entry = threading.Event()
-                cache[rid] = entry
-        if not owner:
-            # a duplicate while the first execution is still running:
-            # park on its marker, then serve the owner's reply
-            entry.wait(timeout=self.request_timeout)
-            with self._lock:
-                got = cache.get(rid)
-            if isinstance(got, bytes):
-                return got
-            from antidote_tpu.cluster.remote import RemoteCallError
-
-            raise RemoteCallError(
-                "duplicate request: first execution failed or timed out")
-        try:
-            result = self._handler(origin, kind, payload)
-            reply = termcodec.encode(("ok", result))
-        except Exception:
-            with self._lock:
-                cache.pop(rid, None)  # errors are not cached (typed
-                # protocol errors are deterministic; infra errors should
-                # retry fresh)
-            entry.set()
-            raise
-        with self._lock:
-            # evict oldest COMPLETED replies only — popping another
-            # request's in-flight marker would orphan its waiters
-            if len(cache) >= _DEDUP_CAP:
-                stale = [k for k, v in cache.items()
-                         if isinstance(v, bytes)]
-                for k in stale[:len(cache) - _DEDUP_CAP + 1]:
-                    cache.pop(k)
-            # re-insert at the dict tail: overwriting the in-flight
-            # marker in place would leave a SLOW request's reply at its
-            # request-START position — first in line for eviction,
-            # exactly for the requests most likely to be retried
-            cache.pop(rid, None)
-            cache[rid] = reply
-        entry.set()
-        return reply
+        return self._amo.answer(origin, rid, kind, payload,
+                                self._handler)
 
     # ------------------------------------------------------------- client
 

@@ -49,6 +49,27 @@ from antidote_tpu.txn.node import Node
 log = logging.getLogger(__name__)
 
 
+def build_link(node_id, host: str = "127.0.0.1", port: int = 0,
+               config: Optional[Config] = None):
+    """The DC's node-fabric endpoint: the native IO plane when built
+    (C++ event loop, GIL-free waits, pipelined requests —
+    cluster/nativelink.py), else the pure-Python NodeLink.  Both speak
+    the same termcodec payloads over different wire framings, so every
+    member of one cluster must pick the same plane — which they do, by
+    sharing the Config default and the same build environment."""
+    cfg = config or Config()
+    if cfg.node_fabric == "native":
+        from antidote_tpu.cluster import nativelink
+
+        if nativelink.native_available():
+            return nativelink.NativeNodeLink(
+                node_id, host=host, port=port,
+                workers=cfg.fabric_workers)
+        log.warning("native node fabric unavailable; falling back to "
+                    "the Python NodeLink")
+    return NodeLink(node_id, host=host, port=port)
+
+
 def plan_ring(n_partitions: int, node_ids: List[Any]) -> Dict[int, Any]:
     """Round-robin partition placement — the cluster plan the reference
     computes via riak_core claim (reference antidote_dc_manager's
@@ -112,7 +133,13 @@ class ClusterNode(Node):
 
 
 class ClusterStablePlane:
-    """Two-level stable time: local partition fold + node-summary gossip."""
+    """Two-level stable time: local partition fold + node-summary gossip.
+
+    ``member_ids`` are the DATA members (ring owners) only: the
+    min-of-mins is over nodes that actually hold partitions.  A
+    coordinator-only member (see NodeServer's client role) neither
+    contributes a summary nor pins the snapshot — it just receives
+    peer summaries and reads the merged view."""
 
     def __init__(self, dc_id, node_id, member_ids: List[Any],
                  local: StableTimeTracker):
@@ -147,9 +174,11 @@ class ClusterStablePlane:
             lambda cur: vc if cur is None else cur.join(vc))
 
     def local_summary(self) -> VC:
-        """This node's contribution: the min-fold over its partitions."""
+        """This node's contribution: the min-fold over its partitions.
+        A coordinator-only member has none — nothing to record."""
         s = self.local.get_stable_snapshot()
-        self.put_node(self.node_id, s)
+        if self.node_id in self._idx:
+            self.put_node(self.node_id, s)
         return s
 
     def get_stable_snapshot(self) -> VC:
@@ -182,7 +211,8 @@ class NodeServer:
             planned = dict(plan[2]).get(node_id)
             if planned is not None:
                 host, port = planned
-        self.link = NodeLink(node_id, host=host, port=port)
+        self.link = build_link(node_id, host=host, port=port,
+                               config=self.config)
         self.addr = self.link.serve(self._handle)
         self.node: Optional[ClusterNode] = None
         self.api = None
@@ -203,21 +233,56 @@ class NodeServer:
     def descriptor(self) -> Tuple[Any, Tuple[str, int]]:
         return (self.node_id, self.addr)
 
+    def fabric_kind(self) -> str:
+        """Which wire framing this node's fabric speaks ("native" =
+        corr-id frames via nodelink.cpp, "python" = plain NodeLink
+        frames).  The two do not interoperate: a plan must never mix
+        them — one member silently falling back (no compiler) would
+        strand every RPC to it in decode errors."""
+        return "native" if hasattr(self.link, "finish_many") else \
+            "python"
+
     def install_cluster(self, dc_id, ring: Dict[int, Any],
-                        members: Dict[Any, Tuple[str, int]]) -> None:
+                        members: Dict[Any, Tuple[str, int]],
+                        fabric: Optional[str] = None,
+                        clients: Optional[List[Any]] = None) -> None:
         """Commit the cluster plan on this node (the staged-join
         plan/commit step).  Persisted first: a crash between commit and
-        assembly re-runs assembly at the next boot."""
+        assembly re-runs assembly at the next boot.
+
+        ``fabric`` is the plan author's fabric kind: a mismatch with
+        this node's refuses the join LOUDLY instead of assembling a
+        member nobody can talk to.  ``clients`` lists the members that
+        are INTENDED to be coordinator-only (client role): they hold
+        RemotePartition proxies for the whole ring and run transactions
+        without owning data — the riak_core pattern of coordinating
+        from any node while vnodes live on the ring (reference
+        src/antidote_dc_manager.erl nodes vs ring claim).  The list is
+        explicit so a member that was MEANT to own data but got no ring
+        slot (an operator sizing mistake) still fails loudly."""
         if self.node is not None:
             raise RuntimeError("node already belongs to a cluster")
         if self.node_id not in members:
             raise ValueError(f"plan does not include {self.node_id!r}")
+        if fabric is not None and fabric != self.fabric_kind():
+            raise RuntimeError(
+                f"fabric mismatch: plan requires {fabric!r} but "
+                f"{self.node_id!r} runs {self.fabric_kind()!r} (native "
+                "fabric unavailable here? fix the build or set "
+                "Config.node_fabric='python' cluster-wide)")
         owners = set(ring.values())
-        if owners != set(members):
+        if not owners <= set(members):
             raise ValueError(
-                f"every member must own >= 1 partition and every owner "
-                f"must be a member (owners {owners!r} vs members "
-                f"{set(members)!r})")
+                f"every ring owner must be a member (owners {owners!r} "
+                f"vs members {set(members)!r})")
+        slotless = set(members) - owners
+        declared = set(clients or ())
+        if slotless != declared:
+            raise ValueError(
+                f"members without ring slots {sorted(slotless, key=repr)!r} "
+                f"must exactly match the declared client members "
+                f"{sorted(declared, key=repr)!r} — a data member left "
+                "without a slot is a plan error, not a silent demotion")
         self.meta.put("cluster_plan", (dc_id, dict(ring), dict(members)))
         self._assemble(dc_id, dict(ring), dict(members))
 
@@ -236,8 +301,9 @@ class NodeServer:
             return lambda: VC({dc_id: pm.min_prepared()})
 
         tracker.sources = [_source(node.partitions[p]) for p in local_idx]
+        data_members = sorted(set(ring.values()), key=repr)
         plane = ClusterStablePlane(dc_id, self.node_id,
-                                   list(members), tracker)
+                                   data_members, tracker)
         last = self.meta.get("last_stable_vc")
         if last:
             plane.seed_floor(VC(last))
@@ -262,7 +328,9 @@ class NodeServer:
     # -------------------------------------------------------------- gossip
 
     def _gossip_loop(self) -> None:
-        period = self.config.heartbeat_s
+        period = self.config.cluster_gossip_s
+        if period is None:
+            period = self.config.heartbeat_s
         while not self._stop.wait(period):
             try:
                 self.gossip_tick()
@@ -277,6 +345,10 @@ class NodeServer:
         just failed is backed off for a few seconds so one dead member's
         connect timeouts don't delay the live members' gossip."""
         if self.plane is None:
+            return
+        if self.node_id not in self.plane._idx:
+            # coordinator-only member: nothing to contribute — its
+            # stable view fills from the data members' broadcasts
             return
         summary = self.plane.local_summary()
         now = time.monotonic()
@@ -296,10 +368,13 @@ class NodeServer:
         if kind == "check_up":
             return True
         if kind == "join":
-            dc_id, ring_pairs, member_pairs = payload
+            dc_id, ring_pairs, member_pairs = payload[:3]
+            fabric = payload[3] if len(payload) > 3 else None
+            clients = list(payload[4]) if len(payload) > 4 else None
             self.install_cluster(
                 dc_id, {int(p): nid for p, nid in ring_pairs},
-                {nid: tuple(addr) for nid, addr in member_pairs})
+                {nid: tuple(addr) for nid, addr in member_pairs},
+                fabric=fabric, clients=clients)
             return True
         if kind == "gossip":
             nid, vc = payload
@@ -345,13 +420,23 @@ class NodeServer:
 
 
 def create_dc_cluster(dc_id, n_partitions: int,
-                      servers: List[NodeServer]) -> Dict[int, Any]:
+                      servers: List[NodeServer],
+                      clients: List[NodeServer] = ()) -> Dict[int, Any]:
     """In-process cluster build: plan the ring over the given servers
     and commit it on each (the antidote_dc_manager:create_dc flow,
-    reference src/antidote_dc_manager.erl:53-81).  For cross-process
-    builds, push the same plan via the "join" RPC instead."""
+    reference src/antidote_dc_manager.erl:53-81).  ``clients`` join as
+    coordinator-only members: full API, no ring slots.  For
+    cross-process builds, push the same plan via the "join" RPC
+    instead."""
     members = {s.node_id: s.addr for s in servers}
-    ring = plan_ring(n_partitions, list(members))
-    for s in servers:
-        s.install_cluster(dc_id, ring, members)
+    members.update({c.node_id: c.addr for c in clients})
+    kinds = {s.fabric_kind() for s in list(servers) + list(clients)}
+    if len(kinds) > 1:
+        raise RuntimeError(
+            f"members run different fabrics {sorted(kinds)!r}; the "
+        "framings do not interoperate — align Config.node_fabric")
+    ring = plan_ring(n_partitions, [s.node_id for s in servers])
+    client_ids = [c.node_id for c in clients]
+    for s in list(servers) + list(clients):
+        s.install_cluster(dc_id, ring, members, clients=client_ids)
     return ring

@@ -43,6 +43,21 @@ class Config:
     #: inter-DC heartbeat period, seconds (reference ?HEARTBEAT_PERIOD
     #: 1 s, include/antidote.hrl:55)
     heartbeat_s: float = 1.0
+    #: cluster stable-gossip period, seconds — its own knob, NOT the
+    #: inter-DC heartbeat (the reference separates ?META_DATA_SLEEP
+    #: from ?HEARTBEAT_PERIOD, include/antidote.hrl:55,60).  None
+    #: follows heartbeat_s, so existing single-knob tunings keep
+    #: working; set explicitly to decouple.
+    cluster_gossip_s: float | None = None
+    #: intra-DC node fabric IO plane: "native" = C++ event loop with
+    #: GIL-free waits + pipelined requests (cluster/nativelink.py),
+    #: falling back to the pure-Python NodeLink when no compiler is
+    #: available; "python" forces the fallback
+    node_fabric: str = "native"
+    #: worker threads answering node RPCs on the native fabric (the
+    #: reference's per-vnode read-server pool is 20,
+    #: include/antidote.hrl:28)
+    fabric_workers: int = 16
     #: reload DC descriptors / env flags from disk at boot (reference
     #: recover_meta_data_on_start)
     recover_meta_data_on_start: bool = True

@@ -36,10 +36,13 @@ rare host-side repack (store.orset_grow).
 
 from __future__ import annotations
 
+import atexit
 import logging
+import threading
 from dataclasses import replace as dc_replace
 from typing import Any, Callable, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -98,6 +101,25 @@ def _pack_rows(rows: List[tuple], capacity: int, d: int,
     return key_idx, lane_off, arrays
 
 
+#: (append_fn, state-shape signature, bucket) combos already compiled
+#: (or being compiled) in this process — plane instances share XLA
+#: programs class-wide, so one warm pass covers every partition
+_WARMED: set = set()
+_WARM_LOCK = threading.Lock()
+_WARM_THREADS: List[threading.Thread] = []
+
+
+def _join_warm_threads() -> None:
+    # a daemon thread force-unwound MID-XLA-CALL at interpreter exit
+    # aborts the process ("terminate called ... FATAL: exception not
+    # rethrown"); give in-flight warms a bounded grace period instead
+    for t in list(_WARM_THREADS):
+        t.join(timeout=5.0)
+
+
+atexit.register(_join_warm_threads)
+
+
 class _PlaneBase:
     """Shared machinery: key directory, pending rows, flush/gc plumbing."""
 
@@ -129,6 +151,7 @@ class _PlaneBase:
         self.on_evict: Callable[[Any, str], None] = lambda k, t: None
         self.capacity = key_capacity
         self.st = self._init_state(key_capacity)
+        self.warm_appends()
 
     # -- subclass hooks -----------------------------------------------------
 
@@ -146,6 +169,61 @@ class _PlaneBase:
     _row_cols: tuple = ()
     #: the store's ``*_append`` for this plane's shard state
     _append_fn = None
+
+    def warm_appends(self, buckets: tuple = (64, 256)) -> None:
+        """Compile this plane's append programs for every dispatch
+        bucket BEFORE the serving path needs them, in a background
+        thread (XLA compilation is C++ work that releases the GIL, so
+        commits keep flowing).  Without this, the first flush at an
+        unseen bucket shape pays a ~300 ms in-line compile UNDER the
+        partition lock — measured as the dominant config6 p99 term and
+        the cluster data node's commit convoy.  The warm rows are all
+        padding (key index = capacity, _pack_rows' sentinel), so
+        executing the program is a no-op on the discarded result."""
+        if type(self)._append_fn is None:
+            return
+        shapes = tuple(
+            (tuple(x.shape), str(getattr(x, "dtype", "")))
+            for x in jax.tree_util.tree_leaves(self.st))
+        base_key = (id(type(self)._append_fn), shapes)
+        todo = []
+        with _WARM_LOCK:
+            for b in buckets:
+                k = base_key + (b,)
+                if k not in _WARMED:
+                    _WARMED.add(k)
+                    todo.append(b)
+        if not todo:
+            return
+        d, cols, cap = self.domain.d, self._row_cols, self.capacity
+        fn = type(self)._append_fn
+        # the append DONATES its state buffers — warm on a copy, never
+        # the live state.  The copy is taken HERE, synchronously: this
+        # runs from __init__ (or a grow site under the partition lock),
+        # before concurrent appends could donate the buffers out from
+        # under a background tree_map.
+        st_copy = jax.tree_util.tree_map(jnp.copy, self.st)
+
+        def run():
+            st = st_copy
+            for b in todo:
+                ki = np.full(b, cap, dtype=np.int32)
+                lo = np.zeros(b, dtype=np.int32)
+                arrays = [np.zeros((b, d) if tag == "vv" else b,
+                                   dtype=np.int64) for tag in cols]
+                try:
+                    st, _over = fn(st, jnp.asarray(ki),
+                                   jnp.asarray(lo),
+                                   *(jnp.asarray(a) for a in arrays))
+                except Exception:  # noqa: BLE001 — warm is best-effort
+                    log.debug("append warm failed", exc_info=True)
+                    return
+
+        _WARM_THREADS[:] = [t for t in _WARM_THREADS if t.is_alive()]
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"warm:{self.type_name}")
+        _WARM_THREADS.append(t)
+        t.start()
 
     def _append_rows(self, rows: List[tuple]) -> np.ndarray:
         """Device-append decoded rows via the shared packing

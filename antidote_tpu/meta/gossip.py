@@ -63,7 +63,9 @@ class StableTimeTracker:
     # -- merge callbacks (the stable_time_functions role) ----------------
 
     def _merge_rows(self, rows: List[np.ndarray]) -> VC:
-        if len(self.domain) == 0:
+        if not rows or len(self.domain) == 0:
+            # zero partitions: a coordinator-only cluster member has no
+            # rows to fold; its stable view comes from peer gossip
             return VC()
         gst = np.stack(rows).min(axis=0)
         return self.domain.from_dense(gst)

@@ -96,24 +96,64 @@ def _fresh_txid_suffix() -> str:
     return f"{_TXID_PREFIX}{next(_TXID_SEQ):x}"
 
 
-def _fan_out(pairs, fn):
+def _fan_out(pairs, fn, spec=None):
     """Run ``fn(p, pm)`` for every 2PC participant, overlapping the
-    REMOTE ones in threads (their cost is a fabric round trip whose
-    wait releases the GIL — the reference broadcasts prepare/commit and
-    collects replies, src/clocksi_vnode.erl:168-200).  Local calls run
-    inline; results return in participant order; the first exception
-    re-raises after every call finished (a half-collected prepare round
-    must not leak in-flight RPC threads)."""
+    REMOTE ones (their cost is a fabric round trip whose wait releases
+    the GIL — the reference broadcasts prepare/commit and collects
+    replies, src/clocksi_vnode.erl:168-200).  Results return in
+    participant order; the first exception re-raises only after every
+    call finished (a half-collected prepare round must not leak
+    in-flight work).
+
+    When ``spec(p, pm) -> (method, args, kwargs)`` is given and the
+    remote link is pipelined (cluster/nativelink.py), all remote calls
+    are STARTED first from this thread (zero thread spawns — the
+    reference's async broadcast, src/clocksi_interactive_coord.erl:
+    514-577), local calls run while the frames are in flight, and the
+    round is collected in one native wait.  Otherwise remote calls fall
+    back to a thread per participant."""
     import threading as _threading
 
     remote = [(i, p, pm) for i, (p, pm) in enumerate(pairs)
               if getattr(pm, "deferred_stage", False)]
     results: list = [None] * len(pairs)
+    errs: list = []
+    handles = []
+    if spec is not None and remote:
+        link = remote[0][2].link
+        if hasattr(link, "finish_many") and all(
+                pm.link is link for _i, _p, pm in remote):
+            try:
+                for i, p, pm in remote:
+                    method, args, kwargs = spec(p, pm)
+                    handles.append((i, pm.start_call(method, *args,
+                                                     **kwargs)))
+            except BaseException:
+                # a failed start (unknown peer) must not leak the
+                # already-started calls' native completion slots
+                link.abandon([h for _i, h in handles])
+                raise
+    if handles:
+        for i, (p, pm) in enumerate(pairs):
+            if not getattr(pm, "deferred_stage", False):
+                try:
+                    results[i] = fn(p, pm)
+                except BaseException as e:  # noqa: BLE001 — below
+                    errs.append(e)
+        link = remote[0][2].link
+        for (i, _h), (ok, val) in zip(
+                handles, link.finish_many([h for _i, h in handles])):
+            if ok:
+                results[i] = val
+            else:
+                errs.append(val)
+        if errs:
+            raise errs[0]
+        return results
     if len(remote) <= 1:
         for i, (p, pm) in enumerate(pairs):
             results[i] = fn(p, pm)
         return results
-    errs: list = []
 
     def run(i, p, pm):
         try:
@@ -258,9 +298,35 @@ class Coordinator:
                 metas.append((key, cls, pm))
                 by_pm.setdefault(pm, []).append((key, cls.name))
             values: dict = {}
-            for pm, items in by_pm.items():
-                values.update(pm.read_many(
-                    items, tx.snapshot_vc, txid=tx.txid))
+            # remote partitions on a pipelined link: start every
+            # read_many first, resolve local partitions while the
+            # frames are in flight, collect the round in one native
+            # wait (the reference's async batched reads,
+            # src/clocksi_interactive_coord.erl:731-747)
+            handles = []
+            link = None
+            try:
+                for pm, items in by_pm.items():
+                    if (getattr(pm, "deferred_stage", False)
+                            and hasattr(pm.link, "finish_many")):
+                        link = pm.link
+                        handles.append(pm.start_call(
+                            "read_many", items, tx.snapshot_vc,
+                            txid=tx.txid))
+                    else:
+                        values.update(pm.read_many(
+                            items, tx.snapshot_vc, txid=tx.txid))
+            except BaseException:
+                # a local read failed mid-round: started remote calls
+                # must not leak their native completion slots
+                if handles:
+                    link.abandon(handles)
+                raise
+            if handles:
+                for ok, val in link.finish_many(handles):
+                    if not ok:
+                        raise val
+                    values.update(val)
             out = []
             for key, cls, pm in metas:
                 value = values[(key, cls.name)]
@@ -394,10 +460,19 @@ class Coordinator:
                                             tx.snapshot_vc, certify)
                 return pm.prepare(tx.txid, tx.snapshot_vc, certify)
 
+            def _prepare_spec(p, pm):
+                if p in tx.deferred_ops:
+                    return ("stage_prepare",
+                            (tx.txid, [tuple(o) for o in
+                                       tx.deferred_ops[p]],
+                             tx.snapshot_vc, certify), {})
+                return ("prepare", (tx.txid, tx.snapshot_vc, certify),
+                        {})
+
             try:
                 prepare_times = _fan_out(
                     [(p, pm) for p, pm in zip(tx.partitions, pms)],
-                    _prepare)
+                    _prepare, spec=_prepare_spec)
             except CertificationError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(str(e)) from e
@@ -410,7 +485,10 @@ class Coordinator:
                 _fan_out(
                     [(p, pm) for p, pm in zip(tx.partitions, pms)],
                     lambda _p, pm: pm.commit(tx.txid, ct, tx.snapshot_vc,
-                                             certified=certify))
+                                             certified=certify),
+                    spec=lambda _p, _pm: (
+                        "commit", (tx.txid, ct, tx.snapshot_vc),
+                        {"certified": certify}))
             except Exception as e:
                 # post-decision failure: some partitions may hold a
                 # durable commit record — reporting an abort here would

@@ -1,0 +1,176 @@
+"""Native node fabric (cluster/nativelink.py + native/nodelink.cpp):
+the NodeLink protocol contract over the C++ IO plane.
+
+What must hold (same contract as the Python NodeLink, judged by the
+same rules as tests/cluster/test_cluster.py's fabric expectations):
+typed errors cross the wire, a transport failure retries ONCE with the
+same rid and the peer's at-most-once cache keeps non-idempotent
+handlers exactly-once, a restarted server rebinds its advertised port,
+and pipelined fan-out preserves per-call results and errors.
+"""
+
+import threading
+import time
+
+import pytest
+
+from antidote_tpu.cluster.nativelink import (
+    NativeNodeLink,
+    native_available,
+)
+from antidote_tpu.interdc.transport import LinkDown
+from antidote_tpu.txn.manager import CertificationError
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain")
+
+
+def _pair(handler, **kw):
+    a = NativeNodeLink("a", **kw)
+    b = NativeNodeLink("b", **kw)
+    addr = b.serve(handler)
+    a.serve(lambda *x: None)
+    a.connect("b", addr)
+    return a, b
+
+
+def test_roundtrip_and_typed_errors():
+    def handler(origin, kind, payload):
+        if kind == "cert":
+            raise CertificationError("ww conflict")
+        if kind == "timeout":
+            raise TimeoutError("clock wait")
+        return (origin, kind, payload)
+
+    a, b = _pair(handler)
+    try:
+        assert a.request("b", "echo", {"k": [1, b"x", None]}) == \
+            ("a", "echo", {"k": [1, b"x", None]})
+        with pytest.raises(CertificationError):
+            a.request("b", "cert", None)
+        with pytest.raises(TimeoutError):
+            a.request("b", "timeout", None)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_pipelined_fanout_mixed_results():
+    def handler(origin, kind, payload):
+        if payload == 3:
+            raise CertificationError("no")
+        return payload * 10
+
+    a, b = _pair(handler)
+    try:
+        out = a.request_many([("b", "q", i) for i in range(6)])
+        for i, (ok, val) in enumerate(out):
+            if i == 3:
+                assert not ok and isinstance(val, CertificationError)
+            else:
+                assert ok and val == i * 10
+    finally:
+        a.close()
+        b.close()
+
+
+def test_big_frames_grow_buffers_both_directions():
+    blob = b"z" * (3 << 20)
+
+    a, b = _pair(lambda o, k, p: p)
+    try:
+        assert a.request("b", "echo", blob) == blob
+    finally:
+        a.close()
+        b.close()
+
+
+def test_linkdown_on_unreachable_peer():
+    a = NativeNodeLink("a")
+    a.serve(lambda *x: None)
+    a.connect("ghost", ("127.0.0.1", 1))
+    try:
+        with pytest.raises(LinkDown):
+            a.request("ghost", "q", None)
+    finally:
+        a.close()
+
+
+def test_retry_after_drop_is_at_most_once():
+    """A client whose link dies mid-request re-sends the SAME rid; the
+    server must answer from its at-most-once cache (or park the
+    duplicate on the first execution), never run the handler twice."""
+    calls = []
+    started = threading.Event()
+
+    def handler(origin, kind, payload):
+        calls.append(payload)
+        started.set()
+        time.sleep(0.3)  # reply lands after the client dropped the link
+        return len(calls)
+
+    a, b = _pair(handler)
+    try:
+        h = a.start_request("b", "bump", 1)
+        assert started.wait(5.0)  # first execution is in flight
+        # sever the link under the in-flight request: its reply is lost
+        a._lib.nl_drop_peer(a._h, h.idx)
+        # finish retries once with the same rid on a fresh dial; the
+        # duplicate parks on the in-flight marker and gets execution
+        # #1's reply
+        assert a.finish_request(h) == 1
+        assert calls == [1]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_server_restart_rebinds_advertised_port():
+    a, b = _pair(lambda o, k, p: ("v1", p))
+    addr = b.local_addr()
+    try:
+        assert a.request("b", "q", 7) == ("v1", 7)
+        b.close()
+        b2 = NativeNodeLink("b", host=addr[0], port=addr[1])
+        b2.serve(lambda o, k, p: ("v2", p))
+        try:
+            # the client's first attempt may ride the dead connection;
+            # the built-in single retry dials the rebound listener
+            assert a.request("b", "q", 8) == ("v2", 8)
+        finally:
+            b2.close()
+    finally:
+        a.close()
+
+
+def test_concurrent_clients_share_one_connection():
+    seen = []
+    lock = threading.Lock()
+
+    def handler(origin, kind, payload):
+        with lock:
+            seen.append(payload)
+        return payload
+
+    a, b = _pair(handler)
+    errs = []
+
+    def worker(t):
+        try:
+            for i in range(50):
+                assert a.request("b", "q", (t, i)) == (t, i)
+        except Exception as e:  # noqa: BLE001 — collected for assert
+            errs.append(e)
+
+    try:
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert len(seen) == 400
+    finally:
+        a.close()
+        b.close()
