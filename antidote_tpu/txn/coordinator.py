@@ -26,7 +26,11 @@ from antidote_tpu import stats
 from antidote_tpu.clocks import VC
 from antidote_tpu.crdt import DownstreamCtx, DownstreamError, get_type, is_type
 from antidote_tpu.mat.materializer import materialize_eager
-from antidote_tpu.txn.manager import CertificationError
+from antidote_tpu.txn.manager import (
+    _RAW_OP,
+    CertificationError,
+    _is_raw,
+)
 
 
 class TxnState(Enum):
@@ -73,8 +77,16 @@ class Transaction:
     client_ops: List[Tuple] = field(default_factory=list)
     #: partition -> [(key, type_name, effect)] buffered for DEFERRED
     #: staging (remote partitions: shipped with prepare/single-commit
-    #: in one fabric round trip)
+    #: in one fabric round trip).  Entries whose effect is the tagged
+    #: pair ("__raw_op__", op) are RAW OPERATIONS: downstream is
+    #: generated at the owner against its own materialized state
+    #: (reference clocksi_downstream runs at the vnode,
+    #: src/clocksi_downstream.erl:41-68) — saving the exact-state read
+    #: round trip the coordinator would otherwise pay per update
     deferred_ops: Dict[int, List[Tuple]] = field(default_factory=dict)
+    #: keys with raw ops pending in deferred_ops: a read of one inside
+    #: this txn must materialize them first (read-your-writes)
+    raw_keys: set = field(default_factory=set)
     #: True while this txn holds the node's TxnGate shared (from first
     #: staged mutation to commit/abort) — live handoff drains these
     gated: bool = False
@@ -295,6 +307,11 @@ class Coordinator:
                 key, type_name, _bucket = self.node.normalize_bound(bo)
                 cls = get_type(type_name)
                 pm = self.node.partition_of(key)
+                if key in tx.raw_keys:
+                    # this txn updated the key with owner-deferred raw
+                    # ops — materialize them into effects so the read
+                    # below observes them (read-your-writes)
+                    self._materialize_raw_ops(tx, key)
                 metas.append((key, cls, pm))
                 by_pm.setdefault(pm, []).append((key, cls.name))
             values: dict = {}
@@ -395,6 +412,24 @@ class Coordinator:
                 raise TransactionAborted(f"pre-commit hook failed: {e}") from e
             cls = get_type(type_name2)
             pm = self.node.partition_of(key2)
+            remote = getattr(pm, "deferred_stage", False)
+            if (remote and cls.require_state_downstream(op)
+                    and cls.name != "counter_b"):
+                # REMOTE + state-requiring: ship the raw op and let the
+                # OWNER generate downstream against its local
+                # materialized state (the reference generates at the
+                # vnode, src/clocksi_downstream.erl:41-68) — this
+                # removes a full exact-state read round trip per
+                # update.  counter_b keeps the coordinator detour: its
+                # downstream consults the bcounter permission manager,
+                # which lives with the coordinator's node.
+                tx.deferred_ops.setdefault(pm.partition, []).append(
+                    (key2, cls.name, (_RAW_OP, op)))
+                tx.raw_keys.add(key2)
+                if pm.partition not in tx.partitions:
+                    tx.partitions.append(pm.partition)
+                tx.client_ops.append((bucket, key2, cls.name, op))
+                continue
             try:
                 state = None
                 if cls.require_state_downstream(op):
@@ -409,7 +444,7 @@ class Coordinator:
             except DownstreamError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(f"downstream failed: {e}") from e
-            if getattr(pm, "deferred_stage", False):
+            if remote:
                 tx.deferred_ops.setdefault(pm.partition, []).append(
                     (key2, cls.name, effect))
             else:
@@ -419,6 +454,28 @@ class Coordinator:
             if pm.partition not in tx.partitions:
                 tx.partitions.append(pm.partition)
             tx.client_ops.append((bucket, key2, cls.name, op))
+
+    def _materialize_raw_ops(self, tx: Transaction, key) -> None:
+        """Convert a key's pending raw ops into effects at the
+        coordinator (the pre-owner-generation path): needed when THIS
+        txn reads a key it updated with owner-deferred ops — the read
+        must observe them (read-your-writes), and own-effect
+        materialization works on effects, not ops."""
+        pm = self.node.partition_of(key)
+        entries = tx.deferred_ops.get(pm.partition, [])
+        for i, (k, tname, eff) in enumerate(entries):
+            if k != key or not _is_raw(eff):
+                continue
+            cls = get_type(tname)
+            state = pm.read_with_writeset(
+                key, tname, tx.snapshot_vc, tx.txid,
+                tx.own_effects(key), exact_state=True)
+            effect = self.node.gen_downstream(
+                cls, eff[1], state, tx.ctx, key=key)
+            entries[i] = (k, tname, effect)
+            ws = tx.writeset.setdefault(key, (tname, []))
+            ws[1].append(effect)
+        tx.raw_keys.discard(key)
 
     # --------------------------------------------------------------- commit
 

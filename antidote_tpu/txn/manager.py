@@ -35,6 +35,17 @@ class CertificationError(Exception):
     """Write-write certification failed — transaction must abort."""
 
 
+#: tag marking a deferred-op entry that carries a RAW OPERATION whose
+#: downstream the OWNER partition generates (reference
+#: clocksi_downstream at the vnode, src/clocksi_downstream.erl:41-68)
+_RAW_OP = "__raw_op__"
+
+
+def _is_raw(effect) -> bool:
+    return (isinstance(effect, tuple) and len(effect) == 2
+            and effect[0] == _RAW_OP)
+
+
 #: stable-horizon sampling throttle (seconds); see PartitionManager
 _STABLE_REFRESH_S = 0.05
 
@@ -82,6 +93,12 @@ class PartitionManager:
         if device_plane is not None:
             device_plane.set_evict_handler(self._migrate_key_to_host)
         self.read_wait_timeout = read_wait_timeout
+        #: owner-side downstream generation hooks (set by the Node):
+        #: gen_downstream_cb(cls, op, state, ctx, key=) and the node's
+        #: dot minter — needed to resolve shipped raw ops (see
+        #: _resolve_raw_ops)
+        self.gen_downstream_cb = None
+        self.mint_dot_cb = None
         #: GC horizon source (set by Node): a clock no FUTURE commit can
         #: fall below — the GST.  A txn's own snapshot is NOT safe here: a
         #: concurrent txn prepared earlier can still commit with a lower
@@ -158,10 +175,49 @@ class PartitionManager:
             self.log.append_update(self.dc_id, txid, key, type_name, effect)
             self._staged.setdefault(txid, []).append((key, type_name, effect))
 
-    def stage_group(self, txid, ops: List[Tuple[Any, str, Any]]) -> None:
+    def _resolve_raw_ops(self, txid, ops, snapshot_vc: Optional[VC]
+                         ) -> List[Tuple[Any, str, Any]]:
+        """Generate downstream AT THE OWNER for shipped raw operations
+        (entries whose effect is ``(_RAW_OP, op)``) — the reference's
+        clocksi_downstream runs next to the vnode holding the state
+        (src/clocksi_downstream.erl:41-68), and shipping the op instead
+        of pre-reading the state saves the coordinator one exact-state
+        round trip per update.  Effects (raw or pre-generated) of the
+        SAME transaction on the same key are applied progressively so
+        each generation observes its predecessors.  Runs OUTSIDE
+        self._lock: the snapshot read may clock-wait / block on
+        prepared txns exactly like any read."""
+        if not any(_is_raw(e) for _k, _t, e in ops):
+            return list(ops)
+        if snapshot_vc is None:
+            raise ValueError("raw deferred ops need the txn snapshot")
+        from antidote_tpu.crdt import DownstreamCtx, get_type
+
+        ctx = DownstreamCtx(actor=(str(self.dc_id), txid[1]),
+                            mint=self.mint_dot_cb)
+        own: Dict[Any, List[Any]] = {}
+        resolved = []
+        for key, type_name, eff in ops:
+            if _is_raw(eff):
+                cls = get_type(type_name)
+                state = self.read_with_writeset(
+                    key, type_name, snapshot_vc, txid,
+                    own.get(key, []), exact_state=True)
+                effect = self.gen_downstream_cb(
+                    cls, eff[1], state, ctx, key=key)
+            else:
+                effect = eff
+            own.setdefault(key, []).append(effect)
+            resolved.append((key, type_name, effect))
+        return resolved
+
+    def stage_group(self, txid, ops: List[Tuple[Any, str, Any]],
+                    snapshot_vc: Optional[VC] = None) -> None:
         """Stage a transaction's whole op list for this partition in one
         lock pass (the deferred-staging form a remote coordinator ships
-        with prepare — see stage_prepare)."""
+        with prepare — see stage_prepare).  Raw shipped operations are
+        resolved to effects first (owner-side downstream generation)."""
+        ops = self._resolve_raw_ops(txid, ops, snapshot_vc)
         with self._lock:
             staged = self._staged.setdefault(txid, [])
             for key, type_name, effect in ops:
@@ -178,14 +234,14 @@ class PartitionManager:
         deferred coordinator buffers its remote writeset locally and
         this call preserves the same contract: everything durable at
         the owner before the prepare ack."""
-        self.stage_group(txid, ops)
+        self.stage_group(txid, ops, snapshot_vc)
         return self.prepare(txid, snapshot_vc, certify)
 
     def stage_single_commit(self, txid, ops, snapshot_vc: VC,
                             certify: bool = True) -> int:
         """Stage + single-partition fast-path commit in one call (one
         round trip for a remote single-partition transaction)."""
-        self.stage_group(txid, ops)
+        self.stage_group(txid, ops, snapshot_vc)
         return self.single_commit(txid, snapshot_vc, certify)
 
     # -------------------------------------------------------- 2PC on this partition

@@ -421,6 +421,10 @@ class Node:
         pm = PartitionManager(p, self.dc_id, log, self.clock,
                               device_plane=plane)
         pm.stable_vc_source = self.stable_vc
+        # owner-side downstream generation (shipped raw ops resolve at
+        # the partition that holds the state — manager._resolve_raw_ops)
+        pm.gen_downstream_cb = self.gen_downstream
+        pm.mint_dot_cb = self.mint_dot
         # recovery-off + logging-on: the log may hold history this
         # process never published — a bottom-seeded warm cache would
         # disagree with log-fallback reads (see PartitionManager)

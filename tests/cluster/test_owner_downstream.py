@@ -1,0 +1,134 @@
+"""Owner-side downstream generation (manager._resolve_raw_ops): a
+remote coordinator ships RAW operations of state-requiring types; the
+owner partition generates the effect against its local materialized
+state — the reference's clocksi_downstream runs at the vnode
+(src/clocksi_downstream.erl:41-68).
+
+What must hold: the generated effects are semantically identical to
+coordinator-side generation (add-wins supersession, observed-remove
+cancellation), reads inside the same transaction still observe the
+txn's own raw updates (read-your-writes degrades them on demand), and
+multi-op transactions generate in program order at the owner.
+"""
+
+import pytest
+
+from antidote_tpu.cluster import NodeServer, create_dc_cluster
+from antidote_tpu.config import Config
+
+
+@pytest.fixture
+def duo(tmp_path):
+    servers = [
+        NodeServer(f"n{i}", data_dir=str(tmp_path / f"n{i}"),
+                   config=Config(n_partitions=4, heartbeat_s=0.05))
+        for i in range(2)
+    ]
+    create_dc_cluster("dc1", 4, servers)
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def _owner_of(servers, key):
+    ring = servers[0].node.ring
+    return ring[key % len(ring)]
+
+
+def _remote_key(servers, coordinator_idx, base=0):
+    """A key whose partition is owned by the OTHER node."""
+    me = servers[coordinator_idx].node_id
+    k = base
+    while _owner_of(servers, k) == me:
+        k += 1
+    return k
+
+
+def test_remote_set_add_remove_generates_at_owner(duo):
+    api = duo[0].api
+    k = _remote_key(duo, 0)
+    bo = (k, "set_aw", "b")
+
+    tx = api.start_transaction()
+    api.update_objects([(bo, "add", b"x"), (bo, "add", b"y")], tx)
+    # the raw ops are pending at the coordinator, not yet effects
+    assert k in tx.raw_keys
+    cvc = api.commit_transaction(tx)
+
+    # observed-remove must cancel the add it SAW (generated at the
+    # owner against the committed state)
+    tx = api.start_transaction(clock=cvc)
+    api.update_objects([(bo, "remove", b"x")], tx)
+    cvc = api.commit_transaction(tx)
+
+    tx = api.start_transaction(clock=cvc)
+    assert api.read_objects([bo], tx) == [[b"y"]]
+    api.commit_transaction(tx)
+
+    # and the OWNER node agrees (same effects applied everywhere)
+    api1 = duo[1].api
+    tx = api1.start_transaction(clock=cvc)
+    assert api1.read_objects([bo], tx) == [[b"y"]]
+    api1.commit_transaction(tx)
+
+
+def test_read_your_raw_writes_in_same_txn(duo):
+    api = duo[0].api
+    k = _remote_key(duo, 0, base=100)
+    bo = (k, "set_aw", "b")
+
+    tx = api.start_transaction()
+    api.update_objects([(bo, "add", b"a")], tx)
+    assert k in tx.raw_keys
+    # the read degrades the raw op into an effect and observes it
+    assert api.read_objects([bo], tx) == [[b"a"]]
+    assert k not in tx.raw_keys
+    # a later update in the same txn must see the degraded effect too
+    api.update_objects([(bo, "remove", b"a")], tx)
+    assert api.read_objects([bo], tx) == [[]]
+    cvc = api.commit_transaction(tx)
+
+    tx = api.start_transaction(clock=cvc)
+    assert api.read_objects([bo], tx) == [[]]
+    api.commit_transaction(tx)
+
+
+def test_mvreg_assign_remote_owner_generated(duo):
+    api = duo[0].api
+    k = _remote_key(duo, 0, base=200)
+    bo = (k, "register_mv", "b")
+
+    tx = api.start_transaction()
+    api.update_objects([(bo, "assign", b"v1")], tx)
+    cvc = api.commit_transaction(tx)
+
+    # a second assign must supersede v1 (it observed v1's dot at the
+    # owner): exactly one live value remains
+    tx = api.start_transaction(clock=cvc)
+    api.update_objects([(bo, "assign", b"v2")], tx)
+    cvc = api.commit_transaction(tx)
+
+    for srv in duo:
+        tx = srv.api.start_transaction(clock=cvc)
+        assert srv.api.read_objects([bo], tx) == [[b"v2"]]
+        srv.api.commit_transaction(tx)
+
+
+def test_mixed_local_remote_txn_converges(duo):
+    """One txn spanning a local and a remote state-requiring update:
+    2PC with one raw participant; both nodes read the same values."""
+    api = duo[0].api
+    k_remote = _remote_key(duo, 0, base=300)
+    k_local = k_remote + 1
+    while _owner_of(duo, k_local) != duo[0].node_id:
+        k_local += 1
+    tx = api.start_transaction()
+    api.update_objects([((k_remote, "set_aw", "b"), "add", b"r"),
+                        ((k_local, "set_aw", "b"), "add", b"l")], tx)
+    cvc = api.commit_transaction(tx)
+    for srv in duo:
+        tx = srv.api.start_transaction(clock=cvc)
+        got = srv.api.read_objects(
+            [(k_remote, "set_aw", "b"), (k_local, "set_aw", "b")], tx)
+        assert got == [[b"r"], [b"l"]]
+        srv.api.commit_transaction(tx)
