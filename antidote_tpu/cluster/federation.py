@@ -116,17 +116,15 @@ class NodeInterDc:
         self._inbox = bus.register(self._self_desc(), self._handle_query)
         self._worker = InboxWorker(self._inbox, self._deliver)
         self._hb = None
-        # stable sources: gate watermarks + own min-prepared per slice
-        tracker = srv.plane.local
-        local_sorted = sorted(self.local)
-
-        def _source(p):
-            def pull():
-                return VC(self.gates[p].applied_vc).set_dc(
-                    self.dc_id, node.partitions[p].min_prepared())
-            return pull
-
-        tracker.sources = [_source(p) for p in local_sorted]
+        # stable sources: gate watermarks + own min-prepared per slice.
+        # Installed as the NodeServer's source FACTORY (not a one-shot
+        # sources list): a cross-node handoff rebuilds the stable plane,
+        # and the rebuild must keep pulling the dep-gate watermarks or
+        # the DC snapshot could pass un-applied remote transactions.
+        srv.source_factory = self._source_for
+        srv.plane.local.sources = [
+            self._source_for(p) for p in sorted(self.local)]
+        srv.on_ring_change = self.refresh_ring
         node.wait_hook = self._wait_hook
         # restart re-join: re-observe the federations this node knew
         # (reference check_node_restart reconnects its DCs,
@@ -136,6 +134,60 @@ class NodeInterDc:
                 self.observe_dc(FederatedDescriptor.from_wire(t))
             except Exception:  # noqa: BLE001 — a dead peer at boot
                 log.warning("restart re-observe of %r failed", t[0])
+
+    def _source_for(self, p: int):
+        def pull():
+            g = self.gates.get(p)
+            pm = self.node.partitions[p]
+            if g is None:
+                # a just-adopted slice whose gate is still being wired
+                # (refresh_ring runs right after the plane rebuild):
+                # the log's per-DC commit maxima are its conservative
+                # applied watermarks
+                return VC(pm.log.max_commit_vc).set_dc(
+                    self.dc_id, pm.min_prepared())
+            return VC(g.applied_vc).set_dc(
+                self.dc_id, pm.min_prepared())
+        return pull
+
+    def refresh_ring(self) -> None:
+        """Adopt a re-planned ring (cross-node handoff): wire senders,
+        dependency gates, and sub-buffers for newly-owned slices,
+        retire those of de-owned slices.  Stream continuity holds
+        because the transferred log carries the per-origin opid
+        counters — the new owner's sender resumes the SAME opid stream
+        remote sub-buffers are watching, and its sub-buffers resume at
+        the watermarks the old owner had applied."""
+        node = self.node
+        with self._rx_lock:
+            new_local = set(node.local_partition_indices())
+            for p in sorted(new_local - self.local):
+                pm = node.partitions[p]
+                sender = InterDcLogSender(self.dc_id, p, self.bus,
+                                          enabled=bool(self.remote))
+                sender.seed_watermark(
+                    pm.log.op_counters.get(self.dc_id, 0))
+                pm.log.on_append = (
+                    lambda rec, _s=sender: _s.on_append(rec))
+                self.senders[p] = sender
+                g = DependencyGate(pm, self.dc_id, node.clock.now_us)
+                g.seed_clock(pm.log.max_commit_vc)
+                self.gates[p] = g
+                for dc_id in self.remote:
+                    self.sub_bufs[(dc_id, p)] = SubBuf(
+                        dc_id, p,
+                        deliver=self._make_gate_deliver(p),
+                        fetch_range=self._fetch_range,
+                        last_opid=pm.log.op_counters.get(dc_id, 0))
+            for p in sorted(self.local - new_local):
+                self.senders.pop(p, None)
+                self.gates.pop(p, None)
+                for dc_id in list(self.remote):
+                    self.sub_bufs.pop((dc_id, p), None)
+            self.local = new_local
+        # the plane was just rebuilt by the NodeServer with this
+        # object's source factory, so the gate watermarks are already
+        # wired for the new slice set — nothing further here
 
     # ---------------------------------------------------------- membership
 
@@ -263,6 +315,16 @@ class NodeInterDc:
         if kind == idc_query.LOG_READ:
             partition, first, last = payload
             if partition not in self.local:
+                owner = self.node.ring.get(partition)
+                if owner is not None and owner != self.srv.node_id:
+                    # the slice moved (cross-node handoff) after the
+                    # remote DC cached our descriptor: forward over the
+                    # node fabric to the current owner and relay its
+                    # answer — repair keeps routing across re-plans
+                    bins = self.srv.link.request(
+                        owner, "idc_log_read",
+                        (partition, first, last))
+                    return [InterDcTxn.from_bin(b) for b in bins]
                 raise ValueError(
                     f"partition {partition} not owned by member "
                     f"{self.member_index} of {self.dc_id!r}")

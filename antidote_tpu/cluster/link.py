@@ -31,10 +31,13 @@ log = logging.getLogger(__name__)
 
 
 def _err_kind(exc: Exception) -> str:
+    from antidote_tpu.cluster.remote import WrongOwner
     from antidote_tpu.txn.manager import CertificationError
 
     if isinstance(exc, CertificationError):
         return "certification"
+    if isinstance(exc, WrongOwner):
+        return "wrong_owner"
     if isinstance(exc, TimeoutError):
         return "timeout"
     return "generic"
@@ -47,8 +50,10 @@ def _raise_remote(kind: str, msg: str):
         raise CertificationError(msg)
     if kind == "timeout":
         raise TimeoutError(msg)
-    from antidote_tpu.cluster.remote import RemoteCallError
+    from antidote_tpu.cluster.remote import RemoteCallError, WrongOwner
 
+    if kind == "wrong_owner":
+        raise WrongOwner(msg)
     raise RemoteCallError(msg)
 
 

@@ -30,7 +30,7 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from antidote_tpu.clocks import VC, vc_min
 from antidote_tpu.cluster.link import NodeLink
@@ -47,6 +47,14 @@ from antidote_tpu.txn.manager import PartitionManager
 from antidote_tpu.txn.node import Node
 
 log = logging.getLogger(__name__)
+
+#: partition methods parked during a handoff drain: NEW mutating work.
+#: Reads and the commit/abort calls resolving already-prepared
+#: transactions keep flowing — the drain needs them to finish.
+_HANDOFF_PARKED = frozenset({
+    "stage_update", "stage_prepare", "stage_single_commit",
+    "prepare", "single_commit",
+})
 
 
 def build_link(node_id, host: str = "127.0.0.1", port: int = 0,
@@ -222,11 +230,32 @@ class NodeServer:
         self._assembled = threading.Event()
         #: peer -> monotonic time before which gossip skips it
         self._peer_backoff: Dict[Any, float] = {}
+        #: member id -> advertised address (the committed plan's view)
+        self._members: Dict[Any, Tuple[str, int]] = {}
+        #: cross-node handoff state per partition:
+        #: {"state": "drain" | "retired", "new_owner", "event"}
+        self._handoff: Dict[int, dict] = {}
+        #: partitions handed off but not yet re-planned globally: their
+        #: stable contribution stays PINNED at the transfer's commit
+        #: watermark VC (own entry: max own-DC commit; remote entries:
+        #: the applied-replication watermarks) so the DC snapshot can
+        #: pass neither a commit the new owner is still preparing nor
+        #: a remote txn it has not applied (see handoff_cutover)
+        self._stable_pins: Dict[int, VC] = {}
+        #: stable-source builder per local partition; the federation
+        #: layer (cluster/federation.py) swaps in gate-aware sources so
+        #: a plane rebuild never drops the dep-gate watermarks
+        self.source_factory: Optional[Callable[[int], Callable]] = None
+        #: called after any ring/ownership change (handoff install,
+        #: cutover, re-plan) — the federation layer re-wires its
+        #: per-partition senders/gates/sub-buffers here
+        self.on_ring_change: Optional[Callable[[], None]] = None
         plan = self.meta.get("cluster_plan")
         if plan is not None:
             # restart: reload the committed plan and re-join (reference
             # check_node_restart, src/inter_dc_manager.erl:156-201)
             self._assemble(*plan)
+            self._resume_handoff_out()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -289,34 +318,59 @@ class NodeServer:
     def _assemble(self, dc_id, ring, members) -> None:
         from antidote_tpu.api import AntidoteTPU
 
-        for nid, addr in members.items():
+        self._members = {nid: tuple(addr)
+                         for nid, addr in members.items()}
+        for nid, addr in self._members.items():
             if nid != self.node_id:
-                self.link.connect(nid, tuple(addr))
+                self.link.connect(nid, addr)
         node = ClusterNode(self.node_id, ring, self.link, dc_id=dc_id,
                            config=self.config, data_dir=self.data_dir)
-        local_idx = node.local_partition_indices()
-        tracker = StableTimeTracker(dc_id, len(local_idx))
-
-        def _source(pm):
-            return lambda: VC({dc_id: pm.min_prepared()})
-
-        tracker.sources = [_source(node.partitions[p]) for p in local_idx]
-        data_members = sorted(set(ring.values()), key=repr)
-        plane = ClusterStablePlane(dc_id, self.node_id,
-                                   data_members, tracker)
-        last = self.meta.get("last_stable_vc")
-        if last:
-            plane.seed_floor(VC(last))
-        node.stable_vc_provider = plane.get_stable_snapshot
-        node.wait_hook = self._wait_hook
-        self.plane = plane
         self.node = node
+        last = self.meta.get("last_stable_vc")
+        self._install_stable_plane(
+            prev_stable=VC(last) if last else None)
+        node.wait_hook = self._wait_hook
         self.api = AntidoteTPU(node=node)
         self._gossip = threading.Thread(target=self._gossip_loop,
                                         daemon=True)
         self._gossip.start()
         self._assembled.set()
         self.meta.mark_started()
+
+    def _install_stable_plane(self, prev_stable: Optional[VC] = None
+                              ) -> None:
+        """(Re)build the two-level stable plane from the CURRENT ring:
+        sources for the locally-owned partitions, plus pinned entries
+        for partitions handed off but not yet globally re-planned.
+        ``prev_stable`` seeds both the local floor and every data
+        member's summary entry — the previous published snapshot is the
+        min over all members, so it is a sound (conservative) starting
+        summary for each, and it keeps the published view monotone
+        across the rebuild."""
+        node = self.node
+        dc_id = node.dc_id
+        local_idx = node.local_partition_indices()
+        tracker = StableTimeTracker(
+            dc_id, len(local_idx) + len(self._stable_pins))
+
+        def _default_source(p):
+            pm = node.partitions[p]
+            return lambda: VC({dc_id: pm.min_prepared()})
+
+        mk = self.source_factory or _default_source
+        sources = [mk(p) for p in local_idx]
+        for p in sorted(self._stable_pins):
+            sources.append(lambda _v=self._stable_pins[p]: _v)
+        tracker.sources = sources
+        data_members = sorted(set(node.ring.values()), key=repr)
+        plane = ClusterStablePlane(dc_id, self.node_id,
+                                   data_members, tracker)
+        if prev_stable:
+            plane.seed_floor(prev_stable)
+            for m in data_members:
+                plane.put_node(m, prev_stable)
+        node.stable_vc_provider = plane.get_stable_snapshot
+        self.plane = plane
 
     def _wait_hook(self) -> None:
         # a causal wait is released by PEER summaries arriving at their
@@ -387,12 +441,69 @@ class NodeServer:
             p, method, args, kwargs = payload
             if method not in PARTITION_METHODS:
                 raise RemoteCallError(f"method {method!r} not allowed")
+            st = self._handoff.get(p)
+            if st is not None:
+                if st["state"] == "drain" and method in _HANDOFF_PARKED:
+                    # new mutating work parks for the (short) cutover
+                    # window; reads and the commits/aborts resolving
+                    # already-prepared txns flow so the drain finishes
+                    st["event"].wait(timeout=30.0)
+                    st = self._handoff.get(p)
+                if st is not None and st["state"] == "retired":
+                    from antidote_tpu.cluster.remote import WrongOwner
+
+                    raise WrongOwner(
+                        f"partition {p} moved to "
+                        f"{st['new_owner']!r}")
             pm = self.node.partitions[p]
             if not isinstance(pm, PartitionManager):
                 raise RemoteCallError(
                     f"partition {p} not owned by {self.node_id!r} "
                     f"(stale ring at {origin!r}?)")
             return getattr(pm, method)(*args, **kwargs)
+        if kind == "ring":
+            if self.node is None:
+                raise RemoteCallError("node not assembled yet")
+            return (list(self.node.ring.items()),
+                    list(self._members.items()))
+        if kind == "idc_log_read":
+            # intra-DC forward of a federated gap-repair query: a
+            # remote DC with a pre-handoff descriptor asked the wrong
+            # member; the partition's CURRENT owner answers from its
+            # log (see federation._handle_query)
+            from antidote_tpu.interdc import query as idc_query
+
+            p, first, last = payload
+            pm = self.node.partitions[int(p)]
+            if not isinstance(pm, PartitionManager):
+                raise RemoteCallError(f"partition {p} not local")
+            txns = pm.scan_log(
+                lambda lg: idc_query.answer_log_read(
+                    lg, self.node.dc_id, int(p), first, last))
+            return [t.to_bin() for t in txns]
+        if kind == "handoff_fetch":
+            p, offset, max_bytes = payload
+            pm = self.node.partitions[p]
+            if not isinstance(pm, PartitionManager):
+                raise RemoteCallError(f"partition {p} not local")
+            return pm.log.read_bytes(int(offset), int(max_bytes))
+        if kind == "handoff_begin":
+            p, from_owner = payload
+            return self._handoff_begin(int(p), from_owner)
+        if kind == "handoff_install":
+            p, base_offset, tail = payload
+            return self._handoff_install(int(p), int(base_offset), tail)
+        if kind == "handoff_cutover":
+            p, new_owner, b_cursor = payload
+            return self._handoff_cutover(int(p), new_owner,
+                                         int(b_cursor))
+        if kind == "ring_update":
+            ring_pairs, member_pairs, clients = payload
+            self._apply_ring_update(
+                {int(p): nid for p, nid in ring_pairs},
+                {nid: tuple(addr) for nid, addr in member_pairs},
+                list(clients))
+            return True
         if kind == "status":
             return {
                 "node_id": self.node_id,
@@ -404,6 +515,280 @@ class NodeServer:
                     if self.plane else {},
             }
         raise RemoteCallError(f"unknown node RPC kind {kind!r}")
+
+    # ----------------------------------------------------- cross-node handoff
+
+    def _rpc(self, target, kind: str, payload):
+        """Fabric request, or a direct local dispatch when the target
+        is this node (the rebalance driver addresses every member
+        uniformly)."""
+        if target == self.node_id:
+            return self._handle(self.node_id, kind, payload)
+        return self.link.request(target, kind, payload)
+
+    def _staged_path(self, p: int) -> str:
+        return self.node._log_path(p) + ".handoff"
+
+    def _handoff_begin(self, p: int, from_owner) -> int:
+        """Receiving side, serving phase: pull the partition's log in
+        chunks from the current owner into a staged file, re-pulling
+        until the remaining delta is small (the riak_core handoff fold
+        while the vnode keeps serving, reference
+        src/logging_vnode.erl:781-812).  Returns the staged cursor; the
+        final tail arrives pushed by the owner's cutover."""
+        staged = self._staged_path(p)
+        cursor = 0
+        with open(staged, "wb") as f:
+            while True:
+                data, end = self._rpc(from_owner, "handoff_fetch",
+                                      (p, cursor, 4 << 20))
+                if data:
+                    f.write(data)
+                    cursor += len(data)
+                if end - cursor <= 65536:
+                    break
+            f.flush()
+            os.fsync(f.fileno())
+        return cursor
+
+    def _handoff_install(self, p: int, base_offset: int,
+                         tail: bytes) -> bool:
+        """Receiving side, cutover: append the owner-pushed tail to the
+        staged log, promote it to the live log path, and adopt the
+        partition (build + recover + serve).  The local plan persists
+        immediately: if this node restarts before the global re-plan,
+        it must come back serving the partition it accepted."""
+        staged = self._staged_path(p)
+        have = os.path.getsize(staged) if os.path.exists(staged) else 0
+        if have != base_offset:
+            raise RemoteCallError(
+                f"handoff install mismatch: staged {have} bytes, "
+                f"owner pushed tail from {base_offset}")
+        with open(staged, "ab") as f:
+            f.write(tail)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(staged, self.node._log_path(p))
+        self.node.ring[p] = self.node_id
+        self.node.adopt_partition(p)
+        prev = self.plane.get_stable_snapshot() if self.plane else None
+        self._install_stable_plane(prev_stable=prev)
+        if self.on_ring_change is not None:
+            self.on_ring_change()
+        self.meta.put("cluster_plan",
+                      (self.node.dc_id, dict(self.node.ring),
+                       dict(self._members)))
+        return True
+
+    def _handoff_cutover(self, p: int, new_owner, b_cursor: int) -> bool:
+        """Owning side, cutover: drain the partition (park new mutating
+        work, let prepared transactions resolve, drain local
+        transactions via the TxnGate), push the final log tail to the
+        new owner, then retire the partition behind a typed
+        wrong-owner redirect.  The stable contribution stays pinned at
+        the transferred commit watermark until the global re-plan, so
+        the DC snapshot cannot pass a commit the new owner is still
+        preparing (their clock advances past the watermark at adopt)."""
+        pm = self.node.partitions[p]
+        if not isinstance(pm, PartitionManager):
+            raise RemoteCallError(
+                f"partition {p} not owned by {self.node_id!r}")
+        if new_owner not in self._members:
+            raise RemoteCallError(f"unknown member {new_owner!r}")
+        ev = threading.Event()
+        self._handoff[p] = {"state": "drain", "new_owner": new_owner,
+                            "event": ev}
+        try:
+            with self.node.txn_gate.exclusive():
+                deadline = time.monotonic() + 30.0
+                while pm.has_prepared():
+                    if time.monotonic() > deadline:
+                        raise RemoteCallError(
+                            f"partition {p} drain timed out")
+                    time.sleep(0.005)
+                tail, end = pm.log.read_bytes(b_cursor, 1 << 62)
+                # journal the in-doubt transfer BEFORE the push: a
+                # crash from here on resolves ownership by asking the
+                # new owner at restart (_resume_handoff_out)
+                out = dict(self.meta.get("handoff_out") or {})
+                out[p] = new_owner
+                self.meta.put("handoff_out", out)
+                self._rpc(new_owner, "handoff_install",
+                          (p, b_cursor, tail))
+                # pin at the transferred commit watermark VC: every
+                # future commit on p happens at the new owner ABOVE the
+                # own-DC entry (their clock advances past it at adopt),
+                # and their replication gate seeds at the same remote
+                # watermarks
+                self._stable_pins[p] = VC(pm.log.max_commit_vc)
+                self.node.ring[p] = new_owner
+                self.node.partitions[p] = RemotePartition(
+                    self.link, new_owner, p)
+                self._install_stable_plane(
+                    prev_stable=self.plane.get_stable_snapshot())
+                if self.on_ring_change is not None:
+                    self.on_ring_change()
+                pm.log.close()
+                if os.path.exists(pm.log.path):
+                    os.replace(pm.log.path, pm.log.path + ".handedoff")
+                self._handoff[p] = {"state": "retired",
+                                    "new_owner": new_owner,
+                                    "event": ev}
+        except BaseException:
+            # failed transfer: un-drain and keep serving
+            self._handoff.pop(p, None)
+            out = dict(self.meta.get("handoff_out") or {})
+            if out.pop(p, None) is not None:
+                self.meta.put("handoff_out", out)
+            raise
+        finally:
+            ev.set()
+        return True
+
+    def _apply_ring_update(self, ring: Dict[int, Any],
+                           members: Dict[Any, Tuple[str, int]],
+                           clients: List[Any]) -> None:
+        """Adopt the re-planned ring: re-aim proxies, rebuild the
+        stable plane over the new data-member set, persist the plan
+        (the riak_core ring gossip + claimant commit)."""
+        if self.node is None:
+            raise RemoteCallError("node not assembled yet")
+        prev = self.plane.get_stable_snapshot() if self.plane else None
+        self._members = dict(members)
+        for nid, addr in self._members.items():
+            if nid != self.node_id:
+                self.link.connect(nid, addr)
+        node = self.node
+        for p, owner in ring.items():
+            node.ring[p] = owner
+            cur = node.partitions[p]
+            if owner == self.node_id:
+                if not isinstance(cur, PartitionManager):
+                    raise RemoteCallError(
+                        f"re-plan says {self.node_id!r} owns partition "
+                        f"{p} but it was never handed off here")
+            elif isinstance(cur, RemotePartition):
+                cur.owner = owner
+            else:
+                raise RemoteCallError(
+                    f"re-plan moves partition {p} away from "
+                    f"{self.node_id!r} without a handoff")
+        # pins for partitions the plan now assigns elsewhere are done:
+        # the new owner reports them from here on
+        self._stable_pins = {
+            p: t for p, t in self._stable_pins.items()
+            if ring.get(p) == self.node_id}
+        out = dict(self.meta.get("handoff_out") or {})
+        done = [p for p, owner in out.items() if ring.get(p) == owner]
+        if done:
+            for p in done:
+                out.pop(p)
+            self.meta.put("handoff_out", out)
+        self._install_stable_plane(prev_stable=prev)
+        if self.on_ring_change is not None:
+            self.on_ring_change()
+        self.meta.put("cluster_plan",
+                      (node.dc_id, dict(ring), dict(self._members)))
+
+    def _resume_handoff_out(self) -> None:
+        """Restart with an in-doubt outbound handoff journaled: ask the
+        intended new owner whether it adopted the partition.  If it
+        did (its plan claims ownership), retire our copy; if it
+        answers and did not, resume ownership; if it is unreachable,
+        serve only if our log survived (a renamed log means the
+        transfer got far enough that the new owner may have it — stay
+        retired and warn, operator resolves)."""
+        out = dict(self.meta.get("handoff_out") or {})
+        if not out or self.node is None:
+            return
+        for p, new_owner in list(out.items()):
+            p = int(p)
+            log_alive = os.path.exists(self.node._log_path(p)) and \
+                os.path.getsize(self.node._log_path(p)) > 0
+            theirs = None
+            try:
+                ring_pairs, _members = self.link.request(
+                    new_owner, "ring", None)
+                theirs = {int(q): nid for q, nid in ring_pairs}.get(p)
+            except Exception:  # noqa: BLE001 — peer down
+                log.warning("handoff resolution: %r unreachable", new_owner)
+            if theirs == new_owner or (theirs is None and not log_alive):
+                # adopted there (or unknowable and our copy is gone):
+                # stay retired behind a redirect
+                self.node.ring[p] = new_owner
+                self.node.partitions[p] = RemotePartition(
+                    self.link, new_owner, p)
+                self._handoff[p] = {"state": "retired",
+                                    "new_owner": new_owner,
+                                    "event": threading.Event()}
+                self._install_stable_plane(
+                    prev_stable=self.plane.get_stable_snapshot())
+                if theirs is None:
+                    log.warning(
+                        "partition %d: transfer to %r in doubt and "
+                        "local log already renamed — staying retired",
+                        p, new_owner)
+            else:
+                # not adopted: resume ownership, forget the intent
+                out.pop(p)
+                self.meta.put("handoff_out", out)
+
+    def add_member(self, node_id, addr: Tuple[str, int]) -> None:
+        """Admit a running, empty NodeServer into this live cluster as
+        a coordinator-only member (the staged-join 'plan' half); hand
+        it data afterwards with rebalance() (the 'commit' half) — the
+        reference's join_new_nodes + claim transition,
+        src/antidote_dc_manager.erl:53-81."""
+        if self.node is None:
+            raise RuntimeError("node not assembled yet")
+        if node_id in self._members:
+            raise ValueError(f"{node_id!r} is already a member")
+        self._members[node_id] = tuple(addr)
+        self.link.connect(node_id, tuple(addr))
+        ring = dict(self.node.ring)
+        clients = sorted(set(self._members) - set(ring.values()),
+                         key=repr)
+        self.link.request(
+            node_id, "join",
+            (self.node.dc_id, list(ring.items()),
+             list(self._members.items()), self.fabric_kind(), clients))
+        payload = (list(ring.items()), list(self._members.items()),
+                   clients)
+        for nid in self._members:
+            if nid not in (self.node_id, node_id):
+                self.link.request(nid, "ring_update", payload)
+        self._apply_ring_update(ring, dict(self._members), clients)
+
+    def rebalance(self, new_ring: Dict[int, Any]) -> Dict[int, Any]:
+        """Re-plan a LIVE cluster's ring from this node: stream each
+        moving partition to its new owner while serving, cut over
+        under the owner's TxnGate, then push + persist the new plan on
+        every member (the reference's riak_core claimant transition,
+        antidote_dc_manager's plan/commit staged change,
+        src/antidote_dc_manager.erl:53-81)."""
+        if self.node is None:
+            raise RuntimeError("node not assembled yet")
+        old_ring = dict(self.node.ring)
+        if sorted(new_ring) != sorted(old_ring):
+            raise ValueError("re-plan must cover the same partitions")
+        owners = set(new_ring.values())
+        unknown = owners - set(self._members)
+        if unknown:
+            raise ValueError(f"new owners {unknown!r} are not members")
+        moves = [(p, old_ring[p], new_ring[p])
+                 for p in sorted(new_ring) if old_ring[p] != new_ring[p]]
+        for p, old, new in moves:
+            cursor = self._rpc(new, "handoff_begin", (p, old))
+            self._rpc(old, "handoff_cutover", (p, new, cursor))
+        clients = sorted(set(self._members) - owners, key=repr)
+        payload = (list(new_ring.items()),
+                   list(self._members.items()), clients)
+        for nid in self._members:
+            if nid != self.node_id:
+                self.link.request(nid, "ring_update", payload)
+        self._apply_ring_update(dict(new_ring), dict(self._members),
+                                clients)
+        return dict(new_ring)
 
     # ------------------------------------------------------------ shutdown
 

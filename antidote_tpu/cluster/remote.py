@@ -22,6 +22,12 @@ class RemoteCallError(RuntimeError):
     """A remote partition call failed for a non-protocol reason."""
 
 
+class WrongOwner(RuntimeError):
+    """The partition moved to another node (cross-node handoff): the
+    caller refreshes its routing and retries — riak_core's forwarding
+    window after an ownership transfer."""
+
+
 #: PartitionManager methods a peer may invoke — the vnode command set
 #: (reads, 2PC, staging, stable-time probes).  A whitelist, not
 #: getattr-anything: the fabric is intra-DC but still a network surface.
@@ -48,9 +54,34 @@ class RemotePartition:
         self.partition = partition
 
     def _call(self, method: str, *args, **kwargs):
-        return self.link.request(
-            self.owner, "part",
-            (self.partition, method, tuple(args), dict(kwargs)))
+        try:
+            return self.link.request(
+                self.owner, "part",
+                (self.partition, method, tuple(args), dict(kwargs)))
+        except WrongOwner:
+            # the partition moved (cross-node handoff): learn the new
+            # ring from the node that redirected us, re-aim, retry once
+            # — riak_core's request forwarding after ownership transfer
+            self.refresh_owner()
+            return self.link.request(
+                self.owner, "part",
+                (self.partition, method, tuple(args), dict(kwargs)))
+
+    def refresh_owner(self) -> None:
+        """Re-resolve this slot's owner from the redirecting node's
+        current ring and make sure the fabric can dial it."""
+        ring_pairs, member_pairs = self.link.request(
+            self.owner, "ring", None)
+        ring = {int(p): nid for p, nid in ring_pairs}
+        members = {nid: tuple(addr) for nid, addr in member_pairs}
+        new_owner = ring.get(self.partition)
+        if new_owner is None or new_owner == self.owner:
+            raise RemoteCallError(
+                f"partition {self.partition} has no (new) owner in the "
+                f"redirecting node's ring")
+        if new_owner in members:
+            self.link.connect(new_owner, members[new_owner])
+        self.owner = new_owner
 
     # -- pipelined calls (native fabric, cluster/nativelink.py) -----------
 

@@ -37,6 +37,7 @@ class PartitionLog:
                  backend: str = "auto", enabled: bool = True,
                  on_append: Optional[Callable[[LogRecord], None]] = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
         self.partition = partition
         self.sync_on_commit = sync_on_commit
         #: reference enable_logging flag: when False no durable writes
@@ -113,6 +114,23 @@ class PartitionLog:
             self.log.sync()
 
     # --------------------------------------------------------------- read
+
+    def read_bytes(self, offset: int, max_bytes: int) -> Tuple[bytes, int]:
+        """Raw byte range of the log file plus the current end offset —
+        the cross-node handoff transfer unit: the log is self-framed
+        and CRC'd, so the receiver validates it by ordinary recovery
+        (the reference streams fold chunks between vnodes the same way,
+        src/logging_vnode.erl:781-812).  Returns (b"", end) when
+        logging is disabled (nothing to hand off) or offset >= end."""
+        if not self.enabled:
+            return b"", 0
+        self.log.flush()
+        end = self.log.end_offset()
+        if offset >= end:
+            return b"", end
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            return f.read(min(max_bytes, end - offset)), end
 
     def records(self, offset: int = 0) -> Iterator[LogRecord]:
         if not self.enabled:

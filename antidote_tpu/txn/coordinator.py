@@ -33,6 +33,13 @@ from antidote_tpu.txn.manager import (
 )
 
 
+def _is_wrong_owner(exc) -> bool:
+    # imported lazily: the cluster package imports this module back
+    from antidote_tpu.cluster.remote import WrongOwner
+
+    return isinstance(exc, WrongOwner)
+
+
 class TxnState(Enum):
     ACTIVE = "active"
     COMMITTED = "committed"
@@ -157,6 +164,14 @@ def _fan_out(pairs, fn, spec=None):
                 handles, link.finish_many([h for _i, h in handles])):
             if ok:
                 results[i] = val
+            elif _is_wrong_owner(val):
+                # the partition moved mid-round (cross-node handoff):
+                # the synchronous path re-resolves the owner and
+                # retries (RemotePartition._call self-heals)
+                try:
+                    results[i] = fn(pairs[i][0], pairs[i][1])
+                except BaseException as e:  # noqa: BLE001 — below
+                    errs.append(e)
             else:
                 errs.append(val)
         if errs:
@@ -327,9 +342,9 @@ class Coordinator:
                     if (getattr(pm, "deferred_stage", False)
                             and hasattr(pm.link, "finish_many")):
                         link = pm.link
-                        handles.append(pm.start_call(
+                        handles.append((pm.start_call(
                             "read_many", items, tx.snapshot_vc,
-                            txid=tx.txid))
+                            txid=tx.txid), pm, items))
                     else:
                         values.update(pm.read_many(
                             items, tx.snapshot_vc, txid=tx.txid))
@@ -337,13 +352,21 @@ class Coordinator:
                 # a local read failed mid-round: started remote calls
                 # must not leak their native completion slots
                 if handles:
-                    link.abandon(handles)
+                    link.abandon([h for h, _pm, _it in handles])
                 raise
             if handles:
-                for ok, val in link.finish_many(handles):
-                    if not ok:
+                for (ok, val), (_h, pm, items) in zip(
+                        link.finish_many([h for h, _pm, _it in handles]),
+                        handles):
+                    if ok:
+                        values.update(val)
+                    elif _is_wrong_owner(val):
+                        # moved mid-read (handoff): the synchronous
+                        # path self-heals the proxy and retries
+                        values.update(pm.read_many(
+                            items, tx.snapshot_vc, txid=tx.txid))
+                    else:
                         raise val
-                    values.update(val)
             out = []
             for key, cls, pm in metas:
                 value = values[(key, cls.name)]

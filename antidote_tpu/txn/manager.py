@@ -752,6 +752,12 @@ class PartitionManager:
 
     # ------------------------------------------------------- stable plane
 
+    def has_prepared(self) -> bool:
+        """True while any transaction holds a prepare on this partition
+        (the cross-node handoff drain waits for this to clear)."""
+        with self._lock:
+            return bool(self.prepared)
+
     def min_prepared(self) -> int:
         """Min prepare time of in-flight txns (caps the stable time so a
         snapshot never passes a pending commit; reference get_min_prep,

@@ -589,6 +589,34 @@ class Node:
                     with pm._lock:
                         pm.device.gc(recovered_vc)
 
+    def adopt_partition(self, p: int):
+        """Build + recover ONE partition from its (just-installed) log
+        — the receiving half of a cross-node handoff: the transferred
+        log replays into the materializer exactly like a boot-time
+        recovery, and the clock advances past every adopted commit so
+        this node's future commit times stay monotone for the moved
+        keys."""
+        pm = self._build_partition(p)
+        pre_hosted = pm._pre_hosted()
+        for _seq, payload in pm.log.committed_payloads():
+            with pm._lock:
+                if pm._mid_batch_migrated(pre_hosted, payload.key):
+                    pm._note_skipped_publish(payload.key, payload)
+                else:
+                    pm._publish(payload.key, payload.type_name,
+                                payload, None)
+            if payload.commit_dc != self.dc_id:
+                continue
+            if payload.commit_time > pm.committed.get(payload.key, 0):
+                pm.committed[payload.key] = payload.commit_time
+        recovered = pm.log.max_commit_vc
+        self.clock.advance_to(recovered.get_dc(self.dc_id))
+        if recovered and pm.device is not None:
+            with pm._lock:
+                pm.device.gc(recovered)
+        self.partitions[p] = pm
+        return pm
+
     def close(self) -> None:
         for pm in self._local_partitions():
             pm.log.close()

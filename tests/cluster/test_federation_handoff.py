@@ -1,0 +1,92 @@
+"""Cross-node handoff INSIDE a federated DC: ownership of a slice
+moves between existing members while remote DCs keep replicating from
+it — and keep gap-repairing through their now-STALE descriptors (the
+old owner forwards repair queries to the new owner over the node
+fabric, cluster/federation.py _handle_query).
+
+The reference's analogue: riak_core ownership transfer under a
+connected inter-DC mesh; repair requests hit the member the cached
+descriptor names (src/inter_dc_query.erl:95-130) and must still get
+answered.
+"""
+
+import time
+
+from antidote_tpu.interdc import InProcBus
+
+from tests.cluster.test_federation import make_dc
+from antidote_tpu.cluster.federation import connect_federation
+
+
+def _converge_read(srv, groups, ct, bos, want, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            vals, _ = srv.api.read_objects_static(ct, bos)
+            assert vals == want
+            return
+        except TimeoutError:
+            assert time.monotonic() < deadline
+            for nids in groups:
+                for nid in nids:
+                    nid.tick_heartbeats()
+                    nid.pump()
+                    nid.srv.gossip_tick()
+
+
+def test_replication_and_repair_survive_handoff(tmp_path):
+    bus = InProcBus()
+    sa, na = make_dc(bus, tmp_path, "dcA")
+    sb, nb = make_dc(bus, tmp_path, "dcB")
+    connect_federation([na, nb])
+    try:
+        # history on dcA's partition 0 (owned by member n1), replicated
+        ct = sa[0].api.update_objects_static(
+            None, [((0, "counter_pn", "b"), "increment", 1)])
+        _converge_read(sb[0], (na, nb), ct, [(0, "counter_pn", "b")],
+                       [1])
+
+        # move partition 0 to dcA's OTHER member while federated
+        old_owner = sa[0].node.ring[0]
+        new_ring = dict(sa[0].node.ring)
+        new_ring[0] = [s.node_id for s in sa
+                       if s.node_id != old_owner][0]
+        sa[0].rebalance(new_ring)
+        new_srv = [s for s in sa if s.node_id == new_ring[0]][0]
+        new_nid = [n for n in na if n.srv is new_srv][0]
+        assert 0 in new_nid.local
+        assert 0 in new_nid.senders and 0 in new_nid.gates
+
+        # writes at the NEW owner still replicate to dcB — opid stream
+        # continuity across the publisher change
+        ct = new_srv.api.update_objects_static(
+            ct, [((0, "counter_pn", "b"), "increment", 10)])
+        _converge_read(sb[1], (na, nb), ct, [(0, "counter_pn", "b")],
+                       [11])
+
+        # now force a GAP at dcB and let repair route through the
+        # STALE descriptor (it still names the old owner for slice 0)
+        for nid in nb:
+            bus.set_drop_rx((nid.dc_id, nid.member_index), True)
+        for _ in range(3):
+            ct = new_srv.api.update_objects_static(
+                ct, [((0, "counter_pn", "b"), "increment", 1)])
+        for nid in nb:
+            bus.set_drop_rx((nid.dc_id, nid.member_index), False)
+        ct = new_srv.api.update_objects_static(
+            ct, [((0, "counter_pn", "b"), "increment", 1)])
+        _converge_read(sb[0], (na, nb), ct, [(0, "counter_pn", "b")],
+                       [15])
+
+        # dcB -> dcA direction: dcA's new owner applies remote txns for
+        # the moved slice (its sub-buffers resumed at the adopted
+        # watermarks)
+        ct = sb[0].api.update_objects_static(
+            ct, [((0, "counter_pn", "b"), "increment", 100)])
+        _converge_read(new_srv, (na, nb), ct, [(0, "counter_pn", "b")],
+                       [115])
+    finally:
+        for nid in na + nb:
+            nid.close()
+        for s in sa + sb:
+            s.close()

@@ -1,0 +1,257 @@
+"""Cross-node handoff: re-planning a LIVE multi-node DC's ring.
+
+The reference's riak_core transfers partition ownership between live
+nodes with handoff folds that run while the vnode keeps serving
+(reference src/logging_vnode.erl:781-812, claim/plan staged join
+src/antidote_dc_manager.erl:53-81).  Here: the new owner pulls the
+partition's CRC-framed log in chunks over the node fabric, the old
+owner drains (prepared transactions resolve, new mutating work parks),
+pushes the final tail, retires behind a typed wrong-owner redirect,
+and the driver commits the new plan on every member.
+
+What must hold: a cluster GROWS while writers commit continuously and
+no committed transaction is lost; proxies self-heal across the move;
+the stable snapshot never regresses; a restarted former owner honors
+the transfer.
+"""
+
+import threading
+import time
+
+import pytest
+
+from antidote_tpu.cluster import NodeServer, create_dc_cluster
+from antidote_tpu.cluster.remote import RemotePartition
+from antidote_tpu.config import Config
+from antidote_tpu.txn.coordinator import TransactionAborted
+from antidote_tpu.txn.manager import PartitionManager
+
+
+def _cfg():
+    return Config(n_partitions=8, heartbeat_s=0.05)
+
+
+def _counter_total(api, keys):
+    tx = api.start_transaction()
+    vals = api.read_objects([(k, "counter_pn", "b") for k in keys], tx)
+    api.commit_transaction(tx)
+    return sum(vals)
+
+
+def test_grow_cluster_under_continuous_writes(tmp_path):
+    """2-node DC grows to 3 while 3 writer threads commit without
+    pause; every committed increment survives the move."""
+    servers = [
+        NodeServer(f"n{i}", data_dir=str(tmp_path / f"n{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    create_dc_cluster("dc1", 8, servers)
+    s3 = NodeServer("n2", data_dir=str(tmp_path / "n2x"), config=_cfg())
+    try:
+        servers[0].add_member("n2", s3.addr)
+        assert s3.node is not None
+        assert s3.node.local_partition_indices() == []
+
+        stop = threading.Event()
+        committed = [0, 0, 0]
+        aborted = [0, 0, 0]
+        errs = []
+
+        def writer(slot, api, seed):
+            k = 0
+            try:
+                while not stop.is_set():
+                    key = (seed * 97 + k) % 64
+                    k += 1
+                    try:
+                        tx = api.start_transaction()
+                        api.update_objects(
+                            [((key, "counter_pn", "b"), "increment", 1),
+                             ((100 + key, "set_aw", "b"), "add",
+                              f"w{slot}")], tx)
+                        api.commit_transaction(tx)
+                        committed[slot] += 1
+                    except TransactionAborted:
+                        aborted[slot] += 1
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        apis = [servers[0].api, servers[1].api, s3.api]
+        threads = [threading.Thread(target=writer, args=(i, a, i))
+                   for i, a in enumerate(apis)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+
+        # the re-plan: n2 takes partitions 2 and 5 (one from each)
+        new_ring = dict(servers[0].node.ring)
+        assert new_ring[2] == "n0" and new_ring[5] == "n1"
+        new_ring[2] = "n2"
+        new_ring[5] = "n2"
+        servers[0].rebalance(new_ring)
+
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs
+        total = sum(committed)
+        assert total > 50  # writers really ran through the move
+
+        # ownership moved everywhere
+        for srv in servers + [s3]:
+            assert srv.node.ring[2] == "n2"
+            assert srv.node.ring[5] == "n2"
+        assert isinstance(s3.node.partitions[2], PartitionManager)
+        assert isinstance(s3.node.partitions[5], PartitionManager)
+        assert isinstance(servers[0].node.partitions[2], RemotePartition)
+
+        # nothing lost: the counters' grand total equals the number of
+        # committed increment transactions, read from EVERY member
+        for srv in servers + [s3]:
+            assert _counter_total(srv.api, range(64)) == total
+    finally:
+        for srv in servers + [s3]:
+            srv.close()
+
+
+def test_moved_partition_serves_history_and_new_writes(tmp_path):
+    servers = [
+        NodeServer(f"m{i}", data_dir=str(tmp_path / f"m{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    extra = NodeServer("m2", data_dir=str(tmp_path / "m2"),
+                       config=_cfg())
+    try:
+        create_dc_cluster("dc1", 8, servers, clients=[extra])
+        api = servers[0].api
+        # history on partition 3 (owned by m1) before the move
+        tx = api.start_transaction()
+        api.update_objects(
+            [((3 + 8 * i, "counter_pn", "b"), "increment", i + 1)
+             for i in range(4)], tx)
+        cvc = api.commit_transaction(tx)
+
+        new_ring = dict(servers[0].node.ring)
+        old_owner = new_ring[3]
+        new_ring[3] = "m2"
+        servers[0].rebalance(new_ring)
+
+        # history is served by the new owner
+        tx = extra.api.start_transaction(clock=cvc)
+        vals = extra.api.read_objects(
+            [((3 + 8 * i), "counter_pn", "b") for i in range(4)], tx)
+        extra.api.commit_transaction(tx)
+        assert vals == [1, 2, 3, 4]
+
+        # new writes through a STALE proxy self-heal onto the new owner
+        stale_api = servers[0 if old_owner != "m0" else 1].api
+        tx = stale_api.start_transaction()
+        stale_api.update_objects([((3, "counter_pn", "b"),
+                                   "increment", 10)], tx)
+        cvc = stale_api.commit_transaction(tx)
+        tx = extra.api.start_transaction(clock=cvc)
+        assert extra.api.read_objects([(3, "counter_pn", "b")], tx) \
+            == [11]
+        extra.api.commit_transaction(tx)
+
+        # stable snapshot still advances after the move (pins cleared)
+        s0 = servers[0].plane.get_stable_snapshot().get_dc("dc1")
+        time.sleep(0.3)
+        s1 = servers[0].plane.get_stable_snapshot().get_dc("dc1")
+        assert s1 >= s0
+    finally:
+        for srv in servers + [extra]:
+            srv.close()
+
+
+def test_crash_between_cutover_and_replan_resolves_via_journal(tmp_path):
+    """The old owner dies AFTER pushing the partition to the new owner
+    but BEFORE the global re-plan: its restart finds the handoff-out
+    journal, asks the new owner, and retires behind a redirect instead
+    of serving a log it no longer has (split-brain guard)."""
+    servers = [
+        NodeServer(f"j{i}", data_dir=str(tmp_path / f"j{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    extra = NodeServer("j2", data_dir=str(tmp_path / "j2"),
+                       config=_cfg())
+    try:
+        create_dc_cluster("dc1", 8, servers, clients=[extra])
+        api = servers[0].api
+        tx = api.start_transaction()
+        api.update_objects([((0, "counter_pn", "b"), "increment", 5)],
+                           tx)
+        api.commit_transaction(tx)
+        assert servers[0].node.ring[0] == "j0"
+
+        # transfer partition 0 to j2 WITHOUT the ring_update step (the
+        # driver "crashed" right after the cutover)
+        cursor = servers[0]._rpc("j2", "handoff_begin", (0, "j0"))
+        servers[0]._rpc("j0", "handoff_cutover", (0, "j2", cursor))
+        assert servers[0].meta.get("handoff_out") == {0: "j2"}
+
+        servers[0].close()
+        j0b = NodeServer("j0", data_dir=str(tmp_path / "j0"),
+                         config=_cfg())
+        try:
+            # the journal + peer query retired the moved partition
+            assert isinstance(j0b.node.partitions[0], RemotePartition)
+            assert j0b.node.ring[0] == "j2"
+            tx = j0b.api.start_transaction()
+            assert j0b.api.read_objects([(0, "counter_pn", "b")], tx) \
+                == [5]
+            j0b.api.commit_transaction(tx)
+        finally:
+            j0b.close()
+        servers = servers[1:]
+    finally:
+        for srv in servers + [extra]:
+            srv.close()
+
+
+def test_former_owner_restart_honors_transfer(tmp_path):
+    """The old owner crashes right after the transfer (before/without
+    anything else happening) and restarts from its persisted plan: the
+    handoff journal + peer query must keep it from serving the moved
+    partition."""
+    servers = [
+        NodeServer(f"r{i}", data_dir=str(tmp_path / f"r{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    extra = NodeServer("r2", data_dir=str(tmp_path / "r2"),
+                       config=_cfg())
+    try:
+        create_dc_cluster("dc1", 8, servers, clients=[extra])
+        api = servers[0].api
+        tx = api.start_transaction()
+        api.update_objects([((0, "counter_pn", "b"), "increment", 7)],
+                           tx)
+        api.commit_transaction(tx)
+
+        new_ring = dict(servers[0].node.ring)
+        assert new_ring[0] == "r0"
+        new_ring[0] = "r2"
+        servers[0].rebalance(new_ring)
+
+        # "crash" r0 and restart it from disk
+        servers[0].close()
+        r0b = NodeServer("r0", data_dir=str(tmp_path / "r0"),
+                         config=_cfg())
+        try:
+            assert r0b.node.ring[0] == "r2"
+            assert isinstance(r0b.node.partitions[0], RemotePartition)
+            tx = r0b.api.start_transaction()
+            assert r0b.api.read_objects([(0, "counter_pn", "b")], tx) \
+                == [7]
+            r0b.api.commit_transaction(tx)
+        finally:
+            r0b.close()
+        servers = servers[1:]
+    finally:
+        for srv in servers + [extra]:
+            srv.close()
