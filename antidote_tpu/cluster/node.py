@@ -203,6 +203,9 @@ class NodeServer:
 
     def __init__(self, node_id, host: str = "127.0.0.1", port: int = 0,
                  data_dir: str = ".", config: Optional[Config] = None):
+        from antidote_tpu.runtime import tune_runtime
+
+        tune_runtime()  # this process serves a node: GC + GIL knobs
         self.node_id = node_id
         self.config = config or Config()
         os.makedirs(data_dir, exist_ok=True)

@@ -96,4 +96,9 @@ class Config:
     device_max_dcs: int = 64
     #: per-key element-slot cap before an OR-set key evicts
     device_max_slots: int = 256
+    #: partition -> chip placement over jax.devices(): "ring" commits
+    #: partition p's plane state to chip p % n_devices (the ring as
+    #: the live data plane across a host's chips); "none" keeps the
+    #: default device.  No-op with a single device.
+    device_placement: str = "none"
     extra: dict = field(default_factory=dict)

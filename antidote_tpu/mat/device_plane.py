@@ -1851,6 +1851,9 @@ class DevicePlane:
         self.planes["map_rr"] = MapPlane("map_rr", make)
         self.planes["rga"] = RgaPlane(
             ClockDomain(8), key_capacity, flush_ops, gc_ops, max_dcs)
+        #: mesh device this partition's plane states are committed to
+        #: (None = default device); see place_on
+        self.device = None
         #: keys evicted to the host path (sticky)
         self.host_only: set = set()
         #: types whose dense representation collapses dot sets per DC —
@@ -1892,6 +1895,41 @@ class DevicePlane:
             return flds is None or all(
                 kt[1] not in self.STATE_LOSSY for kt in flds)
         return type_name not in self.STATE_LOSSY
+
+    def place_on(self, device) -> None:
+        """Commit every plane's state arrays to ``device`` — the ring as
+        the live data plane across a host's chips: partition p's
+        materializer lives on chip p % n (the reference instantiates
+        every vnode layer per partition across nodes,
+        src/antidote_app.erl:42-59; per-partition device placement is
+        the same idea over the mesh).  JAX's committed-placement rule
+        keeps every functional update (append/gc/grow return NEW
+        arrays from committed inputs) on the same chip, so one call at
+        partition build time pins the plane for its lifetime.  RGA
+        documents (dict-of-states, created lazily per document) keep
+        default placement."""
+        import jax as _jax
+
+        def _place(plane):
+            if isinstance(plane, MapPlane):
+                orig = plane._make_sub
+
+                def placed_make(tn, _orig=orig):
+                    sub = _orig(tn)
+                    sub.st = _jax.device_put(sub.st, device)
+                    return sub
+
+                plane._make_sub = placed_make
+                for s in plane._all_planes():
+                    s.st = _jax.device_put(s.st, device)
+            elif isinstance(plane, RgaPlane):
+                pass  # per-document dict states: lazily created
+            else:
+                plane.st = _jax.device_put(plane.st, device)
+
+        self.device = device
+        for plane in self.planes.values():
+            _place(plane)
 
     def set_evict_handler(self, fn: Callable[[Any, str], None]) -> None:
         def handler(key, type_name):

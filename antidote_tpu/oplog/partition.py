@@ -12,6 +12,7 @@ restart recovers both the op-id counters and the max commit VC
 
 from __future__ import annotations
 
+import array
 import os
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -52,6 +53,15 @@ class PartitionLog:
         #: reference's ETS cache answers this implicitly; a miss there
         #: scans only the per-key log via its key index)
         self.keys_seen: set = set()
+        #: key -> flat int64 array of (update_offset, commit_offset)
+        #: pairs in commit order — THE per-key log index (the
+        #: reference's disk_log is scanned via the materializer's
+        #: per-key ETS ops cache; here the index lets a cache-miss
+        #: exact read replay ONE key's history instead of the whole
+        #: partition log, which grows without bound)
+        self.key_commits: Dict[Any, "array.array"] = {}
+        #: txid -> [(key, update_offset)] awaiting their commit record
+        self._pending_updates: Dict[Any, List[Tuple[Any, int]]] = {}
         #: max committed time seen per DC (recovered; seeds the dependency
         #: clock on restart, reference src/logging_vnode.erl:301-322)
         self.max_commit_vc = VC()
@@ -67,38 +77,56 @@ class PartitionLog:
         self.op_counters[dc] = n
         return OpId(dc, n)
 
-    def _append(self, rec: LogRecord, sync: bool) -> LogRecord:
+    def _append(self, rec: LogRecord, sync: bool) -> int:
+        """Write + tap one record; returns its log offset (-1 when
+        logging is disabled) and maintains the per-key commit index."""
+        off = -1
         if self.enabled:
-            self.log.append(rec.to_bytes())
+            off = self.log.append(rec.to_bytes())
             if sync:
                 self.log.sync()
+            self._index(rec, off)
         if self.on_append is not None:
             self.on_append(rec)
-        return rec
+        return off
+
+    def _index(self, rec: LogRecord, off: int) -> None:
+        kind = rec.kind()
+        if kind == "update":
+            self._pending_updates.setdefault(rec.txid, []).append(
+                (rec.payload[1], off))
+        elif kind == "commit":
+            for k, off_u in self._pending_updates.pop(rec.txid, ()):
+                self.key_commits.setdefault(
+                    k, array.array("q")).extend((off_u, off))
+        elif kind == "abort":
+            self._pending_updates.pop(rec.txid, None)
 
     def append_update(self, dc, txid, key, type_name, effect) -> LogRecord:
         self.keys_seen.add(key)
-        return self._append(
-            update_record(self._next_op_id(dc), txid, key, type_name, effect),
-            sync=False)
+        rec = update_record(self._next_op_id(dc), txid, key, type_name,
+                            effect)
+        self._append(rec, sync=False)
+        return rec
 
     def append_prepare(self, dc, txid, prepare_time: int) -> LogRecord:
-        return self._append(
-            prepare_record(self._next_op_id(dc), txid, prepare_time),
-            sync=False)
+        rec = prepare_record(self._next_op_id(dc), txid, prepare_time)
+        self._append(rec, sync=False)
+        return rec
 
     def append_commit(self, dc, txid, commit_time: int,
                       snapshot_vc: VC, certified: bool = True) -> LogRecord:
         """Commit record; fsyncs when sync_on_commit (reference
         append_commit / ?SYNC_LOG)."""
-        return self._append(
-            commit_record(self._next_op_id(dc), txid, dc, commit_time,
-                          snapshot_vc, certified),
-            sync=self.sync_on_commit)
+        rec = commit_record(self._next_op_id(dc), txid, dc, commit_time,
+                            snapshot_vc, certified)
+        self._append(rec, sync=self.sync_on_commit)
+        return rec
 
     def append_abort(self, dc, txid) -> LogRecord:
-        return self._append(abort_record(self._next_op_id(dc), txid),
-                            sync=False)
+        rec = abort_record(self._next_op_id(dc), txid)
+        self._append(rec, sync=False)
+        return rec
 
     def append_remote_group(self, records: List[LogRecord]) -> None:
         """Store replicated records from another DC without assigning
@@ -158,7 +186,35 @@ class PartitionLog:
 
         Returns [(op_seq, Payload)] in log order.  ``to_vc``: only ops in
         that snapshot; ``from_vc``: drop ops already covered by it.
-        """
+
+        With ``key`` given, the per-key commit index replays ONLY that
+        key's records (O(key history) file reads instead of an
+        assembling scan of the whole partition log — the cache-miss
+        exact-state read runs this on every recently-written set/map
+        key, and the full scan was the measured dominant cost of the
+        logged txn path)."""
+        if key is not None and self.enabled:
+            self.log.flush()
+            out = []
+            seq = 0
+            idx = self.key_commits.get(key)
+            for i in range(0, len(idx) if idx is not None else 0, 2):
+                upd = LogRecord.from_bytes(self.log.read(idx[i]))
+                commit = LogRecord.from_bytes(self.log.read(idx[i + 1]))
+                _, k, type_name, effect = upd.payload
+                (dc, ct), svc = commit.payload[1], commit.payload[2]
+                p = Payload(key=k, type_name=type_name, effect=effect,
+                            commit_dc=dc, commit_time=ct,
+                            snapshot_vc=svc, txid=upd.txid,
+                            certified=commit_certified(commit.payload))
+                if to_vc is not None and \
+                        not op_in_read_snapshot(to_vc, p):
+                    continue
+                if from_vc is not None and p.commit_vc().le(from_vc):
+                    continue
+                seq += 1
+                out.append((seq, p))
+            return out
         asm = TxnAssembler()
         out: List[Tuple[int, Payload]] = []
         seq = 0
@@ -194,9 +250,15 @@ class PartitionLog:
     # ----------------------------------------------------------- recovery
 
     def _recover(self) -> None:
-        """Rebuild op-id counters and the max commit VC from the log
-        (reference get_last_op_from_log, src/logging_vnode.erl:595-643)."""
-        for rec in self.records():
+        """Rebuild op-id counters, the per-key commit index, and the
+        max commit VC from the log (reference get_last_op_from_log,
+        src/logging_vnode.erl:595-643)."""
+        if not self.enabled:
+            return
+        self.log.flush()
+        for off, payload_bytes in self.log.scan(0):
+            rec = LogRecord.from_bytes(payload_bytes)
+            self._index(rec, off)
             cur = self.op_counters.get(rec.op_id.dc, 0)
             if rec.op_id.n > cur:
                 self.op_counters[rec.op_id.dc] = rec.op_id.n

@@ -418,6 +418,12 @@ class Node:
             from antidote_tpu.mat.device_plane import DevicePlane
 
             plane = DevicePlane(config=self.config)
+            if self.config.device_placement == "ring":
+                import jax
+
+                devs = jax.devices()
+                if len(devs) > 1:
+                    plane.place_on(devs[p % len(devs)])
         pm = PartitionManager(p, self.dc_id, log, self.clock,
                               device_plane=plane)
         pm.stable_vc_source = self.stable_vc

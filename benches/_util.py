@@ -22,8 +22,13 @@ def setup(argv=None):
     argv = sys.argv if argv is None else argv
     import jax
 
+    from antidote_tpu.runtime import tune_runtime
+
     if "--cpu" in argv:
         jax.config.update("jax_platforms", "cpu")
+    # benches measure the SERVING configuration (GC + GIL knobs a node
+    # process applies at startup), not the default interpreter
+    tune_runtime()
     return "--quick" in argv, jax
 
 
