@@ -597,7 +597,18 @@ class Coordinator:
         if tx.state is not TxnState.ACTIVE:
             return
         for p in tx.partitions:
-            self.node.partitions[p].abort(tx.txid)
+            try:
+                self.node.partitions[p].abort(tx.txid)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                # an unreachable participant cannot be told to abort;
+                # its in-memory staged/prepared state dies with it and
+                # recovery discards commit-less records — letting this
+                # escape would mask the abort CAUSE the caller reports
+                import logging as _logging
+
+                _logging.getLogger(__name__).warning(
+                    "abort of %r at partition %d failed (participant "
+                    "unreachable?)", tx.txid, p, exc_info=True)
         tx.state = TxnState.ABORTED
         stats.registry.open_transactions.dec()
         stats.registry.aborted_transactions.inc()

@@ -1,0 +1,164 @@
+"""Chaos at FEDERATION scale: 2 DCs x 2 member node-servers each,
+randomized workload over (almost) every CRDT type, with inter-DC link
+flaps, silent frame loss, and a member kill -15/restart — all at once,
+across 3 seeds.
+
+The reference's hardest multi-DC suite does exactly this shape (kill
+BEAM nodes of a multi-node DC mid-replication and assert convergence,
+reference test/multidc/multiple_dcs_node_failure_SUITE.erl:85-120).
+This harness is the federation-scale extension the round-3 listener
+bugs called for: a member restart re-binds its advertised address,
+re-observes the federation from persisted descriptors, and its slice
+gap-repairs — under load, not in isolation.  (counter_b is excluded:
+its decrements legitimately abort on rights, covered by its own
+suite.)
+"""
+
+import random
+import time
+
+import pytest
+
+from antidote_tpu.clocks import vc_max
+from antidote_tpu.cluster import NodeServer
+from antidote_tpu.cluster.federation import NodeInterDc, connect_federation
+from antidote_tpu.config import Config
+from antidote_tpu.interdc import InProcBus
+from antidote_tpu.txn.coordinator import TransactionAborted
+
+from tests.cluster.test_federation import make_dc, pump_all
+
+TYPES = ["counter_pn", "counter_fat", "set_aw", "set_rw", "set_go",
+         "register_lww", "register_mv", "flag_ew", "flag_dw",
+         "map_go", "map_rr", "rga"]
+
+ELEMS = ["a", "b", "c", "d"]
+
+
+def _random_update(rng, tname):
+    if tname in ("counter_pn", "counter_fat"):
+        return ("increment", rng.randint(1, 3))
+    if tname in ("set_aw", "set_rw", "set_go"):
+        if tname != "set_go" and rng.random() < 0.35:
+            return ("remove", rng.choice(ELEMS))
+        return ("add", rng.choice(ELEMS))
+    if tname in ("register_lww", "register_mv"):
+        return ("assign", rng.choice(ELEMS))
+    if tname in ("flag_ew", "flag_dw"):
+        return (rng.choice(["enable", "disable"]), ())
+    if tname == "map_go":
+        return ("update", ((("n", "counter_pn"), ("increment", 1))))
+    if tname == "map_rr":
+        if rng.random() < 0.25:
+            return ("remove", ("tags", "set_aw"))
+        return ("update", ((("tags", "set_aw"),
+                            ("add", rng.choice(ELEMS)))))
+    if tname == "rga":
+        return ("add_right", (0, rng.choice(ELEMS)))
+    raise AssertionError(tname)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chaos_federation_all_types_converge(tmp_path, seed):
+    rng = random.Random(seed)
+    bus = InProcBus()
+    sa, na = make_dc(bus, tmp_path, "dcA")
+    sb, nb = make_dc(bus, tmp_path, "dcB")
+    connect_federation([na, nb])
+    apis = [s.api for s in sa + sb]
+    clocks = [None] * len(apis)
+    keys = [(f"chaos_{t}_{k}", t, "bkt")
+            for t in TYPES for k in range(2)]
+    try:
+        def burst(n, causal=True, exclude=()):
+            live = [i for i in range(len(apis)) if i not in exclude]
+            for _ in range(n):
+                i = rng.choice(live)
+                key = rng.choice(keys)
+                op = _random_update(rng, key[1])
+                try:
+                    clocks[i] = apis[i].update_objects_static(
+                        clocks[i] if causal else None, [(key, *op)])
+                except TransactionAborted:
+                    # a key owned by a dead member: that slice of the
+                    # keyspace is unavailable until the restart — the
+                    # write aborts cleanly, like the reference without
+                    # replicas
+                    assert exclude, "abort outside the down window"
+            pump_all([na, nb])
+
+        burst(30)
+
+        # inter-DC partition: both DCs stay available; writes in the
+        # window carry no cross-DC causal floor (a floor straddling
+        # the cut would correctly block until the heal)
+        for a in na:
+            for b in nb:
+                bus.set_link((a.dc_id, a.member_index),
+                             (b.dc_id, b.member_index), False)
+        burst(15, causal=False)
+        for a in na:
+            for b in nb:
+                bus.set_link((a.dc_id, a.member_index),
+                             (b.dc_id, b.member_index), True)
+        burst(10)
+
+        # silent frame loss inbound to BOTH dcB members: only opid gap
+        # repair can recover the stream
+        for nid in nb:
+            bus.set_drop_rx((nid.dc_id, nid.member_index), True)
+        burst(12, causal=False)
+        for nid in nb:
+            bus.set_drop_rx((nid.dc_id, nid.member_index), False)
+        burst(10)
+
+        # kill -15 one dcB member mid-workload and restart it from its
+        # data dir: plan reload, advertised-address rebind, federation
+        # re-observe from persisted descriptors, slice catch-up (the
+        # round-3 listener-shutdown bugs lived exactly here)
+        victim = rng.randrange(2)
+        nb[victim].close()
+        sb[victim].close()
+        clocks[2 + victim] = None
+        burst(12, causal=False, exclude=(2 + victim,))
+        name = f"dcB_n{victim + 1}"
+        srv = NodeServer(name, data_dir=str(tmp_path / name),
+                         config=Config(n_partitions=4,
+                                       heartbeat_s=0.02,
+                                       clock_wait_timeout_s=10.0))
+        assert srv.node is not None  # plan reloaded from disk
+        nid = NodeInterDc(srv, bus)
+        assert "dcA" in nid.remote  # persisted descriptors re-observed
+        nid.start()
+        sb[victim], nb[victim] = srv, nid
+        apis[2 + victim] = srv.api
+        burst(30)
+
+        merged = vc_max([c for c in clocks if c is not None])
+        deadline = time.monotonic() + 45.0
+        while True:
+            views = []
+            try:
+                for api in apis:
+                    vals, _ = api.read_objects_static(merged, keys)
+                    views.append(vals)
+            except TimeoutError:
+                assert time.monotonic() < deadline, \
+                    "replicas never covered the merged clock"
+                pump_all([na, nb])
+                continue
+            if all(v == views[0] for v in views[1:]):
+                break
+            assert time.monotonic() < deadline, (
+                "replicas disagree at the merged clock:\n"
+                + "\n".join(repr(v) for v in views))
+            pump_all([na, nb])
+            time.sleep(0.01)
+        # sanity: the workload actually produced state everywhere
+        assert any(v not in (0, [], {}, False, None, frozenset())
+                   for v in views[0])
+    finally:
+        for nid in na + nb:
+            nid.close()
+        for s in sa + sb:
+            s.close()
