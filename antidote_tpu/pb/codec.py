@@ -173,6 +173,27 @@ def clock_from_pb(t: pb.ApbTerm) -> Optional[VC]:
 
 # ------------------------------------------------------------- objects
 
+def encode_clock_token(vc: Optional[VC]) -> bytes:
+    """Opaque causal-clock bytes for protocols whose clients only echo
+    the token (the upstream compat protocol ships term_to_binary blobs
+    the same way, reference src/antidote_pb_process.erl:41-46).
+    termcodec, never pickle: tokens come back from untrusted clients."""
+    from antidote_tpu.interdc import termcodec
+
+    return termcodec.encode(dict(vc) if vc else {})
+
+
+def decode_clock_token(data: bytes) -> Optional[VC]:
+    from antidote_tpu.interdc import termcodec
+
+    if not data:
+        return None
+    d = termcodec.decode(data)
+    if not isinstance(d, dict):
+        raise ValueError("malformed clock token")
+    return VC(d) if d else None
+
+
 def bound_to_pb(bo, out: pb.ApbBoundObject) -> None:
     if len(bo) == 2:
         key, type_name = bo

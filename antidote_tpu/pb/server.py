@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import socketserver
+import struct
 import threading
 import uuid
 from typing import Dict
@@ -37,13 +38,33 @@ class PbServer:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                from antidote_tpu.pb import compat
+
                 conn = _Connection(outer.db)
+                cconn = compat.CompatConnection(outer.db)
                 try:
                     while True:
                         frame = codec.read_frame(self.request)
                         if frame is None:
                             return
                         code, body = frame
+                        # dual-protocol dispatch by message code: the
+                        # upstream antidote_pb registry numbers from
+                        # 107, the rebuild's own protocol from 10 —
+                        # disjoint, so antidotec_pb-style clients and
+                        # native clients share the port (pb/compat.py)
+                        if compat.is_compat_code(code):
+                            try:
+                                req = compat.decode_request(code, body)
+                                resp = cconn.process(req)
+                            except Exception as e:  # noqa: BLE001
+                                log.exception("pb compat request failed")
+                                resp = compat.error_resp(str(e))
+                            ccode, cbody = compat.encode_response(resp)
+                            self.request.sendall(
+                                struct.pack(">IB", len(cbody) + 1,
+                                            ccode) + cbody)
+                            continue
                         try:
                             req = codec.decode_msg(code, body)
                             resp = conn.process(req)
@@ -56,6 +77,7 @@ class PbServer:
                         self.request.sendall(codec.encode_msg(resp))
                 finally:
                     conn.abort_all()
+                    cconn.abort_all()
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -1,0 +1,213 @@
+"""Upstream-protocol compatibility (pb/compat.py): a client speaking
+the PUBLIC antidote_pb_codec protobuf — frames hand-assembled here
+from the transcribed schema, NOT via the rebuild's own client — runs
+full sessions against the shared PB port.
+
+Also pins RECORDED FRAMES: canonical request bytes as hex, so any
+future schema divergence found against a real antidotec_pb capture is
+a reviewable one-file diff (the provenance note in
+antidote_compat.proto explains why live byte-verification is
+impossible in this environment: zero egress, codec dep not vendored).
+"""
+
+import socket
+import struct
+
+import pytest
+
+from antidote_tpu.api import AntidoteTPU
+from antidote_tpu.config import Config
+from antidote_tpu.pb import antidote_compat_pb2 as cpb
+from antidote_tpu.pb import compat
+from antidote_tpu.pb.server import PbServer
+
+
+@pytest.fixture
+def served(tmp_path):
+    db = AntidoteTPU(config=Config(n_partitions=4,
+                                   data_dir=str(tmp_path)))
+    srv = PbServer(db, port=0).start()
+    sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+    yield sock
+    sock.close()
+    srv.stop()
+    db.close()
+
+
+def _send(sock, msg) -> None:
+    code = compat.CODES[type(msg).__name__]
+    body = msg.SerializeToString()
+    sock.sendall(struct.pack(">IB", len(body) + 1, code) + body)
+
+
+def _recv(sock):
+    hdr = b""
+    while len(hdr) < 5:
+        hdr += sock.recv(5 - len(hdr))
+    (ln,), code = struct.unpack(">I", hdr[:4]), hdr[4]
+    body = b""
+    while len(body) < ln - 1:
+        body += sock.recv(ln - 1 - len(body))
+    name = {v: k for k, v in compat.CODES.items()}[code]
+    msg = getattr(cpb, name)()
+    msg.ParseFromString(body)
+    return msg
+
+
+def _bound(key: bytes, t, bucket=b"bkt"):
+    bo = cpb.ApbBoundObject()
+    bo.key = key
+    bo.type = t
+    bo.bucket = bucket
+    return bo
+
+
+def test_interactive_session_counter_set_reg_flag(served):
+    sock = served
+    _send(sock, cpb.ApbStartTransaction())
+    st = _recv(sock)
+    assert type(st).__name__ == "ApbStartTransactionResp" and st.success
+    txd = st.transaction_descriptor
+
+    upd = cpb.ApbUpdateObjects()
+    upd.transaction_descriptor = txd
+    u = upd.updates.add()
+    u.boundobject.CopyFrom(_bound(b"c1", cpb.COUNTER))
+    u.operation.counterop.inc = 5
+    u = upd.updates.add()
+    u.boundobject.CopyFrom(_bound(b"s1", cpb.ORSET))
+    u.operation.setop.optype = cpb.ApbSetUpdate.ADD
+    u.operation.setop.adds.append(b"x")
+    u.operation.setop.adds.append(b"y")
+    u = upd.updates.add()
+    u.boundobject.CopyFrom(_bound(b"r1", cpb.LWWREG))
+    u.operation.regop.value = b"hello"
+    u = upd.updates.add()
+    u.boundobject.CopyFrom(_bound(b"f1", cpb.FLAG_EW))
+    u.operation.flagop.value = True
+    _send(sock, upd)
+    op = _recv(sock)
+    assert type(op).__name__ == "ApbOperationResp" and op.success
+
+    rd = cpb.ApbReadObjects()
+    rd.transaction_descriptor = txd
+    for bo in (_bound(b"c1", cpb.COUNTER), _bound(b"s1", cpb.ORSET),
+               _bound(b"r1", cpb.LWWREG), _bound(b"f1", cpb.FLAG_EW)):
+        rd.boundobjects.add().CopyFrom(bo)
+    _send(sock, rd)
+    rr = _recv(sock)
+    assert type(rr).__name__ == "ApbReadObjectsResp" and rr.success
+    assert rr.objects[0].counter.value == 5
+    assert sorted(rr.objects[1].set.value) == [b"x", b"y"]
+    assert rr.objects[2].reg.value == b"hello"
+    assert rr.objects[3].flag.value is True
+
+    commit = cpb.ApbCommitTransaction()
+    commit.transaction_descriptor = txd
+    _send(sock, commit)
+    cr = _recv(sock)
+    assert type(cr).__name__ == "ApbCommitResp" and cr.success
+    assert cr.commit_time  # opaque token, echoed below
+
+    # static read at the commit time: sees the committed state
+    srd = cpb.ApbStaticReadObjects()
+    srd.transaction.timestamp = cr.commit_time
+    srd.objects.add().CopyFrom(_bound(b"c1", cpb.COUNTER))
+    _send(sock, srd)
+    sr = _recv(sock)
+    assert type(sr).__name__ == "ApbStaticReadObjectsResp"
+    assert sr.objects.objects[0].counter.value == 5
+
+
+def test_static_update_and_map(served):
+    sock = served
+    su = cpb.ApbStaticUpdateObjects()
+    su.transaction.SetInParent()
+    u = su.updates.add()
+    u.boundobject.CopyFrom(_bound(b"m1", cpb.GMAP))
+    nest = u.operation.mapop.updates.add()
+    nest.key.key = b"hits"
+    nest.key.type = cpb.COUNTER
+    nest.update.counterop.inc = 3
+    _send(sock, su)
+    cr = _recv(sock)
+    assert cr.success
+
+    srd = cpb.ApbStaticReadObjects()
+    srd.transaction.timestamp = cr.commit_time
+    srd.objects.add().CopyFrom(_bound(b"m1", cpb.GMAP))
+    _send(sock, srd)
+    sr = _recv(sock)
+    ent = sr.objects.objects[0].map.entries[0]
+    assert ent.key.key == b"hits" and ent.key.type == cpb.COUNTER
+    assert ent.value.counter.value == 3
+
+
+def test_native_and_compat_share_one_port(served, tmp_path):
+    """The same connection's port serves the rebuild's own protocol
+    too (disjoint code spaces): a native client sees compat writes."""
+    sock = served
+    su = cpb.ApbStaticUpdateObjects()
+    su.transaction.SetInParent()
+    u = su.updates.add()
+    u.boundobject.CopyFrom(_bound(b"shared", cpb.COUNTER))
+    u.operation.counterop.inc = 9
+    _send(sock, su)
+    cr = _recv(sock)
+    assert cr.success
+
+    from antidote_tpu.pb.client import PbClient
+
+    port = sock.getpeername()[1]
+    with PbClient(port=port) as cl:
+        vals, _ = cl.read_objects_static(
+            None, [((b"shared"), "counter_pn", b"bkt")])
+        assert vals[0] == 9
+
+
+def test_unknown_type_returns_error_resp(served):
+    sock = served
+    rd = cpb.ApbReadObjects()
+    rd.transaction_descriptor = b"nope"
+    rd.boundobjects.add().CopyFrom(_bound(b"x", cpb.COUNTER))
+    _send(sock, rd)
+    err = _recv(sock)
+    assert type(err).__name__ == "ApbErrorResp"
+
+
+# --------------------------------------------------------------- frames
+
+def test_recorded_canonical_frames():
+    """Golden bytes of canonical requests under the transcribed
+    schema.  If a divergence from upstream antidote_pb_codec is ever
+    found (a real antidotec_pb capture disagrees), fixing the .proto
+    shows up here as a reviewable byte diff."""
+    m = cpb.ApbStartTransaction()
+    code = compat.CODES["ApbStartTransaction"]
+    assert (code, m.SerializeToString().hex()) == (119, "")
+
+    upd = cpb.ApbUpdateObjects()
+    upd.transaction_descriptor = b"T"
+    u = upd.updates.add()
+    u.boundobject.CopyFrom(_bound(b"k", cpb.COUNTER, b"b"))
+    u.operation.counterop.inc = 1
+    assert compat.CODES["ApbUpdateObjects"] == 118
+    # pin the exact bytes (fails loudly on any schema change):
+    # updates[1]{ boundobject{key "k", COUNTER, bucket "b"},
+    #             operation{counterop{inc 1}} }
+    # transaction_descriptor[2] "T"
+    assert upd.SerializeToString().hex() == \
+        "0a100a080a016b10031a016212040a020802120154"
+
+
+def test_frame_layout_matches_reference_packet4():
+    """[u32 BE length][u8 code][payload] — {packet,4} framing around
+    the 1-byte message code (reference
+    src/antidote_pb_protocol.erl:42-58)."""
+    m = cpb.ApbAbortTransaction()
+    m.transaction_descriptor = b"T"
+    body = m.SerializeToString()
+    frame = struct.pack(">IB", len(body) + 1,
+                        compat.CODES["ApbAbortTransaction"]) + body
+    assert frame.hex() == "0000000478" + body.hex()
+    assert compat.CODES["ApbAbortTransaction"] == 120
