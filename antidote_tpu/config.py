@@ -96,6 +96,11 @@ class Config:
     device_max_dcs: int = 64
     #: per-key element-slot cap before an OR-set key evicts
     device_max_slots: int = 256
+    #: run threshold device flushes/GCs on a background flusher thread
+    #: (group commit: commits only stage; reads needing pending data
+    #: still flush inline).  Committers flush inline past 4x the
+    #: threshold (backpressure).
+    device_async_flush: bool = True
     #: partition -> chip placement over jax.devices(): "ring" commits
     #: partition p's plane state to chip p % n_devices (the ring as
     #: the live data plane across a host's chips); "none" keeps the

@@ -151,7 +151,12 @@ class _PlaneBase:
         self.on_evict: Callable[[Any, str], None] = lambda k, t: None
         self.capacity = key_capacity
         self.st = self._init_state(key_capacity)
-        self.warm_appends()
+        #: background compile kicked on the FIRST staged op for this
+        #: plane (DevicePlane.stage): warming every type at node build
+        #: would compile 11 types' programs nobody may ever use —
+        #: costly, and on small hosts the compile threads compete with
+        #: serving
+        self._warm_kicked = False
 
     # -- subclass hooks -----------------------------------------------------
 
@@ -169,6 +174,12 @@ class _PlaneBase:
     _row_cols: tuple = ()
     #: the store's ``*_append`` for this plane's shard state
     _append_fn = None
+
+    def kick_warm(self) -> None:
+        """Idempotent first-use trigger for warm_appends."""
+        if not self._warm_kicked:
+            self._warm_kicked = True
+            self.warm_appends()
 
     def warm_appends(self, buckets: tuple = (64, 256)) -> None:
         """Compile this plane's append programs for every dispatch
@@ -388,14 +399,40 @@ class _PlaneBase:
         log.debug("device plane: evicted %r (%s)", key, self.type_name)
         self.on_evict(key, self.type_name)
 
+    #: set by DevicePlane.stage when async flushing is wired: called
+    #: with this plane to run flush/gc on the flusher thread
+    _schedule = None
+
     def maybe_flush_gc(self, stable_vc: Optional[VC]) -> None:
         if stable_vc is not None:
             self._last_stable = (stable_vc if self._last_stable is None
                                  else self._last_stable.join(stable_vc))
+        due_flush = len(self.rows) >= self.flush_ops
+        due_gc = (stable_vc is not None
+                  and self._ops_since_gc >= self.gc_ops)
+        if not (due_flush or due_gc):
+            return
+        if self._schedule is not None \
+                and len(self.rows) < 4 * self.flush_ops:
+            # group commit: the committing transaction only stages; the
+            # device work runs on the flusher thread.  Past 4x the
+            # threshold the committer flushes INLINE — backpressure so
+            # a lagging flusher cannot let staged rows grow unboundedly
+            self._schedule(self)
+            return
+        if due_flush:
+            self.flush()
+        if due_gc:
+            self.gc(self._last_stable or stable_vc)
+
+    def flush_gc_now(self) -> None:
+        """Flusher-thread entry: run any due flush/GC (caller holds the
+        partition lock and has quiesced device readers)."""
         if len(self.rows) >= self.flush_ops:
             self.flush()
-        if stable_vc is not None and self._ops_since_gc >= self.gc_ops:
-            self.gc(stable_vc)
+        if self._last_stable is not None \
+                and self._ops_since_gc >= self.gc_ops:
+            self.gc(self._last_stable)
 
     def flush(self) -> None:
         """Drain staged rows into the device ring, padded to a bucket.
@@ -1631,6 +1668,25 @@ class MapPlane:
         self.pending_keys: set = set()
         self.on_evict: Callable[[Any, str], None] = lambda k, t: None
         self._evicting = None
+        self._warm_kicked = False
+
+    def kick_warm(self) -> None:
+        """First-use warm trigger: existing sub-planes (presence
+        included) warm-compile now, and every LAZILY created sub-plane
+        warms at creation (see _PlaneBase.warm_appends)."""
+        if self._warm_kicked:
+            return
+        self._warm_kicked = True
+        orig = self._make_sub
+
+        def warming_make(tn, _orig=orig):
+            sub = _orig(tn)
+            sub.warm_appends()
+            return sub
+
+        self._make_sub = warming_make
+        for s in self._all_planes():
+            s.warm_appends()
 
     # -- plumbing shared with _PlaneBase's interface ------------------------
 
@@ -1698,8 +1754,11 @@ class MapPlane:
                 return
         self.pending_keys.add(key)
 
+    _schedule = None
+
     def maybe_flush_gc(self, stable_vc: Optional[VC]) -> None:
         for p in self._all_planes():
+            p._schedule = self._schedule  # async-flush wiring follows
             p.maybe_flush_gc(stable_vc)
         if not any(p.rows for p in self._all_planes()):
             self.pending_keys.clear()
@@ -1854,6 +1913,13 @@ class DevicePlane:
         #: mesh device this partition's plane states are committed to
         #: (None = default device); see place_on
         self.device = None
+        #: when set (by the owning PartitionManager), threshold flushes
+        #: and GCs are SCHEDULED here instead of running inline on the
+        #: committing transaction's back — group commit: the commit
+        #: path only stages; the XLA work happens on the flusher thread
+        #: under the partition lock (reads needing pending data still
+        #: flush inline — they need the result)
+        self.flush_scheduler = None
         #: keys evicted to the host path (sticky)
         self.host_only: set = set()
         #: types whose dense representation collapses dot sets per DC —
@@ -1948,6 +2014,10 @@ class DevicePlane:
     def stage(self, key, type_name: str, payload: Payload,
               stable_vc: Optional[VC]) -> None:
         p = self.planes[type_name]
+        if not p._warm_kicked:
+            p.kick_warm()
+        if p._schedule is not self.flush_scheduler:
+            p._schedule = self.flush_scheduler
         p.stage(key, payload)
         p.maybe_flush_gc(stable_vc)
 

@@ -35,6 +35,64 @@ class CertificationError(Exception):
     """Write-write certification failed — transaction must abort."""
 
 
+class DeviceFlusher:
+    """One background thread draining scheduled device flush/GC jobs —
+    group commit for the data plane: the committing transaction only
+    STAGES (list append); the XLA dispatch runs here, under the owning
+    partition's lock with readers quiesced — exactly the conditions the
+    inline path had, minus the committing client waiting out the
+    flush.  (The reference materializer applies its op cache outside
+    the commit reply path the same way,
+    src/materializer_vnode.erl:620-647.)"""
+
+    def __init__(self):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._queued: set = set()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def schedule(self, pm: "PartitionManager", plane) -> None:
+        key = (id(pm), id(plane))
+        with self._lock:
+            if key in self._queued:
+                return
+            self._queued.add(key)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="device-flusher")
+                self._thread.start()
+        self._q.put((key, pm, plane))
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            key, pm, plane = item
+            with self._lock:
+                self._queued.discard(key)
+            try:
+                with pm._lock:
+                    pm._wait_device_quiesce()
+                    plane.flush_gc_now()
+            except Exception:  # noqa: BLE001 — the drain must not die
+                import logging as _logging
+
+                _logging.getLogger(__name__).exception(
+                    "background device flush failed")
+
+    def stop(self) -> None:
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            self._q.put(None)
+            t.join(timeout=5.0)
+
+
 #: tag marking a deferred-op entry that carries a RAW OPERATION whose
 #: downstream the OWNER partition generates (reference
 #: clocksi_downstream at the vnode, src/clocksi_downstream.erl:41-68)

@@ -96,6 +96,11 @@ class Node:
         self.config = config or Config()
         self.clock = HybridClock()
         self.hooks = HookRegistry()
+        from antidote_tpu.txn.manager import DeviceFlusher
+
+        #: background group-commit flusher shared by this node's
+        #: partitions (see Config.device_async_flush)
+        self._flusher = DeviceFlusher()
         base = data_dir or self.config.data_dir
         os.makedirs(base, exist_ok=True)
         self.data_dir = base
@@ -426,6 +431,9 @@ class Node:
                     plane.place_on(devs[p % len(devs)])
         pm = PartitionManager(p, self.dc_id, log, self.clock,
                               device_plane=plane)
+        if plane is not None and self.config.device_async_flush:
+            plane.flush_scheduler = (
+                lambda pl, _pm=pm: self._flusher.schedule(_pm, pl))
         pm.stable_vc_source = self.stable_vc
         # owner-side downstream generation (shipped raw ops resolve at
         # the partition that holds the state — manager._resolve_raw_ops)
@@ -624,6 +632,7 @@ class Node:
         return pm
 
     def close(self) -> None:
+        self._flusher.stop()
         for pm in self._local_partitions():
             pm.log.close()
 
