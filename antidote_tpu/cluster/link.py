@@ -31,13 +31,15 @@ log = logging.getLogger(__name__)
 
 
 def _err_kind(exc: Exception) -> str:
-    from antidote_tpu.cluster.remote import WrongOwner
+    from antidote_tpu.cluster.remote import HandoffParked, WrongOwner
     from antidote_tpu.txn.manager import CertificationError
 
     if isinstance(exc, CertificationError):
         return "certification"
     if isinstance(exc, WrongOwner):
         return "wrong_owner"
+    if isinstance(exc, HandoffParked):
+        return "parked"
     if isinstance(exc, TimeoutError):
         return "timeout"
     return "generic"
@@ -50,10 +52,16 @@ def _raise_remote(kind: str, msg: str):
         raise CertificationError(msg)
     if kind == "timeout":
         raise TimeoutError(msg)
-    from antidote_tpu.cluster.remote import RemoteCallError, WrongOwner
+    from antidote_tpu.cluster.remote import (
+        HandoffParked,
+        RemoteCallError,
+        WrongOwner,
+    )
 
     if kind == "wrong_owner":
         raise WrongOwner(msg)
+    if kind == "parked":
+        raise HandoffParked(msg)
     raise RemoteCallError(msg)
 
 

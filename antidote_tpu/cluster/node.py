@@ -43,7 +43,7 @@ from antidote_tpu.config import Config
 from antidote_tpu.meta.gossip import StableTimeTracker
 from antidote_tpu.meta.sender import MetaDataSender
 from antidote_tpu.meta.stable_store import StableMetaData
-from antidote_tpu.txn.manager import PartitionManager
+from antidote_tpu.txn.manager import PartitionManager, PartitionRetired
 from antidote_tpu.txn.node import Node
 
 log = logging.getLogger(__name__)
@@ -203,11 +203,15 @@ class NodeServer:
 
     def __init__(self, node_id, host: str = "127.0.0.1", port: int = 0,
                  data_dir: str = ".", config: Optional[Config] = None):
-        from antidote_tpu.runtime import tune_runtime
-
-        tune_runtime()  # this process serves a node: GC + GIL knobs
         self.node_id = node_id
         self.config = config or Config()
+        if self.config.tune_process:
+            # this process serves a node: GC + GIL knobs.  Embedders
+            # opt out with Config(tune_process=False) — the tuning
+            # mutates process-global interpreter state.
+            from antidote_tpu.runtime import tune_runtime
+
+            tune_runtime()
         os.makedirs(data_dir, exist_ok=True)
         self.data_dir = data_dir
         self.meta = StableMetaData(
@@ -235,9 +239,14 @@ class NodeServer:
         self._peer_backoff: Dict[Any, float] = {}
         #: member id -> advertised address (the committed plan's view)
         self._members: Dict[Any, Tuple[str, int]] = {}
-        #: cross-node handoff state per partition:
-        #: {"state": "drain" | "retired", "new_owner", "event"}
+        #: cross-node handoff state per partition (outbound side):
+        #: {"state": "drain" | "retired" | "in_doubt", "new_owner"}
         self._handoff: Dict[int, dict] = {}
+        #: inbound install state per partition: serializes
+        #: handoff_install vs. handoff_probe and carries the probe's
+        #: cancel fence (see _handoff_in_entry)
+        self._handoff_in: Dict[int, dict] = {}
+        self._handoff_in_lock = threading.Lock()
         #: partitions handed off but not yet re-planned globally: their
         #: stable contribution stays PINNED at the transfer's commit
         #: watermark VC (own entry: max own-DC commit; remote entries:
@@ -447,23 +456,64 @@ class NodeServer:
             st = self._handoff.get(p)
             if st is not None:
                 if st["state"] == "drain" and method in _HANDOFF_PARKED:
-                    # new mutating work parks for the (short) cutover
-                    # window; reads and the commits/aborts resolving
-                    # already-prepared txns flow so the drain finishes
-                    st["event"].wait(timeout=30.0)
-                    st = self._handoff.get(p)
+                    # new mutating work is refused with a RETRYABLE
+                    # error for the (short) cutover window — the proxy
+                    # backs off and re-sends.  Refusing instead of
+                    # parking keeps every fabric worker free for the
+                    # reads and the commit/abort traffic the drain
+                    # itself is waiting on (advisor r04: parked
+                    # workers could starve the drain).
+                    from antidote_tpu.cluster.remote import HandoffParked
+
+                    raise HandoffParked(
+                        f"partition {p} draining for handoff to "
+                        f"{st['new_owner']!r}")
                 if st is not None and st["state"] == "retired":
                     from antidote_tpu.cluster.remote import WrongOwner
 
                     raise WrongOwner(
                         f"partition {p} moved to "
                         f"{st['new_owner']!r}")
+                if st is not None and st["state"] == "in_doubt":
+                    raise RemoteCallError(
+                        f"partition {p} ownership in doubt "
+                        f"(transfer to {st['new_owner']!r} unresolved)")
             pm = self.node.partitions[p]
             if not isinstance(pm, PartitionManager):
                 raise RemoteCallError(
                     f"partition {p} not owned by {self.node_id!r} "
                     f"(stale ring at {origin!r}?)")
-            return getattr(pm, method)(*args, **kwargs)
+            try:
+                return getattr(pm, method)(*args, **kwargs)
+            except PartitionRetired:
+                # this call raced the cutover's drain refusal: it
+                # passed the state check above before drain was set,
+                # then hit the retired flag under pm._lock — map by
+                # the CURRENT handoff state instead of silently losing
+                # the append (advisor r04 TOCTOU).  While the cutover
+                # is still draining/in flight the ring still names
+                # this node, so a WrongOwner redirect would dead-end
+                # (refresh_owner finds no new owner); the retryable
+                # refusal keeps the client backing off until the
+                # cutover resolves either way.
+                from antidote_tpu.cluster.remote import (
+                    HandoffParked,
+                    WrongOwner,
+                )
+
+                st = self._handoff.get(p)
+                state = st["state"] if st else None
+                if state == "retired":
+                    raise WrongOwner(
+                        f"partition {p} moved to "
+                        f"{st['new_owner']!r}") from None
+                if state == "in_doubt":
+                    raise RemoteCallError(
+                        f"partition {p} ownership in doubt "
+                        f"(transfer to {st['new_owner']!r} "
+                        f"unresolved)") from None
+                raise HandoffParked(
+                    f"partition {p} draining for handoff") from None
         if kind == "ring":
             if self.node is None:
                 raise RemoteCallError("node not assembled yet")
@@ -496,6 +546,9 @@ class NodeServer:
         if kind == "handoff_install":
             p, base_offset, tail = payload
             return self._handoff_install(int(p), int(base_offset), tail)
+        if kind == "handoff_probe":
+            (p,) = payload
+            return self._handoff_probe(int(p))
         if kind == "handoff_cutover":
             p, new_owner, b_cursor = payload
             return self._handoff_cutover(int(p), new_owner,
@@ -532,6 +585,13 @@ class NodeServer:
     def _staged_path(self, p: int) -> str:
         return self.node._log_path(p) + ".handoff"
 
+    def _handoff_in_entry(self, p: int) -> dict:
+        """Receiver-side per-partition install state: a lock that
+        serializes install vs. probe, and the probe's cancel flag."""
+        with self._handoff_in_lock:
+            return self._handoff_in.setdefault(
+                int(p), {"lock": threading.Lock(), "cancelled": False})
+
     def _handoff_begin(self, p: int, from_owner) -> int:
         """Receiving side, serving phase: pull the partition's log in
         chunks from the current owner into a staged file, re-pulling
@@ -539,6 +599,11 @@ class NodeServer:
         while the vnode keeps serving, reference
         src/logging_vnode.erl:781-812).  Returns the staged cursor; the
         final tail arrives pushed by the owner's cutover."""
+        ent = self._handoff_in_entry(p)
+        with ent["lock"]:
+            # a fresh staging round supersedes any cancel a previous
+            # attempt's settlement probe left behind
+            ent["cancelled"] = False
         staged = self._staged_path(p)
         cursor = 0
         with open(staged, "wb") as f:
@@ -560,28 +625,61 @@ class NodeServer:
         staged log, promote it to the live log path, and adopt the
         partition (build + recover + serve).  The local plan persists
         immediately: if this node restarts before the global re-plan,
-        it must come back serving the partition it accepted."""
-        staged = self._staged_path(p)
-        have = os.path.getsize(staged) if os.path.exists(staged) else 0
-        if have != base_offset:
-            raise RemoteCallError(
-                f"handoff install mismatch: staged {have} bytes, "
-                f"owner pushed tail from {base_offset}")
-        with open(staged, "ab") as f:
-            f.write(tail)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(staged, self.node._log_path(p))
-        self.node.ring[p] = self.node_id
-        self.node.adopt_partition(p)
-        prev = self.plane.get_stable_snapshot() if self.plane else None
-        self._install_stable_plane(prev_stable=prev)
-        if self.on_ring_change is not None:
-            self.on_ring_change()
-        self.meta.put("cluster_plan",
-                      (self.node.dc_id, dict(self.node.ring),
-                       dict(self._members)))
-        return True
+        it must come back serving the partition it accepted.
+
+        Runs under the per-partition install lock shared with
+        handoff_probe: the owner's settlement probe either observes
+        this install COMPLETE (and reports adoption) or cancels it
+        before it starts — "probe answered not-adopted, then the
+        install applied anyway" cannot happen (the double-owner race
+        the round-4 advisor flagged)."""
+        ent = self._handoff_in_entry(p)
+        with ent["lock"]:
+            if ent["cancelled"]:
+                raise RemoteCallError(
+                    f"handoff install of {p} cancelled by the owner's "
+                    f"settlement probe; re-run handoff_begin to retry")
+            staged = self._staged_path(p)
+            have = os.path.getsize(staged) if os.path.exists(staged) \
+                else 0
+            if have != base_offset:
+                raise RemoteCallError(
+                    f"handoff install mismatch: staged {have} bytes, "
+                    f"owner pushed tail from {base_offset}")
+            with open(staged, "ab") as f:
+                f.write(tail)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(staged, self.node._log_path(p))
+            self.node.ring[p] = self.node_id
+            self.node.adopt_partition(p)
+            prev = self.plane.get_stable_snapshot() if self.plane \
+                else None
+            self._install_stable_plane(prev_stable=prev)
+            if self.on_ring_change is not None:
+                self.on_ring_change()
+            self.meta.put("cluster_plan",
+                          (self.node.dc_id, dict(self.node.ring),
+                           dict(self._members)))
+            return True
+
+    def _handoff_probe(self, p: int) -> bool:
+        """Receiving side: adoption oracle for the owner's settlement
+        (cutover failure / restart resolution).  Under the install
+        lock: reports whether this node adopted the partition, and if
+        not, CANCELS any staged-but-unapplied install so the answer
+        stays true afterwards — the fence that makes 'resume
+        ownership' safe for the asking side."""
+        ent = self._handoff_in_entry(p)
+        with ent["lock"]:
+            adopted = (
+                self.node is not None
+                and self.node.ring.get(p) == self.node_id
+                and isinstance(self.node.partitions[p],
+                               PartitionManager))
+            if not adopted:
+                ent["cancelled"] = True
+            return adopted
 
     def _handoff_cutover(self, p: int, new_owner, b_cursor: int) -> bool:
         """Owning side, cutover: drain the partition (park new mutating
@@ -598,55 +696,139 @@ class NodeServer:
                 f"partition {p} not owned by {self.node_id!r}")
         if new_owner not in self._members:
             raise RemoteCallError(f"unknown member {new_owner!r}")
-        ev = threading.Event()
-        self._handoff[p] = {"state": "drain", "new_owner": new_owner,
-                            "event": ev}
+        #: a journal entry from a PREVIOUS attempt means that attempt's
+        #: install may have been applied at the receiver — then even a
+        #: pre-install failure of THIS attempt must settle by probe,
+        #: never clean-resume (the clean path deletes the journal)
+        prior_intent = p in (self.meta.get("handoff_out") or {})
+        self._handoff[p] = {"state": "drain", "new_owner": new_owner}
+        install_sent = False
         try:
             with self.node.txn_gate.exclusive():
                 deadline = time.monotonic() + 30.0
-                while pm.has_prepared():
+                while True:
+                    # the prepared check, the retire flag, and the tail
+                    # snapshot form ONE pm._lock critical section:
+                    # every append also runs under pm._lock and checks
+                    # pm.retired first, so no mutating RPC that raced
+                    # the drain park can land a record after the tail
+                    # is read — it raises PartitionRetired instead
+                    # (advisor r04: cutover TOCTOU)
+                    with pm._lock:
+                        if not pm.prepared:
+                            pm.retired = True
+                            tail, end = pm.log.read_bytes(
+                                b_cursor, 1 << 62)
+                            break
                     if time.monotonic() > deadline:
                         raise RemoteCallError(
                             f"partition {p} drain timed out")
                     time.sleep(0.005)
-                tail, end = pm.log.read_bytes(b_cursor, 1 << 62)
                 # journal the in-doubt transfer BEFORE the push: a
                 # crash from here on resolves ownership by asking the
                 # new owner at restart (_resume_handoff_out)
                 out = dict(self.meta.get("handoff_out") or {})
                 out[p] = new_owner
                 self.meta.put("handoff_out", out)
+                install_sent = True
                 self._rpc(new_owner, "handoff_install",
                           (p, b_cursor, tail))
-                # pin at the transferred commit watermark VC: every
-                # future commit on p happens at the new owner ABOVE the
-                # own-DC entry (their clock advances past it at adopt),
-                # and their replication gate seeds at the same remote
-                # watermarks
-                self._stable_pins[p] = VC(pm.log.max_commit_vc)
-                self.node.ring[p] = new_owner
-                self.node.partitions[p] = RemotePartition(
-                    self.link, new_owner, p)
-                self._install_stable_plane(
-                    prev_stable=self.plane.get_stable_snapshot())
-                if self.on_ring_change is not None:
-                    self.on_ring_change()
-                pm.log.close()
-                if os.path.exists(pm.log.path):
-                    os.replace(pm.log.path, pm.log.path + ".handedoff")
-                self._handoff[p] = {"state": "retired",
-                                    "new_owner": new_owner,
-                                    "event": ev}
+                self._retire_local_copy(p, new_owner, pm)
         except BaseException:
-            # failed transfer: un-drain and keep serving
+            if not install_sent and not prior_intent:
+                # clean failure before anything ever left this node:
+                # un-drain, forget the intent, keep serving
+                with pm._lock:
+                    pm.retired = False
+                self._handoff.pop(p, None)
+                out = dict(self.meta.get("handoff_out") or {})
+                if out.pop(p, None) is not None:
+                    self.meta.put("handoff_out", out)
+                raise
+            # an install push (this attempt's or a journaled earlier
+            # one) may have been applied at the receiver even though we
+            # saw an error (reply lost, link dropped).  Resuming
+            # ownership here would create two live owners with the
+            # in-doubt journal deleted (advisor r04) — resolve by
+            # probing the intended new owner instead, exactly like a
+            # restart does.
+            self._settle_inflight_handoff(p, new_owner, pm)
+            raise
+        return True
+
+    def _retire_local_copy(self, p: int, new_owner,
+                           pm: Optional[PartitionManager]) -> None:
+        """Ownership-transfer epilogue, shared by the cutover success
+        path, the settlement's adopted branch, and restart resolution:
+        pin the stable contribution at the transferred commit
+        watermark VC (every future commit on p happens at the new
+        owner ABOVE the own-DC entry — their clock advances past it
+        at adopt — and their replication gate seeds at the same
+        remote watermarks), re-aim ring + proxy, rebuild the stable
+        plane, announce the ring change, and retire the log file
+        behind the redirect state.  ``pm`` is None when no live local
+        copy exists (restart found the slot already proxied)."""
+        if pm is not None:
+            self._stable_pins[p] = VC(pm.log.max_commit_vc)
+        self.node.ring[p] = new_owner
+        self.node.partitions[p] = RemotePartition(
+            self.link, new_owner, p)
+        self._install_stable_plane(
+            prev_stable=self.plane.get_stable_snapshot())
+        if self.on_ring_change is not None:
+            self.on_ring_change()
+        if pm is not None:
+            with pm._lock:
+                # already set on the cutover path; restart resolution
+                # reaches here with a freshly rebuilt pm
+                pm.retired = True
+            pm.log.close()
+            if os.path.exists(pm.log.path):
+                os.replace(pm.log.path, pm.log.path + ".handedoff")
+        self._handoff[p] = {"state": "retired", "new_owner": new_owner}
+
+    def _settle_inflight_handoff(self, p: int, new_owner, pm) -> None:
+        """A cutover failed after an install push may have reached the
+        receiver.  Probe the intended new owner: the probe runs under
+        the receiver's per-partition install lock and CANCELS any
+        not-yet-applied install, so its answer is a fence, not a
+        snapshot — "not adopted" means no install can land afterwards
+        (a still-executing install either finished before the probe,
+        and the probe reports adoption, or fails on the cancel flag).
+        Adopted -> finish retiring our copy; fenced-not-adopted ->
+        resume serving and forget the intent; unreachable -> the
+        transfer stays in doubt: journal KEPT, partition parked, and
+        restart (or a rebalance retry — handoff_begin re-stages and
+        clears the cancel) resolves it."""
+        adopted = None
+        try:
+            adopted = bool(self.link.request(
+                new_owner, "handoff_probe", (p,)))
+        except Exception:  # noqa: BLE001 — peer down
+            pass
+        if adopted:
+            # adopted there: complete our side of the cutover
+            self._retire_local_copy(p, new_owner, pm)
+            log.warning(
+                "partition %d: install push errored but %r adopted it; "
+                "retired local copy", p, new_owner)
+        elif adopted is False:
+            # fenced: the receiver answered, did not adopt, and can no
+            # longer apply a late install — safe to resume
+            with pm._lock:
+                pm.retired = False
             self._handoff.pop(p, None)
             out = dict(self.meta.get("handoff_out") or {})
             if out.pop(p, None) is not None:
                 self.meta.put("handoff_out", out)
-            raise
-        finally:
-            ev.set()
-        return True
+        else:
+            # unreachable: genuinely in doubt — park, keep the journal
+            self._handoff[p] = {"state": "in_doubt",
+                                "new_owner": new_owner}
+            log.warning(
+                "partition %d: transfer to %r in doubt (peer "
+                "unreachable after install push) — parked until "
+                "resolution", p, new_owner)
 
     def _apply_ring_update(self, ring: Dict[int, Any],
                            members: Dict[Any, Tuple[str, int]],
@@ -694,47 +876,52 @@ class NodeServer:
                       (node.dc_id, dict(ring), dict(self._members)))
 
     def _resume_handoff_out(self) -> None:
-        """Restart with an in-doubt outbound handoff journaled: ask the
-        intended new owner whether it adopted the partition.  If it
-        did (its plan claims ownership), retire our copy; if it
-        answers and did not, resume ownership; if it is unreachable,
-        serve only if our log survived (a renamed log means the
-        transfer got far enough that the new owner may have it — stay
-        retired and warn, operator resolves)."""
+        """Restart with an in-doubt outbound handoff journaled: probe
+        the intended new owner (the probe fences late installs — see
+        _handoff_probe).  Adopted -> retire our copy behind a
+        redirect; fenced-not-adopted -> resume ownership; unreachable
+        -> the transfer stays in doubt — the journal only exists once
+        the install push was attempted, so our surviving log does NOT
+        prove non-adoption (install applied + crash before the rename
+        leaves it intact).  Park the partition rather than risk two
+        live owners; the next restart (or the peer returning before a
+        rebalance retry) resolves it."""
         out = dict(self.meta.get("handoff_out") or {})
         if not out or self.node is None:
             return
         for p, new_owner in list(out.items()):
             p = int(p)
-            log_alive = os.path.exists(self.node._log_path(p)) and \
-                os.path.getsize(self.node._log_path(p)) > 0
-            theirs = None
+            adopted = None
             try:
-                ring_pairs, _members = self.link.request(
-                    new_owner, "ring", None)
-                theirs = {int(q): nid for q, nid in ring_pairs}.get(p)
+                adopted = bool(self.link.request(
+                    new_owner, "handoff_probe", (p,)))
             except Exception:  # noqa: BLE001 — peer down
                 log.warning("handoff resolution: %r unreachable", new_owner)
-            if theirs == new_owner or (theirs is None and not log_alive):
-                # adopted there (or unknowable and our copy is gone):
-                # stay retired behind a redirect
-                self.node.ring[p] = new_owner
-                self.node.partitions[p] = RemotePartition(
-                    self.link, new_owner, p)
-                self._handoff[p] = {"state": "retired",
-                                    "new_owner": new_owner,
-                                    "event": threading.Event()}
-                self._install_stable_plane(
-                    prev_stable=self.plane.get_stable_snapshot())
-                if theirs is None:
-                    log.warning(
-                        "partition %d: transfer to %r in doubt and "
-                        "local log already renamed — staying retired",
-                        p, new_owner)
-            else:
-                # not adopted: resume ownership, forget the intent
+            if adopted:
+                # adopted there: stay retired behind a redirect (and
+                # close + rename any surviving local log — the crash
+                # may have landed before the cutover's rename)
+                pm = self.node.partitions[p]
+                self._retire_local_copy(
+                    p, new_owner,
+                    pm if isinstance(pm, PartitionManager) else None)
+            elif adopted is False:
+                # fenced: no install can land there — resume ownership
                 out.pop(p)
                 self.meta.put("handoff_out", out)
+            else:
+                # unreachable: park in doubt, keep the journal
+                pm = self.node.partitions[p] \
+                    if p < len(self.node.partitions) else None
+                if isinstance(pm, PartitionManager):
+                    with pm._lock:
+                        pm.retired = True
+                self._handoff[p] = {"state": "in_doubt",
+                                    "new_owner": new_owner}
+                log.warning(
+                    "partition %d: transfer to %r in doubt (peer "
+                    "unreachable at restart) — parked until "
+                    "resolution", p, new_owner)
 
     def add_member(self, node_id, addr: Tuple[str, int]) -> None:
         """Admit a running, empty NodeServer into this live cluster as

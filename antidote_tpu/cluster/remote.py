@@ -13,6 +13,7 @@ behave identically local and remote.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from antidote_tpu.clocks import VC
@@ -26,6 +27,15 @@ class WrongOwner(RuntimeError):
     """The partition moved to another node (cross-node handoff): the
     caller refreshes its routing and retries — riak_core's forwarding
     window after an ownership transfer."""
+
+
+class HandoffParked(RuntimeError):
+    """The partition is draining for a cutover and new mutating work is
+    momentarily refused.  Retryable: the CALLER backs off and re-sends
+    (this proxy does so transparently) — refusing instead of parking
+    the request server-side keeps the fabric's worker threads free to
+    serve the commit/abort traffic the drain is waiting on (advisor
+    r04: a blocked-worker park could starve the drain under load)."""
 
 
 #: PartitionManager methods a peer may invoke — the vnode command set
@@ -53,19 +63,36 @@ class RemotePartition:
         self.owner = owner_node
         self.partition = partition
 
+    #: client-side backoff while the owner drains for a cutover; the
+    #: window is normally a few ms, the deadline mirrors the server's
+    #: old 30 s park bound
+    _PARK_RETRY_S = 0.005
+    _PARK_DEADLINE_S = 30.0
+
     def _call(self, method: str, *args, **kwargs):
-        try:
-            return self.link.request(
-                self.owner, "part",
-                (self.partition, method, tuple(args), dict(kwargs)))
-        except WrongOwner:
-            # the partition moved (cross-node handoff): learn the new
-            # ring from the node that redirected us, re-aim, retry once
-            # — riak_core's request forwarding after ownership transfer
-            self.refresh_owner()
-            return self.link.request(
-                self.owner, "part",
-                (self.partition, method, tuple(args), dict(kwargs)))
+        payload = (self.partition, method, tuple(args), dict(kwargs))
+        deadline = None
+        redirected = False
+        while True:
+            try:
+                return self.link.request(self.owner, "part", payload)
+            except WrongOwner:
+                if redirected:
+                    raise  # one refresh per call: a ping-pong ring is a bug
+                # the partition moved (cross-node handoff): learn the
+                # new ring from the node that redirected us, re-aim,
+                # retry — riak_core's forwarding after a transfer
+                self.refresh_owner()
+                redirected = True
+            except HandoffParked:
+                # drain window: back off client-side and re-send (the
+                # server refuses rather than parking a worker thread)
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + self._PARK_DEADLINE_S
+                elif now > deadline:
+                    raise
+                time.sleep(self._PARK_RETRY_S)
 
     def refresh_owner(self) -> None:
         """Re-resolve this slot's owner from the redirecting node's

@@ -101,6 +101,13 @@ class Config:
     #: still flush inline).  Committers flush inline past 4x the
     #: threshold (backpressure).
     device_async_flush: bool = True
+    #: per-process interpreter tuning (GC freeze + thresholds, GIL
+    #: switch interval — antidote_tpu/runtime.py) applied when a
+    #: NodeServer starts.  Default on: a node process's main duty is
+    #: serving.  Turn OFF when EMBEDDING a node in an application
+    #: whose own GC/scheduling behavior must not change (the tuning
+    #: mutates process-global state).
+    tune_process: bool = True
     #: partition -> chip placement over jax.devices(): "ring" commits
     #: partition p's plane state to chip p % n_devices (the ring as
     #: the live data plane across a host's chips); "none" keeps the

@@ -30,6 +30,7 @@ import numpy as np
 
 from antidote_tpu.clocks import VC
 from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.txn.manager import PartitionRetired
 
 
 class DependencyGate:
@@ -176,7 +177,16 @@ class DependencyGate:
                         continue
                     deps = VC(txn.snapshot_vc).set_dc(origin, 0)
                     if self.partition_vc().ge(deps):
-                        self._apply(txn)
+                        try:
+                            self._apply(txn)
+                        except PartitionRetired:
+                            # the slice is mid-handoff (cutover set the
+                            # retired flag before the ring re-aim): stop
+                            # this pass with the txn still queued — the
+                            # new owner's sub-buffers resume at the
+                            # transferred opid counters, so nothing is
+                            # lost when refresh_ring drops this gate
+                            return advanced
                         q.popleft()
                         progress = advanced = True
                     else:
@@ -270,7 +280,14 @@ class DependencyGate:
             if txn.is_ping():
                 self._advance(origin, txn.timestamp)
             else:
-                self._apply(txn)
+                try:
+                    self._apply(txn)
+                except PartitionRetired:
+                    # mid-handoff (see _process_host): re-queue and
+                    # stop WITHOUT folding the fixpoint clock — the
+                    # fold would cover the unapplied remainder
+                    q.appendleft(txn)
+                    return advanced
             advanced = True
         # fold the kernel's final clock back AFTER the replay (it
         # includes the blocked-head ts-1 advances; advancing before the

@@ -163,7 +163,15 @@ def _value_resp(tname: str, value) -> "cpb.ApbReadObjectResp":
     kind = _VALUE_KIND.get(tname)
     if kind == "counter":
         v = int(value)
-        resp.counter.value = max(-(1 << 31), min(v, (1 << 31) - 1))
+        if not -(1 << 31) <= v <= (1 << 31) - 1:
+            # the upstream schema carries counters as sint32; a
+            # silently saturated value would be WRONG data on the
+            # client — refuse loudly instead (the server converts
+            # this to an ApbErrorResp)
+            raise ValueError(
+                f"counter value {v} exceeds the compat protocol's "
+                f"sint32 range; read it over the native protocol")
+        resp.counter.value = v
     elif kind == "set":
         resp.set.value.extend(
             bytes(e) if isinstance(e, (bytes, bytearray))

@@ -40,6 +40,15 @@ def _is_wrong_owner(exc) -> bool:
     return isinstance(exc, WrongOwner)
 
 
+def _is_retryable_route(exc) -> bool:
+    """Errors the synchronous proxy path self-heals: a moved partition
+    (re-resolve the ring) or a drain-window refusal (back off and
+    re-send) — both transient routing states, not txn outcomes."""
+    from antidote_tpu.cluster.remote import HandoffParked, WrongOwner
+
+    return isinstance(exc, (WrongOwner, HandoffParked))
+
+
 class TxnState(Enum):
     ACTIVE = "active"
     COMMITTED = "committed"
@@ -164,10 +173,11 @@ def _fan_out(pairs, fn, spec=None):
                 handles, link.finish_many([h for _i, h in handles])):
             if ok:
                 results[i] = val
-            elif _is_wrong_owner(val):
-                # the partition moved mid-round (cross-node handoff):
-                # the synchronous path re-resolves the owner and
-                # retries (RemotePartition._call self-heals)
+            elif _is_retryable_route(val):
+                # the partition moved or is draining mid-round
+                # (cross-node handoff): the synchronous path
+                # re-resolves / backs off and retries
+                # (RemotePartition._call self-heals)
                 try:
                     results[i] = fn(pairs[i][0], pairs[i][1])
                 except BaseException as e:  # noqa: BLE001 — below

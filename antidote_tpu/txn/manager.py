@@ -35,6 +35,15 @@ class CertificationError(Exception):
     """Write-write certification failed — transaction must abort."""
 
 
+class PartitionRetired(Exception):
+    """The partition's log was snapshot for a cross-node handoff; no
+    further mutation may land here.  Raised under the partition lock by
+    every mutating entry point once the handoff cutover set
+    ``retired`` — the cluster RPC layer converts it to a typed
+    wrong-owner redirect (the riak_core forwarding that follows a
+    handoff, reference src/logging_vnode.erl:781-812)."""
+
+
 class DeviceFlusher:
     """One background thread draining scheduled device flush/GC jobs —
     group commit for the data plane: the committing transaction only
@@ -171,6 +180,13 @@ class PartitionManager:
         self._stable_cache = VC()
         self._stable_cached_at = 0.0
         self._lock = threading.Condition()
+        #: set (under self._lock) by the handoff cutover at the moment
+        #: the final log tail is snapshot: appends require self._lock,
+        #: so checking this flag in the same critical section as the
+        #: append makes "record lands after the tail snapshot"
+        #: impossible — the in-flight mutator that raced the drain gets
+        #: PartitionRetired instead of a silent ack
+        self.retired = False
         #: txid -> (prepare_time, [keys])
         self.prepared: Dict[Any, Tuple[int, List[Any]]] = {}
         #: key -> last committed time at this DC
@@ -226,10 +242,17 @@ class PartitionManager:
 
     # ------------------------------------------------------------ updates
 
+    def _mutate_check(self) -> None:
+        """Must run under self._lock, before any log append."""
+        if self.retired:
+            raise PartitionRetired(
+                f"partition {self.partition} handed off")
+
     def stage_update(self, txid, key, type_name: str, effect) -> None:
         """Log the update record and stage it for commit (the reference's
         async append + FSM ack path, src/clocksi_interactive_coord.erl:1029-1038)."""
         with self._lock:
+            self._mutate_check()
             self.log.append_update(self.dc_id, txid, key, type_name, effect)
             self._staged.setdefault(txid, []).append((key, type_name, effect))
 
@@ -277,6 +300,7 @@ class PartitionManager:
         resolved to effects first (owner-side downstream generation)."""
         ops = self._resolve_raw_ops(txid, ops, snapshot_vc)
         with self._lock:
+            self._mutate_check()
             staged = self._staged.setdefault(txid, [])
             for key, type_name, effect in ops:
                 self.log.append_update(self.dc_id, txid, key, type_name,
@@ -321,6 +345,7 @@ class PartitionManager:
     def prepare(self, txid, snapshot_vc: VC, certify: bool = True) -> int:
         """Certify + log a prepare record; returns the prepare time."""
         with self._lock:
+            self._mutate_check()
             keys = [k for k, _t, _e in self._staged.get(txid, [])]
             if certify:
                 self.certify(txid, keys, snapshot_vc)
@@ -474,6 +499,7 @@ class PartitionManager:
         update_materializer :634-657)."""
         stable = self._stable_for_gc()  # before the lock (see __init__)
         with self._lock:
+            self._mutate_check()
             self.log.append_commit(self.dc_id, txid, commit_time,
                                    snapshot_vc, certified)
             pre_hosted = self._pre_hosted()
@@ -497,6 +523,7 @@ class PartitionManager:
         """One-partition fast path: prepare + commit in one step
         (reference single_commit, src/clocksi_vnode.erl:180-190)."""
         with self._lock:
+            self._mutate_check()
             keys = [k for k, _t, _e in self._staged.get(txid, [])]
             if certify:
                 self.certify(txid, keys, snapshot_vc)
@@ -507,6 +534,7 @@ class PartitionManager:
 
     def abort(self, txid) -> None:
         with self._lock:
+            self._mutate_check()
             if txid in self._staged or txid in self.prepared:
                 self.log.append_abort(self.dc_id, txid)
             self._staged.pop(txid, None)
@@ -528,6 +556,7 @@ class PartitionManager:
         certified = all(commit_certified(rec.payload) for rec in records
                         if rec.kind() == "commit")
         with self._lock:
+            self._mutate_check()
             self.log.append_remote_group(records)
             pre_hosted = self._pre_hosted()
             for rec in records:

@@ -280,8 +280,15 @@ def _config_extras(quick_cpu: bool) -> dict:
         out["txn_p50_ms"] = cfg6["detail"].get("p50_ms")
         out["txn_p99_ms"] = cfg6["detail"].get("p99_ms")
         out["txn_pb_per_sec"] = cfg6["detail"].get("pb_txn_per_sec")
+        out["txn_pb_starved"] = cfg6["detail"].get("pb_starved")
         out["txn_cluster_per_sec"] = cfg6["detail"].get(
             "cluster_txn_per_sec")
+        # topology honesty (round-4 verdict): the driver line must say
+        # how many cores backed the serving rows, and must carry the
+        # scale-out ratio (or the starved marker explaining its absence)
+        out["cpu_count"] = cfg6["detail"].get("cpu_count")
+        out["cluster_starved"] = cfg6["detail"].get("cluster_starved")
+        out["cluster_scaling"] = cfg6["detail"].get("cluster_scaling")
     except Exception as e:
         out["txn_error"] = repr(e)
     # configs 1/3/4 quick, on the bench platform (hardware when the

@@ -263,20 +263,27 @@ def main():
         db.close()
         # client fleet sized to the machine: on a multi-core bench host
         # the fixed fleet saturates the data plane; on a starved box the
-        # numbers stay honest instead of measuring OS time-slicing
+        # serving-topology rows are SKIPPED outright — N server + M
+        # client processes on fewer cores measure OS time-slicing, not
+        # the framework (round-4 verdict: a 1-core box recorded
+        # 469/386 txn/s artifacts that cost real signal)
         cores = os.cpu_count() or 1
         n_nodes = 4 if not quick else 2
         n_clients = max(2, min(4, cores // 2)) if quick else \
             max(4, min(8, cores - n_nodes))
         cl_threads = 2 if cores < 4 else 4
-        cluster_tput, cluster_aborts = run_cluster(
-            n_nodes, txns_per_client=txns, K=K, tmp=tmp,
-            n_clients=n_clients, threads=cl_threads)
-        # data-plane scaling: same fleet against ONE data node (the
-        # VERDICT scale-out metric is the 1->N ratio)
-        cluster_tput_1, _ = run_cluster(
-            1, txns_per_client=max(txns // 2, 100), K=K, tmp=tmp + "1",
-            n_clients=n_clients, threads=cl_threads)
+        cluster_starved = cores < n_nodes + n_clients
+        if cluster_starved:
+            cluster_tput = cluster_tput_1 = cluster_aborts = None
+        else:
+            cluster_tput, cluster_aborts = run_cluster(
+                n_nodes, txns_per_client=txns, K=K, tmp=tmp,
+                n_clients=n_clients, threads=cl_threads)
+            # data-plane scaling: same fleet against ONE data node (the
+            # VERDICT scale-out metric is the 1->N ratio)
+            cluster_tput_1, _ = run_cluster(
+                1, txns_per_client=max(txns // 2, 100), K=K,
+                tmp=tmp + "1", n_clients=n_clients, threads=cl_threads)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -286,18 +293,29 @@ def main():
          p50_ms=p50, p99_ms=p99,
          single_thread_txn_per_sec=round(tput_1),
          pb_txn_per_sec=round(pb_tput), pb_p50_ms=pb50, pb_p99_ms=pb99,
+         # the pb row runs 8 client threads + the server in ONE
+         # process: on a single core it measures serialized dispatch,
+         # not concurrency — flagged so nobody reads it as serving
+         # throughput (round-4 verdict)
+         pb_starved=cores < 2,
          pb_abort_rate=round(
              pb_aborts / max(pb_aborts + len(pb_lat), 1), 4),
-         cluster_txn_per_sec=round(cluster_tput),
+         cluster_txn_per_sec=(round(cluster_tput)
+                              if cluster_tput is not None else None),
+         cluster_starved=cluster_starved,
          cluster_nodes=n_nodes,
          cluster_clients=n_clients,
          cluster_client_threads=cl_threads,
-         cluster_txn_per_sec_1node=round(cluster_tput_1),
-         cluster_scaling=round(cluster_tput / max(cluster_tput_1, 1), 2),
+         cluster_txn_per_sec_1node=(round(cluster_tput_1)
+                                    if cluster_tput_1 is not None
+                                    else None),
+         cluster_scaling=(round(cluster_tput / max(cluster_tput_1, 1), 2)
+                          if cluster_tput is not None else None),
          cpu_count=cores,
-         cluster_abort_rate=round(
+         cluster_abort_rate=(round(
              # each CLIENT process makes exactly `txns` attempts
-             cluster_aborts / max(n_clients * txns, 1), 4),
+             cluster_aborts / max(n_clients * txns, 1), 4)
+             if cluster_aborts is not None else None),
          abort_rate=round(aborts / max(aborts + len(lat), 1), 4),
          mix="80% update (1r+2w), 20% read (3r); pb variant static",
          note="vs_baseline = thread-scaling factor (8 clients vs 1)")

@@ -255,3 +255,222 @@ def test_former_owner_restart_honors_transfer(tmp_path):
     finally:
         for srv in servers + [extra]:
             srv.close()
+
+
+# --------------------------------------------------------------------------
+# cutover failure modes (advisor r04: TOCTOU + double-owner on install loss)
+
+
+def test_racing_mutator_is_redirected_not_silently_lost(tmp_path):
+    """A mutating call that slipped past the drain park before the
+    cutover set it (the TOCTOU window) hits the retired flag UNDER the
+    partition lock and raises, instead of appending after the tail
+    snapshot and being silently dropped with the log."""
+    from antidote_tpu.txn.manager import PartitionRetired
+
+    servers = [
+        NodeServer(f"t{i}", data_dir=str(tmp_path / f"t{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    extra = NodeServer("t2", data_dir=str(tmp_path / "t2"),
+                       config=_cfg())
+    try:
+        create_dc_cluster("dc1", 8, servers, clients=[extra])
+        pm_old = servers[0].node.partitions[0]
+        assert isinstance(pm_old, PartitionManager)
+        new_ring = dict(servers[0].node.ring)
+        new_ring[0] = "t2"
+        servers[0].rebalance(new_ring)
+        # the stale pm reference a racing worker thread would hold:
+        # every mutating entry point refuses under the lock
+        with pytest.raises(PartitionRetired):
+            pm_old.stage_update(("tx", 1), 0, "counter_pn", 1)
+        with pytest.raises(PartitionRetired):
+            pm_old.stage_group(("tx", 2), [(0, "counter_pn", 1)])
+        from antidote_tpu.clocks import VC
+
+        with pytest.raises(PartitionRetired):
+            pm_old.prepare(("tx", 3), VC())
+    finally:
+        for srv in servers + [extra]:
+            srv.close()
+
+
+def _two_plus_receiver(tmp_path, tag):
+    servers = [
+        NodeServer(f"{tag}{i}", data_dir=str(tmp_path / f"{tag}{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    recv = NodeServer(f"{tag}2", data_dir=str(tmp_path / f"{tag}2"),
+                      config=_cfg())
+    create_dc_cluster("dc1", 8, servers, clients=[recv])
+    api = servers[0].api
+    tx = api.start_transaction()
+    api.update_objects([((0, "counter_pn", "b"), "increment", 9)], tx)
+    api.commit_transaction(tx)
+    assert servers[0].node.ring[0] == f"{tag}0"
+    return servers, recv
+
+
+def test_install_applied_but_reply_lost_retires_old_owner(tmp_path):
+    """The receiver adopts the partition but its reply is 'lost' (the
+    install handler raises after applying): the old owner must NOT
+    resume serving — it queries the intended owner, sees the adoption,
+    and retires.  One live owner, journal kept for the re-plan."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers, recv = _two_plus_receiver(tmp_path, "a")
+    try:
+        orig = recv._handoff_install
+
+        def applied_but_reply_lost(p, base_offset, tail):
+            orig(p, base_offset, tail)
+            raise RemoteCallError("injected: reply lost")
+
+        recv._handoff_install = applied_but_reply_lost
+        cursor = servers[0]._rpc("a2", "handoff_begin", (0, "a0"))
+        with pytest.raises(RemoteCallError):
+            servers[0]._rpc("a0", "handoff_cutover", (0, "a2", cursor))
+
+        # exactly one live owner: the receiver
+        assert isinstance(servers[0].node.partitions[0], RemotePartition)
+        assert servers[0]._handoff[0]["state"] == "retired"
+        assert isinstance(recv.node.partitions[0], PartitionManager)
+        # the in-doubt journal survives until the global re-plan
+        assert servers[0].meta.get("handoff_out") == {0: "a2"}
+        # history is served (through the old owner's redirect too)
+        tx = servers[0].api.start_transaction()
+        assert servers[0].api.read_objects(
+            [(0, "counter_pn", "b")], tx) == [9]
+        servers[0].api.commit_transaction(tx)
+    finally:
+        for srv in servers + [recv]:
+            srv.close()
+
+
+def test_install_never_applied_resumes_ownership(tmp_path):
+    """The install fails cleanly before the receiver applies anything:
+    the old owner confirms non-adoption via the ring query, resumes
+    serving, and forgets the intent."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers, recv = _two_plus_receiver(tmp_path, "b")
+    try:
+        def never_applied(p, base_offset, tail):
+            raise RemoteCallError("injected: install refused")
+
+        recv._handoff_install = never_applied
+        cursor = servers[0]._rpc("b2", "handoff_begin", (0, "b0"))
+        with pytest.raises(RemoteCallError):
+            servers[0]._rpc("b0", "handoff_cutover", (0, "b2", cursor))
+
+        pm = servers[0].node.partitions[0]
+        assert isinstance(pm, PartitionManager)
+        assert pm.retired is False
+        assert 0 not in servers[0]._handoff
+        assert not (servers[0].meta.get("handoff_out") or {})
+        # still serving writes
+        tx = servers[0].api.start_transaction()
+        servers[0].api.update_objects(
+            [((0, "counter_pn", "b"), "increment", 1)], tx)
+        cvc = servers[0].api.commit_transaction(tx)
+        tx = servers[0].api.start_transaction(clock=cvc)
+        assert servers[0].api.read_objects(
+            [(0, "counter_pn", "b")], tx) == [10]
+        servers[0].api.commit_transaction(tx)
+    finally:
+        for srv in servers + [recv]:
+            srv.close()
+
+
+def test_install_in_doubt_parks_then_retry_resolves(tmp_path):
+    """Install push fails AND the receiver is unreachable for the
+    resolution query: the partition parks in doubt (no write on either
+    side, journal kept) instead of resuming into a potential
+    double-owner; a later retry (receiver back) completes the move."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+    from antidote_tpu.txn.manager import PartitionRetired
+
+    servers, recv = _two_plus_receiver(tmp_path, "c")
+    try:
+        def never_applied(p, base_offset, tail):
+            raise RemoteCallError("injected: link dropped")
+
+        recv._handoff_install = never_applied
+        orig_req = servers[0].link.request
+
+        def peer_gone(target, kind, payload):
+            if target == "c2" and kind == "handoff_probe":
+                raise ConnectionError("injected: peer gone")
+            return orig_req(target, kind, payload)
+
+        servers[0].link.request = peer_gone
+        cursor = servers[0]._rpc("c2", "handoff_begin", (0, "c0"))
+        with pytest.raises(RemoteCallError):
+            servers[0]._rpc("c0", "handoff_cutover", (0, "c2", cursor))
+
+        assert servers[0]._handoff[0]["state"] == "in_doubt"
+        assert servers[0].meta.get("handoff_out") == {0: "c2"}
+        pm = servers[0].node.partitions[0]
+        assert isinstance(pm, PartitionManager)
+        with pytest.raises(PartitionRetired):
+            pm.stage_update(("tx", 9), 0, "counter_pn", 1)
+
+        # receiver returns: the retry finishes the transfer
+        servers[0].link.request = orig_req
+        del recv._handoff_install  # restore the real bound method
+        servers[0]._rpc("c0", "handoff_cutover", (0, "c2", cursor))
+        assert servers[0]._handoff[0]["state"] == "retired"
+        assert isinstance(recv.node.partitions[0], PartitionManager)
+        tx = recv.api.start_transaction()
+        assert recv.api.read_objects(
+            [(0, "counter_pn", "b")], tx) == [9]
+        recv.api.commit_transaction(tx)
+    finally:
+        for srv in servers + [recv]:
+            srv.close()
+
+
+def test_restart_with_receiver_down_parks_in_doubt(tmp_path):
+    """Old owner crashes after the cutover, restarts while the receiver
+    is DOWN: the journaled transfer cannot be resolved, so the
+    partition parks in doubt (it must neither serve — possible double
+    owner — nor crash recovery)."""
+    from antidote_tpu.txn.manager import PartitionRetired
+
+    servers = [
+        NodeServer(f"d{i}", data_dir=str(tmp_path / f"d{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    extra = NodeServer("d2", data_dir=str(tmp_path / "d2"),
+                       config=_cfg())
+    try:
+        create_dc_cluster("dc1", 8, servers, clients=[extra])
+        api = servers[0].api
+        tx = api.start_transaction()
+        api.update_objects([((0, "counter_pn", "b"), "increment", 3)],
+                           tx)
+        api.commit_transaction(tx)
+        cursor = servers[0]._rpc("d2", "handoff_begin", (0, "d0"))
+        servers[0]._rpc("d0", "handoff_cutover", (0, "d2", cursor))
+        servers[0].close()
+        extra.close()  # receiver gone before the old owner restarts
+
+        d0b = NodeServer("d0", data_dir=str(tmp_path / "d0"),
+                         config=_cfg())
+        try:
+            assert d0b._handoff[0]["state"] == "in_doubt"
+            assert d0b.meta.get("handoff_out") == {0: "d2"}
+            pm = d0b.node.partitions[0]
+            if isinstance(pm, PartitionManager):
+                with pytest.raises(PartitionRetired):
+                    pm.stage_update(("tx", 1), 0, "counter_pn", 1)
+        finally:
+            d0b.close()
+        servers = servers[1:]
+    finally:
+        for srv in servers:
+            srv.close()
