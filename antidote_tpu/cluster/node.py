@@ -137,7 +137,35 @@ class ClusterNode(Node):
         raise RuntimeError(
             "repartition of a multi-node DC is a cluster-level plan "
             "(every member folds its slice against the new ring); "
-            "resize single-node DCs or re-plan the cluster instead")
+            "use NodeServer.resize_cluster, or resize single-node DCs "
+            "directly")
+
+    def build_resize_fold(self, new_n: int, own_slot=None):
+        """LiveFold over THIS member's ring slice only.  ``own_slot``
+        is not accepted here — the slice IS the filter, and silently
+        substituting it for a caller's would stage the wrong slots.
+        Restricted to
+        integer growth factors: with new_n = m * old_n the key routing
+        satisfies q ≡ p (mod old_n) for every key of old partition p
+        (k % new_n ≡ k % old_n mod old_n, crc32 alike), so each
+        partition splits IN PLACE into m children on its current owner
+        and no data crosses nodes during the resize — ownership moves
+        afterwards with the ordinary rebalance/handoff (the riak_core
+        plan/claim separation, reference
+        src/antidote_dc_manager.erl:53-81)."""
+        if own_slot is not None:
+            raise ValueError(
+                "a ClusterNode's fold slice is its ring slice; "
+                "own_slot cannot be overridden")
+        old_n = self.config.n_partitions
+        if new_n % old_n:
+            raise ValueError(
+                f"multi-node resize must grow by an integer factor "
+                f"({old_n} -> {new_n}); children of a partition must "
+                f"stay on its owner")
+        return super().build_resize_fold(
+            new_n,
+            own_slot=lambda q: self.ring[q % old_n] == self.node_id)
 
 
 class ClusterStablePlane:
@@ -262,12 +290,50 @@ class NodeServer:
         #: cutover, re-plan) — the federation layer re-wires its
         #: per-partition senders/gates/sub-buffers here
         self.on_ring_change: Optional[Callable[[], None]] = None
+        #: cluster-resize state: the LiveFold built by resize_prepare
+        #: (consumed by resize_commit) and the parking flag that
+        #: refuses part RPCs while this member's width is mid-change
+        self._resize_fold = None
+        self._resize_parking = False
         plan = self.meta.get("cluster_plan")
         if plan is not None:
-            # restart: reload the committed plan and re-join (reference
+            # restart: a node-level resize journal means this member
+            # was killed between its fold swap and the plan persist —
+            # the journaled width wins; expand the plan's ring to it
+            # (children inherit their parent's owner) before assembly
+            plan = self._reconcile_resized_plan(plan)
+            # reload the committed plan and re-join (reference
             # check_node_restart, src/inter_dc_manager.erl:156-201)
             self._assemble(*plan)
             self._resume_handoff_out()
+            if self.meta.get("cluster_resize") is not None:
+                # killed mid-cluster-resize: come back FROZEN (parked)
+                # — serving at this member's width while peers may
+                # hold another would split key routing; the driver's
+                # resize_cluster re-run finishes and unfreezes
+                self._resize_parking = True
+                self.node.txn_gate.freeze()
+                log.warning(
+                    "%r restarted mid-cluster-resize: parked until the "
+                    "resize is re-driven to completion", node_id)
+
+    def _reconcile_resized_plan(self, plan):
+        from antidote_tpu.txn.node import (
+            read_resize_journal,
+            resize_journal_path,
+        )
+
+        dc_id, ring, members = plan
+        parsed = read_resize_journal(
+            resize_journal_path(self.data_dir, dc_id))
+        if parsed is None:
+            return plan
+        old_n, new_n = parsed
+        if len(ring) == old_n:
+            ring = {q: ring[q % old_n] for q in range(new_n)}
+            plan = (dc_id, ring, members)
+            self.meta.put("cluster_plan", plan)
+        return plan
 
     # ------------------------------------------------------------ lifecycle
 
@@ -362,8 +428,25 @@ class NodeServer:
         node = self.node
         dc_id = node.dc_id
         local_idx = node.local_partition_indices()
-        tracker = StableTimeTracker(
-            dc_id, len(local_idx) + len(self._stable_pins))
+        # under ring placement the local fold itself is a device
+        # collective: each local row sits on its partition's GLOBAL
+        # ring chip (meta/device_stable.py); pinned rows ride the same
+        # mapping.  Cross-node stays gossip — on a multi-host pod the
+        # mesh spans the hosts and the collective spans the DC.
+        from antidote_tpu.meta.device_stable import make_stable_tracker
+
+        placement = None
+        if node.config.device_placement == "ring":
+            import jax
+
+            n_devs = len(jax.devices())
+            if n_devs > 1:
+                placement = [p % n_devs for p in local_idx] + [
+                    p % n_devs for p in sorted(self._stable_pins)]
+        tracker = make_stable_tracker(
+            node.config, dc_id,
+            len(local_idx) + len(self._stable_pins),
+            placement=placement)
 
         def _default_source(p):
             pm = node.partitions[p]
@@ -450,34 +533,27 @@ class NodeServer:
         if kind == "part":
             if self.node is None:
                 raise RemoteCallError("node not assembled yet")
+            if self._resize_parking:
+                # this member's partition WIDTH is mid-change: a peer
+                # still routing with the old width would land keys on
+                # the wrong partition — refuse retryably until the
+                # resize finishes cluster-wide
+                from antidote_tpu.cluster.remote import HandoffParked
+
+                raise HandoffParked(
+                    f"cluster resize in progress at {self.node_id!r}")
             p, method, args, kwargs = payload
             if method not in PARTITION_METHODS:
                 raise RemoteCallError(f"method {method!r} not allowed")
             st = self._handoff.get(p)
-            if st is not None:
-                if st["state"] == "drain" and method in _HANDOFF_PARKED:
-                    # new mutating work is refused with a RETRYABLE
-                    # error for the (short) cutover window — the proxy
-                    # backs off and re-sends.  Refusing instead of
-                    # parking keeps every fabric worker free for the
-                    # reads and the commit/abort traffic the drain
-                    # itself is waiting on (advisor r04: parked
-                    # workers could starve the drain).
-                    from antidote_tpu.cluster.remote import HandoffParked
-
-                    raise HandoffParked(
-                        f"partition {p} draining for handoff to "
-                        f"{st['new_owner']!r}")
-                if st is not None and st["state"] == "retired":
-                    from antidote_tpu.cluster.remote import WrongOwner
-
-                    raise WrongOwner(
-                        f"partition {p} moved to "
-                        f"{st['new_owner']!r}")
-                if st is not None and st["state"] == "in_doubt":
-                    raise RemoteCallError(
-                        f"partition {p} ownership in doubt "
-                        f"(transfer to {st['new_owner']!r} unresolved)")
+            if st is not None and (st["state"] != "drain"
+                                   or method in _HANDOFF_PARKED):
+                # mutating work during a drain is refused with a
+                # RETRYABLE error — the proxy backs off and re-sends;
+                # refusing instead of parking keeps every fabric
+                # worker free for the reads and commit/abort traffic
+                # the drain itself is waiting on (advisor r04)
+                self._handoff_refusal(p, st)
             pm = self.node.partitions[p]
             if not isinstance(pm, PartitionManager):
                 raise RemoteCallError(
@@ -490,30 +566,8 @@ class NodeServer:
                 # passed the state check above before drain was set,
                 # then hit the retired flag under pm._lock — map by
                 # the CURRENT handoff state instead of silently losing
-                # the append (advisor r04 TOCTOU).  While the cutover
-                # is still draining/in flight the ring still names
-                # this node, so a WrongOwner redirect would dead-end
-                # (refresh_owner finds no new owner); the retryable
-                # refusal keeps the client backing off until the
-                # cutover resolves either way.
-                from antidote_tpu.cluster.remote import (
-                    HandoffParked,
-                    WrongOwner,
-                )
-
-                st = self._handoff.get(p)
-                state = st["state"] if st else None
-                if state == "retired":
-                    raise WrongOwner(
-                        f"partition {p} moved to "
-                        f"{st['new_owner']!r}") from None
-                if state == "in_doubt":
-                    raise RemoteCallError(
-                        f"partition {p} ownership in doubt "
-                        f"(transfer to {st['new_owner']!r} "
-                        f"unresolved)") from None
-                raise HandoffParked(
-                    f"partition {p} draining for handoff") from None
+                # the append (advisor r04 TOCTOU)
+                self._handoff_refusal(p, self._handoff.get(p))
         if kind == "ring":
             if self.node is None:
                 raise RemoteCallError("node not assembled yet")
@@ -560,6 +614,21 @@ class NodeServer:
                 {nid: tuple(addr) for nid, addr in member_pairs},
                 list(clients))
             return True
+        if kind == "resize_prepare":
+            new_n, max_passes, delta_threshold = payload
+            return self._resize_prepare(int(new_n), int(max_passes),
+                                        int(delta_threshold))
+        if kind == "resize_freeze":
+            (new_n,) = payload
+            return self._resize_freeze(int(new_n))
+        if kind == "resize_drain":
+            self.node.txn_gate.wait_idle(timeout=60.0)
+            return True
+        if kind == "resize_commit":
+            (new_n,) = payload
+            return self._resize_commit(int(new_n))
+        if kind == "resize_finish":
+            return self._resize_finish()
         if kind == "status":
             return {
                 "node_id": self.node_id,
@@ -573,6 +642,28 @@ class NodeServer:
         raise RemoteCallError(f"unknown node RPC kind {kind!r}")
 
     # ----------------------------------------------------- cross-node handoff
+
+    def _handoff_refusal(self, p: int, st: Optional[dict]):
+        """Raise the typed refusal for a partition in handoff state
+        ``st`` — shared by the pre-dispatch check and the
+        PartitionRetired race path.  Retired -> WrongOwner redirect;
+        in_doubt -> hard error; draining (or state unknown: the race
+        hit between the retire flag and the state update) -> a
+        retryable backoff, because the ring still names this node
+        until the install completes and a WrongOwner redirect would
+        dead-end in refresh_owner."""
+        from antidote_tpu.cluster.remote import HandoffParked, WrongOwner
+
+        state = st["state"] if st else None
+        if state == "retired":
+            raise WrongOwner(
+                f"partition {p} moved to {st['new_owner']!r}") from None
+        if state == "in_doubt":
+            raise RemoteCallError(
+                f"partition {p} ownership in doubt (transfer to "
+                f"{st['new_owner']!r} unresolved)") from None
+        raise HandoffParked(
+            f"partition {p} draining for handoff") from None
 
     def _rpc(self, target, kind: str, payload):
         """Fabric request, or a direct local dispatch when the target
@@ -599,6 +690,9 @@ class NodeServer:
         while the vnode keeps serving, reference
         src/logging_vnode.erl:781-812).  Returns the staged cursor; the
         final tail arrives pushed by the owner's cutover."""
+        if self.meta.get("cluster_resize") is not None:
+            raise RemoteCallError(
+                "cluster resize in progress; no handoff may start")
         ent = self._handoff_in_entry(p)
         with ent["lock"]:
             # a fresh staging round supersedes any cancel a previous
@@ -696,6 +790,11 @@ class NodeServer:
                 f"partition {p} not owned by {self.node_id!r}")
         if new_owner not in self._members:
             raise RemoteCallError(f"unknown member {new_owner!r}")
+        if self.meta.get("cluster_resize") is not None:
+            # a resize is mid-flight: its fold captured THIS ring; an
+            # ownership move under it would desync the fold's slices
+            raise RemoteCallError(
+                "cluster resize in progress; no cutover may start")
         #: a journal entry from a PREVIOUS attempt means that attempt's
         #: install may have been applied at the receiver — then even a
         #: pre-install failure of THIS attempt must settle by probe,
@@ -768,7 +867,13 @@ class NodeServer:
         plane, announce the ring change, and retire the log file
         behind the redirect state.  ``pm`` is None when no live local
         copy exists (restart found the slot already proxied)."""
-        if pm is not None:
+        if pm is not None and pm.log.max_commit_vc:
+            # an EMPTY max_commit_vc means this pm was rebuilt over a
+            # fresh log after the real history was renamed (restart
+            # after a completed cutover): pinning BOTTOM would freeze
+            # the DC's stable snapshot at zero until the re-plan.  No
+            # pin is needed then — the receiver's clock advanced past
+            # the true watermark at adopt
             self._stable_pins[p] = VC(pm.log.max_commit_vc)
         self.node.ring[p] = new_owner
         self.node.partitions[p] = RemotePartition(
@@ -817,12 +922,17 @@ class NodeServer:
             # longer apply a late install — safe to resume
             with pm._lock:
                 pm.retired = False
+                pm.parked = False
             self._handoff.pop(p, None)
             out = dict(self.meta.get("handoff_out") or {})
             if out.pop(p, None) is not None:
                 self.meta.put("handoff_out", out)
         else:
-            # unreachable: genuinely in doubt — park, keep the journal
+            # unreachable: genuinely in doubt — park WRITES AND READS
+            # (the receiver may have adopted and taken writes), keep
+            # the journal
+            with pm._lock:
+                pm.parked = True
             self._handoff[p] = {"state": "in_doubt",
                                 "new_owner": new_owner}
             log.warning(
@@ -910,12 +1020,18 @@ class NodeServer:
                 out.pop(p)
                 self.meta.put("handoff_out", out)
             else:
-                # unreachable: park in doubt, keep the journal
+                # unreachable: park in doubt, keep the journal.  Reads
+                # park too (pm.parked): the partition object here was
+                # rebuilt over whatever log survived — possibly a
+                # brand-new EMPTY one if the crash landed after the
+                # cutover's rename — so a local read could serve
+                # bottom values for committed keys
                 pm = self.node.partitions[p] \
                     if p < len(self.node.partitions) else None
                 if isinstance(pm, PartitionManager):
                     with pm._lock:
                         pm.retired = True
+                        pm.parked = True
                 self._handoff[p] = {"state": "in_doubt",
                                     "new_owner": new_owner}
                 log.warning(
@@ -979,6 +1095,158 @@ class NodeServer:
         self._apply_ring_update(dict(new_ring), dict(self._members),
                                 clients)
         return dict(new_ring)
+
+    # ------------------------------------- cluster partition-count resize
+
+    def resize_cluster(self, new_n: int, max_passes: int = 6,
+                       delta_threshold: int = 256) -> Dict[int, Any]:
+        """Grow a LIVE multi-node DC's partition count (the riak_core
+        ring-resize the reference's fixed ring cannot do, generalized
+        from the single-node Node.repartition_live).  ``new_n`` must
+        be an integer multiple of the current count: each partition
+        splits IN PLACE into new_n/old_n children on its current owner
+        (no data crosses nodes — see ClusterNode.build_resize_fold);
+        ownership then moves with the ordinary rebalance().
+
+        Protocol (driver = this member):
+        1. prepare  — every data member incrementally folds its slice
+           into staged child logs WHILE SERVING (LiveFold passes).
+        2. freeze   — every member closes its gate to NEW transactions
+           and journals the resize marker (a member restarting
+           mid-resize comes back parked, never serving a width its
+           peers may not share).
+        3. drain    — wait until every member's in-flight transactions
+           completed (their remote 2PC legs still serve: no member has
+           changed width yet, routing stays consistent).
+        4. commit   — each member folds its final delta, swaps logs
+           under the node-level crash journal, adopts the expanded
+           ring at the new width, persists the new plan, and PARKS
+           part RPCs (peers still at the old width must not land
+           wrong-partition records).
+        5. finish   — clear markers, unpark, unfreeze everywhere.
+
+        Crash-resumable and idempotent: a member killed at any point
+        restarts parked (marker) with its journaled width reconciled
+        (_reconcile_resized_plan + Node._resume_interrupted_resize);
+        re-running resize_cluster no-ops the already-resized members
+        and completes the stragglers.  A driver failure leaves the
+        cluster frozen-but-consistent; re-drive to finish.  Refused
+        while federated (partition counts are part of the inter-DC
+        contract — same rule as DataCenter.repartition) or while a
+        handoff is in flight."""
+        if self.node is None:
+            raise RuntimeError("node not assembled yet")
+        if self.source_factory is not None:
+            raise RuntimeError(
+                "resize requires a disconnected DC: drop the "
+                "federation first; every DC resizes separately "
+                "(partition counts are part of the inter-DC contract)")
+        old_n = self.node.config.n_partitions
+        if new_n != old_n and (new_n <= 0 or new_n % old_n):
+            raise ValueError(
+                f"multi-node resize must grow by an integer factor "
+                f"({old_n} -> {new_n})")
+        members = sorted(self._members, key=repr)
+        for m in members:
+            self._rpc(m, "resize_prepare",
+                      (new_n, max_passes, delta_threshold))
+        for m in members:
+            self._rpc(m, "resize_freeze", (new_n,))
+        for m in members:
+            self._rpc(m, "resize_drain", None)
+        for m in members:
+            self._rpc(m, "resize_commit", (new_n,))
+        for m in members:
+            self._rpc(m, "resize_finish", None)
+        return dict(self.node.ring)
+
+    def _resize_prepare(self, new_n: int, max_passes: int,
+                        delta_threshold: int) -> str:
+        node = self.node
+        if node is None:
+            raise RemoteCallError("node not assembled yet")
+        if node.config.n_partitions == new_n:
+            return "done"  # idempotent re-drive after a crash
+        if self._handoff:
+            raise RemoteCallError(
+                "handoff in flight; resolve it before resizing")
+        if not node.config.enable_logging:
+            raise RemoteCallError(
+                "resize folds the durable logs; enable_logging=False "
+                "leaves nothing to redistribute")
+        if self.source_factory is not None:
+            raise RemoteCallError(
+                "member is federated; disconnect before resizing")
+        if self.node_id not in set(node.ring.values()):
+            self._resize_fold = None  # coordinator-only member
+            return "client"
+        self._resize_fold = node.build_resize_fold(new_n)
+        self._resize_fold.serve_passes(max_passes, delta_threshold)
+        return "prepared"
+
+    def _resize_freeze(self, new_n: int) -> bool:
+        self.meta.put("cluster_resize", int(new_n))
+        self.node.txn_gate.freeze()
+        return True
+
+    def _resize_commit(self, new_n: int) -> str:
+        node = self.node
+        old_n = node.config.n_partitions
+        if old_n == new_n:
+            return "done"
+        self._resize_parking = True
+        data_member = self.node_id in set(node.ring.values())
+        new_ring = {q: node.ring[q % old_n] for q in range(new_n)}
+        if data_member:
+            fold = self._resize_fold
+            if fold is None:
+                raise RemoteCallError(
+                    "resize_commit without resize_prepare")
+            fold.final_pass()
+            for pm in node._local_partitions():
+                pm.log.close()
+            journal = node._resize_journal_path()
+            tmp = journal + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{old_n} {new_n}\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, journal)
+            # the new plan persists BEFORE the swap clears the
+            # journal: at every crash point either the journal or the
+            # persisted plan carries the new width (restart reconciles
+            # from whichever survives)
+            self.meta.put("cluster_plan",
+                          (node.dc_id, dict(new_ring),
+                           dict(self._members)))
+            node._complete_resize_swap(old_n, new_n)
+        else:
+            self.meta.put("cluster_plan",
+                          (node.dc_id, dict(new_ring),
+                           dict(self._members)))
+        node.config.n_partitions = new_n
+        node.ring = dict(new_ring)
+        node.partitions = [node._build_partition(q)
+                           for q in range(new_n)]
+        if data_member:
+            # UNCONDITIONAL, like the single-node resize paths:
+            # recover_from_log only governs boot — a mid-session
+            # resize that skipped the replay would serve bottom for
+            # every committed key
+            node._recover_stores()
+        self._resize_fold = None
+        self._install_stable_plane(
+            prev_stable=self.plane.get_stable_snapshot()
+            if self.plane else None)
+        if self.on_ring_change is not None:
+            self.on_ring_change()
+        return "committed"
+
+    def _resize_finish(self) -> bool:
+        self.meta.delete("cluster_resize")
+        self._resize_parking = False
+        self.node.txn_gate.unfreeze()
+        return True
 
     # ------------------------------------------------------------ shutdown
 

@@ -33,7 +33,7 @@ from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
 from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
 from antidote_tpu.interdc.wire import DcDescriptor, InterDcTxn
-from antidote_tpu.meta.gossip import StableTimeTracker
+from antidote_tpu.meta.device_stable import make_stable_tracker
 from antidote_tpu.meta.stable_store import StableMetaData
 from antidote_tpu.txn.node import Node
 
@@ -51,7 +51,9 @@ class DataCenter(AntidoteTPU):
         self.meta = StableMetaData(
             os.path.join(base, f"{dc_id}_meta.pkl"),
             recover=cfg.recover_meta_data_on_start)
-        self.stable = StableTimeTracker(dc_id, cfg.n_partitions)
+        # ring placement over a real mesh: the stable fold is a device
+        # collective, host fold as oracle (meta/device_stable.py)
+        self.stable = make_stable_tracker(cfg, dc_id, cfg.n_partitions)
         #: drop inbound heartbeats (reference inter_dc_manager:drop_ping,
         #: src/inter_dc_manager.erl:254-260 — lets tests age the GST)
         self.drop_ping = False
@@ -146,8 +148,9 @@ class DataCenter(AntidoteTPU):
         with self._rx_lock:
             floor = self.stable.get_stable_snapshot()
             self.node.repartition(new_n)
-            self.stable = StableTimeTracker(
-                self.node.dc_id, self.node.config.n_partitions)
+            self.stable = make_stable_tracker(
+                self.node.config, self.node.dc_id,
+                self.node.config.n_partitions)
             # stability is permanent: the resized tracker keeps the old
             # published floor (same rule as the restart restore above)
             self.stable.seed_floor(floor)

@@ -71,6 +71,63 @@ def _bucket(n: int) -> int:
     return b
 
 
+#: read-fold dispatch counter (tests assert the fused cross-partition
+#: path issues <= n_devices programs per multi-partition read)
+_read_dispatches = 0
+
+
+def count_read_dispatch() -> None:
+    global _read_dispatches
+    _read_dispatches += 1
+
+
+def read_dispatch_count() -> int:
+    return _read_dispatches
+
+
+#: one compiled program per CANONICALIZED combination of fused store
+#: calls (entries sorted by function, so access order doesn't mint new
+#: programs); jax's own cache handles per-shape specialization under
+#: each entry.  Any k same-type planes share one entry regardless of
+#: which partitions they are.  Bounded: a pattern explosion clears the
+#: table rather than growing it forever (the jit objects are cheap to
+#: rebuild; the underlying executables live in jax's own cache).
+_FUSED_CACHE: Dict[tuple, Any] = {}
+_FUSED_CACHE_CAP = 64
+
+
+def fused_read(splits: list) -> list:
+    """Run many planes' batched read folds as ONE XLA program — the
+    cross-partition read for a ring-placed node: all captures must sit
+    on one chip; the caller groups by ``closure.device`` (reference:
+    the coordinator's async batched reads,
+    src/clocksi_interactive_coord.erl:731-747, lifted from
+    per-partition to per-chip).  ``splits`` are the ``closure.split``
+    pairs; returns their post-processed {key: state} dicts in order."""
+    # canonical order: same multiset of store calls -> same program
+    order = sorted(range(len(splits)),
+                   key=lambda i: splits[i][0][0].__name__)
+    fns = tuple(splits[i][0][0] for i in order)
+    fn = _FUSED_CACHE.get(fns)
+    if fn is None:
+        if len(_FUSED_CACHE) >= _FUSED_CACHE_CAP:
+            _FUSED_CACHE.clear()
+
+        def body(argss, _fns=fns):
+            return tuple(f(*a) for f, a in zip(_fns, argss))
+
+        fn = jax.jit(body)
+        _FUSED_CACHE[fns] = fn
+    count_read_dispatch()
+    outs = fn(tuple(splits[i][0][1] for i in order))
+    results: list = [None] * len(splits)
+    for pos, i in enumerate(order):
+        post = splits[i][1]
+        results[i] = post(
+            jax.tree_util.tree_map(np.asarray, outs[pos]))
+    return results
+
+
 class ReadBelowBase(Exception):
     """Read snapshot does not dominate the device base — serve from log."""
 
@@ -236,6 +293,64 @@ class _PlaneBase:
         _WARM_THREADS.append(t)
         t.start()
 
+    def warm_reads(self, buckets: tuple = (1, 64)) -> None:
+        """Background-compile this plane's READ fold at the CURRENT
+        state shapes.  The first read after a capacity growth
+        recompiles the fold on whatever client thread issued it —
+        measured 0.35-1 s inline (the dominant config6 p99 spike
+        together with the growth itself); warming runs it on a copy in
+        a compile thread instead.  Buckets cover the single-key reader
+        (shape 1) and the first batched-dispatch bucket."""
+        shapes = tuple(
+            (tuple(x.shape), str(getattr(x, "dtype", "")))
+            for x in jax.tree_util.tree_leaves(self.st))
+        base_key = ("read", id(type(self)), shapes)
+        todo = []
+        with _WARM_LOCK:
+            for b in buckets:
+                k = base_key + (b,)
+                if k not in _WARMED:
+                    _WARMED.add(k)
+                    todo.append(b)
+        if not todo:
+            return
+        try:
+            rv = self._read_vc_dense(None)
+        except ReadBelowBase:  # pragma: no cover — latest never raises
+            return
+        # reads are pure but appends DONATE the state buffers — warm on
+        # a copy taken here, under the caller's partition lock
+        st_copy = jax.tree_util.tree_map(jnp.copy, self.st)
+        specs = []
+        for b in todo:
+            pad = np.zeros(b, dtype=np.int32)
+            try:
+                spec, _post = self._many_split(
+                    st_copy, [], np.zeros(0, dtype=np.int32), pad, rv)
+            except NotImplementedError:
+                return  # per-document planes (RGA) have no batch fold
+            specs.append(spec)
+
+        def run():
+            for fn, args in specs:
+                try:
+                    jax.block_until_ready(fn(*args))
+                except Exception:  # noqa: BLE001 — warm is best-effort
+                    log.debug("read warm failed", exc_info=True)
+                    return
+
+        _WARM_THREADS[:] = [t for t in _WARM_THREADS if t.is_alive()]
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"warm-read:{self.type_name}")
+        _WARM_THREADS.append(t)
+        t.start()
+
+    def _post_grow(self) -> None:
+        """After any capacity growth: compile the append AND read
+        programs for the new shapes off the serving threads."""
+        self.warm_appends()
+        self.warm_reads()
+
     def _append_rows(self, rows: List[tuple]) -> np.ndarray:
         """Device-append decoded rows via the shared packing
         (:func:`_pack_rows`); returns bool[n] overflow."""
@@ -267,6 +382,7 @@ class _PlaneBase:
                 new_d = min(self.domain.d * 2, self.max_dcs)
                 self.domain = self.domain.grow(new_d)
                 self._grow_dcs(new_d)
+                self._post_grow()
         return self.domain.index_of(actor)
 
     def _key_idx(self, key) -> int:
@@ -276,6 +392,7 @@ class _PlaneBase:
                 self.flush()
                 self.capacity *= 2
                 self._grow_keys(self.capacity)
+                self._post_grow()
             idx = len(self.rev_keys)
             self.key_index[key] = idx
             self.rev_keys.append(key)
@@ -362,12 +479,38 @@ class _PlaneBase:
         pad[:len(idxs)] = idxs
         return self._many_reader(self.st, owned, idxs, pad, rv)
 
+    def _many_split(self, st, owned: list, idxs: np.ndarray,
+                    pad: np.ndarray, rv):
+        """Subclass hook: ``((fn, args), post)`` — the batched read
+        split into its device half (a jitted store call; ``fn(*args)``
+        yields the fold's array pytree) and its host half (``post``
+        maps the np-converted arrays to {key: state}).  The split is
+        what lets the FUSED cross-partition path (fused_read, below)
+        run many planes' folds from one chip as a single XLA program
+        — one dispatch per chip instead of one per partition."""
+        raise NotImplementedError
+
     def _many_reader(self, st, owned: list, idxs: np.ndarray,
                      pad: np.ndarray, rv):
-        """Subclass hook: closure materializing the owned keys in one
-        batched fold of the captured state (``pad`` = idxs padded to
-        the dispatch bucket)."""
-        raise NotImplementedError
+        """Closure materializing the owned keys in one batched fold of
+        the captured state (``pad`` = idxs padded to the dispatch
+        bucket).  Carries ``.split``/``.device`` so a cross-partition
+        caller can fuse this fold with other planes' (see fused_read);
+        planes with no batched-fold form (RGA's per-document trees)
+        override this without a split."""
+        spec, post = self._many_split(st, owned, idxs, pad, rv)
+        fn, args = spec
+
+        def run():
+            count_read_dispatch()
+            out = fn(*args)
+            return post(jax.tree_util.tree_map(np.asarray, out))
+
+        run.split = (spec, post)
+        leaf = jax.tree_util.tree_leaves(st)[0]
+        run.device = next(iter(leaf.devices())) \
+            if hasattr(leaf, "devices") else None
+        return run
 
     def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
         """{key: state} for device-owned keys; callers take the host
@@ -433,6 +576,21 @@ class _PlaneBase:
         if self._last_stable is not None \
                 and self._ops_since_gc >= self.gc_ops:
             self.gc(self._last_stable)
+        self._maybe_speculative_grow()
+
+    def _maybe_speculative_grow(self) -> None:
+        """Double the key directory BEFORE stage() must do it inline:
+        a grow is a host repack + re-upload plus fresh XLA programs at
+        the new shapes — on the commit path that was the dominant
+        config6 p99 term (0.7-2.5 s in-run recompile spikes after a
+        doubling).  Here it runs on the background flusher, under the
+        partition lock with readers quiesced, and the new programs
+        warm before the serving threads first use them."""
+        if len(self.rev_keys) * 8 >= self.capacity * 7:
+            self.flush()
+            self.capacity *= 2
+            self._grow_keys(self.capacity)
+            self._post_grow()
 
     def flush(self) -> None:
         """Drain staged rows into the device ring, padded to a bucket.
@@ -569,6 +727,7 @@ class OrsetPlane(_PlaneBase):
                 if len(slots) >= self.max_slots:
                     return None
                 self._grow_slots(min(self.n_slots * 2, self.max_slots))
+                self._post_grow()
             s = len(slots)
             slots[elem] = s
             self.rev_elems[idx].append(elem)
@@ -641,13 +800,11 @@ class OrsetPlane(_PlaneBase):
 
         return run
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
+    def _many_split(self, st, owned, idxs, pad, rv):
         elem_lists = [self.rev_elems[i] for i in idxs]
         domain = self.domain
 
-        def run():
-            dots = np.asarray(store.orset_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv)))
+        def post(dots):
             actors = domain.dc_ids
             out = {}
             for i, k in enumerate(owned):
@@ -664,7 +821,8 @@ class OrsetPlane(_PlaneBase):
                 out[k] = state
             return out
 
-        return run
+        return ((store.orset_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 class CounterPlane(_PlaneBase):
@@ -710,13 +868,12 @@ class CounterPlane(_PlaneBase):
         return lambda: int(store.counter_read_keys(
             st, jnp.asarray([idx], dtype=np.int32), jnp.asarray(rv))[0])
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
-        def run():
-            vals = np.asarray(store.counter_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv)))
+    def _many_split(self, st, owned, idxs, pad, rv):
+        def post(vals):
             return {k: int(vals[i]) for i, k in enumerate(owned)}
 
-        return run
+        return ((store.counter_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 class MvregPlane(OrsetPlane):
@@ -780,13 +937,11 @@ class MvregPlane(OrsetPlane):
 
         return run
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
+    def _many_split(self, st, owned, idxs, pad, rv):
         val_lists = [self.rev_elems[i] for i in idxs]
         domain = self.domain
 
-        def run():
-            dots = np.asarray(store.mvreg_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv)))
+        def post(dots):
             actors = domain.dc_ids
             out = {}
             for i, k in enumerate(owned):
@@ -800,7 +955,8 @@ class MvregPlane(OrsetPlane):
                 out[k] = frozenset(pairs)
             return out
 
-        return run
+        return ((store.mvreg_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 class FlagEwPlane(OrsetPlane):
@@ -853,12 +1009,10 @@ class FlagEwPlane(OrsetPlane):
 
         return run
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
+    def _many_split(self, st, owned, idxs, pad, rv):
         domain = self.domain
 
-        def run():
-            dots = np.asarray(store.orset_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv)))
+        def post(dots):
             actors = domain.dc_ids
             return {
                 k: frozenset(
@@ -868,7 +1022,8 @@ class FlagEwPlane(OrsetPlane):
                 for i, k in enumerate(owned)
             }
 
-        return run
+        return ((store.orset_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 class RwsetPlane(OrsetPlane):
@@ -980,14 +1135,12 @@ class RwsetPlane(OrsetPlane):
 
         return run
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
+    def _many_split(self, st, owned, idxs, pad, rv):
         elem_lists = [self.rev_elems[i] for i in idxs]
         domain = self.domain
 
-        def run():
-            adds, rmvs = store.rwset_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv))
-            adds, rmvs = np.asarray(adds), np.asarray(rmvs)
+        def post(out_arrays):
+            adds, rmvs = out_arrays
             actors = domain.dc_ids
             out = {}
             for i, k in enumerate(owned):
@@ -1002,7 +1155,8 @@ class RwsetPlane(OrsetPlane):
                 out[k] = state
             return out
 
-        return run
+        return ((store.rwset_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 class FlagDwPlane(RwsetPlane):
@@ -1058,13 +1212,11 @@ class FlagDwPlane(RwsetPlane):
 
         return run
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
+    def _many_split(self, st, owned, idxs, pad, rv):
         domain = self.domain
 
-        def run():
-            adds, rmvs = store.rwset_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv))
-            adds, rmvs = np.asarray(adds), np.asarray(rmvs)
+        def post(out_arrays):
+            adds, rmvs = out_arrays
             actors = domain.dc_ids
             return {
                 k: (self._dots_of(adds[i, 0], actors),
@@ -1072,7 +1224,8 @@ class FlagDwPlane(RwsetPlane):
                 for i, k in enumerate(owned)
             }
 
-        return run
+        return ((store.rwset_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 class SetGoPlane(OrsetPlane):
@@ -1144,12 +1297,10 @@ class SetGoPlane(OrsetPlane):
 
         return run
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
+    def _many_split(self, st, owned, idxs, pad, rv):
         elem_lists = [self.rev_elems[i] for i in idxs]
 
-        def run():
-            present = np.asarray(store.setgo_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv)))
+        def post(present):
             return {
                 k: frozenset(
                     e for slot, e in enumerate(list(elem_lists[i]))
@@ -1157,7 +1308,8 @@ class SetGoPlane(OrsetPlane):
                 for i, k in enumerate(owned)
             }
 
-        return run
+        return ((store.setgo_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 #: tiebreak packing: rank << _TIE_SHIFT | seq (seq must fit the low bits)
@@ -1302,14 +1454,13 @@ class LwwPlane(_PlaneBase):
 
         return run
 
-    def _many_reader(self, st, owned, idxs, pad, rv):
+    def _many_split(self, st, owned, idxs, pad, rv):
         # consistent with the captured state (see LwwPlane._reader)
         acts = self.actors_sorted
         vals = self.rev_vals
 
-        def run():
-            ts, tie, val = (np.asarray(a) for a in store.lww_read_keys(
-                st, jnp.asarray(pad), jnp.asarray(rv)))
+        def post(out_arrays):
+            ts, tie, val = out_arrays
             out = {}
             for i, k in enumerate(owned):
                 if val[i] < 0:
@@ -1321,7 +1472,8 @@ class LwwPlane(_PlaneBase):
                               vals[int(val[i])])
             return out
 
-        return run
+        return ((store.lww_read_keys,
+                 (st, jnp.asarray(pad), jnp.asarray(rv))), post)
 
 
 #: bottom (empty) nested states as the planes reconstruct them — used by
@@ -1537,12 +1689,15 @@ class RgaPlane(_PlaneBase):
                         m[i, c] = max(m[i, c], t)
                 return jnp.asarray(m)
 
-            args = (col(ins, 2), col(ins, 3), col(ins, 4), col(ins, 5),
-                    col(ins, 6), col(ins, 7), col(ins, 8, np.int64),
-                    ss(ins),
-                    col(dels, 2), col(dels, 3), col(dels, 7),
-                    col(dels, 8, np.int64), ss(dels))
-            st, ok = rga_store.rga_append(st, *args)
+            # bucketed append: per-commit group sizes vary freely, and
+            # un-padded blocks would mint one XLA program per distinct
+            # (inserts, deletes) pair (rga_store.rga_append_padded)
+            ins_cols = (col(ins, 2), col(ins, 3), col(ins, 4),
+                        col(ins, 5), col(ins, 6), col(ins, 7),
+                        col(ins, 8, np.int64), ss(ins))
+            del_cols = (col(dels, 2), col(dels, 3), col(dels, 7),
+                        col(dels, 8, np.int64), ss(dels))
+            st, ok = rga_store.rga_append_padded(st, ins_cols, del_cols)
             if not bool(ok):
                 # fold what is stable, then grow to fit the backlog
                 if self._last_stable is not None:
@@ -1556,8 +1711,10 @@ class RgaPlane(_PlaneBase):
                         self._base_vc = self._base_vc.join(
                             self._last_stable)
                         self._has_base = True
-                need_w = int(st.wn) + len(ins)
-                need_d = int(st.dn) + len(dels)
+                # room for the PADDED block (the append refuses when
+                # the pad would overhang, see rga_append)
+                need_w = int(st.wn) + rga_store._append_bucket(len(ins))
+                need_d = int(st.dn) + rga_store._append_bucket(len(dels))
                 nw = st.nw
                 while nw < need_w:
                     nw *= 2
@@ -1565,7 +1722,8 @@ class RgaPlane(_PlaneBase):
                 while md < need_d:
                     md *= 2
                 st = rga_store.rga_grow(st, nw=nw, md=md)
-                st, ok = rga_store.rga_append(st, *args)
+                st, ok = rga_store.rga_append_padded(st, ins_cols,
+                                                     del_cols)
                 assert bool(ok), "rga append must fit after grow"
             self.st[idx] = st
         return overflow

@@ -162,14 +162,28 @@ def _ckey_pack(parent_uid, uid):
 @partial(jax.jit, donate_argnums=(0,))
 def rga_append(st: RgaStoreState, ins_lamport, ins_actor, ref_lamport,
                ref_actor, elem, ins_dc, ins_ct, ins_ss,
-               del_lamport, del_actor, del_dc, del_ct, del_ss):
+               del_lamport, del_actor, del_dc, del_ct, del_ss,
+               n_ins=None, n_del=None):
     """Append one op block (B insert lanes + C delete lanes) into the
     window, each lane carrying its full commit VC (origin column,
     commit time, snapshot columns).  Returns (state, ok) — ok=False
     means the window or delete lanes are full: the caller folds (or
-    grows) and retries."""
+    grows) and retries.
+
+    ``n_ins``/``n_del`` are the LOGICAL lane counts when the arrays
+    are padded to a dispatch bucket (rga_append_padded): the padded
+    tail is written into the invalid region beyond wn/dn — masked by
+    every fold/read and overwritten by the next append — while the
+    counters advance by the logical counts only.  Without bucketing,
+    every distinct (B, C) pair mints its own XLA program (measured
+    ~0.45 s/block on CPU: the whole config-4 steady-state deficit)."""
     b = ins_lamport.shape[0]
     c = del_lamport.shape[0]
+    nb = b if n_ins is None else n_ins
+    nc = c if n_del is None else n_del
+    # physical room for the PADDED block: the dynamic_update_slice
+    # below would clamp its start (corrupting valid lanes) if the pad
+    # overhung — refuse conservatively, the caller folds/grows
     ok = (st.wn + b <= st.nw) & (st.dn + c <= st.md)
     i32 = lambda a: a.astype(jnp.int32)
     i64 = lambda a: a.astype(jnp.int64)
@@ -192,12 +206,43 @@ def rga_append(st: RgaStoreState, ins_lamport, ins_actor, ref_lamport,
         welem=put(st.welem, elem),
         wdc=put(st.wdc, ins_dc), wct=put64(st.wct, ins_ct),
         wss=put64(st.wss, ins_ss),
-        wn=jnp.where(ok, st.wn + b, st.wn),
+        wn=jnp.where(ok, st.wn + nb, st.wn),
         dlam=putd(st.dlam, del_lamport), dact=putd(st.dact, del_actor),
         ddc=putd(st.ddc, del_dc), dct=putd64(st.dct, del_ct),
         dss=putd64(st.dss, del_ss),
-        dn=jnp.where(ok, st.dn + c, st.dn),
+        dn=jnp.where(ok, st.dn + nc, st.dn),
     ), ok
+
+
+def _append_bucket(n: int, floor: int = 64) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def rga_append_padded(st: RgaStoreState, ins_cols, del_cols,
+                      floor: int = 64):
+    """:func:`rga_append` with both lane blocks padded to power-of-two
+    buckets and the logical counts passed through — callers whose
+    block sizes vary per call (the live plane's per-commit groups, the
+    bench's lamport-sliced deletes) compile a handful of programs
+    instead of one per distinct size.  ``ins_cols``/``del_cols`` are
+    the positional argument tuples of rga_append (host arrays)."""
+    b = int(np.asarray(ins_cols[0]).shape[0])
+    c = int(np.asarray(del_cols[0]).shape[0])
+    bp, cp = _append_bucket(b, floor), _append_bucket(c, floor)
+
+    def pad(a, n):
+        a = np.asarray(a)
+        if a.shape[0] == n:
+            return jnp.asarray(a)
+        w = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return jnp.asarray(np.pad(a, w))
+
+    return rga_append(
+        st, *(pad(a, bp) for a in ins_cols),
+        *(pad(a, cp) for a in del_cols), n_ins=b, n_del=c)
 
 
 def _included(ss, dc, ct, rv):

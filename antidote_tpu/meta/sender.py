@@ -94,3 +94,9 @@ class MetaDataSender:
         """Last published view without re-folding."""
         with self._lock:
             return self._entries[name].merged
+
+    def peek_value(self, name: str, partition: int) -> Any:
+        """One partition's raw datum (no fold) — the device stable
+        plane mirrors these rows onto the mesh."""
+        with self._lock:
+            return self._entries[name].values[partition]

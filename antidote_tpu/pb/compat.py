@@ -23,6 +23,24 @@ Mapping notes:
 Message codes follow the upstream registry (best-effort; the recorded
 frames in tests/pb/ are the divergence-diff baseline):
 107 ApbRegUpdate ... 128 ApbStaticReadObjectsResp, 0 ApbErrorResp.
+
+DIVERGENCE-DIFF PROCEDURE (byte-level verification is impossible in
+this environment — zero egress, the upstream codec dep not vendored —
+so the corpus is built to make a future check MECHANICAL):
+
+1. On a machine with the real client, capture one frame per message:
+   drive antidotec_pb through the same canonical instances listed in
+   tests/pb/test_pb_compat.py::_GOLDEN_FRAMES (each entry documents
+   exactly which fields are set to which values), dumping the raw
+   [u32 len][u8 code][payload] bytes per message.
+2. Diff the captured (code, payload-hex) pairs against _GOLDEN_FRAMES
+   row by row.  A code mismatch = fix this file's CODES table; a
+   payload mismatch = fix the corresponding field numbers/types in
+   antidote_compat.proto and regenerate (protoc), then update the
+   golden hex — the test failure shows the reviewable byte diff.
+3. Re-run tests/pb/test_pb_compat.py: the end-to-end session tests
+   (interactive, static, map, error/abort) prove the fixed schema
+   against the live server; the golden tests pin it for the future.
 """
 
 from __future__ import annotations

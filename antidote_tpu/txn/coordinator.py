@@ -29,7 +29,9 @@ from antidote_tpu.mat.materializer import materialize_eager
 from antidote_tpu.txn.manager import (
     _RAW_OP,
     CertificationError,
+    PartitionManager,
     _is_raw,
+    read_many_fused,
 )
 
 
@@ -348,6 +350,7 @@ class Coordinator:
             handles = []
             link = None
             try:
+                local_groups = []
                 for pm, items in by_pm.items():
                     if (getattr(pm, "deferred_stage", False)
                             and hasattr(pm.link, "finish_many")):
@@ -355,9 +358,23 @@ class Coordinator:
                         handles.append((pm.start_call(
                             "read_many", items, tx.snapshot_vc,
                             txid=tx.txid), pm, items))
+                    elif isinstance(pm, PartitionManager):
+                        local_groups.append((pm, items))
                     else:
+                        # a remote proxy on a non-pipelined fabric:
+                        # plain call — it has no begin/finish split
                         values.update(pm.read_many(
                             items, tx.snapshot_vc, txid=tx.txid))
+                if len(local_groups) == 1:
+                    pm, items = local_groups[0]
+                    values.update(pm.read_many(
+                        items, tx.snapshot_vc, txid=tx.txid))
+                elif local_groups:
+                    # multi-partition local read: fuse the device folds
+                    # per chip — at most n_devices programs, not one
+                    # per partition (manager.read_many_fused)
+                    values.update(read_many_fused(
+                        local_groups, tx.snapshot_vc, txid=tx.txid))
             except BaseException:
                 # a local read failed mid-round: started remote calls
                 # must not leak their native completion slots

@@ -14,6 +14,7 @@ transaction block on the condition until commit/abort notifies
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -29,6 +30,8 @@ from antidote_tpu.mat.materializer import (
 from antidote_tpu.oplog.partition import PartitionLog
 from antidote_tpu.oplog.records import commit_certified
 from antidote_tpu.txn.clock import HybridClock
+
+log = logging.getLogger(__name__)
 
 
 class CertificationError(Exception):
@@ -187,6 +190,14 @@ class PartitionManager:
         #: impossible — the in-flight mutator that raced the drain gets
         #: PartitionRetired instead of a silent ack
         self.retired = False
+        #: stronger park for IN-DOUBT ownership (a handoff whose
+        #: install may or may not have been applied at an unreachable
+        #: receiver): READS refuse too — the receiver may have adopted
+        #: and taken writes, and after a restart the local pm may sit
+        #: on a rebuilt EMPTY log, so serving a read here could return
+        #: stale or bottom values for committed keys.  ``retired``
+        #: alone keeps reads flowing (the drain window needs them).
+        self.parked = False
         #: txid -> (prepare_time, [keys])
         self.prepared: Dict[Any, Tuple[int, List[Any]]] = {}
         #: key -> last committed time at this DC
@@ -247,6 +258,12 @@ class PartitionManager:
         if self.retired:
             raise PartitionRetired(
                 f"partition {self.partition} handed off")
+
+    def _read_check(self) -> None:
+        """Must run under self._lock, before serving a read."""
+        if self.parked:
+            raise PartitionRetired(
+                f"partition {self.partition} ownership in doubt")
 
     def stage_update(self, txid, key, type_name: str, effect) -> None:
         """Log the update record and stage it for commit (the reference's
@@ -604,6 +621,7 @@ class PartitionManager:
             self.clock.wait_until(snapshot_vc.get_dc(self.dc_id))
         reader = None
         with self._lock:
+            self._read_check()
             if snapshot_vc is not None:
                 deadline = time.monotonic() + self.read_wait_timeout
                 while self._blocking_prepared(key, snapshot_vc, txid):
@@ -742,11 +760,23 @@ class PartitionManager:
         the async-batched-reads pipelining of the reference coordinator
         (src/clocksi_interactive_coord.erl:731-747) fused with the
         read-server concurrency split of :meth:`read`."""
+        out, dev_batches = self.read_many_begin(items, snapshot_vc,
+                                                txid)
+        return self.read_many_finish(out, dev_batches, snapshot_vc,
+                                     txid)
+
+    def read_many_begin(self, items, snapshot_vc, txid=None):
+        """First half of :meth:`read_many`: gate, split, flush, and
+        capture the device folds (reader counts INCREMENTED — the
+        caller MUST run read_many_finish exactly once, whatever
+        happens).  Split out so a multi-partition caller can fuse the
+        captured folds across partitions per chip (read_many_fused)."""
         if snapshot_vc is not None:
             self.clock.wait_until(snapshot_vc.get_dc(self.dc_id))
         out: Dict[Tuple[Any, str], Any] = {}
         dev_batches = []  # (type, [(key, cacheable_frontier)], closure)
         with self._lock:
+            self._read_check()
             if snapshot_vc is not None:
                 deadline = time.monotonic() + self.read_wait_timeout
                 while any(self._blocking_prepared(k, snapshot_vc, txid)
@@ -795,10 +825,22 @@ class PartitionManager:
                 else:
                     self._dev_readers += 1
                 dev_batches.append((type_name, pairs, closure))
+        return out, dev_batches
+
+    def read_many_finish(self, out, dev_batches, snapshot_vc,
+                         txid=None, got_map=None):
+        """Second half of :meth:`read_many`: run (or accept) the device
+        folds, post-process, warm the cache, and RELEASE the reader
+        counts taken by read_many_begin.  ``got_map`` maps a batch's
+        index to its already-computed {key: value} dict (the fused
+        cross-partition path ran the fold); missing entries run their
+        own closure here."""
+        got_map = got_map or {}
         pending_readers = sum(1 for _t, _p, c in dev_batches
                               if c is not None)
         try:
-            for type_name, pairs, closure in dev_batches:
+            for bi, (type_name, pairs, closure) in enumerate(
+                    dev_batches):
                 if closure is None:
                     with self._lock:
                         for key, _fr, _ex in pairs:
@@ -806,7 +848,7 @@ class PartitionManager:
                                 key, type_name, snapshot_vc, txid)
                     continue
                 try:
-                    got = closure()
+                    got = got_map[bi] if bi in got_map else closure()
                 finally:
                     with self._lock:
                         self._dev_readers -= 1
@@ -859,4 +901,85 @@ class PartitionManager:
         """Committed value at ``clock`` (None = latest) without Clock-SI
         gating (get_objects path); store access under the partition lock."""
         with self._lock:
+            self._read_check()
             return self._read_store(key, type_name, clock)
+
+
+def read_many_fused(groups, snapshot_vc, txid=None
+                    ) -> Dict[Tuple[Any, str], Any]:
+    """Multi-partition batched read with per-CHIP device dispatch:
+    ``groups`` is [(pm, items)] over LOCAL partitions; every captured
+    device fold landing on the same chip runs in ONE XLA program
+    (mat/device_plane.fused_read), so a read spanning P ring-placed
+    partitions issues at most n_devices * n_types programs instead of
+    P * n_types (round-4 verdict item 4: per-partition dispatch won't
+    scale to the 256-partition configs).  On a single-device node this
+    degenerates to one program for the whole read — strictly fewer
+    dispatches than the per-partition loop it replaces.
+
+    Begin/run/finish are split so reader counts stay balanced on every
+    path: each partition's read_many_begin increments its counts, and
+    read_many_finish (which always runs, fused result or not) releases
+    them."""
+    from antidote_tpu.mat.device_plane import fused_read
+
+    begun = []  # (pm, out, dev_batches)
+    try:
+        for pm, items in groups:
+            out, dev_batches = pm.read_many_begin(items, snapshot_vc,
+                                                  txid)
+            begun.append((pm, out, dev_batches))
+    except BaseException:
+        # release the already-begun partitions' reader counts (their
+        # closures run un-fused; results discarded)
+        for pm, out, dev_batches in begun:
+            try:
+                pm.read_many_finish(out, dev_batches, snapshot_vc, txid)
+            except Exception:  # noqa: BLE001 — original error wins
+                pass
+        raise
+    # group fusible captures by chip.  BaseException here (interrupt
+    # mid-fuse) must still fall through to the finish loop below —
+    # every begun partition's reader counts are released there.
+    results: Dict[Tuple[int, int], dict] = {}
+    err = None
+    try:
+        by_dev: Dict[Any, list] = {}
+        for gi, (_pm, _out, batches) in enumerate(begun):
+            for bi, (_t, _pairs, closure) in enumerate(batches):
+                split = getattr(closure, "split", None) \
+                    if closure is not None else None
+                if split is not None:
+                    by_dev.setdefault(
+                        getattr(closure, "device", None), []).append(
+                            (gi, bi, split))
+        for dev, entries in by_dev.items():
+            if len(entries) < 2 or dev is None:
+                continue  # a lone fold dispatches itself in finish
+            try:
+                outs = fused_read([s for _gi, _bi, s in entries])
+            except Exception:  # noqa: BLE001 — per-fold fallback
+                log.exception("fused cross-partition read failed; "
+                              "falling back to per-partition folds")
+                continue
+            for (gi, bi, _s), got in zip(entries, outs):
+                results[(gi, bi)] = got
+    except BaseException as e:  # noqa: BLE001 — re-raised below
+        err = e
+    merged: Dict[Tuple[Any, str], Any] = {}
+    for gi, (pm, out, batches) in enumerate(begun):
+        got_map = {bi: results[(gi, bi)]
+                   for bi in range(len(batches))
+                   if (gi, bi) in results}
+        # EVERY begun partition's finish must run (it releases the
+        # reader counts begin took) — a failing partition must not
+        # leak its successors' counts; first error re-raises after
+        try:
+            merged.update(pm.read_many_finish(
+                out, batches, snapshot_vc, txid, got_map))
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            if err is None:
+                err = e
+    if err is not None:
+        raise err
+    return merged

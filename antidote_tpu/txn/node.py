@@ -42,11 +42,17 @@ class TxnGate:
         self._cond = threading.Condition()
         self._active = 0
         self._blocking = False
+        #: cluster-resize freeze — its OWN flag, not _blocking: a
+        #: handoff cutover's exclusive() releases _blocking on exit,
+        #: and that must never reopen a gate the resize froze (the
+        #: member would admit transactions at the old partition width
+        #: through the resize barrier)
+        self._frozen = False
 
     def enter(self, timeout: float = 30.0) -> None:
         with self._cond:
             deadline = time.monotonic() + timeout
-            while self._blocking:
+            while self._blocking or self._frozen:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cond.wait(remaining):
                     raise TimeoutError(
@@ -59,6 +65,35 @@ class TxnGate:
             if self._active <= 0:
                 self._cond.notify_all()
 
+    def freeze(self) -> None:
+        """Close the gate to NEW transactions WITHOUT draining — the
+        cluster-resize barrier's first half: every member freezes, the
+        in-flight transactions (including their remote 2PC legs, which
+        the members still serve) run to completion, then wait_idle
+        confirms the global drain.  Stays frozen until unfreeze()
+        (persisted across a crash by the caller's resize marker);
+        composes with exclusive() — a cutover finishing during the
+        freeze must not reopen the gate."""
+        with self._cond:
+            self._frozen = True
+
+    def unfreeze(self) -> None:
+        with self._cond:
+            self._frozen = False
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout: float = 60.0) -> None:
+        """Block until no transaction holds the gate (call after
+        freeze(); a frozen gate admits nobody new, so idle is a
+        barrier, not a race)."""
+        with self._cond:
+            deadline = time.monotonic() + timeout
+            while self._active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    raise TimeoutError(
+                        "in-flight transactions never drained")
+
     def exclusive(self, drain_timeout: float = 60.0):
         gate = self
 
@@ -67,6 +102,10 @@ class TxnGate:
                 with gate._cond:
                     if gate._blocking:
                         raise RuntimeError("cutover already in progress")
+                    if gate._frozen:
+                        raise RuntimeError(
+                            "gate frozen by a cluster resize; no "
+                            "cutover may start until it finishes")
                     gate._blocking = True
                     deadline = time.monotonic() + drain_timeout
                     while gate._active:
@@ -86,6 +125,118 @@ class TxnGate:
                 return False
 
         return _Exclusive()
+
+
+def resize_journal_path(data_dir: str, dc_id) -> str:
+    """The ring-resize journal's location — ONE owner for the name:
+    Node's crash recovery (_resume_interrupted_resize) and the cluster
+    restart reconciliation (cluster/node.py _reconcile_resized_plan)
+    must read the same file or a mid-resize crash recovers a width
+    the persisted plan disagrees with."""
+    return os.path.join(data_dir, f"{dc_id}_resize.journal")
+
+
+def read_resize_journal(path: str):
+    """(old_n, new_n) from a resize journal, or None if absent."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        old_n, new_n = (int(x) for x in f.read().split())
+    return old_n, new_n
+
+
+class LiveFold:
+    """Incremental committed-group fold from live partition logs into
+    staged resize logs — the riak_core handoff fold running while the
+    vnode keeps serving (reference src/logging_vnode.erl:781-812),
+    shared by the single-node live resize (Node.repartition_live) and
+    the cluster-wide resize (each member folds its LOCAL slice,
+    cluster/node.py resize_cluster).
+
+    Emission safety: a transaction's update records always precede its
+    FIRST commit copy in wall order (stage -> prepare -> commit), so
+    any commit seen by pass k has all its updates below pass k+1's
+    cursors — groups emit one pass after their commit is first seen,
+    and the quiesced final pass emits the rest."""
+
+    def __init__(self, parts, new_logs, route):
+        #: [(global index, PartitionManager)] — the logs folded FROM
+        self.parts = list(parts)
+        #: {global new index: PartitionLog} — the staged logs folded TO
+        self.new_logs = dict(new_logs)
+        #: key -> global new partition index
+        self.route = route
+        self.cursors = {p: 0 for p, _pm in self.parts}
+        self._updates: dict = {}   # txid -> [update records]
+        self._commits: dict = {}   # txid -> commit record (first wins)
+        self._ready: list = []     # commit order, not yet emitted
+        self._emitted: set = set()
+
+    def scan_pass(self) -> int:
+        """One cursor pass over every live log; returns the number of
+        new records seen."""
+        seen = 0
+        for p, pm in self.parts:
+            def scan(log, _p=p):
+                # byte cursors: records(offset) scans from a FILE
+                # offset, and under the partition lock nothing appends
+                # between the iteration and end_offset()
+                new = list(log.records(offset=self.cursors[_p]))
+                self.cursors[_p] = log.log.end_offset()
+                return new
+            for rec in pm.scan_log(scan):
+                seen += 1
+                kind = rec.kind()
+                if kind == "update":
+                    self._updates.setdefault(rec.txid, []).append(rec)
+                elif kind == "commit" and rec.txid not in self._commits \
+                        and rec.txid not in self._emitted:
+                    self._commits[rec.txid] = rec
+                    self._ready.append(rec.txid)
+        return seen
+
+    def _emit(self, txids) -> None:
+        for txid in txids:
+            rec = self._commits.pop(txid)
+            dests: dict = {}
+            for u in self._updates.pop(txid, ()):
+                dests.setdefault(self.route(u.payload[1]), []).append(u)
+            (dc, ct) = rec.payload[1]
+            svc = rec.payload[2]
+            cert = commit_certified(rec.payload)
+            for q, ups in dests.items():
+                lg = self.new_logs[q]
+                for u in ups:
+                    lg.append_update(dc, txid, u.payload[1],
+                                     u.payload[2], u.payload[3])
+                lg.append_commit(dc, txid, ct, svc, certified=cert)
+            self._emitted.add(txid)
+
+    def serve_passes(self, max_passes: int, delta_threshold: int
+                     ) -> None:
+        """Phase 1 — fold toward the live frontier while serving:
+        passes shrink as clients keep committing; stop once a pass
+        sees at most ``delta_threshold`` new records."""
+        self.scan_pass()
+        for _ in range(max_passes):
+            emittable, self._ready = self._ready, []
+            seen = self.scan_pass()
+            # commits collected before this pass now have every update
+            # below the cursors — safe to emit
+            self._emit(emittable)
+            if seen <= delta_threshold:
+                break
+
+    def final_pass(self) -> None:
+        """Phase 2 — with the gate held (no appenders), fold the
+        remainder and close the staged logs.  Dangling updates without
+        commits are aborted/in-doubt transactions — they do not
+        survive the resize."""
+        self.scan_pass()
+        self._emit(self._ready)
+        self._ready = []
+        for lg in self.new_logs.values():
+            lg.close()
 
 
 class Node:
@@ -117,6 +268,14 @@ class Node:
         #: stable snapshot.
         self.stable_vc_provider: Callable[[], VC] = (
             lambda: VC({dc_id: self.min_prepared_vc()}))
+        #: ring-placed node over a real mesh: the stable fold itself is
+        #: a device collective (rows co-located with the partitions'
+        #: planes, GST = cross-chip pmin — meta/device_stable.py; the
+        #: reference's gossip fold, src/meta_data_sender.erl:224-255).
+        #: Higher layers (DataCenter, NodeServer) install richer
+        #: trackers over the same mechanism via make_stable_tracker.
+        self.stable_tracker = None
+        self._install_device_stable()
         #: (monotonic time, VC) pair backing stable_vc()'s TTL cache
         self._stable_read_cache = (0.0, None)
         #: called inside causal clock-wait spins; the inter-DC layer
@@ -130,6 +289,39 @@ class Node:
         self.txn_gate = TxnGate()
         if self.config.recover_from_log:
             self._recover_stores()
+
+    def _install_device_stable(self) -> None:
+        """Serve this node's OWN stable fold from the device mesh when
+        the data plane is ring-placed over multiple chips: each local
+        partition's row (own min-prepared — the single-node default
+        provider's quantity) lives on the partition's chip and the GST
+        is a cross-chip pmin (meta/device_stable.py).  Skipped when a
+        higher layer will install its own provider anyway for slices
+        this process doesn't own (ClusterNode), or with <2 devices."""
+        if not (self.config.device_store
+                and self.config.device_placement == "ring"):
+            return
+        if any(not isinstance(pm, PartitionManager)
+               for pm in self.partitions):
+            return  # cluster member: NodeServer wires the plane
+        import jax
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            return
+        from antidote_tpu.meta.device_stable import (
+            DeviceStableTimeTracker,
+        )
+
+        trk = DeviceStableTimeTracker(
+            self.dc_id, self.config.n_partitions, devs)
+        dc_id = self.dc_id
+        trk.sources = [
+            (lambda _pm=pm: VC({dc_id: _pm.min_prepared()}))
+            for pm in self.partitions
+        ]
+        self.stable_tracker = trk
+        self.stable_vc_provider = trk.get_stable_snapshot
 
     # ------------------------------------------------------------ elasticity
 
@@ -241,6 +433,31 @@ class Node:
         self.partitions = [self._build_partition(p)
                            for p in range(new_n)]
         self._recover_stores()
+        if self.stable_tracker is not None:
+            self._install_device_stable()  # re-aim rows at the new ring
+
+    def build_resize_fold(self, new_n: int, own_slot=None) -> LiveFold:
+        """LiveFold from this process's partitions toward width
+        ``new_n``.  ``own_slot(q) -> bool`` restricts the staged logs
+        to the slots this process will own — a single-process node
+        stages all of them; ClusterNode passes its ring-slice filter
+        (cluster/node.py)."""
+        parts = [(p, pm) for p, pm in enumerate(self.partitions)
+                 if isinstance(pm, PartitionManager)]
+        new_logs = {}
+        for q in range(new_n):
+            if own_slot is not None and not own_slot(q):
+                continue
+            path = self._log_path(q) + ".resize"
+            if os.path.exists(path):
+                os.remove(path)
+            new_logs[q] = PartitionLog(path, partition=q,
+                                       sync_on_commit=False,
+                                       enabled=True)
+        # a key routed outside new_logs KeyErrors in the emit — a
+        # correctness assert for sliced folds, not a silent drop
+        return LiveFold(parts, new_logs,
+                        lambda k: self.partition_index(k, new_n))
 
     def repartition_live(self, new_n: int, max_passes: int = 6,
                          delta_threshold: int = 256) -> None:
@@ -279,85 +496,15 @@ class Node:
                 "repartition folds the durable logs; enable_logging="
                 "False leaves nothing to redistribute")
 
-        resize_paths = [self._log_path(p) + ".resize"
-                        for p in range(new_n)]
-        for path in resize_paths:
-            if os.path.exists(path):
-                os.remove(path)
-        new_logs = [
-            PartitionLog(path, partition=p, sync_on_commit=False,
-                         enabled=True)
-            for p, path in enumerate(resize_paths)
-        ]
-        cursors = [0] * old_n
-        updates: dict = {}     # txid -> [update records]
-        commits: dict = {}     # txid -> commit record (first copy wins)
-        ready: list = []       # commit order, not yet emitted
-        emitted: set = set()
-
-        def scan_pass() -> int:
-            """One cursor pass over every live log; returns the number
-            of new records seen."""
-            seen = 0
-            for p, pm in enumerate(self.partitions):
-                def scan(log, _p=p):
-                    # byte cursors: records(offset) scans from a FILE
-                    # offset, and under the partition lock nothing
-                    # appends between the iteration and end_offset()
-                    new = list(log.records(offset=cursors[_p]))
-                    cursors[_p] = log.log.end_offset()
-                    return new
-                for rec in pm.scan_log(scan):
-                    seen += 1
-                    kind = rec.kind()
-                    if kind == "update":
-                        updates.setdefault(rec.txid, []).append(rec)
-                    elif kind == "commit" and rec.txid not in commits \
-                            and rec.txid not in emitted:
-                        commits[rec.txid] = rec
-                        ready.append(rec.txid)
-            return seen
-
-        def emit(txids) -> None:
-            for txid in txids:
-                rec = commits.pop(txid)
-                dests: dict = {}
-                for u in updates.pop(txid, ()):
-                    dest = self.partition_index(u.payload[1], new_n)
-                    dests.setdefault(dest, []).append(u)
-                (dc, ct) = rec.payload[1]
-                svc = rec.payload[2]
-                cert = commit_certified(rec.payload)
-                for p, ups in dests.items():
-                    lg = new_logs[p]
-                    for u in ups:
-                        lg.append_update(dc, txid, u.payload[1],
-                                         u.payload[2], u.payload[3])
-                    lg.append_commit(dc, txid, ct, svc, certified=cert)
-                emitted.add(txid)
+        fold = self.build_resize_fold(new_n)
 
         # phase 1: fold toward the live frontier while serving
-        scan_pass()
-        for _ in range(max_passes):
-            emittable, ready[:] = ready[:], []
-            seen = scan_pass()
-            # commits collected before this pass now have every update
-            # below the cursors — safe to emit
-            emit(emittable)
-            if seen <= delta_threshold:
-                break
+        fold.serve_passes(max_passes, delta_threshold)
 
         # phase 2: cutover — drain in-flight txns, fold the remainder,
         # swap under the journal, rebuild via recovery
         with self.txn_gate.exclusive():
-            scan_pass()
-            emit(ready)
-            ready.clear()
-            # dangling updates without commits are aborted/in-doubt
-            # transactions — they do not survive the resize (same rule
-            # as the quiesced fold)
-            for lg in new_logs:
-                lg.close()
+            fold.final_pass()
             for pm in self.partitions:
                 pm.log.close()
             journal = self._resize_journal_path()
@@ -372,9 +519,11 @@ class Node:
             self.partitions = [self._build_partition(p)
                                for p in range(new_n)]
             self._recover_stores()
+            if self.stable_tracker is not None:
+                self._install_device_stable()
 
     def _resize_journal_path(self) -> str:
-        return os.path.join(self.data_dir, f"{self.dc_id}_resize.journal")
+        return resize_journal_path(self.data_dir, self.dc_id)
 
     def _complete_resize_swap(self, old_n: int, new_n: int) -> None:
         """Idempotently finish a journaled log swap: every remaining
@@ -400,11 +549,10 @@ class Node:
         a repartition after its staged logs were complete — finish the
         swap and adopt the journal's partition count (the caller's
         config may still carry the old one)."""
-        journal = self._resize_journal_path()
-        if not os.path.exists(journal):
+        parsed = read_resize_journal(self._resize_journal_path())
+        if parsed is None:
             return
-        with open(journal) as f:
-            old_n, new_n = (int(x) for x in f.read().split())
+        old_n, new_n = parsed
         self._complete_resize_swap(old_n, new_n)
         self.config.n_partitions = new_n
 

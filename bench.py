@@ -279,6 +279,10 @@ def _config_extras(quick_cpu: bool) -> dict:
         out["txn_per_sec_8client_cpu_quick"] = cfg6["value"]
         out["txn_p50_ms"] = cfg6["detail"].get("p50_ms")
         out["txn_p99_ms"] = cfg6["detail"].get("p99_ms")
+        out["txn_p50_1t_ms"] = cfg6["detail"].get("p50_1t_ms")
+        out["txn_p99_1t_ms"] = cfg6["detail"].get("p99_1t_ms")
+        out["txn_latency_starved"] = cfg6["detail"].get(
+            "latency_starved")
         out["txn_pb_per_sec"] = cfg6["detail"].get("pb_txn_per_sec")
         out["txn_pb_starved"] = cfg6["detail"].get("pb_starved")
         out["txn_cluster_per_sec"] = cfg6["detail"].get(
@@ -289,6 +293,8 @@ def _config_extras(quick_cpu: bool) -> dict:
         out["cpu_count"] = cfg6["detail"].get("cpu_count")
         out["cluster_starved"] = cfg6["detail"].get("cluster_starved")
         out["cluster_scaling"] = cfg6["detail"].get("cluster_scaling")
+        out["cluster_rpc_latency"] = cfg6["detail"].get(
+            "cluster_rpc_latency")
     except Exception as e:
         out["txn_error"] = repr(e)
     # configs 1/3/4 quick, on the bench platform (hardware when the

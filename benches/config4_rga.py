@@ -63,15 +63,16 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
         sl = slice(lo, hi)
         dhi = dptr + int(np.searchsorted(dlam[dptr:], hi, side="right"))
         dsl = slice(dptr, dhi)
-        st, ok = rga_store.rga_append(
-            st, jnp.asarray(tr["ins_lamport"][sl]),
-            jnp.asarray(tr["ins_actor"][sl]),
-            jnp.asarray(tr["ref_lamport"][sl]),
-            jnp.asarray(tr["ref_actor"][sl]),
-            jnp.asarray(tr["elem"][sl]),
-            *vc_cols(np.arange(lo + 1, hi + 1)),
-            jnp.asarray(dlam[dsl]), jnp.asarray(dact[dsl]),
-            *vc_cols(np.full(dhi - dptr, hi)))
+        # padded append: the delete-slice length varies per block, and
+        # un-padded shapes re-compile the append program every block
+        # (the whole steady-state deficit of earlier rounds)
+        st, ok = rga_store.rga_append_padded(
+            st,
+            (tr["ins_lamport"][sl], tr["ins_actor"][sl],
+             tr["ref_lamport"][sl], tr["ref_actor"][sl],
+             tr["elem"][sl], *vc_cols(np.arange(lo + 1, hi + 1))),
+            (dlam[dsl], dact[dsl],
+             *vc_cols(np.full(dhi - dptr, hi))))
         assert bool(ok)
         dptr = dhi
         return st

@@ -63,6 +63,28 @@ def _run_threads(worker, n_threads):
     return dt
 
 
+def warm_keyspace(db, K, chunk=256):
+    """Touch the FULL key space once (writes + reads): key-directory
+    growth and the per-shape XLA programs (append AND read folds)
+    compile here, not inside the measured window.  Without this the
+    measured run pays 0.3-1 s in-run recompiles whenever a partition's
+    key capacity doubles — the dominant p99 term (round-4 verdict
+    item 6), and on a 1-core bench host a background warm thread
+    competes with serving, so warm-up is the only honest place."""
+    for lo in range(0, K, chunk):
+        ks = range(lo, min(lo + chunk, K))
+        ct = db.update_objects_static(None, [
+            ((f"c{k}", "counter_pn", "bucket"), "increment", 0)
+            for k in ks])
+        db.update_objects_static(ct, [
+            ((f"s{k}", "set_aw", "bucket"), "add", "warm")
+            for k in ks])
+        db.read_objects_static(None, [
+            (f"c{k}", "counter_pn", "bucket") for k in ks])
+        db.read_objects_static(None, [
+            (f"s{k}", "set_aw", "bucket") for k in ks])
+
+
 def run_direct(db, n_threads, txns_per_thread, K, seed=0):
     from antidote_tpu.clocks import VC
 
@@ -236,6 +258,55 @@ def run_cluster(n_data, txns_per_client, K, tmp, n_clients=4,
                 p.kill()
 
 
+def run_cluster_latency(tmp):
+    """Single-threaded RPC latency decomposition for the cluster path
+    — the scale-out proxy a starved box CAN measure honestly (round-4
+    verdict: throughput rows on cores < processes are time-slicing
+    artifacts, but sequential round-trip latency is not
+    oversubscribed).  Returns µs p50 for: fabric ping (pure wire +
+    dispatch), remote single-key read, remote single-partition
+    commit."""
+    from antidote_tpu.cluster import NodeServer, create_dc_cluster
+    from antidote_tpu.config import Config
+
+    cfg = lambda: Config(n_partitions=4, heartbeat_s=0.5,
+                         sync_log=False)
+    servers = [NodeServer(f"L{i}", data_dir=os.path.join(tmp, f"L{i}"),
+                          config=cfg()) for i in range(2)]
+    try:
+        create_dc_cluster("dcL", 4, servers)
+        api = servers[0].api
+        # keys owned by the REMOTE member (partition 1/3 -> L1)
+        remote_key = 1
+        ct = api.update_objects_static(
+            None, [((remote_key, "counter_pn", "b"), "increment", 1)])
+
+        def p50(fn, n=200):
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                fn()
+                ts.append(time.perf_counter() - t0)
+            return round(float(np.percentile(ts, 50)) * 1e6, 1)
+
+        ping = p50(lambda: servers[0].link.request("L1", "check_up",
+                                                   None))
+        read = p50(lambda: api.read_objects_static(
+            ct, [(remote_key, "counter_pn", "b")]))
+
+        def commit():
+            api.update_objects_static(
+                None, [((remote_key, "counter_pn", "b"),
+                        "increment", 1)])
+
+        commit_us = p50(commit)
+        return {"ping_us": ping, "remote_read_us": read,
+                "remote_commit_us": commit_us}
+    finally:
+        for s in servers:
+            s.close()
+
+
 def main():
     quick, _jax = setup()
     from antidote_tpu.api import AntidoteTPU
@@ -252,9 +323,11 @@ def main():
         # measured concurrency: flush batch sizes — hence XLA program
         # shapes — depend on thread interleaving, and a compile inside
         # the timed region would swamp it
+        warm_keyspace(db, K)
         run_direct(db, n_threads, 60, K, seed=999)
 
-        tput_1, _, _ = run_direct(db, 1, txns, K, seed=1)
+        tput_1, lat_1, _ = run_direct(db, 1, txns, K, seed=1)
+        p50_1t, p99_1t = _percentiles(lat_1)
         tput_n, lat, aborts = run_direct(db, n_threads, txns, K, seed=2)
         p50, p99 = _percentiles(lat)
         pb_tput, pb_lat, pb_aborts = run_pb(
@@ -272,6 +345,13 @@ def main():
         n_clients = max(2, min(4, cores // 2)) if quick else \
             max(4, min(8, cores - n_nodes))
         cl_threads = 2 if cores < 4 else 4
+        # RPC latency decomposition: sequential, so honest even on a
+        # starved box (in-process 2-member cluster over the real
+        # fabric)
+        try:
+            cluster_lat = run_cluster_latency(os.path.join(tmp, "L"))
+        except Exception:  # noqa: BLE001 — a lat probe must not kill
+            cluster_lat = None
         cluster_starved = cores < n_nodes + n_clients
         if cluster_starved:
             cluster_tput = cluster_tput_1 = cluster_aborts = None
@@ -291,6 +371,12 @@ def main():
          round(tput_n / tput_1, 2),
          threads=n_threads, txns_per_thread=txns, keys=K,
          p50_ms=p50, p99_ms=p99,
+         # single-thread percentiles separate the FRAMEWORK's commit
+         # path from closed-loop queueing: N threads on fewer cores
+         # measure OS/GIL time-slicing in the tail (the 8-thread
+         # p99/p50 ratio is flagged starved on such hosts)
+         p50_1t_ms=p50_1t, p99_1t_ms=p99_1t,
+         latency_starved=(os.cpu_count() or 1) < n_threads,
          single_thread_txn_per_sec=round(tput_1),
          pb_txn_per_sec=round(pb_tput), pb_p50_ms=pb50, pb_p99_ms=pb99,
          # the pb row runs 8 client threads + the server in ONE
@@ -302,6 +388,7 @@ def main():
              pb_aborts / max(pb_aborts + len(pb_lat), 1), 4),
          cluster_txn_per_sec=(round(cluster_tput)
                               if cluster_tput is not None else None),
+         cluster_rpc_latency=cluster_lat,
          cluster_starved=cluster_starved,
          cluster_nodes=n_nodes,
          cluster_clients=n_clients,

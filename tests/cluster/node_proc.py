@@ -38,6 +38,17 @@ def main():
     node_id = sys.argv[1]
     data_dir = sys.argv[2]
     port = int(sys.argv[3])
+    faults = sys.argv[4] if len(sys.argv) > 4 else ""
+    if "die_in_resize_swap" in faults:
+        # crash injection: kill -9 semantics at the nastiest resize
+        # point — journal + new plan persisted, staged logs complete,
+        # live logs NOT yet swapped (restart must resume via journal)
+        from antidote_tpu.txn.node import Node
+
+        def dying(self, old_n, new_n):
+            os._exit(9)
+
+        Node._complete_resize_swap = dying
     srv = NodeServer(node_id, port=port, data_dir=data_dir,
                      config=Config(heartbeat_s=0.02, sync_log=True,
                                    clock_wait_timeout_s=20.0))
@@ -75,6 +86,12 @@ def main():
             elif cmd == "stable":
                 out({"stable": dict(
                     srv.plane.get_stable_snapshot())})
+            elif cmd == "resize":
+                ring = srv.resize_cluster(int(req["n"]))
+                out({"ring": {str(p): o for p, o in ring.items()}})
+            elif cmd == "width":
+                out({"n": srv.node.config.n_partitions,
+                     "parked": srv._resize_parking})
             elif cmd == "kill":
                 os._exit(9)
             elif cmd == "exit":

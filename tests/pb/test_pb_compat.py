@@ -211,3 +211,162 @@ def test_frame_layout_matches_reference_packet4():
                         compat.CODES["ApbAbortTransaction"]) + body
     assert frame.hex() == "0000000478" + body.hex()
     assert compat.CODES["ApbAbortTransaction"] == 120
+
+# --------------------------------------------------- full golden corpus
+
+#: canonical instance bytes for EVERY message code the compat layer
+#: registers (round-4 verdict item 8: the corpus must span 107-128 + 0
+#: so a future diff against a real antidotec_pb capture is mechanical
+#: per message, not archaeological).  See the divergence-diff
+#: procedure in pb/compat.py's module docstring.
+_GOLDEN_FRAMES = [
+    ("ApbErrorResp", 0, "0a036572721000"),
+    ("ApbRegUpdate", 107, "0a0176"),
+    ("ApbGetRegResp", 108, "0a0176"),
+    ("ApbCounterUpdate", 109, "0802"),
+    ("ApbGetCounterResp", 110, "080e"),
+    ("ApbOperationResp", 111, "0801"),
+    ("ApbSetUpdate", 112, "0801120165"),
+    ("ApbGetSetResp", 113, "0a0165"),
+    ("ApbTxnProperties", 114, ""),
+    ("ApbBoundObject", 115, "0a016b10031a0162"),
+    ("ApbReadObjects", 116, "0a080a016b10031a0162120154"),
+    ("ApbUpdateOp", 117, "0a080a016b10031a016212040a020802"),
+    ("ApbUpdateObjects", 118,
+     "0a100a080a016b10031a016212040a020802120154"),
+    ("ApbStartTransaction", 119, "1200"),
+    ("ApbAbortTransaction", 120, "0a0154"),
+    ("ApbCommitTransaction", 121, "0a0154"),
+    ("ApbStaticUpdateObjects", 122,
+     "0a02120012100a080a016b10031a016212040a020802"),
+    ("ApbStaticReadObjects", 123, "0a02120012080a016b10031a0162"),
+    ("ApbStartTransactionResp", 124, "0801120154"),
+    ("ApbReadObjectResp", 125, "0a02080e"),
+    ("ApbReadObjectsResp", 126, "080112040a02080e"),
+    ("ApbCommitResp", 127, "0801120143"),
+    ("ApbStaticReadObjectsResp", 128,
+     "0a08080112040a02080e12050801120143"),
+]
+
+
+def _canonical_instance(name):
+    """The fixed canonical instance each golden frame pins."""
+    b = cpb.ApbBoundObject()
+    b.key, b.type, b.bucket = b"k", cpb.COUNTER, b"b"
+    m = getattr(cpb, name)()
+    if name == "ApbErrorResp":
+        m.errmsg, m.errcode = b"err", 0
+    elif name in ("ApbRegUpdate", "ApbGetRegResp"):
+        m.value = b"v"
+    elif name == "ApbCounterUpdate":
+        m.inc = 1
+    elif name == "ApbGetCounterResp":
+        m.value = 7
+    elif name in ("ApbOperationResp",):
+        m.success = True
+    elif name == "ApbSetUpdate":
+        m.optype = cpb.ApbSetUpdate.ADD
+        m.adds.append(b"e")
+    elif name == "ApbGetSetResp":
+        m.value.append(b"e")
+    elif name == "ApbBoundObject":
+        m.CopyFrom(b)
+    elif name == "ApbReadObjects":
+        m.transaction_descriptor = b"T"
+        m.boundobjects.add().CopyFrom(b)
+    elif name == "ApbUpdateOp":
+        m.boundobject.CopyFrom(b)
+        m.operation.counterop.inc = 1
+    elif name == "ApbUpdateObjects":
+        m.transaction_descriptor = b"T"
+        u = m.updates.add()
+        u.boundobject.CopyFrom(b)
+        u.operation.counterop.inc = 1
+    elif name == "ApbStartTransaction":
+        m.properties.SetInParent()
+    elif name in ("ApbAbortTransaction", "ApbCommitTransaction"):
+        m.transaction_descriptor = b"T"
+    elif name == "ApbStaticUpdateObjects":
+        m.transaction.properties.SetInParent()
+        u = m.updates.add()
+        u.boundobject.CopyFrom(b)
+        u.operation.counterop.inc = 1
+    elif name == "ApbStaticReadObjects":
+        m.transaction.properties.SetInParent()
+        m.objects.add().CopyFrom(b)
+    elif name == "ApbStartTransactionResp":
+        m.success, m.transaction_descriptor = True, b"T"
+    elif name == "ApbReadObjectResp":
+        m.counter.value = 7
+    elif name == "ApbReadObjectsResp":
+        m.success = True
+        m.objects.add().counter.value = 7
+    elif name == "ApbCommitResp":
+        m.success, m.commit_time = True, b"C"
+    elif name == "ApbStaticReadObjectsResp":
+        m.objects.success = True
+        m.objects.objects.add().counter.value = 7
+        m.committime.success = True
+        m.committime.commit_time = b"C"
+    return m
+
+
+def test_golden_corpus_covers_every_code():
+    assert sorted(n for n, _c, _h in _GOLDEN_FRAMES) == \
+        sorted(compat.CODES)
+
+
+@pytest.mark.parametrize("name,code,hexbytes", _GOLDEN_FRAMES)
+def test_golden_frame(name, code, hexbytes):
+    assert compat.CODES[name] == code
+    m = _canonical_instance(name)
+    assert m.SerializeToString().hex() == hexbytes, name
+    # and the frame round-trips through the transcribed schema
+    m2 = getattr(cpb, name)()
+    m2.ParseFromString(bytes.fromhex(hexbytes))
+    assert m2 == m
+
+
+def test_interactive_error_and_abort_flow(served):
+    """Interactive flow exercising the ERROR and ABORT codes end to
+    end: start -> update unknown-type error -> abort -> commit of the
+    aborted descriptor errors."""
+    s = served
+    st = cpb.ApbStartTransaction()
+    st.properties.SetInParent()
+    _send(s, st)
+    resp = _recv(s)
+    assert type(resp).__name__ == "ApbStartTransactionResp"
+    assert resp.success
+    txd = resp.transaction_descriptor
+
+    up = cpb.ApbUpdateObjects()
+    up.transaction_descriptor = txd
+    u = up.updates.add()
+    # op/type mismatch: a counter increment against an ORSET key
+    u.boundobject.key = b"g"
+    u.boundobject.type = cpb.ORSET
+    u.boundobject.bucket = b"b"
+    u.operation.counterop.inc = 1
+    _send(s, up)
+    resp = _recv(s)
+    name = type(resp).__name__
+    assert name in ("ApbErrorResp", "ApbOperationResp"), name
+    if name == "ApbOperationResp":
+        assert not resp.success
+
+    ab = cpb.ApbAbortTransaction()
+    ab.transaction_descriptor = txd
+    _send(s, ab)
+    resp = _recv(s)
+    assert type(resp).__name__ in ("ApbOperationResp",
+                                   "ApbErrorResp")
+
+    cm = cpb.ApbCommitTransaction()
+    cm.transaction_descriptor = txd
+    _send(s, cm)
+    resp = _recv(s)
+    name = type(resp).__name__
+    assert name in ("ApbErrorResp", "ApbCommitResp"), name
+    if name == "ApbCommitResp":
+        assert not resp.success
