@@ -256,7 +256,11 @@ class NodeServer:
                 host, port = planned
         self.link = build_link(node_id, host=host, port=port,
                                config=self.config)
-        self.addr = self.link.serve(self._handle)
+        # every attribute _handle touches must exist BEFORE serve():
+        # a restarting member's peers dial the advertised address the
+        # moment it binds, and a gossip arriving mid-__init__ used to
+        # AttributeError (which also put the SENDER on its 2 s
+        # backoff, delaying the restarted member's stable view)
         self.node: Optional[ClusterNode] = None
         self.api = None
         self.plane: Optional[ClusterStablePlane] = None
@@ -294,7 +298,14 @@ class NodeServer:
         #: (consumed by resize_commit) and the parking flag that
         #: refuses part RPCs while this member's width is mid-change
         self._resize_fold = None
-        self._resize_parking = False
+        # PARKED BEFORE THE FABRIC BINDS when restarting mid-resize:
+        # a peer still routing at the old partition width must not
+        # land a key on a wrong-width partition in the window between
+        # serve() and the marker check below (the gate freeze itself
+        # needs the assembled node and follows)
+        self._resize_parking = (
+            self.meta.get("cluster_resize") is not None)
+        self.addr = self.link.serve(self._handle)
         plan = self.meta.get("cluster_plan")
         if plan is not None:
             # restart: a node-level resize journal means this member
@@ -306,12 +317,12 @@ class NodeServer:
             # check_node_restart, src/inter_dc_manager.erl:156-201)
             self._assemble(*plan)
             self._resume_handoff_out()
-            if self.meta.get("cluster_resize") is not None:
-                # killed mid-cluster-resize: come back FROZEN (parked)
-                # — serving at this member's width while peers may
-                # hold another would split key routing; the driver's
+            if self._resize_parking:
+                # killed mid-cluster-resize: come back FROZEN (part
+                # RPCs were already parked before the fabric bound) —
+                # serving at this member's width while peers may hold
+                # another would split key routing; the driver's
                 # resize_cluster re-run finishes and unfreezes
-                self._resize_parking = True
                 self.node.txn_gate.freeze()
                 log.warning(
                     "%r restarted mid-cluster-resize: parked until the "
@@ -568,6 +579,45 @@ class NodeServer:
                 # the CURRENT handoff state instead of silently losing
                 # the append (advisor r04 TOCTOU)
                 self._handoff_refusal(p, self._handoff.get(p))
+        if kind == "part_multi":
+            # per-owner batched read: ONE fabric round trip carries a
+            # whole member's share of a multi-partition read, answered
+            # by the fused per-chip fold (txn/manager.read_many_fused)
+            # — the remote mirror of the coordinator's local fusion
+            if self.node is None:
+                raise RemoteCallError("node not assembled yet")
+            if self._resize_parking:
+                from antidote_tpu.cluster.remote import HandoffParked
+
+                raise HandoffParked(
+                    f"cluster resize in progress at {self.node_id!r}")
+            groups_payload, snapshot_vc, txid = payload
+            groups = []
+            for p, items in groups_payload:
+                p = int(p)
+                st = self._handoff.get(p)
+                if st is not None and st["state"] != "drain":
+                    # reads flow during a drain (matching "part");
+                    # retired/in_doubt refuse for the WHOLE batch —
+                    # the caller heals partition by partition
+                    self._handoff_refusal(p, st)
+                pm = self.node.partitions[p]
+                if not isinstance(pm, PartitionManager):
+                    raise RemoteCallError(
+                        f"partition {p} not owned by "
+                        f"{self.node_id!r} (stale ring at {origin!r}?)")
+                groups.append((pm, [tuple(i) for i in items]))
+            from antidote_tpu.txn.manager import read_many_fused
+
+            try:
+                return read_many_fused(groups, snapshot_vc, txid)
+            except PartitionRetired:
+                # raced a cutover mid-batch: refuse; the caller's
+                # per-partition fallback self-heals each slot
+                from antidote_tpu.cluster.remote import HandoffParked
+
+                raise HandoffParked(
+                    "partition draining for handoff") from None
         if kind == "ring":
             if self.node is None:
                 raise RemoteCallError("node not assembled yet")

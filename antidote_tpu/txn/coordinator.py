@@ -35,13 +35,6 @@ from antidote_tpu.txn.manager import (
 )
 
 
-def _is_wrong_owner(exc) -> bool:
-    # imported lazily: the cluster package imports this module back
-    from antidote_tpu.cluster.remote import WrongOwner
-
-    return isinstance(exc, WrongOwner)
-
-
 def _is_retryable_route(exc) -> bool:
     """Errors the synchronous proxy path self-heals: a moved partition
     (re-resolve the ring) or a drain-window refusal (back off and
@@ -310,6 +303,34 @@ class Coordinator:
 
     # ---------------------------------------------------------------- reads
 
+    def _multi_or_fallback(self, link, owner, payload, groups, tx):
+        """One per-owner batched read over a non-pipelined link, with
+        the per-partition self-healing path as fallback."""
+        try:
+            return link.request(owner, "part_multi", payload)
+        except Exception as e:  # noqa: BLE001 — heal per partition
+            return self._read_groups_fallback(groups, tx, e)
+
+    def _read_groups_fallback(self, groups, tx, err):
+        """Resolve a failed per-owner batch partition by partition.
+        Only ROUTING-class failures fall back (a moved/draining slot,
+        or a RemoteCallError — which also covers an older peer that
+        does not speak part_multi): the per-partition path self-heals
+        those.  A real error (a read timeout on a prepared txn, a
+        link failure) re-raises immediately — re-issuing every
+        partition's read would serialize the same wait N times over
+        before surfacing the same failure."""
+        from antidote_tpu.cluster.remote import RemoteCallError
+
+        if not (_is_retryable_route(err)
+                or isinstance(err, RemoteCallError)):
+            raise err
+        values: dict = {}
+        for pm, items in groups:
+            values.update(pm.read_many(items, tx.snapshot_vc,
+                                       txid=tx.txid))
+        return values
+
     def read_objects(self, tx: Transaction, bound_objects: List) -> List[Any]:
         """Reads grouped per partition and executed as one batched call
         each (async batched reads, reference
@@ -342,29 +363,40 @@ class Coordinator:
                 metas.append((key, cls, pm))
                 by_pm.setdefault(pm, []).append((key, cls.name))
             values: dict = {}
-            # remote partitions on a pipelined link: start every
-            # read_many first, resolve local partitions while the
-            # frames are in flight, collect the round in one native
-            # wait (the reference's async batched reads,
-            # src/clocksi_interactive_coord.erl:731-747)
+            # remote partitions batch PER OWNER MEMBER (one fabric
+            # round trip per node, fused per-chip server-side —
+            # cluster/node.py "part_multi"), started first on a
+            # pipelined link so local partitions resolve while the
+            # frames are in flight (the reference's async batched
+            # reads, src/clocksi_interactive_coord.erl:731-747)
             handles = []
             link = None
             try:
                 local_groups = []
+                by_owner: dict = {}
                 for pm, items in by_pm.items():
-                    if (getattr(pm, "deferred_stage", False)
-                            and hasattr(pm.link, "finish_many")):
-                        link = pm.link
-                        handles.append((pm.start_call(
-                            "read_many", items, tx.snapshot_vc,
-                            txid=tx.txid), pm, items))
-                    elif isinstance(pm, PartitionManager):
+                    if isinstance(pm, PartitionManager):
                         local_groups.append((pm, items))
+                    elif hasattr(pm, "owner") and hasattr(pm, "link"):
+                        by_owner.setdefault(pm.owner, []).append(
+                            (pm, items))
                     else:
-                        # a remote proxy on a non-pipelined fabric:
-                        # plain call — it has no begin/finish split
+                        # a stand-in without the proxy surface (the
+                        # mocked test tier): plain per-partition call
                         values.update(pm.read_many(
                             items, tx.snapshot_vc, txid=tx.txid))
+                for owner, groups in by_owner.items():
+                    payload = ([(pm.partition, items)
+                                for pm, items in groups],
+                               tx.snapshot_vc, tx.txid)
+                    l = groups[0][0].link
+                    if hasattr(l, "finish_many"):
+                        link = l
+                        handles.append((l.start_request(
+                            owner, "part_multi", payload), groups))
+                    else:
+                        values.update(self._multi_or_fallback(
+                            l, owner, payload, groups, tx))
                 if len(local_groups) == 1:
                     pm, items = local_groups[0]
                     values.update(pm.read_many(
@@ -379,21 +411,19 @@ class Coordinator:
                 # a local read failed mid-round: started remote calls
                 # must not leak their native completion slots
                 if handles:
-                    link.abandon([h for h, _pm, _it in handles])
+                    link.abandon([h for h, _g in handles])
                 raise
             if handles:
-                for (ok, val), (_h, pm, items) in zip(
-                        link.finish_many([h for h, _pm, _it in handles]),
+                for (ok, val), (_h, groups) in zip(
+                        link.finish_many([h for h, _g in handles]),
                         handles):
                     if ok:
                         values.update(val)
-                    elif _is_wrong_owner(val):
-                        # moved mid-read (handoff): the synchronous
-                        # path self-heals the proxy and retries
-                        values.update(pm.read_many(
-                            items, tx.snapshot_vc, txid=tx.txid))
                     else:
-                        raise val
+                        # moved/parked/unsupported mid-read: the
+                        # per-partition path self-heals each proxy
+                        values.update(self._read_groups_fallback(
+                            groups, tx, val))
             out = []
             for key, cls, pm in metas:
                 value = values[(key, cls.name)]

@@ -130,3 +130,39 @@ def test_unplaced_node_still_correct(tmp_path):
         assert used <= 1, used  # one chip, one fused program
     finally:
         db.close()
+
+
+def test_fused_failure_falls_back_to_per_fold(tmp_path, monkeypatch):
+    """A failing fused program must not lose the read or leak reader
+    counts: each partition's own fold serves, and a later flush (which
+    waits for readers to drain) still completes."""
+    db = _db(tmp_path, n_partitions=8)
+    try:
+        tx = db.start_transaction()
+        db.update_objects(
+            [((k, "counter_pn", "b"), "increment", k + 1)
+             for k in range(16)], tx)
+        cvc = db.commit_transaction(tx)
+        for pm in db.node.partitions:
+            pm._val_cache.clear()
+
+        def boom(splits):
+            raise RuntimeError("injected fused failure")
+
+        monkeypatch.setattr(device_plane, "fused_read", boom)
+        import antidote_tpu.txn.manager as manager
+        monkeypatch.setattr(manager, "fused_read", boom, raising=False)
+        tx = db.start_transaction(clock=cvc)
+        vals = db.read_objects(
+            [(k, "counter_pn", "b") for k in range(16)], tx)
+        db.commit_transaction(tx)
+        assert vals == [k + 1 for k in range(16)]
+        # reader counts drained: a write+flush completes promptly
+        tx = db.start_transaction()
+        db.update_objects([((0, "counter_pn", "b"), "increment", 1)],
+                          tx)
+        db.commit_transaction(tx)
+        for pm in db.node.partitions:
+            assert pm._dev_readers == 0
+    finally:
+        db.close()
