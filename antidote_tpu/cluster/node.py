@@ -542,55 +542,41 @@ class NodeServer:
                 self.plane.put_node(nid, vc)
             return None
         if kind == "part":
-            if self.node is None:
-                raise RemoteCallError("node not assembled yet")
-            if self._resize_parking:
-                # this member's partition WIDTH is mid-change: a peer
-                # still routing with the old width would land keys on
-                # the wrong partition — refuse retryably until the
-                # resize finishes cluster-wide
-                from antidote_tpu.cluster.remote import HandoffParked
-
-                raise HandoffParked(
-                    f"cluster resize in progress at {self.node_id!r}")
+            self._require_serving()
             p, method, args, kwargs = payload
-            if method not in PARTITION_METHODS:
-                raise RemoteCallError(f"method {method!r} not allowed")
-            st = self._handoff.get(p)
-            if st is not None and (st["state"] != "drain"
-                                   or method in _HANDOFF_PARKED):
-                # mutating work during a drain is refused with a
-                # RETRYABLE error — the proxy backs off and re-sends;
-                # refusing instead of parking keeps every fabric
-                # worker free for the reads and commit/abort traffic
-                # the drain itself is waiting on (advisor r04)
-                self._handoff_refusal(p, st)
-            pm = self.node.partitions[p]
-            if not isinstance(pm, PartitionManager):
-                raise RemoteCallError(
-                    f"partition {p} not owned by {self.node_id!r} "
-                    f"(stale ring at {origin!r}?)")
-            try:
-                return getattr(pm, method)(*args, **kwargs)
-            except PartitionRetired:
-                # this call raced the cutover's drain refusal: it
-                # passed the state check above before drain was set,
-                # then hit the retired flag under pm._lock — map by
-                # the CURRENT handoff state instead of silently losing
-                # the append (advisor r04 TOCTOU)
-                self._handoff_refusal(p, self._handoff.get(p))
+            return self._part_call(origin, int(p), method, args,
+                                   kwargs)
+        if kind == "part_batch":
+            # per-owner batched 2PC round: one frame carries a whole
+            # member's share of the fan-out (prepare/commit/abort...)
+            # with ELEMENT-WISE results — a certification conflict on
+            # one partition must not mask the others' replies
+            self._require_serving()
+            from antidote_tpu.cluster.link import _err_kind
+
+            (calls,) = payload
+            out = []
+            for p, method, args, kwargs in calls:
+                try:
+                    out.append((True, self._part_call(
+                        origin, int(p), method, args, kwargs)))
+                except Exception as e:  # noqa: BLE001 — element-wise
+                    ek = _err_kind(e)
+                    if ek == "generic":
+                        # a lone "part" failure logs its traceback in
+                        # the fabric worker; a batched element must
+                        # stay as diagnosable
+                        log.exception(
+                            "part_batch element failed "
+                            "(p=%s %s from %r)", p, method, origin)
+                    out.append((False, (ek, str(e))))
+            return out
         if kind == "part_multi":
             # per-owner batched read: ONE fabric round trip carries a
             # whole member's share of a multi-partition read, answered
             # by the fused per-chip fold (txn/manager.read_many_fused)
             # — the remote mirror of the coordinator's local fusion
-            if self.node is None:
-                raise RemoteCallError("node not assembled yet")
-            if self._resize_parking:
-                from antidote_tpu.cluster.remote import HandoffParked
-
-                raise HandoffParked(
-                    f"cluster resize in progress at {self.node_id!r}")
+            self._require_serving()
             groups_payload, snapshot_vc, txid = payload
             groups = []
             for p, items in groups_payload:
@@ -692,6 +678,50 @@ class NodeServer:
         raise RemoteCallError(f"unknown node RPC kind {kind!r}")
 
     # ----------------------------------------------------- cross-node handoff
+
+    def _require_serving(self) -> None:
+        """Shared partition-RPC admission guard (part / part_multi /
+        part_batch): a member must be assembled, and while its
+        partition WIDTH is mid-change a peer still routing at the old
+        width would land keys on the wrong partition — refuse
+        retryably until the resize finishes cluster-wide."""
+        if self.node is None:
+            raise RemoteCallError("node not assembled yet")
+        if self._resize_parking:
+            from antidote_tpu.cluster.remote import HandoffParked
+
+            raise HandoffParked(
+                f"cluster resize in progress at {self.node_id!r}")
+
+    def _part_call(self, origin, p: int, method: str, args, kwargs):
+        """One partition-method dispatch with the full handoff-state
+        discipline — shared by the "part" RPC and each element of a
+        "part_batch" frame."""
+        if method not in PARTITION_METHODS:
+            raise RemoteCallError(f"method {method!r} not allowed")
+        st = self._handoff.get(p)
+        if st is not None and (st["state"] != "drain"
+                               or method in _HANDOFF_PARKED):
+            # mutating work during a drain is refused with a RETRYABLE
+            # error — the proxy backs off and re-sends; refusing
+            # instead of parking keeps every fabric worker free for
+            # the reads and commit/abort traffic the drain itself is
+            # waiting on (advisor r04)
+            self._handoff_refusal(p, st)
+        pm = self.node.partitions[p]
+        if not isinstance(pm, PartitionManager):
+            raise RemoteCallError(
+                f"partition {p} not owned by {self.node_id!r} "
+                f"(stale ring at {origin!r}?)")
+        try:
+            return getattr(pm, method)(*args, **kwargs)
+        except PartitionRetired:
+            # this call raced the cutover's drain refusal: it passed
+            # the state check above before drain was set, then hit
+            # the retired flag under pm._lock — map by the CURRENT
+            # handoff state instead of silently losing the append
+            # (advisor r04 TOCTOU)
+            self._handoff_refusal(p, self._handoff.get(p))
 
     def _handoff_refusal(self, p: int, st: Optional[dict]):
         """Raise the typed refusal for a partition in handoff state

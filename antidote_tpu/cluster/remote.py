@@ -110,18 +110,6 @@ class RemotePartition:
             self.link.connect(new_owner, members[new_owner])
         self.owner = new_owner
 
-    # -- pipelined calls (native fabric, cluster/nativelink.py) -----------
-
-    def start_call(self, method: str, *args, **kwargs):
-        """Queue the call and return a handle; collect with the link's
-        finish_request / finish_many.  The coordinator starts every
-        remote 2PC participant's call from one thread, runs the local
-        participants while the frames are in flight, then collects the
-        round in a single native wait (coordinator._fan_out)."""
-        return self.link.start_request(
-            self.owner, "part",
-            (self.partition, method, tuple(args), dict(kwargs)))
-
     # -- reads ------------------------------------------------------------
 
     def read(self, key, type_name: str, snapshot_vc: Optional[VC],

@@ -35,6 +35,19 @@ from antidote_tpu.txn.manager import (
 )
 
 
+def _batch_never_ran(exc) -> bool:
+    """True only for whole-batch refusals raised BEFORE any element
+    executed (the receiving handler's own guards) — the cases where
+    re-sending the batch's mutating calls cannot double-apply."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    if not isinstance(exc, RemoteCallError):
+        return False
+    msg = str(exc)
+    return ("unknown node RPC kind" in msg
+            or "node not assembled yet" in msg)
+
+
 def _is_retryable_route(exc) -> bool:
     """Errors the synchronous proxy path self-heals: a moved partition
     (re-resolve the ring) or a drain-window refusal (back off and
@@ -129,12 +142,18 @@ def _fan_out(pairs, fn, spec=None):
     in-flight work).
 
     When ``spec(p, pm) -> (method, args, kwargs)`` is given and the
-    remote link is pipelined (cluster/nativelink.py), all remote calls
-    are STARTED first from this thread (zero thread spawns — the
-    reference's async broadcast, src/clocksi_interactive_coord.erl:
-    514-577), local calls run while the frames are in flight, and the
-    round is collected in one native wait.  Otherwise remote calls fall
-    back to a thread per participant."""
+    remote link is pipelined (cluster/nativelink.py), the remote calls
+    are batched PER OWNER MEMBER into one "part_batch" frame each —
+    one fabric round trip per node, not per partition — started first
+    from this thread (zero thread spawns — the reference's async
+    broadcast, src/clocksi_interactive_coord.erl:514-577), local calls
+    run while the frames are in flight, and the round is collected in
+    one native wait.  Element failures inside a batch stay
+    element-wise (a certification conflict on one partition does not
+    mask the others' prepare times); a whole-batch refusal
+    (resize parking, an older peer) self-heals per participant on the
+    synchronous path.  Otherwise remote calls fall back to a thread
+    per participant."""
     import threading as _threading
 
     remote = [(i, p, pm) for i, (p, pm) in enumerate(pairs)
@@ -146,15 +165,22 @@ def _fan_out(pairs, fn, spec=None):
         link = remote[0][2].link
         if hasattr(link, "finish_many") and all(
                 pm.link is link for _i, _p, pm in remote):
+            by_owner: dict = {}
+            for i, p, pm in remote:
+                method, args, kwargs = spec(p, pm)
+                by_owner.setdefault(pm.owner, []).append(
+                    (i, pm.partition, method, tuple(args),
+                     dict(kwargs)))
             try:
-                for i, p, pm in remote:
-                    method, args, kwargs = spec(p, pm)
-                    handles.append((i, pm.start_call(method, *args,
-                                                     **kwargs)))
+                for owner, calls in by_owner.items():
+                    payload = [(part, m, a, kw)
+                               for _i, part, m, a, kw in calls]
+                    handles.append((owner, calls, link.start_request(
+                        owner, "part_batch", (payload,))))
             except BaseException:
                 # a failed start (unknown peer) must not leak the
                 # already-started calls' native completion slots
-                link.abandon([h for _i, h in handles])
+                link.abandon([h for _o, _c, h in handles])
                 raise
     if handles:
         for i, (p, pm) in enumerate(pairs):
@@ -163,20 +189,49 @@ def _fan_out(pairs, fn, spec=None):
                     results[i] = fn(p, pm)
                 except BaseException as e:  # noqa: BLE001 — below
                     errs.append(e)
+
+        def heal(i):
+            # moved/draining mid-round (cross-node handoff): the
+            # synchronous path re-resolves / backs off and retries
+            # (RemotePartition._call self-heals)
+            try:
+                results[i] = fn(pairs[i][0], pairs[i][1])
+            except BaseException as e:  # noqa: BLE001 — below
+                errs.append(e)
+
+        from antidote_tpu.cluster.link import _raise_remote
+
         link = remote[0][2].link
-        for (i, _h), (ok, val) in zip(
-                handles, link.finish_many([h for _i, h in handles])):
+        for (owner, calls, _h), (ok, val) in zip(
+                handles, link.finish_many([h for _o, _c, h in
+                                           handles])):
             if ok:
-                results[i] = val
-            elif _is_retryable_route(val):
-                # the partition moved or is draining mid-round
-                # (cross-node handoff): the synchronous path
-                # re-resolves / backs off and retries
-                # (RemotePartition._call self-heals)
-                try:
-                    results[i] = fn(pairs[i][0], pairs[i][1])
-                except BaseException as e:  # noqa: BLE001 — below
-                    errs.append(e)
+                for (i, pt, m, _a, _kw), (ok_i, v) in zip(calls, val):
+                    if ok_i:
+                        results[i] = v
+                        continue
+                    try:
+                        # (err_kind, message); keep the owner + call
+                        # in the message — a batched element failure
+                        # must stay as diagnosable as a lone RPC's
+                        _raise_remote(v[0],
+                                      f"{owner!r} p{pt} {m}: {v[1]}")
+                    except BaseException as e:  # noqa: BLE001
+                        if _is_retryable_route(e):
+                            heal(i)
+                        else:
+                            errs.append(e)
+            elif _is_retryable_route(val) or _batch_never_ran(val):
+                # provably PRE-EXECUTION refusals only: resize
+                # parking, an old peer without the RPC, a member not
+                # yet assembled.  Any other whole-batch error (a
+                # timeout whose first execution may still complete, a
+                # duplicate-request ambiguity) must NOT re-send
+                # mutating 2PC calls — re-executing an applied commit
+                # is a silent double-apply; surface it instead (the
+                # commit round maps it to CommitOutcomeUnknown).
+                for i, _pt, _m, _a, _kw in calls:
+                    heal(i)
             else:
                 errs.append(val)
         if errs:

@@ -574,3 +574,45 @@ def test_multi_partition_remote_read_is_one_rpc_per_owner(tmp_path):
     finally:
         for srv in servers:
             srv.close()
+
+
+def test_wide_txn_2pc_batches_per_owner(tmp_path):
+    """A transaction updating many remote partitions crosses the
+    fabric once per owner per ROUND (stage_prepare, commit), not once
+    per partition (the per-owner "part_batch")."""
+    servers = [
+        NodeServer(f"wb{i}", data_dir=str(tmp_path / f"wb{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers)
+        api = servers[0].api
+        link = servers[0].link
+        if not hasattr(link, "finish_many"):
+            pytest.skip("pipelined fabric unavailable")
+        calls = []
+        orig = link.start_request
+        link.start_request = (
+            lambda t, k, p: (calls.append((t, k)), orig(t, k, p))[1])
+        # touches all 8 partitions: 4 local + 4 remote (one owner)
+        tx = api.start_transaction()
+        api.update_objects(
+            [((k, "counter_pn", "b"), "increment", 1)
+             for k in range(8)], tx)
+        api.commit_transaction(tx)
+        link.start_request = orig
+        batches = [c for c in calls if c[1] == "part_batch"]
+        parts = [c for c in calls if c[1] == "part"]
+        # one batched frame per round (prepare + commit = 2), nothing
+        # per partition
+        assert len(batches) == 2 and not parts, calls
+        # and the data is right
+        tx = api.start_transaction()
+        vals = api.read_objects(
+            [(k, "counter_pn", "b") for k in range(8)], tx)
+        api.commit_transaction(tx)
+        assert vals == [1] * 8
+    finally:
+        for srv in servers:
+            srv.close()
