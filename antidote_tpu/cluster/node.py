@@ -298,6 +298,7 @@ class NodeServer:
         #: (consumed by resize_commit) and the parking flag that
         #: refuses part RPCs while this member's width is mid-change
         self._resize_fold = None
+        self._resize_ring = None
         # PARKED BEFORE THE FABRIC BINDS when restarting mid-resize:
         # a peer still routing at the old partition width must not
         # land a key on a wrong-width partition in the window between
@@ -807,6 +808,13 @@ class NodeServer:
         before it starts — "probe answered not-adopted, then the
         install applied anyway" cannot happen (the double-owner race
         the round-4 advisor flagged)."""
+        if self.meta.get("cluster_resize") is not None:
+            # freeze order is per-member: this receiver may be frozen
+            # while the pushing owner is not yet — adopting an
+            # old-width partition here would dodge the resize barrier
+            # (the owner's cutover settles via the probe and resumes)
+            raise RemoteCallError(
+                "cluster resize in progress; no install may land")
         ent = self._handoff_in_entry(p)
         with ent["lock"]:
             if ent["cancelled"]:
@@ -881,6 +889,14 @@ class NodeServer:
         #: never clean-resume (the clean path deletes the journal)
         prior_intent = p in (self.meta.get("handoff_out") or {})
         self._handoff[p] = {"state": "drain", "new_owner": new_owner}
+        # flag-then-check against a racing resize_freeze (which sets
+        # its marker, then looks for drain entries): with both sides
+        # re-checking after setting their own flag, one of the two
+        # admin operations always sees the other and backs out
+        if self.meta.get("cluster_resize") is not None:
+            self._handoff.pop(p, None)
+            raise RemoteCallError(
+                "cluster resize in progress; no cutover may start")
         install_sent = False
         try:
             with self.node.txn_gate.exclusive():
@@ -1247,9 +1263,7 @@ class NodeServer:
             raise RemoteCallError("node not assembled yet")
         if node.config.n_partitions == new_n:
             return "done"  # idempotent re-drive after a crash
-        if self._handoff:
-            raise RemoteCallError(
-                "handoff in flight; resolve it before resizing")
+        self._refuse_if_handoff_busy()
         if not node.config.enable_logging:
             raise RemoteCallError(
                 "resize folds the durable logs; enable_logging=False "
@@ -1257,6 +1271,10 @@ class NodeServer:
         if self.source_factory is not None:
             raise RemoteCallError(
                 "member is federated; disconnect before resizing")
+        #: the ring the fold slices were built against — commit/freeze
+        #: refuse if ownership moved afterwards (the folds would stage
+        #: the wrong slots)
+        self._resize_ring = dict(node.ring)
         if self.node_id not in set(node.ring.values()):
             self._resize_fold = None  # coordinator-only member
             return "client"
@@ -1264,9 +1282,45 @@ class NodeServer:
         self._resize_fold.serve_passes(max_passes, delta_threshold)
         return "prepared"
 
+    def _refuse_if_handoff_busy(self) -> None:
+        """An IN-FLIGHT ownership transfer (draining or in doubt)
+        excludes a resize; COMPLETED transfers (retired redirect
+        entries, which persist for stale callers) do not."""
+        busy = [p for p, st in self._handoff.items()
+                if st["state"] in ("drain", "in_doubt")]
+        if busy:
+            raise RemoteCallError(
+                f"handoff in flight on partitions {sorted(busy)}; "
+                f"resolve it before resizing")
+        if self.meta.get("handoff_out"):
+            # a journaled transfer not yet globally re-planned: its
+            # OLD-width partition indices would be misread after the
+            # resize (restart resolution probes by index)
+            raise RemoteCallError(
+                "journaled handoff awaiting re-plan; commit the "
+                "rebalance before resizing")
+
     def _resize_freeze(self, new_n: int) -> bool:
+        # flag-then-check, mirrored by the cutover (which sets its
+        # drain entry, then re-checks this marker): whichever admin
+        # operation loses the race sees the other's flag and backs
+        # out — neither can slip through the check-then-act window
         self.meta.put("cluster_resize", int(new_n))
         self.node.txn_gate.freeze()
+        try:
+            self._refuse_if_handoff_busy()
+            if self._resize_ring is not None and \
+                    self._resize_ring != dict(self.node.ring):
+                # a rebalance COMPLETED between prepare and freeze:
+                # the folds staged at prepare no longer match
+                # ownership — the driver must re-prepare
+                raise RemoteCallError(
+                    "ring changed since resize_prepare; re-drive "
+                    "the resize")
+        except BaseException:
+            self.meta.delete("cluster_resize")
+            self.node.txn_gate.unfreeze()
+            raise
         return True
 
     def _resize_commit(self, new_n: int) -> str:
@@ -1274,6 +1328,11 @@ class NodeServer:
         old_n = node.config.n_partitions
         if old_n == new_n:
             return "done"
+        if self._resize_ring is not None and \
+                self._resize_ring != dict(node.ring):
+            raise RemoteCallError(
+                "ring changed since resize_prepare; re-drive the "
+                "resize")
         self._resize_parking = True
         data_member = self.node_id in set(node.ring.values())
         new_ring = {q: node.ring[q % old_n] for q in range(new_n)}
@@ -1306,6 +1365,14 @@ class NodeServer:
                            dict(self._members)))
         node.config.n_partitions = new_n
         node.ring = dict(new_ring)
+        # completed-handoff redirect entries and stable pins are keyed
+        # by OLD-width partition indices: left in place they would
+        # shadow (WrongOwner) or mis-pin the NEW partitions that reuse
+        # those indices.  The freshly persisted plan supersedes them —
+        # every remaining entry is "retired" (drain/in_doubt refused
+        # at prepare AND freeze).
+        self._handoff.clear()
+        self._stable_pins.clear()
         node.partitions = [node._build_partition(q)
                            for q in range(new_n)]
         if data_member:
@@ -1315,6 +1382,7 @@ class NodeServer:
             # every committed key
             node._recover_stores()
         self._resize_fold = None
+        self._resize_ring = None
         self._install_stable_plane(
             prev_stable=self.plane.get_stable_snapshot()
             if self.plane else None)

@@ -1,0 +1,130 @@
+"""Elasticity soak: every cluster reshaping operation in sequence on
+ONE live DC under continuous writers — membership growth, ownership
+rebalance, partition-count resize, member crash + restart — with an
+exact-total oracle at every checkpoint.  The interactions between the
+mechanisms (a rebalance after a resize, a restart after both, batched
+2PC/read RPCs across all of it) are where composition bugs live;
+the per-mechanism suites cannot see them."""
+
+import threading
+import time
+
+from antidote_tpu.cluster import NodeServer, create_dc_cluster
+from antidote_tpu.config import Config
+from antidote_tpu.txn.coordinator import TransactionAborted
+from antidote_tpu.txn.manager import PartitionManager
+
+
+def _cfg():
+    return Config(n_partitions=4, heartbeat_s=0.05)
+
+
+def test_full_elasticity_soak(tmp_path):
+    servers = {
+        f"s{i}": NodeServer(f"s{i}", data_dir=str(tmp_path / f"s{i}"),
+                            config=_cfg())
+        for i in range(2)
+    }
+    extra = None
+    stop = threading.Event()
+    committed = [0, 0]
+    maybes = [0, 0]  # commit raised AFTER the decision may have landed
+    errs = []
+
+    def writer(slot, api, seed):
+        k = 0
+        while not stop.is_set():
+            key = (seed * 11 + k) % 48
+            k += 1
+            tx = None
+            try:
+                tx = api.start_transaction()
+                api.update_objects(
+                    [((key, "counter_pn", "b"), "increment", 1)], tx)
+                api.commit_transaction(tx)
+                committed[slot] += 1
+            except TransactionAborted:
+                pass
+            except TimeoutError:
+                # a timeout during COMMIT may have applied (reply
+                # lost after the decision): exact equality would
+                # undercount — track as in-doubt
+                if tx is not None and tx.writeset:
+                    maybes[slot] += 1
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+                return
+
+    def check_totals(api):
+        tx = api.start_transaction()
+        vals = api.read_objects(
+            [(k, "counter_pn", "b") for k in range(48)], tx)
+        api.commit_transaction(tx)
+        lo, hi = sum(committed), sum(committed) + sum(maybes)
+        assert lo <= sum(vals) <= hi, (sum(vals), lo, hi)
+
+    try:
+        create_dc_cluster("dc1", 4, list(servers.values()))
+        threads = [
+            threading.Thread(target=writer,
+                             args=(i, servers[f"s{i}"].api, i))
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+
+        # 1. grow the partition count 4 -> 8 while serving
+        servers["s0"].resize_cluster(8)
+        time.sleep(0.2)
+
+        # 2. admit a third member and hand it two children
+        extra = NodeServer("s2", data_dir=str(tmp_path / "s2"),
+                           config=_cfg())
+        servers["s0"].add_member("s2", extra.addr)
+        new_ring = dict(servers["s0"].node.ring)
+        new_ring[1] = "s2"
+        new_ring[5] = "s2"
+        servers["s0"].rebalance(new_ring)
+        time.sleep(0.2)
+
+        # 3. resize AGAIN on the reshaped 3-owner ring (8 -> 16)
+        servers["s0"].resize_cluster(16)
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "writer wedged past the join"
+        assert not errs, errs
+        assert sum(committed) > 30, committed
+        for srv in list(servers.values()) + [extra]:
+            assert srv.node.config.n_partitions == 16
+        assert isinstance(extra.node.partitions[1], PartitionManager)
+        assert isinstance(extra.node.partitions[9], PartitionManager)
+        check_totals(extra.api)
+
+        # 4. crash + restart a data member; totals survive
+        servers["s1"].close()
+        servers["s1"] = NodeServer(
+            "s1", data_dir=str(tmp_path / "s1"), config=_cfg())
+        assert servers["s1"].node.config.n_partitions == 16
+        check_totals(servers["s1"].api)
+        check_totals(servers["s0"].api)
+
+        # 5. the reshaped DC still serves new cross-node writes
+        tx = extra.api.start_transaction()
+        extra.api.update_objects(
+            [((k, "counter_pn", "b"), "increment", 1)
+             for k in range(16)], tx)
+        cvc = extra.api.commit_transaction(tx)
+        tx = servers["s0"].api.start_transaction(clock=cvc)
+        vals = servers["s0"].api.read_objects(
+            [(k, "counter_pn", "b") for k in range(16)], tx)
+        servers["s0"].api.commit_transaction(tx)
+        assert all(v >= 1 for v in vals)
+    finally:
+        stop.set()
+        for srv in servers.values():
+            srv.close()
+        if extra is not None:
+            extra.close()
