@@ -640,6 +640,9 @@ class NodeServer:
         if kind == "handoff_probe":
             (p,) = payload
             return self._handoff_probe(int(p))
+        if kind == "handoff_settle":
+            p, new_owner = payload
+            return self._handoff_settle(int(p), new_owner)
         if kind == "handoff_cutover":
             p, new_owner, b_cursor = payload
             return self._handoff_cutover(int(p), new_owner,
@@ -666,12 +669,16 @@ class NodeServer:
             return self._resize_commit(int(new_n))
         if kind == "resize_finish":
             return self._resize_finish()
+        if kind == "resize_abort":
+            return self._resize_abort()
         if kind == "status":
             return {
                 "node_id": self.node_id,
                 "assembled": self.node is not None,
                 "local_partitions":
                     self.node.local_partition_indices()
+                    if self.node else [],
+                "ring": sorted(self.node.ring.items())
                     if self.node else [],
                 "stable": dict(self.plane.get_stable_snapshot())
                     if self.plane else {},
@@ -863,6 +870,21 @@ class NodeServer:
                 ent["cancelled"] = True
             return adopted
 
+    def _handoff_settle(self, p: int, new_owner) -> bool:
+        """Driver-requested settlement of an interrupted transfer (a
+        re-driven rebalance saw the receiver adopted while this node
+        may still hold a parked in-doubt copy): probe + retire /
+        resume, exactly the cutover failure path.  True when the local
+        copy no longer serves (retired or already proxied)."""
+        if self.node is None:
+            raise RemoteCallError("node not assembled yet")
+        pm = self.node.partitions[p]
+        if not isinstance(pm, PartitionManager):
+            return True
+        self._settle_inflight_handoff(p, new_owner, pm)
+        return not isinstance(self.node.partitions[p],
+                              PartitionManager)
+
     def _handoff_cutover(self, p: int, new_owner, b_cursor: int) -> bool:
         """Owning side, cutover: drain the partition (park new mutating
         work, let prepared transactions resolve, drain local
@@ -888,13 +910,20 @@ class NodeServer:
         #: pre-install failure of THIS attempt must settle by probe,
         #: never clean-resume (the clean path deletes the journal)
         prior_intent = p in (self.meta.get("handoff_out") or {})
+        #: an existing entry (a retry of an in_doubt transfer) must be
+        #: RESTORED — not deleted — if this attempt backs out before
+        #: doing anything, or the parked-in-doubt safety state is lost
+        prior_entry = self._handoff.get(p)
         self._handoff[p] = {"state": "drain", "new_owner": new_owner}
         # flag-then-check against a racing resize_freeze (which sets
         # its marker, then looks for drain entries): with both sides
         # re-checking after setting their own flag, one of the two
         # admin operations always sees the other and backs out
         if self.meta.get("cluster_resize") is not None:
-            self._handoff.pop(p, None)
+            if prior_entry is None:
+                self._handoff.pop(p, None)
+            else:
+                self._handoff[p] = prior_entry
             raise RemoteCallError(
                 "cluster resize in progress; no cutover may start")
         install_sent = False
@@ -1044,6 +1073,20 @@ class NodeServer:
         (the riak_core ring gossip + claimant commit)."""
         if self.node is None:
             raise RemoteCallError("node not assembled yet")
+        if self.meta.get("cluster_resize") is not None:
+            # a resize is mid-flight here: adopting a re-plan now would
+            # desync this member's ring from the resize fold (and the
+            # resize's own freeze check only sees its LOCAL snapshot)
+            raise RemoteCallError(
+                "cluster resize in progress; ring update refused")
+        n = self.node.config.n_partitions
+        if sorted(ring) != list(range(n)):
+            # a re-plan broadcast that raced a completed resize: its
+            # old-width ring applied over this member would leave the
+            # widened tail permanently stale
+            raise RemoteCallError(
+                f"ring update at width {len(ring)} does not match this "
+                f"member's {n} partitions; stale re-plan refused")
         prev = self.plane.get_stable_snapshot() if self.plane else None
         self._members = dict(members)
         for nid, addr in self._members.items():
@@ -1180,16 +1223,48 @@ class NodeServer:
         moves = [(p, old_ring[p], new_ring[p])
                  for p in sorted(new_ring) if old_ring[p] != new_ring[p]]
         for p, old, new in moves:
+            # a RE-DRIVEN rebalance (an earlier attempt's broadcast was
+            # refused mid-way, e.g. by a mid-flight resize): the probe
+            # fences + reports adoption, so a move whose data already
+            # transferred is skipped instead of re-fetched from an
+            # owner that no longer holds it.  The OLD owner may still
+            # hold a parked in-doubt copy from the interrupted attempt
+            # — settle it (probe + retire) or its ring_update below
+            # would refuse 'moved without a handoff' on every re-drive
+            if bool(self._rpc(new, "handoff_probe", (p,))):
+                if not bool(self._rpc(old, "handoff_settle", (p, new))):
+                    raise RemoteCallError(
+                        f"partition {p}: receiver {new!r} adopted but "
+                        f"old owner {old!r} could not settle its copy; "
+                        f"resolve connectivity and re-drive")
+                continue
             cursor = self._rpc(new, "handoff_begin", (p, old))
             self._rpc(old, "handoff_cutover", (p, new, cursor))
         clients = sorted(set(self._members) - owners, key=repr)
         payload = (list(new_ring.items()),
                    list(self._members.items()), clients)
+        refused = []
         for nid in self._members:
             if nid != self.node_id:
-                self.link.request(nid, "ring_update", payload)
+                try:
+                    self.link.request(nid, "ring_update", payload)
+                except Exception as e:  # noqa: BLE001 — keep going
+                    refused.append((nid, e))
+        # apply locally even when part of the broadcast was refused:
+        # the DRIVER's ring must reflect the moves that already
+        # happened or a re-drive would recompute them as fresh moves.
+        # The divergence window this leaves (some members on the old
+        # ring) is closed against a racing resize by resize_cluster's
+        # pre-flight ring-equality check across all members.
         self._apply_ring_update(dict(new_ring), dict(self._members),
                                 clients)
+        if refused:
+            raise RemoteCallError(
+                f"re-plan applied on {len(self._members) - len(refused)}"
+                f"/{len(self._members)} members; refused by "
+                f"{sorted(nid for nid, _ in refused)!r} "
+                f"({refused[0][1]}) — re-drive rebalance(new_ring) "
+                f"once the refusing operation resolves")
         return dict(new_ring)
 
     # ------------------------------------- cluster partition-count resize
@@ -1243,11 +1318,73 @@ class NodeServer:
                 f"multi-node resize must grow by an integer factor "
                 f"({old_n} -> {new_n})")
         members = sorted(self._members, key=repr)
+        # pre-flight: members must agree on the ring.  An interrupted
+        # rebalance broadcast (refused on one member, the old owner's
+        # handoff journal already drained by its own ring_update)
+        # leaves silent same-width divergence none of the per-member
+        # checks can see — resize_commit expands each member's OWN
+        # ring, so committing over divergent rings splits routing
+        # permanently.  A partial-commit RECOVERY legitimately mixes
+        # two widths; that state is allowed only when it is exactly
+        # this resize's split (children on the parent's owner).
+        rings_by_width: Dict[int, dict] = {}
         for m in members:
-            self._rpc(m, "resize_prepare",
-                      (new_n, max_passes, delta_threshold))
-        for m in members:
-            self._rpc(m, "resize_freeze", (new_n,))
+            st = self._rpc(m, "status", None)
+            r = {int(p): o for p, o in (st.get("ring") or [])}
+            if not r:
+                raise RuntimeError(
+                    f"member {m!r} is not assembled (empty ring); "
+                    f"restore or remove it before resizing")
+            rings_by_width.setdefault(len(r), {})[m] = r
+        for w, group in rings_by_width.items():
+            if len({tuple(sorted(r.items()))
+                    for r in group.values()}) > 1:
+                raise RuntimeError(
+                    f"members at width {w} disagree on the ring "
+                    f"(an interrupted rebalance?): {group!r}; "
+                    f"re-drive the rebalance to convergence before "
+                    f"resizing")
+        widths = sorted(rings_by_width)
+        if len(widths) == 2:
+            w0, w1 = widths
+            small = next(iter(rings_by_width[w0].values()))
+            big = next(iter(rings_by_width[w1].values()))
+            if w1 != new_n or w1 % w0 or \
+                    any(big[q] != small[q % w0] for q in big):
+                raise RuntimeError(
+                    f"mixed ring widths {widths} are not a "
+                    f"partial commit of this resize (to {new_n}); "
+                    f"resolve before resizing")
+        elif len(widths) > 2:
+            raise RuntimeError(
+                f"members at {len(widths)} different ring widths "
+                f"{widths}; resolve before resizing")
+
+        def unwind():
+            # abort-before-start: every member discards its prepare
+            # staging, clears its marker, and reopens its gate.  Sent
+            # to ALL members (not just those whose RPC returned — a
+            # freeze whose reply was lost may still have applied) so
+            # nobody stays gated or keeps staged child logs until an
+            # operator re-drives.  Post-freeze phases deliberately do
+            # NOT unwind — a commit must be re-driven to completion,
+            # never rolled back.
+            for m in members:
+                try:
+                    self._rpc(m, "resize_abort", None)
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    log.warning(
+                        "resize unwind: could not reach %r", m)
+
+        try:
+            for m in members:
+                self._rpc(m, "resize_prepare",
+                          (new_n, max_passes, delta_threshold))
+            for m in members:
+                self._rpc(m, "resize_freeze", (new_n,))
+        except BaseException:
+            unwind()
+            raise
         for m in members:
             self._rpc(m, "resize_drain", None)
         for m in members:
@@ -1395,6 +1532,40 @@ class NodeServer:
         self._resize_parking = False
         self.node.txn_gate.unfreeze()
         return True
+
+    def _resize_abort(self) -> str:
+        """Abort-before-commit: discard the prepare staging (folds AND
+        their staged child log files), clear the marker, reopen the
+        gate.  On a member that already COMMITTED the new width (a
+        re-driven resize unwinding after a partial-commit crash) this
+        is a NO-OP: committed members must stay parked at the new
+        width until a successful re-drive finishes — unparking one
+        would let it serve a width its peers may not share."""
+        marker = self.meta.get("cluster_resize")
+        if marker is not None and self._resize_parking \
+                and self.node is not None \
+                and self.node.config.n_partitions == int(marker):
+            # _resize_parking discriminates a REAL pending commit from
+            # an idempotent same-width re-drive that merely re-froze
+            # this member (width equality alone would classify the
+            # whole already-finished cluster as committed and leave
+            # every member gated after an unwind)
+            return "committed"
+        if self._resize_fold is not None:
+            self._resize_fold.discard()
+            self._resize_fold = None
+        if self.node is not None:
+            # also sweep staged files from a PREVIOUS attempt that
+            # died before this process held a fold object (restart
+            # after a prepare-crash): left behind, a later resize's
+            # swap would promote them over the live logs
+            self.node.sweep_staged_resize()
+        self._resize_ring = None
+        self.meta.delete("cluster_resize")
+        self._resize_parking = False
+        if self.node is not None:
+            self.node.txn_gate.unfreeze()
+        return "aborted"
 
     # ------------------------------------------------------------ shutdown
 

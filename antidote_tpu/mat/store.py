@@ -292,14 +292,43 @@ def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
 
     K = st.dots.shape[0]
     interpret = jax.default_backend() != "tpu"
+    args = (st.dots, st.ops, st.valid, st.base_vc, st.has_base,
+            read_vc.astype(st.ops.dtype))
     if fused == "hybrid":
-        fn, default_bk = pallas_kernels.orset_read_hybrid, 512
-    else:
-        fn, default_bk = pallas_kernels.orset_read_packed, 256
-    return fn(
-        st.dots, st.ops, st.valid, st.base_vc, st.has_base,
-        read_vc.astype(st.ops.dtype),
-        block_k=min(block_k or default_bk, K), interpret=interpret)
+        fn = pallas_kernels.orset_read_hybrid
+        if block_k is not None:
+            return fn(*args, block_k=min(block_k, K),
+                      interpret=interpret)
+        # scoped-VMEM budgets differ per TPU generation (measured on
+        # v5 lite: block_k=512 requests 26.18M against the 16.00M
+        # limit) — probe descending block sizes ONCE per
+        # (backend, shard shape), cache the largest that compiles.
+        # Pallas/Mosaic raises the VMEM overflow synchronously at the
+        # dispatching call, so the probe needs no execution round-trip.
+        key = ("hybrid", jax.default_backend(), st.dots.shape,
+               st.ops.shape)
+        bk = _BLOCK_K_CACHE.get(key)
+        if bk is not None:
+            return fn(*args, block_k=min(bk, K), interpret=interpret)
+        last = None
+        for bk in (512, 256, 128):
+            try:
+                out = fn(*args, block_k=min(bk, K),
+                         interpret=interpret)
+            except Exception as e:  # noqa: BLE001 — inspect + reraise
+                if "vmem" not in str(e).lower():
+                    raise
+                last = e
+                continue
+            _BLOCK_K_CACHE[key] = bk
+            return out
+        raise last
+    return pallas_kernels.orset_read_packed(
+        *args, block_k=min(block_k or 256, K), interpret=interpret)
+
+
+#: (variant, backend, shapes) -> largest block_k that compiled there
+_BLOCK_K_CACHE: dict = {}
 
 
 @jax.jit

@@ -238,6 +238,21 @@ class LiveFold:
         for lg in self.new_logs.values():
             lg.close()
 
+    def discard(self) -> None:
+        """Abort-before-swap: close and DELETE the staged child logs.
+        An aborted resize must not leave half-folded files on disk —
+        a re-driven prepare rebuilds them from scratch anyway."""
+        for lg in self.new_logs.values():
+            try:
+                lg.close()
+            except Exception:  # noqa: BLE001 — already closed
+                pass
+            try:
+                os.remove(lg.path)
+            except OSError:
+                pass
+        self.new_logs.clear()
+
 
 class Node:
     def __init__(self, dc_id="dc1", config: Optional[Config] = None,
@@ -435,6 +450,20 @@ class Node:
         self._recover_stores()
         if self.stable_tracker is not None:
             self._install_device_stable()  # re-aim rows at the new ring
+
+    def sweep_staged_resize(self) -> None:
+        """Delete every staged ``.resize`` child log in this node's
+        data dir — the abort-path sweep for attempts that died before
+        the current process held a fold object.  Lives here so the
+        staged-log naming (``_log_path(p) + ".resize"``, also used by
+        build_resize_fold and _complete_resize_swap) has ONE owner."""
+        import glob as _glob
+
+        for f in _glob.glob(os.path.join(self.data_dir, "*.resize")):
+            try:
+                os.remove(f)
+            except OSError:
+                pass
 
     def build_resize_fold(self, new_n: int, own_slot=None) -> LiveFold:
         """LiveFold from this process's partitions toward width

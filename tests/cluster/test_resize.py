@@ -371,3 +371,395 @@ def test_kill9_in_resize_swap_recovers(tmp_path):
     finally:
         for p in procs:
             p.stop()
+
+
+def test_freeze_refusal_unwinds_frozen_members(tmp_path):
+    """A freeze-phase refusal (a handoff raced in between prepare and
+    freeze) must abort the resize WITHOUT leaving the members that
+    already froze gated — previously they stayed frozen (marker set,
+    gate closed) until an operator re-drove the resize."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers = [
+        NodeServer(f"fz{i}", data_dir=str(tmp_path / f"fz{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers)
+        # members freeze in sorted order (fz0 first); make fz1 refuse
+        real = servers[1]._resize_freeze
+
+        def refuse(new_n):
+            raise RemoteCallError("injected freeze refusal")
+
+        servers[1]._resize_freeze = refuse
+        with pytest.raises(RemoteCallError):
+            servers[0].resize_cluster(16)
+
+        # the already-frozen member was unwound: marker cleared, gate
+        # open, transactions admitted immediately on BOTH members —
+        # and the prepare-phase staging (child .resize logs) was
+        # discarded, not leaked
+        import glob
+
+        for i, srv in enumerate(servers):
+            assert srv.meta.get("cluster_resize") is None
+            assert srv._resize_fold is None
+            assert not glob.glob(str(tmp_path / f"fz{i}" / "*.resize"))
+            tx = srv.api.start_transaction()
+            srv.api.update_objects(
+                [((1, "counter_pn", "b"), "increment", 1)], tx)
+            srv.api.commit_transaction(tx)
+
+        # with the refusal gone, a re-driven resize completes
+        servers[1]._resize_freeze = real
+        servers[0].resize_cluster(16)
+        for srv in servers:
+            assert srv.node.config.n_partitions == 16
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_stale_ring_update_refused_after_resize(tmp_path):
+    """A rebalance's re-plan broadcast that lands AFTER a resize (or
+    while one is mid-flight) must be refused: applying an old-width
+    ring over a widened member would leave its new partitions
+    permanently stale; applying any ring under the resize marker would
+    desync the fold."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers = [
+        NodeServer(f"su{i}", data_dir=str(tmp_path / f"su{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers)
+        old_ring = dict(servers[0].node.ring)
+        members = dict(servers[0]._members)
+
+        # marker set (mid-resize): any ring update is refused
+        servers[1].meta.put("cluster_resize", 16)
+        with pytest.raises(RemoteCallError, match="resize in progress"):
+            servers[1]._apply_ring_update(old_ring, members, [])
+        servers[1].meta.delete("cluster_resize")
+
+        servers[0].resize_cluster(16)
+
+        # the lagging old-width broadcast arrives after the commit:
+        # width check refuses it and the 16-wide ring survives
+        with pytest.raises(RemoteCallError, match="width 8"):
+            servers[1]._apply_ring_update(old_ring, members, [])
+        assert len(servers[1].node.ring) == 16
+        assert servers[1].node.config.n_partitions == 16
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_cutover_backout_preserves_in_doubt_entry(tmp_path):
+    """A cutover retry on a parked-in-doubt partition that backs out on
+    the flag-then-check (a resize_freeze raced its marker in) must
+    RESTORE the in_doubt entry — previously it popped it, leaving a
+    retired/parked partition with no handoff state: callers spun on
+    retryable HandoffParked forever instead of the hard in-doubt error,
+    and the resize guard no longer saw the partition as busy."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers = [
+        NodeServer(f"id{i}", data_dir=str(tmp_path / f"id{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers)
+        p = next(q for q, o in servers[0].node.ring.items()
+                 if o == "id0")
+        pm = servers[0].node.partitions[p]
+        with pm._lock:
+            pm.parked = True
+        servers[0]._handoff[p] = {"state": "in_doubt",
+                                  "new_owner": "id1"}
+
+        # drive the exact race window: the first marker check sees no
+        # resize, the flag-then-check (after the drain entry is set)
+        # sees one — as if resize_freeze journaled its marker between
+        # the two
+        real_meta = servers[0].meta
+
+        class RaceMeta:
+            def __init__(self):
+                self.calls = 0
+
+            def get(self, key, default=None):
+                if key == "cluster_resize":
+                    self.calls += 1
+                    return None if self.calls == 1 else 16
+                return real_meta.get(key, default)
+
+            def __getattr__(self, name):
+                return getattr(real_meta, name)
+
+        servers[0].meta = RaceMeta()
+        try:
+            with pytest.raises(RemoteCallError,
+                               match="resize in progress"):
+                servers[0]._handoff_cutover(p, "id1", 0)
+        finally:
+            servers[0].meta = real_meta
+
+        # the safety state survived the back-out
+        assert servers[0]._handoff[p]["state"] == "in_doubt"
+        # and the resize guard still refuses while it stands
+        with pytest.raises(RemoteCallError, match="handoff in flight"):
+            servers[0]._refuse_if_handoff_busy()
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_rebalance_redrive_after_refused_broadcast(tmp_path):
+    """A rebalance whose ring_update broadcast is refused on one member
+    (e.g. a mid-flight resize froze it) raises a re-drive error AFTER
+    applying the plan locally; re-driving the SAME rebalance converges
+    the cluster — the probe skips the move whose data already
+    transferred instead of re-fetching it from the retired owner."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers = [
+        NodeServer(f"rd{i}", data_dir=str(tmp_path / f"rd{i}"),
+                   config=_cfg())
+        for i in range(3)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers[:2], clients=[servers[2]])
+        api = servers[0].api
+        tx = api.start_transaction()
+        api.update_objects([((0, "counter_pn", "b"), "increment", 7)],
+                           tx)
+        api.commit_transaction(tx)
+
+        p = next(q for q, o in servers[0].node.ring.items()
+                 if o == "rd0")
+        new_ring = dict(servers[0].node.ring)
+        new_ring[p] = "rd2"
+
+        # rd1's ring_update refuses once (as a resize-frozen member
+        # would); the cutover itself has already completed
+        real = servers[1]._apply_ring_update
+        calls = {"n": 0}
+
+        def refuse_once(ring, members, clients):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RemoteCallError("injected: resize in progress")
+            return real(ring, members, clients)
+
+        servers[1]._apply_ring_update = refuse_once
+        with pytest.raises(RemoteCallError, match="re-drive"):
+            servers[0].rebalance(new_ring)
+
+        # the driver applied locally (it must, for the re-drive to
+        # see the move as done); data moved to rd2
+        assert servers[0].node.ring[p] == "rd2"
+        assert servers[1].node.ring[p] == "rd0"  # the refused member
+
+        # re-drive: probe skips the completed move, broadcast lands,
+        # every member converges, the handoff journal drains
+        servers[0].rebalance(new_ring)
+        for srv in servers:
+            assert srv.node.ring[p] == "rd2"
+        assert not (servers[0].meta.get("handoff_out") or {})
+
+        # the moved partition still serves its history and new writes
+        tx = servers[1].api.start_transaction()
+        v = servers[1].api.read_objects([(0, "counter_pn", "b")], tx)
+        servers[1].api.commit_transaction(tx)
+        assert v[0] == 7
+        tx = servers[2].api.start_transaction()
+        servers[2].api.update_objects(
+            [((0, "counter_pn", "b"), "increment", 1)], tx)
+        cvc = servers[2].api.commit_transaction(tx)
+        tx = servers[0].api.start_transaction(clock=cvc)
+        v = servers[0].api.read_objects([(0, "counter_pn", "b")], tx)
+        servers[0].api.commit_transaction(tx)
+        assert v[0] == 8
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_same_width_redrive_abort_leaves_cluster_serving(tmp_path):
+    """An idempotent same-width re-drive that aborts at freeze must
+    fully unwind: width equality alone must not classify the healthy,
+    already-finished members as 'committed' (that left the whole
+    cluster gated with journaled markers).  Stale on-disk staged files
+    from a dead earlier attempt are swept by the abort too — a later
+    resize's swap would otherwise promote them over the live logs."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers = [
+        NodeServer(f"sw{i}", data_dir=str(tmp_path / f"sw{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers)
+        servers[0].resize_cluster(16)
+        for srv in servers:
+            assert srv.meta.get("cluster_resize") is None
+
+        # a stale half-folded staged file from a crashed old attempt
+        stale = tmp_path / "sw0" / "dc1_p3.log.resize"
+        stale.write_bytes(b"half-folded garbage")
+
+        def refuse(new_n):
+            raise RemoteCallError("injected freeze refusal")
+
+        real = servers[1]._resize_freeze
+        servers[1]._resize_freeze = refuse
+        with pytest.raises(RemoteCallError):
+            servers[0].resize_cluster(16)
+        servers[1]._resize_freeze = real
+
+        assert not stale.exists()
+        # every member serves immediately — no marker, no gate
+        for srv in servers:
+            assert srv.meta.get("cluster_resize") is None
+            assert not srv._resize_parking
+            tx = srv.api.start_transaction()
+            srv.api.update_objects(
+                [((2, "counter_pn", "b"), "increment", 1)], tx)
+            srv.api.commit_transaction(tx)
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_redrive_rebalance_settles_in_doubt_old_owner(tmp_path):
+    """Receiver adopts, reply lost, AND the settlement probe cannot
+    reach it -> the old owner parks in doubt.  A re-driven rebalance
+    (receiver reachable again) must settle the old owner's parked copy
+    — not just probe-skip the move — or its ring_update refuses
+    'moved without a handoff' on every re-drive, a livelock only a
+    restart could break."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers = [
+        NodeServer(f"sd{i}", data_dir=str(tmp_path / f"sd{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    recv = NodeServer("sd2", data_dir=str(tmp_path / "sd2"),
+                      config=_cfg())
+    try:
+        create_dc_cluster("dc1", 8, servers, clients=[recv])
+        api = servers[0].api
+        tx = api.start_transaction()
+        api.update_objects([((0, "counter_pn", "b"), "increment", 5)],
+                           tx)
+        api.commit_transaction(tx)
+        p = next(q for q, o in servers[0].node.ring.items()
+                 if o == "sd0")
+
+        # install applies at the receiver but the reply is 'lost', and
+        # the settlement probe is 'unreachable' exactly once
+        real_install = recv._handoff_install
+
+        def applied_reply_lost(pp, base_offset, tail):
+            real_install(pp, base_offset, tail)
+            raise RemoteCallError("injected: reply lost")
+
+        # probe call order: #1 the rebalance driver's probe-skip check
+        # (fresh move -> must answer), #2 the old owner's settlement
+        # probe after the lost reply (-> 'unreachable'), #3+ re-drive
+        real_probe = recv._handoff_probe
+        calls = {"n": 0}
+
+        def probe_flaky(pp):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RemoteCallError("injected: unreachable")
+            return real_probe(pp)
+
+        recv._handoff_install = applied_reply_lost
+        recv._handoff_probe = probe_flaky
+        new_ring = dict(servers[0].node.ring)
+        new_ring[p] = "sd2"
+        with pytest.raises(RemoteCallError):
+            servers[0].rebalance(new_ring)
+        recv._handoff_install = real_install
+
+        assert servers[0]._handoff[p]["state"] == "in_doubt"
+
+        # re-drive: probe sees adoption, the old owner's copy is
+        # settled (retired), the plan lands everywhere
+        servers[0].rebalance(new_ring)
+        from antidote_tpu.cluster.remote import RemotePartition as _RP  # noqa: F401
+        assert servers[0].node.ring[p] == "sd2"
+        assert not isinstance(servers[0].node.partitions[p],
+                              PartitionManager)
+        for srv in servers + [recv]:
+            assert srv.node.ring[p] == "sd2"
+        assert not (servers[0].meta.get("handoff_out") or {})
+
+        # history and new writes both served
+        tx = recv.api.start_transaction()
+        v = recv.api.read_objects([(0, "counter_pn", "b")], tx)
+        recv.api.commit_transaction(tx)
+        assert v[0] == 5
+    finally:
+        for srv in servers + [recv]:
+            srv.close()
+
+
+def test_resize_refuses_divergent_rings_until_rebalance_redriven(tmp_path):
+    """After a partially-refused rebalance broadcast the handoff
+    journal is already drained, so no per-member check sees the
+    divergence — the resize pre-flight must: with one member on the
+    stale ring, resize_cluster refuses; once the rebalance is
+    re-driven to convergence it proceeds."""
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    servers = [
+        NodeServer(f"dv{i}", data_dir=str(tmp_path / f"dv{i}"),
+                   config=_cfg())
+        for i in range(3)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers[:2], clients=[servers[2]])
+        p = next(q for q, o in servers[0].node.ring.items()
+                 if o == "dv0")
+        new_ring = dict(servers[0].node.ring)
+        new_ring[p] = "dv1"
+
+        real = servers[2]._apply_ring_update
+        calls = {"n": 0}
+
+        def refuse_once(ring, members, clients):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RemoteCallError("injected refusal")
+            return real(ring, members, clients)
+
+        servers[2]._apply_ring_update = refuse_once
+        with pytest.raises(RemoteCallError, match="re-drive"):
+            servers[0].rebalance(new_ring)
+
+        # divergence is silent: journal drained, no handoff entries
+        assert not (servers[0].meta.get("handoff_out") or {})
+        assert servers[2].node.ring[p] == "dv0"  # stale
+
+        with pytest.raises(RuntimeError, match="disagree"):
+            servers[0].resize_cluster(16)
+
+        servers[0].rebalance(new_ring)  # re-drive converges
+        assert servers[2].node.ring[p] == "dv1"
+        servers[0].resize_cluster(16)   # now allowed
+        for srv in servers:
+            assert len(srv.node.ring) == 16
+    finally:
+        for srv in servers:
+            srv.close()

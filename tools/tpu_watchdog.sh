@@ -1,0 +1,27 @@
+#!/bin/bash
+# Tunnel watchdog: the remote-TPU tunnel on this rig comes and goes, so
+# a one-shot bench can land in a down-window and record nothing.  This
+# loop probes with a short KILLABLE jit (a wedged tunnel hangs inside
+# native code); the moment a probe passes it runs the full bench and
+# keeps the JSON line as BENCH_hw_selfcapture.json next to bench.py;
+# exits once a non-degraded line is captured.  Paths relative to the
+# repo root (the script's parent directory); scratch files under
+# $WATCHDOG_TMP (default /tmp).
+cd "$(dirname "$(readlink -f "$0")")/.." || exit 1
+TMP=${WATCHDOG_TMP:-/tmp}
+for i in $(seq 1 400); do
+  if timeout 120 python -c "import jax, jax.numpy as jnp; jax.jit(lambda a:(a*2).sum())(jnp.arange(8.0)).block_until_ready()" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%T) tunnel UP - running bench" >> $TMP/tpu_watchdog.log
+    timeout 5400 python bench.py > $TMP/bench_hw.out 2> $TMP/bench_hw.err
+    rc=$?
+    if grep -q '"degraded": false' $TMP/bench_hw.out 2>/dev/null; then
+      cp $TMP/bench_hw.out BENCH_hw_selfcapture.json
+      echo "$(date -u +%FT%T) bench captured (non-degraded)" >> $TMP/tpu_watchdog.log
+      exit 0
+    fi
+    echo "$(date -u +%FT%T) bench ran but degraded or died (exit=$rc)" >> $TMP/tpu_watchdog.log
+  else
+    echo "$(date -u +%FT%T) tunnel down" >> $TMP/tpu_watchdog.log
+  fi
+  sleep 180
+done
