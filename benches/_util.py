@@ -26,6 +26,19 @@ def setup(argv=None):
 
     if "--cpu" in argv:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # persistent XLA compile cache (works through the axon tunnel):
+        # a config retried after a tunnel drop skips finished compiles
+        try:
+            import os
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), ".jax_cache"))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            pass
     # benches measure the SERVING configuration (GC + GIL knobs a node
     # process applies at startup), not the default interpreter
     tune_runtime()

@@ -1,0 +1,203 @@
+"""Phase-checkpointed hardware capture for the flaky remote-TPU tunnel.
+
+Round-5 post-mortem: the tunnel's up-windows can be shorter than one
+full bench run, and a monolithic ``python bench.py`` that dies mid-run
+records NOTHING (two windows were lost this way).  This orchestrator
+splits the hardware evidence into independent phases, each run as a
+subprocess whose one-line JSON result is checkpointed to
+``.hw_phases/<name>.json`` the moment it succeeds.  A tunnel drop costs
+only the phase in flight; the next window resumes at the first missing
+phase.  The persistent XLA compile cache (.jax_cache, enabled inside
+every phase) carries finished compiles across windows, so retries get
+cheaper each attempt.
+
+When every phase is captured the results are assembled into
+``BENCH_hw_selfcapture.json`` in bench.py's exact schema (plus
+``self_captured`` provenance) and the loop exits.
+
+Run: ``python tools/hw_capture.py`` (foreground; backgroundable).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PHASE_DIR = os.path.join(REPO, ".hw_phases")
+
+# (name, needs_tunnel, command, timeout_s) — priority order: the
+# headline (north star) first, then the driver-entry compile proof,
+# then the remaining BASELINE configs.
+PHASES = [
+    ("baselines", False,
+     [sys.executable, os.path.join("tools", "hw_phase.py"), "baselines"],
+     600),
+    ("config6", False,
+     [sys.executable, "-m", "benches.config6_txn", "--cpu", "--quick"],
+     900),
+    ("headline", True,
+     [sys.executable, os.path.join("tools", "hw_phase.py"), "headline"],
+     2400),
+    ("entry", True,
+     [sys.executable, os.path.join("tools", "hw_phase.py"), "entry"],
+     900),
+    ("config1", True,
+     [sys.executable, "-m", "benches.config1_counter", "--quick"], 900),
+    ("config3", True,
+     [sys.executable, "-m", "benches.config3_mvreg", "--quick"], 900),
+    ("config4", True,
+     [sys.executable, "-m", "benches.config4_rga", "--quick"], 900),
+    ("gst", True,
+     [sys.executable, os.path.join("tools", "hw_phase.py"), "gst"], 900),
+]
+
+
+def log(msg):
+    print(f"{time.strftime('%FT%T')} {msg}", file=sys.stderr, flush=True)
+
+
+def phase_path(name):
+    return os.path.join(PHASE_DIR, name + ".json")
+
+
+def have(name):
+    return os.path.exists(phase_path(name))
+
+
+def tunnel_up(timeout=120):
+    """Killable jit probe: a wedged tunnel hangs inside native code."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "print(jax.jit(lambda a: (a*2).sum())(jnp.arange(8.0)))"],
+            timeout=timeout, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_phase(name, cmd, timeout):
+    log(f"phase {name}: starting")
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"phase {name}: TIMEOUT after {timeout}s")
+        return False
+    lines = [l for l in (r.stdout or "").splitlines() if l.startswith("{")]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or "")[-400:].replace("\n", " | ")
+        log(f"phase {name}: FAILED rc={r.returncode} stderr: {tail}")
+        return False
+    os.makedirs(PHASE_DIR, exist_ok=True)
+    with open(phase_path(name), "w") as f:
+        f.write(lines[-1] + "\n")
+    log(f"phase {name}: captured")
+    return True
+
+
+def assemble():
+    """BENCH line in bench.py's schema from the checkpointed phases."""
+    p = {}
+    for name, _, _, _ in PHASES:
+        with open(phase_path(name)) as f:
+            p[name] = json.loads(f.read())
+    hd, base = p["headline"], p["baselines"]
+    cpp = base.get("cpp_ops")
+    vs = hd["dev_ops"] / cpp if cpp else hd["dev_ops"] / base["host_ops"]
+    cfg6 = p["config6"]
+    ms = lambda v: round(v * 1e3, 2) if isinstance(v, float) else v
+    detail = {
+        "degraded": False,
+        "self_captured": True,
+        "self_captured_note": (
+            "assembled by tools/hw_capture.py from phase checkpoints "
+            "(tunnel windows are shorter than one monolithic bench run); "
+            "per-phase capture timestamps in phase_times"),
+        "phase_times": {k: v.get("captured_at") for k, v in p.items()},
+        "device": hd["device"],
+        "keys": hd["keys"], "batch": hd["batch"], "steps": hd["steps"],
+        "full_shard_read_ms": ms(hd["read_jnp_s"]),
+        "full_shard_read_fused_ms": ms(hd["read_fused_s"]),
+        "full_shard_read_hybrid_ms": ms(hd["read_hybrid_s"]),
+        "host_python_merges_per_sec": round(base["host_ops"]),
+        "host_cpp_merges_per_sec": round(cpp) if cpp else None,
+        "vs_python_baseline": round(hd["dev_ops"] / base["host_ops"], 2),
+        "baseline_note": (
+            "no Erlang runtime in image; BEAM per-op loop is bracketed "
+            "by [CPython, C++] — vs_baseline uses the C++ bracket (per "
+            "core; x%d cores for a machine-wide bound)"
+            % (base.get("cpu_count") or 1)),
+        "entry_compile_run_s": round(p["entry"]["entry_compile_run_s"], 1),
+    }
+    for k, v in p["gst"].items():
+        if k not in ("captured_at", "phase_s", "backend", "vs_host_round"):
+            detail[k] = v
+    detail["txn_per_sec_8client_cpu_quick"] = cfg6["value"]
+    for src, dst in (("p50_ms", "txn_p50_ms"), ("p99_ms", "txn_p99_ms"),
+                     ("p50_1t_ms", "txn_p50_1t_ms"),
+                     ("p99_1t_ms", "txn_p99_1t_ms"),
+                     ("latency_starved", "txn_latency_starved"),
+                     ("pb_txn_per_sec", "txn_pb_per_sec"),
+                     ("pb_starved", "txn_pb_starved"),
+                     ("cluster_txn_per_sec", "txn_cluster_per_sec"),
+                     ("cpu_count", "cpu_count"),
+                     ("cluster_starved", "cluster_starved"),
+                     ("cluster_scaling", "cluster_scaling"),
+                     ("cluster_rpc_latency", "cluster_rpc_latency")):
+        detail[dst] = cfg6["detail"].get(src)
+    for name, key in (("config1", "counter"), ("config3", "mvreg_64dc"),
+                      ("config4", "rga_steady")):
+        cfg = p[name]
+        detail[f"{key}_value"] = cfg["value"]
+        detail[f"{key}_unit"] = cfg["unit"]
+        detail[f"{key}_vs_baseline"] = cfg["vs_baseline"]
+    return {
+        "metric": "orset_update_merges_per_sec_per_chip_1M_keys",
+        "value": round(hd["dev_ops"]),
+        "unit": "merges/s",
+        "vs_baseline": round(vs, 2),
+        "detail": detail,
+    }
+
+
+def main():
+    max_loops = int(os.environ.get("HW_CAPTURE_LOOPS", "400"))
+    for loop in range(max_loops):
+        missing = [ph for ph in PHASES if not have(ph[0])]
+        if not missing:
+            break
+        ran_any = False
+        for name, needs_tunnel, cmd, timeout in missing:
+            if needs_tunnel:
+                if not tunnel_up():
+                    log(f"tunnel down (phase {name} waiting)")
+                    break  # phases are priority-ordered: wait, retry
+                ran_any = True
+                run_phase(name, cmd, timeout)
+            else:
+                ran_any = True
+                run_phase(name, cmd, timeout)
+        missing = [ph for ph in PHASES if not have(ph[0])]
+        if not missing:
+            break
+        if not ran_any or all(ph[1] for ph in missing):
+            time.sleep(180)
+    missing = [ph[0] for ph in PHASES if not have(ph[0])]
+    if missing:
+        log(f"gave up with phases missing: {missing}")
+        return 1
+    line = assemble()
+    out = os.path.join(REPO, "BENCH_hw_selfcapture.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(line) + "\n")
+    log(f"assembled {out}")
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

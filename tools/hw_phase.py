@@ -1,0 +1,108 @@
+"""One hardware-capture phase per invocation (tools/hw_capture.py runs
+these as subprocesses so a tunnel drop mid-phase kills ONE phase, not
+the whole capture).  Each phase prints exactly one JSON line on stdout
+as its final output; everything else goes to stderr.
+
+Phases:
+  headline   bench_device at 1M keys (BASELINE config 2) — the north star
+  baselines  host CPython + native C++ per-op loops (no tunnel needed)
+  entry      __graft_entry__.entry() compile + run on the live chip
+  gst        config-5 GST fold at 256 DCs on the live chip
+
+Configs 1/3/4/6 already have standalone modules (benches/configN_*.py)
+and are invoked directly by the orchestrator.
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _cache():
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+
+
+def phase_headline():
+    _cache()
+    import jax
+
+    import bench
+
+    dev_ops, read_jnp, read_fused, read_hybrid = bench.bench_device(
+        K=1_000_000, B=65_536, n_steps=20, D=8, n_dcs=3)
+    return {
+        "device": str(jax.devices()[0]),
+        "backend": jax.default_backend(),
+        "dev_ops": dev_ops,
+        "keys": 1_000_000, "batch": 65_536, "steps": 20,
+        "read_jnp_s": read_jnp,
+        "read_fused_s": read_fused,
+        "read_hybrid_s": read_hybrid,
+    }
+
+
+def phase_baselines():
+    import bench
+
+    K = 1_000_000
+    host_ops = bench.bench_host_baseline(K)
+    cpp_ops = bench.bench_cpp_baseline(K, 2_000_000)
+    return {"host_ops": host_ops, "cpp_ops": cpp_ops,
+            "cpu_count": os.cpu_count()}
+
+
+def phase_entry():
+    _cache()
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    t0 = time.perf_counter()
+    out = jax.jit(fn)(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+    compile_s = time.perf_counter() - t0
+    # forced completion via scalar fetch (block_until_ready is not a
+    # real barrier on this tunnel — benches/_util.py module doc)
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf).reshape(-1)[:1]
+    return {"device": str(jax.devices()[0]),
+            "backend": jax.default_backend(),
+            "entry_compile_run_s": compile_s}
+
+
+def phase_gst():
+    _cache()
+    import jax
+
+    from benches.config5_gst import summary
+
+    return {"backend": jax.default_backend(), **summary(jax, N=256)}
+
+
+def main():
+    name = sys.argv[1]
+    fn = {"headline": phase_headline, "baselines": phase_baselines,
+          "entry": phase_entry, "gst": phase_gst}[name]
+    t0 = time.time()
+    out = fn()
+    out["captured_at"] = t0
+    out["phase_s"] = round(time.time() - t0, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
