@@ -55,12 +55,13 @@ _Z = np.int32(0)
 _NSCAL = 6
 
 
-def _fold_presence(dots, ops, lane_mask, e: int, d: int, l: int):
-    """Shared ORSWOT fold + presence body (both kernels): a dot
-    survives iff its seq exceeds every observed VV that covered its
-    (elem, dc) cell.  ``lane_mask(i)`` yields lane i's inclusion∧valid
-    column ([TK, 1] bool) — computed inline by the fully-fused kernel,
-    read from a precomputed ref by the hybrid one."""
+def _fold_live(dots, ops, lane_mask, e: int, d: int, l: int):
+    """Shared ORSWOT fold body: the live dot table [TK, E*D] after
+    applying the masked lanes — a dot survives iff its seq exceeds every
+    observed VV that covered its (elem, dc) cell.  ``lane_mask(i)``
+    yields lane i's inclusion∧valid column ([TK, 1] bool).  This is
+    kernels.orset_apply restated as one-hot masked max-reductions over
+    the static lane × DC axes (see module doc)."""
     f = _NSCAL + 2 * d
     tk = dots.shape[0]
     ed = e * d
@@ -92,8 +93,14 @@ def _fold_presence(dots, ops, lane_mask, e: int, d: int, l: int):
             max_obs, jnp.where(at_e & mask_i, obs_t, _Z))
 
     merged = jnp.maximum(dots, last_seq)
-    live = jnp.where(merged > max_obs, merged, _Z)
-    # presence per element = max over its D chunk, via column maxes
+    return jnp.where(merged > max_obs, merged, _Z)      # [TK, E*D]
+
+
+def _fold_presence(dots, ops, lane_mask, e: int, d: int, l: int):
+    """ORSWOT fold + element presence (read kernels): presence per
+    element = max over its D chunk of the live table, via column
+    maxes."""
+    live = _fold_live(dots, ops, lane_mask, e, d, l)
     outs = []
     for j in range(e):
         m = live[:, j * d][:, None]
@@ -239,6 +246,89 @@ def orset_read_hybrid(dots, ops, valid, base_vc, has_base, read_vc,
         mask.astype(jnp.int32),
     )
     return out > 0
+
+
+def _orset_gc_kernel(
+    dots_ref,       # [TK, E*D] VMEM (flattened dot table)
+    ops_ref,        # [TK, L*F] VMEM (packed store rows)
+    valid_ref,      # [TK, L]   VMEM
+    gst_ref,        # [1, D]    SMEM
+    ndots_ref,      # [TK, E*D] VMEM out — folded dot table
+    nvalid_ref,     # [TK, L]   VMEM out — surviving lanes
+    *, e: int, d: int, l: int,
+):
+    """Fused GC fold (store.orset_gc semantics): every valid lane whose
+    commit VC <= GST folds into the dot table and frees; the jnp path
+    materializes the [K, L, D] commit-VC tensor and the [K, L, E, D]
+    one-hot select in HBM between XLA fusions (measured 34 ms per GC at
+    1M keys on the round-5 bench chip), here the packed rows are read
+    once and only the folded table + lane bitmap leave VMEM."""
+    f = _NSCAL + 2 * d
+    tk = dots_ref.shape[0]
+    ops = ops_ref[:]
+    valid = valid_ref[:]
+    col = lambda j: ops[:, j][:, None]
+    true_col = jnp.ones((tk, 1), jnp.bool_)
+
+    stable = []
+    for i in range(l):                                  # static unroll
+        off = i * f
+        opdc_i = col(off + 4)
+        opct_i = col(off + 5)
+        st_i = true_col
+        for dd in range(d):
+            # commit VC column dd: the op snapshot with the origin
+            # column bumped to the commit time (ct >= ss[origin], so
+            # max == set; same form as the read kernels)
+            ss_c = col(off + _NSCAL + d + dd)
+            cvc_c = jnp.where(opdc_i == np.int32(dd),
+                              jnp.maximum(ss_c, opct_i), ss_c)
+            st_i = st_i & (cvc_c <= gst_ref[0, dd])
+        stable.append((valid[:, i][:, None] != _Z) & st_i)
+
+    ndots_ref[:] = _fold_live(
+        dots_ref[:], ops, lambda i: stable[i], e, d, l)
+    nvalid_ref[:] = jnp.concatenate(
+        [((valid[:, i][:, None] != _Z) & ~stable[i]).astype(jnp.int32)
+         for i in range(l)], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def orset_gc_packed(dots, ops, valid, gst,
+                    block_k: int = 256, interpret: bool = False):
+    """(new_dots int[K, E, D], new_valid bool[K*L]): the store GC fold
+    as one HBM pass.  Semantics identical to store.orset_gc's
+    dots/valid update (base_vc/has_base are caller-side scalars)."""
+    k, e, d = dots.shape
+    f = ops.shape[-1]
+    l = ops.shape[0] // k
+    i32 = lambda a: a.astype(jnp.int32)
+    grid = (pl.cdiv(k, block_k),)
+    row = lambda i: (i, _Z)
+    bspec = lambda shp: pl.BlockSpec(shp, row, memory_space=pltpu.VMEM)
+    smem = lambda shp: pl.BlockSpec(
+        shp, lambda i: (_Z, _Z), memory_space=pltpu.SMEM)
+    kern = functools.partial(_orset_gc_kernel, e=e, d=d, l=l)
+    ndots, nvalid = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            bspec((block_k, e * d)),
+            bspec((block_k, l * f)),
+            bspec((block_k, l)),
+            smem((1, d)),
+        ],
+        out_specs=(bspec((block_k, e * d)), bspec((block_k, l))),
+        out_shape=(jax.ShapeDtypeStruct((k, e * d), jnp.int32),
+                   jax.ShapeDtypeStruct((k, l), jnp.int32)),
+        interpret=interpret,
+    )(
+        i32(dots).reshape(k, e * d),
+        i32(ops).reshape(k, l * f),
+        i32(valid).reshape(k, l),
+        i32(gst)[None, :],
+    )
+    return ndots.reshape(k, e, d), (nvalid > 0).reshape(k * l)
 
 
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))

@@ -299,36 +299,92 @@ def orset_read_full(st: OrsetShardState, read_vc: jax.Array,
         if block_k is not None:
             return fn(*args, block_k=min(block_k, K),
                       interpret=interpret)
-        # scoped-VMEM budgets differ per TPU generation (measured on
-        # v5 lite: block_k=512 requests 26.18M against the 16.00M
-        # limit) — probe descending block sizes ONCE per
-        # (backend, shard shape), cache the largest that compiles.
-        # Pallas/Mosaic raises the VMEM overflow synchronously at the
-        # dispatching call, so the probe needs no execution round-trip.
-        key = ("hybrid", jax.default_backend(), st.dots.shape,
-               st.ops.shape)
-        bk = _BLOCK_K_CACHE.get(key)
-        if bk is not None:
-            return fn(*args, block_k=min(bk, K), interpret=interpret)
-        last = None
-        for bk in (512, 256, 128):
-            try:
-                out = fn(*args, block_k=min(bk, K),
-                         interpret=interpret)
-            except Exception as e:  # noqa: BLE001 — inspect + reraise
-                if "vmem" not in str(e).lower():
-                    raise
-                last = e
-                continue
-            _BLOCK_K_CACHE[key] = bk
-            return out
-        raise last
+        return _probe_block_k(
+            fn, args,
+            ("hybrid", jax.default_backend(), st.dots.shape,
+             st.ops.shape),
+            K, interpret)
     return pallas_kernels.orset_read_packed(
         *args, block_k=min(block_k or 256, K), interpret=interpret)
 
 
 #: (variant, backend, shapes) -> largest block_k that compiled there
 _BLOCK_K_CACHE: dict = {}
+
+
+def _probe_block_k(fn, args, cache_key, K, interpret,
+                   ladder=(512, 256, 128)):
+    """Call ``fn(*args, block_k=..)`` with the largest block size this
+    chip's scoped-VMEM budget accepts, probing the descending ladder
+    once per ``cache_key`` (budgets differ per TPU generation —
+    measured on v5 lite: block_k=512 requests 26.18M against the
+    16.00M limit).  Pallas/Mosaic raises the VMEM overflow
+    synchronously at the dispatching call, so the probe needs no
+    execution round-trip."""
+    bk = _BLOCK_K_CACHE.get(cache_key)
+    if bk is not None:
+        return fn(*args, block_k=min(bk, K), interpret=interpret)
+    last = None
+    for bk in ladder:
+        try:
+            out = fn(*args, block_k=min(bk, K), interpret=interpret)
+        except Exception as e:  # noqa: BLE001 — inspect + reraise
+            if "vmem" not in str(e).lower():
+                raise
+            last = e
+            continue
+        _BLOCK_K_CACHE[cache_key] = bk
+        return out
+    raise last
+
+
+def orset_gc_full(st: OrsetShardState, gst: jax.Array,
+                  fused: str | bool = "auto",
+                  block_k: int | None = None) -> OrsetShardState:
+    """:func:`orset_gc` flag-selecting the fused Pallas fold
+    (pallas_kernels.orset_gc_packed — one HBM pass over the packed rows;
+    the jnp path's [K, L, D] commit-VC tensor and one-hot select
+    intermediates cost ~10x the pass's bandwidth floor, measured 34 ms
+    vs a ~4 ms floor per GC at 1M keys on the round-5 bench chip).
+
+    Same ``fused`` contract as :func:`orset_read_full`, EXCEPT "auto"
+    resolves to the jnp path: measured on the round-5 bench chip the
+    fused fold is SLOWER (58.8 ms vs 24.5 ms at 1M keys — XLA already
+    fuses the GC chain well, and the kernel's unrolled one-hot fold is
+    VPU-bound), unlike the read where the Pallas kernel wins 2.4x.
+    Kept for explicit fused=True use on TPU generations with more
+    VMEM/VPU headroom; the kernel is equality-tested against orset_gc
+    (tests/unit/test_pallas_kernels.py).
+
+    Callers must treat ``st`` as CONSUMED: the jnp fallback (auto,
+    False, or an int64 store) donates st's buffers (orset_gc's
+    donate_argnums), while the fused path does not — code that touches
+    st after this call works on one path and crashes on the other."""
+    if fused == "auto":
+        fused = False
+    if not fused or st.ops.dtype != jnp.int32:
+        return orset_gc(st, gst)
+    from antidote_tpu.mat import pallas_kernels
+
+    K = st.dots.shape[0]
+    interpret = jax.default_backend() != "tpu"
+    args = (st.dots, st.ops, st.valid, gst.astype(st.ops.dtype))
+    fn = pallas_kernels.orset_gc_packed
+    if block_k is not None:
+        ndots, nvalid = fn(*args, block_k=min(block_k, K),
+                           interpret=interpret)
+    else:
+        ndots, nvalid = _probe_block_k(
+            fn, args,
+            ("gc", jax.default_backend(), st.dots.shape, st.ops.shape),
+            K, interpret)
+    return replace(
+        st,
+        dots=ndots.astype(st.dots.dtype),
+        base_vc=jnp.maximum(st.base_vc, gst.astype(st.base_vc.dtype)),
+        has_base=jnp.ones((), dtype=bool),
+        valid=nvalid,
+    )
 
 
 @jax.jit

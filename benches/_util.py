@@ -17,6 +17,31 @@ import sys
 import time
 
 
+def enable_compile_cache():
+    """Persistent XLA compile cache at <repo>/.jax_cache (verified
+    working through the axon remote-compile tunnel): compiles survive
+    process death, so a bench retried after a mid-run tunnel drop
+    re-pays only the compiles it never finished — on this rig's short
+    tunnel windows that is the difference between eventually capturing
+    hardware numbers and never finishing (round-5 post-mortem: the
+    first window died in warm-up).  The single definition shared by
+    bench.py, the configs, and tools/hw_phase.py — the phase
+    subprocesses must all hit the SAME cache dir."""
+    import os
+
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass  # older jax: the cache is an optimization, never required
+
+
 def setup(argv=None):
     """Apply --cpu / --quick flags; returns (quick, jax)."""
     argv = sys.argv if argv is None else argv
@@ -27,18 +52,8 @@ def setup(argv=None):
     if "--cpu" in argv:
         jax.config.update("jax_platforms", "cpu")
     else:
-        # persistent XLA compile cache (works through the axon tunnel):
         # a config retried after a tunnel drop skips finished compiles
-        try:
-            import os
-            jax.config.update(
-                "jax_compilation_cache_dir",
-                os.path.join(os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__))), ".jax_cache"))
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0)
-        except Exception:
-            pass
+        enable_compile_cache()
     # benches measure the SERVING configuration (GC + GIL knobs a node
     # process applies at startup), not the default interpreter
     tune_runtime()

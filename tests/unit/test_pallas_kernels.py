@@ -127,3 +127,67 @@ def test_hybrid_read_matches_jnp_path(block_k):
     got = store.orset_read_full(st, read_vc, fused="hybrid",
                                 block_k=block_k)
     assert (np.asarray(got) == want).all()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_gc_matches_jnp_path(seed):
+    """orset_gc_full(fused=True) — the fused GC fold — produces the
+    exact dots/valid/base the jnp orset_gc produces, including on a
+    store that already has a folded base and live unstable lanes."""
+    st, frontier = _filled_store(seed=seed + 10)
+    # a GST strictly between base and frontier: some lanes fold, some
+    # survive (the interesting mixed case)
+    gst = (np.asarray(frontier) // 2).astype(np.int32)
+    got = store.orset_gc_full(st, jnp.asarray(gst), fused=True,
+                              block_k=64)
+    st2, _ = _filled_store(seed=seed + 10)  # orset_gc donates its input
+    want = store.orset_gc(st2, jnp.asarray(gst))
+    assert (np.asarray(got.dots) == np.asarray(want.dots)).all()
+    assert (np.asarray(got.valid) == np.asarray(want.valid)).all()
+    assert (np.asarray(got.base_vc) == np.asarray(want.base_vc)).all()
+    assert bool(got.has_base) == bool(want.has_base)
+
+
+def test_gc_full_reads_agree_after_fold():
+    """A read after the fused GC equals a read after the jnp GC (the
+    fold is transparent to materialization)."""
+    st, frontier = _filled_store(seed=21)
+    gst = (np.asarray(frontier) // 2).astype(np.int32)
+    b = store.orset_gc_full(st, jnp.asarray(gst), fused=True, block_k=64)
+    st2, _ = _filled_store(seed=21)      # orset_gc donates its input
+    a = store.orset_gc(st2, jnp.asarray(gst))
+    ra = reference_read(a, frontier)
+    rb = reference_read(b, frontier)
+    assert (ra == rb).all()
+
+
+def test_gc_full_int64_falls_back():
+    """µs-int64 stores must take the jnp path even when fused is
+    requested (the kernel computes in int32)."""
+    K, D, n_dcs = 64, 8, 3
+    rng = np.random.default_rng(3)
+    clock = np.zeros(n_dcs, dtype=np.int32)
+    st = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=D,
+                                dtype=jnp.int64)
+    s = orset_batch(rng, K, 128, D, n_dcs, clock, obs_lag=2)
+    lane = jnp.asarray(store.batch_lane_offsets(s["key_idx"]))
+    st, _ = store.orset_append(
+        st, jnp.asarray(s["key_idx"]), lane,
+        jnp.asarray(s["elem_slot"]), jnp.asarray(s["is_add"]),
+        jnp.asarray(s["dot_dc"]), jnp.asarray(s["dot_seq"]),
+        jnp.asarray(s["obs_vv"]), jnp.asarray(s["op_dc"]),
+        jnp.asarray(s["op_ct"]), jnp.asarray(s["op_ss"]))
+    gst = jnp.asarray(s["frontier"])
+    got = store.orset_gc_full(st, gst, fused=True)   # jnp fallback path
+    # the fallback IS orset_gc, which donates st — rebuild for `want`
+    st2 = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=D,
+                                 dtype=jnp.int64)
+    st2, _ = store.orset_append(
+        st2, jnp.asarray(s["key_idx"]), lane,
+        jnp.asarray(s["elem_slot"]), jnp.asarray(s["is_add"]),
+        jnp.asarray(s["dot_dc"]), jnp.asarray(s["dot_seq"]),
+        jnp.asarray(s["obs_vv"]), jnp.asarray(s["op_dc"]),
+        jnp.asarray(s["op_ct"]), jnp.asarray(s["op_ss"]))
+    want = store.orset_gc(st2, gst)
+    assert (np.asarray(got.dots) == np.asarray(want.dots)).all()
+    assert (np.asarray(got.valid) == np.asarray(want.valid)).all()

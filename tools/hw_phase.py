@@ -23,14 +23,9 @@ sys.path.insert(0, _REPO)
 
 
 def _cache():
-    import jax
+    from benches._util import enable_compile_cache
 
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(_REPO, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception:
-        pass
+    enable_compile_cache()
 
 
 def phase_headline():
@@ -39,12 +34,15 @@ def phase_headline():
 
     import bench
 
-    dev_ops, read_jnp, read_fused, read_hybrid = bench.bench_device(
+    bestv, read_jnp, read_fused, read_hybrid = bench.bench_device(
         K=1_000_000, B=65_536, n_steps=20, D=8, n_dcs=3)
     return {
         "device": str(jax.devices()[0]),
         "backend": jax.default_backend(),
-        "dev_ops": dev_ops,
+        "dev_ops": bestv["ops_per_sec"],
+        "headline_variant": {k: v for k, v in bestv.items()
+                             if k != "variants"},
+        "variants": bestv["variants"],
         "keys": 1_000_000, "batch": 65_536, "steps": 20,
         "read_jnp_s": read_jnp,
         "read_fused_s": read_fused,
@@ -69,16 +67,15 @@ def phase_entry():
     import __graft_entry__ as ge
 
     fn, args = ge.entry()
+    from benches._util import fetch
+
     t0 = time.perf_counter()
     out = jax.jit(fn)(*args)
-    jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+    # forced completion via one-scalar fetch INSIDE the timed window
+    # (block_until_ready is not a real barrier on this tunnel —
+    # benches/_util.py module doc)
+    fetch(out)
     compile_s = time.perf_counter() - t0
-    # forced completion via scalar fetch (block_until_ready is not a
-    # real barrier on this tunnel — benches/_util.py module doc)
-    import numpy as np
-
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    np.asarray(leaf).reshape(-1)[:1]
     return {"device": str(jax.devices()[0]),
             "backend": jax.default_backend(),
             "entry_compile_run_s": compile_s}
