@@ -74,9 +74,11 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
       — a coalescing level is only honest while overflow stays ~0.
 
     Variants: (coalesce=1, gc_every=4) is the historic configuration
-    (BENCH_r01..r04 comparable); (coalesce=4, gc_every=3) keeps the
-    mean per-key lane load under 1 between folds at 1M keys.  The
-    headline is the faster; both land in the detail dict."""
+    (BENCH_r01..r04 comparable); (coalesce=4, gc_every=3) and
+    (coalesce=8, gc_every=2) trade scatter count against per-key lane
+    load (the deepest level rides ~1 op/key mean between folds at 1M
+    keys).  The headline is the fastest; all land in the detail
+    dict."""
     import jax
     import jax.numpy as jnp
 

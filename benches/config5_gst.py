@@ -28,7 +28,7 @@ import time
 
 import numpy as np
 
-from benches._util import emit, setup, timed
+from benches._util import emit, fetch, setup, timed
 
 
 def make_state(rng, N, P):
@@ -87,6 +87,29 @@ def host_round_seconds(N=64, P=8):
     return time.perf_counter() - t0
 
 
+def _gate_cascade(N, q_len=8):
+    """The canonical gate workload, shared by BOTH gate probes so the
+    kernel-only and end-to-end rates measure the same cascade: yields
+    (origin_idx, pos, ts, deps) rows where deps maps origin_idx ->
+    timestamp.  Txn at phase p > 0 carries two cross-origin
+    dependencies on strictly earlier phases, so the cascade drains
+    fully by induction on p with ~q_len rounds."""
+    rng = np.random.default_rng(7)
+    rows = []
+    for oi in range(N):
+        base = 1000 * (oi + 1)
+        for p in range(q_len):
+            ts = base + 100 * p
+            deps = {}
+            if p > 0:
+                for dep_oi in rng.choice(N, size=2, replace=False):
+                    if dep_oi != oi:
+                        deps[int(dep_oi)] = (1000 * (dep_oi + 1)
+                                             + 100 * int(rng.integers(0, p)))
+            rows.append((oi, p, ts, deps))
+    return rows
+
+
 def gate_throughput(N, q_len=8, batched=True):
     """Drive the *actual* DependencyGate + StableTimeTracker with N
     origin DCs whose queued txns form cross-origin dependency cascades
@@ -102,7 +125,6 @@ def gate_throughput(N, q_len=8, batched=True):
     from antidote_tpu.interdc.wire import InterDcTxn
     from antidote_tpu.meta.gossip import StableTimeTracker
 
-    rng = np.random.default_rng(7)
     origins = [f"dc{i:03d}" for i in range(N)]
 
     applied = []
@@ -116,26 +138,17 @@ def gate_throughput(N, q_len=8, batched=True):
     gate.on_clock_update = lambda: tracker.put(0, gate.partition_vc())
 
     total = 0
-    for oi, origin in enumerate(origins):
-        q = deque()
-        base = 1000 * (oi + 1)
-        for p in range(q_len):
-            ts = base + 100 * p
-            snap = {origin: ts - 1}
-            # two cross-origin dependencies on strictly earlier phases
-            # (txn at phase p may need any origin's phase < p): drains
-            # fully by induction on p, with ~q_len cascade rounds
-            if p > 0:
-                for dep_oi in rng.choice(N, size=2, replace=False):
-                    if dep_oi != oi:
-                        snap[origins[dep_oi]] = (
-                            1000 * (dep_oi + 1)
-                            + 100 * int(rng.integers(0, p)))
-            q.append(InterDcTxn(
-                dc_id=origin, partition=0, prev_log_opid=0,
-                snapshot_vc=VC(snap), timestamp=ts, records=["r"]))
-            total += 1
-        gate.queues[origin] = q
+    queues = {o: deque() for o in origins}
+    for oi, p, ts, deps in _gate_cascade(N, q_len):
+        origin = origins[oi]
+        snap = {origin: ts - 1}
+        for dep_oi, dep_ts in deps.items():
+            snap[origins[dep_oi]] = dep_ts
+        queues[origin].append(InterDcTxn(
+            dc_id=origin, partition=0, prev_log_opid=0,
+            snapshot_vc=VC(snap), timestamp=ts, records=["r"]))
+        total += 1
+    gate.queues.update(queues)
 
     t0 = time.perf_counter()
     gate.process_queues()
@@ -144,6 +157,55 @@ def gate_throughput(N, q_len=8, batched=True):
     assert len(applied) == total
     assert tracker.get_stable_snapshot().get_dc(origins[0]) > 0
     return total / dt
+
+
+def gate_device_kernel_rate(jax, N, q_len=8, iters=8):
+    """txns/s through the device fixpoint KERNEL alone
+    (interdc/dep.py gate_fixpoint), chained with one end fetch — the
+    number a colocated host sees per process_queues device call.  The
+    end-to-end `gate_txns_per_sec_device_fixpoint` includes one
+    device->host result fetch per call, which on this rig's remote
+    tunnel costs 30-100 ms and dominates — a topology artifact the
+    production adaptive gate (interdc/dep.py _pick_batched) measures
+    and routes around on its own platform."""
+    import jax.numpy as jnp
+
+    from antidote_tpu.interdc.dep import gate_fixpoint
+
+    n = N * q_len
+    ss = np.zeros((n, N), np.int64)
+    origin = np.zeros((n,), np.int32)
+    pos = np.zeros((n,), np.int32)
+    ts = np.zeros((n,), np.int64)
+    for i, (oi, p, t, deps) in enumerate(_gate_cascade(N, q_len)):
+        origin[i], pos[i], ts[i] = oi, p, t
+        ss[i, oi] = t - 1
+        for dep_oi, dep_ts in deps.items():
+            ss[i, dep_oi] = dep_ts
+    ss, origin, pos, ts = map(jnp.asarray, (ss, origin, pos, ts))
+    is_ping = jnp.zeros((n,), bool)
+    pvc0 = jnp.zeros((N,), jnp.int64)
+
+    applied, rounds, _ = gate_fixpoint(ss, origin, pos, ts, is_ping, pvc0)
+    fetch(applied)
+    assert bool(applied.all())
+    # min of several overhead probes AND min over repeated runs: one
+    # spiked tunnel round-trip must not zero (or inflate) the window
+    oh = min(min((lambda t0: (fetch(applied), time.perf_counter() - t0)[1])(
+        time.perf_counter()) for _ in range(3)), 10.0)
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            # numerically zero (txn 0 applies at round 0) but
+            # data-dependent on the previous call, so calls chain
+            dep0 = jnp.minimum(rounds[0], 0).astype(pvc0.dtype)
+            applied, rounds, _ = gate_fixpoint(
+                ss, origin, pos, ts, is_ping, pvc0 + dep0)
+        fetch(applied)
+        dt = max(time.perf_counter() - t0 - oh, 1e-9) / iters
+        best = dt if best is None else min(best, dt)
+    return n / best
 
 
 def summary(jax, N=256, P=16):
@@ -155,6 +217,7 @@ def summary(jax, N=256, P=16):
     gate_dev = gate_throughput(N, batched=True)
     gate_dev = max(gate_dev, gate_throughput(N, batched=True))  # warm jit
     gate_host = gate_throughput(N, batched=False)
+    gate_kernel = gate_device_kernel_rate(jax, N)
     # host-vs-device crossover table (round-2 verdict #5): the live gate
     # adapts at runtime from measured cost; this records where the
     # crossover sits on THIS platform for the judge's record
@@ -176,6 +239,7 @@ def summary(jax, N=256, P=16):
         "gst_convergence_us": round(dt * 1e6 * rounds, 1),
         "gst_host_round_ms": round(host_dt * 1e3, 3),
         "gate_txns_per_sec_device_fixpoint": round(gate_dev),
+        "gate_device_kernel_txns_per_sec": round(gate_kernel),
         "gate_txns_per_sec_host_walk": round(gate_host),
         "gate_speedup": round(gate_dev / gate_host, 2),
         "gate_crossover": crossover,
