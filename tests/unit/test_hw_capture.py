@@ -1,0 +1,54 @@
+"""The hardware-capture assembler must refuse to label non-TPU phase
+results as chip evidence (a tunnel drop between the probe and a phase
+subprocess's jax init silently falls back to CPU)."""
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo/tools")
+import hw_capture  # noqa: E402
+
+
+def _write_phases(d, backend="tpu", device="TPU v5 lite0"):
+    hd = {"backend": backend, "device": device, "dev_ops": 1e6,
+          "keys": 1, "batch": 1, "steps": 1, "headline_variant": {},
+          "variants": {}, "read_jnp_s": 0.1, "read_fused_s": 0.1,
+          "read_hybrid_s": 0.1, "captured_at": 0.0}
+    (d / "headline.json").write_text(json.dumps(hd))
+    (d / "baselines.json").write_text(json.dumps(
+        {"host_ops": 1.0, "cpp_ops": 2.0, "cpu_count": 1,
+         "captured_at": 0.0}))
+    (d / "entry.json").write_text(json.dumps(
+        {"backend": backend, "entry_compile_run_s": 1.0,
+         "captured_at": 0.0}))
+    (d / "gst.json").write_text(json.dumps(
+        {"backend": backend, "gst_gossip_round_us": 1.0,
+         "captured_at": 0.0}))
+    cfg = {"value": 1, "unit": "ops/s", "vs_baseline": 1.0,
+           "detail": {"device": device}}
+    for name in ("config1", "config3", "config4", "config6"):
+        (d / (name + ".json")).write_text(json.dumps(cfg))
+
+
+def test_assemble_accepts_tpu_phases(tmp_path):
+    _write_phases(tmp_path)
+    line = hw_capture.assemble(str(tmp_path))
+    assert line["detail"]["degraded"] is False
+    assert line["detail"]["self_captured"] is True
+
+
+def test_assemble_refuses_cpu_backend(tmp_path):
+    _write_phases(tmp_path, backend="cpu", device="TFRT_CPU_0")
+    with pytest.raises(RuntimeError, match="not tpu"):
+        hw_capture.assemble(str(tmp_path))
+
+
+def test_assemble_refuses_cpu_config_device(tmp_path):
+    _write_phases(tmp_path)
+    cfg = {"value": 1, "unit": "ops/s", "vs_baseline": 1.0,
+           "detail": {"device": "TFRT_CPU_0"}}
+    (tmp_path / "config3.json").write_text(json.dumps(cfg))
+    with pytest.raises(RuntimeError, match="not a TPU"):
+        hw_capture.assemble(str(tmp_path))

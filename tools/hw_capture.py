@@ -61,8 +61,8 @@ def log(msg):
     print(f"{time.strftime('%FT%T')} {msg}", file=sys.stderr, flush=True)
 
 
-def phase_path(name):
-    return os.path.join(PHASE_DIR, name + ".json")
+def phase_path(name, phase_dir=None):
+    return os.path.join(phase_dir or PHASE_DIR, name + ".json")
 
 
 def have(name):
@@ -106,11 +106,11 @@ def run_phase(name, cmd, timeout):
     return True
 
 
-def assemble():
+def assemble(phase_dir=None):
     """BENCH line in bench.py's schema from the checkpointed phases."""
     p = {}
     for name, _, _, _ in PHASES:
-        with open(phase_path(name)) as f:
+        with open(phase_path(name, phase_dir)) as f:
             p[name] = json.loads(f.read())
     hd, base = p["headline"], p["baselines"]
     for name in ("headline", "entry", "gst"):
