@@ -6,9 +6,16 @@ txn's snapshot with the origin entry zeroed (the origin dependency is
 already guaranteed by FIFO order + opid continuity) — reference
 try_store, src/inter_dc_dep_vnode.erl:121-154.  Applying a txn appends
 its records to the local log without assigning local ids and pushes the
-effects into the materializer store (:144-152).  Heartbeats just advance
-the origin's clock entry (:124-125).  Queues are processed to fixpoint
-whenever the clock advances (:96-117).
+effects into the materializer store (:144-152).  Heartbeats advance the
+origin's clock entry to their stamp MINUS ONE — a deliberate hardening
+over the reference's inclusive advance (:124-125): the heartbeat's
+contract is "no future txn commits with a SMALLER time"
+(inter_dc_log_sender_vnode.erl:92), and a commit at EXACTLY the stamp
+can still be in flight (Clock-SI commit time = max of prepare times =
+the max-prepare partition's min_prepared), so the inclusive form lets a
+causal reader pass the stable wait and miss that txn (see
+_process_host).  Queues are processed to fixpoint whenever the clock
+advances (:96-117).
 
 At a handful of DCs the fixpoint is a host walk over queue heads.  At
 hundreds of DCs (BASELINE config 5) the walk is the bottleneck, so past
@@ -107,6 +114,8 @@ class DependencyGate:
         advanced_any = False
         while True:
             pend = self.pending()
+            if pend == 0:
+                break
             if pend >= self.batch_threshold:
                 advanced_any |= self._timed_pass(pend)
             else:
@@ -171,7 +180,22 @@ class DependencyGate:
                 while q:
                     txn = q[0]
                     if txn.is_ping():
-                        self._advance(origin, txn.timestamp)
+                        # EXCLUSIVE advance: the ping's contract is "no
+                        # FUTURE txn will commit with a SMALLER time"
+                        # (reference inter_dc_log_sender_vnode.erl:92)
+                        # — the stream is complete only BELOW the
+                        # stamp.  A commit at EXACTLY the stamp can
+                        # still be in flight: Clock-SI picks commit
+                        # time = max(prepare times), so the max-prepare
+                        # partition's min_prepared EQUALS the pending
+                        # commit's time, and its heartbeat can outrun
+                        # the commit record.  The reference advances
+                        # inclusively (inter_dc_dep_vnode.erl:122-125)
+                        # and carries this µs-level race; in-process
+                        # delivery here hits it ~5% of runs (caught by
+                        # tests/multidc/test_ring_placement.py under
+                        # load), so we harden to ts-1.
+                        self._advance(origin, txn.timestamp - 1)
                         q.popleft()
                         progress = advanced = True
                         continue
@@ -242,7 +266,10 @@ class DependencyGate:
         for i, (origin, pos, txn) in enumerate(flat):
             origin_col[i] = cols[origin]
             pos_arr[i] = pos
-            ts[i] = txn.timestamp
+            # exclusive ping advance (see _process_host): the kernel
+            # folds applied rows' ts into the clock, so a ping row
+            # carries ts-1
+            ts[i] = txn.timestamp - 1 if txn.is_ping() else txn.timestamp
             if txn.is_ping():
                 ping[i] = True
             else:
@@ -278,7 +305,8 @@ class DependencyGate:
             assert q[0] is txn, "device fixpoint applied out of FIFO order"
             q.popleft()
             if txn.is_ping():
-                self._advance(origin, txn.timestamp)
+                # exclusive ping advance (see _process_host)
+                self._advance(origin, txn.timestamp - 1)
             else:
                 try:
                     self._apply(txn)

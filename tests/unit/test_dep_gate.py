@@ -115,11 +115,41 @@ def test_fifo_blocks_later_ready_txns():
 def test_pings_advance_clock_and_unblock():
     gate, pm = make_gate(threshold=0)
     a1 = make_txn("a", 150, {"b": 500})
-    ping_b = make_txn("b", 500, {}, ping=True)
+    ping_b = make_txn("b", 501, {}, ping=True)
     run(gate, {"a": [a1], "b": [ping_b]})
     assert pm.applied == [("a", 150)]
     assert gate.applied_vc.get_dc("b") == 500
     assert gate.pending() == 0
+
+
+@pytest.mark.parametrize("threshold", [0, 10**9])
+def test_ping_advance_is_exclusive(threshold):
+    """A heartbeat's contract is "no FUTURE txn commits with a SMALLER
+    time" — completeness only BELOW the stamp.  Clock-SI picks commit
+    time = max(prepare times), so the max-prepare partition's
+    min_prepared EQUALS a pending commit's time and its ping can
+    outrun the commit record; an inclusive advance would let a causal
+    reader pass the stable wait and miss the txn (the reference
+    carries this µs race, inter_dc_dep_vnode.erl:122-125; caught live
+    by tests/multidc/test_ring_placement.py under load)."""
+    gate, pm = make_gate(threshold=threshold)
+    # a ping stamped exactly at a still-in-flight commit's time...
+    ping_b = make_txn("b", 500, {}, ping=True)
+    run(gate, {"b": [ping_b]})
+    # ...must NOT claim completeness AT 500
+    assert gate.applied_vc.get_dc("b") == 499
+    # a dependency on b at exactly 500 stays gated until the real txn
+    gate2, pm2 = make_gate(threshold=threshold)
+    a1 = make_txn("a", 150, {"b": 500})
+    run(gate2, {"a": [a1], "b": [make_txn("b", 500, {}, ping=True)]})
+    assert pm2.applied == []
+    assert gate2.pending() == 1
+    # the commit record itself (ts=500) releases it
+    b1 = make_txn("b", 500, {})
+    gate2.enqueue(b1)
+    gate2.process_queues()
+    assert ("a", 150) in pm2.applied and ("b", 500) in pm2.applied
+    assert gate2.pending() == 0
 
 
 def test_blocked_head_advances_clock_breaks_cross_block():
