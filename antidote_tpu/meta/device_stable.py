@@ -92,9 +92,15 @@ class DeviceStableTimeTracker(StableTimeTracker):
     # -- row ingestion ----------------------------------------------------
 
     def put(self, partition: int, vc: VC) -> None:
-        super().put(partition, vc)  # the host oracle row
-        with self._dev_lock:
-            self._dirty.add(partition)
+        # one critical section for the host-row update AND the
+        # dirty-mark (the tracker lock is an RLock for exactly this):
+        # released in between, a snapshot holding both locks could fold
+        # the NEW row on host but skip flushing the device mirror
+        # (partition not yet dirty) — dev lagging host by one put
+        with self._lock:
+            super().put(partition, vc)  # the host oracle row
+            with self._dev_lock:
+                self._dirty.add(partition)
 
     # -- device plumbing --------------------------------------------------
 
@@ -173,13 +179,20 @@ class DeviceStableTimeTracker(StableTimeTracker):
         """(device snapshot, host snapshot) folded from ONE source
         refresh — the oracle-equality form: time-dependent sources
         (min-prepared reads the clock) make two separately-refreshed
-        snapshots incomparable."""
+        snapshots incomparable.  Both folds run under ONE lock hold:
+        a concurrent put() between them would feed the later fold
+        newer rows and make the pair transiently unequal (observed
+        live with background heartbeats — the device fold lagging the
+        host fold by one put)."""
         if self.sources:
             self.refresh()
-        dev = self._device_snapshot()
-        with self._lock:
-            stable = self.sender.merged("stable")
+        with self._lock, self._dev_lock:
+            # ONE floor peek shared by both folds: a concurrent
+            # seed_floor between two peeks would skew only the later
+            # fold
             floor = self.sender.peek("stable_floor")
+            dev = self._device_snapshot_locked(floor)
+            stable = self.sender.merged("stable")
             host = VC(stable if floor is None else stable.join(floor))
         return dev, host
 
@@ -188,39 +201,41 @@ class DeviceStableTimeTracker(StableTimeTracker):
             self.refresh()
         if self.n_partitions == 0:
             return super().get_stable_snapshot()
-        return self._device_snapshot()
+        with self._lock, self._dev_lock:
+            return self._device_snapshot_locked(
+                self.sender.peek("stable_floor"))
 
-    def _device_snapshot(self) -> VC:
+    def _device_snapshot_locked(self, floor) -> VC:
+        """The device fold; caller holds self._lock AND self._dev_lock
+        and passes the floor it peeked (one peek per snapshot)."""
         import jax
 
-        with self._lock, self._dev_lock:
-            self._ensure_width()
-            if self._fold_fn is None:
-                self._build_fold()
-            self._flush_dirty()
-            fold, sharding = self._fold_fn
-            n = len(self.devices)
-            for k in range(n):
-                if self._blocks_dev[k] is None:
-                    self._blocks_dev[k] = jax.device_put(
-                        self._blocks_host[k], self.devices[k])
-            global_mat = jax.make_array_from_single_device_arrays(
-                (n * self._rpd, self._d_pad), sharding,
-                self._blocks_dev)
-            row = np.asarray(fold(global_mat))
-            # +inf pad rows survive the min only when a column is
-            # beyond every real row's width — those columns are absent
-            # from the domain anyway; mask for safety
-            row = np.where(row == _I64_MAX, 0, row)
-            gst = self.domain.from_dense(row[:self.domain.d])
-            floor = self.sender.peek("stable_floor")
-            if floor is not None:
-                gst = gst.join(floor)
-            # monotone publish, the device path's own lineage
-            self._published_dev = (
-                gst if self._published_dev is None
-                else self._published_dev.join(gst))
-            return VC(self._published_dev)
+        self._ensure_width()
+        if self._fold_fn is None:
+            self._build_fold()
+        self._flush_dirty()
+        fold, sharding = self._fold_fn
+        n = len(self.devices)
+        for k in range(n):
+            if self._blocks_dev[k] is None:
+                self._blocks_dev[k] = jax.device_put(
+                    self._blocks_host[k], self.devices[k])
+        global_mat = jax.make_array_from_single_device_arrays(
+            (n * self._rpd, self._d_pad), sharding,
+            self._blocks_dev)
+        row = np.asarray(fold(global_mat))
+        # +inf pad rows survive the min only when a column is
+        # beyond every real row's width — those columns are absent
+        # from the domain anyway; mask for safety
+        row = np.where(row == _I64_MAX, 0, row)
+        gst = self.domain.from_dense(row[:self.domain.d])
+        if floor is not None:
+            gst = gst.join(floor)
+        # monotone publish, the device path's own lineage
+        self._published_dev = (
+            gst if self._published_dev is None
+            else self._published_dev.join(gst))
+        return VC(self._published_dev)
 
 
 def make_stable_tracker(config, dc_id, n_partitions: int,

@@ -40,7 +40,10 @@ class StableTimeTracker:
         self.n_partitions = n_partitions
         self.domain = domain or ClockDomain(8)
         self.sender = sender or MetaDataSender()
-        self._lock = threading.Lock()
+        # RLock: DeviceStableTimeTracker.put wraps super().put plus its
+        # dirty-mark in one outer hold so a snapshot can never observe
+        # the row updated but the device mirror not yet marked stale
+        self._lock = threading.RLock()
         self.sender.register(
             "stable", n_partitions,
             initial=lambda: np.zeros(self.domain.d, dtype=np.int64),
