@@ -121,15 +121,31 @@ class Proc:
             self.p.stdin.flush()
         except (BrokenPipeError, OSError):
             pass
-        self.p.wait(timeout=10)
+        self._wait_or_kill()
+
+    def _wait_or_kill(self):
+        # a starved CI box can overrun a polite grace period; teardown
+        # must never error, so escalate to SIGKILL instead of raising
+        try:
+            self.p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.p.kill()
+            try:
+                self.p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # D-state child: beyond SIGKILL; do not error
 
     def stop(self):
         if self.p.poll() is None:
+            # fire-and-forget exit: send() waits for a reply line with
+            # no real timeout (blocking readline), so a hung child
+            # would wedge teardown before _wait_or_kill could escalate
             try:
-                self.send({"cmd": "exit"}, timeout=10)
-            except Exception:
+                self.p.stdin.write(json.dumps({"cmd": "exit"}) + "\n")
+                self.p.stdin.flush()
+            except (BrokenPipeError, OSError):
                 self.p.kill()
-            self.p.wait(timeout=10)
+            self._wait_or_kill()
 
 
 @pytest.fixture
