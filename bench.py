@@ -56,128 +56,118 @@ def build_stream(K, B, n_steps, D, n_dcs, rng):
     return steps
 
 
-def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
-    """Returns (best_variant_dict, read_jnp, read_fused, read_hybrid).
+#: the headline shard shape (BASELINE config 2) — shared with
+#: tools/hw_phase.py so the checkpointed phases measure EXACTLY the
+#: configuration bench.py reports
+HEADLINE_SHAPE = dict(K=1_000_000, B=65_536, D=8, n_dcs=3, warmup=2)
 
-    Round-5 methodology (measured on the real chip, see CHANGES_r05):
-    - the per-batch XLA scatter costs ~200 ns/row SERIALIZED and is the
-      dominant term, but scales sub-linearly in batch size (65k rows
-      13.5 ms, 262k rows 30 ms) — so the bench also measures the
-      COALESCED configuration the production flusher reaches under
-      load (mat/device_plane.py batches pending commit groups per
-      flush), where each device append carries several stream chunks;
-    - the whole timed loop is ONE jitted lax.scan program: the tunnel
-      charges ~6 ms per dispatch, which is a measurement artifact of
-      this rig's remote topology (a colocated host dispatches in µs),
-      and scan also mirrors how the plane replays a backlog;
-    - overflow (ops dropped for lane pressure) is fetched and reported
-      — a coalescing level is only honest while overflow stays ~0.
 
-    Variants: (coalesce=1, gc_every=4) is the historic configuration
-    (BENCH_r01..r04 comparable); (coalesce=4, gc_every=3) and
-    (coalesce=8, gc_every=2) trade scatter count against per-key lane
-    load (the deepest level rides ~1 op/key mean between folds at 1M
-    keys).  The headline is the fastest; all land in the detail
-    dict."""
+def headline_sweep(n_steps, gc_every=4):
+    """name -> (coalesce, gc_every, n_appends, with_reads): the
+    coalescing-variant sweep bench_device runs (reads ride on b4's
+    final state).  Single source of truth for bench_device AND the
+    phase-checkpointed hardware capture (tools/hw_phase.py)."""
+    return {
+        "b1": (1, gc_every, n_steps, False),
+        "b4": (4, 3, max(n_steps // 4, 3), True),
+        "b8": (8, 2, max(n_steps // 8, 2), False),
+    }
+
+
+def bench_variant(K, B, D, n_dcs, warmup, rng,
+                  coalesce, gc_every_v, n_appends):
+    """One coalescing-variant run of BASELINE config 2 (see
+    bench_device) — module-level so tools/hw_phase.py can checkpoint
+    each variant as its own tunnel-window-sized phase.  Returns
+    (variant dict, final state, last frontier, fetch overhead)."""
     import jax
     import jax.numpy as jnp
 
     from antidote_tpu.mat import store
 
-    rng = np.random.default_rng(0)
+    bb = B * coalesce
+    steps = build_stream(K, bb, n_appends + warmup, D, n_dcs, rng)
+    st = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=D,
+                                dtype=jnp.int32)
 
-    def run_variant(coalesce, gc_every_v, n_appends):
-        bb = B * coalesce
-        steps = build_stream(K, bb, n_appends + warmup, D, n_dcs, rng)
-        st = store.orset_shard_init(K, n_lanes=8, n_slots=8, n_dcs=D,
-                                    dtype=jnp.int32)
+    def put(s):
+        return {k: jax.device_put(jnp.asarray(v))
+                for k, v in s.items()}
 
-        def put(s):
-            return {k: jax.device_put(jnp.asarray(v))
-                    for k, v in s.items()}
+    dev_steps = [put(s) for s in steps]
 
-        dev_steps = [put(s) for s in steps]
+    def one_step(st, s, do_gc):
+        st, ov = store.orset_append(
+            st, s["key_idx"], s["lane_off"], s["elem_slot"],
+            s["is_add"], s["dot_dc"], s["dot_seq"], s["obs_vv"],
+            s["op_dc"], s["op_ct"], s["op_ss"])
+        if do_gc:
+            # amortized fold at the batch frontier (the reference
+            # GCs per key every ?OPS_THRESHOLD ops — also
+            # amortized); L lanes absorb gc_every appends of
+            # per-key arrivals
+            st = store.orset_gc(st, s["frontier"])
+        return st, ov
 
-        def one_step(st, s, do_gc):
+    for s in dev_steps[:warmup]:
+        st, _ = one_step(st, s, True)
+    fetch(st.dots)
+
+    stacked = {k: jnp.stack([d[k] for d in dev_steps[warmup:]])
+               for k in dev_steps[0]}
+    do_gc = jnp.asarray(
+        [(i + 1) % gc_every_v == 0 for i in range(n_appends)])
+
+    @jax.jit
+    def run(st, stacked, do_gc):
+        def body(st, x):
+            s, g = x
             st, ov = store.orset_append(
                 st, s["key_idx"], s["lane_off"], s["elem_slot"],
                 s["is_add"], s["dot_dc"], s["dot_seq"], s["obs_vv"],
                 s["op_dc"], s["op_ct"], s["op_ss"])
-            if do_gc:
-                # amortized fold at the batch frontier (the reference
-                # GCs per key every ?OPS_THRESHOLD ops — also
-                # amortized); L lanes absorb gc_every appends of
-                # per-key arrivals
-                st = store.orset_gc(st, s["frontier"])
-            return st, ov
+            st = jax.lax.cond(
+                g, lambda t: store.orset_gc(t, s["frontier"]),
+                lambda t: t, st)
+            return st, jnp.sum(ov)
+        return jax.lax.scan(body, st, (stacked, do_gc))
 
-        for s in dev_steps[:warmup]:
-            st, _ = one_step(st, s, True)
-        fetch(st.dots)
-
-        stacked = {k: jnp.stack([d[k] for d in dev_steps[warmup:]])
-                   for k in dev_steps[0]}
-        do_gc = jnp.asarray(
-            [(i + 1) % gc_every_v == 0 for i in range(n_appends)])
-
-        @jax.jit
-        def run(st, stacked, do_gc):
-            def body(st, x):
-                s, g = x
-                st, ov = store.orset_append(
-                    st, s["key_idx"], s["lane_off"], s["elem_slot"],
-                    s["is_add"], s["dot_dc"], s["dot_seq"], s["obs_vv"],
-                    s["op_dc"], s["op_ct"], s["op_ss"])
-                st = jax.lax.cond(
-                    g, lambda t: store.orset_gc(t, s["frontier"]),
-                    lambda t: t, st)
-                return st, jnp.sum(ov)
-            return jax.lax.scan(body, st, (stacked, do_gc))
-
-        stc, ov = run(st, stacked, do_gc)          # compile + warm run
-        fetch(stc.dots)
+    stc, ov = run(st, stacked, do_gc)          # compile + warm run
+    fetch(stc.dots)
+    t0 = time.perf_counter()
+    fetch(stc.dots)
+    fetch_oh = time.perf_counter() - t0
+    best = None
+    for _ in range(2):
         t0 = time.perf_counter()
+        stc, ov = run(st, stacked, do_gc)
         fetch(stc.dots)
-        fetch_oh = time.perf_counter() - t0
-        best = None
-        for _ in range(2):
-            t0 = time.perf_counter()
-            stc, ov = run(st, stacked, do_gc)
-            fetch(stc.dots)
-            dt = max(time.perf_counter() - t0 - fetch_oh, 1e-9)
-            best = dt if best is None else min(best, dt)
-        # dropped (overflowed) ops were never merged: they do not count
-        # toward the rate, and a variant that sheds load cannot win on
-        # the shed ops
-        dropped = int(np.sum(np.asarray(ov)))
-        n_ops = bb * n_appends - dropped
-        return {
-            "coalesce": coalesce, "batch_rows": bb,
-            "gc_every": gc_every_v, "appends": n_appends,
-            "ops": n_ops, "seconds": round(best, 4),
-            "overflow_dropped": dropped,
-            "ops_per_sec": n_ops / best,
-        }, stc, dev_steps[-1]["frontier"], fetch_oh
+        dt = max(time.perf_counter() - t0 - fetch_oh, 1e-9)
+        best = dt if best is None else min(best, dt)
+    # dropped (overflowed) ops were never merged: they do not count
+    # toward the rate, and a variant that sheds load cannot win on
+    # the shed ops
+    dropped = int(np.sum(np.asarray(ov)))
+    n_ops = bb * n_appends - dropped
+    return {
+        "coalesce": coalesce, "batch_rows": bb,
+        "gc_every": gc_every_v, "appends": n_appends,
+        "ops": n_ops, "seconds": round(best, 4),
+        "overflow_dropped": dropped,
+        "ops_per_sec": n_ops / best,
+    }, stc, dev_steps[-1]["frontier"], fetch_oh
 
-    v1 = run_variant(1, gc_every, n_steps)[0]  # drop the ~1 GB state
-    # coalesced: fewer/bigger scatters over the same stream shape (the
-    # XLA scatter is serialized per row but sublinear in batch size);
-    # the deepest level rides ~1 op/key mean lane load between folds —
-    # its (deducted, reported) overflow stays a handful of ops at 1M
-    # keys
-    v8 = run_variant(8, 2, max(n_steps // 8, 2))[0]
-    v4, stc, frontier, fetch_oh = run_variant(
-        4, 3, max(n_steps // 4, 3))
-    allv = (v1, v4, v8)
-    variants = {"b%d_gc%d" % (v["batch_rows"], v["gc_every"]): v
-                for v in allv}
-    bestv = max(allv, key=lambda v: v["ops_per_sec"])
-    bestv = dict(bestv, variants=variants)
 
-    # full-shard read, chained on itself so each read depends on the
-    # last — measured through both read paths (jnp reference, Pallas
-    # fused packed-row) on the coalesced variant's final state
-    n_reads = 10
+
+def bench_reads(stc, frontier, fetch_oh, n_reads=10):
+    """Full-shard read latency on a built store state, chained on
+    itself so each read depends on the last — measured through the jnp
+    reference path and both Pallas fused variants.  Module-level so
+    tools/hw_phase.py can run it inside a checkpointed phase."""
+    import jax
+    import jax.numpy as jnp
+
+    from antidote_tpu.mat import store
 
     def chain_read(read_fn):
         def one_read(present):
@@ -210,8 +200,59 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
         except Exception as e:
             return "ERR: " + repr(e)[:160]
 
-    read_fused = try_read(True)
-    read_hybrid = try_read("hybrid")
+    return read_jnp, try_read(True), try_read("hybrid")
+
+
+def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
+    """Returns (best_variant_dict, read_jnp, read_fused, read_hybrid).
+
+    Round-5 methodology (measured on the real chip, see CHANGES_r05):
+    - the per-batch XLA scatter costs ~200 ns/row SERIALIZED and is the
+      dominant term, but scales sub-linearly in batch size (65k rows
+      13.5 ms, 262k rows 30 ms) — so the bench also measures the
+      COALESCED configuration the production flusher reaches under
+      load (mat/device_plane.py batches pending commit groups per
+      flush), where each device append carries several stream chunks;
+    - the whole timed loop is ONE jitted lax.scan program: the tunnel
+      charges ~6 ms per dispatch, which is a measurement artifact of
+      this rig's remote topology (a colocated host dispatches in µs),
+      and scan also mirrors how the plane replays a backlog;
+    - overflow (ops dropped for lane pressure) is fetched and reported
+      — a coalescing level is only honest while overflow stays ~0.
+
+    Variants: (coalesce=1, gc_every=4) is the historic configuration
+    (BENCH_r01..r04 comparable); (coalesce=4, gc_every=3) and
+    (coalesce=8, gc_every=2) trade scatter count against per-key lane
+    load (the deepest level rides ~1 op/key mean between folds at 1M
+    keys).  The headline is the fastest; all land in the detail
+    dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from antidote_tpu.mat import store
+
+    rng = np.random.default_rng(0)
+
+    def run_variant(coalesce, gc_every_v, n_appends):
+        return bench_variant(K, B, D, n_dcs, warmup, rng,
+                             coalesce, gc_every_v, n_appends)
+
+    sweep = headline_sweep(n_steps, gc_every)
+    # coalesced levels trade scatter count against per-key lane load
+    # (XLA scatter is serialized per row but sublinear in batch size);
+    # overflow is deducted and reported.  Non-reads variants drop
+    # their ~1 GB final state immediately.
+    v1 = run_variant(*sweep["b1"][:3])[0]
+    v8 = run_variant(*sweep["b8"][:3])[0]
+    v4, stc, frontier, fetch_oh = run_variant(*sweep["b4"][:3])
+    allv = (v1, v4, v8)
+    variants = {"b%d_gc%d" % (v["batch_rows"], v["gc_every"]): v
+                for v in allv}
+    bestv = max(allv, key=lambda v: v["ops_per_sec"])
+    bestv = dict(bestv, variants=variants)
+
+    read_jnp, read_fused, read_hybrid = bench_reads(stc, frontier,
+                                                    fetch_oh)
     return bestv, read_jnp, read_fused, read_hybrid
 
 

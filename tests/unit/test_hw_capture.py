@@ -12,11 +12,20 @@ import hw_capture  # noqa: E402
 
 
 def _write_phases(d, backend="tpu", device="TPU v5 lite0"):
-    hd = {"backend": backend, "device": device, "dev_ops": 1e6,
-          "keys": 1, "batch": 1, "steps": 1, "headline_variant": {},
-          "variants": {}, "read_jnp_s": 0.1, "read_fused_s": 0.1,
-          "read_hybrid_s": 0.1, "captured_at": 0.0}
-    (d / "headline.json").write_text(json.dumps(hd))
+    def hv(coalesce, gc, rate, reads=False):
+        out = {"backend": backend, "device": device, "keys": 1,
+               "batch": 1, "steps": 1, "captured_at": 0.0,
+               "variant": {"coalesce": coalesce, "batch_rows": coalesce,
+                           "gc_every": gc, "ops_per_sec": rate,
+                           "appends": 5, "overflow_dropped": 0}}
+        if reads:
+            out.update(read_jnp_s=0.1, read_fused_s=0.1,
+                       read_hybrid_s=0.1)
+        return out
+    (d / "headline_b1.json").write_text(json.dumps(hv(1, 4, 1e6)))
+    (d / "headline_b4.json").write_text(
+        json.dumps(hv(4, 3, 2e6, reads=True)))
+    (d / "headline_b8.json").write_text(json.dumps(hv(8, 2, 1.5e6)))
     (d / "baselines.json").write_text(json.dumps(
         {"host_ops": 1.0, "cpp_ops": 2.0, "cpu_count": 1,
          "captured_at": 0.0}))
@@ -37,6 +46,9 @@ def test_assemble_accepts_tpu_phases(tmp_path):
     line = hw_capture.assemble(str(tmp_path))
     assert line["detail"]["degraded"] is False
     assert line["detail"]["self_captured"] is True
+    # headline = fastest variant, all three recorded
+    assert line["value"] == 2_000_000
+    assert len(line["detail"]["variants"]) == 3
 
 
 def test_assemble_refuses_cpu_backend(tmp_path):

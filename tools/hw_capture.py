@@ -38,9 +38,18 @@ PHASES = [
     ("config6", False,
      [sys.executable, "-m", "benches.config6_txn", "--cpu", "--quick"],
      900),
-    ("headline", True,
-     [sys.executable, os.path.join("tools", "hw_phase.py"), "headline"],
-     2400),
+    # the headline sweep is split into one phase per coalescing
+    # variant: each is tunnel-window-sized and checkpoints on its own
+    # (reads ride on b4's final state)
+    ("headline_b4", True,
+     [sys.executable, os.path.join("tools", "hw_phase.py"),
+      "headline_b4"], 1800),
+    ("headline_b1", True,
+     [sys.executable, os.path.join("tools", "hw_phase.py"),
+      "headline_b1"], 1800),
+    ("headline_b8", True,
+     [sys.executable, os.path.join("tools", "hw_phase.py"),
+      "headline_b8"], 1800),
     ("entry", True,
      [sys.executable, os.path.join("tools", "hw_phase.py"), "entry"],
      900),
@@ -112,8 +121,27 @@ def assemble(phase_dir=None):
     for name, _, _, _ in PHASES:
         with open(phase_path(name, phase_dir)) as f:
             p[name] = json.loads(f.read())
-    hd, base = p["headline"], p["baselines"]
-    for name in ("headline", "entry", "gst"):
+    hv = {w: p["headline_" + w] for w in ("b1", "b4", "b8")}
+    variants = {"b%d_gc%d" % (v["variant"]["batch_rows"],
+                              v["variant"]["gc_every"]): v["variant"]
+                for v in hv.values()}
+    best = max((v["variant"] for v in hv.values()),
+               key=lambda v: v["ops_per_sec"])
+    b4 = hv["b4"]
+    hd = {  # explicit: no stale leftovers from the b4 phase dict
+        "device": b4["device"], "keys": b4["keys"], "batch": b4["batch"],
+        "dev_ops": best["ops_per_sec"],
+        "headline_variant": best, "variants": variants,
+        # the appends behind the headline number (per-variant counts
+        # live in `variants`)
+        "steps": best["appends"],
+        "read_jnp_s": b4["read_jnp_s"],
+        "read_fused_s": b4["read_fused_s"],
+        "read_hybrid_s": b4["read_hybrid_s"],
+    }
+    base = p["baselines"]
+    for name in ("headline_b1", "headline_b4", "headline_b8",
+                 "entry", "gst"):
         if p[name].get("backend") != "tpu":
             raise RuntimeError(
                 "phase %r recorded backend %r, not tpu — refusing to "

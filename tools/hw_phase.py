@@ -4,7 +4,10 @@ the whole capture).  Each phase prints exactly one JSON line on stdout
 as its final output; everything else goes to stderr.
 
 Phases:
-  headline   bench_device at 1M keys (BASELINE config 2) — the north star
+  headline_b1 / headline_b4 / headline_b8
+             one coalescing variant each of the 1M-key headline sweep
+             (BASELINE config 2, the north star; reads ride on b4's
+             final state) — split so each fits a short tunnel window
   baselines  host CPython + native C++ per-op loops (no tunnel needed)
   entry      __graft_entry__.entry() compile + run on the live chip
   gst        config-5 GST fold at 256 DCs on the live chip
@@ -28,26 +31,37 @@ def _cache():
     enable_compile_cache()
 
 
-def phase_headline():
+def phase_headline_variant(which):
+    """One coalescing variant of the headline sweep — a
+    tunnel-window-sized unit the orchestrator checkpoints on its own;
+    the sweep spec and shard shape come from bench.py (single source
+    of truth)."""
     _cache()
+    import numpy as np
+
     import jax
 
     import bench
 
-    bestv, read_jnp, read_fused, read_hybrid = bench.bench_device(
-        K=1_000_000, B=65_536, n_steps=20, D=8, n_dcs=3)
-    return {
+    shape = bench.HEADLINE_SHAPE
+    coalesce, gc_every, n_appends, with_reads = \
+        bench.headline_sweep(n_steps=20)[which]
+    rng = np.random.default_rng(0)
+    v, stc, frontier, fetch_oh = bench.bench_variant(
+        shape["K"], shape["B"], shape["D"], shape["n_dcs"],
+        shape["warmup"], rng, coalesce, gc_every, n_appends)
+    out = {
         "device": str(jax.devices()[0]),
         "backend": jax.default_backend(),
-        "dev_ops": bestv["ops_per_sec"],
-        "headline_variant": {k: v for k, v in bestv.items()
-                             if k != "variants"},
-        "variants": bestv["variants"],
-        "keys": 1_000_000, "batch": 65_536, "steps": 20,
-        "read_jnp_s": read_jnp,
-        "read_fused_s": read_fused,
-        "read_hybrid_s": read_hybrid,
+        "keys": shape["K"], "batch": shape["B"],
+        "variant": v,
     }
+    if with_reads:
+        read_jnp, read_fused, read_hybrid = bench.bench_reads(
+            stc, frontier, fetch_oh)
+        out.update(read_jnp_s=read_jnp, read_fused_s=read_fused,
+                   read_hybrid_s=read_hybrid)
+    return out
 
 
 def phase_baselines():
@@ -92,8 +106,11 @@ def phase_gst():
 
 def main():
     name = sys.argv[1]
-    fn = {"headline": phase_headline, "baselines": phase_baselines,
-          "entry": phase_entry, "gst": phase_gst}[name]
+    fn = {"baselines": phase_baselines,
+          "entry": phase_entry, "gst": phase_gst,
+          "headline_b1": lambda: phase_headline_variant("b1"),
+          "headline_b4": lambda: phase_headline_variant("b4"),
+          "headline_b8": lambda: phase_headline_variant("b8")}[name]
     t0 = time.time()
     out = fn()
     out["captured_at"] = t0
