@@ -1,0 +1,44 @@
+"""The headline sweep is the single source of truth shared by
+bench_device and the phase-checkpointed hardware capture — these pin
+the contract so the two can't drift apart silently."""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/tools")
+
+import bench  # noqa: E402
+import hw_capture  # noqa: E402
+
+
+def test_sweep_names_match_capture_phases():
+    sweep = bench.headline_sweep(20)
+    phase_names = {name for name, _, _, _ in hw_capture.PHASES
+                   if name.startswith("headline_")}
+    assert phase_names == {"headline_" + w for w in sweep}
+    # exactly one variant carries the read measurements
+    assert sum(1 for v in sweep.values() if v[3]) == 1
+
+
+def test_sweep_shapes():
+    sweep = bench.headline_sweep(20)
+    assert sweep["b1"][:3] == (1, 4, 20)
+    assert sweep["b4"][:3] == (4, 3, 5)
+    assert sweep["b8"][:3] == (8, 2, 2)
+    # quick mode keeps every variant runnable
+    for c, g, n, _r in bench.headline_sweep(4).values():
+        assert n >= 2 and g >= 1
+
+
+def test_bench_variant_contract():
+    rng = np.random.default_rng(0)
+    v, stc, frontier, fetch_oh = bench.bench_variant(
+        16_384, 2_048, 8, 3, 1, rng, coalesce=2, gc_every_v=2,
+        n_appends=2)
+    assert v["ops_per_sec"] > 0
+    assert v["batch_rows"] == 4_096
+    assert v["ops"] == 4_096 * 2 - v["overflow_dropped"]
+    assert stc.dots.shape[0] == 16_384
+    assert fetch_oh >= 0
