@@ -1,7 +1,8 @@
 """Causal-consistency checker: concurrent cross-DC traces validated
 against the Clock-SI visibility rules (the test class that catches
 clock/visibility races directly — the round-5 heartbeat/commit race
-produced exactly a causal-floor violation of the kind checked here).
+produced exactly a causal-floor violation of the kind checked here;
+resurrecting that bug under a monkeypatch makes these tests fail).
 
 Writers add UNIQUE elements to set_aw keys (each write's returned
 commit VC identifies it exactly); readers snapshot-read concurrently
@@ -18,151 +19,23 @@ satisfy:
 3. **Session monotonicity**: within one reader session (each read
    seeded with the previous read's returned clock), visibility never
    shrinks.
-"""
 
-import threading
-import time
+Rule definitions and the trace generator live in tests/causal_core.py
+(shared with the federation-scale variant,
+tests/cluster/test_causal_federation.py).
+"""
 
 import pytest
 
-from antidote_tpu.clocks import VC
-from antidote_tpu.txn.coordinator import TransactionAborted
+import causal_core as cc
 from antidote_tpu.config import Config
 from antidote_tpu.interdc.dc import DataCenter, connect_dcs
 from antidote_tpu.interdc.transport import InProcBus
-
-N_KEYS = 4
-N_WRITES = 24  # per DC
-N_READS = 30   # per reader session
 
 
 def _cfg(tmp_path, name, **kw):
     return Config(n_partitions=4, data_dir=str(tmp_path / name),
                   heartbeat_s=0.005, **kw)
-
-
-def _key(i):
-    return (f"ck{i % N_KEYS}", "set_aw", "b")
-
-
-def _run_trace(a, b):
-    """Concurrent writers on both DCs + reader sessions on both;
-    returns (writes {elem: commit_vc}, reads [(clock, vc, elems)])."""
-    writes = {}
-    w_lock = threading.Lock()
-    reads = []
-    r_lock = threading.Lock()
-    errs = []
-
-    def _commit_retry(dc, updates):
-        # certification aborts are correct behavior under concurrent
-        # same-key writers at lagging snapshots (GR's scalar GST);
-        # clients retry exactly as the reference's clients do
-        for _ in range(200):
-            try:
-                return dc.update_objects_static(None, updates)
-            except TransactionAborted:
-                # let the stable tick advance past the conflicting
-                # commit before retrying (GR snapshots move with the
-                # gossiped GST, not per-commit)
-                time.sleep(0.005)
-        raise AssertionError("writer starved by certification aborts")
-
-    def writer(dc, tag):
-        try:
-            for i in range(N_WRITES):
-                if i % 3 == 2:
-                    # multi-partition txn: commit time = max(prepare
-                    # times) — the shape whose heartbeat can carry the
-                    # exact pending commit time (the round-5 race)
-                    elems = [f"{tag}{i}k{k}".encode()
-                             for k in range(N_KEYS)]
-                    ct = _commit_retry(
-                        dc, [(_key(k), "add", e)
-                             for k, e in enumerate(elems)])
-                    with w_lock:
-                        for k, e in enumerate(elems):
-                            writes[(e, k % N_KEYS)] = ct
-                else:
-                    elem = f"{tag}{i}".encode()
-                    ct = _commit_retry(dc, [(_key(i), "add", elem)])
-                    with w_lock:
-                        writes[(elem, i % N_KEYS)] = ct
-        except Exception as e:  # pragma: no cover - surfaced below
-            errs.append(e)
-
-    def reader(dc, follow):
-        """One session: each read's clock = previous returned vc; every
-        few reads jump to a fresh remote commit clock (the cross-DC
-        causal handoff that exposed the heartbeat race)."""
-        try:
-            clock = None
-            prev = {}  # key -> frozenset of last seen elems
-            for i in range(N_READS):
-                if i % 2 == 1:
-                    with w_lock:
-                        if writes:
-                            newest = max(writes.values(),
-                                         key=lambda v: sorted(v.items()))
-                    clock = newest if writes else clock
-                objs = [_key(k) for k in range(N_KEYS)]
-                vals, vc = dc.read_objects_static(clock, objs)
-                snap = {o: frozenset(v) for o, v in zip(objs, vals)}
-                with r_lock:
-                    reads.append((clock, vc, snap))
-                for o, seen in snap.items():
-                    if follow and not seen >= prev.get(o, frozenset()):
-                        raise AssertionError(
-                            f"session visibility shrank for {o}: "
-                            f"{prev[o] - seen} disappeared")
-                prev = snap
-                clock = vc
-        except Exception as e:
-            errs.append(e)
-
-    threads = [threading.Thread(target=writer, args=(a, "a")),
-               threading.Thread(target=writer, args=(b, "b")),
-               threading.Thread(target=reader, args=(a, True)),
-               threading.Thread(target=reader, args=(b, True))]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    assert not errs, errs[0]
-    return writes, reads
-
-
-def _validate(writes, reads, causal_floor=True):
-    """The post-hoc rules over every recorded read.  ``causal_floor``
-    is the Clock-SI promise (wait_for_clock dominates the whole client
-    clock); GentleRain waits only on the scalar GST, so its floor is
-    not entry-wise — rules 2-3 still apply."""
-    for clock, _vc, snap in reads:
-        for key_i in range(N_KEYS):
-            key = _key(key_i)
-            visible = snap[key]
-            owners = {e: v for (e, ki), v in writes.items()
-                      if ki == key_i}
-            # 1. causal floor: clock-dominated writes must be visible
-            if causal_floor and clock is not None:
-                for e, wvc in owners.items():
-                    if wvc.le(clock):
-                        assert e in visible, (
-                            f"causal floor violated: write {e} with "
-                            f"commit {dict(wvc.items())} <= read clock "
-                            f"{dict(clock.items())} is missing")
-            # 2. downward closure: visibility is a VC-order down-set
-            # (a reader can glimpse an element a writer thread has not
-            # recorded yet — its commit VC is unknown; skip those)
-            for e2 in visible:
-                v2 = owners.get(e2)
-                if v2 is None:
-                    continue
-                for e1, v1 in owners.items():
-                    if e1 not in visible and v1.le(v2):
-                        raise AssertionError(
-                            f"snapshot not downward closed: {e2} "
-                            f"visible but earlier {e1} missing")
 
 
 @pytest.mark.parametrize("placement", ["none", "ring"])
@@ -176,9 +49,9 @@ def test_causal_visibility_two_dcs(tmp_path, placement):
         connect_dcs([a, b])
         a.start_bg_processes()
         b.start_bg_processes()
-        writes, reads = _run_trace(a, b)
-        assert len(writes) >= 2 * N_WRITES
-        _validate(writes, reads)
+        writes, reads = cc.run_trace([a, b], [a, b])
+        assert len(writes) >= 2 * cc.N_WRITES
+        cc.validate(writes, reads)
     finally:
         a.close()
         b.close()
@@ -201,9 +74,9 @@ def test_causal_visibility_gentlerain(tmp_path):
         connect_dcs([a, b])
         a.start_bg_processes()
         b.start_bg_processes()
-        writes, reads = _run_trace(a, b)
-        assert len(writes) >= 2 * N_WRITES
-        _validate(writes, reads, causal_floor=False)
+        writes, reads = cc.run_trace([a, b], [a, b])
+        assert len(writes) >= 2 * cc.N_WRITES
+        cc.validate(writes, reads, causal_floor=False)
     finally:
         a.close()
         b.close()
