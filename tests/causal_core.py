@@ -32,14 +32,19 @@ def run_trace(writer_eps, reader_eps, tags=None):
 
     def commit_retry(ep, updates):
         # certification aborts are correct behavior under concurrent
-        # same-key writers at lagging snapshots; clients retry with a
-        # stable-tick backoff exactly as the reference's clients do
-        for _ in range(200):
+        # same-key writers at lagging snapshots — and a member
+        # fail-over window surfaces as a burst of aborts too; clients
+        # retry against a WALL deadline exactly as the reference's
+        # clients ride out both (basho_bench drivers retry on abort)
+        deadline = time.monotonic() + 30.0
+        while True:
             try:
                 return ep.update_objects_static(None, updates)
             except TransactionAborted:
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        "writer starved by certification aborts")
                 time.sleep(0.005)
-        raise AssertionError("writer starved by certification aborts")
 
     def writer(ep, tag):
         try:

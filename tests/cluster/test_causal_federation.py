@@ -48,3 +48,76 @@ def test_causal_visibility_federation(tmp_path):
             nid.close()
         for s in servers_a + servers_b:
             s.close()
+
+
+def test_causal_visibility_across_member_restart(tmp_path):
+    """The checker's rules must hold across a crash/restart of a
+    reader-side member mid-trace: recovery (journaled plan, stable
+    floor, re-observed federation) may make reads time out while the
+    member is down — an availability gap — but every read that
+    SUCCEEDS, before, during, or after the restart, must still satisfy
+    the causal floor and snapshot closure (restart recovery that
+    forgot the stable floor or replayed the log short would fail
+    here)."""
+    import threading
+    import time as _t
+
+    bus = InProcBus()
+    servers_a, nids_a = _make_dc(bus, tmp_path, "dcA")
+    servers_b, nids_b = _make_dc(bus, tmp_path, "dcB")
+    stop = threading.Event()
+    restarted = []
+
+    def chaos():
+        # one crash/restart of dcB's second member (a reader endpoint)
+        _t.sleep(0.4)
+        victim_nid, victim_srv = nids_b[1], servers_b[1]
+        victim_nid.close()
+        victim_srv.close()
+        _t.sleep(0.2)
+        srv = NodeServer("dcB_n2",
+                         data_dir=str(tmp_path / "dcB_n2"),
+                         config=Config(n_partitions=4,
+                                       heartbeat_s=0.005,
+                                       clock_wait_timeout_s=10.0))
+        nid = NodeInterDc(srv, bus)
+        nid.start()
+        servers_b[1], nids_b[1] = srv, nid
+        restarted.append(srv)
+
+    class RestartTolerantReader:
+        """Endpoint proxy following the CURRENT incarnation of the
+        member; reads hitting the down-window raise and are retried
+        (only successful reads enter the validated trace)."""
+
+        def __init__(self, servers, idx):
+            self.servers, self.idx = servers, idx
+
+        def read_objects_static(self, clock, objs):
+            deadline = _t.monotonic() + 30.0
+            while True:
+                try:
+                    return self.servers[self.idx].api \
+                        .read_objects_static(clock, objs)
+                except Exception:
+                    if _t.monotonic() > deadline:
+                        raise
+                    _t.sleep(0.05)
+
+    try:
+        connect_federation([nids_a, nids_b])
+        t = threading.Thread(target=chaos)
+        t.start()
+        writes, reads = cc.run_trace(
+            [servers_a[0].api, servers_b[0].api],
+            [servers_a[1].api, RestartTolerantReader(servers_b, 1)])
+        t.join()
+        stop.set()
+        assert restarted, "chaos thread never restarted the member"
+        assert len(writes) >= 2 * cc.N_WRITES
+        cc.validate(writes, reads)
+    finally:
+        for nid in nids_a + nids_b:
+            nid.close()
+        for s in servers_a + servers_b:
+            s.close()
