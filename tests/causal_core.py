@@ -20,9 +20,13 @@ def key_of(i):
     return (f"ck{i % N_KEYS}", "set_aw", "b")
 
 
-def run_trace(writer_eps, reader_eps, tags=None):
+def run_trace(writer_eps, reader_eps, tags=None,
+              retry_exc=(TransactionAborted,)):
     """Concurrent writers + reader sessions; returns
-    (writes {(elem, key_i): commit_vc}, reads [(clock, vc, snap)])."""
+    (writes {(elem, key_i): commit_vc}, reads [(clock, vc, snap)]).
+    ``retry_exc``: exception types a writer rides out with the wall
+    deadline (cluster maintenance windows add retryable refusals on
+    top of certification aborts)."""
     tags = tags or [chr(ord("a") + i) for i in range(len(writer_eps))]
     writes = {}
     w_lock = threading.Lock()
@@ -40,7 +44,7 @@ def run_trace(writer_eps, reader_eps, tags=None):
         while True:
             try:
                 return ep.update_objects_static(None, updates)
-            except TransactionAborted:
+            except retry_exc:
                 if time.monotonic() > deadline:
                     raise AssertionError(
                         "writer starved by certification aborts")
