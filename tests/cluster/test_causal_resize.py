@@ -69,3 +69,55 @@ def test_causal_visibility_through_resize(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_causal_visibility_through_rebalance(tmp_path):
+    """Causal checker through a live ownership handoff: half of member
+    1's partitions move to member 2 mid-trace (probe-fenced cutover,
+    cluster/node.py rebalance).  Moved keys keep serving the complete
+    causally-consistent history from their new owner."""
+    servers = [
+        NodeServer(f"n{i + 1}", data_dir=str(tmp_path / f"n{i + 1}"),
+                   config=Config(n_partitions=4, heartbeat_s=0.005,
+                                 clock_wait_timeout_s=10.0))
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 4, servers)
+        moved = []
+
+        errs = []
+
+        def chaos():
+            try:
+                time.sleep(0.3)
+                new_ring = dict(servers[0].node.ring)
+                # move every partition member 1 owns to member 2
+                owner0 = [p for p, o in new_ring.items()
+                          if o == servers[0].node_id]
+                for p in owner0:
+                    new_ring[p] = servers[1].node_id
+                servers[0].rebalance(new_ring)
+                moved.extend(owner0)
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=chaos)
+        t.start()
+        writes, reads = cc.run_trace(
+            [servers[0].api, servers[1].api],
+            [RetryingReader(servers[0].api),
+             RetryingReader(servers[1].api)],
+            retry_exc=(TransactionAborted, TimeoutError, OSError,
+                       RuntimeError))
+        t.join(timeout=60)
+        assert not errs, errs[0]
+        assert moved, "rebalance never ran"
+        assert len(writes) >= 2 * cc.N_WRITES
+        cc.validate(writes, reads)
+        final = RetryingReader(servers[1].api).read_objects_static(
+            None, [cc.key_of(k) for k in range(cc.N_KEYS)])
+        assert sum(len(v) for v in final[0]) == len(writes)
+    finally:
+        for s in servers:
+            s.close()
