@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from antidote_tpu.clocks import dense
+from antidote_tpu.runtime import COLLECTIVE_LOCK
 from antidote_tpu.mat import store
 
 
@@ -165,7 +166,8 @@ class _ShardedBase:
             fn = self._sm(local_gc, in_specs=(self._state_spec,),
                           out_specs=(self._state_spec, P()),
                           donate=True)
-            self.st, gst = fn(self.st)
+            with COLLECTIVE_LOCK:
+                self.st, gst = fn(self.st)
             return gst
 
         def local_gc_given(st, fr):
@@ -175,7 +177,8 @@ class _ShardedBase:
         fn = self._sm(local_gc_given,
                       in_specs=(self._state_spec, P()),
                       out_specs=(self._state_spec, P()), donate=True)
-        self.st, gst = fn(self.st, *self._rep_put(local_frontiers))
+        with COLLECTIVE_LOCK:
+            self.st, gst = fn(self.st, *self._rep_put(local_frontiers))
         return gst
 
     # ----------------------------------------------------------- append

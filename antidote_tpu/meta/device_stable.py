@@ -36,6 +36,8 @@ from antidote_tpu.meta.gossip import StableTimeTracker
 
 _I64_MAX = np.iinfo(np.int64).max
 
+from antidote_tpu.runtime import COLLECTIVE_LOCK as _COLLECTIVE_LOCK
+
 
 def _pow2(n: int, floor: int = 8) -> int:
     return max(floor, 1 << (max(n, 1) - 1).bit_length())
@@ -220,10 +222,11 @@ class DeviceStableTimeTracker(StableTimeTracker):
             if self._blocks_dev[k] is None:
                 self._blocks_dev[k] = jax.device_put(
                     self._blocks_host[k], self.devices[k])
-        global_mat = jax.make_array_from_single_device_arrays(
-            (n * self._rpd, self._d_pad), sharding,
-            self._blocks_dev)
-        row = np.asarray(fold(global_mat))
+        with _COLLECTIVE_LOCK:
+            global_mat = jax.make_array_from_single_device_arrays(
+                (n * self._rpd, self._d_pad), sharding,
+                self._blocks_dev)
+            row = np.asarray(fold(global_mat))
         # +inf pad rows survive the min only when a column is
         # beyond every real row's width — those columns are absent
         # from the domain anyway; mask for safety

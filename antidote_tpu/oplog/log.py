@@ -16,6 +16,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import threading
 import zlib
 from typing import Iterator, Optional, Tuple
 
@@ -68,6 +69,13 @@ class DurableLog:
         self.path = path
         self._native = None
         self._py = None
+        #: guards every native-handle use against close(): a member
+        #: shutdown can race an in-flight remote-apply append on a
+        #: delivery thread, and calling into the C backend with a freed
+        #: handle is a segfault, not an exception (caught live by
+        #: tests/cluster/test_causal_federation.py restart chaos).  A
+        #: closed log raises OSError from append/read instead.
+        self._lock = threading.Lock()
         lib = _NativeBackend.load() if backend in ("auto", "native") else None
         if lib is not None:
             h = lib.oplog_open(path.encode(), 1)
@@ -90,45 +98,63 @@ class DurableLog:
             # recovery treats a zero-length frame as a torn tail; storing
             # one would truncate every later record on restart
             raise ValueError("empty log records are not allowed")
-        if self._native:
-            lib, h = self._native
-            off = lib.oplog_append(h, payload, len(payload))
-            if off < 0:
-                raise OSError("append failed")
-            return off
-        return self._py.append(payload)
+        with self._lock:
+            if self._native:
+                lib, h = self._native
+                off = lib.oplog_append(h, payload, len(payload))
+                if off < 0:
+                    raise OSError("append failed")
+                return off
+            if self._py is None:
+                raise OSError(f"log {self.path} is closed")
+            return self._py.append(payload)
 
     def flush(self) -> None:
-        if self._native:
-            self._native[0].oplog_flush(self._native[1])
-        elif self._py is not None:  # no-op on a closed log
-            self._py.flush()
+        with self._lock:
+            if self._native:
+                self._native[0].oplog_flush(self._native[1])
+            elif self._py is not None:  # no-op on a closed log
+                self._py.flush()
 
     def sync(self) -> None:
-        """Flush + fsync — the commit-path durability barrier."""
-        if self._native:
-            self._native[0].oplog_sync(self._native[1])
-        elif self._py is not None:  # no-op on a closed log
-            self._py.sync()
+        """Flush + fsync — the commit-path durability barrier.
+
+        Holds the log lock across the fsync: same-partition appenders
+        already serialize behind the partition lock at every call site,
+        so the extra exclusion is cross-path only (handoff byte reads,
+        migration scans — rare).  A refcounted close guard would keep
+        fsync out of the critical section; deliberately not attempted
+        hours before round end (memory safety first)."""
+        with self._lock:
+            if self._native:
+                self._native[0].oplog_sync(self._native[1])
+            elif self._py is not None:  # no-op on a closed log
+                self._py.sync()
 
     def end_offset(self) -> int:
-        if self._native:
-            return self._native[0].oplog_end_offset(self._native[1])
-        return self._py.end
+        with self._lock:
+            if self._native:
+                return self._native[0].oplog_end_offset(self._native[1])
+            if self._py is None:
+                raise OSError(f"log {self.path} is closed")
+            return self._py.end
 
     def read(self, offset: int) -> Optional[bytes]:
-        if self._native:
-            lib, h = self._native
-            n = 4096
-            while True:
-                buf = ctypes.create_string_buffer(n)
-                got = lib.oplog_read(h, offset, buf, n)
-                if got < 0:
-                    return None
-                if got <= n:
-                    return buf.raw[:got]
-                n = int(got)
-        return self._py.read(offset)
+        with self._lock:
+            if self._native:
+                lib, h = self._native
+                n = 4096
+                while True:
+                    buf = ctypes.create_string_buffer(n)
+                    got = lib.oplog_read(h, offset, buf, n)
+                    if got < 0:
+                        return None
+                    if got <= n:
+                        return buf.raw[:got]
+                    n = int(got)
+            if self._py is None:
+                raise OSError(f"log {self.path} is closed")
+            return self._py.read(offset)
 
     def scan(self, offset: int = 0) -> Iterator[Tuple[int, bytes]]:
         """Iterate (offset, payload) from ``offset`` to the end."""
@@ -137,21 +163,28 @@ class DurableLog:
             if payload is None:
                 return
             yield offset, payload
-            if self._native:
-                nxt = self._native[0].oplog_next(self._native[1], offset)
-            else:
-                nxt = self._py.next_offset(offset)
+            with self._lock:
+                if self._native:
+                    nxt = self._native[0].oplog_next(
+                        self._native[1], offset)
+                elif self._py is not None:
+                    nxt = self._py.next_offset(offset)
+                else:
+                    # closed mid-scan: a silent partial history would
+                    # be served as a successful replay
+                    raise OSError(f"log {self.path} closed mid-scan")
             if nxt < 0:
                 return
             offset = nxt
 
     def close(self) -> None:
-        if self._native:
-            self._native[0].oplog_close(self._native[1])
-            self._native = None
-        elif self._py:
-            self._py.close()
-            self._py = None
+        with self._lock:
+            if self._native:
+                self._native[0].oplog_close(self._native[1])
+                self._native = None
+            elif self._py:
+                self._py.close()
+                self._py = None
 
 
 class _PyLog:

@@ -38,3 +38,15 @@ def tune_runtime(switch_interval_s: float = 0.0005,
     sys.setswitchinterval(switch_interval_s)
     gc.freeze()
     gc.set_threshold(*gc_thresholds)
+
+
+#: process-wide serialization of XLA programs containing COLLECTIVES:
+#: JAX's single-controller model does not support concurrent collective
+#: programs over the same devices — two threads interleaving their
+#: pmin/psum programs abort inside the XLA runtime (caught by the
+#: causal-checker stress loops via the device stable fold).  Every
+#: collective launch site takes this lock; real deployments run one
+#: node per host process, so it is uncontended there.
+import threading as _threading
+
+COLLECTIVE_LOCK = _threading.Lock()

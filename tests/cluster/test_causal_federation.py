@@ -15,13 +15,13 @@ from antidote_tpu.config import Config
 from antidote_tpu.interdc import InProcBus
 
 
-def _make_dc(bus, tmp_path, dc_id, n_nodes=2, n_partitions=4):
+def _make_dc(bus, tmp_path, dc_id, n_nodes=2, n_partitions=4, **kw):
     servers = [
         NodeServer(f"{dc_id}_n{i + 1}",
                    data_dir=str(tmp_path / f"{dc_id}_n{i + 1}"),
                    config=Config(n_partitions=n_partitions,
                                  heartbeat_s=0.005,
-                                 clock_wait_timeout_s=10.0))
+                                 clock_wait_timeout_s=10.0, **kw))
         for i in range(n_nodes)
     ]
     create_dc_cluster(dc_id, n_partitions, servers)
@@ -29,16 +29,22 @@ def _make_dc(bus, tmp_path, dc_id, n_nodes=2, n_partitions=4):
     return servers, nids
 
 
-def test_causal_visibility_federation(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("placement", ["none", "ring"])
+def test_causal_visibility_federation(tmp_path, placement):
+    kw = {"device_placement": "ring", "device_flush_ops": 8} \
+        if placement == "ring" else {}
     bus = InProcBus()
-    servers_a, nids_a = _make_dc(bus, tmp_path, "dcA")
-    servers_b, nids_b = _make_dc(bus, tmp_path, "dcB")
+    servers_a, nids_a = _make_dc(bus, tmp_path, "dcA", **kw)
+    servers_b, nids_b = _make_dc(bus, tmp_path, "dcB", **kw)
     try:
         connect_federation([nids_a, nids_b])
         # writers on member 1, reader sessions on member 2: every
         # cross-DC write is served to the reader via handoff through
         # the OTHER node's ring slice as well
-        writes, reads = cc.run_trace(
+        writes, reads, abandoned = cc.run_trace(
             [servers_a[0].api, servers_b[0].api],
             [servers_a[1].api, servers_b[1].api])
         assert len(writes) >= 2 * cc.N_WRITES
@@ -108,7 +114,7 @@ def test_causal_visibility_across_member_restart(tmp_path):
         connect_federation([nids_a, nids_b])
         t = threading.Thread(target=chaos)
         t.start()
-        writes, reads = cc.run_trace(
+        writes, reads, abandoned = cc.run_trace(
             [servers_a[0].api, servers_b[0].api],
             [servers_a[1].api, RestartTolerantReader(servers_b, 1)])
         t.join()

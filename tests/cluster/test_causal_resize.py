@@ -52,7 +52,7 @@ def test_causal_visibility_through_resize(tmp_path):
 
         t = threading.Thread(target=chaos)
         t.start()
-        writes, reads = cc.run_trace(
+        writes, reads, abandoned = cc.run_trace(
             [servers[0].api, servers[1].api],
             [RetryingReader(servers[0].api),
              RetryingReader(servers[1].api)],
@@ -65,7 +65,12 @@ def test_causal_visibility_through_resize(tmp_path):
         # and the widened cluster still serves the full history
         final = RetryingReader(servers[1].api).read_objects_static(
             None, [cc.key_of(k) for k in range(cc.N_KEYS)])
-        assert sum(len(v) for v in final[0]) == len(writes)
+        seen = set().union(*map(set, final[0]))
+        recorded = {e for e, _k in writes}
+        # every recorded write present; extras only from in-doubt
+        # commits that turned out durable (post-decision failures)
+        assert seen >= recorded
+        assert seen - recorded <= abandoned, (seen - recorded, abandoned)
     finally:
         for s in servers:
             s.close()
@@ -104,7 +109,7 @@ def test_causal_visibility_through_rebalance(tmp_path):
 
         t = threading.Thread(target=chaos)
         t.start()
-        writes, reads = cc.run_trace(
+        writes, reads, abandoned = cc.run_trace(
             [servers[0].api, servers[1].api],
             [RetryingReader(servers[0].api),
              RetryingReader(servers[1].api)],
@@ -117,7 +122,12 @@ def test_causal_visibility_through_rebalance(tmp_path):
         cc.validate(writes, reads)
         final = RetryingReader(servers[1].api).read_objects_static(
             None, [cc.key_of(k) for k in range(cc.N_KEYS)])
-        assert sum(len(v) for v in final[0]) == len(writes)
+        seen = set().union(*map(set, final[0]))
+        recorded = {e for e, _k in writes}
+        # every recorded write present; extras only from in-doubt
+        # commits that turned out durable (post-decision failures)
+        assert seen >= recorded
+        assert seen - recorded <= abandoned, (seen - recorded, abandoned)
     finally:
         for s in servers:
             s.close()

@@ -49,7 +49,7 @@ def test_causal_visibility_two_dcs(tmp_path, placement):
         connect_dcs([a, b])
         a.start_bg_processes()
         b.start_bg_processes()
-        writes, reads = cc.run_trace([a, b], [a, b])
+        writes, reads, abandoned = cc.run_trace([a, b], [a, b])
         assert len(writes) >= 2 * cc.N_WRITES
         cc.validate(writes, reads)
     finally:
@@ -74,7 +74,7 @@ def test_causal_visibility_gentlerain(tmp_path):
         connect_dcs([a, b])
         a.start_bg_processes()
         b.start_bg_processes()
-        writes, reads = cc.run_trace([a, b], [a, b])
+        writes, reads, abandoned = cc.run_trace([a, b], [a, b])
         assert len(writes) >= 2 * cc.N_WRITES
         cc.validate(writes, reads, causal_floor=False)
     finally:
