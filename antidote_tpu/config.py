@@ -113,4 +113,20 @@ class Config:
     #: the live data plane across a host's chips); "none" keeps the
     #: default device.  No-op with a single device.
     device_placement: str = "none"
+    #: fraction of transactions traced end-to-end (txid-deterministic;
+    #: antidote_tpu/obs/spans.py).  1.0 traces everything (tests /
+    #: debugging), 0 disables span recording entirely.  The default
+    #: keeps tracing overhead well under the 5%% budget on the txn
+    #: bench while still collecting a steady trickle of full trees.
+    trace_sample_rate: float = 0.05
+    #: finished spans kept in the in-process ring (/debug/spans depth)
+    trace_capacity: int = 65536
+    #: flight-recorder dump directory (None = <tempdir>/antidote_obs;
+    #: antidote_tpu/obs/events.py)
+    flight_recorder_dir: str | None = None
+    #: probability a device-served set_aw read is cross-checked against
+    #: a log replay at the same snapshot (the read-inclusion probe,
+    #: antidote_tpu/obs/probe.py); violations dump the flight recorder.
+    #: Default off: the oracle replay costs a per-key log scan.
+    obs_selfcheck_set_aw: float = 0.0
     extra: dict = field(default_factory=dict)

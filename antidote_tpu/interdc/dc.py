@@ -35,6 +35,7 @@ from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
 from antidote_tpu.interdc.wire import DcDescriptor, InterDcTxn
 from antidote_tpu.meta.device_stable import make_stable_tracker
 from antidote_tpu.meta.stable_store import StableMetaData
+from antidote_tpu.obs.spans import tracer
 from antidote_tpu.txn.node import Node
 
 
@@ -293,7 +294,10 @@ class DataCenter(AntidoteTPU):
         if self._staleness is None:
             self._staleness = stats.StalenessSampler(
                 self.stable.get_stable_snapshot, self.node.clock.now_us,
-                period_s=self.node.config.staleness_sample_s)
+                period_s=self.node.config.staleness_sample_s,
+                # per-peer replication lag rides the same snapshot fetch
+                peers_source=lambda: list(self.connected_dcs),
+                local_dc=self.node.dc_id)
             self._staleness.start()
         stats.install_error_monitor()
         if self.node.config.metrics_port is not None:
@@ -345,6 +349,8 @@ class DataCenter(AntidoteTPU):
         # one-at-a-time delivery: the background worker and wait-hook
         # pumps may race, but sub_bufs/dep gates assume a single writer
         # (the reference gets this from one gen_server per buffer)
+        txid = (None if txn.is_ping()
+                else getattr(txn.records[-1], "txid", None))
         with self._rx_lock:
             if txn.dc_id not in self.connected_dcs:
                 return  # not subscribed to this origin
@@ -353,10 +359,29 @@ class DataCenter(AntidoteTPU):
             buf = self.sub_bufs.get((txn.dc_id, txn.partition))
             if buf is None:
                 return  # connect raced the stream; repair catches up
+            if txid is None:
+                buf.process(txn)
+                return
+            # arrival marker only: buf.process may drain a backlog of
+            # OTHER buffered transactions, so a span here would charge
+            # their apply cost to this txid.  The per-txn deliver span
+            # lives in the gate deliver callback, at release time.
+            tracer.instant("interdc_rx", "interdc", txid=txid,
+                           origin=str(txn.dc_id), partition=txn.partition)
             buf.process(txn)
 
     def _make_gate_deliver(self, p: int):
         def deliver(txn: InterDcTxn) -> None:
+            if not txn.is_ping():
+                # point event, not a span: enqueue can synchronously
+                # drain the gate's whole backlog, and a span here would
+                # charge those OTHER transactions' apply cost to this
+                # txid (per-txn apply timing is depgate_admit's job)
+                tracer.instant("interdc_deliver", "interdc",
+                               txid=getattr(txn.records[-1], "txid",
+                                            None),
+                               origin=str(txn.dc_id),
+                               partition=txn.partition)
             self.dep_gates[p].enqueue(txn)
         return deliver
 

@@ -35,8 +35,11 @@ from typing import Any, Callable, Dict
 
 import numpy as np
 
+from antidote_tpu import stats
 from antidote_tpu.clocks import VC
 from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import tracer
 from antidote_tpu.txn.manager import PartitionRetired
 
 
@@ -85,6 +88,9 @@ class DependencyGate:
     # ------------------------------------------------------------- ingest
 
     def enqueue(self, txn: InterDcTxn) -> None:
+        # gate-wait clock: _apply reads it back for the dep-gate wait
+        # histogram and the admit span of the txn's trace tree
+        txn._obs_enq_us = self.now_us()
         q = self.queues.setdefault(txn.dc_id, deque())
         q.append(txn)
         # a txn landing behind its own origin's blocked head cannot
@@ -336,8 +342,21 @@ class DependencyGate:
             self.applied_vc = self.applied_vc.set_dc(origin, ts)
 
     def _apply(self, txn: InterDcTxn) -> None:
-        self.pm.apply_remote(txn.records, txn.dc_id, txn.timestamp,
-                             txn.snapshot_vc)
+        # getattr: harness fakes (tests/unit/test_dep_gate.py) enqueue
+        # opaque record stubs — an untagged span still times the apply
+        txid = (getattr(txn.records[-1], "txid", None)
+                if txn.records else None)
+        enq = getattr(txn, "_obs_enq_us", None)
+        wait_s = (max(self.now_us() - enq, 0) / 1e6
+                  if enq is not None else 0.0)
+        with tracer.span("depgate_admit", "interdc", txid=txid,
+                         origin=str(txn.dc_id), wait_s=wait_s):
+            self.pm.apply_remote(txn.records, txn.dc_id, txn.timestamp,
+                                 txn.snapshot_vc)
+        stats.registry.depgate_wait.observe(wait_s)
+        recorder.record("interdc", "depgate_admit", txid=txid,
+                        origin=str(txn.dc_id), wait_s=wait_s,
+                        timestamp=txn.timestamp)
         self._advance(txn.dc_id, txn.timestamp)
 
     def pending(self) -> int:

@@ -15,6 +15,8 @@ import threading
 
 from antidote_tpu.interdc.transport import Transport
 from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import tracer
 from antidote_tpu.oplog.records import LogRecord, TxnAssembler
 
 
@@ -49,7 +51,16 @@ class InterDcLogSender:
                                       self.last_sent_opid, done)
             self.last_sent_opid = txn.last_opid()
         if self.enabled:
-            self.transport.publish(self.dc_id, txn.to_bin())
+            # the commit record closes the group, so its txid correlates
+            # this broadcast with the coordinator/log/device spans
+            txid = getattr(done[-1], "txid", None)
+            with tracer.span("interdc_send", "interdc", txid=txid,
+                             partition=self.partition,
+                             dc=str(self.dc_id)):
+                self.transport.publish(self.dc_id, txn.to_bin())
+            recorder.record("interdc", "send", txid=txid,
+                            partition=self.partition,
+                            records=len(done))
 
     def ping(self, min_prepared_time: int) -> None:
         """Broadcast a heartbeat carrying this partition's min-prepared

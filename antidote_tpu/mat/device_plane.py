@@ -39,6 +39,7 @@ from __future__ import annotations
 import atexit
 import logging
 import threading
+import time
 from dataclasses import replace as dc_replace
 from typing import Any, Callable, Dict, List, Optional
 
@@ -46,8 +47,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from antidote_tpu import tracing
+from antidote_tpu import stats, tracing
 from antidote_tpu.clocks import VC, ClockDomain
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import tracer
 from antidote_tpu.mat import store
 from antidote_tpu.mat.materializer import Payload
 
@@ -540,6 +543,8 @@ class _PlaneBase:
         self.rev_keys[idx] = _Evicted
         self._purge_idx(idx)
         log.debug("device plane: evicted %r (%s)", key, self.type_name)
+        recorder.record("device", "evict", plane=self.type_name,
+                        key=key)
         self.on_evict(key, self.type_name)
 
     #: set by DevicePlane.stage when async flushing is wired: called
@@ -607,34 +612,47 @@ class _PlaneBase:
         # chunk size is the intended steady-state batch anyway
         step = max(self.flush_ops, _MIN_BUCKET)
         overflow = np.zeros(len(rows), dtype=bool)
-        with tracing.annotate(f"device_flush:{self.type_name}"):
+        t0 = time.perf_counter()
+        # the span and histogram cover the overflow-retry path too —
+        # the forced GC + second append (possibly a fresh XLA compile)
+        # dominate exactly the flushes the stage-latency panel hunts
+        with tracing.annotate(f"device_flush:{self.type_name}"), \
+                tracer.span(f"device_flush:{self.type_name}", "device",
+                            rows=len(rows)):
             for i in range(0, len(rows), step):
                 overflow[i:i + step] = self._append_rows(
                     rows[i:i + step])
-        self._ops_since_gc += len(rows)
-        if overflow.any():
-            retry = [r for r, o in zip(rows, overflow) if o]
-            gst = None
-            if self._last_stable is not None:
-                pairs = self._ss_pairs(self._last_stable)
-                if pairs is not None:
-                    gst = self._dense_vc(pairs)
+            self._ops_since_gc += len(rows)
+            if overflow.any():
+                retry = [r for r, o in zip(rows, overflow) if o]
+                gst = None
+                if self._last_stable is not None:
+                    pairs = self._ss_pairs(self._last_stable)
+                    if pairs is not None:
+                        gst = self._dense_vc(pairs)
+                        self._device_gc(gst)
+                        self._base_vc = self._base_vc.join(
+                            self._last_stable)
+                        self._has_base = True
+                        self._ops_since_gc = 0
+                overflow2 = self._append_rows(retry)
+                if gst is not None:
+                    # invariant: every ring op with commit VC <=
+                    # base_vc must be folded INTO the base — the
+                    # retried rows landed after the fold above, so fold
+                    # once more at the same horizon (rows above it are
+                    # untouched)
                     self._device_gc(gst)
-                    self._base_vc = self._base_vc.join(self._last_stable)
-                    self._has_base = True
-                    self._ops_since_gc = 0
-            overflow2 = self._append_rows(retry)
-            if gst is not None:
-                # invariant: every ring op with commit VC <= base_vc must
-                # be folded INTO the base — the retried rows landed after
-                # the fold above, so fold once more at the same horizon
-                # (rows above it are untouched)
-                self._device_gc(gst)
-            bad_keys = {self.rev_keys[r[0]]
-                        for r, o in zip(retry, overflow2) if o}
-            for key in bad_keys:
-                if key is not _Evicted:
-                    self.evict(key)
+                bad_keys = {self.rev_keys[r[0]]
+                            for r, o in zip(retry, overflow2) if o}
+                for key in bad_keys:
+                    if key is not _Evicted:
+                        self.evict(key)
+        stats.registry.device_flush_latency.observe(
+            time.perf_counter() - t0)
+        recorder.record("device", "flush", plane=self.type_name,
+                        rows=len(rows),
+                        overflow=int(overflow.sum()))
 
     def gc(self, stable_vc: VC) -> None:
         """Fold ops at/below the gossiped stable snapshot into the base
@@ -647,8 +665,11 @@ class _PlaneBase:
         pairs = self._ss_pairs(stable_vc)
         if pairs is None:
             return
-        with tracing.annotate(f"device_gc:{self.type_name}"):
+        with tracing.annotate(f"device_gc:{self.type_name}"), \
+                tracer.span(f"device_gc:{self.type_name}", "device"):
             self._device_gc(self._dense_vc(pairs))
+        recorder.record("device", "gc", plane=self.type_name,
+                        horizon=dict(stable_vc))
         self._base_vc = self._base_vc.join(stable_vc)
         self._has_base = True
         self._ops_since_gc = 0
@@ -2176,25 +2197,56 @@ class DevicePlane:
             p.kick_warm()
         if p._schedule is not self.flush_scheduler:
             p._schedule = self.flush_scheduler
+        # the txid-correlated device-plane hop of the txn span tree
+        # (instant: the XLA work happens later, at flush time) plus the
+        # flight-recorder record of the _publish window the round-5
+        # set_aw bug lives in
+        tracer.instant("device_stage", "device", txid=payload.txid,
+                       key=key, type=type_name)
+        # per-op stage events get their OWN subsystem ring: at serving
+        # rates they would otherwise evict the rare flush/evict/gc
+        # events that bound the suspect _publish window from the shared
+        # 512-deep "device" ring within a second
+        recorder.record("device_stage", "stage", plane=type_name,
+                        key=key, txid=payload.txid,
+                        commit_time=payload.commit_time)
         p.stage(key, payload)
         p.maybe_flush_gc(stable_vc)
 
-    def read(self, key, type_name: str, read_vc: Optional[VC]):
-        return self.planes[type_name].read(key, read_vc)
+    def read(self, key, type_name: str, read_vc: Optional[VC],
+             txid=None):
+        # txid-tagged so the per-read span joins its txn's tree and
+        # obeys per-txid sampling; untagged reads fall back to
+        # sampled()'s 1-in-N thinning instead of flooding the ring
+        with tracer.span("device_read", "device", txid=txid, key=key,
+                         type=type_name):
+            t0 = time.perf_counter()
+            value = self.planes[type_name].read(key, read_vc)
+        stats.registry.device_read_latency.observe(
+            time.perf_counter() - t0)
+        return value
 
     def read_many(self, keys: list, type_name: str,
-                  read_vc: Optional[VC]) -> dict:
+                  read_vc: Optional[VC], txid=None) -> dict:
         """{key: state} for device-owned keys; callers take the host
         path for the rest."""
-        return self.planes[type_name].read_many(keys, read_vc)
+        with tracer.span("device_read_many", "device", txid=txid,
+                         n=len(keys), type=type_name):
+            t0 = time.perf_counter()
+            out = self.planes[type_name].read_many(keys, read_vc)
+        stats.registry.device_read_latency.observe(
+            time.perf_counter() - t0)
+        return out
 
     def gc(self, stable_vc: VC) -> None:
-        for p in self.planes.values():
-            p.gc(stable_vc)
+        with tracer.span("device_gc_all", "device"):
+            for p in self.planes.values():
+                p.gc(stable_vc)
 
     def flush(self) -> None:
-        for p in self.planes.values():
-            p.flush()
+        with tracer.span("device_flush_all", "device"):
+            for p in self.planes.values():
+                p.flush()
 
     def pending(self) -> int:
         return sum(len(p.rows) for p in self.planes.values())

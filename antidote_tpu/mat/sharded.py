@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from antidote_tpu import tracing
 from antidote_tpu.clocks import dense
 from antidote_tpu.runtime import COLLECTIVE_LOCK
 from antidote_tpu.mat import store
@@ -203,8 +204,13 @@ class _ShardedBase:
             local_append,
             in_specs=(self._state_spec,) + (P(),) * (2 + len(payload)),
             out_specs=(self._state_spec, P()), donate=True)
-        self.st, overflow = fn(
-            self.st, *self._rep_put(key_idx, lane_off, *payload))
+        # the pmax over shards is a collective launch like the GC fold's
+        # pmin — runtime.py's invariant ("every collective launch site
+        # takes this lock") covers it too, or a threaded append racing a
+        # locked GC still aborts inside the XLA runtime
+        args = self._rep_put(key_idx, lane_off, *payload)
+        with COLLECTIVE_LOCK, tracing.annotate("sharded_append"):
+            self.st, overflow = fn(self.st, *args)
         return overflow
 
     # ------------------------------------------------------------- reads
@@ -219,7 +225,12 @@ class _ShardedBase:
 
         fn = self._sm(local_read, in_specs=(self._state_spec, P()),
                       out_specs=P("part"))
-        return fn(self.st, rv)
+        # sharded over the mesh: the dispatch launches a multi-chip
+        # program and must serialize with collective launches (the
+        # read itself has no cross-shard reduce, but an interleaved
+        # launch against a running pmin/psum still trips the runtime)
+        with COLLECTIVE_LOCK, tracing.annotate("sharded_read"):
+            return fn(self.st, rv)
 
     def read_keys(self, key_idx, read_vc) -> jax.Array:
         """Point reads for GLOBAL key indices, replicated to every chip
@@ -238,7 +249,10 @@ class _ShardedBase:
         fn = self._sm(local_read_keys,
                       in_specs=(self._state_spec, P(), P()),
                       out_specs=P())
-        return fn(self.st, key_idx, rv)
+        # the psum assembling the replicated answer is a collective —
+        # same serialization rule as append/gc (runtime.py invariant)
+        with COLLECTIVE_LOCK, tracing.annotate("sharded_read_keys"):
+            return fn(self.st, key_idx, rv)
 
 
 class ShardedOrsetStore(_ShardedBase):

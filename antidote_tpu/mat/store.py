@@ -225,16 +225,7 @@ def orset_append(
     return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def orset_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
-    """Fold every ring op with commit VC <= GST into the base snapshot
-    and free its lane (the batched op_insert_gc/snapshot_insert_gc,
-    reference src/materializer_vnode.erl:511-647).
-
-    Safe because the GST is a *stable* time: no op with commit VC <= GST
-    can still be in flight (reference dc_utilities:get_stable_snapshot
-    contract), so folding is permanent and base_vc := max(base_vc, gst).
-    Lanes are freed, not compacted (see module doc)."""
+def _orset_gc_impl(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
     cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)      # [K, L, D]
     stable = st.valid2d & dense.le(cvc, gst[None, None, :])
     dots = kernels.orset_apply(
@@ -248,6 +239,28 @@ def orset_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
         has_base=jnp.ones((), dtype=bool),
         valid=st.valid & ~stable.reshape(-1),
     )
+
+
+#: the same fold WITHOUT donation — orset_gc_full's jnp path, so its
+#: flag-independent contract ("st stays valid") holds on every path
+_orset_gc_nodonate = jax.jit(_orset_gc_impl)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def orset_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
+    """Fold every ring op with commit VC <= GST into the base snapshot
+    and free its lane (the batched op_insert_gc/snapshot_insert_gc,
+    reference src/materializer_vnode.erl:511-647).
+
+    Safe because the GST is a *stable* time: no op with commit VC <= GST
+    can still be in flight (reference dc_utilities:get_stable_snapshot
+    contract), so folding is permanent and base_vc := max(base_vc, gst).
+    Lanes are freed, not compacted (see module doc).
+
+    DONATES ``st``'s buffers (the live planes' steady-state GC aliases
+    the multi-hundred-MB ops tensor in place); callers that must keep
+    ``st`` use :func:`orset_gc_full`, whose paths all preserve it."""
+    return _orset_gc_impl(st, gst)
 
 
 @jax.jit
@@ -356,14 +369,16 @@ def orset_gc_full(st: OrsetShardState, gst: jax.Array,
     VMEM/VPU headroom; the kernel is equality-tested against orset_gc
     (tests/unit/test_pallas_kernels.py).
 
-    Callers must treat ``st`` as CONSUMED: the jnp fallback (auto,
-    False, or an int64 store) donates st's buffers (orset_gc's
-    donate_argnums), while the fused path does not — code that touches
-    st after this call works on one path and crashes on the other."""
+    Unlike :func:`orset_gc`, ``st`` is NOT consumed on ANY path: the
+    jnp fallback runs the non-donating jit and the fused path never
+    donated — uniform semantics regardless of the flag (the previous
+    flag-dependent donation was a use-after-donate hazard: caller code
+    touching st afterwards worked under fused=True and crashed — or
+    silently read donated buffers — under the default)."""
     if fused == "auto":
         fused = False
     if not fused or st.ops.dtype != jnp.int32:
-        return orset_gc(st, gst)
+        return _orset_gc_nodonate(st, gst)
     from antidote_tpu.mat import pallas_kernels
 
     K = st.dots.shape[0]

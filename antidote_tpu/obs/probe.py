@@ -1,0 +1,79 @@
+"""Online self-checks — the set_aw read-inclusion probe.
+
+VERDICT round 5 documents an open causal-correctness bug: a
+device-served ``set_aw`` read transiently misses one OLD element in
+roughly 1/10 heavy federation runs.  The probe is the tripwire: a
+sampled fraction of device-served set_aw reads is re-materialized from
+the durable log at the SAME snapshot (the host-oracle-exact path,
+``PartitionManager._read_from_log``) and the element sets compared.
+Inclusion is the property under test — every element the log replay
+shows live at the snapshot must appear in the device fold's state (the
+dot-collapse keeps element presence exact; see the device_plane module
+doc).  A violation dumps the flight recorder (``force=True`` — this is
+the forensic record the round-6 hunt exists for) and logs at ERROR so
+the error monitor counts it.
+
+The probe only arms on reads with an EXPLICIT snapshot: a read-latest
+device fold races commits that land between the fold and the log
+replay, which would flag phantom misses; an explicit VC filters both
+sides to the same op window (``op_in_read_snapshot``), so a reported
+miss is real.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+
+from antidote_tpu.config import Config as _Config
+from antidote_tpu.obs.events import recorder
+
+log = logging.getLogger(__name__)
+
+#: probability a device-served set_aw read is cross-checked
+#: (Config.obs_selfcheck_set_aw via obs.configure — Config is the
+#: single source of the default; off by default, the replay costs a
+#: per-key log scan)
+SELF_CHECK_RATE: float = _Config().obs_selfcheck_set_aw
+
+
+def should_check(read_vc) -> bool:
+    """Arm the probe?  Explicit-snapshot reads only (module doc)."""
+    rate = SELF_CHECK_RATE
+    if rate <= 0.0 or read_vc is None:
+        return False
+    return rate >= 1.0 or random.random() < rate
+
+
+def missing_elements(device_state, oracle_state) -> set:
+    """Elements live in the log-replay oracle but absent from the
+    device fold — the inclusion violation set.  Both states are the
+    set_aw host shape (element -> live dots); extra elements on the
+    device side are NOT flagged here (that is a staleness question,
+    not the inclusion property this probe guards)."""
+    return set(oracle_state) - set(device_state)
+
+
+def verify_set_aw_inclusion(partition: int, key, read_vc, device_state,
+                            oracle_state) -> set:
+    """Record the check; on violation, dump the flight recorder and
+    trip the error monitor.  Returns the missing-element set so the
+    caller (and tests) can assert on it."""
+    missing = missing_elements(device_state, oracle_state)
+    recorder.record("probe", "set_aw_check", partition=partition,
+                    key=key, missing=len(missing))
+    if missing:
+        extra = {
+            "partition": partition,
+            "key": key,
+            "read_vc": dict(read_vc) if read_vc is not None else None,
+            "missing": sorted(repr(e) for e in missing),
+            "device_elements": sorted(repr(e) for e in device_state),
+            "oracle_elements": sorted(repr(e) for e in oracle_state),
+        }
+        recorder.dump("set_aw_inclusion", extra=extra, force=True)
+        log.error(
+            "set_aw inclusion probe: device read of %r (partition %d) "
+            "missed %d element(s) present in the log replay", key,
+            partition, len(missing))
+    return missing

@@ -14,9 +14,13 @@ from __future__ import annotations
 
 import array
 import os
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from antidote_tpu import stats
 from antidote_tpu.clocks import VC
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import tracer
 from antidote_tpu.mat.materializer import Payload, op_in_read_snapshot
 from antidote_tpu.oplog.log import DurableLog
 from antidote_tpu.oplog.records import (
@@ -118,14 +122,21 @@ class PartitionLog:
                       snapshot_vc: VC, certified: bool = True) -> LogRecord:
         """Commit record; fsyncs when sync_on_commit (reference
         append_commit / ?SYNC_LOG)."""
-        rec = commit_record(self._next_op_id(dc), txid, dc, commit_time,
-                            snapshot_vc, certified)
-        self._append(rec, sync=self.sync_on_commit)
+        t0 = time.perf_counter()
+        with tracer.span("log_append_commit", "oplog", txid=txid,
+                         partition=self.partition):
+            rec = commit_record(self._next_op_id(dc), txid, dc,
+                                commit_time, snapshot_vc, certified)
+            self._append(rec, sync=self.sync_on_commit)
+        stats.registry.log_append_latency.observe(
+            time.perf_counter() - t0)
         return rec
 
     def append_abort(self, dc, txid) -> LogRecord:
         rec = abort_record(self._next_op_id(dc), txid)
         self._append(rec, sync=False)
+        recorder.record("oplog", "abort_record", txid=txid,
+                        partition=self.partition)
         return rec
 
     def append_remote_group(self, records: List[LogRecord]) -> None:

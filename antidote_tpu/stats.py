@@ -24,6 +24,7 @@ Exposition is the Prometheus text format served by a stdlib HTTP server
 
 from __future__ import annotations
 
+import bisect
 import http.server
 import logging
 import os
@@ -32,7 +33,15 @@ import time
 from typing import Dict, Iterable, Optional, Tuple
 
 
-class Counter:
+class _LabeledMetric:
+    """Shared labeled-child machinery (label-key construction, locked
+    child store, exposition loop) for Counter and LabeledGauge."""
+
+    kind = "untyped"
+    #: counters expose a zero sample when childless; gauges expose
+    #: nothing until a child exists
+    _zero_when_empty = False
+
     def __init__(self, name: str, help_: str, labels: Tuple[str, ...] = ()):
         self.name = name
         self.help = help_
@@ -40,23 +49,53 @@ class Counter:
         self._values: Dict[Tuple, float] = {}
         self._lock = threading.Lock()
 
+    def _key(self, labels: Dict) -> Tuple:
+        return tuple(labels.get(n, "") for n in self.label_names)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:
+            items = list(self._values.items())
+        if not items and self._zero_when_empty:
+            items = [((), 0.0)]
+        for key, v in items:
+            yield f"{self.name}{_fmt_labels(self.label_names, key)} {_fmt(v)}"
+
+
+class Counter(_LabeledMetric):
+    kind = "counter"
+    _zero_when_empty = True
+
     def inc(self, amount: float = 1.0, **labels) -> None:
-        key = tuple(labels.get(n, "") for n in self.label_names)
+        key = self._key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        key = tuple(labels.get(n, "") for n in self.label_names)
         with self._lock:
-            return self._values.get(key, 0.0)
+            return self._values.get(self._key(labels), 0.0)
 
-    def expose(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
+
+class LabeledGauge(_LabeledMetric):
+    """Gauge with label dimensions (the per-DC replication-lag series:
+    one child per peer, like client_golang's GaugeVec)."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
         with self._lock:
-            items = list(self._values.items()) or [((), 0.0)]
-        for key, v in items:
-            yield f"{self.name}{_fmt_labels(self.label_names, key)} {_fmt(v)}"
+            self._values[self._key(labels)] = float(v)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._values.get(self._key(labels))
+
+    def remove(self, **labels) -> None:
+        """Drop a child series so a departed peer's last sample does
+        not expose as a frozen value forever."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
 
 
 class Gauge:
@@ -97,13 +136,14 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
+        # bisect_left: first bucket >= v, i.e. the le-semantics bucket;
+        # len(buckets) lands on the +Inf tail.  Hot path (stage-latency
+        # histograms observe several times per txn) — keep it O(log n)
+        # and branch-free under the lock.
+        i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self._sum += v
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+            self._counts[i] += 1
 
     @property
     def count(self) -> int:
@@ -129,10 +169,18 @@ def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+def _escape_label(v) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline — exposition-format spec; unescaped values break scrapes)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(names: Tuple[str, ...], values: Tuple) -> str:
     if not names:
         return ""
-    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    pairs = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(names, values))
     return "{" + pairs + "}"
 
 
@@ -156,10 +204,43 @@ class Registry:
         self.operations = Counter(
             "antidote_operations_total", "Number of operations executed",
             labels=("type",))
+        # ---- stage-latency histograms + replication lag (ISSUE 1):
+        # per-plane timing of the txn lifecycle, seconds.  Buckets span
+        # 100 µs (a warm device fold) to 5 s (an in-run XLA compile).
+        lat_buckets = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
+                       0.1, 0.5, 1.0, 5.0)
+        self.commit_latency = Histogram(
+            "antidote_txn_commit_latency_seconds",
+            "Commit call latency at the coordinator", buckets=lat_buckets)
+        self.log_append_latency = Histogram(
+            "antidote_log_append_latency_seconds",
+            "Durable commit-record append latency (fsync included when "
+            "sync_log)", buckets=lat_buckets)
+        self.device_flush_latency = Histogram(
+            "antidote_device_flush_latency_seconds",
+            "Device-plane append-flush latency per batch",
+            buckets=lat_buckets)
+        self.device_read_latency = Histogram(
+            "antidote_device_read_latency_seconds",
+            "Device-plane materialization-fold latency per read",
+            buckets=lat_buckets)
+        self.depgate_wait = Histogram(
+            "antidote_depgate_wait_seconds",
+            "Inter-DC txn wait in the dependency gate (enqueue to "
+            "apply)", buckets=lat_buckets)
+        self.replication_lag = LabeledGauge(
+            "antidote_replication_lag_seconds",
+            "Local-clock age of the stable snapshot entry per peer DC, "
+            "as observed by each local DC (the registry is process-"
+            "global and a process may host several DCs)",
+            labels=("dc", "peer"))
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
-                self.aborted_transactions, self.operations)
+                self.aborted_transactions, self.operations,
+                self.commit_latency, self.log_append_latency,
+                self.device_flush_latency, self.device_read_latency,
+                self.depgate_wait, self.replication_lag)
 
     def exposition(self) -> str:
         lines = []
@@ -226,6 +307,22 @@ class ErrorMonitorHandler(logging.Handler):
 
     def emit(self, record) -> None:
         self.registry.error_count.inc()
+        # an error-monitor trip also dumps the flight recorder (rate-
+        # limited inside dump(); lazy import — obs pulls nothing heavy
+        # but stats must stay importable standalone)
+        try:
+            from antidote_tpu.obs.events import recorder as _rec
+
+            _rec.record("errors", "monitor_trip",
+                        logger=record.name,
+                        message=record.getMessage()[:200])
+            # anomalies that dump directly (abort, probe violation) also
+            # log at ERROR; their forced dump already captured this
+            # window, so don't write a redundant file for the log line
+            if _rec.last_dump_age_s() >= _rec.min_dump_interval_s:
+                _rec.dump("error_monitor")
+        except Exception:  # noqa: BLE001 — the handler must not die
+            pass
 
 
 _error_monitor_installed = False
@@ -269,21 +366,50 @@ def stop_shared_metrics_server() -> None:
 class StalenessSampler:
     """Every 10 s, observe (now - min GST entry) in ms (reference
     src/antidote_stats_collector.erl:87-93: staleness of the stable
-    snapshot vs the local clock)."""
+    snapshot vs the local clock).
+
+    The same snapshot fetch also feeds the per-peer replication-lag
+    gauge when ``peers_source`` is given — the gauge rides this
+    sampler's period instead of forcing an extra stable-snapshot fold
+    (on device-backed trackers: an XLA launch under COLLECTIVE_LOCK)
+    per heartbeat tick."""
 
     def __init__(self, stable_vc_source, now_us, reg: Optional[Registry] = None,
-                 period_s: float = 10.0):
+                 period_s: float = 10.0, peers_source=None,
+                 local_dc: str = ""):
         self.stable_vc_source = stable_vc_source
         self.now_us = now_us
         self.registry = reg or registry
         self.period_s = period_s
+        #: () -> iterable of peer DC ids to gauge replication lag for
+        self.peers_source = peers_source
+        #: the observing DC's id — the gauge's ``dc`` label, so several
+        #: DCs in one process don't clobber each other's peer series
+        self.local_dc = str(local_dc)
+        self._lag_peers: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def sample_once(self) -> float:
-        staleness_ms = sample_staleness_ms(
-            self.stable_vc_source(), self.now_us())
+        st = self.stable_vc_source()
+        now_us = self.now_us()
+        staleness_ms = sample_staleness_ms(st, now_us)
         self.registry.staleness.observe(staleness_ms)
+        peers = set(self.peers_source()) if self.peers_source else set()
+        for peer in peers:
+            ts = st.get_dc(peer)
+            if ts <= 0:
+                continue  # no stable entry yet: lag is undefined, not epoch-sized
+            self.registry.replication_lag.set(
+                max(now_us - ts, 0) / 1e6, dc=self.local_dc,
+                peer=str(peer))
+        # a departed peer's series is dropped, not frozen at its last
+        # value (only THIS dc's series: another DC in the process may
+        # still be replicating from that peer)
+        for gone in self._lag_peers - peers:
+            self.registry.replication_lag.remove(dc=self.local_dc,
+                                                 peer=str(gone))
+        self._lag_peers = peers
         return staleness_ms
 
     def start(self) -> None:
@@ -293,11 +419,15 @@ class StalenessSampler:
         self._thread.start()
 
     def _run(self) -> None:
-        while not self._stop.wait(self.period_s):
+        # one immediate sample so short-lived processes (and the
+        # federation smoke test) see the gauges without waiting a period
+        while True:
             try:
                 self.sample_once()
             except Exception:  # noqa: BLE001 — sampler must not die
                 logging.getLogger(__name__).exception("staleness sample")
+            if self._stop.wait(self.period_s):
+                return
 
     def stop(self) -> None:
         self._stop.set()
@@ -317,14 +447,24 @@ class MetricsServer:
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib API
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path in ("", "/metrics"):
+                    body = outer.registry.exposition().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/healthz":
+                    body = outer.healthz().encode()
+                    ctype = "application/json"
+                elif path == "/debug/spans":
+                    from antidote_tpu.obs.spans import tracer
+
+                    body = tracer.export_chrome_json().encode()
+                    ctype = "application/json"
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = outer.registry.exposition().encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -336,6 +476,22 @@ class MetricsServer:
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
+
+    def healthz(self) -> str:
+        """Liveness JSON: serving + a shallow state summary (span ring
+        depth, flight-recorder dump count, open txns)."""
+        import json
+
+        from antidote_tpu.obs.events import recorder as _rec
+        from antidote_tpu.obs.spans import tracer as _tr
+
+        return json.dumps({
+            "status": "ok",
+            "open_transactions": self.registry.open_transactions.value(),
+            "error_count": self.registry.error_count.value(),
+            "spans_buffered": len(_tr),
+            "flight_recorder_dumps": len(_rec.dumps),
+        })
 
     def start(self) -> "MetricsServer":
         self._thread.start()

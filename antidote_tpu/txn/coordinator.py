@@ -18,12 +18,15 @@ from __future__ import annotations
 
 import itertools
 import os
+import time as _time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
 
 from antidote_tpu import stats
 from antidote_tpu.clocks import VC
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import traced, tracer
 from antidote_tpu.crdt import DownstreamCtx, DownstreamError, get_type, is_type
 from antidote_tpu.mat.materializer import materialize_eager
 from antidote_tpu.txn.manager import (
@@ -283,6 +286,8 @@ class Coordinator:
                                            node.clock.now_us()))
         txid = (snap.get_dc(node.dc_id), _fresh_txid_suffix())
         stats.registry.open_transactions.inc()
+        tracer.instant("txn_start", "coordinator", txid=txid,
+                       dc=str(node.dc_id))
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
             ctx=DownstreamCtx(actor=(str(node.dc_id), txid[1]),
@@ -347,6 +352,8 @@ class Coordinator:
             client_clock if props.update_clock else None)
         txid = (snap.get_dc(self.node.dc_id), _fresh_txid_suffix())
         stats.registry.open_transactions.inc()
+        tracer.instant("txn_start", "coordinator", txid=txid,
+                       dc=str(self.node.dc_id), protocol="gr")
         return Transaction(
             txid=txid, snapshot_vc=snap, properties=props,
             ctx=DownstreamCtx(actor=(str(self.node.dc_id), txid[1]),
@@ -386,6 +393,7 @@ class Coordinator:
                                        txid=tx.txid))
         return values
 
+    @traced("txn_read", "coordinator")
     def read_objects(self, tx: Transaction, bound_objects: List) -> List[Any]:
         """Reads grouped per partition and executed as one batched call
         each (async batched reads, reference
@@ -499,6 +507,7 @@ class Coordinator:
 
     # -------------------------------------------------------------- updates
 
+    @traced("txn_update", "coordinator")
     def update_objects(self, tx: Transaction, updates: List) -> None:
         """[(bound_object, op_name, op_param)] — validate, hook,
         generate downstream, log, stage."""
@@ -614,7 +623,9 @@ class Coordinator:
 
     # --------------------------------------------------------------- commit
 
+    @traced("txn_commit", "coordinator")
     def commit_transaction(self, tx: Transaction) -> VC:
+        t0 = _time.perf_counter()
         self._check_active(tx)
         node = self.node
         certify = (tx.properties.certify
@@ -625,12 +636,15 @@ class Coordinator:
             pm = node.partitions[tx.partitions[0]]
             deferred = tx.deferred_ops.get(tx.partitions[0])
             try:
-                if deferred is not None:
-                    ct = pm.stage_single_commit(
-                        tx.txid, deferred, tx.snapshot_vc, certify)
-                else:
-                    ct = pm.single_commit(tx.txid, tx.snapshot_vc,
-                                          certify)
+                with tracer.span("single_commit", "coordinator",
+                                 txid=tx.txid,
+                                 partition=tx.partitions[0]):
+                    if deferred is not None:
+                        ct = pm.stage_single_commit(
+                            tx.txid, deferred, tx.snapshot_vc, certify)
+                    else:
+                        ct = pm.single_commit(tx.txid, tx.snapshot_vc,
+                                              certify)
             except CertificationError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(str(e)) from e
@@ -662,9 +676,12 @@ class Coordinator:
                         {})
 
             try:
-                prepare_times = _fan_out(
-                    [(p, pm) for p, pm in zip(tx.partitions, pms)],
-                    _prepare, spec=_prepare_spec)
+                with tracer.span("2pc_prepare", "coordinator",
+                                 txid=tx.txid,
+                                 partitions=len(tx.partitions)):
+                    prepare_times = _fan_out(
+                        [(p, pm) for p, pm in zip(tx.partitions, pms)],
+                        _prepare, spec=_prepare_spec)
             except CertificationError as e:
                 self.abort_transaction(tx)
                 raise TransactionAborted(str(e)) from e
@@ -674,13 +691,17 @@ class Coordinator:
                 raise TransactionAborted(f"prepare failed: {e}") from e
             ct = max(prepare_times)
             try:
-                _fan_out(
-                    [(p, pm) for p, pm in zip(tx.partitions, pms)],
-                    lambda _p, pm: pm.commit(tx.txid, ct, tx.snapshot_vc,
-                                             certified=certify),
-                    spec=lambda _p, _pm: (
-                        "commit", (tx.txid, ct, tx.snapshot_vc),
-                        {"certified": certify}))
+                with tracer.span("2pc_commit", "coordinator",
+                                 txid=tx.txid,
+                                 partitions=len(tx.partitions)):
+                    _fan_out(
+                        [(p, pm) for p, pm in zip(tx.partitions, pms)],
+                        lambda _p, pm: pm.commit(tx.txid, ct,
+                                                 tx.snapshot_vc,
+                                                 certified=certify),
+                        spec=lambda _p, _pm: (
+                            "commit", (tx.txid, ct, tx.snapshot_vc),
+                            {"certified": certify}))
             except Exception as e:
                 # post-decision failure: some partitions may hold a
                 # durable commit record — reporting an abort here would
@@ -688,12 +709,16 @@ class Coordinator:
                 tx.state = TxnState.UNKNOWN
                 stats.registry.open_transactions.dec()
                 self._release_gate(tx)
+                recorder.record("txn", "commit_unknown", txid=tx.txid,
+                                error=str(e))
+                recorder.dump("commit_unknown")
                 raise CommitOutcomeUnknown(
                     f"commit decided at {ct} but applying it failed: {e}"
                 ) from e
             commit_vc = tx.snapshot_vc.set_dc(node.dc_id, ct)
         tx.state = TxnState.COMMITTED
         tx.commit_vc = commit_vc
+        stats.registry.commit_latency.observe(_time.perf_counter() - t0)
         stats.registry.open_transactions.dec()
         self._release_gate(tx)
         for bucket, key, type_name, op in tx.client_ops:
@@ -708,6 +733,11 @@ class Coordinator:
     def abort_transaction(self, tx: Transaction) -> None:
         if tx.state is not TxnState.ACTIVE:
             return
+        tracer.instant("txn_abort", "coordinator", txid=tx.txid,
+                       partitions=len(tx.partitions))
+        recorder.record("txn", "abort", txid=tx.txid,
+                        partitions=list(tx.partitions),
+                        keys=list(tx.writeset))
         for p in tx.partitions:
             try:
                 self.node.partitions[p].abort(tx.txid)
@@ -725,3 +755,10 @@ class Coordinator:
         stats.registry.open_transactions.dec()
         stats.registry.aborted_transactions.inc()
         self._release_gate(tx)
+        # forensic snapshot of the window leading up to the abort —
+        # AFTER partition cleanup and the gate release, so neither
+        # readers blocked on this txn's prepared keys nor
+        # start_transaction callers waiting on a gate slot are held out
+        # for the (rate-limited, but synchronous) ring serialization +
+        # disk write
+        recorder.dump("txn_abort", extra={"txid": repr(tx.txid)})

@@ -262,6 +262,28 @@ class Node:
         self.config = config or Config()
         self.clock = HybridClock()
         self.hooks = HookRegistry()
+        # push only explicitly-set observability knobs into the
+        # process-global tracer/recorder/probe (shared by every DC in
+        # the process, like stats.registry): a later Node built with a
+        # default Config must not silently revert the sample rate or
+        # disarm the probe another DC configured.  The globals START
+        # from the same Config defaults (obs/spans.py, obs/probe.py),
+        # so skipping the push is lossless; the one blind spot is a
+        # Node explicitly setting a knob BACK to the default after
+        # another DC changed it — use obs.configure() directly for that
+        from antidote_tpu import obs
+
+        _obs_defaults = Config()
+        obs.configure(**{kw: v for kw, v, d in (
+            ("sample_rate", self.config.trace_sample_rate,
+             _obs_defaults.trace_sample_rate),
+            ("capacity", self.config.trace_capacity,
+             _obs_defaults.trace_capacity),
+            ("dump_dir", self.config.flight_recorder_dir,
+             _obs_defaults.flight_recorder_dir),
+            ("selfcheck_set_aw", self.config.obs_selfcheck_set_aw,
+             _obs_defaults.obs_selfcheck_set_aw),
+        ) if v != d})
         from antidote_tpu.txn.manager import DeviceFlusher
 
         #: background group-commit flusher shared by this node's

@@ -3,11 +3,13 @@ results as chip evidence (a tunnel drop between the probe and a phase
 subprocess's jax init silently falls back to CPU)."""
 
 import json
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "/root/repo/tools")
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "tools"))
 import hw_capture  # noqa: E402
 
 

@@ -1,0 +1,330 @@
+"""Observability-plane tests (ISSUE 1): metrics exposition round-trip
+(label escaping, +Inf bucket, _sum/_count), span-tree assembly from
+concurrent transactions, Chrome trace export, the flight recorder's
+dump-on-abort / rate-limit / probe-violation paths, and the /healthz +
+/debug/spans endpoints on the metrics server.
+"""
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.api import AntidoteTPU, TransactionAborted
+from antidote_tpu.config import Config
+from antidote_tpu.obs import probe
+from antidote_tpu.obs.events import FlightRecorder, recorder
+from antidote_tpu.obs.spans import Tracer, tracer
+
+
+@pytest.fixture(autouse=True)
+def _isolate_obs_globals(tmp_path):
+    """The tracer/recorder are process-global (like stats.registry);
+    snapshot the knobs, point dumps at the test tmpdir, and clear the
+    rings so tests neither leak into nor inherit from each other."""
+    saved = (tracer.sample_rate, recorder.dump_dir,
+             recorder.min_dump_interval_s, probe.SELF_CHECK_RATE)
+    tracer.clear()
+    recorder.clear()
+    recorder.dump_dir = str(tmp_path / "flightrec")
+    yield
+    (tracer.sample_rate, recorder.dump_dir,
+     recorder.min_dump_interval_s, probe.SELF_CHECK_RATE) = saved
+    tracer.clear()
+    recorder.clear()
+
+
+# --------------------------------------------------------------- metrics
+
+
+_LINE = re.compile(r'^(\w+)(?:\{(.*)\})? (.+)$')
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_exposition(text):
+    """Tiny Prometheus text-format reader: {(name, labels): value} —
+    the round-trip half of the exposition tests (a value that doesn't
+    parse back identical would break a real scrape)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, rawlabels, value = m.groups()
+        labels = tuple(
+            (k, v.replace("\\n", "\n").replace('\\"', '"')
+             .replace("\\\\", "\\"))
+            for k, v in _LABEL.findall(rawlabels or ""))
+        out[(name, labels)] = float(value)
+    return out
+
+
+def test_new_stage_metrics_exposed():
+    text = stats.registry.exposition()
+    for name in ("antidote_txn_commit_latency_seconds",
+                 "antidote_log_append_latency_seconds",
+                 "antidote_device_flush_latency_seconds",
+                 "antidote_device_read_latency_seconds",
+                 "antidote_depgate_wait_seconds"):
+        assert f"# TYPE {name} histogram" in text
+    assert "# TYPE antidote_replication_lag_seconds gauge" in text
+
+
+def test_counter_label_escaping_round_trip():
+    reg = stats.Registry()
+    nasty = 'quo"te back\\slash new\nline'
+    reg.operations.inc(3, type=nasty)
+    parsed = _parse_exposition("\n".join(reg.operations.expose()))
+    assert parsed[("antidote_operations_total",
+                   (("type", nasty),))] == 3
+    # and the raw line is legally escaped (no bare quote/newline)
+    (line,) = [ln for ln in reg.operations.expose()
+               if not ln.startswith("#")]
+    assert "\n" not in line and '\\"' in line and "\\\\" in line
+
+
+def test_histogram_inf_bucket_sum_count_round_trip():
+    reg = stats.Registry()
+    h = reg.commit_latency
+    h.observe(0.0002)   # -> le=0.0005
+    h.observe(0.02)     # -> le=0.05
+    h.observe(99.0)     # -> only +Inf
+    parsed = _parse_exposition("\n".join(h.expose()))
+    name = "antidote_txn_commit_latency_seconds"
+    assert parsed[(name + "_bucket", (("le", "+Inf"),))] == 3
+    assert parsed[(name + "_count", ())] == 3
+    assert parsed[(name + "_sum", ())] == pytest.approx(99.0202)
+    # buckets are cumulative: the 0.05 bucket holds both finite samples
+    assert parsed[(name + "_bucket", (("le", "0.05"),))] == 2
+    assert parsed[(name + "_bucket", (("le", "0.0005"),))] == 1
+
+
+def test_replication_lag_gauge_per_peer():
+    reg = stats.Registry()
+    reg.replication_lag.set(0.25, dc="dc1", peer="dc2")
+    reg.replication_lag.set(1.5, dc="dc1", peer="dc3")
+    reg.replication_lag.set(0.5, dc="dc1", peer="dc2")  # overwrite
+    # another local DC's view of the same peer is its own series
+    reg.replication_lag.set(2.5, dc="dc4", peer="dc3")
+    parsed = _parse_exposition(
+        "\n".join(reg.replication_lag.expose()))
+    assert parsed[("antidote_replication_lag_seconds",
+                   (("dc", "dc1"), ("peer", "dc2")))] == 0.5
+    assert parsed[("antidote_replication_lag_seconds",
+                   (("dc", "dc1"), ("peer", "dc3")))] == 1.5
+    assert parsed[("antidote_replication_lag_seconds",
+                   (("dc", "dc4"), ("peer", "dc3")))] == 2.5
+    assert reg.replication_lag.value(dc="dc1", peer="dc3") == 1.5
+
+
+# ----------------------------------------------------------------- spans
+
+
+def test_span_tree_assembly_from_concurrent_transactions():
+    t = Tracer(sample_rate=1.0)
+    txids = [("dc1", i) for i in range(4)]
+
+    def commit(txid):
+        with t.span("txn_commit", "coordinator", txid=txid):
+            with t.span("2pc_prepare", "coordinator", txid=txid):
+                time.sleep(0.001)
+            with t.span("2pc_commit", "coordinator", txid=txid):
+                pass
+
+    threads = [threading.Thread(target=commit, args=(txid,))
+               for txid in txids]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    for txid in txids:
+        roots = t.tree(txid)
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["span"].name == "txn_commit"
+        assert [c["span"].name for c in root["children"]] == [
+            "2pc_prepare", "2pc_commit"]
+        # no cross-contamination between concurrent txns
+        assert all(s.txid == txid for s in t.spans(txid=txid))
+    assert len(t) == 12
+
+
+def test_sampling_is_deterministic_and_proportional():
+    a = Tracer(sample_rate=0.5)
+    b = Tracer(sample_rate=0.5)
+    txids = [("dc1", i) for i in range(2000)]
+    da = [a.sampled(x) for x in txids]
+    assert da == [b.sampled(x) for x in txids]     # process-stable
+    assert 800 < sum(da) < 1200                    # ~rate fraction
+    assert Tracer(sample_rate=0.0).sampled(None) is False
+    # untagged (txid-less) spans are thinned to ~rate by a hashed call
+    # counter: not recorded on every call (a hot untagged path must not
+    # flood the ring), and not a plain modulo (a periodic call pattern
+    # must not phase-lock one call site out of the ring)
+    t = Tracer(sample_rate=0.05)
+    decisions = [t.sampled(None) for _ in range(2000)]
+    assert 50 < sum(decisions) < 150               # ~rate fraction
+    t2 = Tracer(sample_rate=0.05)
+    assert decisions == [t2.sampled(None) for _ in range(2000)]
+
+
+def test_chrome_export_is_valid_trace_event_json(tmp_path):
+    t = Tracer(sample_rate=1.0)
+    with t.span("txn_commit", "coordinator", txid="tx9", n=3):
+        t.instant("device_stage", "device", txid="tx9")
+    doc = json.loads(t.export_chrome_json())
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    assert [e["name"] for e in events] == ["device_stage", "txn_commit"]
+    for e in events:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["args"]["txid"] == "tx9"
+        assert {"pid", "tid", "cat"} <= e.keys()
+    # the file save() writes is byte-identical JSON
+    path = t.save(str(tmp_path / "trace.json"))
+    assert json.load(open(path)) == doc
+
+
+def test_span_ring_is_bounded():
+    t = Tracer(capacity=8, sample_rate=1.0)
+    for i in range(50):
+        t.instant(f"e{i}", "host", txid="x")
+    assert len(t) == 8
+    assert t.spans()[0].name == "e42"  # oldest evicted first
+
+
+def test_default_config_node_does_not_stomp_obs_globals(tmp_path):
+    # the tracer/recorder/probe are process-global; a later Node built
+    # with a default Config must not revert another DC's knobs
+    tracer.sample_rate = 1.0
+    probe.SELF_CHECK_RATE = 0.5
+    db = AntidoteTPU(dc_id="dcx", data_dir=str(tmp_path / "d"))
+    try:
+        assert tracer.sample_rate == 1.0
+        assert probe.SELF_CHECK_RATE == 0.5
+    finally:
+        db.close()
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_dump_on_txn_abort(tmp_path):
+    cfg = Config(trace_sample_rate=1.0,
+                 flight_recorder_dir=str(tmp_path / "dumps"))
+    db = AntidoteTPU(dc_id="dc1", config=cfg,
+                     data_dir=str(tmp_path / "data"))
+    try:
+        tx = db.start_transaction()
+        with pytest.raises(TransactionAborted):
+            # bounded-counter decrement below zero certifies-fails
+            db.update_objects(
+                [(("obs_bc", "counter_b"), "decrement", (5, "dc1"))], tx)
+        assert recorder.dumps, "abort did not dump the flight recorder"
+        body = json.load(open(recorder.dumps[-1]))
+        assert body["reason"] == "txn_abort"
+        kinds = [e["kind"] for e in body["events"]["txn"]]
+        assert "abort" in kinds
+        # the abort's point event is on the trace timeline too
+        assert tracer.spans(name="txn_abort")
+    finally:
+        db.close()
+
+
+def test_flight_recorder_rate_limit_and_force(tmp_path):
+    rec = FlightRecorder(dump_dir=str(tmp_path),
+                         min_dump_interval_s=3600.0)
+    rec.record("txn", "abort", txid="t1")
+    assert rec.dump("storm") is not None
+    assert rec.dump("storm") is None          # rate-limited
+    assert rec.dump("other_reason") is not None  # per-reason buckets
+    assert rec.dump("storm", force=True) is not None
+    assert len(rec.dumps) == 3
+
+
+def test_probe_violation_dumps_and_clean_check_does_not():
+    # count probe dumps by reason: leaked background threads from other
+    # tests may trip the error monitor (its own reason) at any moment
+    def probe_dumps():
+        return [p for p in recorder.dumps if "set_aw_inclusion" in p]
+
+    dumps0 = len(probe_dumps())
+    ok = probe.verify_set_aw_inclusion(
+        0, "k", {"dc1": 7}, {"a", "b"}, {"a", "b"})
+    assert ok == set() and len(probe_dumps()) == dumps0
+
+    missing = probe.verify_set_aw_inclusion(
+        0, "k", {"dc1": 7}, {"a"}, {"a", "b"})
+    assert missing == {"b"}
+    assert len(probe_dumps()) == dumps0 + 1
+    body = json.load(open(probe_dumps()[-1]))
+    assert body["reason"] == "set_aw_inclusion"
+    assert body["extra"]["missing"] == ["'b'"]
+    assert body["extra"]["read_vc"] == {"dc1": 7}
+
+
+def test_error_monitor_coalesces_with_fresh_dump(tmp_path, monkeypatch):
+    """An anomaly that dumps directly also logs at ERROR; the monitor
+    must not write a second file for the same window — only for ERRORs
+    arriving with no recent dump."""
+    from antidote_tpu.obs import events
+    rec = FlightRecorder(dump_dir=str(tmp_path),
+                         min_dump_interval_s=0.2)
+    monkeypatch.setattr(events, "recorder", rec)
+    handler = stats.ErrorMonitorHandler(stats.Registry())
+    record = logging.LogRecord(
+        "antidote_tpu.obs.probe", logging.ERROR, __file__, 0,
+        "probe violation", None, None)
+
+    assert rec.dump("set_aw_inclusion", force=True) is not None
+    handler.emit(record)                  # coalesced with the dump above
+    assert len(rec.dumps) == 1
+
+    time.sleep(0.25)
+    handler.emit(record)                  # stale window: monitor dumps
+    assert [p for p in rec.dumps if "error_monitor" in p]
+
+
+def test_probe_arms_only_with_explicit_snapshot():
+    probe.SELF_CHECK_RATE = 1.0
+    assert probe.should_check({"dc1": 1}) is True
+    assert probe.should_check(None) is False   # read-latest races
+    probe.SELF_CHECK_RATE = 0.0
+    assert probe.should_check({"dc1": 1}) is False
+
+
+# ------------------------------------------------------------- endpoints
+
+
+def test_healthz_and_debug_spans_endpoints():
+    tracer.sample_rate = 1.0
+    with tracer.span("txn_commit", "coordinator", txid="http1"):
+        pass
+    srv = stats.MetricsServer(port=0, reg=stats.Registry()).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        health = json.load(urllib.request.urlopen(
+            base + "/healthz", timeout=5))
+        assert health["status"] == "ok"
+        assert health["spans_buffered"] >= 1
+        assert "flight_recorder_dumps" in health
+
+        doc = json.load(urllib.request.urlopen(
+            base + "/debug/spans", timeout=5))
+        assert any(e["name"] == "txn_commit"
+                   and e["args"].get("txid") == "http1"
+                   for e in doc["traceEvents"])
+        # /metrics still serves the exposition beside the new routes
+        body = urllib.request.urlopen(
+            base + "/metrics", timeout=5).read().decode()
+        assert "antidote_txn_commit_latency_seconds" in body
+    finally:
+        srv.stop()

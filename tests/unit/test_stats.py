@@ -163,6 +163,14 @@ class TestMonitoringStack:
         exposed = {line.split()[0].split("{")[0]
                    for line in text.splitlines()
                    if line and not line.startswith("#")}
+        # labeled families (e.g. the per-peer replication-lag gauge)
+        # expose no sample lines until a child exists — the TYPE line
+        # still proves the metric is registered and scrapeable
+        labeled = {m.name for m in stats.registry.metrics()
+                   if isinstance(m, stats.LabeledGauge)}
+        exposed |= {line.split()[2] for line in text.splitlines()
+                    if line.startswith("# TYPE ")
+                    and line.split()[2] in labeled}
         names, _dash = self._base_metrics()
         missing = set()
         for n in names:

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""trace_lint — instrumentation-coverage check for the obs plane.
+
+ISSUE 1 threads txid-correlated spans (antidote_tpu/obs/spans.py) and
+profiler annotations (antidote_tpu/tracing.py) through every public
+entry point of the coordinator, device plane, log, and inter-DC
+planes.  Instrumentation rots silently: a refactor that drops a
+``with tracer.span(...)`` breaks no test, it just blinds the next
+forensic hunt.  This lint pins the contract — every entry point listed
+in ENTRY_POINTS must carry a span, an instant, a profiler annotation,
+or the @traced decorator — and fails loudly when one goes dark.
+
+Runs standalone (``python tools/trace_lint.py``) and from tier-1
+(tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
+Purely static (ast), so it needs no JAX and runs in milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List
+
+#: (relative module path) -> {class name: [method, ...]} — the public
+#: entry points of each plane that MUST be instrumented.  Grow this
+#: list when a PR adds a plane; never shrink it to silence the lint.
+ENTRY_POINTS: Dict[str, Dict[str, List[str]]] = {
+    "antidote_tpu/txn/coordinator.py": {
+        "Coordinator": ["read_objects", "update_objects",
+                        "commit_transaction", "abort_transaction"],
+    },
+    "antidote_tpu/oplog/partition.py": {
+        "PartitionLog": ["append_commit"],
+    },
+    "antidote_tpu/mat/device_plane.py": {
+        "DevicePlane": ["stage", "read", "read_many", "gc", "flush"],
+    },
+    "antidote_tpu/mat/sharded.py": {
+        "_ShardedBase": ["append", "read", "read_keys"],
+    },
+    "antidote_tpu/interdc/sender.py": {
+        "InterDcLogSender": ["on_append"],
+    },
+    "antidote_tpu/interdc/dep.py": {
+        "DependencyGate": ["_apply"],
+    },
+    "antidote_tpu/interdc/dc.py": {
+        "DataCenter": ["_deliver"],
+    },
+}
+
+#: a call to <obj>.<attr> counts as instrumentation when (obj, attr)
+#: is one of these — the span/annotation surfaces of the obs plane
+_INSTRUMENTED_CALLS = {
+    ("tracer", "span"), ("tracer", "instant"),
+    ("tracing", "annotate"),
+}
+
+#: decorators that wrap the whole method in a span
+_INSTRUMENTED_DECORATORS = {"traced"}
+
+
+def _is_instrumented(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = getattr(target, "attr", getattr(target, "id", None))
+        if name in _INSTRUMENTED_DECORATORS:
+            return True
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and (f.value.id, f.attr) in _INSTRUMENTED_CALLS):
+            return True
+    return False
+
+
+def _methods(tree: ast.Module, cls_name: str) -> Dict[str, ast.FunctionDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            return {n.name: n for n in node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    return {}
+
+
+def lint(root: str) -> List[str]:
+    """All violations, as ``path::Class.method: <reason>`` strings."""
+    problems: List[str] = []
+    for rel, classes in sorted(ENTRY_POINTS.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: file vanished (update ENTRY_POINTS "
+                            "if the plane moved)")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for cls, methods in sorted(classes.items()):
+            found = _methods(tree, cls)
+            for m in methods:
+                fn = found.get(m)
+                if fn is None:
+                    problems.append(
+                        f"{rel}::{cls}.{m}: entry point missing "
+                        "(renamed? update ENTRY_POINTS)")
+                elif not _is_instrumented(fn):
+                    problems.append(
+                        f"{rel}::{cls}.{m}: no span/annotation — add "
+                        "tracer.span/instant, tracing.annotate, or "
+                        "@traced")
+    return problems
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else repo_root()
+    problems = lint(root)
+    n_points = sum(len(ms) for classes in ENTRY_POINTS.values()
+                   for ms in classes.values())
+    if problems:
+        print(f"trace_lint: {len(problems)} uninstrumented entry "
+              f"point(s) of {n_points}:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(f"trace_lint: OK — {n_points} entry points instrumented")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
