@@ -121,6 +121,13 @@ class Config:
     trace_sample_rate: float = 0.05
     #: finished spans kept in the in-process ring (/debug/spans depth)
     trace_capacity: int = 65536
+    #: per-kernel device-plane profiling (antidote_tpu/obs/prof.py):
+    #: call/dispatch timing, compile-cache-miss counters, and buffer
+    #: high-watermarks on every jitted mat//interdc entry point, served
+    #: at /debug/prof.  Lightweight (µs of host bookkeeping per BATCH
+    #: dispatch; honest completion fetches only for sampled txns or an
+    #: open XProf capture); False turns every hook into a passthrough.
+    kernel_profile: bool = True
     #: flight-recorder dump directory (None = <tempdir>/antidote_obs;
     #: antidote_tpu/obs/events.py)
     flight_recorder_dir: str | None = None

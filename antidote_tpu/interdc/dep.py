@@ -288,9 +288,9 @@ class DependencyGate:
         # (the two gating paths must agree regardless of queue depth)
         pvc[cols[self.own_dc]] = self.now_us()
 
-        from antidote_tpu import tracing
+        from antidote_tpu.obs import prof
 
-        with tracing.annotate("gate_fixpoint"):
+        with prof.annotate("gate_fixpoint"):
             applied, rounds, new_pvc = gate_fixpoint(
                 jnp.asarray(ss), jnp.asarray(origin_col),
                 jnp.asarray(pos_arr), jnp.asarray(ts), jnp.asarray(ping),
@@ -462,5 +462,12 @@ def gate_fixpoint(ss, origin, pos, ts, is_ping, pvc):
             rounds = note_round(rounds, applied, r)
             return applied, rounds, pvc
 
-        _GATE_JIT = jax.jit(_fixpoint)
+        from antidote_tpu.obs import prof as _prof
+
+        # kernel-span wrapped: the gate's padded-shape jit cache is the
+        # classic recompilation-storm source (every new (n_pad, d_pad)
+        # pair compiles), which the compile-miss counter now attributes
+        _GATE_JIT = _prof.profiler.wrap(
+            jax.jit(_fixpoint), name="gate_fixpoint",
+            subsystem="interdc.dep")
     return _GATE_JIT(ss, origin, pos, ts, is_ping, pvc)

@@ -47,8 +47,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from antidote_tpu import stats, tracing
+from antidote_tpu import stats
 from antidote_tpu.clocks import VC, ClockDomain
+from antidote_tpu.obs import prof
 from antidote_tpu.obs.events import recorder
 from antidote_tpu.obs.spans import tracer
 from antidote_tpu.mat import store
@@ -119,7 +120,12 @@ def fused_read(splits: list) -> list:
         def body(argss, _fns=fns):
             return tuple(f(*a) for f, a in zip(_fns, argss))
 
-        fn = jax.jit(body)
+        # one kernel-span name for every fused pattern: the per-pattern
+        # jits differ, but the operator-facing question ("how long do
+        # fused cross-partition reads take, how often do they compile")
+        # is per call site
+        fn = prof.profiler.wrap(jax.jit(body), name="fused_read",
+                                subsystem="mat.device_plane")
         _FUSED_CACHE[fns] = fn
     count_read_dispatch()
     outs = fn(tuple(splits[i][0][1] for i in order))
@@ -616,7 +622,7 @@ class _PlaneBase:
         # the span and histogram cover the overflow-retry path too —
         # the forced GC + second append (possibly a fresh XLA compile)
         # dominate exactly the flushes the stage-latency panel hunts
-        with tracing.annotate(f"device_flush:{self.type_name}"), \
+        with prof.annotate(f"device_flush:{self.type_name}"), \
                 tracer.span(f"device_flush:{self.type_name}", "device",
                             rows=len(rows)):
             for i in range(0, len(rows), step):
@@ -665,7 +671,7 @@ class _PlaneBase:
         pairs = self._ss_pairs(stable_vc)
         if pairs is None:
             return
-        with tracing.annotate(f"device_gc:{self.type_name}"), \
+        with prof.annotate(f"device_gc:{self.type_name}"), \
                 tracer.span(f"device_gc:{self.type_name}", "device"):
             self._device_gc(self._dense_vc(pairs))
         recorder.record("device", "gc", plane=self.type_name,

@@ -32,6 +32,15 @@ Conventions:
   origin DC (seq 0 = no dot);
 - element slots are dense indices assigned host-side (hash interning);
 - all arrays are fixed-shape; invalid / padding lanes carry valid=False.
+
+Profiling (ISSUE 2): nothing here is jit-decorated — these folds are
+pure building blocks composed INTO the jitted entry points of
+mat/store.py / mat/rga_store.py, so the kernel-span layer
+(antidote_tpu/obs/prof.py) times them at those call sites; wrapping
+them here would fire inside jit traces and measure compilation, not
+execution.  tools/trace_lint.py pins the invariant: any function in
+this package that grows a ``@jax.jit`` decorator must also grow a
+``@kernel_span``.
 """
 
 from __future__ import annotations

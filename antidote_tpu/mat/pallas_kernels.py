@@ -46,6 +46,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from antidote_tpu.obs.prof import kernel_span
+
 # index-map constants must stay int32: the package enables jax x64, and
 # a plain Python 0 traces as i64 there, which mosaic rejects
 _Z = np.int32(0)
@@ -148,6 +150,7 @@ def _orset_read_kernel(
     out_ref[:] = _fold_presence(dots_ref[:], ops, lane_mask, e, d, l)
 
 
+@kernel_span("mat.pallas")
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
 def orset_read_packed(dots, ops, valid, base_vc, has_base, read_vc,
                       block_k: int = 256, interpret: bool = False):
@@ -207,6 +210,7 @@ def _orset_fold_kernel(
         lambda i: mask[:, i][:, None] != _Z, e, d, l)
 
 
+@kernel_span("mat.pallas")
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
 def orset_read_hybrid(dots, ops, valid, base_vc, has_base, read_vc,
                       block_k: int = 512, interpret: bool = False):
@@ -293,6 +297,7 @@ def _orset_gc_kernel(
          for i in range(l)], axis=1)
 
 
+@kernel_span("mat.pallas")
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
 def orset_gc_packed(dots, ops, valid, gst,
                     block_k: int = 256, interpret: bool = False):
@@ -331,6 +336,7 @@ def orset_gc_packed(dots, ops, valid, gst,
     return ndots.reshape(k, e, d), (nvalid > 0).reshape(k * l)
 
 
+@kernel_span("mat.pallas")
 @functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
 def orset_read_fused(
     dots, elem_slot, is_add, dot_dc, dot_seq, obs_vv,

@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from antidote_tpu.obs.prof import kernel_span
+
 _I32MAX = jnp.iinfo(jnp.int32).max
 
 
@@ -173,6 +175,7 @@ def _merge_impl(
                 deleted=deleted, subtree=subtree, parent=parent, uid=uid)
 
 
+@kernel_span("mat.rga")
 @partial(jax.jit, static_argnames=("actor_bits",))
 def rga_merge(
     ins_lamport: jax.Array,  # int32[N] lamport of inserted vertex
@@ -202,6 +205,7 @@ def rga_merge(
     return r["doc"], r["n_visible"], r["rank"], r["visible"]
 
 
+@kernel_span("mat.rga")
 @partial(jax.jit, static_argnames=("actor_bits",))
 def rga_merge_full(ins_lamport, ins_actor, ref_lamport, ref_actor,
                    elem, valid, del_lamport, del_actor, del_valid,

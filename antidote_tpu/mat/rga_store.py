@@ -59,6 +59,7 @@ import numpy as np
 
 from antidote_tpu.clocks import dense
 from antidote_tpu.mat import rga_kernel
+from antidote_tpu.obs.prof import kernel_span
 from antidote_tpu.mat.rga_kernel import _I32MAX, pack_uid
 
 _I64MAX = jnp.iinfo(jnp.int64).max
@@ -159,6 +160,7 @@ def _ckey_pack(parent_uid, uid):
             | (jnp.int64(_I32MAX) - uid.astype(jnp.int64)))
 
 
+@kernel_span("mat.rga")
 @partial(jax.jit, donate_argnums=(0,))
 def rga_append(st: RgaStoreState, ins_lamport, ins_actor, ref_lamport,
                ref_actor, elem, ins_dc, ins_ct, ins_ss,
@@ -252,6 +254,7 @@ def _included(ss, dc, ct, rv):
     return jnp.all(cvc <= rv[None, :].astype(jnp.int64), axis=1)
 
 
+@kernel_span("mat.rga")
 @jax.jit
 def rga_read(st: RgaStoreState, read_vc):
     """Materialize the full RGA state at dense snapshot ``read_vc``
@@ -365,6 +368,7 @@ def rga_read(st: RgaStoreState, read_vc):
     return lam, act, elem_out, vis, n
 
 
+@kernel_span("mat.rga")
 @jax.jit
 def rga_read_doc(st: RgaStoreState, read_vc):
     """Visible document only: (doc int32[PB+NW] padded with -1,
@@ -443,6 +447,7 @@ def _window_tour(parent_key, uid, valid, is_root, nw):
     return rank, reachable, root_of, fin
 
 
+@kernel_span("mat.rga")
 @partial(jax.jit, donate_argnums=(0,), static_argnames=())
 def rga_fold(st: RgaStoreState, gst):
     """Fold window ops whose commit VC <= the dense GST (int64[D]) into

@@ -46,8 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from antidote_tpu import tracing
 from antidote_tpu.clocks import dense
+from antidote_tpu.obs import prof
 from antidote_tpu.runtime import COLLECTIVE_LOCK
 from antidote_tpu.mat import store
 
@@ -112,14 +112,18 @@ class _ShardedBase:
     def _sm(self, fn, in_specs, out_specs, donate: bool = False):
         key = fn.__name__
         if key not in self._jits:
-            self._jits[key] = jax.jit(
+            # kernel-span wrapped (obs/prof.py): multi-chip dispatches
+            # and their compile misses show up per collective entry
+            # point in /debug/prof and the KERNEL_* metrics
+            self._jits[key] = prof.profiler.wrap(jax.jit(
                 jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs, check_vma=False),
                 # state-updating entries alias the multi-hundred-MB ops
                 # tensor in place, like the single-device store's
                 # donate_argnums (an inner donation is ignored under an
                 # outer trace)
-                donate_argnums=(0,) if donate else ())
+                donate_argnums=(0,) if donate else ()),
+                name=f"sharded_{key.lstrip('_')}", subsystem="mat.sharded")
         return self._jits[key]
 
     def _rep_put(self, *arrays):
@@ -209,7 +213,7 @@ class _ShardedBase:
         # takes this lock") covers it too, or a threaded append racing a
         # locked GC still aborts inside the XLA runtime
         args = self._rep_put(key_idx, lane_off, *payload)
-        with COLLECTIVE_LOCK, tracing.annotate("sharded_append"):
+        with COLLECTIVE_LOCK, prof.annotate("sharded_append"):
             self.st, overflow = fn(self.st, *args)
         return overflow
 
@@ -229,7 +233,7 @@ class _ShardedBase:
         # program and must serialize with collective launches (the
         # read itself has no cross-shard reduce, but an interleaved
         # launch against a running pmin/psum still trips the runtime)
-        with COLLECTIVE_LOCK, tracing.annotate("sharded_read"):
+        with COLLECTIVE_LOCK, prof.annotate("sharded_read"):
             return fn(self.st, rv)
 
     def read_keys(self, key_idx, read_vc) -> jax.Array:
@@ -251,7 +255,7 @@ class _ShardedBase:
                       out_specs=P())
         # the psum assembling the replicated answer is a collective —
         # same serialization rule as append/gc (runtime.py invariant)
-        with COLLECTIVE_LOCK, tracing.annotate("sharded_read_keys"):
+        with COLLECTIVE_LOCK, prof.annotate("sharded_read_keys"):
             return fn(self.st, key_idx, rv)
 
 

@@ -53,6 +53,7 @@ import numpy as np
 
 from antidote_tpu.clocks import dense
 from antidote_tpu.mat import kernels
+from antidote_tpu.obs.prof import kernel_span
 
 # packed op-tensor columns (OR-Set): scalars, then obs VV, then op SS
 _ELEM, _ISADD, _DOTDC, _DOTSEQ, _OPDC, _OPCT, _NSCAL = 0, 1, 2, 3, 4, 5, 6
@@ -199,6 +200,7 @@ def orset_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
     )
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def orset_append(
     st: OrsetShardState,
@@ -243,9 +245,11 @@ def _orset_gc_impl(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
 
 #: the same fold WITHOUT donation — orset_gc_full's jnp path, so its
 #: flag-independent contract ("st stays valid") holds on every path
-_orset_gc_nodonate = jax.jit(_orset_gc_impl)
+_orset_gc_nodonate = kernel_span("mat.store", name="orset_gc_nodonate")(
+    jax.jit(_orset_gc_impl))
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def orset_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
     """Fold every ring op with commit VC <= GST into the base snapshot
@@ -263,6 +267,7 @@ def orset_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
     return _orset_gc_impl(st, gst)
 
 
+@kernel_span("mat.store")
 @jax.jit
 def orset_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
     """bool[K, E]: element presence for every key at ``read_vc`` in one
@@ -402,6 +407,7 @@ def orset_gc_full(st: OrsetShardState, gst: jax.Array,
     )
 
 
+@kernel_span("mat.store")
 @jax.jit
 def orset_read_keys(st: OrsetShardState, key_idx: jax.Array,
                     read_vc: jax.Array) -> jax.Array:
@@ -422,6 +428,7 @@ def orset_read_keys(st: OrsetShardState, key_idx: jax.Array,
         mask)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def orset_purge_keys(st: OrsetShardState,
                      key_idx: jax.Array) -> OrsetShardState:
@@ -489,6 +496,7 @@ def orset_grow(st: OrsetShardState, n_keys: int | None = None,
 # contributes only its observed VV).
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def mvreg_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
     """Fold stable assigns into the base dot table (same stability
@@ -506,6 +514,7 @@ def mvreg_gc(st: OrsetShardState, gst: jax.Array) -> OrsetShardState:
     )
 
 
+@kernel_span("mat.store")
 @jax.jit
 def mvreg_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
     """int[K, E, D]: live value-slot dot tables at ``read_vc``."""
@@ -519,6 +528,7 @@ def mvreg_read(st: OrsetShardState, read_vc: jax.Array) -> jax.Array:
         st.dots, st.elem_slot, st.dot_dc, st.dot_seq, st.obs_vv, mask)
 
 
+@kernel_span("mat.store")
 @jax.jit
 def mvreg_read_keys(st: OrsetShardState, key_idx: jax.Array,
                     read_vc: jax.Array) -> jax.Array:
@@ -621,6 +631,7 @@ def lww_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
     )
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def lww_append(st: LwwShardState, key_idx, lane_off, ts, tie, val,
                op_dc, op_ct, op_ss, active: jax.Array | None = None):
@@ -632,6 +643,7 @@ def lww_append(st: LwwShardState, key_idx, lane_off, ts, tie, val,
     return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def lww_gc(st: LwwShardState, gst: jax.Array) -> LwwShardState:
     cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
@@ -648,6 +660,7 @@ def lww_gc(st: LwwShardState, gst: jax.Array) -> LwwShardState:
     )
 
 
+@kernel_span("mat.store")
 @jax.jit
 def lww_read(st: LwwShardState, read_vc: jax.Array):
     """(ts, tie, val)[K] at ``read_vc``."""
@@ -662,6 +675,7 @@ def lww_read(st: LwwShardState, read_vc: jax.Array):
         st.op_ts, st.op_tie, st.op_val, mask)
 
 
+@kernel_span("mat.store")
 @jax.jit
 def lww_read_keys(st: LwwShardState, key_idx: jax.Array,
                   read_vc: jax.Array):
@@ -673,6 +687,7 @@ def lww_read_keys(st: LwwShardState, key_idx: jax.Array,
         ops[..., _LTS], ops[..., _LTIE], ops[..., _LVAL], mask)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def lww_purge_keys(st: LwwShardState, key_idx: jax.Array) -> LwwShardState:
     L = st.n_lanes
@@ -865,6 +880,7 @@ def rwset_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
     )
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def rwset_append(st: RwsetShardState, key_idx, lane_off, elem_slot, kind,
                  dot_dc, dot_seq, obs_add, obs_rmv, op_dc, op_ct, op_ss,
@@ -879,6 +895,7 @@ def rwset_append(st: RwsetShardState, key_idx, lane_off, elem_slot, kind,
     return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def rwset_gc(st: RwsetShardState, gst: jax.Array) -> RwsetShardState:
     """Fold stable ops into the base planes (orset_gc stability
@@ -898,6 +915,7 @@ def rwset_gc(st: RwsetShardState, gst: jax.Array) -> RwsetShardState:
     )
 
 
+@kernel_span("mat.store")
 @jax.jit
 def rwset_read(st: RwsetShardState, read_vc: jax.Array):
     """(adds, rmvs)[K, E, D]: live dot tables for every key at
@@ -913,6 +931,7 @@ def rwset_read(st: RwsetShardState, read_vc: jax.Array):
         st.obs_add, st.obs_rmv, mask)
 
 
+@kernel_span("mat.store")
 @jax.jit
 def rwset_read_keys(st: RwsetShardState, key_idx: jax.Array,
                     read_vc: jax.Array):
@@ -928,6 +947,7 @@ def rwset_read_keys(st: RwsetShardState, key_idx: jax.Array,
         ops[..., _RNSCAL + d:_RNSCAL + 2 * d], mask)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def rwset_purge_keys(st: RwsetShardState,
                      key_idx: jax.Array) -> RwsetShardState:
@@ -1044,6 +1064,7 @@ def setgo_shard_init(n_keys: int, n_lanes: int, n_slots: int, n_dcs: int,
     )
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def setgo_append(st: SetGoShardState, key_idx, lane_off, elem_slot,
                  op_dc, op_ct, op_ss, active: jax.Array | None = None):
@@ -1055,6 +1076,7 @@ def setgo_append(st: SetGoShardState, key_idx, lane_off, elem_slot,
     return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def setgo_gc(st: SetGoShardState, gst: jax.Array) -> SetGoShardState:
     cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
@@ -1069,6 +1091,7 @@ def setgo_gc(st: SetGoShardState, gst: jax.Array) -> SetGoShardState:
     )
 
 
+@kernel_span("mat.store")
 @jax.jit
 def setgo_read_keys(st: SetGoShardState, key_idx: jax.Array,
                     read_vc: jax.Array) -> jax.Array:
@@ -1079,6 +1102,7 @@ def setgo_read_keys(st: SetGoShardState, key_idx: jax.Array,
         st.present[key_idx], ops[..., _GELEM], mask)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def setgo_purge_keys(st: SetGoShardState,
                      key_idx: jax.Array) -> SetGoShardState:
@@ -1193,6 +1217,7 @@ def counter_shard_init(n_keys: int, n_lanes: int, n_dcs: int,
     )
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def counter_append(st: CounterShardState, key_idx, lane_off, delta,
                    op_dc, op_ct, op_ss,
@@ -1207,6 +1232,7 @@ def counter_append(st: CounterShardState, key_idx, lane_off, delta,
     return _scatter_rows(st, key_idx, lane_off, rows, active)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def counter_gc(st: CounterShardState, gst: jax.Array) -> CounterShardState:
     cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
@@ -1221,6 +1247,7 @@ def counter_gc(st: CounterShardState, gst: jax.Array) -> CounterShardState:
     )
 
 
+@kernel_span("mat.store")
 @jax.jit
 def counter_read(st: CounterShardState, read_vc: jax.Array) -> jax.Array:
     """int[K]: counter values at ``read_vc``."""
@@ -1233,6 +1260,7 @@ def counter_read(st: CounterShardState, read_vc: jax.Array) -> jax.Array:
     return kernels.counter_read(st.value, st.delta, mask)
 
 
+@kernel_span("mat.store")
 @jax.jit
 def counter_read_keys(st: CounterShardState, key_idx: jax.Array,
                       read_vc: jax.Array) -> jax.Array:
@@ -1243,6 +1271,7 @@ def counter_read_keys(st: CounterShardState, key_idx: jax.Array,
     return kernels.counter_read(st.value[key_idx], ops[..., _CDELTA], mask)
 
 
+@kernel_span("mat.store")
 @partial(jax.jit, donate_argnums=(0,))
 def counter_purge_keys(st: CounterShardState,
                        key_idx: jax.Array) -> CounterShardState:

@@ -15,6 +15,11 @@ halves natively for the TPU serving stack:
   txn aborts, error-monitor trips, and probe violations.
 - :mod:`antidote_tpu.obs.probe` — online self-checks (the set_aw
   read-inclusion probe chasing the VERDICT round-5 transient miss).
+- :mod:`antidote_tpu.obs.prof` — the device-plane profiler (ISSUE 2):
+  kernel spans over the jitted mat/ and interdc entry points,
+  compile-cache-miss counters, device-buffer high-watermarks, and the
+  XProf capture API (absorbed from ``antidote_tpu/tracing.py``, which
+  remains a re-export shim).
 
 Everything here is process-global, mirroring ``stats.registry`` (the
 reference's metrics are BEAM-node-global the same way): all DCs in a
@@ -25,17 +30,19 @@ process share one tracer and one recorder, and the exporter surfaces
 from __future__ import annotations
 
 from antidote_tpu.obs.events import FlightRecorder, recorder  # noqa: F401
+from antidote_tpu.obs.prof import DeviceProfiler, profiler  # noqa: F401
 from antidote_tpu.obs.spans import Span, Tracer, tracer  # noqa: F401
 
 
 def configure(sample_rate: float | None = None,
               capacity: int | None = None,
               dump_dir: str | None = None,
-              selfcheck_set_aw: float | None = None) -> None:
-    """Apply config knobs to the process-global tracer/recorder/probe
-    (Node.__init__ forwards Config.trace_sample_rate & friends here).
-    ``None`` leaves a setting untouched, so tests and operators can
-    override a single knob without reciting the rest."""
+              selfcheck_set_aw: float | None = None,
+              kernel_profile: bool | None = None) -> None:
+    """Apply config knobs to the process-global tracer/recorder/probe/
+    profiler (Node.__init__ forwards Config.trace_sample_rate & friends
+    here).  ``None`` leaves a setting untouched, so tests and operators
+    can override a single knob without reciting the rest."""
     from antidote_tpu.obs import probe as _probe
 
     if sample_rate is not None:
@@ -46,3 +53,5 @@ def configure(sample_rate: float | None = None,
         recorder.dump_dir = dump_dir
     if selfcheck_set_aw is not None:
         _probe.SELF_CHECK_RATE = float(selfcheck_set_aw)
+    if kernel_profile is not None:
+        profiler.configure(enabled=bool(kernel_profile))

@@ -58,6 +58,11 @@ class FlightRecorder:
         self._last_dump: Dict[str, float] = {}
         #: paths written by dump(), oldest first (tests assert on it)
         self.dumps: List[str] = []
+        #: per-subsystem count of events evicted by ring overflow —
+        #: /healthz surfaces it so a flooded ring (events silently
+        #: falling out before the dump that needs them) is visible
+        #: BEFORE a forensic dump comes back empty
+        self._dropped: Dict[str, int] = {}
 
     # ------------------------------------------------------------ recording
 
@@ -69,6 +74,10 @@ class FlightRecorder:
             with self._lock:
                 ring = self._rings.setdefault(
                     subsystem, deque(maxlen=self.capacity))
+        if len(ring) == ring.maxlen:
+            # racy under threads, but a lock here would tax every hot-
+            # path record for a diagnostic that only needs magnitude
+            self._dropped[subsystem] = self._dropped.get(subsystem, 0) + 1
         ring.append((time.time_ns() // 1000, kind, fields))
 
     # -------------------------------------------------------------- queries
@@ -99,11 +108,23 @@ class FlightRecorder:
             for name, ring in rings.items()
         }
 
+    def drop_counts(self) -> Dict[str, int]:
+        """{subsystem: events evicted by ring overflow} since start/clear
+        (the /healthz ring-occupancy signal)."""
+        return dict(self._dropped)
+
+    def ring_fill(self) -> Dict[str, float]:
+        """{subsystem: fill fraction 0..1} of each ring."""
+        with self._lock:
+            return {name: len(ring) / (ring.maxlen or 1)
+                    for name, ring in self._rings.items()}
+
     def clear(self) -> None:
         with self._lock:
             self._rings.clear()
             self._last_dump.clear()
             self.dumps.clear()
+            self._dropped.clear()
 
     def last_dump_age_s(self) -> float:
         """Seconds since the most recent dump under ANY reason (inf if

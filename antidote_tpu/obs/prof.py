@@ -1,0 +1,405 @@
+"""Device-plane profiler — the kernel-span layer over the jitted hot
+paths, plus the XProf capture API (absorbed from antidote_tpu/tracing.py
+so the process has ONE tracing namespace; tracing.py remains a
+re-export shim for existing imports).
+
+PR 1 made the *host* planes observable (txid spans, flight recorder,
+stage histograms); the fused XLA/Pallas programs in antidote_tpu/mat/
+stayed a black box.  This module closes that gap in the Dapper spirit
+of always-on, sampled production profiling:
+
+- **Kernel spans** — every jitted entry point of the materializer,
+  sharded store, and dependency gate is wrapped (``@kernel_span`` at
+  the definition, or :meth:`DeviceProfiler.wrap` around dynamically
+  built jits).  Each call records dispatch wall time; when the call
+  runs under a *sampled* txn span (obs/spans.py) or an active capture,
+  completion is also measured honestly — a scalar device→host fetch,
+  the benches/_util.py methodology (``block_until_ready`` does not
+  block through the remote-TPU tunnel) — and a ``kernel:*`` child-span
+  joins the transaction's trace tree.
+- **Compile-cache-miss counters** — keyed by function + abstract shape
+  signature (shapes/dtypes of array leaves, values of static scalars),
+  so a recompilation storm is attributable to the kernel and shape
+  that minted it instead of showing up as an anonymous p99 spike.
+- **Device-buffer census** — per-subsystem high-watermark gauges over
+  the LARGEST single state pytree any of the subsystem's kernels has
+  returned (a lower bound on its footprint — several plane states
+  co-reside; the global ``jax.live_arrays()`` census in
+  :meth:`DeviceProfiler.snapshot`, served by stats.py's
+  ``/debug/prof``, is the total).
+- **Capture unification** — when an XProf window is open
+  (:func:`profile`/:func:`start`), every wrapped kernel call is
+  additionally bracketed by a ``jax.profiler.TraceAnnotation`` carrying
+  the kernel name and the active txid, so the device timeline reads
+  "kernel:orset_read_keys[txid=...]" instead of anonymous XLA modules.
+
+Cost discipline: with ``profiler.enabled`` False every hook is a single
+attribute check + passthrough (no tree flattening, no jnp ops, zero
+new compile-cache entries — tests/unit/test_obs_prof.py pins this).
+Enabled (the default), the per-call cost is a few µs of host
+bookkeeping on *batch-level* dispatches; the completion fetch happens
+only for sampled txns, ``detail`` mode, or open captures.  Calls made
+while a jit trace is being staged (a wrapped store fn composed into
+fused_read / shard_map bodies) pass straight through — timing a trace
+would record compilation, not execution.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from antidote_tpu.obs.spans import tracer
+
+# ------------------------------------------------------------------ capture
+# (moved verbatim from antidote_tpu/tracing.py — one capture at a time,
+# mirroring jax.profiler's own constraint)
+
+_capture_lock = threading.Lock()
+_active_dir: Optional[str] = None
+
+
+def annotate(name: str):
+    """Context manager labeling the enclosed host+device work in a
+    profiler capture; no-op cost when no capture is active."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def profile(log_dir: str):
+    """Capture a JAX profiler trace of the enclosed block into
+    ``log_dir`` (inspect with TensorBoard's profile plugin / XProf)."""
+    start(log_dir)
+    try:
+        yield log_dir
+    finally:
+        stop()
+
+
+def start(log_dir: str) -> None:
+    """Begin a capture (idempotent per process: one capture at a time).
+    While the window is open, wrapped kernel calls auto-annotate the
+    device timeline with their name and active txid."""
+    global _active_dir
+    import jax
+
+    with _capture_lock:
+        if _active_dir is not None:
+            raise RuntimeError(
+                f"profiler already capturing to {_active_dir}")
+        jax.profiler.start_trace(log_dir)
+        _active_dir = log_dir
+
+
+def stop() -> str:
+    """End the capture; returns the trace directory."""
+    global _active_dir
+    import jax
+
+    with _capture_lock:
+        if _active_dir is None:
+            raise RuntimeError("no profiler capture active")
+        jax.profiler.stop_trace()
+        out, _active_dir = _active_dir, None
+        return out
+
+
+def active_dir() -> Optional[str]:
+    return _active_dir
+
+
+# --------------------------------------------------------- kernel-span layer
+
+_trace_clean_fn: Optional[Callable[[], bool]] = None
+
+
+def _trace_clean() -> bool:
+    """True when no jax trace is being staged on this thread — wrapped
+    kernels called *inside* another jit's trace (fused_read bodies,
+    shard_map locals) must pass through untimed."""
+    global _trace_clean_fn
+    if _trace_clean_fn is None:
+        try:
+            from jax.core import trace_state_clean as fn
+        except Exception:  # pragma: no cover — very old/absent jax
+            fn = lambda: True  # noqa: E731
+        _trace_clean_fn = fn
+    return _trace_clean_fn()
+
+
+def _sig(args: tuple, kwargs: dict) -> tuple:
+    """Abstract-shape signature of a call: (shape, dtype) per array
+    leaf, the value itself for Python scalars.  Value-keying scalars is
+    right for THIS codebase's wrapped kernels, where a raw Python
+    scalar only ever reaches a jit as a static arg (pallas block_k /
+    interpret, rga_merge actor_bits — distinct values mint distinct
+    programs); a kernel taking a *traced* Python scalar would have its
+    misses overcounted, which the per-kernel signature cap below
+    bounds."""
+    import jax
+
+    out = []
+    for x in jax.tree_util.tree_leaves((args, kwargs)):
+        if x is None or isinstance(x, (bool, int, float, str)):
+            out.append(("static", x))
+        else:
+            out.append((tuple(getattr(x, "shape", ())),
+                        str(getattr(x, "dtype", ""))))
+    return tuple(out)
+
+
+def _force(out) -> bool:
+    """Honest completion barrier: device→host fetch of ONE scalar of
+    the result (benches/_util.py fetch) — the only completion clock
+    that works through the remote-TPU tunnel.  Returns False when the
+    result holds no fetchable array (pure-host outputs)."""
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        shape = getattr(leaf, "shape", None)
+        if shape is None or not hasattr(leaf, "dtype"):
+            continue
+        if any(s == 0 for s in shape):
+            continue
+        idx = tuple(0 for _ in shape)
+        np.asarray(leaf[idx] if shape else leaf)
+        return True
+    return False
+
+
+def _nbytes(out) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(out):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+#: per-kernel signature-set bound: past this the set clears en masse
+#: (the spans decision-cache idiom) — the miss counter may then
+#: recount old shapes, but a long-running node cannot grow host memory
+#: without bound when a kernel's signature space is large
+_SHAPES_CAP = 1024
+
+
+class _KernelStat:
+    """Aggregate for one wrapped kernel (mutated under the profiler
+    lock; snapshot() copies the scalars out)."""
+
+    __slots__ = ("subsystem", "calls", "dispatch_s", "complete_s",
+                 "completions", "compile_misses", "shapes",
+                 "bytes_out_hwm", "last_call_us")
+
+    def __init__(self, subsystem: str):
+        self.subsystem = subsystem
+        self.calls = 0
+        self.dispatch_s = 0.0
+        self.complete_s = 0.0
+        self.completions = 0
+        self.compile_misses = 0
+        self.shapes: set = set()
+        self.bytes_out_hwm = 0
+        self.last_call_us = 0
+
+
+class DeviceProfiler:
+    """Process-global kernel profiler (all DCs share it, like
+    stats.registry and obs.spans.tracer)."""
+
+    def __init__(self):
+        #: master switch — False makes every wrapped call a bare
+        #: passthrough (Config.kernel_profile via obs.configure)
+        self.enabled = True
+        #: honest completion fetch on EVERY call, not just sampled
+        #: ones — bench/diagnosis mode, too heavy for serving
+        self.detail = False
+        self._stats: Dict[str, _KernelStat] = {}
+        self._subsys_hwm: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------- configuration
+
+    def configure(self, enabled: Optional[bool] = None,
+                  detail: Optional[bool] = None) -> None:
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if detail is not None:
+            self.detail = bool(detail)
+
+    def reset(self) -> None:
+        """Drop all aggregates (test isolation)."""
+        with self._lock:
+            self._stats.clear()
+            self._subsys_hwm.clear()
+
+    # ------------------------------------------------------------- wrapping
+
+    def wrap(self, fn, name: Optional[str] = None,
+             subsystem: str = "mat"):
+        """Wrap a jitted callable in the kernel-span layer.  Semantics
+        are preserved exactly (args pass through, donation and
+        exceptions included); ``__name__`` is kept so callers that key
+        caches on it (device_plane._FUSED_CACHE) see no change."""
+        kname = name or getattr(fn, "__name__", repr(fn))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not self.enabled or not _trace_clean():
+                return fn(*args, **kwargs)
+            return self._call(fn, kname, subsystem, args, kwargs)
+
+        wrapper.__kernel_span__ = (kname, subsystem)
+        return wrapper
+
+    def _stat(self, kname: str, subsystem: str) -> _KernelStat:
+        st = self._stats.get(kname)
+        if st is None:
+            with self._lock:
+                st = self._stats.setdefault(kname, _KernelStat(subsystem))
+        return st
+
+    def _call(self, fn, kname: str, subsystem: str, args, kwargs):
+        from antidote_tpu import stats as _stats
+
+        reg = _stats.registry
+        st = self._stat(kname, subsystem)
+        # the underlying jit object's id joins the key: several distinct
+        # programs can share one kernel NAME (fused_read's per-pattern
+        # jits, _sm's per-instance shard_maps), and same-shape calls of
+        # a DIFFERENT program are still fresh XLA compiles (id reuse
+        # after a dropped jit is GC'd can undercount — acceptable for a
+        # storm detector)
+        sig = (id(fn),) + _sig(args, kwargs)
+        if sig not in st.shapes:
+            # first call at a new abstract shape = a jit compile-cache
+            # miss for this kernel (jax specializes per shape); counting
+            # here attributes a recompilation storm to its source
+            with self._lock:
+                if sig not in st.shapes:
+                    if len(st.shapes) >= _SHAPES_CAP:
+                        st.shapes.clear()
+                    st.shapes.add(sig)
+                    st.compile_misses += 1
+                    reg.kernel_compile_misses.inc(kernel=kname)
+        cur = tracer.current()
+        cap = _active_dir is not None
+        t0_us = time.time_ns() // 1000
+        t0 = time.perf_counter()
+        if cap:
+            label = f"kernel:{kname}"
+            if cur is not None and cur.txid is not None:
+                label += f"[txid={cur.txid!r}]"
+            with annotate(label):
+                out = fn(*args, **kwargs)
+        else:
+            out = fn(*args, **kwargs)
+        dispatch = time.perf_counter() - t0
+        dur = dispatch
+        completed = False
+        if cur is not None or cap or self.detail:
+            completed = _force(out)
+            if completed:
+                dur = time.perf_counter() - t0
+        nb = _nbytes(out)
+        with self._lock:
+            st.calls += 1
+            st.dispatch_s += dispatch
+            st.last_call_us = t0_us
+            if completed:
+                st.completions += 1
+                st.complete_s += dur
+            if nb > st.bytes_out_hwm:
+                st.bytes_out_hwm = nb
+            if nb > self._subsys_hwm.get(subsystem, 0):
+                self._subsys_hwm[subsystem] = nb
+                reg.device_buffer_hwm.set(nb, subsystem=subsystem)
+        reg.kernel_calls.inc(kernel=kname, subsystem=subsystem)
+        reg.kernel_dispatch_latency.observe(dispatch)
+        if completed:
+            reg.kernel_complete_latency.observe(dur)
+        if cur is not None:
+            tracer.record_span(
+                f"kernel:{kname}", "kernel", cur.txid, t0_us,
+                int(dur * 1e6), parent_id=cur.span_id,
+                subsystem=subsystem, complete=completed)
+        return out
+
+    # -------------------------------------------------------------- queries
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready profiler state — the /debug/prof body."""
+        with self._lock:
+            kernels = {
+                name: {
+                    "subsystem": st.subsystem,
+                    "calls": st.calls,
+                    "compile_misses": st.compile_misses,
+                    "dispatch_total_s": round(st.dispatch_s, 6),
+                    "dispatch_mean_s": round(
+                        st.dispatch_s / st.calls, 9) if st.calls else 0.0,
+                    "completions": st.completions,
+                    "complete_mean_s": round(
+                        st.complete_s / st.completions, 9)
+                    if st.completions else None,
+                    "bytes_out_hwm": st.bytes_out_hwm,
+                    "last_call_us": st.last_call_us,
+                }
+                for name, st in self._stats.items()
+            }
+            subsys = dict(self._subsys_hwm)
+        return {
+            "enabled": self.enabled,
+            "detail": self.detail,
+            "capture_dir": _active_dir,
+            "kernels": kernels,
+            "subsystem_bytes_hwm": subsys,
+            "live_buffers": self._census(),
+        }
+
+    @staticmethod
+    def _census() -> Optional[Dict[str, int]]:
+        """Global live-device-buffer census.  Only runs when jax is
+        already imported (never drags the runtime in from an endpoint)
+        and degrades to None on any failure — a diagnostic read must
+        not take the server down."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None or not hasattr(jax, "live_arrays"):
+            return None
+        try:
+            arrs = jax.live_arrays()
+            return {"count": len(arrs),
+                    "bytes": int(sum(int(getattr(a, "nbytes", 0) or 0)
+                                     for a in arrs))}
+        except Exception:  # noqa: BLE001 — census is best-effort
+            return None
+
+
+#: process-wide profiler (all DCs share it, like stats.registry)
+profiler = DeviceProfiler()
+
+
+def kernel_span(subsystem: str, name: Optional[str] = None):
+    """Decorator marking a jitted entry point as a profiled kernel —
+    the instrumentation idiom tools/trace_lint.py enforces on every
+    public ``@jax.jit`` function under antidote_tpu/mat/::
+
+        @kernel_span("mat.store")
+        @partial(jax.jit, donate_argnums=(0,))
+        def orset_append(...): ...
+    """
+
+    def deco(fn):
+        return profiler.wrap(fn, name=name, subsystem=subsystem)
+
+    return deco

@@ -114,15 +114,19 @@ class _LiveSpan:
         stack = getattr(_tls, "stack", None)
         if stack is None:
             stack = _tls.stack = []
-        self._parent = stack[-1] if stack else None
-        stack.append(self.span_id)
+        # the stack holds the LIVE span objects (not bare ids): the
+        # kernel-span layer (obs/prof.py) reads the innermost open
+        # span's txid/span_id via Tracer.current to attach device
+        # kernels to the active txn's tree
+        self._parent = stack[-1].span_id if stack else None
+        stack.append(self)
         self._start_ns = time.time_ns()
         return self
 
     def __exit__(self, *exc):
         dur_us = (time.time_ns() - self._start_ns) // 1000
         stack = getattr(_tls, "stack", None)
-        if stack and stack[-1] == self.span_id:
+        if stack and stack[-1] is self:
             stack.pop()
         self._tracer._add(Span(
             self.span_id, self._parent, self.name, self.cat, self.txid,
@@ -159,6 +163,11 @@ class Tracer:
         self._decision_cache.clear()
 
     # -------------------------------------------------------- configuration
+
+    @property
+    def capacity(self) -> int:
+        """Ring capacity (the /healthz occupancy denominator)."""
+        return self._capacity
 
     def set_capacity(self, capacity: int) -> None:
         if capacity == self._capacity:
@@ -217,9 +226,32 @@ class Tracer:
             return
         stack = getattr(_tls, "stack", None)
         self._add(Span(
-            next(_SPAN_IDS), stack[-1] if stack else None, name, cat,
-            txid, time.time_ns() // 1000, 0, threading.get_ident(),
+            next(_SPAN_IDS), stack[-1].span_id if stack else None, name,
+            cat, txid, time.time_ns() // 1000, 0, threading.get_ident(),
             args))
+
+    def current(self):
+        """The calling thread's innermost OPEN span, or None.  Only
+        call sites that passed the sampling decision push onto the
+        stack (unsampled sites get the shared null context), so a
+        non-None result means "this call chain is being traced" — the
+        hook the kernel-span layer (obs/prof.py) uses to decide whether
+        to time completion and attach a kernel child-span."""
+        stack = getattr(_tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def record_span(self, name: str, cat: str, txid, start_us: int,
+                    dur_us: int, parent_id: Optional[int] = None,
+                    **args) -> None:
+        """Record an externally timed, already-finished span — the
+        kernel-span layer measures dispatch→completion itself (a
+        perf_counter pair around the XLA call) and deposits the result
+        here, parented under the enclosing live span so kernels appear
+        as children in the txn tree.  No sampling check: callers gate
+        on :meth:`current`, which already encodes the decision."""
+        self._add(Span(
+            next(_SPAN_IDS), parent_id, name, cat, txid, int(start_us),
+            int(dur_us), threading.get_ident(), args))
 
     def _add(self, span: Span) -> None:
         with self._lock:

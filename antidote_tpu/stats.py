@@ -234,13 +234,47 @@ class Registry:
             "as observed by each local DC (the registry is process-"
             "global and a process may host several DCs)",
             labels=("dc", "peer"))
+        # ---- kernel-span layer (ISSUE 2, antidote_tpu/obs/prof.py):
+        # per-kernel device-plane timing, compile-cache misses, and the
+        # buffer census.  Dispatch buckets reach down to 10 µs (a warm
+        # dispatch is host-side only); the completion histogram shares
+        # the stage-latency bucket ladder.
+        self.kernel_dispatch_latency = Histogram(
+            "antidote_kernel_dispatch_latency_seconds",
+            "Host wall time to dispatch one profiled device kernel "
+            "(async: excludes device execution)",
+            buckets=(0.00001, 0.0001, 0.0005, 0.001, 0.005, 0.01,
+                     0.05, 0.1, 0.5, 1.0, 5.0))
+        self.kernel_complete_latency = Histogram(
+            "antidote_kernel_complete_latency_seconds",
+            "Dispatch-to-completion wall time of profiled kernels, "
+            "measured by a scalar device->host fetch (sampled txns, "
+            "detail mode, and open captures only)", buckets=lat_buckets)
+        self.kernel_calls = Counter(
+            "antidote_kernel_calls_total",
+            "Profiled device-kernel dispatches",
+            labels=("kernel", "subsystem"))
+        self.kernel_compile_misses = Counter(
+            "antidote_kernel_compile_cache_misses_total",
+            "First dispatches at a new abstract shape per kernel (each "
+            "one is an XLA compile; a storm here explains p99 spikes)",
+            labels=("kernel",))
+        self.device_buffer_hwm = LabeledGauge(
+            "antidote_device_buffer_bytes_high_watermark",
+            "High-watermark of the LARGEST single state pytree a "
+            "subsystem's kernels have returned (a lower bound on its "
+            "footprint; /debug/prof's live-buffer census is the total)",
+            labels=("subsystem",))
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
                 self.aborted_transactions, self.operations,
                 self.commit_latency, self.log_append_latency,
                 self.device_flush_latency, self.device_read_latency,
-                self.depgate_wait, self.replication_lag)
+                self.depgate_wait, self.replication_lag,
+                self.kernel_dispatch_latency, self.kernel_complete_latency,
+                self.kernel_calls, self.kernel_compile_misses,
+                self.device_buffer_hwm)
 
     def exposition(self) -> str:
         lines = []
@@ -459,6 +493,13 @@ class MetricsServer:
 
                     body = tracer.export_chrome_json().encode()
                     ctype = "application/json"
+                elif path == "/debug/prof":
+                    import json as _json
+
+                    from antidote_tpu.obs.prof import profiler
+
+                    body = _json.dumps(profiler.snapshot()).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -479,18 +520,27 @@ class MetricsServer:
 
     def healthz(self) -> str:
         """Liveness JSON: serving + a shallow state summary (span ring
-        depth, flight-recorder dump count, open txns)."""
+        depth + occupancy, flight-recorder dump/drop counts, open
+        txns).  Ring occupancy makes a flooded ring visible BEFORE the
+        forensic dump that needed its events comes back empty."""
         import json
 
         from antidote_tpu.obs.events import recorder as _rec
         from antidote_tpu.obs.spans import tracer as _tr
 
+        cap = _tr.capacity
+        drops = _rec.drop_counts()
         return json.dumps({
             "status": "ok",
             "open_transactions": self.registry.open_transactions.value(),
             "error_count": self.registry.error_count.value(),
             "spans_buffered": len(_tr),
+            "span_ring_capacity": cap,
+            "span_ring_fill_pct": round(100.0 * len(_tr) / cap, 4)
+            if cap else 0.0,
             "flight_recorder_dumps": len(_rec.dumps),
+            "flight_recorder_dropped": drops,
+            "flight_recorder_dropped_total": sum(drops.values()),
         })
 
     def start(self) -> "MetricsServer":

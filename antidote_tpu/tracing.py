@@ -1,14 +1,9 @@
-"""Tracing / profiling — the SURVEY §5.1 first-class improvement.
+"""Re-export shim — the profile-capture API lives in
+:mod:`antidote_tpu.obs.prof` now (ISSUE 2: one tracing namespace, not
+two).  The capture functions, the kernel-span layer, and the txid span
+tree all share the obs/ package; this module survives only so existing
+imports (``from antidote_tpu import tracing``) keep working.
 
-The reference leans on BEAM tooling (observer, fprof) for runtime
-visibility; the TPU rebuild's hot paths are XLA programs, so the
-native story is the JAX profiler: capture a trace directory viewable
-in TensorBoard/XProf (device timelines, HLO cost attribution,
-host-side gaps), with the framework's hot operations labeled via
-trace annotations so a capture reads as "device_flush / device_gc /
-device_read / gate_fixpoint", not anonymous XLA modules.
-
-Usage:
     with tracing.profile("/tmp/trace"):        # capture a window
         ... run traffic ...
 
@@ -16,64 +11,15 @@ Usage:
     db.stop_profiling()
 
 Annotations are no-ops outside an active capture (TraceAnnotation is
-cheap), so they stay on permanently in the hot paths
-(antidote_tpu/mat/device_plane.py, antidote_tpu/interdc/dep.py).
+cheap), so they stay on permanently in the hot paths.
 """
 
 from __future__ import annotations
 
-import contextlib
-import threading
-
-_lock = threading.Lock()
-_active_dir: str | None = None
-
-
-def annotate(name: str):
-    """Context manager labeling the enclosed host+device work in a
-    profiler capture; no-op cost when no capture is active."""
-    import jax
-
-    return jax.profiler.TraceAnnotation(name)
-
-
-@contextlib.contextmanager
-def profile(log_dir: str):
-    """Capture a JAX profiler trace of the enclosed block into
-    ``log_dir`` (inspect with TensorBoard's profile plugin / XProf)."""
-    start(log_dir)
-    try:
-        yield log_dir
-    finally:
-        stop()
-
-
-def start(log_dir: str) -> None:
-    """Begin a capture (idempotent per process: one capture at a time,
-    mirroring jax.profiler's own constraint)."""
-    global _active_dir
-    import jax
-
-    with _lock:
-        if _active_dir is not None:
-            raise RuntimeError(
-                f"profiler already capturing to {_active_dir}")
-        jax.profiler.start_trace(log_dir)
-        _active_dir = log_dir
-
-
-def stop() -> str:
-    """End the capture; returns the trace directory."""
-    global _active_dir
-    import jax
-
-    with _lock:
-        if _active_dir is None:
-            raise RuntimeError("no profiler capture active")
-        jax.profiler.stop_trace()
-        out, _active_dir = _active_dir, None
-        return out
-
-
-def active_dir() -> str | None:
-    return _active_dir
+from antidote_tpu.obs.prof import (  # noqa: F401
+    active_dir,
+    annotate,
+    profile,
+    start,
+    stop,
+)

@@ -283,6 +283,8 @@ class Node:
              _obs_defaults.flight_recorder_dir),
             ("selfcheck_set_aw", self.config.obs_selfcheck_set_aw,
              _obs_defaults.obs_selfcheck_set_aw),
+            ("kernel_profile", self.config.kernel_profile,
+             _obs_defaults.kernel_profile),
         ) if v != d})
         from antidote_tpu.txn.manager import DeviceFlusher
 

@@ -1,16 +1,186 @@
-"""Run every BASELINE config; one JSON line each (config 2 = bench.py)."""
+"""Run every BASELINE config and persist the round's results.
 
+Historically each config printed one JSON line to stdout and nothing
+kept them — the bench "trajectory" was whatever scrollback survived.
+This runner still streams the per-config lines (config 2 = bench.py),
+but it also aggregates them into a schema-versioned, timestamped
+``BENCH_rNN.json`` next to the earlier rounds' files, together with
+the kernel-profile summary of the run (antidote_tpu/obs/prof.py) —
+the input ``tools/bench_gate.py`` diffs to fail loudly on regressions
+instead of silently drifting.
+
+Flags (beyond the configs' own ``--cpu`` / ``--quick``):
+- ``--dry-run``  skip the heavy configs entirely and emit a schema-
+  valid BENCH file with an empty metric set — the wiring check CI and
+  tests/unit/test_bench_gate.py use.
+- ``--out-dir``  where BENCH_rNN.json lands (default: the repo root,
+  beside the earlier rounds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import re
 import runpy
 import sys
+import time
+
+#: bump when the BENCH file layout changes; bench_gate refuses to
+#: compare files whose schema it does not know
+SCHEMA_VERSION = 1
+
+CONFIGS = ("benches.config1_counter", "bench", "benches.config3_mvreg",
+           "benches.config4_rga", "benches.config5_gst",
+           "benches.config6_txn")
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 
-def main():
-    for mod in ("benches.config1_counter", "bench",
-                "benches.config3_mvreg", "benches.config4_rga",
-                "benches.config5_gst", "benches.config6_txn"):
-        sys.stderr.write(f"== {mod}\n")
-        runpy.run_module(mod, run_name="__main__")
+class _Tee(io.TextIOBase):
+    """Stdout tee: the configs' JSON lines keep streaming to the real
+    stdout (operators watch them) while this captures them for the
+    aggregate file."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.lines: list = []
+        self._buf = ""
+
+    def write(self, s: str) -> int:
+        self.inner.write(s)
+        self._buf += s
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            self.lines.append(line)
+        return len(s)
+
+    def flush(self) -> None:
+        self.inner.flush()
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def next_round(out_dir: str) -> int:
+    """1 + the highest BENCH_rNN round already on disk (legacy driver
+    logs count too — the trajectory stays monotone)."""
+    best = 0
+    try:
+        names = os.listdir(out_dir)
+    except OSError:
+        names = []
+    for f in names:
+        m = _BENCH_RE.fullmatch(f)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best + 1
+
+
+def collect_metrics(lines) -> dict:
+    """{metric: {value, unit, vs_baseline, detail}} from the configs'
+    one-line JSON outputs (benches/_util.emit shape); non-JSON and
+    non-metric lines are ignored."""
+    metrics = {}
+    for ln in lines:
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "metric" in d and "value" in d:
+            metrics[str(d["metric"])] = {
+                k: d.get(k) for k in ("value", "unit", "vs_baseline",
+                                      "detail")}
+    return metrics
+
+
+def _kernel_profile() -> dict | None:
+    """The run's per-kernel profile (only meaningful after the configs
+    actually dispatched device work; None when obs never loaded)."""
+    try:
+        from antidote_tpu.obs.prof import profiler
+
+        snap = profiler.snapshot()
+        return snap if snap.get("kernels") else None
+    except Exception:  # noqa: BLE001 — the bench file must still write
+        return None
+
+
+def run(dry_run: bool = False, out_dir: str | None = None,
+        configs=None):
+    """Run the configs (unless ``dry_run``) and write BENCH_rNN.json;
+    returns (path, body).  ``configs`` defaults to CONFIGS at call
+    time (tests substitute a stub suite)."""
+    configs = CONFIGS if configs is None else configs
+    out_dir = out_dir or repo_root()
+    lines: list = []
+    failures: dict = {}
+    if not dry_run:
+        tee = _Tee(sys.stdout)
+        old, sys.stdout = sys.stdout, tee
+        try:
+            for mod in configs:
+                sys.stderr.write(f"== {mod}\n")
+                try:
+                    runpy.run_module(mod, run_name="__main__")
+                except SystemExit as e:  # a config's argparse/exit
+                    if e.code not in (None, 0):
+                        failures[mod] = f"exit {e.code}"
+                except Exception as e:  # noqa: BLE001 — one config's
+                    # crash must not lose the finished configs' rows
+                    failures[mod] = repr(e)
+                    sys.stderr.write(f"!! {mod} failed: {e!r}\n")
+        finally:
+            sys.stdout = old
+        if tee._buf:
+            lines = tee.lines + [tee._buf]
+        else:
+            lines = tee.lines
+    nn = next_round(out_dir)
+    body = {
+        "schema_version": SCHEMA_VERSION,
+        "round": nn,
+        "generated_at_us": time.time_ns() // 1000,
+        "argv": list(sys.argv[1:]),
+        "dry_run": bool(dry_run),
+        "metrics": collect_metrics(lines),
+        "failures": failures,
+        "kernel_profile": _kernel_profile(),
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"BENCH_r{nn:02d}.json")
+    with open(path, "w") as f:
+        json.dump(body, f, indent=1)
+    sys.stderr.write(f"== wrote {path} "
+                     f"({len(body['metrics'])} metrics)\n")
+    return path, body
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dry-run", action="store_true",
+                    help="skip the heavy configs; emit a schema-valid "
+                         "BENCH file with an empty metric set")
+    ap.add_argument("--out-dir", default=None,
+                    help="directory for BENCH_rNN.json (default: repo "
+                         "root)")
+    # configs read sys.argv themselves for --cpu/--quick — pass through
+    args, _rest = ap.parse_known_args(argv)
+    _path, body = run(dry_run=args.dry_run, out_dir=args.out_dir)
+    # fail loudly when a config crashed: the rows that DID finish are
+    # persisted above, but CI must not read a half-dead suite as green
+    if body["failures"]:
+        sys.stderr.write(f"== {len(body['failures'])} config(s) "
+                         f"failed: {sorted(body['failures'])}\n")
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -1,0 +1,250 @@
+"""Bench trajectory + regression gate (ISSUE 2): benches/run_all.py
+writes a schema-versioned BENCH_rNN.json, and tools/bench_gate.py
+passes on equal fixtures, fails on a fabricated 20% regression, and
+ignores legacy (un-versioned) round logs."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "tools"))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import bench_gate  # noqa: E402
+from benches import run_all  # noqa: E402
+
+
+def _bench_body(metrics, rnd=1):
+    return {
+        "schema_version": 1,
+        "round": rnd,
+        "generated_at_us": 0,
+        "argv": [],
+        "dry_run": False,
+        "metrics": metrics,
+        "failures": {},
+        "kernel_profile": None,
+    }
+
+
+_METRICS = {
+    "counter_pn_increments_per_sec_single_dc": {
+        "value": 1_000_000, "unit": "ops/s", "vs_baseline": 2.0,
+        "detail": {}},
+    "txn_p99_ms": {"value": 10.0, "unit": "ms", "vs_baseline": 1.0,
+                   "detail": {}},
+    "gst_rounds_to_convergence": {"value": 6, "unit": "rounds",
+                                  "vs_baseline": 1.0, "detail": {}},
+}
+
+
+def _write(tmp_path, rnd, metrics):
+    path = tmp_path / f"BENCH_r{rnd:02d}.json"
+    path.write_text(json.dumps(_bench_body(metrics, rnd)))
+    return str(path)
+
+
+# ------------------------------------------------------------------ gate
+
+
+def test_gate_passes_on_equal_fixtures(tmp_path, capsys):
+    _write(tmp_path, 1, _METRICS)
+    _write(tmp_path, 2, _METRICS)
+    assert bench_gate.main(["--root", str(tmp_path)]) == 0
+    assert "no headline metric regressed" in capsys.readouterr().out
+
+
+def test_gate_fails_on_20pct_throughput_regression(tmp_path, capsys):
+    _write(tmp_path, 1, _METRICS)
+    worse = json.loads(json.dumps(_METRICS))
+    worse["counter_pn_increments_per_sec_single_dc"]["value"] = 800_000
+    _write(tmp_path, 2, worse)
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    assert "REGRESSED counter_pn_increments_per_sec_single_dc" \
+        in capsys.readouterr().err
+
+
+def test_gate_fails_on_latency_rise_and_unit_directions(tmp_path):
+    _write(tmp_path, 1, _METRICS)
+    worse = json.loads(json.dumps(_METRICS))
+    worse["txn_p99_ms"]["value"] = 12.5   # +25% latency = regression
+    _write(tmp_path, 2, worse)
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    # raw direction rules
+    assert bench_gate.direction("ops/s") == 1
+    assert bench_gate.direction("ms") == -1
+    assert bench_gate.direction("rounds") == 0  # unknown: skipped
+
+
+def test_gate_ignores_improvements_and_unknown_units(tmp_path, capsys):
+    _write(tmp_path, 1, _METRICS)
+    better = json.loads(json.dumps(_METRICS))
+    better["counter_pn_increments_per_sec_single_dc"]["value"] = 2e6
+    better["txn_p99_ms"]["value"] = 1.0
+    better["gst_rounds_to_convergence"]["value"] = 60  # unknown unit
+    _write(tmp_path, 2, better)
+    assert bench_gate.main(["--root", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "improved" in out and "skipped" in out
+
+
+def test_gate_fails_when_a_metric_vanishes(tmp_path, capsys):
+    """A crashed config's headline metric disappearing from the new
+    round must fail the gate, not silently skip."""
+    _write(tmp_path, 1, _METRICS)
+    fewer = {k: v for k, v in _METRICS.items() if k != "txn_p99_ms"}
+    _write(tmp_path, 2, fewer)
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    assert "MISSING   txn_p99_ms" in capsys.readouterr().err
+
+
+def test_gate_fails_on_recorded_config_failures(tmp_path, capsys):
+    _write(tmp_path, 1, _METRICS)
+    body = _bench_body(_METRICS, 2)
+    body["failures"] = {"benches.config6_txn": "RuntimeError('boom')"}
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(body))
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    assert "CONFIG FAILED benches.config6_txn" \
+        in capsys.readouterr().err
+
+
+def test_gate_scan_skips_dry_run_files(tmp_path, capsys):
+    """Dry-run wiring checks (empty metrics) must not consume a diff
+    slot — the gate compares the newest two REAL rounds around them."""
+    _write(tmp_path, 1, _METRICS)
+    dry = _bench_body({}, 2)
+    dry["dry_run"] = True
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(dry))
+    worse = json.loads(json.dumps(_METRICS))
+    worse["counter_pn_increments_per_sec_single_dc"]["value"] = 700_000
+    _write(tmp_path, 3, worse)
+    # r02 (dry) skipped: r01 -> r03 diff sees the 30% regression
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    assert "BENCH_r01.json -> BENCH_r03.json" \
+        in capsys.readouterr().out
+
+
+def test_gate_ignores_legacy_unversioned_files(tmp_path, capsys):
+    # a legacy driver round log (no schema_version) must not be diffed
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": 5, "results": {"whatever": 1}}))
+    _write(tmp_path, 2, _METRICS)
+    assert bench_gate.main(["--root", str(tmp_path)]) == 0
+    assert "nothing to diff" in capsys.readouterr().out
+
+
+def test_gate_explicit_pair_and_bad_input(tmp_path, capsys):
+    a = _write(tmp_path, 1, _METRICS)
+    b = _write(tmp_path, 2, _METRICS)
+    assert bench_gate.main([a, b]) == 0
+    assert bench_gate.main([a]) == 2                      # not a pair
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text("{}")
+    assert bench_gate.main([a, str(legacy)]) == 2         # unversioned
+    capsys.readouterr()
+
+
+def test_gate_threshold_flag(tmp_path):
+    _write(tmp_path, 1, _METRICS)
+    worse = json.loads(json.dumps(_METRICS))
+    worse["counter_pn_increments_per_sec_single_dc"]["value"] = 900_000
+    _write(tmp_path, 2, worse)                            # -10%
+    assert bench_gate.main(["--root", str(tmp_path)]) == 0
+    assert bench_gate.main(
+        ["--root", str(tmp_path), "--threshold", "0.05"]) == 1
+
+
+# --------------------------------------------------------------- run_all
+
+
+def test_run_all_dry_run_emits_valid_bench_file(tmp_path):
+    path, body_ret = run_all.run(dry_run=True, out_dir=str(tmp_path))
+    assert os.path.basename(path) == "BENCH_r01.json"
+    body = json.load(open(path))
+    assert body["schema_version"] == run_all.SCHEMA_VERSION
+    assert body["dry_run"] is True
+    assert body["metrics"] == {} and body["failures"] == {}
+    # the gate understands the file it just wrote
+    assert bench_gate.load_bench(path)["round"] == 1
+
+
+def test_run_all_round_numbering_skips_existing(tmp_path):
+    # legacy and versioned rounds both advance the counter
+    (tmp_path / "BENCH_r07.json").write_text("{}")
+    path, _body = run_all.run(dry_run=True, out_dir=str(tmp_path))
+    assert os.path.basename(path) == "BENCH_r08.json"
+
+
+def test_run_all_aggregates_emitted_metric_lines(tmp_path, monkeypatch):
+    """A config module's emit() lines land in the BENCH file's metrics
+    map (exercised with a stub module instead of the heavy configs)."""
+    import types
+
+    stub = types.ModuleType("_bench_stub_config")
+    stub_src = (
+        "from benches._util import emit\n"
+        "emit('stub_ops_per_sec', 123456, 'ops/s', 1.5, detail_k=7)\n")
+    path = tmp_path / "_bench_stub_config.py"
+    path.write_text(stub_src)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    out, _body = run_all.run(dry_run=False, out_dir=str(tmp_path),
+                             configs=("_bench_stub_config",))
+    body = json.load(open(out))
+    m = body["metrics"]["stub_ops_per_sec"]
+    assert m["value"] == 123456 and m["unit"] == "ops/s"
+    assert m["vs_baseline"] == 1.5
+    assert m["detail"] == {"detail_k": 7}
+    assert body["failures"] == {}
+
+
+def test_run_all_records_config_failure_without_losing_rows(
+        tmp_path, monkeypatch):
+    ok = tmp_path / "_bench_ok_config.py"
+    ok.write_text("from benches._util import emit\n"
+                  "emit('ok_metric', 1, 'ops/s', 1.0)\n")
+    bad = tmp_path / "_bench_bad_config.py"
+    bad.write_text("raise RuntimeError('config exploded')\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    out, ret = run_all.run(dry_run=False, out_dir=str(tmp_path),
+                           configs=("_bench_ok_config",
+                                    "_bench_bad_config"))
+    body = json.load(open(out))
+    assert "ok_metric" in body["metrics"]
+    assert "_bench_bad_config" in body["failures"]
+    assert ret["failures"] == body["failures"]  # returned body matches disk
+
+
+def test_collect_metrics_skips_noise():
+    lines = ["not json", "{broken",
+             '{"metric": "m", "value": 2, "unit": "ops/s", '
+             '"vs_baseline": 1, "detail": {}}',
+             '{"other": "json"}']
+    out = run_all.collect_metrics(lines)
+    assert list(out) == ["m"] and out["m"]["value"] == 2
+
+
+def test_cli_dry_run_writes_to_out_dir(tmp_path):
+    assert run_all.main(["--dry-run", "--out-dir", str(tmp_path)]) == 0
+    files = [f for f in os.listdir(tmp_path) if f.startswith("BENCH_r")]
+    assert files, "no BENCH file written"
+
+
+def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
+    bad = tmp_path / "_bench_cli_bad_config.py"
+    bad.write_text("raise RuntimeError('cli boom')\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setattr(run_all, "CONFIGS", ("_bench_cli_bad_config",))
+    assert run_all.main(["--out-dir", str(tmp_path)]) == 1
+
+
+@pytest.mark.parametrize("unit,expect", [
+    ("ops/s", 1), ("txns/s", 1), ("merges/sec", 1),
+    ("s", -1), ("ms", -1), ("us", -1),
+    ("", 0), (None, 0), ("bytes", 0),
+])
+def test_direction_table(unit, expect):
+    assert bench_gate.direction(unit) == expect

@@ -28,7 +28,8 @@ def test_lint_detects_a_dark_entry_point(tmp_path):
                        .replace("@traced", "@_not_traced")
                        .replace("tracer.span", "tracer_span")
                        .replace("tracer.instant", "tracer_instant")
-                       .replace("tracing.annotate", "tracing_annotate"))
+                       .replace("tracing.annotate", "tracing_annotate")
+                       .replace("prof.annotate", "prof_annotate"))
     problems = trace_lint.lint(str(tmp_path))
     # every single entry point goes dark in the stripped copy
     n_points = sum(len(ms) for classes in trace_lint.ENTRY_POINTS.values()
@@ -38,3 +39,38 @@ def test_lint_detects_a_dark_entry_point(tmp_path):
 
 def test_standalone_main_exit_code():
     assert trace_lint.main([]) == 0
+
+
+def test_kernel_span_rule_flags_bare_jit(tmp_path):
+    """ISSUE 2 rule: a public @jax.jit function under antidote_tpu/mat/
+    without @kernel_span is flagged; private and decorated ones pass."""
+    d = tmp_path / "antidote_tpu" / "mat"
+    d.mkdir(parents=True)
+    (d / "newstore.py").write_text(
+        "import jax\n"
+        "from jax import jit\n"
+        "from functools import partial\n"
+        "from antidote_tpu.obs.prof import kernel_span\n"
+        "@jax.jit\n"
+        "def bare_read(st):\n    return st\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def bare_append(st):\n    return st\n"
+        "@jit\n"
+        "def bare_from_import(st):\n    return st\n"
+        "@partial(jit, donate_argnums=(0,))\n"
+        "def bare_from_import_partial(st):\n    return st\n"
+        "@jit(donate_argnums=(0,))\n"
+        "def bare_called_jit(st):\n    return st\n"
+        "@kernel_span('mat.store')\n"
+        "@jax.jit\n"
+        "def good_read(st):\n    return st\n"
+        "@jax.jit\n"
+        "def _private_impl(st):\n    return st\n")
+    problems = trace_lint.lint_kernel_spans(str(tmp_path))
+    flagged = {p.split("::")[1].split(":")[0] for p in problems}
+    assert flagged == {"bare_read", "bare_append", "bare_from_import",
+                       "bare_from_import_partial", "bare_called_jit"}
+
+
+def test_kernel_span_rule_clean_on_repo():
+    assert trace_lint.lint_kernel_spans(trace_lint.repo_root()) == []

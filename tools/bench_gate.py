@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""bench_gate — the continuous benchmark regression gate.
+
+Diffs the newest two schema-versioned ``BENCH_rNN.json`` files
+(written by ``benches/run_all.py``) and exits nonzero when any
+headline metric regressed by more than the threshold (default 15%),
+so a perf regression fails a run loudly instead of scrolling past.
+
+Direction is inferred from each metric's unit: throughput units
+("ops/s", "txns/s", anything ``*/s``) regress when the value DROPS;
+latency/duration units ("s", "ms", "us") regress when the value
+RISES.  Metrics with unknown units or non-positive baselines are
+reported as skipped, never failed — the gate only asserts what it
+can interpret.  But the gate DOES fail when the new round recorded
+config failures or LOST a metric the old round had: a crashed
+benchmark vanishing from the file is worse than a slowdown, not
+invisible.
+
+Legacy BENCH files (the pre-ISSUE-2 driver round logs, no
+``schema_version`` field) and dry-run wiring checks are ignored when
+scanning a directory.
+
+Usage:
+    python tools/bench_gate.py                     # newest two in repo
+    python tools/bench_gate.py OLD.json NEW.json   # explicit pair
+    python tools/bench_gate.py --threshold 0.10    # tighter gate
+
+Exit codes: 0 = no regression (or fewer than two comparable files),
+1 = at least one metric regressed past the threshold, 2 = bad input.
+
+Tier-1 coverage: tests/unit/test_bench_gate.py runs the gate over
+fixture files (equal pair passes, fabricated 20% regression fails).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.15
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: units whose value should not FALL (bigger is better)
+_HIGHER_BETTER_SUFFIXES = ("/s", "/sec")
+#: units whose value should not RISE (smaller is better)
+_LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec"}
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def direction(unit: Optional[str]) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (skip)."""
+    if not unit:
+        return 0
+    u = str(unit).strip().lower()
+    if any(u.endswith(sfx) for sfx in _HIGHER_BETTER_SUFFIXES):
+        return 1
+    if u in _LOWER_BETTER:
+        return -1
+    return 0
+
+
+def load_bench(path: str) -> Dict:
+    with open(path) as f:
+        body = json.load(f)
+    if not isinstance(body, dict) or "schema_version" not in body:
+        raise ValueError(
+            f"{path}: not a schema-versioned BENCH file (legacy driver "
+            "round log? regenerate with benches/run_all.py)")
+    if body["schema_version"] != 1:
+        raise ValueError(
+            f"{path}: unknown schema_version {body['schema_version']}")
+    return body
+
+
+def find_bench_files(root: str) -> List[Tuple[int, str]]:
+    """(round, path) of every schema-versioned, non-dry-run BENCH
+    file, ascending.  Dry-run files (the wiring check) carry no
+    metrics — diffing against one would vacuously pass two rounds."""
+    out = []
+    for f in sorted(os.listdir(root)):
+        m = _BENCH_RE.fullmatch(f)
+        if not m:
+            continue
+        path = os.path.join(root, f)
+        try:
+            body = load_bench(path)
+        except (ValueError, OSError):
+            continue  # legacy round logs / unreadable: not comparable
+        if body.get("dry_run"):
+            continue
+        out.append((int(m.group(1)), path))
+    out.sort()
+    return out
+
+
+def compare(old: Dict, new: Dict,
+            threshold: float = DEFAULT_THRESHOLD):
+    """(regressions, improvements, skipped, missing) between two BENCH
+    bodies.
+
+    Each regression/improvement entry: (metric, old_value, new_value,
+    signed_change) where signed_change is the raw relative change of
+    the VALUE ((new-old)/old) — the direction rule decides which sign
+    constitutes a regression.  ``missing`` lists metrics the old round
+    had and the new one lost (a crashed config's headline path
+    vanishing is worse than a slowdown, not invisible)."""
+    regressions, improvements, skipped = [], [], []
+    old_metrics = old.get("metrics", {})
+    new_metrics = new.get("metrics", {})
+    missing = sorted(set(old_metrics) - set(new_metrics))
+    for name, m_new in sorted(new_metrics.items()):
+        m_old = old_metrics.get(name)
+        if m_old is None:
+            skipped.append((name, "new metric — no baseline"))
+            continue
+        d = direction(m_new.get("unit"))
+        if d == 0:
+            skipped.append((name, f"unit {m_new.get('unit')!r} has no "
+                                  "regression direction"))
+            continue
+        try:
+            ov, nv = float(m_old["value"]), float(m_new["value"])
+        except (TypeError, ValueError, KeyError):
+            skipped.append((name, "non-numeric value"))
+            continue
+        if ov <= 0:
+            skipped.append((name, "non-positive baseline"))
+            continue
+        change = (nv - ov) / ov
+        goodness = change * d  # positive = better under the unit rule
+        if goodness < -threshold:
+            regressions.append((name, ov, nv, change))
+        elif goodness > threshold:
+            improvements.append((name, ov, nv, change))
+    return regressions, improvements, skipped, missing
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on >threshold regression between the newest "
+                    "two BENCH_rNN.json files")
+    ap.add_argument("files", nargs="*",
+                    help="explicit OLD NEW pair (default: newest two "
+                         "schema-versioned files under --root)")
+    ap.add_argument("--root", default=repo_root(),
+                    help="directory scanned for BENCH_rNN.json")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="relative regression that fails the gate "
+                         "(default 0.15 = 15%%)")
+    args = ap.parse_args(argv)
+
+    if args.files and len(args.files) != 2:
+        print("bench_gate: pass exactly two files (OLD NEW) or none",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.files:
+            old_path, new_path = args.files
+        else:
+            found = find_bench_files(args.root)
+            if len(found) < 2:
+                print(f"bench_gate: {len(found)} comparable BENCH "
+                      f"file(s) under {args.root} — nothing to diff, "
+                      "passing")
+                return 0
+            (_, old_path), (_, new_path) = found[-2], found[-1]
+        old, new = load_bench(old_path), load_bench(new_path)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 2
+
+    regressions, improvements, skipped, missing = compare(
+        old, new, threshold=args.threshold)
+    failures = new.get("failures") or {}
+    print(f"bench_gate: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} "
+          f"(threshold {args.threshold:.0%})")
+    for name, ov, nv, change in improvements:
+        print(f"  improved  {name}: {ov:g} -> {nv:g} ({change:+.1%})")
+    for name, reason in skipped:
+        print(f"  skipped   {name}: {reason}")
+    bad = False
+    if failures:
+        bad = True
+        for mod, err in sorted(failures.items()):
+            print(f"  CONFIG FAILED {mod}: {err}", file=sys.stderr)
+    if missing:
+        bad = True
+        for name in missing:
+            print(f"  MISSING   {name}: present in the old round, "
+                  "absent in the new", file=sys.stderr)
+    if regressions:
+        bad = True
+        for name, ov, nv, change in regressions:
+            print(f"  REGRESSED {name}: {ov:g} -> {nv:g} "
+                  f"({change:+.1%})", file=sys.stderr)
+    if bad:
+        print(f"bench_gate: {len(regressions)} regressed past "
+              f"{args.threshold:.0%}, {len(missing)} missing, "
+              f"{len(failures)} config failure(s)", file=sys.stderr)
+        return 1
+    print("bench_gate: OK — no headline metric regressed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

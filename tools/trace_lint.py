@@ -2,13 +2,20 @@
 """trace_lint — instrumentation-coverage check for the obs plane.
 
 ISSUE 1 threads txid-correlated spans (antidote_tpu/obs/spans.py) and
-profiler annotations (antidote_tpu/tracing.py) through every public
-entry point of the coordinator, device plane, log, and inter-DC
-planes.  Instrumentation rots silently: a refactor that drops a
-``with tracer.span(...)`` breaks no test, it just blinds the next
-forensic hunt.  This lint pins the contract — every entry point listed
-in ENTRY_POINTS must carry a span, an instant, a profiler annotation,
-or the @traced decorator — and fails loudly when one goes dark.
+profiler annotations (antidote_tpu/obs/prof.py; tracing.py is a shim)
+through every public entry point of the coordinator, device plane,
+log, and inter-DC planes.  Instrumentation rots silently: a refactor
+that drops a ``with tracer.span(...)`` breaks no test, it just blinds
+the next forensic hunt.  This lint pins the contract — every entry
+point listed in ENTRY_POINTS must carry a span, an instant, a profiler
+annotation, or the @traced decorator — and fails loudly when one goes
+dark.
+
+ISSUE 2 adds the device-kernel rule: every PUBLIC ``@jax.jit``-
+decorated function under antidote_tpu/mat/ must also carry a
+``@kernel_span`` (antidote_tpu/obs/prof.py) so per-kernel timing and
+compile-cache-miss attribution cannot silently go dark when a new
+jitted entry point lands.
 
 Runs standalone (``python tools/trace_lint.py``) and from tier-1
 (tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
@@ -52,10 +59,15 @@ ENTRY_POINTS: Dict[str, Dict[str, List[str]]] = {
 
 #: a call to <obj>.<attr> counts as instrumentation when (obj, attr)
 #: is one of these — the span/annotation surfaces of the obs plane
+#: (tracing.annotate kept for the shim; prof.annotate is the home)
 _INSTRUMENTED_CALLS = {
     ("tracer", "span"), ("tracer", "instant"),
-    ("tracing", "annotate"),
+    ("tracing", "annotate"), ("prof", "annotate"),
 }
+
+#: package whose public @jax.jit functions must carry @kernel_span
+#: (ISSUE 2 — the device-plane profiler's coverage contract)
+_KERNEL_SPAN_DIR = os.path.join("antidote_tpu", "mat")
 
 #: decorators that wrap the whole method in a span
 _INSTRUMENTED_DECORATORS = {"traced"}
@@ -76,6 +88,63 @@ def _is_instrumented(fn: ast.FunctionDef) -> bool:
                 and (f.value.id, f.attr) in _INSTRUMENTED_CALLS):
             return True
     return False
+
+
+def _is_jax_jit(dec: ast.expr) -> bool:
+    """True for ``@jax.jit``, ``@jit`` (from-imported), either with a
+    call ``(...)``, and ``@[functools.]partial([jax.]jit, ...)``
+    decorator forms.  The bare-name match can in principle catch a
+    foreign ``jit`` (numba's), but under antidote_tpu/mat/ any jit is
+    jax's — a false positive here is a lint nudge, not a build break."""
+    if isinstance(dec, ast.Attribute):
+        return (dec.attr == "jit" and isinstance(dec.value, ast.Name)
+                and dec.value.id == "jax")
+    if isinstance(dec, ast.Name):
+        return dec.id == "jit"
+    if isinstance(dec, ast.Call):
+        f = dec.func
+        name = getattr(f, "attr", getattr(f, "id", None))
+        if name == "partial" and dec.args:
+            return _is_jax_jit(dec.args[0])
+        if name == "jit":
+            return _is_jax_jit(f)
+    return False
+
+
+def _has_kernel_span(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if getattr(target, "attr",
+                   getattr(target, "id", None)) == "kernel_span":
+            return True
+    return False
+
+
+def lint_kernel_spans(root: str) -> List[str]:
+    """ISSUE 2 rule: public @jax.jit functions under antidote_tpu/mat/
+    must carry @kernel_span so the device-plane profiler sees them."""
+    problems: List[str] = []
+    d = os.path.join(root, _KERNEL_SPAN_DIR)
+    if not os.path.isdir(d):
+        return problems
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(d, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in tree.body:
+            if not isinstance(node, ast.FunctionDef) \
+                    or node.name.startswith("_"):
+                continue
+            if any(_is_jax_jit(dec) for dec in node.decorator_list) \
+                    and not _has_kernel_span(node):
+                problems.append(
+                    f"{_KERNEL_SPAN_DIR}/{fname}::{node.name}: public "
+                    "@jax.jit entry point without @kernel_span — its "
+                    "timing and compile-miss attribution are dark "
+                    "(antidote_tpu/obs/prof.py)")
+    return problems
 
 
 def _methods(tree: ast.Module, cls_name: str) -> Dict[str, ast.FunctionDef]:
@@ -109,8 +178,9 @@ def lint(root: str) -> List[str]:
                 elif not _is_instrumented(fn):
                     problems.append(
                         f"{rel}::{cls}.{m}: no span/annotation — add "
-                        "tracer.span/instant, tracing.annotate, or "
+                        "tracer.span/instant, prof.annotate, or "
                         "@traced")
+    problems.extend(lint_kernel_spans(root))
     return problems
 
 
