@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from antidote_tpu.clocks import VC
 from antidote_tpu.interdc import query as idc_query
-from antidote_tpu.interdc.dep import DependencyGate
+from antidote_tpu.interdc.dep import DependencyGate, gate_from_config
 from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
 from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
@@ -104,8 +104,8 @@ class NodeInterDc:
         #: node's stable tracker
         self.gates: Dict[int, DependencyGate] = {}
         for p in sorted(self.local):
-            g = DependencyGate(node.partitions[p], self.dc_id,
-                               node.clock.now_us)
+            g = gate_from_config(node.partitions[p], self.dc_id,
+                                 node.clock.now_us, node.config)
             g.seed_clock(node.partitions[p].log.max_commit_vc)
             self.gates[p] = g
         #: (origin dc, partition) -> SubBuf, owned slices only
@@ -170,7 +170,8 @@ class NodeInterDc:
                 pm.log.on_append = (
                     lambda rec, _s=sender: _s.on_append(rec))
                 self.senders[p] = sender
-                g = DependencyGate(pm, self.dc_id, node.clock.now_us)
+                g = gate_from_config(pm, self.dc_id,
+                                     node.clock.now_us, node.config)
                 g.seed_clock(pm.log.max_commit_vc)
                 self.gates[p] = g
                 for dc_id in self.remote:

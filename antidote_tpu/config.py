@@ -131,6 +131,28 @@ class Config:
     #: flight-recorder dump directory (None = <tempdir>/antidote_obs;
     #: antidote_tpu/obs/events.py)
     flight_recorder_dir: str | None = None
+    #: queued-txn count past which a dependency gate leaves the host
+    #: head-walk for the batched device path (interdc/dep.py; above it
+    #: the adaptive picker still learns the cheaper path from measured
+    #: cost)
+    gate_batch_threshold: int = 48
+    #: batched gate form: True = the device-resident ring (ISSUE 3 —
+    #: incremental appends, in-place retire/compact, one fixpoint per
+    #: admission wave); False = the legacy per-pass repack (kept as
+    #: the benches' comparison baseline)
+    gate_device_ring: bool = True
+    #: initial gate-ring capacity in txn slots (rounded up to a power
+    #: of two; grows by a device-side gather on demand)
+    gate_ring_capacity: int = 256
+    #: enqueue-coalescing window, µs: while the batched regime is
+    #: active and a gating pass ran within the window, further
+    #: deliveries only stage — one device dispatch then admits the
+    #: whole burst.  0 processes every head enqueue immediately (the
+    #: pre-ISSUE-3 behavior).
+    gate_coalesce_us: int = 2000
+    #: dead-slot fraction past which the gate ring compacts (shrinks)
+    #: so the fixpoint stops paying for a drained backlog's peak
+    gate_compact_frac: float = 0.75
     #: probability a device-served set_aw read is cross-checked against
     #: a log replay at the same snapshot (the read-inclusion probe,
     #: antidote_tpu/obs/probe.py); violations dump the flight recorder.

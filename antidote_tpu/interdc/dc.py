@@ -28,7 +28,7 @@ from antidote_tpu.bcounter import BCounterMgr
 from antidote_tpu.clocks import VC
 from antidote_tpu.config import Config
 from antidote_tpu.interdc import query as idc_query
-from antidote_tpu.interdc.dep import DependencyGate
+from antidote_tpu.interdc.dep import gate_from_config
 from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
 from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
@@ -185,7 +185,7 @@ class DataCenter(AntidoteTPU):
             for p in range(n)
         ]
         self.dep_gates = [
-            DependencyGate(pm, dc_id, node.clock.now_us)
+            gate_from_config(pm, dc_id, node.clock.now_us, node.config)
             for pm in node.partitions
         ]
 

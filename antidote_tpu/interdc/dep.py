@@ -20,32 +20,113 @@ advances (:96-117).
 At a handful of DCs the fixpoint is a host walk over queue heads.  At
 hundreds of DCs (BASELINE config 5) the walk is the bottleneck, so past
 ``batch_threshold`` queued txns the gate switches to the batched device
-form: every queued txn's dependency vector is packed into one dense
-[N, D] tensor and :func:`gate_fixpoint` runs the whole
-iterate-until-stable cascade — dominance test, per-origin FIFO prefix,
-watermark advance — as a ``lax.while_loop`` on device (the data-parallel
-fixpoint named in SURVEY §7 hard-part (d)).  One device round trip
-replaces O(rounds × queued) host VC comparisons.
+form.  ISSUE 3 made that form *device-resident*: instead of re-packing
+every queued txn into fresh host tensors per pass (six uploads + three
+fetches per ``process_queues`` call — worst-case repack cost on every
+delivery), each gate keeps a persistent padded ring on device
+(interdc/gate_kernels.py) that is appended to incrementally on arrival
+(one small donated scatter per batch of arrivals), retired/compacted in
+place, and driven by :func:`gate_kernels.ring_fixpoint` — the same
+data-parallel iterate-until-stable cascade (SURVEY §7 hard-part (d)),
+whose only mandatory fetch is a scalar applied-count.  A short
+coalescing window on ``enqueue`` turns a burst of deliveries into ONE
+device dispatch; the GATE_* metric families (stats.py) record the
+amortization ratio the benches gate on.  ``device_ring=False`` keeps
+the pre-ISSUE-3 repack form (the benches' comparison baseline).
 """
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Any, Callable, Dict
+from itertools import islice
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from antidote_tpu import stats
 from antidote_tpu.clocks import VC
+from antidote_tpu.config import Config as _Config
 from antidote_tpu.interdc.wire import InterDcTxn
 from antidote_tpu.obs.events import recorder
 from antidote_tpu.obs.spans import tracer
 from antidote_tpu.txn.manager import PartitionRetired
 
+#: the gate knobs' single source of truth is Config's field defaults
+#: (config.py) — direct DependencyGate(...) constructions (tests,
+#: benches' "production defaults" rows) inherit exactly what a
+#: config-built node gets, so tuning a default cannot silently fork
+#: the two populations
+_KNOB = {k: _Config.__dataclass_fields__[f"gate_{k}"].default
+         for k in ("batch_threshold", "device_ring", "ring_capacity",
+                   "coalesce_us", "compact_frac")}
+
+#: dispatch kinds of the device gate path (the ``kind`` label of
+#: antidote_gate_device_dispatches_total; ``fixpoint`` is shared with
+#: the legacy repack path so dispatch-amortization diffs are honest)
+GATE_DISPATCH_KINDS = ("fixpoint", "append", "retire", "gather")
+
+
+def _note_gate_dispatch(kind: str, h2d: int = 0, d2h: int = 0) -> None:
+    reg = stats.registry
+    reg.gate_dispatches.inc(kind=kind)
+    if h2d:
+        reg.gate_h2d_bytes.inc(h2d)
+    if d2h:
+        reg.gate_d2h_bytes.inc(d2h)
+
+
+def _note_gate_admitted(n: int) -> None:
+    """Bump the admitted counter and refresh the amortization gauge —
+    admitted txns per device dispatch over the process lifetime, the
+    panel the steady-stream bench gates on."""
+    reg = stats.registry
+    reg.gate_admitted_batched.inc(n)
+    total = sum(reg.gate_dispatches.value(kind=k)
+                for k in GATE_DISPATCH_KINDS)
+    if total:
+        reg.gate_admitted_per_dispatch.set(
+            reg.gate_admitted_batched.value() / total)
+
+
+def _pack_txn_row(txn, cols: Dict[Any, int], ss_row) -> Tuple[int, bool]:
+    """Encode one queued txn into a dense dependency row: fill
+    ``ss_row`` (an int64[D] view) with the snapshot VC under the
+    ``cols`` column map and return (ts, is_ping), with the ping's
+    EXCLUSIVE ts-1 advance (see _process_host) already applied.  The
+    ONE row encoding shared by the ring append, the ring bulk load,
+    and the legacy repack packer — test_batched_matches_host_walk
+    relies on the three staying bit-for-bit equivalent."""
+    if txn.is_ping():
+        return txn.timestamp - 1, True
+    for dc, t in txn.snapshot_vc.items():
+        ss_row[cols[dc]] = t
+    return txn.timestamp, False
+
+
+def gate_from_config(pm, own_dc, now_us: Callable[[], int],
+                     config) -> "DependencyGate":
+    """A DependencyGate honoring the node Config's gate_* knobs — the
+    one construction path every assembly (single-DC, inter-DC, and
+    cluster federation) must share, so a knob like
+    ``gate_device_ring=False`` cannot silently apply to some gates and
+    not others."""
+    return DependencyGate(
+        pm, own_dc, now_us,
+        batch_threshold=config.gate_batch_threshold,
+        device_ring=config.gate_device_ring,
+        ring_capacity=config.gate_ring_capacity,
+        coalesce_us=config.gate_coalesce_us,
+        compact_frac=config.gate_compact_frac)
+
 
 class DependencyGate:
     def __init__(self, pm, own_dc, now_us: Callable[[], int],
-                 batch_threshold: int = 48, adapt: bool = True):
+                 batch_threshold: int = _KNOB["batch_threshold"],
+                 adapt: bool = True,
+                 device_ring: bool = _KNOB["device_ring"],
+                 ring_capacity: int = _KNOB["ring_capacity"],
+                 coalesce_us: int = _KNOB["coalesce_us"],
+                 compact_frac: float = _KNOB["compact_frac"]):
         self.pm = pm  # PartitionManager
         self.own_dc = own_dc
         self.now_us = now_us
@@ -68,6 +149,20 @@ class DependencyGate:
         #: in the measured CPU regime), so it is learned, not guessed.
         #: ``adapt=False`` pins the path by threshold alone (benches).
         self.adapt = adapt
+        #: the device-resident ring form (ISSUE 3); False = the legacy
+        #: per-pass repack (kept as the benches' comparison baseline)
+        self.device_ring = device_ring
+        #: initial ring capacity (rounded up to a power of two; grows
+        #: by device-side gather on demand)
+        self.ring_capacity = ring_capacity
+        #: enqueue-coalescing window, µs: while the batched regime is
+        #: active and a pass ran within the window, further enqueues
+        #: only stage — one device dispatch admits the whole burst.
+        #: 0 disables (every head enqueue processes immediately).
+        self.coalesce_us = coalesce_us
+        #: dead-slot fraction past which the ring compacts (shrinks)
+        self.compact_frac = compact_frac
+        self._ring: Optional[_DeviceRing] = None
         self._cost_host: float | None = None
         self._cost_batched: float | None = None
         self._batched_warm = False
@@ -99,7 +194,17 @@ class DependencyGate:
         # reprocess for backlogged queues so ingest under a partition
         # stays O(1) per frame, except for an occasional pass that picks
         # up heads gated only on the advancing local wall clock
-        if len(q) > 1 and (self.now_us() - self._last_proc_us) < 50_000:
+        since_proc = self.now_us() - self._last_proc_us
+        if len(q) > 1 and since_proc < 50_000:
+            return
+        # coalescing window (ISSUE 3): in the batched regime, a burst
+        # of head enqueues right after a pass stages instead of
+        # dispatching — the next pass (the enqueue that outlives the
+        # window, an explicit process_queues, or the heartbeat path)
+        # admits the whole burst with ONE device fixpoint instead of N
+        if (self.coalesce_us > 0 and 0 <= since_proc < self.coalesce_us
+                and self.pending() >= self.batch_threshold):
+            stats.registry.gate_coalesced.inc()
             return
         self.process_queues()
 
@@ -223,12 +328,79 @@ class DependencyGate:
                         break
         return advanced
 
+    # ------------------------------------------------- batched (device)
+
     def _process_batched(self) -> bool:
-        """One-shot device gating: pack every queued txn into dense
-        tensors, run :func:`gate_fixpoint`, then pop+apply the computed
-        FIFO prefixes in queue order.  Equivalent to the host walk (the
-        device fixpoint is the same monotone cascade, evaluated
-        data-parallel)."""
+        """One above-threshold gating pass on device: the resident-ring
+        form by default, the legacy repack form under
+        ``device_ring=False``.  Both compute exactly the host walk's
+        applied set, order, and final clock."""
+        if not self.device_ring:
+            return self._process_batched_repack()
+        if self._ring is None:
+            self._ring = _DeviceRing(self)
+        ring = self._ring
+        ring.sync()
+        if ring.n_live == 0:
+            return False
+        napp, applied, rounds, new_pvc = ring.run_fixpoint()
+        advanced = False
+        completed = True
+        if napp:
+            # replay in (round, fifo pos) order: round-r txns depend
+            # only on rounds < r, so this is a causal apply order (see
+            # gate_kernels.ring_fixpoint)
+            order = sorted(ring.applied_entries(applied),
+                           key=lambda e: (int(rounds[e[0]]), e[2]))
+            ring.begin_wave()
+            for slot, origin, _pos, txn in order:
+                q = self.queues[origin]
+                assert q[0] is txn, \
+                    "device fixpoint applied out of FIFO order"
+                q.popleft()
+                if txn.is_ping():
+                    # exclusive ping advance (see _process_host)
+                    ring.pop_applied(slot)
+                    self._advance(origin, txn.timestamp - 1)
+                else:
+                    try:
+                        self._apply(txn)
+                    except PartitionRetired:
+                        # mid-handoff (see _process_host): re-queue and
+                        # stop WITHOUT folding the fixpoint clock — the
+                        # fold would cover the unapplied remainder.
+                        # Slots admitted so far retire at the next sync.
+                        q.appendleft(txn)
+                        completed = False
+                        break
+                    ring.pop_applied(slot)
+                advanced = True
+            ring.finish_wave(completed)
+            _note_gate_admitted(len(ring.last_wave))
+        if not completed:
+            return advanced
+        # fold the kernel's final clock back AFTER the replay (it
+        # includes the blocked-head ts-1 advances; advancing before the
+        # records hit the materializer would let a concurrent
+        # partition_vc() reader see a stable time covering unapplied
+        # txns).  Applied watermarks are already in via _apply, so only
+        # the ts-1 component is new; the own column carried `now`, not
+        # an applied watermark — skip it.
+        for dc, c in ring.cols.items():
+            if dc != self.own_dc and int(new_pvc[c]) > \
+                    self.applied_vc.get_dc(dc):
+                self._advance(dc, int(new_pvc[c]))
+                advanced = True
+        return advanced
+
+    def _process_batched_repack(self) -> bool:
+        """The pre-ISSUE-3 one-shot device gating: pack every queued
+        txn into dense tensors, run :func:`gate_fixpoint`, then
+        pop+apply the computed FIFO prefixes in queue order.
+        Equivalent to the host walk (the device fixpoint is the same
+        monotone cascade, evaluated data-parallel) — and to the ring
+        form, which amortizes exactly this path's per-pass repack,
+        upload, and fetch (GATE_* counters record both)."""
         import jax.numpy as jnp
 
         # dense columns: every DC named by a queued txn, the applied
@@ -272,15 +444,7 @@ class DependencyGate:
         for i, (origin, pos, txn) in enumerate(flat):
             origin_col[i] = cols[origin]
             pos_arr[i] = pos
-            # exclusive ping advance (see _process_host): the kernel
-            # folds applied rows' ts into the clock, so a ping row
-            # carries ts-1
-            ts[i] = txn.timestamp - 1 if txn.is_ping() else txn.timestamp
-            if txn.is_ping():
-                ping[i] = True
-            else:
-                for dc, t in txn.snapshot_vc.items():
-                    ss[i, cols[dc]] = t
+            ts[i], ping[i] = _pack_txn_row(txn, cols, ss[i])
         pvc = np.zeros(d_pad, dtype=np.int64)
         for dc, c in cols.items():
             pvc[c] = self.applied_vc.get_dc(dc)
@@ -298,6 +462,11 @@ class DependencyGate:
         applied = np.asarray(applied)
         rounds = np.asarray(rounds)
         new_pvc = np.asarray(new_pvc)
+        _note_gate_dispatch(
+            "fixpoint",
+            h2d=(ss.nbytes + origin_col.nbytes + pos_arr.nbytes
+                 + ts.nbytes + ping.nbytes + pvc.nbytes),
+            d2h=applied.nbytes + rounds.nbytes + new_pvc.nbytes)
 
         # replay in (round, fifo pos) order: round-r txns depend only on
         # rounds < r, so this is a causal apply order (see gate_fixpoint)
@@ -305,6 +474,7 @@ class DependencyGate:
             (i for i in range(n) if applied[i]),
             key=lambda i: (int(rounds[i]), flat[i][1]))
         advanced = False
+        admitted = 0
         for i in order:
             origin, pos, txn = flat[i]
             q = self.queues[origin]
@@ -321,8 +491,11 @@ class DependencyGate:
                     # stop WITHOUT folding the fixpoint clock — the
                     # fold would cover the unapplied remainder
                     q.appendleft(txn)
+                    _note_gate_admitted(admitted)
                     return advanced
+            admitted += 1
             advanced = True
+        _note_gate_admitted(admitted)
         # fold the kernel's final clock back AFTER the replay (it
         # includes the blocked-head ts-1 advances; advancing before the
         # records hit the materializer would let a concurrent
@@ -361,6 +534,348 @@ class DependencyGate:
 
     def pending(self) -> int:
         return sum(len(q) for q in self.queues.values())
+
+
+class _DeviceRing:
+    """Host bookkeeping of one gate's device-resident ring (ISSUE 3).
+
+    The device side (interdc/gate_kernels.py) holds padded per-slot
+    rows; this side maps slots to queued txns:
+
+    - ``mirror``: origin -> deque of (slot, txn, pos) in FIFO order —
+      always a suffix-extended copy of the gate's queue (pops happen
+      only at the head, appends only at the tail, so ``sync`` can
+      reconcile by identity from the head);
+    - ``slot_entry``: slot -> (origin, pos, txn) for replaying an
+      admission wave from the fetched applied-mask;
+    - ``free`` / ``retire_pending``: reusable slots, and slots whose
+      device ``live`` bit is still set because their txn left the
+      queue outside a ring replay (host-walk pass in between, or a
+      wave aborted on PartitionRetired) — retired in ONE scatter at
+      the next sync, before the fixpoint can see them;
+    - ``cols``: persistent dense column map (grows only; a width
+      overflow re-lays the ring out via a device-side gather, no
+      re-upload).
+
+    FIFO positions are per-origin monotone counters, NOT queue
+    indices: a popped head leaves a gap, which the fixpoint's
+    min-position prefix rule tolerates by construction.
+    """
+
+    def __init__(self, gate: DependencyGate):
+        self.gate = gate
+        self.init_cap = max(8, 1 << (max(gate.ring_capacity, 8) - 1)
+                            .bit_length())
+        self.cap = 0
+        self.d_pad = 8
+        self.cols: Dict[Any, int] = {}
+        self.dev = None  # (ss, origin, pos, ts, ping, live) on device
+        self.mirror: Dict[Any, deque] = {}
+        self.slot_entry: List[Optional[Tuple[Any, int, Any]]] = []
+        self.free: List[int] = []
+        self.retire_pending: List[int] = []
+        self.pos_next: Dict[Any, int] = {}
+        self.n_live = 0
+        #: slots admitted by the wave currently replaying
+        self.last_wave: List[int] = []
+        self._pending_live = None
+
+    # ------------------------------------------------------------ columns
+
+    def _col_of(self, dc) -> int:
+        c = self.cols.get(dc)
+        if c is None:
+            c = self.cols[dc] = len(self.cols)
+        return c
+
+    # --------------------------------------------------------------- sync
+
+    def sync(self) -> None:
+        """Reconcile the ring with the gate's queues: retire slots
+        popped outside a ring replay, re-layout (grow / widen /
+        compact) when needed, and append new arrivals — each step at
+        most one small device dispatch."""
+        gate = self.gate
+        # 0. FIFO positions are monotone per origin and never reset in
+        #    place; long before int32 arithmetic could wrap, renumber
+        #    through a full rebuild (queues keep every live txn, so
+        #    this loses nothing)
+        if self.pos_next and max(self.pos_next.values()) > (1 << 30):
+            self.invalidate()
+        # 1. heads popped outside the ring replay (host-walk pass ran
+        #    in between, or an aborted wave re-queued its remainder)
+        for origin, dq in self.mirror.items():
+            q = gate.queues.get(origin)
+            while dq and (not q or dq[0][1] is not q[0]):
+                slot, _txn, _pos = dq.popleft()
+                self.slot_entry[slot] = None
+                self.retire_pending.append(slot)
+                self.n_live -= 1
+        # 2. new tail arrivals (per-origin FIFO order preserved)
+        fresh: List[Tuple[Any, Any]] = []
+        for origin, q in gate.queues.items():
+            have = len(self.mirror.get(origin) or ())
+            if len(q) > have:
+                for txn in islice(q, have, None):
+                    fresh.append((origin, txn))
+        # 3. column map growth (persistent: existing rows keep their
+        #    columns; a new DC is a fresh zero column)
+        self._col_of(gate.own_dc)
+        for origin, txn in fresh:
+            self._col_of(origin)
+            if not txn.is_ping():
+                for dc in txn.snapshot_vc:
+                    self._col_of(dc)
+        need_d = max(8, 1 << (len(self.cols) - 1).bit_length())
+        # 4a. empty-ring bulk fast path: with nothing resident, a large
+        #     arrival batch uploads as six dense arrays directly (the
+        #     repack path's exact economy — no scatter, no stale state
+        #     to reconcile), so a bulk-packed queue pays no ring
+        #     penalty; the scatter append below is the incremental
+        #     steady-state path
+        if self.dev is None or (self.n_live == 0
+                                and 2 * len(fresh) >= self.cap):
+            if fresh:
+                self._bulk_load(need_d, fresh)
+            elif self.dev is None:
+                self._build(need_d, 0)
+            return
+        avail = len(self.free) + len(self.retire_pending)
+        dead = self.cap - self.n_live
+        if need_d > self.d_pad or len(fresh) > avail:
+            self._gather(need_d, self.n_live + len(fresh))
+        elif (self.cap > self.init_cap
+              and dead > self.cap * self.gate.compact_frac):
+            # lazy compaction: dead slots passed the threshold and
+            # the live set fits a smaller ring — shrink so the
+            # fixpoint stops paying for a drained backlog's peak
+            self._gather(need_d, self.n_live + len(fresh))
+        # 4b. retire BEFORE append: a freed device slot must read dead
+        #     before its row can be reused, and before the fixpoint
+        #     can re-admit a txn that already left the queue
+        if self.retire_pending:
+            self._dispatch_retire()
+        if fresh:
+            self._dispatch_append(fresh)
+
+    def _build(self, d_pad: int, total: int) -> None:
+        """Fresh all-dead ring (first use, or after invalidate()); the
+        buffers are created on device, so a build uploads nothing —
+        the queued txns then stage through the normal append path."""
+        from antidote_tpu.interdc import gate_kernels as gk
+
+        assert not self.mirror or all(
+            not dq for dq in self.mirror.values())
+        self.cap = max(self.init_cap,
+                       1 << (max(total, 1) - 1).bit_length())
+        self.d_pad = d_pad
+        self.dev = gk.ring_alloc(self.cap, self.d_pad)
+        self.mirror = {}
+        self.slot_entry = [None] * self.cap
+        self.free = list(range(self.cap - 1, -1, -1))
+        self.retire_pending = []
+        self.pos_next = {}
+        self.n_live = 0
+        stats.registry.gate_ring_rebuilds.inc()
+
+    def _bulk_load(self, d_pad: int,
+                   fresh: List[Tuple[Any, Any]]) -> None:
+        """Empty-ring bulk load: pack the whole arrival batch into
+        dense host arrays and upload them as the NEW ring (one H2D of
+        exactly the rows that exist — what the legacy repack paid per
+        pass, paid here once per backlog).  Any previous device state
+        is garbage by construction (n_live == 0), so pending retires
+        die with it."""
+        import jax.numpy as jnp
+
+        from antidote_tpu.interdc import gate_kernels as gk
+
+        k = len(fresh)
+        self.cap = max(self.init_cap,
+                       1 << (max(k, 1) - 1).bit_length())
+        self.d_pad = d_pad
+        ss = np.zeros((self.cap, d_pad), np.int64)
+        origin = np.zeros(self.cap, np.int32)
+        pos = np.full(self.cap, gk.BIG_POS, np.int32)
+        ts = np.zeros(self.cap, np.int64)
+        ping = np.zeros(self.cap, dtype=bool)
+        live = np.zeros(self.cap, dtype=bool)
+        live[:k] = True
+        self.mirror = {}
+        self.slot_entry = [None] * self.cap
+        self.pos_next = {}
+        self.retire_pending = []
+        for i, (o, txn) in enumerate(fresh):
+            p = self.pos_next.get(o, 0)
+            self.pos_next[o] = p + 1
+            origin[i] = self.cols[o]
+            pos[i] = p
+            ts[i], ping[i] = _pack_txn_row(txn, self.cols, ss[i])
+            self.slot_entry[i] = (o, p, txn)
+            self.mirror.setdefault(o, deque()).append((i, txn, p))
+        self.n_live = k
+        self.free = list(range(self.cap - 1, k - 1, -1))
+        self.dev = tuple(jnp.asarray(a)
+                         for a in (ss, origin, pos, ts, ping, live))
+        _note_gate_dispatch(
+            "append",
+            h2d=(ss.nbytes + origin.nbytes + pos.nbytes + ts.nbytes
+                 + ping.nbytes + live.nbytes))
+
+    def invalidate(self) -> None:
+        """Drop the device state; the next sync rebuilds from the
+        queues (defensive escape hatch — no steady-state caller)."""
+        self.dev = None
+        self.mirror = {}
+        self.slot_entry = []
+        self.free = []
+        self.retire_pending = []
+        self.pos_next = {}
+        self.n_live = 0
+
+    def _gather(self, d_pad: int, total: int) -> None:
+        """Re-layout the ring via a device-side gather: grow, shrink
+        (compaction), or widen the clock columns.  Only the index
+        vector crosses the host/device boundary."""
+        from antidote_tpu.interdc import gate_kernels as gk
+
+        new_cap = max(self.init_cap,
+                      1 << (max(total, 1) - 1).bit_length())
+        idx = np.zeros(new_cap, np.int32)
+        new_entry: List[Optional[Tuple[Any, int, Any]]] = [None] * new_cap
+        new_mirror: Dict[Any, deque] = {}
+        i = 0
+        for origin, dq in self.mirror.items():
+            nd = new_mirror[origin] = deque()
+            for slot, txn, pos in dq:
+                idx[i] = slot
+                new_entry[i] = self.slot_entry[slot]
+                nd.append((i, txn, pos))
+                i += 1
+        assert i == self.n_live
+        n_live = np.asarray(i, np.int32)
+        self.dev = gk.ring_gather(*self.dev[:5], idx, n_live,
+                                  new_d=d_pad)
+        _note_gate_dispatch("gather", h2d=idx.nbytes + n_live.nbytes)
+        self.cap = new_cap
+        self.d_pad = d_pad
+        self.mirror = new_mirror
+        self.slot_entry = new_entry
+        self.free = list(range(new_cap - 1, i - 1, -1))
+        self.retire_pending = []  # dead rows did not survive the gather
+
+    def _dispatch_retire(self) -> None:
+        from antidote_tpu.interdc import gate_kernels as gk
+
+        k = len(self.retire_pending)
+        k_pad = max(8, 1 << (k - 1).bit_length())
+        slots = np.full(k_pad, self.cap, np.int32)  # padding: dropped
+        slots[:k] = self.retire_pending
+        ss, origin, pos, ts, ping, live = self.dev
+        self.dev = (ss, origin, pos, ts, ping,
+                    gk.ring_retire(live, slots))
+        _note_gate_dispatch("retire", h2d=slots.nbytes)
+        self.free.extend(self.retire_pending)
+        self.retire_pending = []
+
+    def _dispatch_append(self, fresh: List[Tuple[Any, Any]]) -> None:
+        from antidote_tpu.interdc import gate_kernels as gk
+
+        k = len(fresh)
+        k_pad = max(8, 1 << (k - 1).bit_length())
+        u_ss = np.zeros((k_pad, self.d_pad), np.int64)
+        u_origin = np.zeros(k_pad, np.int32)
+        u_pos = np.full(k_pad, gk.BIG_POS, np.int32)
+        u_ts = np.zeros(k_pad, np.int64)
+        u_ping = np.zeros(k_pad, dtype=bool)
+        slots = np.full(k_pad, self.cap, np.int32)  # padding: dropped
+        for i, (origin, txn) in enumerate(fresh):
+            slot = self.free.pop()
+            pos = self.pos_next.get(origin, 0)
+            self.pos_next[origin] = pos + 1
+            slots[i] = slot
+            u_origin[i] = self.cols[origin]
+            u_pos[i] = pos
+            u_ts[i], u_ping[i] = _pack_txn_row(txn, self.cols, u_ss[i])
+            self.slot_entry[slot] = (origin, pos, txn)
+            self.mirror.setdefault(origin, deque()).append(
+                (slot, txn, pos))
+            self.n_live += 1
+        self.dev = gk.ring_append(*self.dev, slots, u_ss, u_origin,
+                                  u_pos, u_ts, u_ping)
+        _note_gate_dispatch(
+            "append",
+            h2d=(slots.nbytes + u_ss.nbytes + u_origin.nbytes
+                 + u_pos.nbytes + u_ts.nbytes + u_ping.nbytes))
+
+    # ----------------------------------------------------------- fixpoint
+
+    def run_fixpoint(self):
+        """One device fixpoint over the resident ring.  Mandatory D2H
+        is the scalar applied-count; the dense mask + rounds come back
+        only when a wave actually admitted something, the final clock
+        always (it carries the blocked-head ts-1 advances)."""
+        from antidote_tpu.interdc import gate_kernels as gk
+        from antidote_tpu.obs import prof
+
+        gate = self.gate
+        pvc = np.zeros(self.d_pad, np.int64)
+        for dc, c in self.cols.items():
+            pvc[c] = gate.applied_vc.get_dc(dc)
+        # own entry is *replaced* by now, exactly like partition_vc()
+        pvc[self.cols[gate.own_dc]] = gate.now_us()
+        with prof.annotate("gate_ring_fixpoint"):
+            applied_d, rounds_d, pvc_d, live_d, n_d = gk.ring_fixpoint(
+                *self.dev, pvc)
+        napp = int(np.asarray(n_d))
+        d2h = np.dtype(np.int32).itemsize  # the scalar count
+        if napp:
+            applied = np.asarray(applied_d)
+            rounds = np.asarray(rounds_d)
+            d2h += applied.nbytes + rounds.nbytes
+        else:
+            applied = rounds = None
+        new_pvc = np.asarray(pvc_d)
+        d2h += new_pvc.nbytes
+        _note_gate_dispatch("fixpoint", h2d=pvc.nbytes, d2h=d2h)
+        self._pending_live = live_d
+        return napp, applied, rounds, new_pvc
+
+    def applied_entries(self, applied) -> List[Tuple[int, Any, int, Any]]:
+        """(slot, origin, pos, txn) for every applied live slot."""
+        out = []
+        for slot in np.nonzero(applied)[0]:
+            e = self.slot_entry[slot]
+            if e is not None:
+                out.append((int(slot),) + e)
+        return out
+
+    # ------------------------------------------------------------- waves
+
+    def begin_wave(self) -> None:
+        self.last_wave = []
+
+    def pop_applied(self, slot: int) -> None:
+        """The gate replayed this slot's txn (popped + applied)."""
+        origin, _pos, _txn = self.slot_entry[slot]
+        head = self.mirror[origin].popleft()
+        assert head[0] == slot, "ring mirror diverged from queue order"
+        self.slot_entry[slot] = None
+        self.n_live -= 1
+        self.last_wave.append(slot)
+
+    def finish_wave(self, completed: bool) -> None:
+        """Adopt the fixpoint's ``new_live`` when the wave replayed
+        fully (the applied slots are already dead on device — zero
+        extra dispatches); otherwise keep the old live mask and retire
+        the partial wave's slots at the next sync."""
+        if completed and self._pending_live is not None:
+            ss, origin, pos, ts, ping, _live = self.dev
+            self.dev = (ss, origin, pos, ts, ping, self._pending_live)
+            self.free.extend(self.last_wave)
+        else:
+            self.retire_pending.extend(self.last_wave)
+        self._pending_live = None
 
 
 def ready_mask(queued_ss, queued_origin, partition_vc):
@@ -402,6 +917,10 @@ def gate_fixpoint(ss, origin, pos, ts, is_ping, pvc):
     so it cannot depend on any other round-r txn: replaying applies
     sorted by (round, fifo pos) is causally safe, which is how the host
     caller restores the reference's apply-in-dependency-order behavior.
+
+    This is the legacy repack path's kernel; the resident-ring form is
+    :func:`antidote_tpu.interdc.gate_kernels.ring_fixpoint` (the same
+    cascade with a ``live`` mask instead of sentinel padding rows).
     """
     global _GATE_JIT
     if _GATE_JIT is None:

@@ -1,0 +1,171 @@
+"""Jitted kernels of the device-resident dependency-gate ring.
+
+ISSUE 3: the batched gate path used to re-pack every queued txn into
+fresh host arrays, upload six tensors, and fetch three back on EVERY
+``process_queues`` call — worst-case repack cost per delivery.  These
+kernels keep the gate state resident instead: a padded ring of
+dependency rows that is appended to incrementally (one small H2D
+scatter per batch of arrivals, ring buffers donated so the update is
+in-place), retired/compacted in place, and driven by a fixpoint whose
+only mandatory fetch is a scalar applied-count.
+
+Ring layout (all arrays ``cap`` rows; ``d_pad`` dense clock columns):
+
+- ``ss``     int64[cap, d_pad]  snapshot VC of each queued txn
+- ``origin`` int32[cap]         dense column of the txn's origin DC
+- ``pos``    int32[cap]         per-origin FIFO position (monotone)
+- ``ts``     int64[cap]         commit timestamp (pings carry ts-1,
+                                the exclusive-advance hardening —
+                                interdc/dep.py module doc)
+- ``ping``   bool[cap]
+- ``live``   bool[cap]          slot holds a still-queued txn; dead
+                                and never-used slots are inert in
+                                every kernel (no sentinel rows needed)
+
+Host-side slot bookkeeping (mirror queues, free list, column map)
+lives in :class:`antidote_tpu.interdc.dep._DeviceRing`; these kernels
+are pure array programs.  Every public entry point carries
+``@kernel_span`` (tools/trace_lint.py now enforces the rule for
+antidote_tpu/interdc/ as well as mat/).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from antidote_tpu.clocks import dense
+from antidote_tpu.obs.prof import kernel_span
+
+#: FIFO-position infinity: larger than any real queue position, small
+#: enough that +1 arithmetic cannot overflow int32
+BIG_POS = np.int32(np.iinfo(np.int32).max // 2)
+
+
+@kernel_span("interdc.dep")
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def ring_append(ss, origin, pos, ts, ping, live,
+                slots, u_ss, u_origin, u_pos, u_ts, u_ping):
+    """Scatter a padded batch of arrivals into ring ``slots``.
+
+    Update rows are padded to a power-of-two batch (bounding the jit
+    cache); padding rows carry ``slots == cap`` which ``mode="drop"``
+    discards.  The six ring buffers are donated — an append updates
+    the resident state in place, no copy."""
+    ss = ss.at[slots].set(u_ss, mode="drop")
+    origin = origin.at[slots].set(u_origin, mode="drop")
+    pos = pos.at[slots].set(u_pos, mode="drop")
+    ts = ts.at[slots].set(u_ts, mode="drop")
+    ping = ping.at[slots].set(u_ping, mode="drop")
+    live = live.at[slots].set(True, mode="drop")
+    return ss, origin, pos, ts, ping, live
+
+
+@kernel_span("interdc.dep")
+@partial(jax.jit, donate_argnums=(0,))
+def ring_retire(live, slots):
+    """Mark ``slots`` dead (txns popped outside the ring replay: the
+    host walk ran in between, or a wave aborted on PartitionRetired).
+    Padding slots carry ``cap`` and are dropped."""
+    return live.at[slots].set(False, mode="drop")
+
+
+@kernel_span("interdc.dep")
+@partial(jax.jit, static_argnames=("new_d",))
+def ring_gather(ss, origin, pos, ts, ping, idx, n_live, new_d):
+    """Re-layout the ring through a device-side gather: grow capacity
+    (``idx`` longer than the ring), shrink it (lazy compaction once
+    dead slots exceed the threshold), or widen the clock domain
+    (``new_d`` > current width; new columns read 0 = the dense
+    missing-entry semantics).  ``idx[i]`` is the OLD slot written to
+    new slot i; rows at or past ``n_live`` come out dead.  No H2D
+    beyond the index vector itself — the resident rows never round-
+    trip through the host."""
+    if new_d > ss.shape[1]:
+        ss = jnp.pad(ss, ((0, 0), (0, new_d - ss.shape[1])))
+    ss = ss[idx]
+    origin = origin[idx]
+    pos = pos[idx]
+    ts = ts[idx]
+    ping = ping[idx]
+    live = jnp.arange(idx.shape[0], dtype=jnp.int32) < n_live
+    return ss, origin, pos, ts, ping, live
+
+
+@kernel_span("interdc.dep")
+@jax.jit
+def ring_fixpoint(ss, origin, pos, ts, ping, live, pvc):
+    """Iterate-until-stable over the LIVE ring rows — the same monotone
+    cascade as :func:`antidote_tpu.interdc.dep.gate_fixpoint` (dominance
+    test with the origin column zeroed, per-origin FIFO prefix,
+    watermark + blocked-head ts-1 advance, reference
+    src/inter_dc_dep_vnode.erl:96-154) with dead/unused slots gated out
+    by ``live`` instead of sentinel rows.
+
+    Returns ``(applied bool[cap], round int32[cap], final pvc int64[D],
+    new_live bool[cap], applied_count int32)``.  The caller's only
+    mandatory fetch is the scalar count; the dense mask and rounds are
+    fetched once per admission wave, and ``new_live`` (= live minus the
+    applied set) stays on device as the next resident live mask when
+    the wave replays completely."""
+    d = pvc.shape[0]
+    n = ss.shape[0]
+    big = jnp.asarray(np.iinfo(np.int32).max, jnp.int32)
+
+    def round_(pvc):
+        deps = dense.set_dc(ss, origin, 0)
+        ready = live & (ping | dense.ge(pvc, deps))          # [N]
+        # dead rows neither block (pos -> +inf) nor advance anything
+        notready_pos = jnp.where(ready | ~live, big, pos)
+        blocked_min = jnp.full((d,), big, jnp.int32).at[origin].min(
+            notready_pos, mode="drop")
+        applied = ready & (pos < blocked_min[origin])
+        wm = jnp.zeros((d,), ts.dtype).at[origin].max(
+            jnp.where(applied, ts, 0), mode="drop")
+        # blocked-head rule (reference src/inter_dc_dep_vnode.erl:
+        # 137-143): a live head that cannot apply still advances its
+        # origin's clock to ts-1 — FIFO + gap repair mean the origin's
+        # stream is complete below it
+        head_blocked = live & (~ready) & (pos == blocked_min[origin])
+        hb = jnp.zeros((d,), ts.dtype).at[origin].max(
+            jnp.where(head_blocked, ts - 1, 0), mode="drop")
+        return applied, jnp.maximum(pvc, jnp.maximum(wm, hb))
+
+    def note_round(rounds, applied, r):
+        newly = applied & (rounds < 0)
+        return jnp.where(newly, r, rounds)
+
+    def cond(carry):
+        _, _, _, changed = carry
+        return changed
+
+    def body(carry):
+        rounds, pvc, r, _ = carry
+        applied, new_pvc = round_(pvc)
+        rounds = note_round(rounds, applied, r)
+        return (rounds, new_pvc, r + 1, jnp.any(new_pvc != pvc))
+
+    rounds0 = jnp.full((n,), -1, jnp.int32)
+    rounds, pvc, r, _ = jax.lax.while_loop(
+        cond, body,
+        (rounds0, pvc, jnp.asarray(0, jnp.int32), jnp.asarray(True)))
+    # the loop exits after a round that did not advance pvc; evaluate
+    # once more at the stable clock (no-progress-first-round case)
+    applied, _ = round_(pvc)
+    rounds = note_round(rounds, applied, r)
+    return (applied, rounds, pvc, live & ~applied,
+            jnp.sum(applied, dtype=jnp.int32))
+
+
+def ring_alloc(cap: int, d_pad: int):
+    """Fresh all-dead ring buffers, created ON DEVICE (``jnp.zeros``
+    lowers to a device fill — a rebuild uploads nothing)."""
+    return (jnp.zeros((cap, d_pad), dtype=jnp.int64),
+            jnp.zeros((cap,), dtype=jnp.int32),
+            jnp.zeros((cap,), dtype=jnp.int32),
+            jnp.zeros((cap,), dtype=jnp.int64),
+            jnp.zeros((cap,), dtype=bool),
+            jnp.zeros((cap,), dtype=bool))

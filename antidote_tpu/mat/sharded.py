@@ -115,9 +115,11 @@ class _ShardedBase:
             # kernel-span wrapped (obs/prof.py): multi-chip dispatches
             # and their compile misses show up per collective entry
             # point in /debug/prof and the KERNEL_* metrics
+            from antidote_tpu.runtime import shard_map_compat
+
             self._jits[key] = prof.profiler.wrap(jax.jit(
-                jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_vma=False),
+                shard_map_compat(fn, mesh=self.mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False),
                 # state-updating entries alias the multi-hundred-MB ops
                 # tensor in place, like the single-device store's
                 # donate_argnums (an inner donation is ignored under an

@@ -78,6 +78,13 @@ class DeviceStableTimeTracker(StableTimeTracker):
             per_dev[k] += 1
         self._rpd = max(1, max(per_dev, default=0))
         self._dev_lock = threading.Lock()
+        #: serializes device folds + the monotone publish.  The fold
+        #: itself (device transfers + the collective + the D2H fetch)
+        #: runs under THIS mutex only — holding self._lock/_dev_lock
+        #: across it stalled every delivery/heartbeat put() for the
+        #: fold duration (round-5 advisor finding); those locks now
+        #: cover just the host-side row copy.
+        self._fold_lock = threading.Lock()
         self._d_pad = _pow2(self.domain.d)
         #: host mirror of the device rows, device-major (+inf pads are
         #: min-neutral)
@@ -148,28 +155,32 @@ class DeviceStableTimeTracker(StableTimeTracker):
             # all-reduce(min) on TPU (the gossip fold as a collective)
             return jax.lax.pmin(m, "parts")
 
-        fn = jax.jit(jax.shard_map(
+        from antidote_tpu.runtime import shard_map_compat
+
+        fn = jax.jit(shard_map_compat(
             local_min, mesh=self._mesh,
             in_specs=P("parts", None), out_specs=P(None, None)))
         self._fold_fn = (lambda m: fn(m)[0], sharding)
 
-    def _flush_dirty(self) -> None:
-        import jax
-
+    def _copy_dirty_locked(self):
+        """Copy every dirty partition's row into the host-side device
+        blocks.  Caller holds self._lock, self._dev_lock AND
+        self._fold_lock; this is pure host-array work (the EXACT rows
+        the host oracle folds — _grow_if_needed keeps them current),
+        so the row locks are held only for the memcpy, not the device
+        round trip.  Returns (touched device indices, domain snapshot)
+        for the fold that runs after the locks drop."""
+        self._ensure_width()
         touched = set()
         for p in self._dirty:
             k, j = self._slot(p)
-            # the EXACT row the host oracle folds (dense, width
-            # domain.d — _grow_if_needed keeps every row current)
             row = np.asarray(self.sender.peek_value("stable", p))
             blk = self._blocks_host[k]
             blk[j, :] = _I64_MAX
             blk[j, :len(row)] = row
             touched.add(k)
         self._dirty.clear()
-        for k in touched:
-            self._blocks_dev[k] = jax.device_put(
-                self._blocks_host[k], self.devices[k])
+        return touched, self.domain
 
     # -- snapshots --------------------------------------------------------
 
@@ -181,21 +192,24 @@ class DeviceStableTimeTracker(StableTimeTracker):
         """(device snapshot, host snapshot) folded from ONE source
         refresh — the oracle-equality form: time-dependent sources
         (min-prepared reads the clock) make two separately-refreshed
-        snapshots incomparable.  Both folds run under ONE lock hold:
-        a concurrent put() between them would feed the later fold
-        newer rows and make the pair transiently unequal (observed
-        live with background heartbeats — the device fold lagging the
-        host fold by one put)."""
+        snapshots incomparable.  Both folds read their inputs under
+        ONE row-lock hold (a concurrent put() between them would feed
+        the later fold newer rows and make the pair transiently
+        unequal — observed live with background heartbeats); the
+        device round trip itself then runs outside the row locks."""
         if self.sources:
             self.refresh()
-        with self._lock, self._dev_lock:
-            # ONE floor peek shared by both folds: a concurrent
-            # seed_floor between two peeks would skew only the later
-            # fold
-            floor = self.sender.peek("stable_floor")
-            dev = self._device_snapshot_locked(floor)
-            stable = self.sender.merged("stable")
-            host = VC(stable if floor is None else stable.join(floor))
+        with self._fold_lock:
+            with self._lock, self._dev_lock:
+                # ONE floor peek shared by both folds: a concurrent
+                # seed_floor between two peeks would skew only the
+                # later fold
+                floor = self.sender.peek("stable_floor")
+                touched, domain = self._copy_dirty_locked()
+                stable = self.sender.merged("stable")
+                host = VC(stable if floor is None
+                          else stable.join(floor))
+            dev = self._fold_device(touched, domain, floor)
         return dev, host
 
     def get_stable_snapshot(self) -> VC:
@@ -203,23 +217,29 @@ class DeviceStableTimeTracker(StableTimeTracker):
             self.refresh()
         if self.n_partitions == 0:
             return super().get_stable_snapshot()
-        with self._lock, self._dev_lock:
-            return self._device_snapshot_locked(
-                self.sender.peek("stable_floor"))
+        with self._fold_lock:
+            with self._lock, self._dev_lock:
+                floor = self.sender.peek("stable_floor")
+                touched, domain = self._copy_dirty_locked()
+            return self._fold_device(touched, domain, floor)
 
-    def _device_snapshot_locked(self, floor) -> VC:
-        """The device fold; caller holds self._lock AND self._dev_lock
-        and passes the floor it peeked (one peek per snapshot)."""
+    def _fold_device(self, touched, domain, floor) -> VC:
+        """The device fold: flush touched blocks, run the collective,
+        publish monotonically.  Runs under self._fold_lock ONLY (plus
+        COLLECTIVE_LOCK around the launch) — delivery/heartbeat put()
+        calls proceed concurrently instead of stalling for the whole
+        device round trip (round-5 advisor finding); they mark rows
+        dirty for the NEXT fold, which the monotone publish orders.
+        ``domain`` is the width snapshot taken with the rows — a
+        concurrent grow must not skew the dense decode."""
         import jax
 
-        self._ensure_width()
         if self._fold_fn is None:
             self._build_fold()
-        self._flush_dirty()
         fold, sharding = self._fold_fn
         n = len(self.devices)
         for k in range(n):
-            if self._blocks_dev[k] is None:
+            if k in touched or self._blocks_dev[k] is None:
                 self._blocks_dev[k] = jax.device_put(
                     self._blocks_host[k], self.devices[k])
         with _COLLECTIVE_LOCK:
@@ -231,10 +251,11 @@ class DeviceStableTimeTracker(StableTimeTracker):
         # beyond every real row's width — those columns are absent
         # from the domain anyway; mask for safety
         row = np.where(row == _I64_MAX, 0, row)
-        gst = self.domain.from_dense(row[:self.domain.d])
+        gst = domain.from_dense(row[:domain.d])
         if floor is not None:
             gst = gst.join(floor)
-        # monotone publish, the device path's own lineage
+        # monotone publish, the device path's own lineage (serialized
+        # by self._fold_lock)
         self._published_dev = (
             gst if self._published_dev is None
             else self._published_dev.join(gst))

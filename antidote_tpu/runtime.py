@@ -40,6 +40,33 @@ def tune_runtime(switch_interval_s: float = 0.0005,
     gc.set_threshold(*gc_thresholds)
 
 
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check_vma=None):
+    """``shard_map`` across jax versions: top-level ``jax.shard_map``
+    (newer releases) vs ``jax.experimental.shard_map.shard_map``
+    (<= 0.4.x), whose replication-check kwarg is ``check_rep`` where
+    the new API says ``check_vma``.  Every collective build site goes
+    through this resolver — an AttributeError here used to take the
+    whole sharded plane (and its tier-1 tests) down on 0.4.x."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+    if check_vma is not None:
+        # the replication-check kwarg was renamed across versions
+        # (check_rep -> check_vma); the flag is semantic — call sites
+        # disable a check their programs would fail — so try BOTH
+        # spellings before ever dropping it
+        for kw in ({"check_vma": check_vma}, {"check_rep": check_vma}):
+            try:
+                return sm(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+            except TypeError:
+                continue
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
 #: process-wide serialization of XLA programs containing COLLECTIVES:
 #: JAX's single-controller model does not support concurrent collective
 #: programs over the same devices — two threads interleaving their

@@ -265,6 +265,43 @@ class Registry:
             "subsystem's kernels have returned (a lower bound on its "
             "footprint; /debug/prof's live-buffer census is the total)",
             labels=("subsystem",))
+        # ---- device dependency-gate ring (ISSUE 3,
+        # antidote_tpu/interdc/dep.py + gate_kernels.py): the batched
+        # gate path's dispatch/byte economy.  The ratio of admitted
+        # txns to dispatches (and H2D bytes to admitted txns) is the
+        # amortization the resident ring buys over per-pass repack —
+        # the quantity the steady-stream bench gates on.
+        self.gate_dispatches = Counter(
+            "antidote_gate_device_dispatches_total",
+            "Device dispatches by the dependency gate's batched path "
+            "(fixpoint / append / retire / gather ring re-layout)",
+            labels=("kind",))
+        self.gate_h2d_bytes = Counter(
+            "antidote_gate_h2d_bytes_total",
+            "Host-to-device bytes uploaded by the gate's batched path "
+            "(arrival batches, retire/gather index vectors, per-"
+            "dispatch partition clocks)")
+        self.gate_d2h_bytes = Counter(
+            "antidote_gate_d2h_bytes_total",
+            "Device-to-host bytes fetched by the gate's batched path "
+            "(the scalar applied-count always; the dense applied mask "
+            "+ rounds only when a wave admitted txns)")
+        self.gate_admitted_batched = Counter(
+            "antidote_gate_admitted_txns_total",
+            "Transactions and heartbeats admitted through the batched "
+            "device gate path")
+        self.gate_coalesced = Counter(
+            "antidote_gate_coalesced_enqueues_total",
+            "Enqueues absorbed by the gate's coalescing window (staged "
+            "for the next dispatch instead of triggering their own)")
+        self.gate_ring_rebuilds = Counter(
+            "antidote_gate_ring_rebuilds_total",
+            "Full device-ring (re)builds — first use or invalidation; "
+            "growth/compaction re-layouts are `gather` dispatches")
+        self.gate_admitted_per_dispatch = Gauge(
+            "antidote_gate_admitted_per_dispatch",
+            "Amortization ratio of the batched gate path: admitted "
+            "txns per device dispatch over the process lifetime")
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -274,7 +311,11 @@ class Registry:
                 self.depgate_wait, self.replication_lag,
                 self.kernel_dispatch_latency, self.kernel_complete_latency,
                 self.kernel_calls, self.kernel_compile_misses,
-                self.device_buffer_hwm)
+                self.device_buffer_hwm,
+                self.gate_dispatches, self.gate_h2d_bytes,
+                self.gate_d2h_bytes, self.gate_admitted_batched,
+                self.gate_coalesced, self.gate_ring_rebuilds,
+                self.gate_admitted_per_dispatch)
 
     def exposition(self) -> str:
         lines = []

@@ -63,14 +63,23 @@ HEADLINE_SHAPE = dict(K=1_000_000, B=65_536, D=8, n_dcs=3, warmup=2)
 
 
 def headline_sweep(n_steps, gc_every=4):
-    """name -> (coalesce, gc_every, n_appends, with_reads): the
+    """name -> (coalesce, gc_every, n_appends, with_reads, seed): the
     coalescing-variant sweep bench_device runs (reads ride on b4's
     final state).  Single source of truth for bench_device AND the
-    phase-checkpointed hardware capture (tools/hw_phase.py)."""
+    phase-checkpointed hardware capture (tools/hw_phase.py).
+
+    Each variant carries its OWN deterministic rng seed: both capture
+    paths build ``default_rng(seed)`` per variant, so the checkpointed
+    phases and the in-process sweep measure IDENTICAL op streams.
+    (Previously bench_device threaded one rng through b1→b8 while
+    hw_phase reseeded rng(0) per variant — the two "single source of
+    truth" paths silently ran different workloads.)  b1 keeps seed 0:
+    a fresh rng(0) is exactly the stream the historic thread-through
+    gave it, so BENCH_r01..r04 stay comparable."""
     return {
-        "b1": (1, gc_every, n_steps, False),
-        "b4": (4, 3, max(n_steps // 4, 3), True),
-        "b8": (8, 2, max(n_steps // 8, 2), False),
+        "b1": (1, gc_every, n_steps, False, 0),
+        "b4": (4, 3, max(n_steps // 4, 3), True, 4),
+        "b8": (8, 2, max(n_steps // 8, 2), False, 8),
     }
 
 
@@ -231,10 +240,11 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
 
     from antidote_tpu.mat import store
 
-    rng = np.random.default_rng(0)
-
-    def run_variant(coalesce, gc_every_v, n_appends):
-        return bench_variant(K, B, D, n_dcs, warmup, rng,
+    def run_variant(coalesce, gc_every_v, n_appends, _reads, seed):
+        # per-variant rng from the sweep's own seed — the SAME stream
+        # tools/hw_phase.py builds for the checkpointed phase
+        return bench_variant(K, B, D, n_dcs, warmup,
+                             np.random.default_rng(seed),
                              coalesce, gc_every_v, n_appends)
 
     sweep = headline_sweep(n_steps, gc_every)
@@ -242,9 +252,9 @@ def bench_device(K, B, n_steps, D, n_dcs, warmup=2, gc_every=4):
     # (XLA scatter is serialized per row but sublinear in batch size);
     # overflow is deducted and reported.  Non-reads variants drop
     # their ~1 GB final state immediately.
-    v1 = run_variant(*sweep["b1"][:3])[0]
-    v8 = run_variant(*sweep["b8"][:3])[0]
-    v4, stc, frontier, fetch_oh = run_variant(*sweep["b4"][:3])
+    v1 = run_variant(*sweep["b1"])[0]
+    v8 = run_variant(*sweep["b8"])[0]
+    v4, stc, frontier, fetch_oh = run_variant(*sweep["b4"])
     allv = (v1, v4, v8)
     variants = {"b%d_gc%d" % (v["batch_rows"], v["gc_every"]): v
                 for v in allv}

@@ -159,6 +159,132 @@ def gate_throughput(N, q_len=8, batched=True):
     return total / dt
 
 
+def gate_steady_stream(N, q_len=4, mode="ring"):
+    """Steady-stream gate mode (ISSUE 3): txns arrive ONE PER ENQUEUE
+    through the delivery path — the shape inter-DC delivery actually
+    has — instead of pre-queued in bulk, so the measured number is the
+    AMORTIZED admission cost rather than the one-shot repack the bulk
+    probe pays.  Arrival is phase-major over the shared cascade, so
+    every txn's cross-origin dependencies are already in flight when
+    it lands (the stream drains as fast as the gate admits).
+
+    Modes: ``ring`` = the device-resident ring with its coalescing
+    window, batched path pinned (the ISSUE-3 path as a probe);
+    ``repack`` = the legacy per-pass batched form (pre-PR baseline,
+    no coalescing); ``host`` = the pure host head-walk; ``adaptive``
+    = the PRODUCTION configuration (default threshold, EWMA path
+    picker) — on a platform where the host walk wins, it must land
+    near the host rate, which is the "device fixpoint at least
+    matches the host walk where it is selected" acceptance reading.
+    Returns txns/s plus the GATE_* counter deltas the amortization
+    ratios come from."""
+    from antidote_tpu import stats as _stats
+    from antidote_tpu.clocks import VC
+    from antidote_tpu.interdc.dep import (
+        GATE_DISPATCH_KINDS,
+        DependencyGate,
+    )
+    from antidote_tpu.interdc.wire import InterDcTxn
+
+    origins = [f"dc{i:03d}" for i in range(N)]
+    applied = []
+    pm = type("PM", (), {
+        "apply_remote":
+            lambda self, recs, dc, ts, ss: applied.append(dc)})()
+
+    def now_us():
+        return int(time.perf_counter() * 1e6)
+
+    if mode == "host":
+        gate = DependencyGate(pm, "self", now_us,
+                              batch_threshold=10**9, adapt=False)
+    elif mode == "adaptive":
+        gate = DependencyGate(pm, "self", now_us)  # production defaults
+    elif mode == "repack":
+        gate = DependencyGate(pm, "self", now_us, batch_threshold=1,
+                              adapt=False, device_ring=False,
+                              coalesce_us=0)
+    else:
+        gate = DependencyGate(pm, "self", now_us, batch_threshold=1,
+                              adapt=False, device_ring=True)
+    rows = _gate_cascade(N, q_len)
+    arrival = sorted(range(len(rows)),
+                     key=lambda i: (rows[i][1], rows[i][0]))
+    reg = _stats.registry
+    d0 = {k: reg.gate_dispatches.value(kind=k)
+          for k in GATE_DISPATCH_KINDS}
+    h2d0 = reg.gate_h2d_bytes.value()
+    d2h0 = reg.gate_d2h_bytes.value()
+    t0 = time.perf_counter()
+    for i in arrival:
+        oi, p, ts, deps = rows[i]
+        origin = origins[oi]
+        snap = {origin: ts - 1}
+        for dep_oi, dep_ts in deps.items():
+            snap[origins[dep_oi]] = dep_ts
+        gate.enqueue(InterDcTxn(
+            dc_id=origin, partition=0, prev_log_opid=0,
+            snapshot_vc=VC(snap), timestamp=ts, records=["r"]))
+    for _ in range(16 * q_len):
+        if not gate.pending():
+            break
+        gate.process_queues()
+    dt = time.perf_counter() - t0
+    assert gate.pending() == 0, "steady stream should fully drain"
+    total = len(rows)
+    assert len(applied) == total
+    disp = sum(reg.gate_dispatches.value(kind=k) - d0[k]
+               for k in GATE_DISPATCH_KINDS)
+    return {
+        "txns_per_sec": total / dt,
+        "dispatches_per_txn": disp / total,
+        "h2d_bytes_per_txn": (reg.gate_h2d_bytes.value() - h2d0) / total,
+        "d2h_bytes_per_txn": (reg.gate_d2h_bytes.value() - d2h0) / total,
+    }
+
+
+def gate_steady_summary(N, q_len=4):
+    """The steady-stream comparison table: each mode runs twice (the
+    first run eats the mode's XLA compiles at these shapes, like the
+    bulk probe's warm-jit double-call) and the second run is
+    reported.  The amortization ratios — pre-PR repack cost over ring
+    cost, per admitted txn — are the acceptance numbers ISSUE 3 gates
+    on (≥ 4x fewer dispatches and H2D bytes per admitted txn)."""
+    out = {}
+    for mode in ("ring", "repack", "host", "adaptive"):
+        gate_steady_stream(N, q_len, mode)          # warm the compiles
+        out[mode] = gate_steady_stream(N, q_len, mode)
+    ring, repack, host = out["ring"], out["repack"], out["host"]
+    return {
+        "txns": N * q_len,
+        "txns_per_sec_ring": round(ring["txns_per_sec"]),
+        "txns_per_sec_repack": round(repack["txns_per_sec"]),
+        "txns_per_sec_host": round(host["txns_per_sec"]),
+        "txns_per_sec_adaptive": round(out["adaptive"]["txns_per_sec"]),
+        "steady_speedup_vs_host": round(
+            ring["txns_per_sec"] / host["txns_per_sec"], 2),
+        # the production gate's regret: how close the learned routing
+        # lands to the better pure path on THIS platform
+        "adaptive_vs_host": round(
+            out["adaptive"]["txns_per_sec"] / host["txns_per_sec"], 2),
+        "ring_dispatches_per_txn": round(ring["dispatches_per_txn"], 4),
+        "repack_dispatches_per_txn": round(
+            repack["dispatches_per_txn"], 4),
+        "ring_h2d_bytes_per_txn": round(ring["h2d_bytes_per_txn"], 1),
+        "repack_h2d_bytes_per_txn": round(
+            repack["h2d_bytes_per_txn"], 1),
+        "ring_d2h_bytes_per_txn": round(ring["d2h_bytes_per_txn"], 1),
+        "repack_d2h_bytes_per_txn": round(
+            repack["d2h_bytes_per_txn"], 1),
+        "dispatch_amortization_x": round(
+            repack["dispatches_per_txn"]
+            / max(ring["dispatches_per_txn"], 1e-9), 2),
+        "h2d_amortization_x": round(
+            repack["h2d_bytes_per_txn"]
+            / max(ring["h2d_bytes_per_txn"], 1e-9), 2),
+    }
+
+
 def gate_device_kernel_rate(jax, N, q_len=8, iters=8):
     """txns/s through the device fixpoint KERNEL alone
     (interdc/dep.py gate_fixpoint), chained with one end fetch — the
@@ -218,6 +344,7 @@ def summary(jax, N=256, P=16):
     gate_dev = max(gate_dev, gate_throughput(N, batched=True))  # warm jit
     gate_host = gate_throughput(N, batched=False)
     gate_kernel = gate_device_kernel_rate(jax, N)
+    gate_steady = gate_steady_summary(N)
     # host-vs-device crossover table (round-2 verdict #5): the live gate
     # adapts at runtime from measured cost; this records where the
     # crossover sits on THIS platform for the judge's record
@@ -242,6 +369,7 @@ def summary(jax, N=256, P=16):
         "gate_device_kernel_txns_per_sec": round(gate_kernel),
         "gate_txns_per_sec_host_walk": round(gate_host),
         "gate_speedup": round(gate_dev / gate_host, 2),
+        "gate_steady": gate_steady,
         "gate_crossover": crossover,
         "vs_host_round": round(host_dt / dt, 2),
     }
@@ -251,9 +379,30 @@ def main():
     quick, jax = setup()
     N = 256 if not quick else 64
     s = summary(jax, N=N)
+    st = s["gate_steady"]
     emit("gst_gossip_round_us_256dc", s["gst_gossip_round_us"],
          "us/round", s.pop("vs_host_round"),
          device=str(jax.devices()[0]), **s)
+    # the steady-stream gate rows as their OWN headline metrics: the
+    # regression gate (tools/bench_gate.py) understands txn/dispatch
+    # and B/txn directions, so a slide back toward per-pass repack
+    # economy fails a round loudly instead of hiding in detail
+    emit("gate_steady_txns_per_sec", st["txns_per_sec_ring"], "txn/s",
+         st["steady_speedup_vs_host"],
+         host=st["txns_per_sec_host"],
+         repack=st["txns_per_sec_repack"],
+         adaptive=st["txns_per_sec_adaptive"],
+         adaptive_vs_host=st["adaptive_vs_host"], dcs=N)
+    emit("gate_steady_txns_per_dispatch",
+         round(1.0 / max(st["ring_dispatches_per_txn"], 1e-9), 2),
+         "txn/dispatch", st["dispatch_amortization_x"],
+         repack_txns_per_dispatch=round(
+             1.0 / max(st["repack_dispatches_per_txn"], 1e-9), 2),
+         dcs=N)
+    emit("gate_steady_h2d_bytes_per_txn", st["ring_h2d_bytes_per_txn"],
+         "B/txn", st["h2d_amortization_x"],
+         repack_h2d_bytes_per_txn=st["repack_h2d_bytes_per_txn"],
+         dcs=N)
 
 
 if __name__ == "__main__":

@@ -245,6 +245,30 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     ("ops/s", 1), ("txns/s", 1), ("merges/sec", 1),
     ("s", -1), ("ms", -1), ("us", -1),
     ("", 0), (None, 0), ("bytes", 0),
+    # gate amortization family (ISSUE 3): admitted/dispatch must not
+    # fall, per-admitted upload/dispatch cost must not rise — a
+    # regression back to per-pass repack fails the gate
+    ("txn/dispatch", 1), ("txns/dispatch", 1),
+    ("B/txn", -1), ("bytes/txn", -1), ("dispatches/txn", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_amortization_regression(tmp_path, capsys):
+    """A round whose gate slid back toward one-dispatch-per-txn (the
+    pre-ISSUE-3 repack economy) must fail loudly."""
+    old = dict(
+        schema_version=1, round=1,
+        metrics={"gate_steady_txns_per_dispatch": {
+            "value": 24.0, "unit": "txn/dispatch"}})
+    new = dict(
+        schema_version=1, round=2,
+        metrics={"gate_steady_txns_per_dispatch": {
+            "value": 1.1, "unit": "txn/dispatch"}})
+    import json
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(new))
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    assert "REGRESSED" in capsys.readouterr().err

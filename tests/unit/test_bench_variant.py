@@ -28,8 +28,25 @@ def test_sweep_shapes():
     assert sweep["b4"][:3] == (4, 3, 5)
     assert sweep["b8"][:3] == (8, 2, 2)
     # quick mode keeps every variant runnable
-    for c, g, n, _r in bench.headline_sweep(4).values():
+    for c, g, n, _r, _s in bench.headline_sweep(4).values():
         assert n >= 2 and g >= 1
+
+
+def test_sweep_seeds_deterministic_and_distinct():
+    """Both capture paths (bench_device in-process, hw_phase
+    subprocess) derive their rng from the sweep's per-variant seed —
+    the seed must be stable across calls (or the 'identical stream'
+    claim is void) and distinct per variant (or coalescing levels
+    replay the same ops and the comparison degenerates)."""
+    a = bench.headline_sweep(20)
+    b = bench.headline_sweep(4)
+    seeds_a = {name: v[4] for name, v in a.items()}
+    seeds_b = {name: v[4] for name, v in b.items()}
+    assert seeds_a == seeds_b  # n_steps must not perturb the seed
+    assert len(set(seeds_a.values())) == len(seeds_a)
+    # b1 keeps the historic stream (a fresh rng(0) is what the old
+    # thread-through handed it): BENCH_r01..r04 stay comparable
+    assert seeds_a["b1"] == 0
 
 
 def test_bench_variant_contract():

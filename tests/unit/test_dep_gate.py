@@ -26,10 +26,11 @@ def make_txn(origin, ts, snapshot, ping=False):
         records=[] if ping else ["r"])
 
 
-def make_gate(threshold):
+def make_gate(threshold, device_ring=True):
     pm = FakePM()
     gate = DependencyGate(pm, "dc_self", now_us=lambda: 10**9,
-                          batch_threshold=threshold)
+                          batch_threshold=threshold,
+                          device_ring=device_ring)
     return gate, pm
 
 
@@ -74,10 +75,13 @@ def run(gate, queues):
 
 
 @pytest.mark.parametrize("seed", range(6))
-def test_batched_matches_host_walk(seed):
+@pytest.mark.parametrize("ring", [True, False])
+def test_batched_matches_host_walk(seed, ring):
+    """Both batched forms — the ISSUE-3 resident ring and the legacy
+    repack — must match the host walk bit-for-bit."""
     queues = random_scenario(seed)
     host_gate, host_pm = make_gate(threshold=10**9)
-    dev_gate, dev_pm = make_gate(threshold=0)
+    dev_gate, dev_pm = make_gate(threshold=0, device_ring=ring)
     left_host = run(host_gate, {o: list(q) for o, q in queues.items()})
     left_dev = run(dev_gate, {o: list(q) for o, q in queues.items()})
     assert sorted(host_pm.applied) == sorted(dev_pm.applied)
@@ -152,7 +156,8 @@ def test_ping_advance_is_exclusive(threshold):
     assert gate2.pending() == 0
 
 
-def test_blocked_head_advances_clock_breaks_cross_block():
+@pytest.mark.parametrize("ring", [True, False])
+def test_blocked_head_advances_clock_breaks_cross_block(ring):
     """The reference's blocked-txn rule (src/inter_dc_dep_vnode.erl:
     137-143): a head that cannot apply still advances its origin's
     clock to ts-1 — without it, two origins whose heads each need a
@@ -178,7 +183,7 @@ def test_blocked_head_advances_clock_breaks_cross_block():
                 applied.append((dc, ts))
 
         g = DependencyGate(FakePM(), "dc0", lambda: 10 ** 9,
-                           batch_threshold=threshold)
+                           batch_threshold=threshold, device_ring=ring)
         g.queues["dcA"] = deque([txn("dcA", 61, {"dcB": 50}),
                                  txn("dcA", 70, {"dcB": 50})])
         g.queues["dcB"] = deque([txn("dcB", 55, {"dcA": 60}),

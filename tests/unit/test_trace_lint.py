@@ -74,3 +74,25 @@ def test_kernel_span_rule_flags_bare_jit(tmp_path):
 
 def test_kernel_span_rule_clean_on_repo():
     assert trace_lint.lint_kernel_spans(trace_lint.repo_root()) == []
+
+
+def test_kernel_span_rule_covers_interdc(tmp_path):
+    """ISSUE 3 rule: the dependency-gate ring kernels live under
+    antidote_tpu/interdc/, which the lint must sweep exactly like
+    mat/ — a bare public @jax.jit there is a dark device kernel."""
+    assert any(d.endswith(os.path.join("antidote_tpu", "interdc"))
+               for d in trace_lint._KERNEL_SPAN_DIRS)
+    d = tmp_path / "antidote_tpu" / "interdc"
+    d.mkdir(parents=True)
+    (d / "newgate.py").write_text(
+        "import jax\n"
+        "from functools import partial\n"
+        "from antidote_tpu.obs.prof import kernel_span\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def bare_ring_op(st):\n    return st\n"
+        "@kernel_span('interdc.dep')\n"
+        "@jax.jit\n"
+        "def good_ring_op(st):\n    return st\n")
+    problems = trace_lint.lint_kernel_spans(str(tmp_path))
+    flagged = {p.split("::")[1].split(":")[0] for p in problems}
+    assert flagged == {"bare_ring_op"}

@@ -45,10 +45,16 @@ DEFAULT_THRESHOLD = 0.15
 
 _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
-#: units whose value should not FALL (bigger is better)
-_HIGHER_BETTER_SUFFIXES = ("/s", "/sec")
-#: units whose value should not RISE (smaller is better)
-_LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec"}
+#: units whose value should not FALL (bigger is better).  "/dispatch"
+#: covers the gate amortization family (ISSUE 3): admitted txns per
+#: device dispatch — a regression back to per-pass repack collapses it
+#: toward 1 and must fail the gate.
+_HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch")
+#: units whose value should not RISE (smaller is better).  The
+#: "*/txn" per-admitted-cost units (H2D bytes per txn, dispatches per
+#: txn) are the other face of the same amortization story.
+_LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
+                 "b/txn", "bytes/txn", "dispatches/txn"}
 
 
 def repo_root() -> str:

@@ -44,9 +44,13 @@ def phase_headline_variant(which):
     import bench
 
     shape = bench.HEADLINE_SHAPE
-    coalesce, gc_every, n_appends, with_reads = \
+    coalesce, gc_every, n_appends, with_reads, seed = \
         bench.headline_sweep(n_steps=20)[which]
-    rng = np.random.default_rng(0)
+    # the variant's OWN sweep-derived seed: the stream is identical to
+    # the one bench_device builds in-process for this variant (the
+    # sweep is the single source of truth for the workload too, not
+    # just the shape)
+    rng = np.random.default_rng(seed)
     v, stc, frontier, fetch_oh = bench.bench_variant(
         shape["K"], shape["B"], shape["D"], shape["n_dcs"],
         shape["warmup"], rng, coalesce, gc_every, n_appends)

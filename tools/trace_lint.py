@@ -15,7 +15,9 @@ ISSUE 2 adds the device-kernel rule: every PUBLIC ``@jax.jit``-
 decorated function under antidote_tpu/mat/ must also carry a
 ``@kernel_span`` (antidote_tpu/obs/prof.py) so per-kernel timing and
 compile-cache-miss attribution cannot silently go dark when a new
-jitted entry point lands.
+jitted entry point lands.  ISSUE 3 extends the same rule to
+antidote_tpu/interdc/ — the dependency gate's resident-ring kernels
+(interdc/gate_kernels.py) are now a first-class device plane.
 
 Runs standalone (``python tools/trace_lint.py``) and from tier-1
 (tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
@@ -65,9 +67,12 @@ _INSTRUMENTED_CALLS = {
     ("tracing", "annotate"), ("prof", "annotate"),
 }
 
-#: package whose public @jax.jit functions must carry @kernel_span
-#: (ISSUE 2 — the device-plane profiler's coverage contract)
-_KERNEL_SPAN_DIR = os.path.join("antidote_tpu", "mat")
+#: packages whose public @jax.jit functions must carry @kernel_span
+#: (ISSUE 2 for mat/, ISSUE 3 for interdc/ — the device-plane
+#: profiler's coverage contract; grow this tuple when a new package
+#: gains jitted entry points, never shrink it)
+_KERNEL_SPAN_DIRS = (os.path.join("antidote_tpu", "mat"),
+                     os.path.join("antidote_tpu", "interdc"))
 
 #: decorators that wrap the whole method in a span
 _INSTRUMENTED_DECORATORS = {"traced"}
@@ -121,29 +126,31 @@ def _has_kernel_span(fn: ast.FunctionDef) -> bool:
 
 
 def lint_kernel_spans(root: str) -> List[str]:
-    """ISSUE 2 rule: public @jax.jit functions under antidote_tpu/mat/
-    must carry @kernel_span so the device-plane profiler sees them."""
+    """ISSUE 2/3 rule: public @jax.jit functions under the device-
+    plane packages (mat/, interdc/) must carry @kernel_span so the
+    profiler sees them."""
     problems: List[str] = []
-    d = os.path.join(root, _KERNEL_SPAN_DIR)
-    if not os.path.isdir(d):
-        return problems
-    for fname in sorted(os.listdir(d)):
-        if not fname.endswith(".py"):
+    for rel_dir in _KERNEL_SPAN_DIRS:
+        d = os.path.join(root, rel_dir)
+        if not os.path.isdir(d):
             continue
-        path = os.path.join(d, fname)
-        with open(path) as f:
-            tree = ast.parse(f.read(), filename=path)
-        for node in tree.body:
-            if not isinstance(node, ast.FunctionDef) \
-                    or node.name.startswith("_"):
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
                 continue
-            if any(_is_jax_jit(dec) for dec in node.decorator_list) \
-                    and not _has_kernel_span(node):
-                problems.append(
-                    f"{_KERNEL_SPAN_DIR}/{fname}::{node.name}: public "
-                    "@jax.jit entry point without @kernel_span — its "
-                    "timing and compile-miss attribution are dark "
-                    "(antidote_tpu/obs/prof.py)")
+            path = os.path.join(d, fname)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in tree.body:
+                if not isinstance(node, ast.FunctionDef) \
+                        or node.name.startswith("_"):
+                    continue
+                if any(_is_jax_jit(dec) for dec in node.decorator_list) \
+                        and not _has_kernel_span(node):
+                    problems.append(
+                        f"{rel_dir}/{fname}::{node.name}: public "
+                        "@jax.jit entry point without @kernel_span — "
+                        "its timing and compile-miss attribution are "
+                        "dark (antidote_tpu/obs/prof.py)")
     return problems
 
 
