@@ -96,6 +96,20 @@ class Config:
     device_max_dcs: int = 64
     #: per-key element-slot cap before an OR-set key evicts
     device_max_slots: int = 256
+    #: coalesced ingest plane for the materializer stores
+    #: (antidote_tpu/mat/ingest.py): each plane flush uploads ONE
+    #: packed tensor and applies it with a single donated scatter,
+    #: instead of ~10 per-column uploads.  False = the legacy
+    #: per-column append path (the benches' comparison baseline).
+    mat_ingest: bool = True
+    #: ingest coalescing window, µs: staged rows younger than this may
+    #: wait for more arrivals so a burst flushes as one dispatch even
+    #: below device_flush_ops rows; 0 disables the window
+    mat_coalesce_us: int = 2000
+    #: hard staged-row cap per plane (ingest row budget): past it the
+    #: committer flushes INLINE — backpressure so a lagging flusher
+    #: cannot let staged rows grow unboundedly
+    mat_coalesce_rows: int = 8192
     #: run threshold device flushes/GCs on a background flusher thread
     #: (group commit: commits only stage; reads needing pending data
     #: still flush inline).  Committers flush inline past 4x the

@@ -52,7 +52,7 @@ from antidote_tpu.clocks import VC, ClockDomain
 from antidote_tpu.obs import prof
 from antidote_tpu.obs.events import recorder
 from antidote_tpu.obs.spans import tracer
-from antidote_tpu.mat import store
+from antidote_tpu.mat import ingest, store
 from antidote_tpu.mat.materializer import Payload
 
 log = logging.getLogger(__name__)
@@ -61,18 +61,11 @@ log = logging.getLogger(__name__)
 #: int64 arithmetic in the fold
 _VC_INF = (1 << 62)
 
-_MIN_BUCKET = 64
-
-
-def _bucket(n: int) -> int:
-    # powers of FOUR: each padded shape is a distinct XLA program, and
-    # concurrent clients produce arbitrary flush sizes — quantizing
-    # coarser keeps the program count (hence in-run compiles) small at
-    # the cost of ≤4x padding on the rare odd-sized batch
-    b = _MIN_BUCKET
-    while b < n:
-        b *= 4
-    return b
+#: the ONE dispatch-bucket quantizer (powers of four, floor 64) —
+#: shared with the packed ingest packer so serving flushes and warm
+#: compiles can never bucket to different shapes (mat/ingest.py)
+_MIN_BUCKET = ingest._MIN_BUCKET
+_bucket = ingest.bucket
 
 
 #: read-fold dispatch counter (tests assert the fused cross-partition
@@ -193,12 +186,21 @@ class _PlaneBase:
 
     def __init__(self, domain: ClockDomain, key_capacity: int,
                  n_lanes: int, flush_ops: int, gc_ops: int,
-                 max_dcs: int):
+                 max_dcs: int,
+                 ingest_settings: Optional[ingest.IngestSettings] = None):
         self.domain = domain
         self.n_lanes = n_lanes
         self.flush_ops = flush_ops
         self.gc_ops = gc_ops
         self.max_dcs = max_dcs
+        #: coalesced-ingest knobs (mat/ingest.py): packed single-H2D
+        #: flushes, the staging window, and the row budget.  Built by
+        #: the one factory (ingest_from_config) at the DevicePlane /
+        #: sharded-store assembly so every plane honors the same knobs.
+        self._ingest = ingest_settings or ingest.IngestSettings()
+        #: monotonic µs stamp of the oldest staged row (drives the
+        #: coalescing window); meaningless while ``rows`` is empty
+        self._stage_t0_us = 0
         self.key_index: Dict[Any, int] = {}
         self.rev_keys: List[Any] = []
         #: staged decoded rows (lists of python ints / pair-lists)
@@ -259,10 +261,13 @@ class _PlaneBase:
         executing the program is a no-op on the discarded result."""
         if type(self)._append_fn is None:
             return
+        packed_mode = (self._ingest.enabled
+                       and self._packed_perm() is not None)
         shapes = tuple(
             (tuple(x.shape), str(getattr(x, "dtype", "")))
             for x in jax.tree_util.tree_leaves(self.st))
-        base_key = (id(type(self)._append_fn), shapes)
+        base_key = (id(ingest.packed_append) if packed_mode
+                    else id(type(self)._append_fn), shapes)
         todo = []
         with _WARM_LOCK:
             for b in buckets:
@@ -284,11 +289,21 @@ class _PlaneBase:
         def run():
             st = st_copy
             for b in todo:
-                ki = np.full(b, cap, dtype=np.int32)
-                lo = np.zeros(b, dtype=np.int32)
-                arrays = [np.zeros((b, d) if tag == "vv" else b,
-                                   dtype=np.int64) for tag in cols]
                 try:
+                    if packed_mode:
+                        # the serving path is the packed single-upload
+                        # flush: warm ITS program at the same buckets
+                        pk = np.zeros(
+                            (b, 2 + ingest.packed_width(cols, d)),
+                            dtype=np.int64)
+                        pk[:, 0] = cap  # all padding: a no-op program
+                        st, _over = ingest.packed_append(
+                            st, jnp.asarray(pk))
+                        continue
+                    ki = np.full(b, cap, dtype=np.int32)
+                    lo = np.zeros(b, dtype=np.int32)
+                    arrays = [np.zeros((b, d) if tag == "vv" else b,
+                                       dtype=np.int64) for tag in cols]
                     st, _over = fn(st, jnp.asarray(ki),
                                    jnp.asarray(lo),
                                    *(jnp.asarray(a) for a in arrays))
@@ -360,12 +375,31 @@ class _PlaneBase:
         self.warm_appends()
         self.warm_reads()
 
+    def _packed_perm(self):
+        """Ops-layout permutation for this plane's packed flushes, or
+        None when the store has no packed form."""
+        return ingest.perm_for(type(self)._append_fn)
+
     def _append_rows(self, rows: List[tuple]) -> np.ndarray:
-        """Device-append decoded rows via the shared packing
-        (:func:`_pack_rows`); returns bool[n] overflow."""
+        """Device-append decoded rows; returns bool[n] overflow.
+
+        Coalesced path (mat/ingest.py, default): ONE packed host
+        tensor, ONE upload, one donated-scatter dispatch.  Legacy path
+        (``mat_ingest=False``): the historical per-column packing —
+        ~10 separate uploads per flush — kept as the benches'
+        comparison baseline."""
         n = len(rows)
         if n == 0:
             return np.zeros(0, dtype=bool)
+        perm = self._packed_perm()
+        if self._ingest.enabled and perm is not None:
+            packed = ingest.pack_rows(rows, self.capacity,
+                                      self.domain.d, self._row_cols,
+                                      perm)
+            self.st, overflow = ingest.packed_append(
+                self.st, jnp.asarray(packed))
+            ingest.note_dispatch(n, packed.nbytes)
+            return np.asarray(overflow)[:n]
         ki, lo, arrays = _pack_rows(rows, self.capacity, self.domain.d,
                                     self._row_cols)
         self.st, overflow = type(self)._append_fn(
@@ -387,7 +421,7 @@ class _PlaneBase:
             if len(self.domain) >= self.max_dcs:
                 return None
             if len(self.domain) >= self.domain.d:
-                self.flush()  # staged rows were decoded at the old width
+                self.flush("grow")  # staged rows decoded at the old width
                 new_d = min(self.domain.d * 2, self.max_dcs)
                 self.domain = self.domain.grow(new_d)
                 self._grow_dcs(new_d)
@@ -398,7 +432,7 @@ class _PlaneBase:
         idx = self.key_index.get(key)
         if idx is None:
             if len(self.rev_keys) >= self.capacity:
-                self.flush()
+                self.flush("grow")
                 self.capacity *= 2
                 self._grow_keys(self.capacity)
                 self._post_grow()
@@ -441,6 +475,8 @@ class _PlaneBase:
         holds this op; staging would write into purged lanes)."""
         if self.key_index.get(key) != idx:
             return
+        if not self.rows:
+            self._stage_t0_us = time.monotonic_ns() // 1000
         self.rows.extend(rows)
         self.pending_keys.add(key)
 
@@ -457,7 +493,7 @@ class _PlaneBase:
         readers next to the vnode process (reference
         src/clocksi_readitem_server.erl:95-110)."""
         if key in self.pending_keys:
-            self.flush()
+            self.flush("read")
         idx = self.key_index.get(key)
         if idx is None:
             raise ReadBelowBase()  # evicted during the flush — host path
@@ -477,7 +513,7 @@ class _PlaneBase:
         them from the host path); safe to run outside the lock like
         read_begin's closure."""
         if self.pending_keys and not self.pending_keys.isdisjoint(keys):
-            self.flush()
+            self.flush("read")
         owned = [k for k in keys if k in self.key_index]
         if not owned:
             return dict
@@ -557,33 +593,55 @@ class _PlaneBase:
     #: with this plane to run flush/gc on the flusher thread
     _schedule = None
 
+    def _window_due(self, n_rows: int) -> bool:
+        """True when staged rows outlived the coalescing window
+        (mat_coalesce_us): the next stage tick flushes the whole burst
+        as one dispatch even below the flush_ops threshold — bounded
+        device-state staleness, the gate-ring window's plane analogue."""
+        return (n_rows > 0 and self._ingest.coalesce_us > 0
+                and (time.monotonic_ns() // 1000 - self._stage_t0_us)
+                >= self._ingest.coalesce_us)
+
     def maybe_flush_gc(self, stable_vc: Optional[VC]) -> None:
         if stable_vc is not None:
             self._last_stable = (stable_vc if self._last_stable is None
                                  else self._last_stable.join(stable_vc))
-        due_flush = len(self.rows) >= self.flush_ops
+        n_rows = len(self.rows)
+        window_due = self._window_due(n_rows)
+        due_flush = n_rows >= self.flush_ops or window_due
         due_gc = (stable_vc is not None
                   and self._ops_since_gc >= self.gc_ops)
         if not (due_flush or due_gc):
             return
         if self._schedule is not None \
-                and len(self.rows) < 4 * self.flush_ops:
+                and n_rows < min(4 * self.flush_ops,
+                                 self._ingest.row_budget):
             # group commit: the committing transaction only stages; the
             # device work runs on the flusher thread.  Past 4x the
-            # threshold the committer flushes INLINE — backpressure so
+            # threshold (or the ingest row budget, whichever is
+            # tighter) the committer flushes INLINE — backpressure so
             # a lagging flusher cannot let staged rows grow unboundedly
             self._schedule(self)
             return
         if due_flush:
-            self.flush()
+            if n_rows >= self._ingest.row_budget:
+                kind = "budget"
+            elif n_rows >= self.flush_ops:
+                kind = "rows"
+            else:
+                kind = "window"
+            self.flush(kind)
         if due_gc:
             self.gc(self._last_stable or stable_vc)
 
     def flush_gc_now(self) -> None:
         """Flusher-thread entry: run any due flush/GC (caller holds the
         partition lock and has quiesced device readers)."""
-        if len(self.rows) >= self.flush_ops:
-            self.flush()
+        n_rows = len(self.rows)
+        if n_rows >= self.flush_ops:
+            self.flush("rows")
+        elif self._window_due(n_rows):
+            self.flush("window")
         if self._last_stable is not None \
                 and self._ops_since_gc >= self.gc_ops:
             self.gc(self._last_stable)
@@ -598,18 +656,20 @@ class _PlaneBase:
         partition lock with readers quiesced, and the new programs
         warm before the serving threads first use them."""
         if len(self.rev_keys) * 8 >= self.capacity * 7:
-            self.flush()
+            self.flush("grow")
             self.capacity *= 2
             self._grow_keys(self.capacity)
             self._post_grow()
 
-    def flush(self) -> None:
+    def flush(self, kind: str = "explicit") -> None:
         """Drain staged rows into the device ring, padded to a bucket.
         Rows whose key ring is full force a GC at the newest stable
         snapshot and one retry; still-overflowing keys evict to the
-        host path."""
+        host path.  ``kind`` labels the flush trigger for the INGEST_*
+        counters (mat/ingest.py INGEST_FLUSH_KINDS)."""
         if not self.rows:
             return
+        ingest.note_flush(kind)
         rows, self.rows = self.rows, []
         self.pending_keys.clear()
         # chunk at the configured batch size: a backlog above flush_ops
@@ -667,7 +727,7 @@ class _PlaneBase:
         # let the flush's overflow-retry fold at this horizon too
         self._last_stable = (stable_vc if self._last_stable is None
                              else self._last_stable.join(stable_vc))
-        self.flush()
+        self.flush("gc")
         pairs = self._ss_pairs(stable_vc)
         if pairs is None:
             return
@@ -714,14 +774,15 @@ class OrsetPlane(_PlaneBase):
     _append_fn = staticmethod(store.orset_append)
 
     def __init__(self, domain, key_capacity, n_lanes, n_slots, flush_ops,
-                 gc_ops, max_dcs, max_slots):
+                 gc_ops, max_dcs, max_slots, ingest_settings=None):
         self.n_slots = n_slots
         self.max_slots = max_slots
         #: per key-idx: element -> slot and slot -> element
         self.elem_index: List[Dict[Any, int]] = []
         self.rev_elems: List[List[Any]] = []
         super().__init__(domain, key_capacity, n_lanes, flush_ops,
-                         gc_ops, max_dcs)
+                         gc_ops, max_dcs,
+                         ingest_settings=ingest_settings)
 
     def _init_state(self, key_capacity):
         return store.orset_shard_init(
@@ -735,7 +796,7 @@ class OrsetPlane(_PlaneBase):
         self.st = store.orset_grow(self.st, n_keys=new_k)
 
     def _grow_slots(self, new_e):
-        self.flush()
+        self.flush("grow")
         self.n_slots = new_e
         self.st = store.orset_grow(self.st, n_slots=new_e)
 
@@ -993,9 +1054,10 @@ class FlagEwPlane(OrsetPlane):
     type_name = "flag_ew"
 
     def __init__(self, domain, key_capacity, n_lanes, flush_ops, gc_ops,
-                 max_dcs):
+                 max_dcs, ingest_settings=None):
         super().__init__(domain, key_capacity, n_lanes, 1, flush_ops,
-                         gc_ops, max_dcs, max_slots=1)
+                         gc_ops, max_dcs, max_slots=1,
+                         ingest_settings=ingest_settings)
 
     def stage(self, key, payload: Payload) -> None:
         idx = self._key_idx(key)
@@ -1088,7 +1150,7 @@ class RwsetPlane(OrsetPlane):
         self.st = store.rwset_grow(self.st, n_keys=new_k)
 
     def _grow_slots(self, new_e):
-        self.flush()
+        self.flush("grow")
         self.n_slots = new_e
         self.st = store.rwset_grow(self.st, n_slots=new_e)
 
@@ -1194,9 +1256,10 @@ class FlagDwPlane(RwsetPlane):
     type_name = "flag_dw"
 
     def __init__(self, domain, key_capacity, n_lanes, flush_ops, gc_ops,
-                 max_dcs):
+                 max_dcs, ingest_settings=None):
         super().__init__(domain, key_capacity, n_lanes, 1, flush_ops,
-                         gc_ops, max_dcs, max_slots=1)
+                         gc_ops, max_dcs, max_slots=1,
+                         ingest_settings=ingest_settings)
 
     def stage(self, key, payload: Payload) -> None:
         idx = self._key_idx(key)
@@ -1279,7 +1342,7 @@ class SetGoPlane(OrsetPlane):
         self.st = store.setgo_grow(self.st, n_keys=new_k)
 
     def _grow_slots(self, new_e):
-        self.flush()
+        self.flush("grow")
         self.n_slots = new_e
         self.st = store.setgo_grow(self.st, n_slots=new_e)
 
@@ -1360,7 +1423,7 @@ class LwwPlane(_PlaneBase):
     _append_fn = staticmethod(store.lww_append)
 
     def __init__(self, domain, key_capacity, n_lanes, flush_ops, gc_ops,
-                 max_dcs):
+                 max_dcs, ingest_settings=None):
         #: sorted actor strings; rank = index in this list
         self.actors_sorted: List[str] = []
         self._rank: Dict[str, int] = {}
@@ -1368,7 +1431,8 @@ class LwwPlane(_PlaneBase):
         self.val_index: Dict[Any, int] = {}
         self.rev_vals: List[Any] = []
         super().__init__(domain, key_capacity, n_lanes, flush_ops,
-                         gc_ops, max_dcs)
+                         gc_ops, max_dcs,
+                         ingest_settings=ingest_settings)
 
     def _init_state(self, key_capacity):
         return store.lww_shard_init(
@@ -1385,7 +1449,7 @@ class LwwPlane(_PlaneBase):
             return None
         rank = self._rank.get(actor)
         if rank is None:
-            self.flush()  # staged rows carry old-rank packed ties
+            self.flush("grow")  # staged rows carry old-rank ties
             new_sorted = sorted(self.actors_sorted + [actor])
             remap = np.asarray(
                 [new_sorted.index(a) for a in self.actors_sorted],
@@ -1421,7 +1485,7 @@ class LwwPlane(_PlaneBase):
         rebuild the directory, and remap the device columns
         (store.lww_reval).  Keeps register-heavy workloads from leaking
         one value object per assign forever."""
-        self.flush()
+        self.flush("grow")
         ops_val = np.asarray(self.st.ops[:, store._LVAL])
         valid = np.asarray(self.st.valid)
         bval = np.asarray(self.st.base_val)
@@ -1544,7 +1608,7 @@ class RgaPlane(_PlaneBase):
 
     def __init__(self, domain, key_capacity, flush_ops, gc_ops, max_dcs,
                  pb: int = 256, nw: int = 256, md: int = 64,
-                 actor_bits: int = 8):
+                 actor_bits: int = 8, ingest_settings=None):
         self.pb0, self.nw0, self.md0 = pb, nw, md
         self.actor_bits = actor_bits
         self._max_lam = 1 << (31 - actor_bits)
@@ -1554,7 +1618,7 @@ class RgaPlane(_PlaneBase):
         self.elem_index: List[dict] = []
         self.rev_elems: List[list] = []
         super().__init__(domain, key_capacity, 1, flush_ops, gc_ops,
-                         max_dcs)
+                         max_dcs, ingest_settings=ingest_settings)
 
     # -- storage hooks ------------------------------------------------------
 
@@ -1706,25 +1770,29 @@ class RgaPlane(_PlaneBase):
             dels = [r for r in group if r[1] == 1]
 
             def col(rs, j, dt=np.int32):
-                return jnp.asarray(np.asarray([r[j] for r in rs],
-                                              dtype=dt))
+                return np.asarray([r[j] for r in rs], dtype=dt)
 
             def ss(rs):
                 m = np.zeros((len(rs), d), dtype=np.int64)
                 for i, r in enumerate(rs):
                     for c, t in r[9]:
                         m[i, c] = max(m[i, c], t)
-                return jnp.asarray(m)
+                return m
 
             # bucketed append: per-commit group sizes vary freely, and
             # un-padded blocks would mint one XLA program per distinct
-            # (inserts, deletes) pair (rga_store.rga_append_padded)
+            # (inserts, deletes) pair.  The coalesced form uploads the
+            # whole block as ONE packed tensor (mat/ingest.py economy);
+            # the legacy per-column form stays as the baseline knob.
+            append = (rga_store.rga_append_coalesced
+                      if self._ingest.enabled
+                      else rga_store.rga_append_padded)
             ins_cols = (col(ins, 2), col(ins, 3), col(ins, 4),
                         col(ins, 5), col(ins, 6), col(ins, 7),
                         col(ins, 8, np.int64), ss(ins))
             del_cols = (col(dels, 2), col(dels, 3), col(dels, 7),
                         col(dels, 8, np.int64), ss(dels))
-            st, ok = rga_store.rga_append_padded(st, ins_cols, del_cols)
+            st, ok = append(st, ins_cols, del_cols)
             if not bool(ok):
                 # fold what is stable, then grow to fit the backlog
                 if self._last_stable is not None:
@@ -1749,8 +1817,7 @@ class RgaPlane(_PlaneBase):
                 while md < need_d:
                     md *= 2
                 st = rga_store.rga_grow(st, nw=nw, md=md)
-                st, ok = rga_store.rga_append_padded(st, ins_cols,
-                                                     del_cols)
+                st, ok = append(st, ins_cols, del_cols)
                 assert bool(ok), "rga append must fit after grow"
             self.st[idx] = st
         return overflow
@@ -1802,7 +1869,7 @@ class RgaPlane(_PlaneBase):
         cross-key batching), so the base's padded-idx plumbing reduces
         to a reader per owned key."""
         if self.pending_keys and not self.pending_keys.isdisjoint(keys):
-            self.flush()
+            self.flush("read")
         owned = [k for k in keys if k in self.key_index]
         if not owned:
             return dict
@@ -1948,9 +2015,9 @@ class MapPlane:
         if not any(p.rows for p in self._all_planes()):
             self.pending_keys.clear()
 
-    def flush(self) -> None:
+    def flush(self, kind: str = "explicit") -> None:
         for p in self._all_planes():
-            p.flush()
+            p.flush(kind)
         self.pending_keys.clear()
 
     def gc(self, stable_vc: VC) -> None:
@@ -2004,10 +2071,10 @@ class MapPlane:
         for ntype, pairs in group(owned).items():
             sub = self._sub(ntype)
             if not sub.pending_keys.isdisjoint(pairs):
-                sub.flush()
+                sub.flush("read")
         if self._presence is not None and not \
                 self._presence.pending_keys.isdisjoint(owned):
-            self._presence.flush()
+            self._presence.flush("read")
         owned = [k for k in owned if k in self.fields]  # flush may evict
         if not owned:
             return dict
@@ -2064,7 +2131,8 @@ class DevicePlane:
     def __init__(self, config=None, key_capacity: int = 1024,
                  n_lanes: int = 8, n_slots: int = 8,
                  flush_ops: int = 256, gc_ops: int = 2048,
-                 max_dcs: int = 64, max_slots: int = 256):
+                 max_dcs: int = 64, max_slots: int = 256,
+                 ingest_settings: Optional[ingest.IngestSettings] = None):
         if config is not None:
             key_capacity = config.device_key_capacity
             n_lanes = config.device_lanes
@@ -2073,6 +2141,11 @@ class DevicePlane:
             gc_ops = config.device_gc_ops
             max_dcs = config.device_max_dcs
             max_slots = config.device_max_slots
+            # the ONE ingest factory (mat/ingest.py): the sharded
+            # stores build their settings from the same call, so the
+            # single-shard and mesh assemblies honor the same knobs
+            ingest_settings = ingest.ingest_from_config(config)
+        ing = ingest_settings or ingest.IngestSettings()
         slotted = {"set_aw": OrsetPlane, "register_mv": MvregPlane,
                    "set_rw": RwsetPlane, "set_go": SetGoPlane}
         flat = {"counter_pn": CounterPlane, "register_lww": LwwPlane,
@@ -2084,9 +2157,10 @@ class DevicePlane:
             if tn in slotted:
                 return slotted[tn](ClockDomain(8), key_capacity, n_lanes,
                                    n_slots, flush_ops, gc_ops, max_dcs,
-                                   max_slots)
+                                   max_slots, ingest_settings=ing)
             return flat[tn](ClockDomain(8), key_capacity, n_lanes,
-                            flush_ops, gc_ops, max_dcs)
+                            flush_ops, gc_ops, max_dcs,
+                            ingest_settings=ing)
 
         self.planes: Dict[str, Any] = {
             tn: make(tn) for tn in (*slotted, *flat)}
@@ -2094,7 +2168,8 @@ class DevicePlane:
             "map_go", make, make_presence=lambda: make("set_go"))
         self.planes["map_rr"] = MapPlane("map_rr", make)
         self.planes["rga"] = RgaPlane(
-            ClockDomain(8), key_capacity, flush_ops, gc_ops, max_dcs)
+            ClockDomain(8), key_capacity, flush_ops, gc_ops, max_dcs,
+            ingest_settings=ing)
         #: mesh device this partition's plane states are committed to
         #: (None = default device); see place_on
         self.device = None

@@ -44,12 +44,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from antidote_tpu.clocks import dense
 from antidote_tpu.obs import prof
 from antidote_tpu.runtime import COLLECTIVE_LOCK
-from antidote_tpu.mat import store
+from antidote_tpu.mat import ingest, store
 
 
 class _ShardedBase:
@@ -74,7 +75,8 @@ class _ShardedBase:
     #: filter: masked-off rows scatter nowhere and report no overflow)
     _append_store_fn = None
 
-    def __init__(self, mesh: Mesh, n_keys: int, st):
+    def __init__(self, mesh: Mesh, n_keys: int, st,
+                 ingest_settings: Optional[ingest.IngestSettings] = None):
         assert "part" in mesh.axis_names
         self.mesh = mesh
         self.n_shards = mesh.shape["part"]
@@ -84,6 +86,10 @@ class _ShardedBase:
         self.keys_per_shard = n_keys // self.n_shards
         self.key_sh = NamedSharding(mesh, P("part"))
         self.rep = NamedSharding(mesh, P())
+        #: coalesced-ingest knobs — built by the SAME factory the
+        #: DevicePlane uses (ingest.ingest_from_config), so the mesh
+        #: and single-shard assemblies honor identical knobs
+        self.ingest = ingest_settings or ingest.IngestSettings()
         self.st = self._shard_state(st)
         self._jits = {}
 
@@ -219,6 +225,39 @@ class _ShardedBase:
             self.st, overflow = fn(self.st, *args)
         return overflow
 
+    def append_packed(self, packed, n_ops: Optional[int] = None
+                      ) -> jax.Array:
+        """Coalesced-ingest form of :meth:`append`: ONE replicated
+        upload of the packed ``int64[B, 2+F]`` tensor (mat/ingest.py
+        layout — [global key, lane_off, <ops-row columns>]) instead of
+        one per payload column; each chip splits the index columns and
+        masks to its own key range.  Same overflow contract."""
+        base = self
+
+        def local_append_packed(st, packed):
+            key_idx, lane_off, rows = ingest.split_packed(
+                packed, st.ops.dtype)
+            local, mine = base._local_mask(key_idx)
+            st, overflow = store._scatter_rows(
+                st, jnp.where(mine, local, base.keys_per_shard),
+                lane_off, rows, active=mine)
+            return st, jax.lax.pmax(overflow, "part")
+
+        fn = self._sm(local_append_packed,
+                      in_specs=(self._state_spec, P()),
+                      out_specs=(self._state_spec, P()), donate=True)
+        packed = np.asarray(packed, dtype=np.int64)
+        (dev,) = self._rep_put(packed)
+        with COLLECTIVE_LOCK, prof.annotate("sharded_append_packed"):
+            self.st, overflow = fn(self.st, dev)
+        if n_ops is None:
+            # padding rows carry an out-of-range key (the pack_rows
+            # drop sentinel): counting them would inflate the
+            # ops-per-dispatch amortization gauge the benches gate on
+            n_ops = int(np.sum(packed[:, 0] < self.n_keys))
+        ingest.note_dispatch(n_ops, packed.nbytes)
+        return overflow
+
     # ------------------------------------------------------------- reads
 
     def read(self, read_vc) -> jax.Array:
@@ -271,12 +310,14 @@ class ShardedOrsetStore(_ShardedBase):
     _key_fields = frozenset({"dots", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
-                 n_slots: int, n_dcs: int, dtype=jnp.int64):
+                 n_slots: int, n_dcs: int, dtype=jnp.int64,
+                 ingest_settings=None):
         # int64 default like the other public shard inits: op_ct/op_ss
         # columns carry epoch-µs timestamps, which silently truncate in
         # int32 (callers that bench int32 pass it explicitly)
         super().__init__(mesh, n_keys, store.orset_shard_init(
-            n_keys, n_lanes, n_slots, n_dcs, dtype=dtype))
+            n_keys, n_lanes, n_slots, n_dcs, dtype=dtype),
+            ingest_settings=ingest_settings)
 
 
 class ShardedCounterStore(_ShardedBase):
@@ -291,8 +332,9 @@ class ShardedCounterStore(_ShardedBase):
     _key_fields = frozenset({"value", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
-                 n_dcs: int, dtype=jnp.int64):
+                 n_dcs: int, dtype=jnp.int64, ingest_settings=None):
         super().__init__(mesh, n_keys, store.counter_shard_init(
-            n_keys, n_lanes, n_dcs, dtype=dtype))
+            n_keys, n_lanes, n_dcs, dtype=dtype),
+            ingest_settings=ingest_settings)
 
 

@@ -302,6 +302,34 @@ class Registry:
             "antidote_gate_admitted_per_dispatch",
             "Amortization ratio of the batched gate path: admitted "
             "txns per device dispatch over the process lifetime")
+        # ---- coalesced materializer ingest (ISSUE 4,
+        # antidote_tpu/mat/ingest.py): the shard stores' staging
+        # economy — one packed H2D per flush instead of ~10 per-column
+        # uploads, with a coalescing window and row budget.  The
+        # ops-per-dispatch gauge (and H2D bytes per op derived from
+        # these counters) is what the mvreg/RGA bench rows gate on.
+        self.ingest_flushes = Counter(
+            "antidote_ingest_flushes_total",
+            "Materializer ingest flushes by trigger kind (rows "
+            "threshold / coalescing window / row-budget backpressure / "
+            "read / gc horizon / capacity grow / explicit)",
+            labels=("kind",))
+        self.ingest_dispatches = Counter(
+            "antidote_ingest_device_dispatches_total",
+            "Packed-append device dispatches by the coalesced ingest "
+            "plane (one per flush chunk; the legacy per-column path "
+            "does not count here — it is the comparison baseline)")
+        self.ingest_coalesced_ops = Counter(
+            "antidote_ingest_coalesced_ops_total",
+            "Ops applied through packed coalesced flushes")
+        self.ingest_h2d_bytes = Counter(
+            "antidote_ingest_h2d_bytes_total",
+            "Host-to-device bytes uploaded by packed ingest flushes "
+            "(one tensor per dispatch)")
+        self.ingest_ops_per_dispatch = Gauge(
+            "antidote_ingest_ops_per_dispatch",
+            "Amortization ratio of the coalesced ingest plane: ops "
+            "per packed device dispatch over the process lifetime")
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -315,7 +343,10 @@ class Registry:
                 self.gate_dispatches, self.gate_h2d_bytes,
                 self.gate_d2h_bytes, self.gate_admitted_batched,
                 self.gate_coalesced, self.gate_ring_rebuilds,
-                self.gate_admitted_per_dispatch)
+                self.gate_admitted_per_dispatch,
+                self.ingest_flushes, self.ingest_dispatches,
+                self.ingest_coalesced_ops, self.ingest_h2d_bytes,
+                self.ingest_ops_per_dispatch)
 
     def exposition(self) -> str:
         lines = []

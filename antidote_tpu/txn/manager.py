@@ -390,10 +390,35 @@ class PartitionManager:
         concurrent same-key dots at one DC, which the device plane's
         per-DC dot collapse cannot represent — dot-bearing types from
         such commits stay on the host path (evicting the key's device
-        history first if it has any)."""
+        history first if it has any).
+
+        ORDERING (the round-5 transient-miss horizon race): any
+        device-quiesce wait must happen BEFORE the op becomes visible
+        in key_frontier / the value cache.  _wait_device_quiesce waits
+        on the condition, RELEASING self._lock — a reader slipping in
+        while the frontier already covered the unstaged op would pass
+        covers_all, fold device state missing the op, and _cache_put
+        would pin that stale value under the NEW frontier object (a
+        poisoned hit for every read until the key's next publish).
+        Waiting first keeps the invariant a reader relies on: whatever
+        the frontier covers is visible to a device fold captured now."""
+        if self.device is not None:
+            unsound = (not payload.certified
+                       and type_name in self.device.dot_collapse_types)
+            device_route = (not unsound
+                            and self.device.accepts(type_name, key))
+            evict_route = unsound and self.device.owns(type_name, key)
+            if device_route or evict_route:
+                # the accepts/owns decisions are re-checked after the
+                # wait (another publisher can run a whole stage-
+                # overflow-EVICT cycle in the window, see below)
+                self._wait_device_quiesce()
         # join the FULL commit VC (snapshot deps included): covers_all
         # must imply the read's inclusion mask admits this op, and the
-        # mask tests the whole commit VC, not just the commit entry
+        # mask tests the whole commit VC, not just the commit entry.
+        # Read fr_old AFTER any wait above: a same-key publisher that
+        # completed during the window moved the frontier, and the warm
+        # cache update below must chain from the CURRENT entry.
         fr_old = self.key_frontier.get(key)
         fr_new = (fr_old or VC()).join(payload.commit_vc())
         self.key_frontier[key] = fr_new
@@ -434,17 +459,15 @@ class PartitionManager:
             # of paying a host materialization per commit forever
             self._val_cache.pop(key, None)
         if self.device is not None:
-            unsound = (not payload.certified
-                       and type_name in self.device.dot_collapse_types)
-            if not unsound and self.device.accepts(type_name, key):
-                # _wait_device_quiesce WAITS ON THE CONDITION, releasing
-                # self._lock: another publisher can run a whole
-                # stage-overflow-EVICT cycle in the window, so the
-                # accepts() decision above may be stale when we resume.
-                # Staging anyway would re-register the evicted key with
-                # only this op's history — a silently diverging replica
-                # (caught by the concurrent-writers chaos test).
-                self._wait_device_quiesce()
+            if device_route:
+                # the wait already ran above, with the lock held
+                # continuously since: the frontier advance and the
+                # stage are atomic to readers.  The re-check guards the
+                # stage-overflow-EVICT cycle another publisher may have
+                # run during the wait window — staging anyway would
+                # re-register the evicted key with only this op's
+                # history, a silently diverging replica (caught by the
+                # concurrent-writers chaos test).
                 if self.device.accepts(type_name, key):
                     # the plane owns the op from here — including the
                     # eviction path, where the key's whole history (this
@@ -455,10 +478,9 @@ class PartitionManager:
                 # the log, which already holds this op (every caller
                 # appends before publishing), so nothing more to insert
                 return
-            if unsound and self.device.owns(type_name, key):
+            if evict_route:
                 # eviction migrates the full log history — which already
                 # contains this op — so nothing more to insert
-                self._wait_device_quiesce()
                 if self.device.owns(type_name, key):  # see re-check above
                     self.device.planes[type_name].evict(key)
                 return

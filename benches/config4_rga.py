@@ -25,7 +25,15 @@ from antidote_tpu.mat.synth import rga_trace
 
 
 def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
-                             block=1024, fold_every=8):
+                             block=1024, fold_every=8,
+                             coalesced=True, counters=None):
+    """``coalesced`` routes the window appends through the packed
+    single-upload form (rga_store.rga_append_coalesced, ISSUE 4) vs
+    the legacy 13-per-column-upload form (rga_append_padded — the
+    baseline knob).  ``counters`` (optional dict) accumulates the
+    steady loop's device-dispatch/H2D economy: dispatches = kernel
+    launches + H2D transfers (each upload is its own host->device
+    round trip on the hardware tunnel), bytes = uploaded payload."""
     import jax.numpy as jnp
 
     rng = np.random.default_rng(0)
@@ -57,6 +65,29 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
         pb=1 << (n_ins - 1).bit_length(), nw=16 * block, md=4 * block)
 
     dptr = 0
+    ctr = counters if counters is not None else {}
+    ctr.setdefault("dispatches", 0)
+    ctr.setdefault("h2d_bytes", 0)
+    ctr.setdefault("ops", 0)
+    append_fn = (rga_store.rga_append_coalesced if coalesced
+                 else rga_store.rga_append_padded)
+
+    def _note_append(b, c, d=1):
+        """Dispatch/byte accounting for one append block (padded to
+        the rga_store buckets)."""
+        bp = rga_store._append_bucket(b)
+        cp = rga_store._append_bucket(c)
+        if coalesced:
+            # one packed [bp+cp, 7+D] int64 tensor, one upload
+            ctr["dispatches"] += 1 + 1
+            ctr["h2d_bytes"] += (bp + cp) * (7 + d) * 8
+        else:
+            # 8 ins arrays + 5 del arrays, each its own upload
+            ctr["dispatches"] += 1 + 13
+            ctr["h2d_bytes"] += (
+                bp * (5 * 4 + 4 + 8 + 8 * d)   # 5xi32, i32 dc, i64 ct, ss
+                + cp * (2 * 4 + 4 + 8 + 8 * d))
+        ctr["ops"] += b + c
 
     def append(st, lo, hi):
         nonlocal dptr
@@ -66,7 +97,7 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
         # padded append: the delete-slice length varies per block, and
         # un-padded shapes re-compile the append program every block
         # (the whole steady-state deficit of earlier rounds)
-        st, ok = rga_store.rga_append_padded(
+        st, ok = append_fn(
             st,
             (tr["ins_lamport"][sl], tr["ins_actor"][sl],
              tr["ref_lamport"][sl], tr["ref_actor"][sl],
@@ -74,6 +105,7 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
             (dlam[dsl], dact[dsl],
              *vc_cols(np.full(dhi - dptr, hi))))
         assert bool(ok)
+        _note_append(hi - lo, dhi - dptr)
         dptr = dhi
         return st
 
@@ -90,9 +122,11 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
     def step(st, fed, do_fold):
         hi = fed + block
         st = append(st, fed, hi)
+        ctr["dispatches"] += 1  # the read fold
         doc, n_vis = rga_store.rga_read_doc(st, latest)
         if do_fold:
             st = rga_store.rga_fold_host(st, hi - block)
+            ctr["dispatches"] += 1
         return st, hi, n_vis
 
     # warm the jit caches
@@ -102,12 +136,64 @@ def steady_state_ops_per_sec(jax, n_base, n_steady_blocks=8,
     fetch(nv)
     oh = time.perf_counter() - t0
 
+    # the counters report the STEADY loop only (base build + warm-up
+    # excluded — they are untimed)
+    ctr.update(dispatches=0, h2d_bytes=0, ops=0)
     t0 = time.perf_counter()
     for i in range(n_steady_blocks):
         st, fed, nv = step(st, fed, (i + 1) % fold_every == 0)
     fetch(nv)
     dt = max(time.perf_counter() - t0 - oh, 1e-9)
     return n_steady_blocks * block / dt
+
+
+def per_op_legacy_stats(jax, n_ops=160):
+    """The BENCH_r05 regression shape made explicit: ONE edit per
+    append dispatch through the legacy per-column path — 14 device
+    dispatches (1 kernel + 13 uploads) per op, every upload padded to
+    the 64-row bucket.  Returns the per-op dispatch/byte/rate stats
+    the coalesced steady rows are diffed against."""
+    rng = np.random.default_rng(0)
+    tr = rga_trace(rng, n_ops + 64, p_delete=0.0)
+
+    def vc_cols1(stamp):
+        return (np.zeros(1, np.int32),
+                np.asarray([stamp], np.int64),
+                np.zeros((1, 1), np.int64))
+
+    empty = (np.zeros(0, np.int32), np.zeros(0, np.int32),
+             np.zeros(0, np.int32), np.zeros(0, np.int64),
+             np.zeros((0, 1), np.int64))
+    st = rga_store.rga_store_init(pb=1 << (n_ops + 64).bit_length(),
+                                  nw=1 << (n_ops + 64).bit_length(),
+                                  md=64)
+
+    def one(st, i):
+        sl = slice(i, i + 1)
+        st, ok = rga_store.rga_append_padded(
+            st,
+            (tr["ins_lamport"][sl], tr["ins_actor"][sl],
+             tr["ref_lamport"][sl], tr["ref_actor"][sl],
+             tr["elem"][sl], *vc_cols1(i + 1)),
+            empty[:2] + empty[2:])
+        assert bool(ok)
+        return st
+
+    st = one(st, 0)  # warm the compile outside the timed loop
+    fetch(st.wn)
+    bp = rga_store._append_bucket(1)
+    cp = rga_store._append_bucket(0)
+    d = 1
+    per_op_bytes = (bp * (5 * 4 + 4 + 8 + 8 * d)
+                    + cp * (2 * 4 + 4 + 8 + 8 * d))
+    t0 = time.perf_counter()
+    for i in range(1, n_ops):
+        st = one(st, i)
+    fetch(st.wn)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return dict(ops_per_dispatch=round(1 / 14, 4),
+                h2d_bytes_per_op=per_op_bytes,
+                ops_per_sec=round((n_ops - 1) / dt))
 
 
 def oneshot_ops_per_sec(jax, n_ops, iters=5):
@@ -148,18 +234,49 @@ def host_ops_per_sec(n_ops=4_000):
 def main():
     quick, jax = setup()
     n_ops = 100_000 if not quick else 10_000
+    blocks = 8 if not quick else 3
+    block = 1024 if not quick else 512
+    ctr_c: dict = {}
     steady = steady_state_ops_per_sec(
-        jax, n_ops, n_steady_blocks=8 if not quick else 3,
-        block=1024 if not quick else 512)
+        jax, n_ops, n_steady_blocks=blocks, block=block,
+        coalesced=True, counters=ctr_c)
+    ctr_l: dict = {}
+    steady_legacy = steady_state_ops_per_sec(
+        jax, n_ops, n_steady_blocks=blocks, block=block,
+        coalesced=False, counters=ctr_l)
     oneshot = oneshot_ops_per_sec(jax, n_ops)
     host = host_ops_per_sec()
     emit("rga_steady_state_edit_ops_per_sec_100k_doc", round(steady),
          "ops/s", round(steady / host, 2), doc_ops=n_ops,
          device=str(jax.devices()[0]), host_baseline=round(host),
          oneshot_replay_ops_per_sec=round(oneshot),
+         legacy_percolumn_ops_per_sec=round(steady_legacy),
          note="steady = append+read+amortized-fold per 1k-op block on "
               "an incremental base+window store; host baseline measured "
               "at 4k ops (sequential splice does not reach 100k)")
+    # ISSUE 4 directional rows (bench_gate: ops/dispatch up, B/op
+    # down).  dispatches = kernel launches + H2D transfers (each
+    # upload is its own round trip on the hardware tunnel).  The
+    # baseline is the PER-OP legacy path (one edit per dispatch — the
+    # BENCH_r05 scatter-bound regression shape); the per-BLOCK legacy
+    # form rides along in detail: it already amortizes dispatches per
+    # block, and the packed tensor trades ~1.7x bytes within a block
+    # (uniform int64 columns) for 13->1 transfers.
+    per_op = per_op_legacy_stats(jax, n_ops=96 if quick else 192)
+    opd_c = ctr_c["ops"] / max(ctr_c["dispatches"], 1)
+    opd_l = ctr_l["ops"] / max(ctr_l["dispatches"], 1)
+    bpo_c = ctr_c["h2d_bytes"] / max(ctr_c["ops"], 1)
+    bpo_l = ctr_l["h2d_bytes"] / max(ctr_l["ops"], 1)
+    emit("rga_steady_ops_per_dispatch", round(opd_c, 2),
+         "ops/dispatch",
+         round(opd_c / max(per_op["ops_per_dispatch"], 1e-9), 1),
+         per_op_legacy=per_op,
+         block_legacy_ops_per_dispatch=round(opd_l, 2),
+         coalesced=ctr_c, block_legacy=ctr_l)
+    emit("rga_steady_h2d_bytes_per_op", round(bpo_c, 1), "b/op",
+         round(per_op["h2d_bytes_per_op"] / max(bpo_c, 1e-9), 1),
+         per_op_legacy=per_op,
+         block_legacy_h2d_bytes_per_op=round(bpo_l, 1))
 
 
 if __name__ == "__main__":

@@ -225,6 +225,36 @@ def test_stream_oracle_equivalence(tmp_path, type_name):
                 f"key={key} rv={rv}")
 
 
+@pytest.mark.parametrize("type_name", ["register_mv", "rga"])
+def test_stream_oracle_equivalence_legacy_ingest(tmp_path, type_name):
+    """ISSUE 4: the two hot paths rebuilt on the coalesced ingest
+    plane (mvreg over packed orset appends, the RGA steady window)
+    must stay oracle-exact with the LEGACY per-column path too — the
+    mat_ingest=False baseline knob the benches compare against."""
+    from antidote_tpu.mat.ingest import IngestSettings
+
+    gen = StreamGen(seed=11)
+    pm_dev = make_pm(tmp_path, "dev-legacy", device=True,
+                     key_capacity=4, n_lanes=4, n_slots=2,
+                     flush_ops=16, gc_ops=48,
+                     ingest_settings=IngestSettings(enabled=False))
+    pm_host = make_pm(tmp_path, "host-legacy", device=False)
+    cls = get_type(type_name)
+    for i in range(150):
+        p = gen.next_op(type_name)
+        stable = VC({d: max(t - 40, 0) for d, t in gen.clock.items()})
+        for pm in (pm_dev, pm_host):
+            publish(pm, p, stable)
+    for rv in (None, gen.snapshot()):
+        for key in gen.keys:
+            pm_dev._val_cache.clear()
+            pm_host._val_cache.clear()
+            v_dev = pm_dev.value_snapshot(key, type_name, rv)
+            v_host = pm_host.value_snapshot(key, type_name, rv)
+            assert cls.value(v_dev) == cls.value(v_host), (
+                f"key={key} rv={rv}")
+
+
 def test_orset_device_state_roundtrips_dots(tmp_path):
     """The reconstructed device state carries real (dc, seq) dots so
     read-your-writes effect application works on top of it."""

@@ -250,9 +250,48 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # regression back to per-pass repack fails the gate
     ("txn/dispatch", 1), ("txns/dispatch", 1),
     ("B/txn", -1), ("bytes/txn", -1), ("dispatches/txn", -1),
+    # ingest amortization family (ISSUE 4): ops/dispatch must not
+    # fall, per-op H2D cost must not rise — a regression back to
+    # per-op per-column appends fails the gate
+    ("ops/dispatch", 1), ("B/op", -1), ("bytes/op", -1),
+    ("dispatches/op", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_ingest_amortization_regression(tmp_path,
+                                                      capsys):
+    """ISSUE 4 synthetic two-round trajectory: round 2's mvreg/RGA
+    ingest rows slide back toward the per-op economy — ops/dispatch
+    collapses (down = regression) and H2D bytes per op balloons
+    (up = regression).  Both must fail; the unrelated throughput row
+    stays green."""
+    import json
+
+    old = _bench_body({
+        "mvreg_ingest_ops_per_dispatch": {
+            "value": 48.0, "unit": "ops/dispatch"},
+        "rga_steady_h2d_bytes_per_op": {
+            "value": 90.0, "unit": "b/op"},
+        "mvreg_assign_merges_per_sec_64dc": {
+            "value": 1_000_000, "unit": "ops/s"},
+    }, rnd=1)
+    new = _bench_body({
+        "mvreg_ingest_ops_per_dispatch": {
+            "value": 1.2, "unit": "ops/dispatch"},
+        "rga_steady_h2d_bytes_per_op": {
+            "value": 1300.0, "unit": "b/op"},
+        "mvreg_assign_merges_per_sec_64dc": {
+            "value": 1_010_000, "unit": "ops/s"},
+    }, rnd=2)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(new))
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "mvreg_ingest_ops_per_dispatch" in err
+    assert "rga_steady_h2d_bytes_per_op" in err
+    assert "merges_per_sec" not in err
 
 
 def test_gate_fails_on_amortization_regression(tmp_path, capsys):

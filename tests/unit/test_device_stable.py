@@ -66,6 +66,90 @@ def test_monotone_publish_and_floor():
     assert trk.get_stable_snapshot().get_dc("dcB") == 77
 
 
+def test_fold_vs_concurrent_puts_stress():
+    """ISSUE 4 satellite: the copy-dirty-under-lock fold
+    (_copy_dirty_locked + the out-of-lock device round trip) hammered
+    by concurrent putter threads, including mid-run domain growth (the
+    _ensure_width reset path).  Invariants pinned:
+
+    - the device-published snapshot NEVER runs ahead of the true
+      column-wise min over the host rows read AFTER the fold (rows are
+      monotone, so a correct fold is always <= that) — a violation is
+      a horizon race: a stable time covering an unapplied op;
+    - published snapshots are monotone across calls;
+    - snapshot_pair's device and host folds agree (one row-lock hold).
+
+    This pins the stable-fold layer clean; the horizon race the round-5
+    checker actually caught lived one layer up, in the publish path's
+    quiesce window (tests/unit/test_publish_horizon.py)."""
+    import threading
+
+    P = 5
+    trk = DeviceStableTimeTracker("dc0", P, _devices())
+    stop = threading.Event()
+    lock = threading.Lock()
+    dcs = [f"dc{i:02d}" for i in range(24)]
+    known = [3]  # grows past the 8-wide domain mid-run
+    clocks = [{d: 0 for d in dcs} for _ in range(P)]
+    rngs = [np.random.default_rng(p) for p in range(P)]
+    errs: list = []
+
+    def putter(p):
+        i = 0
+        try:
+            while not stop.is_set():
+                i += 1
+                with lock:
+                    if i % 100 == 0 and known[0] < len(dcs):
+                        known[0] += 1
+                    k = known[0]
+                    d = dcs[int(rngs[p].integers(0, k))]
+                    clocks[p][d] += int(rngs[p].integers(1, 5))
+                    vc = VC({dd: clocks[p][dd] for dd in dcs[:k]
+                             if clocks[p][dd]})
+                trk.put(p, vc)
+        except Exception as e:  # noqa: BLE001 — surface in the assert
+            errs.append(e)
+
+    def true_min_after():
+        with trk._lock:
+            rows = [dict(VC(trk.domain.from_dense(np.asarray(
+                trk.sender.peek_value("stable", p)))))
+                for p in range(P)]
+        return {d: min(r.get(d, 0) for r in rows) for d in dcs}
+
+    threads = [threading.Thread(target=putter, args=(p,), daemon=True)
+               for p in range(P)]
+    for t in threads:
+        t.start()
+    prev = None
+    try:
+        import time as _time
+
+        t0 = _time.monotonic()
+        folds = 0
+        while _time.monotonic() - t0 < 3.0:
+            dev = trk.get_stable_snapshot()
+            folds += 1
+            after = true_min_after()
+            for d in dcs:
+                assert dev.get_dc(d) <= after[d], (
+                    f"device fold ran AHEAD of the rows: {d} "
+                    f"{dev.get_dc(d)} > {after[d]} (fold {folds})")
+            if prev is not None:
+                assert prev.le(dev), (prev, dev)
+            prev = dev
+            if folds % 11 == 0:
+                pair_dev, pair_host = trk.snapshot_pair()
+                assert dict(pair_dev) == dict(pair_host)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errs, errs
+    assert folds > 20 and len(dict(prev)) > 3
+
+
 def test_sources_pull_like_host_tracker():
     devs = _devices()
     trk = DeviceStableTimeTracker("dcA", 3, devs)

@@ -1,0 +1,146 @@
+"""The round-5 KNOWN ISSUE pinned (ISSUE 4 satellite): transient
+device-fold under-inclusion under a concurrent same-key
+publish+flush+read burst.
+
+The horizon race: ``_publish`` used to advance ``key_frontier`` (and
+run the value-cache bookkeeping) BEFORE ``_wait_device_quiesce`` —
+which waits on the condition and therefore RELEASES the partition
+lock.  A reader slipping into that window passed ``covers_all``
+against the new frontier, folded device state that did not yet hold
+the op, and ``_cache_put`` pinned the stale value under the NEW
+frontier object — a poisoned hit for every later read until the key's
+next publish swapped the frontier (exactly the observed "transient,
+self-heals, needs publish+flush+read on the same hot key within
+microseconds" signature).  The fix orders the wait BEFORE any
+op-visible state change; these tests force the exact interleaving
+through the real read/publish code and fail on the pre-fix ordering.
+
+The companion stress in tests/unit/test_device_stable.py
+(``test_fold_vs_concurrent_puts_stress``) pins the OTHER suspected
+layer — meta/device_stable.py's copy-dirty-under-lock fold — clean
+against concurrent puts.
+"""
+
+import threading
+import time
+
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.mat.device_plane import DevicePlane
+from antidote_tpu.mat.materializer import Payload
+from antidote_tpu.oplog.partition import PartitionLog
+from antidote_tpu.txn.clock import HybridClock
+from antidote_tpu.txn.manager import PartitionManager
+
+
+def make_pm(tmp_path, **plane_kw):
+    log = PartitionLog(str(tmp_path / "p0.log"), partition=0)
+    plane = DevicePlane(**plane_kw)
+    return PartitionManager(0, "dc1", log, HybridClock(),
+                            device_plane=plane)
+
+
+def publish(pm, p):
+    with pm._lock:
+        pm.log.append_update(p.commit_dc, p.txid, p.key, p.type_name,
+                             p.effect)
+        pm.log.append_commit(p.commit_dc, p.txid, p.commit_time,
+                             p.snapshot_vc)
+        pm._publish(p.key, p.type_name, p, None)
+        pm._lock.notify_all()
+
+
+def orset_add(key, elem, ct, observed=()):
+    return Payload(key=key, type_name="set_aw",
+                   effect=("add", ((elem, ("dc1", ct), observed),)),
+                   commit_dc="dc1", commit_time=ct,
+                   snapshot_vc=VC({"dc1": ct - 1}), txid=f"t{ct}")
+
+
+class _Window:
+    """Parks a publisher inside _wait_device_quiesce (an artificial
+    in-flight reader count holds it there; the condition wait releases
+    the partition lock) and guarantees cleanup on any test outcome —
+    a leaked parked thread would hang the whole suite."""
+
+    def __init__(self, pm, payload):
+        self.pm = pm
+        self.entered = threading.Event()
+        orig = pm._wait_device_quiesce
+
+        def hook():
+            self.entered.set()
+            orig()
+
+        pm._wait_device_quiesce = hook
+        with pm._lock:
+            pm._dev_readers += 1
+        self.thread = threading.Thread(
+            target=publish, args=(pm, payload), daemon=True)
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.entered.wait(timeout=10), \
+            "publisher never reached the quiesce wait"
+        # the publisher is inside cond.wait (lock released); spin until
+        # we can actually take the lock to prove it parked
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if self.pm._lock.acquire(timeout=0.05):
+                self.pm._lock.release()
+                return self
+        pytest.fail("publisher still holds the partition lock")
+
+    def __exit__(self, *exc):
+        with self.pm._lock:
+            self.pm._dev_readers -= 1
+            self.pm._lock.notify_all()
+        self.thread.join(timeout=10)
+        assert not self.thread.is_alive(), "publisher never completed"
+        return False
+
+
+def test_reader_in_publish_quiesce_window_cannot_pin_stale_value(
+        tmp_path):
+    """A reader interleaving with a publish parked in the device-
+    quiesce wait must not PIN a value missing the committed op.  The
+    value cache is cleared first so the window read exercises the real
+    device fold + cache-put path (a warm cache entry would mask the
+    race by answering host-side)."""
+    pm = make_pm(tmp_path, flush_ops=1, gc_ops=10**6)
+    publish(pm, orset_add("k", "a", 1000))
+    assert pm.device.owns("set_aw", "k"), "op1 must flush to the plane"
+    pm._val_cache.clear()
+
+    with _Window(pm, orset_add("k", "b", 2000)):
+        # the window read: full device path, covers_all, cache write.
+        # (This read transiently missing "b" is acceptable — the commit
+        # has not returned; what must NOT happen is the miss PINNING.)
+        pm.read("k", "set_aw", None)
+
+    # after the publish completed, a fresh read MUST include op2 —
+    # pre-fix, the window read's cache entry was keyed by the already-
+    # advanced frontier object and this read served the stale value
+    value = pm.read("k", "set_aw", None)
+    assert "b" in value, f"committed op pinned invisible: {value}"
+    assert "a" in value
+
+
+def test_publisher_waits_before_frontier_advance(tmp_path):
+    """The ordering invariant itself: while a publisher is parked in
+    the quiesce wait, the key's frontier must NOT yet cover the op
+    being published (a covering frontier with an unstaged op is the
+    whole race)."""
+    pm = make_pm(tmp_path, flush_ops=1, gc_ops=10**6)
+    publish(pm, orset_add("k", "a", 1000))
+    assert pm.key_frontier.get("k") is not None
+
+    p2 = orset_add("k", "b", 2000)
+    with _Window(pm, p2):
+        with pm._lock:
+            fr_mid = pm.key_frontier.get("k")
+        assert not p2.commit_vc().le(fr_mid), (
+            "frontier covers an op that is not yet staged — the "
+            "quiesce window exposes it to covers_all readers")
+    assert p2.commit_vc().le(pm.key_frontier.get("k"))

@@ -76,6 +76,40 @@ def test_kernel_span_rule_clean_on_repo():
     assert trace_lint.lint_kernel_spans(trace_lint.repo_root()) == []
 
 
+def test_kernel_span_rule_flags_jit_assignments(tmp_path):
+    """ISSUE 4 rule: the ingest module's flush kernels are natural to
+    land as module-level ``name = jax.jit(impl)`` assignments, which
+    the decorator-only rule never saw — a public unwrapped jitted
+    assignment under mat/ must be flagged; kernel_span-wrapped and
+    private ones pass."""
+    d = tmp_path / "antidote_tpu" / "mat"
+    d.mkdir(parents=True)
+    (d / "newingest.py").write_text(
+        "import jax\n"
+        "from functools import partial\n"
+        "from antidote_tpu.obs.prof import kernel_span, profiler\n"
+        "def _impl(st):\n    return st\n"
+        "bare_flush = jax.jit(_impl)\n"
+        "bare_partial_flush = partial(jax.jit, donate_argnums=(0,))(_impl)\n"
+        "good_flush = kernel_span('mat.ingest')(jax.jit(_impl))\n"
+        "good_wrapped = profiler.wrap(jax.jit(_impl), name='x')\n"
+        "_private_flush = jax.jit(_impl)\n"
+        "not_a_kernel = 7\n")
+    problems = trace_lint.lint_kernel_spans(str(tmp_path))
+    flagged = {p.split("::")[1].split(":")[0] for p in problems}
+    assert flagged == {"bare_flush", "bare_partial_flush"}
+
+
+def test_kernel_span_rule_covers_ingest_module():
+    """The new ingest plane lives under mat/ (already a swept dir) and
+    its public flush kernel really is kernel_span-wrapped — the
+    profiler sees every packed flush."""
+    from antidote_tpu.mat import ingest
+
+    assert hasattr(ingest.packed_append, "__kernel_span__")
+    assert ingest.packed_append.__kernel_span__[1] == "mat.ingest"
+
+
 def test_kernel_span_rule_covers_interdc(tmp_path):
     """ISSUE 3 rule: the dependency-gate ring kernels live under
     antidote_tpu/interdc/, which the lint must sweep exactly like

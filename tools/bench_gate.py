@@ -46,15 +46,19 @@ DEFAULT_THRESHOLD = 0.15
 _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 #: units whose value should not FALL (bigger is better).  "/dispatch"
-#: covers the gate amortization family (ISSUE 3): admitted txns per
-#: device dispatch — a regression back to per-pass repack collapses it
-#: toward 1 and must fail the gate.
+#: covers the amortization families: the gate ring's admitted txns per
+#: device dispatch (ISSUE 3) AND the coalesced ingest plane's ops per
+#: packed dispatch (ISSUE 4) — a regression back to per-op appends
+#: collapses the ratio toward 1 and must fail the gate.
 _HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch")
 #: units whose value should not RISE (smaller is better).  The
 #: "*/txn" per-admitted-cost units (H2D bytes per txn, dispatches per
-#: txn) are the other face of the same amortization story.
+#: txn) are the other face of the gate amortization story; the "*/op"
+#: per-ingested-cost units (H2D bytes per op, dispatches per op) are
+#: the ingest plane's (ISSUE 4 first-class directions).
 _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
-                 "b/txn", "bytes/txn", "dispatches/txn"}
+                 "b/txn", "bytes/txn", "dispatches/txn",
+                 "b/op", "bytes/op", "dispatches/op"}
 
 
 def repo_root() -> str:

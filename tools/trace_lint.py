@@ -125,10 +125,49 @@ def _has_kernel_span(fn: ast.FunctionDef) -> bool:
     return False
 
 
+def _call_name(node: ast.expr):
+    if isinstance(node, ast.Call):
+        f = node.func
+        return getattr(f, "attr", getattr(f, "id", None))
+    return None
+
+
+def _unwrapped_jit_assign(value: ast.expr) -> bool:
+    """True when a module-level assignment VALUE is a bare jitted
+    callable — ``jax.jit(f)`` / ``partial(jax.jit, ...)`` — with no
+    kernel_span / profiler.wrap layer around it.  ISSUE 4 extends the
+    lint here: the ingest plane's flush kernels are natural to land as
+    ``flush = jax.jit(_impl)`` assignments, which the decorator-only
+    rule never saw — an unprofiled flush kernel must not land either
+    way.  ``kernel_span(...)(jax.jit(f))`` (store.py's
+    _orset_gc_nodonate idiom, public form) and ``profiler.wrap(...)``
+    both count as instrumented."""
+    if not isinstance(value, ast.Call):
+        return False
+    if _is_jax_jit(value):
+        return True
+    name = _call_name(value)
+    if name in ("kernel_span", "wrap"):
+        return False  # instrumented wrapper
+    # kernel_span("...")(jax.jit(f)): outer call whose func is a call
+    if isinstance(value.func, ast.Call) \
+            and _call_name(value.func) == "kernel_span":
+        return False
+    # partial(jax.jit, ...)(impl): the func itself is a jit factory
+    if isinstance(value.func, ast.Call) and _is_jax_jit(value.func):
+        return True
+    # any other wrapper around a jit call still hides an unprofiled
+    # kernel: look one level into the arguments
+    return any(isinstance(a, ast.Call) and _is_jax_jit(a)
+               for a in value.args)
+
+
 def lint_kernel_spans(root: str) -> List[str]:
     """ISSUE 2/3 rule: public @jax.jit functions under the device-
     plane packages (mat/, interdc/) must carry @kernel_span so the
-    profiler sees them."""
+    profiler sees them.  ISSUE 4 extends the same contract to public
+    module-level ``NAME = jax.jit(...)`` assignments (the ingest
+    module's flush-kernel form)."""
     problems: List[str] = []
     for rel_dir in _KERNEL_SPAN_DIRS:
         d = os.path.join(root, rel_dir)
@@ -141,16 +180,33 @@ def lint_kernel_spans(root: str) -> List[str]:
             with open(path) as f:
                 tree = ast.parse(f.read(), filename=path)
             for node in tree.body:
-                if not isinstance(node, ast.FunctionDef) \
-                        or node.name.startswith("_"):
-                    continue
-                if any(_is_jax_jit(dec) for dec in node.decorator_list) \
-                        and not _has_kernel_span(node):
-                    problems.append(
-                        f"{rel_dir}/{fname}::{node.name}: public "
-                        "@jax.jit entry point without @kernel_span — "
-                        "its timing and compile-miss attribution are "
-                        "dark (antidote_tpu/obs/prof.py)")
+                if isinstance(node, ast.FunctionDef):
+                    if node.name.startswith("_"):
+                        continue
+                    if any(_is_jax_jit(dec)
+                           for dec in node.decorator_list) \
+                            and not _has_kernel_span(node):
+                        problems.append(
+                            f"{rel_dir}/{fname}::{node.name}: public "
+                            "@jax.jit entry point without @kernel_span "
+                            "— its timing and compile-miss attribution "
+                            "are dark (antidote_tpu/obs/prof.py)")
+                elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    names = [t.id for t in targets
+                             if isinstance(t, ast.Name)]
+                    if not names or all(n.startswith("_")
+                                        for n in names):
+                        continue
+                    if node.value is not None \
+                            and _unwrapped_jit_assign(node.value):
+                        problems.append(
+                            f"{rel_dir}/{fname}::{names[0]}: public "
+                            "jitted assignment without kernel_span/"
+                            "profiler.wrap — unprofiled flush kernels "
+                            "cannot land (antidote_tpu/obs/prof.py)")
     return problems
 
 
