@@ -34,7 +34,12 @@ from antidote_tpu.interdc.dep import DependencyGate, gate_from_config
 from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
 from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
-from antidote_tpu.interdc.wire import DcDescriptor, InterDcTxn
+from antidote_tpu.interdc.wire import (
+    DcDescriptor,
+    InterDcBatch,
+    InterDcTxn,
+    frame_from_bin,
+)
 
 log = logging.getLogger(__name__)
 
@@ -95,7 +100,10 @@ class NodeInterDc:
         self.senders: Dict[int, InterDcLogSender] = {}
         for p in sorted(self.local):
             pm = node.partitions[p]
-            sender = InterDcLogSender(self.dc_id, p, bus, enabled=False)
+            # config routes the ship knobs through (the gate_from_config
+            # lesson: federated senders must honor interdc_ship too)
+            sender = InterDcLogSender(self.dc_id, p, bus, enabled=False,
+                                      config=node.config)
             sender.seed_watermark(pm.log.op_counters.get(self.dc_id, 0))
             pm.log.on_append = (
                 lambda rec, _s=sender: _s.on_append(rec))
@@ -164,7 +172,8 @@ class NodeInterDc:
             for p in sorted(new_local - self.local):
                 pm = node.partitions[p]
                 sender = InterDcLogSender(self.dc_id, p, self.bus,
-                                          enabled=bool(self.remote))
+                                          enabled=bool(self.remote),
+                                          config=node.config)
                 sender.seed_watermark(
                     pm.log.op_counters.get(self.dc_id, 0))
                 pm.log.on_append = (
@@ -178,10 +187,13 @@ class NodeInterDc:
                     self.sub_bufs[(dc_id, p)] = SubBuf(
                         dc_id, p,
                         deliver=self._make_gate_deliver(p),
+                        deliver_batch=self._make_gate_deliver_batch(p),
                         fetch_range=self._fetch_range,
                         last_opid=pm.log.op_counters.get(dc_id, 0))
             for p in sorted(self.local - new_local):
-                self.senders.pop(p, None)
+                gone = self.senders.pop(p, None)
+                if gone is not None:
+                    gone.close()
                 self.gates.pop(p, None)
                 for dc_id in list(self.remote):
                     self.sub_bufs.pop((dc_id, p), None)
@@ -228,6 +240,7 @@ class NodeInterDc:
             self.sub_bufs[(desc.dc_id, p)] = SubBuf(
                 desc.dc_id, p,
                 deliver=self._make_gate_deliver(p),
+                deliver_batch=self._make_gate_deliver_batch(p),
                 fetch_range=self._fetch_range,
                 last_opid=self.node.partitions[p].log.op_counters.get(
                     desc.dc_id, 0))
@@ -274,23 +287,31 @@ class NodeInterDc:
 
     def _deliver(self, data: bytes) -> None:
         try:
-            txn = InterDcTxn.from_bin(data)
+            frame = frame_from_bin(data)
         except ValueError:
             log.warning("dropping malformed inter-DC frame (%d bytes)",
                         len(data))
             return
         with self._rx_lock:
-            if txn.partition not in self.local:
+            if frame.partition not in self.local:
                 return  # another member's slice: its owner handles it
-            buf = self.sub_bufs.get((txn.dc_id, txn.partition))
+            buf = self.sub_bufs.get((frame.dc_id, frame.partition))
             if buf is None:
                 return
-            buf.process(txn)
+            if isinstance(frame, InterDcBatch):
+                buf.process_batch(frame.delivery_txns())
+                return
+            buf.process(frame)
 
     def _make_gate_deliver(self, p: int):
         def deliver(txn: InterDcTxn) -> None:
             self.gates[p].enqueue(txn)
         return deliver
+
+    def _make_gate_deliver_batch(self, p: int):
+        def deliver_batch(txns: List[InterDcTxn]) -> None:
+            self.gates[p].enqueue_batch(txns)
+        return deliver_batch
 
     def _fetch_range(self, origin_dc, partition: int, first: int,
                      last: int) -> Optional[List[InterDcTxn]]:
@@ -341,6 +362,8 @@ class NodeInterDc:
         if self._hb is not None:
             self._hb.stop()
             self._hb = None
+        for s in self.senders.values():
+            s.close()
         self._worker.stop()
         self.bus.unregister((self.dc_id, self.member_index))
 

@@ -167,6 +167,23 @@ class Config:
     #: dead-slot fraction past which the gate ring compacts (shrinks)
     #: so the fixpoint stops paying for a drained backlog's peak
     gate_compact_frac: float = 0.75
+    #: batched inter-DC shipping plane (antidote_tpu/interdc/sender.py):
+    #: committed txns coalesce per (origin, partition) stream into ONE
+    #: columnar batch frame under a window + byte/txn budget, drained
+    #: by an async sender thread so ``transport.publish`` leaves the
+    #: committing thread entirely; heartbeats piggyback on batch
+    #: frames.  False = the legacy one-frame-per-txn path (kept as the
+    #: benches' comparison baseline, like mat_ingest/gate_device_ring)
+    interdc_ship: bool = True
+    #: ship coalescing window, µs: staged txns younger than this may
+    #: wait for more commits so a burst ships as one frame; 0 drains
+    #: immediately (frames still coalesce whatever is staged)
+    interdc_ship_us: int = 2000
+    #: soft byte budget per batch frame (estimated encoded size): past
+    #: it the worker closes the frame early
+    interdc_ship_bytes: int = 256 * 1024
+    #: txn budget per batch frame
+    interdc_ship_txns: int = 64
     #: probability a device-served set_aw read is cross-checked against
     #: a log replay at the same snapshot (the read-inclusion probe,
     #: antidote_tpu/obs/probe.py); violations dump the flight recorder.

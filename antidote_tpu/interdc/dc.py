@@ -32,7 +32,12 @@ from antidote_tpu.interdc.dep import gate_from_config
 from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
 from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
-from antidote_tpu.interdc.wire import DcDescriptor, InterDcTxn
+from antidote_tpu.interdc.wire import (
+    DcDescriptor,
+    InterDcBatch,
+    InterDcTxn,
+    frame_from_bin,
+)
 from antidote_tpu.meta.device_stable import make_stable_tracker
 from antidote_tpu.meta.stable_store import StableMetaData
 from antidote_tpu.obs.spans import tracer
@@ -180,8 +185,13 @@ class DataCenter(AntidoteTPU):
         node = self.node
         dc_id = node.dc_id
         n = node.config.n_partitions
+        # a rebuild (repartition) replaces the senders: stop the old
+        # ship workers first so staged txns flush at the old width
+        for s in getattr(self, "senders", []):
+            s.close()
         self.senders = [
-            InterDcLogSender(dc_id, p, self.bus, enabled=False)
+            InterDcLogSender(dc_id, p, self.bus, enabled=False,
+                             config=node.config)
             for p in range(n)
         ]
         self.dep_gates = [
@@ -249,6 +259,7 @@ class DataCenter(AntidoteTPU):
             self.sub_bufs[(desc.dc_id, p)] = SubBuf(
                 desc.dc_id, p,
                 deliver=self._make_gate_deliver(p),
+                deliver_batch=self._make_gate_deliver_batch(p),
                 fetch_range=self._fetch_range,
                 # crash recovery: resume the stream where the local log
                 # left off (reference src/inter_dc_sub_buf.erl:58-76)
@@ -337,7 +348,7 @@ class DataCenter(AntidoteTPU):
 
     def _deliver(self, data: bytes) -> None:
         try:
-            txn = InterDcTxn.from_bin(data)
+            frame = frame_from_bin(data)
         except ValueError:
             # frames arrive from other administrative domains over the
             # network: a malformed one is dropped (and logged), never
@@ -349,16 +360,30 @@ class DataCenter(AntidoteTPU):
         # one-at-a-time delivery: the background worker and wait-hook
         # pumps may race, but sub_bufs/dep gates assume a single writer
         # (the reference gets this from one gen_server per buffer)
-        txid = (None if txn.is_ping()
-                else getattr(txn.records[-1], "txid", None))
         with self._rx_lock:
-            if txn.dc_id not in self.connected_dcs:
+            if frame.dc_id not in self.connected_dcs:
                 return  # not subscribed to this origin
-            if txn.is_ping() and self.drop_ping:
-                return
-            buf = self.sub_bufs.get((txn.dc_id, txn.partition))
+            buf = self.sub_bufs.get((frame.dc_id, frame.partition))
             if buf is None:
                 return  # connect raced the stream; repair catches up
+            if isinstance(frame, InterDcBatch):
+                # the ship plane's coalesced frame: the whole span goes
+                # through the sub-buffer as one arrival batch, with the
+                # piggybacked heartbeat (if any) trailing it
+                for txn in frame.txns():
+                    tracer.instant("interdc_rx", "interdc",
+                                   txid=getattr(txn.records[-1], "txid",
+                                                None),
+                                   origin=str(frame.dc_id),
+                                   partition=frame.partition)
+                buf.process_batch(frame.delivery_txns(
+                    include_ping=not self.drop_ping))
+                return
+            txn = frame
+            txid = (None if txn.is_ping()
+                    else getattr(txn.records[-1], "txid", None))
+            if txn.is_ping() and self.drop_ping:
+                return
             if txid is None:
                 buf.process(txn)
                 return
@@ -384,6 +409,20 @@ class DataCenter(AntidoteTPU):
                                partition=txn.partition)
             self.dep_gates[p].enqueue(txn)
         return deliver
+
+    def _make_gate_deliver_batch(self, p: int):
+        def deliver_batch(txns: List[InterDcTxn]) -> None:
+            for txn in txns:
+                if not txn.is_ping():
+                    # point events, like the per-txn deliver path (the
+                    # per-txn apply timing is depgate_admit's job)
+                    tracer.instant("interdc_deliver", "interdc",
+                                   txid=getattr(txn.records[-1],
+                                                "txid", None),
+                                   origin=str(txn.dc_id),
+                                   partition=txn.partition)
+            self.dep_gates[p].enqueue_batch(txns)
+        return deliver_batch
 
     def _fetch_range(self, origin_dc, partition: int, first: int,
                      last: int) -> Optional[List[InterDcTxn]]:
@@ -429,6 +468,10 @@ class DataCenter(AntidoteTPU):
 
     def close(self) -> None:
         self._stop_bg_processes()
+        # flush + stop the ship workers before the inbound worker: a
+        # staged batch published now still reaches live peers
+        for s in self.senders:
+            s.close()
         self._worker.stop()
         # persist the published stable snapshot: stability is permanent,
         # and the restarted tracker floors itself here so None-clock

@@ -183,28 +183,40 @@ class DependencyGate:
     # ------------------------------------------------------------- ingest
 
     def enqueue(self, txn: InterDcTxn) -> None:
-        # gate-wait clock: _apply reads it back for the dep-gate wait
-        # histogram and the admit span of the txn's trace tree
-        txn._obs_enq_us = self.now_us()
-        q = self.queues.setdefault(txn.dc_id, deque())
-        q.append(txn)
-        # a txn landing behind its own origin's blocked head cannot
-        # change the fixpoint (FIFO: it only applies after the head, and
-        # the head's dependencies are unchanged) — skip the full
-        # reprocess for backlogged queues so ingest under a partition
-        # stays O(1) per frame, except for an occasional pass that picks
-        # up heads gated only on the advancing local wall clock
-        since_proc = self.now_us() - self._last_proc_us
-        if len(q) > 1 and since_proc < 50_000:
+        self.enqueue_batch([txn])
+
+    def enqueue_batch(self, txns: List[InterDcTxn]) -> None:
+        """Stage one arrival — a single delivery or a whole wire
+        batch's txns (ISSUE 6) — then run at most ONE gating pass: the
+        ring appends the arrival in one scatter and the fixpoint
+        admits it in one dispatch, instead of a pass per txn.
+
+        Skip rules: txns landing behind their origins' blocked heads
+        cannot change the fixpoint (FIFO: they only apply after the
+        head, whose dependencies are unchanged) — an all-backlogged
+        arrival skips the reprocess so ingest under a partition stays
+        O(1) per frame, except for an occasional pass that picks up
+        heads gated only on the advancing local wall clock.  And the
+        coalescing window (ISSUE 3): in the batched regime, arrivals
+        right after a pass stage instead of dispatching — the next
+        pass admits the whole burst with ONE device fixpoint."""
+        if not txns:
             return
-        # coalescing window (ISSUE 3): in the batched regime, a burst
-        # of head enqueues right after a pass stages instead of
-        # dispatching — the next pass (the enqueue that outlives the
-        # window, an explicit process_queues, or the heartbeat path)
-        # admits the whole burst with ONE device fixpoint instead of N
+        now = self.now_us()
+        head_new = False
+        for txn in txns:
+            # gate-wait clock: _apply reads it back for the dep-gate
+            # wait histogram and the admit span of the txn's trace tree
+            txn._obs_enq_us = now
+            q = self.queues.setdefault(txn.dc_id, deque())
+            q.append(txn)
+            head_new |= len(q) == 1
+        since_proc = now - self._last_proc_us
+        if not head_new and since_proc < 50_000:
+            return
         if (self.coalesce_us > 0 and 0 <= since_proc < self.coalesce_us
                 and self.pending() >= self.batch_threshold):
-            stats.registry.gate_coalesced.inc()
+            stats.registry.gate_coalesced.inc(len(txns))
             return
         self.process_queues()
 

@@ -1,28 +1,116 @@
-"""Per-partition inter-DC log sender.
+"""Per-partition inter-DC log sender — with the batched shipping plane.
 
 Every local log append streams here (reference src/logging_vnode.erl:422
 → src/inter_dc_log_sender_vnode.erl:119-131); a TxnAssembler groups the
-records per txid until the commit record arrives, then the whole txn is
-broadcast with the stream's opid watermark.  A periodic heartbeat/ping
+records per txid until the commit record arrives, then the whole txn
+ships with the stream's opid watermark.  A periodic heartbeat/ping
 carries the partition's min-prepared time so remote GSTs keep advancing
 through quiet periods (reference :133-143, ?HEARTBEAT_PERIOD
 include/antidote.hrl:55).
+
+ISSUE 6 rebuilt the wire economy around a per-stream ship buffer:
+under ``Config.interdc_ship`` a committed txn only STAGES on the
+committing thread — an async worker coalesces staged txns under a time
+window + byte/txn budget (``interdc_ship_us`` / ``interdc_ship_bytes``
+/ ``interdc_ship_txns``) into ONE columnar batch frame
+(wire.InterDcBatch) and publishes it off the commit path, with a
+bounded buffer backpressuring committers so a stalled transport cannot
+let staged txns grow without bound.  Heartbeats piggyback on batch
+frames while the stream has traffic and only pay a standalone ping
+frame when it is quiet.  ``interdc_ship=False`` keeps the legacy
+one-frame-per-txn path as the benches' comparison baseline.
+
+Both paths publish through a per-stream ordered outbox: frames enter
+it in watermark order inside the same critical section that advances
+``last_sent_opid``, and leave it under a dedicated publish lock — the
+pre-ISSUE-6 code published after dropping the lock, so two committing
+threads could emit frames out of opid order and force a spurious
+SubBuf gap-repair fetch at every receiver.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
+from collections import deque
+from typing import List, Optional
 
+from antidote_tpu import stats
+from antidote_tpu.config import Config as _Config
+from antidote_tpu.interdc import termcodec
 from antidote_tpu.interdc.transport import Transport
-from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.interdc.wire import InterDcBatch, InterDcTxn
 from antidote_tpu.obs.events import recorder
 from antidote_tpu.obs.spans import tracer
 from antidote_tpu.oplog.records import LogRecord, TxnAssembler
 
+#: the ship knobs' single source of truth is Config's field defaults
+#: (config.py) — direct InterDcLogSender(...) constructions (tests,
+#: benches) inherit exactly what a config-built DC gets
+_KNOB = {k: _Config.__dataclass_fields__[f"interdc_{k}"].default
+         for k in ("ship", "ship_us", "ship_bytes", "ship_txns")}
+
+#: staged-txn cap: past ``ship_txns * this`` the committing thread
+#: blocks until the worker drains (the ingest plane's 4x rule)
+SHIP_BACKPRESSURE_FACTOR = 4
+#: upper bound on a committer's backpressure wait — a wedged transport
+#: must degrade to unbounded staging (with a log line), never deadlock
+#: the partition lock the committer holds
+_BACKPRESSURE_TIMEOUT_S = 5.0
+
+
+def _note_frame(kind: str, nbytes: int, ntxns: int = 0,
+                piggyback: bool = False) -> None:
+    """Count one published frame and refresh the amortization gauges —
+    txns per batch frame (up) and wire bytes per txn-carrying frame's
+    txn (down), the ratios the replication bench gates on."""
+    reg = stats.registry
+    reg.ship_frames.inc(kind=kind)
+    if kind == "batch" and ntxns:
+        # ship_txns counts BATCH-carried txns only: the txns-per-frame
+        # gauge must not be inflated by legacy per-txn frames
+        reg.ship_txns.inc(ntxns)
+    if kind != "ping":
+        reg.ship_bytes.inc(nbytes)
+    if piggyback:
+        reg.ship_piggybacked_pings.inc()
+    batches = reg.ship_frames.value(kind="batch")
+    if batches:
+        reg.ship_txns_per_frame.set(reg.ship_txns.value() / batches)
+    carried = reg.ship_txns.value() + reg.ship_frames.value(kind="txn")
+    if carried:
+        reg.ship_bytes_per_txn.set(reg.ship_bytes.value() / carried)
+
+
+def _est_term_bytes(v) -> int:
+    """Cheap encoded-size estimate for the ship buffer's byte budget
+    (soft budget: the worker closes a frame early past it, so an
+    estimate is enough — exact sizing would mean encoding on the
+    commit path, the cost this plane removes)."""
+    if isinstance(v, (str, bytes)):
+        return len(v) + 5
+    if isinstance(v, (tuple, list, set, frozenset)):
+        return 5 + sum(_est_term_bytes(x) for x in v)
+    if isinstance(v, dict):
+        return 5 + sum(_est_term_bytes(k) + _est_term_bytes(x)
+                       for k, x in v.items())
+    return 9
+
+
+def est_txn_bytes(txn: InterDcTxn) -> int:
+    n = 32 + 16 * len(txn.snapshot_vc or ())
+    for r in txn.records:
+        n += 24
+        if r.kind() == "update":
+            n += (_est_term_bytes(r.payload[1])
+                  + len(r.payload[2]) + _est_term_bytes(r.payload[3]))
+    return n
+
 
 class InterDcLogSender:
     def __init__(self, dc_id, partition: int, transport: Transport,
-                 enabled: bool = True):
+                 enabled: bool = True, config=None):
         self.dc_id = dc_id
         self.partition = partition
         self.transport = transport
@@ -30,11 +118,36 @@ class InterDcLogSender:
         #: start_bg_processes ordering, src/inter_dc_manager.erl:112-145)
         self.enabled = enabled
         self.assembler = TxnAssembler()
-        #: opid watermark of the last broadcast record for this stream
-        #: (seeded from the recovered log at restart by the manager,
-        #: reference {start_timer} handler src/logging_vnode.erl:301-322)
+        #: opid watermark of the last staged-or-broadcast record for
+        #: this stream (seeded from the recovered log at restart by the
+        #: manager, reference {start_timer} src/logging_vnode.erl:301-322)
         self.last_sent_opid = 0
+        self.ship = _KNOB["ship"] if config is None else config.interdc_ship
+        self.ship_us = (_KNOB["ship_us"] if config is None
+                        else config.interdc_ship_us)
+        self.ship_bytes = (_KNOB["ship_bytes"] if config is None
+                           else config.interdc_ship_bytes)
+        self.ship_txns = max(1, _KNOB["ship_txns"] if config is None
+                             else config.interdc_ship_txns)
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        #: per-stream ordered outbox: (kind, txid, frame, ntxns,
+        #: piggyback) appended in watermark order under ``_lock``,
+        #: published FIFO under ``_pub_lock``
+        self._outbox: deque = deque()
+        self._pub_lock = threading.Lock()
+        #: ship buffer: staged (txn, est_bytes) awaiting the worker
+        self._buf: List[tuple] = []
+        self._buf_bytes = 0
+        self._buf_since = 0.0
+        self._pending_ping: Optional[int] = None
+        #: worker is encoding a popped chunk outside the lock — the
+        #: stream has an in-flight frame not yet in the outbox
+        self._draining = False
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ staging
 
     def on_append(self, rec: LogRecord) -> None:
         """Tap for locally-appended records.  Only records originated by
@@ -46,21 +159,62 @@ class InterDcLogSender:
         done = self.assembler.process(rec)
         if done is None:
             return
+        txid = getattr(done[-1], "txid", None)
         with self._lock:
             txn = InterDcTxn.from_ops(self.dc_id, self.partition,
                                       self.last_sent_opid, done)
             self.last_sent_opid = txn.last_opid()
-        if self.enabled:
-            # the commit record closes the group, so its txid correlates
-            # this broadcast with the coordinator/log/device spans
-            txid = getattr(done[-1], "txid", None)
-            with tracer.span("interdc_send", "interdc", txid=txid,
-                             partition=self.partition,
-                             dc=str(self.dc_id)):
-                self.transport.publish(self.dc_id, txn.to_bin())
-            recorder.record("interdc", "send", txid=txid,
-                            partition=self.partition,
-                            records=len(done))
+            if not self.enabled:
+                return
+            if self.ship and termcodec.batch_packable(txn):
+                tracer.instant("interdc_ship_stage", "interdc",
+                               txid=txid, partition=self.partition,
+                               dc=str(self.dc_id))
+                self._stage_locked(txn)
+                return
+            if self.ship:
+                # rare unpackable txn (hand-built records): close the
+                # open batch ahead of it so the stream stays ordered
+                while self._draining:
+                    self._cv.wait(0.05)
+                self._close_batch_locked()
+            # legacy per-txn frame: ORDERED inside the watermark
+            # critical section; encoding is deferred to the drain
+            # (under _pub_lock) so committers don't serialize on it
+            self._outbox.append(("txn", txid, txn, 1, False))
+        self._drain_outbox()
+
+    def _stage_locked(self, txn: InterDcTxn) -> None:
+        # backpressure: the buffer is bounded; a committer ahead of the
+        # worker waits for drain (bounded — see _BACKPRESSURE_TIMEOUT_S)
+        cap = self.ship_txns * SHIP_BACKPRESSURE_FACTOR
+        deadline = time.monotonic() + _BACKPRESSURE_TIMEOUT_S
+        while len(self._buf) >= cap and not self._closed:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                logging.getLogger(__name__).warning(
+                    "ship buffer backpressure timed out (%d staged) — "
+                    "staging anyway", len(self._buf))
+                break
+            self._cv.wait(remaining)
+        if not self._buf:
+            self._buf_since = time.monotonic()
+        self._buf.append((txn, est_txn_bytes(txn)))
+        self._buf_bytes += self._buf[-1][1]
+        stats.registry.ship_queue_depth.set(
+            len(self._buf), dc=str(self.dc_id),
+            partition=str(self.partition))
+        self._ensure_worker_locked()
+        self._cv.notify_all()
+
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._ship_loop, daemon=True,
+                name=f"interdc-ship-{self.dc_id}-p{self.partition}")
+            self._worker.start()
+
+    # --------------------------------------------------------- heartbeats
 
     def ping(self, min_prepared_time: int) -> None:
         """Broadcast a heartbeat carrying this partition's min-prepared
@@ -70,12 +224,211 @@ class InterDcLogSender:
         reference's heartbeat timers run unconditionally once started,
         which is what lets two DCs connect *sequentially* with sync
         waits — the second DC's pings must flow before it has observed
-        anyone.  Callers only tick this from started heartbeat loops."""
+        anyone.  Callers only tick this from started heartbeat loops.
+
+        With the ship plane active and txns staged, the ping
+        piggybacks on the next batch frame instead of paying its own
+        frame (and, published out of band, it would race the staged
+        txns' watermarks into a spurious gap repair at every
+        receiver); a quiet stream still pays the standalone frame."""
         with self._lock:
+            if self.ship and (self._buf or self._draining
+                              or self._pending_ping is not None):
+                # monotone: a later tick's stamp supersedes
+                self._pending_ping = (min_prepared_time
+                                      if self._pending_ping is None
+                                      else max(self._pending_ping,
+                                               min_prepared_time))
+                self._cv.notify_all()
+                return
             txn = InterDcTxn.ping(self.dc_id, self.partition,
                                   self.last_sent_opid, min_prepared_time)
-        self.transport.publish(self.dc_id, txn.to_bin())
+            self._outbox.append(("ping", None, txn, 0, False))
+        self._drain_outbox()
+
+    # ---------------------------------------------------------- ship loop
+
+    def _chunk_locked(self) -> List[InterDcTxn]:
+        """Pop the next frame's txns: up to the txn budget, closing
+        early once the estimated size passes the byte budget."""
+        chunk: List[InterDcTxn] = []
+        total = 0
+        for txn, est in self._buf:
+            if chunk and (len(chunk) >= self.ship_txns
+                          or total + est > self.ship_bytes):
+                break
+            chunk.append(txn)
+            total += est
+        del self._buf[:len(chunk)]
+        self._buf_bytes -= total
+        return chunk
+
+    def _ship_loop(self) -> None:
+        while True:
+            with self._lock:
+                while (not self._closed and not self._buf
+                       and self._pending_ping is None):
+                    self._cv.wait(0.1)
+                if self._closed and not self._buf \
+                        and self._pending_ping is None:
+                    return
+                # coalescing window: hold the frame open for more
+                # commits until the window expires or a budget fills
+                while (not self._closed and self._buf
+                       and len(self._buf) < self.ship_txns
+                       and self._buf_bytes < self.ship_bytes):
+                    remaining = (self.ship_us / 1e6
+                                 - (time.monotonic() - self._buf_since))
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                chunk = self._chunk_locked()
+                ping, self._pending_ping = self._pending_ping, None
+                if self._buf:
+                    self._buf_since = time.monotonic()
+                stats.registry.ship_queue_depth.set(
+                    len(self._buf), dc=str(self.dc_id),
+                    partition=str(self.partition))
+                self._draining = True
+                ping_prev = self.last_sent_opid
+            # encode OUTSIDE the lock: a committing thread staging the
+            # next txn must not wait out a 64-txn frame encode.  The
+            # finally block clears _draining even if encoding throws —
+            # a stuck flag would wedge the unpackable-txn barrier and
+            # the ping piggyback forever.
+            entry = None
+            try:
+                if chunk:
+                    batch = InterDcBatch.from_txns(chunk, ping_ts=ping)
+                    entry = ("batch", batch, batch.to_bin(), len(chunk),
+                             ping is not None)
+                elif ping is not None:
+                    # drained-under-our-feet race: the stamp still flows
+                    txn = InterDcTxn.ping(self.dc_id, self.partition,
+                                          ping_prev, ping)
+                    entry = ("ping", None, txn.to_bin(), 0, False)
+            except Exception:  # noqa: BLE001 — the worker must survive
+                logging.getLogger(__name__).exception(
+                    "ship frame encode failed (%d txns dropped to gap "
+                    "repair)", len(chunk))
+            finally:
+                with self._lock:
+                    if entry is not None:
+                        self._outbox.append(entry)
+                    self._draining = False
+                    self._cv.notify_all()
+            try:
+                self._drain_outbox()
+            except Exception:  # noqa: BLE001 — a transport error must
+                # not kill the drainer; the receivers' opid watermarks
+                # treat the lost frame as loss and gap-repair refetches
+                logging.getLogger(__name__).exception(
+                    "ship publish failed; receivers will gap-repair")
+
+    # ------------------------------------------------------------ publish
+
+    def _drain_outbox(self) -> None:
+        """Publish queued frames FIFO.  Frames enter the outbox in
+        watermark order (under ``_lock``); ``_pub_lock`` serializes the
+        actual publishes, so per-stream frame order holds even when
+        several threads race here (the pre-ISSUE-6 ordering bug)."""
+        while True:
+            with self._pub_lock:
+                with self._lock:
+                    if not self._outbox:
+                        return
+                    kind, meta, frame, ntxns, piggy = self._outbox.popleft()
+                if not isinstance(frame, bytes):
+                    # deferred encode: entries staged under the
+                    # watermark lock carry the object; the bytes are
+                    # produced here, still ordered by _pub_lock
+                    frame = frame.to_bin()
+                if kind == "batch":
+                    with tracer.span("interdc_send_batch", "interdc",
+                                     partition=self.partition,
+                                     dc=str(self.dc_id), txns=ntxns):
+                        self.transport.publish(self.dc_id, frame)
+                    for txn in meta.txns():
+                        txid = getattr(txn.records[-1], "txid", None)
+                        tracer.instant("interdc_send", "interdc",
+                                       txid=txid,
+                                       partition=self.partition,
+                                       dc=str(self.dc_id))
+                    recorder.record("interdc", "send_batch",
+                                    partition=self.partition, txns=ntxns,
+                                    bytes=len(frame),
+                                    piggyback_ping=piggy)
+                elif kind == "txn":
+                    with tracer.span("interdc_send", "interdc",
+                                     txid=meta, partition=self.partition,
+                                     dc=str(self.dc_id)):
+                        self.transport.publish(self.dc_id, frame)
+                    recorder.record("interdc", "send", txid=meta,
+                                    partition=self.partition)
+                else:  # ping
+                    with tracer.span("interdc_send_ping", "interdc",
+                                     partition=self.partition,
+                                     dc=str(self.dc_id)):
+                        self.transport.publish(self.dc_id, frame)
+                _note_frame(kind, len(frame), ntxns, piggy)
+
+    # ----------------------------------------------------------- plumbing
+
+    def _close_batch_locked(self) -> None:
+        """Flush the staged buffer into the outbox as one batch frame
+        (ordering barrier ahead of a legacy frame; caller holds
+        ``_lock`` with ``_draining`` false)."""
+        if not self._buf:
+            return
+        chunks = []
+        while self._buf:
+            chunks.append(self._chunk_locked())
+        ping, self._pending_ping = self._pending_ping, None
+        for i, chunk in enumerate(chunks):
+            batch = InterDcBatch.from_txns(
+                chunk, ping_ts=ping if i == len(chunks) - 1 else None)
+            self._outbox.append(("batch", batch, batch,
+                                 len(chunk), ping is not None
+                                 and i == len(chunks) - 1))
+        stats.registry.ship_queue_depth.set(
+            0, dc=str(self.dc_id), partition=str(self.partition))
 
     def seed_watermark(self, opid: int) -> None:
         with self._lock:
             self.last_sent_opid = max(self.last_sent_opid, opid)
+
+    def pending_ship(self) -> int:
+        with self._lock:
+            return (len(self._buf) + len(self._outbox)
+                    + (1 if self._draining else 0))
+
+    def flush_ship(self, timeout: float = 2.0) -> None:
+        """Drain the ship buffer synchronously (tests / shutdown): wake
+        the worker and wait until everything staged has published."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            self._buf_since = 0.0  # expire the window
+            self._ensure_worker_locked()
+            self._cv.notify_all()
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._buf and not self._outbox \
+                        and not self._draining \
+                        and self._pending_ping is None:
+                    return
+                self._buf_since = 0.0
+                self._cv.notify_all()
+            self._drain_outbox()
+            time.sleep(0.001)
+
+    def close(self) -> None:
+        """Stop the ship worker, flushing staged txns first (restart
+        recovery would re-ship them from the log either way, but a
+        clean shutdown should not force every peer through repair)."""
+        with self._lock:
+            self._closed = True
+            self._cv.notify_all()
+            worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=2.0)
+        self._drain_outbox()

@@ -13,6 +13,15 @@ observed for the stream —
 
 On first contact the watermark is seeded from the local durable log so a
 restarted replica resumes where it crashed (src/inter_dc_sub_buf.erl:58-76).
+
+ISSUE 6 adds batch frames: the ship plane's coalesced frame carries a
+contiguous opid span of txns, so :meth:`SubBuf.process_batch` applies
+the same tri-state per txn but hands every deliverable txn of a frame
+downstream as ONE batch (``deliver_batch``) — the dependency gate
+appends the whole arrival in one ring scatter and admits it with one
+fixpoint instead of per-txn passes.  Duplicate prefixes inside a
+re-sent batch drop txn-by-txn; a gap anywhere buffers the remainder
+and triggers the same repair fetch as the per-txn path.
 """
 
 from __future__ import annotations
@@ -28,11 +37,16 @@ class SubBuf:
                  deliver: Callable[[InterDcTxn], None],
                  fetch_range: Callable[[Any, int, int, int],
                                        Optional[List[InterDcTxn]]],
-                 last_opid: int = 0):
+                 last_opid: int = 0,
+                 deliver_batch: Optional[
+                     Callable[[List[InterDcTxn]], None]] = None):
         self.origin_dc = origin_dc
         self.partition = partition
-        #: hand the txn to the dependency gate
+        #: hand one txn to the dependency gate
         self._deliver = deliver
+        #: hand a whole in-order arrival batch to the dependency gate
+        #: (one gate pass); falls back to per-txn delivery when unset
+        self._deliver_batch = deliver_batch
         #: fetch_range(origin_dc, partition, first, last) -> [InterDcTxn]
         #: or None when the origin is unreachable (repair retried on the
         #: next incoming frame)
@@ -47,6 +61,41 @@ class SubBuf:
             self._try_repair()
             return
         self._handle(txn)
+
+    def process_batch(self, txns: List[InterDcTxn]) -> None:
+        """One batch frame's txns (in stream order, opid-contiguous,
+        optionally ending in the piggybacked ping).  Semantically
+        identical to processing each txn through :meth:`process`; the
+        only difference is that consecutive deliverable txns reach the
+        gate as one arrival batch."""
+        if self.state == "buffering":
+            self._queue.extend(txns)
+            self._try_repair()
+            return
+        fresh: List[InterDcTxn] = []
+        for i, txn in enumerate(txns):
+            if txn.prev_log_opid == self.last_opid:
+                fresh.append(txn)
+                self.last_opid = txn.last_opid()
+            elif txn.prev_log_opid < self.last_opid:
+                continue  # duplicate / already covered
+            else:
+                # gap: flush what is deliverable, buffer the remainder
+                self._flush_batch(fresh)
+                self._queue.extend(txns[i:])
+                self.state = "buffering"
+                self._try_repair()
+                return
+        self._flush_batch(fresh)
+
+    def _flush_batch(self, txns: List[InterDcTxn]) -> None:
+        if not txns:
+            return
+        if self._deliver_batch is not None:
+            self._deliver_batch(txns)
+        else:
+            for txn in txns:
+                self._deliver(txn)
 
     def _handle(self, txn: InterDcTxn) -> None:
         if txn.prev_log_opid == self.last_opid:

@@ -112,6 +112,98 @@ class InterDcTxn:
         return txn
 
 
+@dataclass
+class InterDcBatch:
+    """A coalesced run of committed txns from ONE (origin DC, partition)
+    stream — the batched shipping plane's wire frame (ISSUE 6).
+
+    The txns are contiguous under the stream's opid watermark scheme:
+    ``_txns[i].prev_log_opid == _txns[i-1].last_opid()``, so the whole
+    frame gap-checks as one span (``first_prev_opid`` .. ``last_opid``)
+    in the receiver's SubBuf, and the encoder only ships the span base
+    plus the per-txn commit opids.  ``ping_ts`` piggybacks the
+    partition's heartbeat (min-prepared time) on a traffic-carrying
+    frame so a busy stream pays no standalone ping frames; the receiver
+    materializes it as a trailing ping txn.
+
+    The binary form is columnar (termcodec ``_T_BATCH``): uniform int64
+    columns for op ids / commit times / update counts, an interned
+    type-name table, and memoized VC encoding for the snapshot clocks —
+    the per-txn framing, kind strings, and repeated OpId dc / txid /
+    VC payloads of the legacy per-txn frames are shared or elided.
+    """
+
+    dc_id: Any
+    partition: int
+    _txns: List["InterDcTxn"]
+    #: piggybacked heartbeat stamp (min-prepared time), or None
+    ping_ts: Optional[int] = None
+
+    # ------------------------------------------------------------ queries
+
+    def txns(self) -> List["InterDcTxn"]:
+        return self._txns
+
+    def first_prev_opid(self) -> int:
+        return self._txns[0].prev_log_opid
+
+    def last_opid(self) -> int:
+        return self._txns[-1].last_opid()
+
+    def ping_txn(self) -> Optional["InterDcTxn"]:
+        """The piggybacked heartbeat as a txn for the delivery path
+        (its watermark rides the batch's last opid)."""
+        if self.ping_ts is None:
+            return None
+        return InterDcTxn.ping(self.dc_id, self.partition,
+                               self.last_opid(), self.ping_ts)
+
+    def delivery_txns(self, include_ping: bool = True
+                      ) -> List["InterDcTxn"]:
+        """The frame's txns in stream order, with the piggybacked
+        heartbeat (unless suppressed — drop_ping receivers) trailing —
+        the ONE unwrap every receiver feeds to SubBuf.process_batch."""
+        txns = list(self._txns)
+        ping = self.ping_txn() if include_ping else None
+        if ping is not None:
+            txns.append(ping)
+        return txns
+
+    # ------------------------------------------------------- construction
+
+    @staticmethod
+    def from_txns(txns: List["InterDcTxn"],
+                  ping_ts: Optional[int] = None) -> "InterDcBatch":
+        assert txns, "empty batch (pings ship standalone)"
+        head = txns[0]
+        for a, b in zip(txns, txns[1:]):
+            assert b.prev_log_opid == a.last_opid(), \
+                "batch txns must be opid-contiguous"
+            assert (b.dc_id, b.partition) == (a.dc_id, a.partition), \
+                "batch txns must share one stream"
+        return InterDcBatch(dc_id=head.dc_id, partition=head.partition,
+                            _txns=list(txns), ping_ts=ping_ts)
+
+    # -------------------------------------------------------------- bytes
+
+    def to_bin(self) -> bytes:
+        from antidote_tpu.interdc import termcodec
+
+        return partition_prefix(self.partition) + termcodec.encode(self)
+
+
+def frame_from_bin(data: bytes):
+    """Decode one pub/sub frame: an :class:`InterDcTxn` (legacy per-txn
+    or heartbeat) or an :class:`InterDcBatch` (the ship plane's
+    coalesced frame)."""
+    from antidote_tpu.interdc import termcodec
+
+    frame = termcodec.decode(bytes(data[PARTITION_PREFIX_LEN:]))
+    if not isinstance(frame, (InterDcTxn, InterDcBatch)):
+        raise ValueError("corrupt inter-DC frame")
+    return frame
+
+
 def partition_prefix(partition: int) -> bytes:
     return struct.pack(">Q", partition)
 

@@ -40,6 +40,15 @@ INGEST_* metric families (stats.py) record the economy: flushes by
 trigger kind, coalesced ops, H2D bytes, and the ops-per-dispatch
 amortization gauge the benches gate on directionally
 (tools/bench_gate.py: ops/dispatch up, B/op down).
+
+**Wire-to-scatter (ISSUE 6).**  The batched shipping plane delivers a
+whole inter-DC batch frame's txns as ONE dependency-gate arrival
+(interdc/sub_buf.py ``process_batch`` -> dep.py ``enqueue_batch``),
+so the gate admits them in one wave and their decoded ops stage into
+this plane back-to-back — inside one ``mat_coalesce_us`` window by
+construction.  A wire frame of N txns therefore lands as a handful of
+packed flushes (often one), not N per-txn staging rounds: the wire's
+frame economy and this plane's dispatch economy compose end-to-end.
 """
 
 from __future__ import annotations

@@ -330,6 +330,42 @@ class Registry:
             "antidote_ingest_ops_per_dispatch",
             "Amortization ratio of the coalesced ingest plane: ops "
             "per packed device dispatch over the process lifetime")
+        # ---- batched inter-DC shipping plane (ISSUE 6,
+        # antidote_tpu/interdc/sender.py + wire.py): the wire's frame
+        # and byte economy.  Txns per batch frame (up) and encoded
+        # bytes per shipped txn (down) are the amortization the
+        # steady-stream replication bench gates on.
+        self.ship_frames = Counter(
+            "antidote_ship_frames_total",
+            "Inter-DC pub/sub frames published, by kind (batch = the "
+            "ship plane's coalesced frame, txn = legacy per-txn, "
+            "ping = standalone heartbeat)",
+            labels=("kind",))
+        self.ship_txns = Counter(
+            "antidote_ship_txns_total",
+            "Committed transactions shipped through batch frames")
+        self.ship_bytes = Counter(
+            "antidote_ship_wire_bytes_total",
+            "Encoded wire bytes of txn-carrying frames (batch + legacy "
+            "per-txn, partition prefix included; standalone pings are "
+            "not txn-carrying and count only in ship_frames)")
+        self.ship_piggybacked_pings = Counter(
+            "antidote_ship_piggybacked_pings_total",
+            "Heartbeats that rode a batch frame instead of paying "
+            "their own standalone ping frame")
+        self.ship_queue_depth = LabeledGauge(
+            "antidote_ship_queue_depth",
+            "Committed txns staged in a stream's ship buffer, awaiting "
+            "the async sender thread",
+            labels=("dc", "partition"))
+        self.ship_txns_per_frame = Gauge(
+            "antidote_ship_txns_per_frame",
+            "Amortization ratio of the shipping plane: txns per "
+            "published batch frame over the process lifetime")
+        self.ship_bytes_per_txn = Gauge(
+            "antidote_ship_wire_bytes_per_txn",
+            "Encoded wire bytes per shipped txn over the process "
+            "lifetime (txn-carrying frames only)")
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -346,7 +382,10 @@ class Registry:
                 self.gate_admitted_per_dispatch,
                 self.ingest_flushes, self.ingest_dispatches,
                 self.ingest_coalesced_ops, self.ingest_h2d_bytes,
-                self.ingest_ops_per_dispatch)
+                self.ingest_ops_per_dispatch,
+                self.ship_frames, self.ship_txns, self.ship_bytes,
+                self.ship_piggybacked_pings, self.ship_queue_depth,
+                self.ship_txns_per_frame, self.ship_bytes_per_txn)
 
     def exposition(self) -> str:
         lines = []

@@ -1,0 +1,224 @@
+"""Config 7: steady-stream inter-DC replication — the wire economy.
+
+Cure-style full-mesh log shipping (PAPERS.md: Akkoorath et al., ICDCS
+2016) puts every committed txn on the inter-DC wire, and before ISSUE 6
+the wire was per-transaction: one termcodec frame encoded and published
+synchronously on the log-append tap per commit.  This config drives a
+steady commit stream through the REAL sender -> wire -> SubBuf ->
+dependency-gate pipeline twice — the batched shipping plane
+(``interdc_ship=True``: per-stream coalescing buffer, async publish,
+columnar batch frames) against the legacy per-txn baseline — and
+measures the two ratios the regression gate enforces directionally:
+
+- ``repl_txns_per_frame``     (txn/frame, must not fall): wire frames
+  published per committed txn, the frame-coalescing amortization;
+- ``repl_wire_bytes_per_txn`` (wire B/txn, must not rise): encoded
+  bytes per shipped txn, the columnar/memoized encoding economy.
+
+Delivery equivalence is asserted, not assumed: both paths' frames are
+decoded and driven through a SubBuf + DependencyGate receiver, and the
+admitted record sequence, admission order, and final gate clock must
+be IDENTICAL before any ratio is reported.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from benches._util import emit, setup
+
+
+def build_stream(n_txns, seed=11):
+    """A realistic single-stream commit tape: per txn 1-3 updates over
+    a small hot key pool (counters + or-set adds), commit VCs creeping
+    per DC — the shape a production stream has, not a best case for
+    either wire form."""
+    import numpy as np
+
+    from antidote_tpu.clocks import VC
+    from antidote_tpu.oplog.records import (
+        OpId,
+        commit_record,
+        update_record,
+    )
+
+    rng = np.random.default_rng(seed)
+    base = {"dc1": 1_700_000_000_000_000, "dc2": 1_700_000_000_000_000,
+            "dc3": 1_700_000_000_000_000}
+    records = []
+    opid = 0
+    for i in range(n_txns):
+        txid = ("dc1", 100_000 + i)
+        nup = int(rng.integers(1, 4))
+        for dc in base:
+            base[dc] += int(rng.integers(50, 2000))
+        vc = VC(dict(base))
+        for j in range(nup):
+            opid += 1
+            key = f"account_{int(rng.integers(0, 64)):03d}"
+            if j % 2 == 0:
+                eff = ("increment", int(rng.integers(1, 100)))
+                records.append(update_record(
+                    OpId("dc1", opid), txid, key, "counter_pn", eff))
+            else:
+                eff = ("add", ((f"e{i}", ("dc1", opid), ()),))
+                records.append(update_record(
+                    OpId("dc1", opid), txid, key, "set_aw", eff))
+        opid += 1
+        records.append(commit_record(
+            OpId("dc1", opid), txid, "dc1", base["dc1"], vc))
+    return records, n_txns
+
+
+class CaptureTransport:
+    """Transport stub recording every published frame in order."""
+
+    def __init__(self):
+        self.frames = []
+        self._lock = threading.Lock()
+
+    def publish(self, origin, data: bytes) -> None:
+        with self._lock:
+            self.frames.append(bytes(data))
+
+    def request(self, *a, **k):  # pragma: no cover - never queried
+        raise AssertionError("bench transport has no query channel")
+
+
+def drive_sender(records, ship: bool, ship_txns=64, ship_us=2000):
+    """Feed the commit tape through a sender; returns (frames,
+    commit_path_seconds) — the latter is time spent inside on_append,
+    i.e. what the committing thread pays for the wire."""
+    from antidote_tpu.config import Config
+    from antidote_tpu.interdc.sender import InterDcLogSender
+
+    cfg = Config(interdc_ship=ship, interdc_ship_txns=ship_txns,
+                 interdc_ship_us=ship_us)
+    cap = CaptureTransport()
+    sender = InterDcLogSender("dc1", 0, cap, enabled=True, config=cfg)
+    # mid-stream heartbeats: under ship they must piggyback (no
+    # standalone ping frames while traffic flows)
+    t0 = time.perf_counter()
+    for i, rec in enumerate(records):
+        sender.on_append(rec)
+        if i and i % 997 == 0:
+            sender.ping(rec.op_id.n)
+    commit_path = time.perf_counter() - t0
+    sender.flush_ship()
+    sender.close()
+    return cap.frames, commit_path
+
+
+def receive(frames):
+    """Decode + deliver through the real SubBuf -> DependencyGate
+    pipeline; returns (admitted records list, final gate clock)."""
+    from antidote_tpu.interdc.dep import DependencyGate
+    from antidote_tpu.interdc.sub_buf import SubBuf
+    from antidote_tpu.interdc.wire import InterDcBatch, frame_from_bin
+
+    admitted = []
+    pm = type("PM", (), {
+        "apply_remote": lambda self, recs, dc, ts, ss:
+            admitted.append((tuple(recs), dc, ts, ss))})()
+    gate = DependencyGate(pm, "self", now_us=lambda: 2**62, adapt=False,
+                          batch_threshold=10**9)
+    # the stream's snapshot VCs name dc2/dc3, whose watermarks a real
+    # mesh feeds from those DCs' own streams — seed them so this
+    # single-stream probe gates only on the dc1 dependencies
+    from antidote_tpu.clocks import VC
+
+    gate.seed_clock(VC({"dc2": 2**61, "dc3": 2**61}))
+    buf = SubBuf("dc1", 0, deliver=gate.enqueue,
+                 deliver_batch=gate.enqueue_batch,
+                 fetch_range=lambda *a: None)
+    for data in frames:
+        frame = frame_from_bin(data)
+        if isinstance(frame, InterDcBatch):
+            buf.process_batch(frame.delivery_txns())
+        else:
+            buf.process(frame)
+    gate.process_queues()
+    assert gate.pending() == 0, "steady stream should fully drain"
+    return admitted, gate.applied_vc
+
+
+def run_mode(records, n_txns, ship: bool):
+    from antidote_tpu.interdc.wire import InterDcBatch, frame_from_bin
+
+    frames, commit_path = drive_sender(records, ship=ship)
+    admitted, clock = receive(frames)
+    txn_frames = ping_frames = 0
+    for data in frames:
+        f = frame_from_bin(data)
+        if isinstance(f, InterDcBatch) or not f.is_ping():
+            txn_frames += 1
+        else:
+            ping_frames += 1
+    wire_bytes = sum(len(d) for d in frames)
+    return {
+        "frames": txn_frames,
+        "ping_frames": ping_frames,
+        "wire_bytes": wire_bytes,
+        "txns_per_frame": n_txns / txn_frames,
+        "bytes_per_txn": wire_bytes / n_txns,
+        "commit_path_us_per_txn": commit_path / n_txns * 1e6,
+        "admitted": admitted,
+        "clock": clock,
+    }
+
+
+def summary(n_txns):
+    records, n = build_stream(n_txns)
+    ship = run_mode(records, n, ship=True)
+    legacy = run_mode(records, n, ship=False)
+    # bit-for-bit delivery equivalence: same admissions, same order,
+    # same records, same final dependency clock
+    assert len(ship["admitted"]) == len(legacy["admitted"]) == n, \
+        (len(ship["admitted"]), len(legacy["admitted"]), n)
+    assert ship["admitted"] == legacy["admitted"], \
+        "ship plane diverged from legacy delivery"
+    assert ship["clock"] == legacy["clock"]
+    # heartbeats piggybacked while the stream had traffic
+    assert ship["ping_frames"] <= legacy["ping_frames"]
+    return {
+        "txns": n,
+        "ship_txn_frames": ship["frames"],
+        "legacy_txn_frames": legacy["frames"],
+        "ship_txns_per_frame": round(ship["txns_per_frame"], 2),
+        "legacy_txns_per_frame": round(legacy["txns_per_frame"], 2),
+        "frame_amortization_x": round(
+            ship["txns_per_frame"] / legacy["txns_per_frame"], 2),
+        "ship_bytes_per_txn": round(ship["bytes_per_txn"], 1),
+        "legacy_bytes_per_txn": round(legacy["bytes_per_txn"], 1),
+        "byte_amortization_x": round(
+            legacy["bytes_per_txn"] / ship["bytes_per_txn"], 2),
+        "ship_commit_path_us_per_txn": round(
+            ship["commit_path_us_per_txn"], 2),
+        "legacy_commit_path_us_per_txn": round(
+            legacy["commit_path_us_per_txn"], 2),
+        "ship_ping_frames": ship["ping_frames"],
+        "legacy_ping_frames": legacy["ping_frames"],
+    }
+
+
+def main():
+    quick, _jax = setup()
+    n_txns = 1280 if quick else 8000
+    s = summary(n_txns)
+    emit("repl_txns_per_frame", s["ship_txns_per_frame"], "txn/frame",
+         s["frame_amortization_x"],
+         legacy_txns_per_frame=s["legacy_txns_per_frame"],
+         ship_txn_frames=s["ship_txn_frames"],
+         legacy_txn_frames=s["legacy_txn_frames"], txns=s["txns"])
+    emit("repl_wire_bytes_per_txn", s["ship_bytes_per_txn"],
+         "wire B/txn", s["byte_amortization_x"],
+         legacy_bytes_per_txn=s["legacy_bytes_per_txn"],
+         ship_commit_path_us_per_txn=s["ship_commit_path_us_per_txn"],
+         legacy_commit_path_us_per_txn=s["legacy_commit_path_us_per_txn"],
+         ship_ping_frames=s["ship_ping_frames"],
+         legacy_ping_frames=s["legacy_ping_frames"], txns=s["txns"])
+
+
+if __name__ == "__main__":
+    main()

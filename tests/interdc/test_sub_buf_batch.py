@@ -1,0 +1,144 @@
+"""SubBuf batch-frame handling (ISSUE 6 satellite): gap repair across a
+batch's opid span, duplicate-batch drop, buffering-order preservation,
+and partial-duplicate prefixes."""
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.interdc.sub_buf import SubBuf
+from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.oplog.records import OpId, commit_record
+
+
+def mk_txn(prev, n_ops=1, dc="dc1"):
+    """One txn spanning (prev, prev + n_ops]."""
+    last = prev + n_ops
+    recs = [commit_record(OpId(dc, last), ("t", last), dc, 100 + last,
+                          VC({dc: 90 + last}))]
+    return InterDcTxn.from_ops(dc, 0, prev, recs)
+
+
+def chain(start, n, n_ops=1):
+    out, prev = [], start
+    for _ in range(n):
+        t = mk_txn(prev, n_ops)
+        out.append(t)
+        prev = t.last_opid()
+    return out
+
+
+class Harness:
+    def __init__(self, last_opid=0, repairable=True):
+        self.delivered = []          # (txn, via_batch)
+        self.batch_sizes = []
+        self.fetches = []
+        self.repairable = repairable
+        self.origin_log = {}         # last_opid -> txn
+        self.buf = SubBuf(
+            "dc1", 0,
+            deliver=lambda t: self.delivered.append((t, False)),
+            deliver_batch=self._deliver_batch,
+            fetch_range=self._fetch, last_opid=last_opid)
+
+    def _deliver_batch(self, txns):
+        self.batch_sizes.append(len(txns))
+        self.delivered.extend((t, True) for t in txns)
+
+    def _fetch(self, origin, partition, first, last):
+        self.fetches.append((first, last))
+        if not self.repairable:
+            return None
+        return [t for lo, t in self.origin_log.items()
+                if first <= lo <= last]
+
+    def seed_log(self, txns):
+        for t in txns:
+            self.origin_log[t.last_opid()] = t
+
+    def opids(self):
+        return [t.last_opid() for t, _via in self.delivered]
+
+
+class TestBatchDelivery:
+    def test_contiguous_batch_delivers_as_one_arrival(self):
+        h = Harness()
+        txns = chain(0, 5)
+        h.buf.process_batch(txns)
+        assert h.opids() == [t.last_opid() for t in txns]
+        assert h.batch_sizes == [5]  # ONE gate arrival, not five
+        assert h.buf.last_opid == txns[-1].last_opid()
+        assert h.buf.state == "normal"
+
+    def test_duplicate_batch_dropped(self):
+        h = Harness()
+        txns = chain(0, 4)
+        h.buf.process_batch(txns)
+        n = len(h.delivered)
+        h.buf.process_batch(txns)  # full replay (origin resend)
+        assert len(h.delivered) == n
+        assert h.buf.state == "normal"
+
+    def test_partially_duplicate_batch_delivers_only_fresh_suffix(self):
+        h = Harness()
+        txns = chain(0, 6)
+        h.buf.process_batch(txns[:4])
+        h.buf.process_batch(txns[2:])  # overlap: txns 2-3 are covered
+        assert h.opids() == [t.last_opid() for t in txns]
+        assert h.batch_sizes == [4, 2]
+
+    def test_gap_before_batch_buffers_and_repairs_whole_span(self):
+        h = Harness()
+        lost, arriving = chain(0, 3), chain(3, 4)
+        h.seed_log(lost)
+        h.buf.process_batch(arriving)
+        # the repair fetch covered the batch's full missing prefix span
+        assert h.fetches == [(1, 3)]
+        assert h.opids() == [t.last_opid() for t in lost + arriving]
+        assert h.buf.state == "normal"
+        assert h.buf.last_opid == arriving[-1].last_opid()
+
+    def test_gap_with_unreachable_origin_keeps_buffering_order(self):
+        h = Harness(repairable=False)
+        first, second = chain(3, 2), chain(5, 2)
+        h.buf.process_batch(first)
+        h.buf.process_batch(second)   # arrives while buffering
+        assert h.buf.state == "buffering"
+        assert not h.delivered
+        # heal: the queued txns drain in stream order after repair
+        h.repairable = True
+        h.seed_log(chain(0, 3))
+        h.buf.process_batch(chain(7, 1))
+        assert h.opids() == list(range(1, 9))
+        assert h.buf.state == "normal"
+
+    def test_gap_inside_batch_delivers_prefix_then_repairs(self):
+        h = Harness()
+        txns = chain(0, 6)
+        h.seed_log(txns)
+        # a corrupted middle: txns 0-1, then 4-5 (2-3 lost)
+        h.buf.process_batch(txns[:2] + txns[4:])
+        assert h.opids() == [t.last_opid() for t in txns]
+        assert h.fetches == [(3, 4)]
+        # the deliverable prefix still went down as one batch
+        assert h.batch_sizes[0] == 2
+
+    def test_batch_with_trailing_ping_advances_watermark_only(self):
+        h = Harness()
+        txns = chain(0, 3)
+        ping = InterDcTxn.ping("dc1", 0, txns[-1].last_opid(), 999)
+        h.buf.process_batch(txns + [ping])
+        assert h.batch_sizes == [4]
+        assert h.delivered[-1][0].is_ping()
+        # pings keep the stream watermark (last_opid of the batch)
+        assert h.buf.last_opid == txns[-1].last_opid()
+
+    def test_per_txn_fallback_without_deliver_batch(self):
+        delivered = []
+        buf = SubBuf("dc1", 0, deliver=delivered.append,
+                     fetch_range=lambda *a: None)
+        buf.process_batch(chain(0, 3))
+        assert [t.last_opid() for t in delivered] == [1, 2, 3]
+
+    def test_batch_while_buffering_preserves_arrival_order(self):
+        h = Harness(repairable=False)
+        h.buf.process(mk_txn(2))       # gap: 1-2 missing
+        h.buf.process_batch(chain(3, 2))
+        assert [t.last_opid() for t in h.buf._queue] == [3, 4, 5]

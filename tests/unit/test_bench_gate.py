@@ -255,9 +255,38 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # per-op per-column appends fails the gate
     ("ops/dispatch", 1), ("B/op", -1), ("bytes/op", -1),
     ("dispatches/op", -1),
+    # shipping-plane family (ISSUE 6): txns per wire frame must not
+    # fall, encoded wire bytes per shipped txn must not rise — a
+    # regression back to one-frame-per-txn fails the gate
+    ("txn/frame", 1), ("txns/frame", 1),
+    ("wire B/txn", -1), ("frames/txn", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_ship_plane_regression(tmp_path, capsys):
+    """ISSUE 6 synthetic two-round trajectory: round 2's replication
+    rows slide back toward per-txn frames — txns/frame collapses
+    (down = regression) and wire bytes per txn balloons (up =
+    regression).  Both must fail."""
+    import json
+
+    old = _bench_body({
+        "repl_txns_per_frame": {"value": 58.0, "unit": "txn/frame"},
+        "repl_wire_bytes_per_txn": {"value": 75.0, "unit": "wire B/txn"},
+    }, rnd=1)
+    new = _bench_body({
+        "repl_txns_per_frame": {"value": 1.0, "unit": "txn/frame"},
+        "repl_wire_bytes_per_txn": {"value": 310.0,
+                                    "unit": "wire B/txn"},
+    }, rnd=2)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(new))
+    assert bench_gate.main(["--root", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "repl_txns_per_frame" in err
+    assert "repl_wire_bytes_per_txn" in err
 
 
 def test_gate_fails_on_ingest_amortization_regression(tmp_path,

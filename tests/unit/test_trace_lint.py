@@ -31,10 +31,16 @@ def test_lint_detects_a_dark_entry_point(tmp_path):
                        .replace("tracing.annotate", "tracing_annotate")
                        .replace("prof.annotate", "prof_annotate"))
     problems = trace_lint.lint(str(tmp_path))
-    # every single entry point goes dark in the stripped copy
+    # every single entry point goes dark in the stripped copy (the
+    # stripped interdc files additionally trip the ISSUE-6 publish
+    # rule — counted separately below)
+    entry = [p for p in problems if "no span/annotation" in p
+             or "entry point missing" in p]
     n_points = sum(len(ms) for classes in trace_lint.ENTRY_POINTS.values()
                    for ms in classes.values())
-    assert len(problems) == n_points
+    assert len(entry) == n_points
+    assert any("transport.publish" in p for p in problems), \
+        "stripped sender's publish sites should trip the publish rule"
 
 
 def test_standalone_main_exit_code():
@@ -130,3 +136,33 @@ def test_kernel_span_rule_covers_interdc(tmp_path):
     problems = trace_lint.lint_kernel_spans(str(tmp_path))
     flagged = {p.split("::")[1].split(":")[0] for p in problems}
     assert flagged == {"bare_ring_op"}
+
+
+def test_publish_rule_flags_untraced_publish_sites(tmp_path):
+    """ISSUE 6 rule: a function under antidote_tpu/interdc/ calling
+    transport.publish / bus.publish without a span or instant is a
+    dark wire send; instrumented ones pass."""
+    d = tmp_path / "antidote_tpu" / "interdc"
+    d.mkdir(parents=True)
+    (d / "newsender.py").write_text(
+        "from antidote_tpu.obs.spans import tracer\n"
+        "class S:\n"
+        "    def dark_send(self, data):\n"
+        "        self.transport.publish('dc', data)\n"
+        "    def dark_bus_send(self, bus, data):\n"
+        "        bus.publish('dc', data)\n"
+        "    def good_send(self, data):\n"
+        "        with tracer.span('interdc_send', 'interdc'):\n"
+        "            self.transport.publish('dc', data)\n"
+        "    def good_instant_send(self, data):\n"
+        "        tracer.instant('interdc_send', 'interdc')\n"
+        "        self.transport.publish('dc', data)\n"
+        "    def unrelated(self, q):\n"
+        "        q.publish_stats()\n")
+    problems = trace_lint.lint_publish_spans(str(tmp_path))
+    flagged = {p.split("::")[1].split(":")[0] for p in problems}
+    assert flagged == {"dark_send", "dark_bus_send"}
+
+
+def test_publish_rule_clean_on_repo():
+    assert trace_lint.lint_publish_spans(trace_lint.repo_root()) == []

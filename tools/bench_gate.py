@@ -49,16 +49,21 @@ _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 #: covers the amortization families: the gate ring's admitted txns per
 #: device dispatch (ISSUE 3) AND the coalesced ingest plane's ops per
 #: packed dispatch (ISSUE 4) — a regression back to per-op appends
-#: collapses the ratio toward 1 and must fail the gate.
-_HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch")
+#: collapses the ratio toward 1 and must fail the gate.  "/frame" is
+#: the shipping plane's wire amortization (ISSUE 6): txns per
+#: published batch frame sliding toward 1 means the wire has regressed
+#: to one frame per txn.
+_HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch", "/frame")
 #: units whose value should not RISE (smaller is better).  The
 #: "*/txn" per-admitted-cost units (H2D bytes per txn, dispatches per
-#: txn) are the other face of the gate amortization story; the "*/op"
-#: per-ingested-cost units (H2D bytes per op, dispatches per op) are
-#: the ingest plane's (ISSUE 4 first-class directions).
+#: txn, and ISSUE 6's encoded wire bytes per shipped txn) are the
+#: other face of the amortization stories; the "*/op" per-ingested-
+#: cost units (H2D bytes per op, dispatches per op) are the ingest
+#: plane's (ISSUE 4 first-class directions).
 _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  "b/txn", "bytes/txn", "dispatches/txn",
-                 "b/op", "bytes/op", "dispatches/op"}
+                 "b/op", "bytes/op", "dispatches/op",
+                 "frames/txn", "wire b/txn"}
 
 
 def repo_root() -> str:

@@ -19,6 +19,13 @@ jitted entry point lands.  ISSUE 3 extends the same rule to
 antidote_tpu/interdc/ — the dependency gate's resident-ring kernels
 (interdc/gate_kernels.py) are now a first-class device plane.
 
+ISSUE 6 adds the publish rule: every function under
+antidote_tpu/interdc/ that calls ``transport.publish`` / ``bus.publish``
+(the pub/sub fabric's send) must carry a span or instant — the async
+ship worker moved publishing off the commit path, and an untraced
+publish site would make outbound frames invisible to the txid-
+correlated forensic hunts the obs plane exists for.
+
 Runs standalone (``python tools/trace_lint.py``) and from tier-1
 (tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
 Purely static (ast), so it needs no JAX and runs in milliseconds.
@@ -76,6 +83,13 @@ _KERNEL_SPAN_DIRS = (os.path.join("antidote_tpu", "mat"),
 
 #: decorators that wrap the whole method in a span
 _INSTRUMENTED_DECORATORS = {"traced"}
+
+#: attribute names that hold the inter-DC pub/sub fabric: a call
+#: ``<something>.<one of these>.publish(...)`` (or a bare
+#: ``transport.publish`` / ``bus.publish``) is a wire send and must be
+#: instrumented (ISSUE 6); the package the rule sweeps
+_PUBLISH_OWNERS = ("transport", "bus")
+_PUBLISH_DIR = os.path.join("antidote_tpu", "interdc")
 
 
 def _is_instrumented(fn: ast.FunctionDef) -> bool:
@@ -210,6 +224,49 @@ def lint_kernel_spans(root: str) -> List[str]:
     return problems
 
 
+def _is_publish_call(node: ast.Call) -> bool:
+    """True for ``transport.publish(...)`` / ``self.bus.publish(...)``
+    etc. — an Attribute call named ``publish`` whose owner is (or ends
+    in an attribute named) one of _PUBLISH_OWNERS."""
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr != "publish":
+        return False
+    owner = f.value
+    name = getattr(owner, "attr", getattr(owner, "id", None))
+    return name in _PUBLISH_OWNERS
+
+
+def lint_publish_spans(root: str) -> List[str]:
+    """ISSUE 6 rule: every function under antidote_tpu/interdc/ with a
+    ``transport.publish`` / ``bus.publish`` call site must also carry a
+    span/instant/annotation, so outbound wire sends stay visible to the
+    forensic plane even as they move between threads."""
+    problems: List[str] = []
+    d = os.path.join(root, _PUBLISH_DIR)
+    if not os.path.isdir(d):
+        return problems
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(d, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            has_publish = any(
+                isinstance(c, ast.Call) and _is_publish_call(c)
+                for c in ast.walk(node))
+            if has_publish and not _is_instrumented(node):
+                problems.append(
+                    f"{_PUBLISH_DIR}/{fname}::{node.name}: "
+                    "transport.publish call site without a tracer "
+                    "span/instant — outbound frames go dark "
+                    "(antidote_tpu/obs/spans.py)")
+    return problems
+
+
 def _methods(tree: ast.Module, cls_name: str) -> Dict[str, ast.FunctionDef]:
     for node in tree.body:
         if isinstance(node, ast.ClassDef) and node.name == cls_name:
@@ -244,6 +301,7 @@ def lint(root: str) -> List[str]:
                         "tracer.span/instant, prof.annotate, or "
                         "@traced")
     problems.extend(lint_kernel_spans(root))
+    problems.extend(lint_publish_spans(root))
     return problems
 
 
