@@ -139,14 +139,14 @@ class AntidoteTPU:
     def start_profiling(self, log_dir: str) -> None:
         """Begin a JAX profiler capture of the node's device work
         (SURVEY §5.1; inspect with TensorBoard/XProf)."""
-        from antidote_tpu import tracing
+        from antidote_tpu.obs import prof
 
-        tracing.start(log_dir)
+        prof.start(log_dir)
 
     def stop_profiling(self) -> str:
-        from antidote_tpu import tracing
+        from antidote_tpu.obs import prof
 
-        return tracing.stop()
+        return prof.stop()
 
     def admin_status(self) -> dict:
         """Operator status snapshot (the antidote_console duty,

@@ -40,6 +40,8 @@ from antidote_tpu.interdc.wire import (
     InterDcTxn,
     frame_from_bin,
 )
+from antidote_tpu.obs import pipeline as obs_pipeline
+from antidote_tpu.obs.spans import tracer
 
 log = logging.getLogger(__name__)
 
@@ -142,6 +144,9 @@ class NodeInterDc:
                 self.observe_dc(FederatedDescriptor.from_wire(t))
             except Exception:  # noqa: BLE001 — a dead peer at boot
                 log.warning("restart re-observe of %r failed", t[0])
+        # the pipeline snapshot plane sees federated members too (one
+        # entry per member, keyed "dcid[member]" — obs/pipeline.py)
+        obs_pipeline.register(self)
 
     def _source_for(self, p: int):
         def pull():
@@ -299,8 +304,23 @@ class NodeInterDc:
             if buf is None:
                 return
             if isinstance(frame, InterDcBatch):
+                tracer.adopt_from_wire(frame.trace_hdr, frame.txns())
+                for txn in frame.txns():
+                    tracer.instant(
+                        "interdc_rx", "interdc",
+                        txid=getattr(txn.records[-1], "txid", None),
+                        origin=str(frame.dc_id),
+                        partition=frame.partition)
                 buf.process_batch(frame.delivery_txns())
                 return
+            if not frame.is_ping():
+                if frame.trace_ctx is not None:
+                    tracer.adopt_from_wire((frame.trace_ctx[1], 0),
+                                           [frame])
+                tracer.instant(
+                    "interdc_rx", "interdc",
+                    txid=getattr(frame.records[-1], "txid", None),
+                    origin=str(frame.dc_id), partition=frame.partition)
             buf.process(frame)
 
     def _make_gate_deliver(self, p: int):
@@ -346,6 +366,9 @@ class NodeInterDc:
                     bins = self.srv.link.request(
                         owner, "idc_log_read",
                         (partition, first, last))
+                    tracer.instant("interdc_repair_relay", "interdc",
+                                   partition=partition, first=first,
+                                   last=last, frames=len(bins))
                     return [InterDcTxn.from_bin(b) for b in bins]
                 raise ValueError(
                     f"partition {partition} not owned by member "
@@ -359,6 +382,7 @@ class NodeInterDc:
         raise ValueError(f"unknown inter-DC query kind {kind!r}")
 
     def close(self) -> None:
+        obs_pipeline.unregister(self)
         if self._hb is not None:
             self._hb.stop()
             self._hb = None

@@ -189,4 +189,13 @@ class Config:
     #: antidote_tpu/obs/probe.py); violations dump the flight recorder.
     #: Default off: the oracle replay costs a per-key log scan.
     obs_selfcheck_set_aw: float = 0.0
+    #: causal-probe auditor period, seconds (ISSUE 7,
+    #: antidote_tpu/obs/probe.py): each round commits a unique probe
+    #: element on this DC and causally reads it back on every other
+    #: DC registered in the process, recording the observed
+    #: write->remote-read staleness and alarming (flight-recorder
+    #: dump + error log) on a causal-order violation.  0 disables
+    #: (default — each round costs one txn per period plus a causal
+    #: read per peer).
+    obs_causal_probe_s: float = 0.0
     extra: dict = field(default_factory=dict)

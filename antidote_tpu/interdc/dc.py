@@ -40,6 +40,8 @@ from antidote_tpu.interdc.wire import (
 )
 from antidote_tpu.meta.device_stable import make_stable_tracker
 from antidote_tpu.meta.stable_store import StableMetaData
+from antidote_tpu.obs import pipeline as obs_pipeline
+from antidote_tpu.obs import probe as obs_probe
 from antidote_tpu.obs.spans import tracer
 from antidote_tpu.txn.node import Node
 
@@ -76,6 +78,7 @@ class DataCenter(AntidoteTPU):
         self._hb_worker: Optional[_Ticker] = None
         self._bc_worker: Optional[_Ticker] = None
         self._staleness: Optional[stats.StalenessSampler] = None
+        self._causal_probe: Optional[obs_probe.CausalProbe] = None
         node.bcounter_mgr = BCounterMgr(self)
 
         # re-join DCs we knew before a restart; an unreachable peer must
@@ -108,6 +111,9 @@ class DataCenter(AntidoteTPU):
                 logging.getLogger(__name__).warning(
                     "ignoring persisted unknown flag %r", name)
         self.meta.mark_started()
+        # the pipeline-snapshot plane (/debug/pipeline) and the causal
+        # probe's peer discovery both see every DC in the process
+        obs_pipeline.register(self)
 
     # ---------------------------------------------------------- admin plane
 
@@ -308,8 +314,20 @@ class DataCenter(AntidoteTPU):
                 period_s=self.node.config.staleness_sample_s,
                 # per-peer replication lag rides the same snapshot fetch
                 peers_source=lambda: list(self.connected_dcs),
-                local_dc=self.node.dc_id)
+                local_dc=self.node.dc_id,
+                # per-partition safe-time lag (ISSUE 7): each source is
+                # the partition's dep-gate watermarks + min-prepared —
+                # read at sample time so a repartition's rebuilt source
+                # list is picked up
+                safe_time_sources=lambda: [
+                    (p, src())
+                    for p, src in enumerate(self.stable.sources)])
             self._staleness.start()
+        if self._causal_probe is None \
+                and self.node.config.obs_causal_probe_s > 0:
+            self._causal_probe = obs_probe.CausalProbe(
+                self, period_s=self.node.config.obs_causal_probe_s)
+            self._causal_probe.start()
         stats.install_error_monitor()
         if self.node.config.metrics_port is not None:
             # process-global: all DCs share one registry and one server
@@ -370,6 +388,7 @@ class DataCenter(AntidoteTPU):
                 # the ship plane's coalesced frame: the whole span goes
                 # through the sub-buffer as one arrival batch, with the
                 # piggybacked heartbeat (if any) trailing it
+                tracer.adopt_from_wire(frame.trace_hdr, frame.txns())
                 for txn in frame.txns():
                     tracer.instant("interdc_rx", "interdc",
                                    txid=getattr(txn.records[-1], "txid",
@@ -387,6 +406,8 @@ class DataCenter(AntidoteTPU):
             if txid is None:
                 buf.process(txn)
                 return
+            if txn.trace_ctx is not None:
+                tracer.adopt_from_wire((txn.trace_ctx[1], 0), [txn])
             # arrival marker only: buf.process may drain a backlog of
             # OTHER buffered transactions, so a span here would charge
             # their apply cost to this txid.  The per-txn deliver span
@@ -465,8 +486,12 @@ class DataCenter(AntidoteTPU):
         if self._staleness is not None:
             self._staleness.stop()
             self._staleness = None
+        if self._causal_probe is not None:
+            self._causal_probe.stop()
+            self._causal_probe = None
 
     def close(self) -> None:
+        obs_pipeline.unregister(self)
         self._stop_bg_processes()
         # flush + stop the ship workers before the inbound worker: a
         # staged batch published now still reaches live peers

@@ -37,6 +37,7 @@ the pre-ISSUE-3 repack form (the benches' comparison baseline).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from itertools import islice
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -542,10 +543,43 @@ class DependencyGate:
         recorder.record("interdc", "depgate_admit", txid=txid,
                         origin=str(txn.dc_id), wait_s=wait_s,
                         timestamp=txn.timestamp)
+        # visibility SLO (ISSUE 7): the txn's records just landed in
+        # the local log + materializer — THIS is ingest-visibility
+        # time.  The carried origin-commit wallclock (wire trace_ctx)
+        # turns it into the commit->remote-visible latency Cure's
+        # whole design is about, per (observing dc, origin peer).
+        tctx = getattr(txn, "trace_ctx", None)
+        if tctx is not None:
+            vis_lag_s = max(time.time_ns() // 1000 - tctx[0], 0) / 1e6
+            stats.registry.vis_lag.observe(
+                vis_lag_s, dc=str(self.own_dc), peer=str(txn.dc_id))
+            tracer.instant("interdc_visible", "interdc", txid=txid,
+                           origin=str(txn.dc_id),
+                           vis_lag_s=round(vis_lag_s, 6))
         self._advance(txn.dc_id, txn.timestamp)
 
     def pending(self) -> int:
         return sum(len(q) for q in self.queues.values())
+
+    def queue_stats(self) -> dict:
+        """This gate's backlog + ring occupancy for the pipeline
+        snapshot (obs/pipeline.py): per-origin queue depths, the
+        applied watermark vector, and — when the device ring is live —
+        its slot occupancy."""
+        ring = None
+        if self._ring is not None:
+            ring = {"live_slots": self._ring.n_live,
+                    "capacity": self._ring.cap,
+                    "clock_columns": len(self._ring.cols),
+                    "retire_pending": len(self._ring.retire_pending)}
+        return {
+            "pending": self.pending(),
+            "queues": {str(o): len(q) for o, q in self.queues.items()
+                       if q},
+            "applied_vc": {str(k): v
+                           for k, v in dict(self.applied_vc).items()},
+            "ring": ring,
+        }
 
 
 class _DeviceRing:

@@ -83,6 +83,15 @@ def _note_frame(kind: str, nbytes: int, ntxns: int = 0,
         reg.ship_bytes_per_txn.set(reg.ship_bytes.value() / carried)
 
 
+def _trace_permille() -> int:
+    """The process tracer's sample rate as an integer permille — the
+    frame trace header's compact form (ISSUE 7).  Receivers replay the
+    origin's deterministic per-txid decision at this rate, so a
+    sampled txn's remote-side spans record even when the local rate
+    differs."""
+    return max(0, min(1000, int(round(tracer.sample_rate * 1000))))
+
+
 def _est_term_bytes(v) -> int:
     """Cheap encoded-size estimate for the ship buffer's byte budget
     (soft budget: the worker closes a frame early past it, so an
@@ -163,6 +172,13 @@ class InterDcLogSender:
         with self._lock:
             txn = InterDcTxn.from_ops(self.dc_id, self.partition,
                                       self.last_sent_opid, done)
+            # trace context (ISSUE 7): the origin commit wallclock the
+            # remote visibility-lag histograms subtract from, plus the
+            # sample rate receivers replay the sampling decision at.
+            # Stamped here — the commit record was just appended, so
+            # this wall instant IS commit time to within the staging
+            # hop this plane already made asynchronous.
+            txn.trace_ctx = (time.time_ns() // 1000, _trace_permille())
             self.last_sent_opid = txn.last_opid()
             if not self.enabled:
                 return
@@ -299,7 +315,10 @@ class InterDcLogSender:
             entry = None
             try:
                 if chunk:
-                    batch = InterDcBatch.from_txns(chunk, ping_ts=ping)
+                    batch = InterDcBatch.from_txns(
+                        chunk, ping_ts=ping,
+                        trace_hdr=(_trace_permille(),
+                                   time.time_ns() // 1000))
                     entry = ("batch", batch, batch.to_bin(), len(chunk),
                              ping is not None)
                 elif ping is not None:
@@ -386,7 +405,8 @@ class InterDcLogSender:
         ping, self._pending_ping = self._pending_ping, None
         for i, chunk in enumerate(chunks):
             batch = InterDcBatch.from_txns(
-                chunk, ping_ts=ping if i == len(chunks) - 1 else None)
+                chunk, ping_ts=ping if i == len(chunks) - 1 else None,
+                trace_hdr=(_trace_permille(), time.time_ns() // 1000))
             self._outbox.append(("batch", batch, batch,
                                  len(chunk), ping is not None
                                  and i == len(chunks) - 1))
@@ -401,6 +421,27 @@ class InterDcLogSender:
         with self._lock:
             return (len(self._buf) + len(self._outbox)
                     + (1 if self._draining else 0))
+
+    def queue_stats(self) -> dict:
+        """This stream's ship-buffer state for the pipeline snapshot
+        (obs/pipeline.py): staged depth/bytes, oldest-staged age,
+        outbox length, and the opid watermark."""
+        with self._lock:
+            # _buf_since can be 0.0 with txns still staged (flush_ship
+            # expires the window that way) — a scrape then must not
+            # report process-uptime-sized staged age
+            age_us = (int((time.monotonic() - self._buf_since) * 1e6)
+                      if self._buf and self._buf_since > 0 else 0)
+            return {
+                "staged_txns": len(self._buf),
+                "staged_bytes": self._buf_bytes,
+                "oldest_age_us": max(age_us, 0),
+                "outbox_frames": len(self._outbox),
+                "draining": self._draining,
+                "pending_ping": self._pending_ping is not None,
+                "last_sent_opid": self.last_sent_opid,
+                "enabled": self.enabled,
+            }
 
     def flush_ship(self, timeout: float = 2.0) -> None:
         """Drain the ship buffer synchronously (tests / shutdown): wake

@@ -26,10 +26,24 @@ and triggers the same repair fetch as the per-txn path.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Callable, List, Optional
 
 from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import tracer
+
+
+def _note_admit(txn: InterDcTxn) -> None:
+    """Per-txn SubBuf-admission instant (ISSUE 7): the stage between
+    wire arrival (``interdc_rx``) and gate delivery
+    (``interdc_deliver``) in a sampled txn's journey — the hop where
+    gap-repair delay, if any, was paid."""
+    if not txn.is_ping():
+        tracer.instant("subbuf_admit", "interdc",
+                       txid=getattr(txn.records[-1], "txid", None),
+                       origin=str(txn.dc_id), partition=txn.partition)
 
 
 class SubBuf:
@@ -54,6 +68,12 @@ class SubBuf:
         self.last_opid = last_opid
         self.state = "normal"  # | "buffering"
         self._queue: deque = deque()
+
+    def gap_stats(self) -> dict:
+        """This stream's gap/repair state for the pipeline snapshot
+        (obs/pipeline.py)."""
+        return {"state": self.state, "buffered_txns": len(self._queue),
+                "last_opid": self.last_opid}
 
     def process(self, txn: InterDcTxn) -> None:
         if self.state == "buffering":
@@ -82,6 +102,7 @@ class SubBuf:
             else:
                 # gap: flush what is deliverable, buffer the remainder
                 self._flush_batch(fresh)
+                self._note_gap(txn)
                 self._queue.extend(txns[i:])
                 self.state = "buffering"
                 self._try_repair()
@@ -91,6 +112,8 @@ class SubBuf:
     def _flush_batch(self, txns: List[InterDcTxn]) -> None:
         if not txns:
             return
+        for txn in txns:
+            _note_admit(txn)
         if self._deliver_batch is not None:
             self._deliver_batch(txns)
         else:
@@ -99,15 +122,34 @@ class SubBuf:
 
     def _handle(self, txn: InterDcTxn) -> None:
         if txn.prev_log_opid == self.last_opid:
+            _note_admit(txn)
             self._deliver(txn)
             self.last_opid = txn.last_opid()
         elif txn.prev_log_opid < self.last_opid:
             # duplicate / already covered (e.g. replayed after restart)
             return
         else:
+            self._note_gap(txn)
             self._queue.append(txn)
             self.state = "buffering"
             self._try_repair()
+
+    def _note_gap(self, txn: InterDcTxn) -> None:
+        """Gap detection: the stream lost frames and the txns behind
+        the hole now wait on a repair fetch — the journey stage that
+        explains a visibility-lag outlier.  Gaps are rare and
+        diagnostic by nature, so the flight-recorder event is
+        UNCONDITIONAL (untagged tracer instants are thinned ~19/20 at
+        the default sample rate — exactly wrong for the record an
+        operator chases a lag outlier with); the timeline instant
+        rides the sampler as usual."""
+        recorder.record("interdc", "subbuf_gap",
+                        origin=str(self.origin_dc),
+                        partition=self.partition,
+                        expected=self.last_opid, got=txn.prev_log_opid)
+        tracer.instant("subbuf_gap", "interdc", origin=str(self.origin_dc),
+                       partition=self.partition,
+                       expected=self.last_opid, got=txn.prev_log_opid)
 
     def _try_repair(self) -> None:
         """Fetch (last_opid, first_queued.prev_log_opid] from the origin
@@ -117,17 +159,35 @@ class SubBuf:
             if head.prev_log_opid <= self.last_opid:
                 txn = self._queue.popleft()
                 if txn.prev_log_opid == self.last_opid:
+                    _note_admit(txn)
                     self._deliver(txn)
                     self.last_opid = txn.last_opid()
                 # else: duplicate, drop
                 continue
-            missing = self._fetch_range(self.origin_dc, self.partition,
-                                        self.last_opid + 1,
-                                        head.prev_log_opid)
+            t0 = time.perf_counter()
+            with tracer.span("subbuf_gap_repair", "interdc",
+                             origin=str(self.origin_dc),
+                             partition=self.partition,
+                             first=self.last_opid + 1,
+                             last=head.prev_log_opid):
+                missing = self._fetch_range(
+                    self.origin_dc, self.partition,
+                    self.last_opid + 1, head.prev_log_opid)
+            # unconditional, like _note_gap: the repair record must
+            # survive the sampler for the outlier hunt it exists for
+            recorder.record("interdc", "subbuf_repair",
+                            origin=str(self.origin_dc),
+                            partition=self.partition,
+                            first=self.last_opid + 1,
+                            last=head.prev_log_opid,
+                            fetched=len(missing or ()),
+                            reachable=missing is not None,
+                            dur_s=round(time.perf_counter() - t0, 6))
             if missing is None:
                 return  # origin unreachable; retry on next frame
             for txn in sorted(missing, key=lambda t: t.last_opid()):
                 if txn.last_opid() > self.last_opid:
+                    _note_admit(txn)
                     self._deliver(txn)
                     self.last_opid = txn.last_opid()
             # A successful answer authoritatively covers the requested

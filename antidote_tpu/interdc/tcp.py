@@ -32,8 +32,10 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from antidote_tpu import stats
 from antidote_tpu.interdc import termcodec
 from antidote_tpu.interdc.transport import LinkDown, Transport
 from antidote_tpu.interdc.wire import DcDescriptor
@@ -87,8 +89,11 @@ class TcpTransport(Transport):
         self._dc_id: Any = None
         self._inbox: "queue.Queue[bytes]" = queue.Queue()
         self._handler: Optional[Callable[[Any, str, Any], Any]] = None
-        #: live subscriber connections to OUR pub listener (Python mode)
-        self._subscribers: List[socket.socket] = []
+        #: live subscriber connections to OUR pub listener (Python
+        #: mode): (socket, peer label) — the label feeds the per-
+        #: subscriber send-duration gauge (ISSUE 7 satellite: the
+        #: serial fan-out loop's stalls must be observable per peer)
+        self._subscribers: List[Tuple[socket.socket, str]] = []
         #: target dc_id -> (addr, persistent request socket or None)
         self._peers: Dict[Any, Dict[str, Any]] = {}
         self._lock = threading.RLock()
@@ -204,7 +209,7 @@ class TcpTransport(Transport):
             # matching ZMQ's drop-on-slow PUB semantics
             conn.settimeout(self.connect_timeout)
             with self._lock:
-                self._subscribers.append(conn)
+                self._subscribers.append((conn, str(peer)))
 
     def publish(self, origin, data: bytes) -> None:
         with self._lock:
@@ -217,17 +222,27 @@ class TcpTransport(Transport):
                 return
             conns = list(self._subscribers)
         dead = []
-        for conn in conns:
+        for conn, label in conns:
+            # per-subscriber send timing (ISSUE 7 satellite): this loop
+            # is SERIAL, so one peer with a full TCP window delays every
+            # later peer's frame by its whole send (ROADMAP's latent
+            # many-peer publish stall) — the gauge makes the culprit
+            # visible before it bites
+            t0 = time.perf_counter()
             try:
                 _send_frame(conn, data)
             except OSError:
-                dead.append(conn)
+                dead.append((conn, label))
+            stats.registry.ship_subscriber_send.set(
+                time.perf_counter() - t0, peer=label)
         if dead:
             with self._lock:
-                for conn in dead:
-                    if conn in self._subscribers:
-                        self._subscribers.remove(conn)
-                    conn.close()
+                for entry in dead:
+                    if entry in self._subscribers:
+                        self._subscribers.remove(entry)
+                    entry[0].close()
+                    stats.registry.ship_subscriber_send.remove(
+                        peer=entry[1])
 
     # ----------------------------------------------------- subscribe side
 
@@ -376,7 +391,7 @@ class TcpTransport(Transport):
                 except OSError:
                     pass
         with self._lock:
-            for conn in self._subscribers:
+            for conn, _label in self._subscribers:
                 conn.close()
             self._subscribers.clear()
             for peer in self._peers.values():

@@ -174,8 +174,14 @@ def _enc(v: Any, out: List[bytes], depth: int, ctx: _EncCtx) -> None:
         _enc_seq((v.op_id, v.txid, v.payload), out, depth, ctx)
     elif type(v).__name__ == "InterDcTxn":
         out.append(_T_TXN)
-        _enc_seq((v.dc_id, v.partition, v.prev_log_opid, v.snapshot_vc,
-                  v.timestamp, tuple(v.records)), out, depth, ctx)
+        # trace_ctx (ISSUE 7) rides as a 7th element only when present,
+        # so pre-ISSUE-7 frames (and hand-built txns) keep the 6-arity
+        # form byte-for-byte; the decoder accepts both
+        fields = (v.dc_id, v.partition, v.prev_log_opid, v.snapshot_vc,
+                  v.timestamp, tuple(v.records))
+        if getattr(v, "trace_ctx", None) is not None:
+            fields = fields + (tuple(v.trace_ctx),)
+        _enc_seq(fields, out, depth, ctx)
     elif type(v).__name__ == "InterDcBatch":
         _enc_batch(v, out, depth, ctx)
     elif isinstance(v, tuple):
@@ -291,11 +297,21 @@ def _enc_batch(b, out: List[bytes], depth: int, ctx: _EncCtx) -> None:
     _enc(b.partition, out, depth + 1, ctx)
     _enc(txns[0].prev_log_opid, out, depth + 1, ctx)
     _enc(b.ping_ts, out, depth + 1, ctx)
+    # per-frame trace header (ISSUE 7): (sample permille, ship wall µs)
+    # or None — a small term, not a column (uniform across the frame)
+    hdr = getattr(b, "trace_hdr", None)
+    _enc(tuple(hdr) if hdr is not None else None, out, depth + 1, ctx)
     n = len(txns)
     out.append(_u32(n))
     # uniform per-txn columns (varint delta: near-monotone sequences)
     out.append(_varint_col([t.records[-1].op_id.n for t in txns]))
     out.append(_varint_col([t.timestamp for t in txns]))
+    # origin-commit wallclock column (ISSUE 7): near-monotone like the
+    # commit times, so a txn's entry is 1-3 bytes; 0 marks "absent"
+    # (hand-built txns without a trace context)
+    out.append(_varint_col(
+        [(t.trace_ctx[0] if getattr(t, "trace_ctx", None) else 0)
+         for t in txns]))
     out.append(_varint_col([len(t.records) - 1 for t in txns]))
     # commit-record arity/flag: 0/1 = 4-tuple certified flag, 2 = the
     # legacy 3-tuple payload (no flag) — preserved bit-for-bit
@@ -421,6 +437,21 @@ def batch_packable(txn) -> bool:
         and i64[0] <= txn.timestamp <= i64[1] \
         and commit.payload[1][1] == txn.timestamp \
         and commit.payload[2] == txn.snapshot_vc
+
+
+def _check_trace_pair(pair, permille_idx: int, what: str) -> None:
+    """Validate a decoded wire trace pair (ISSUE 7): two ints, wall
+    µs >= 0, sample permille in 0..1000.  The sender clamps permille
+    on encode (sender._trace_permille); without the matching decode
+    check a hostile frame carrying permille >= 1000 would make the
+    receiver force-adopt EVERY txn it carries into the span ring,
+    evicting legitimately sampled trees."""
+    if not (isinstance(pair, tuple) and len(pair) == 2
+            and all(isinstance(x, int) for x in pair)):
+        raise TermDecodeError(f"bad {what}")
+    if not 0 <= pair[permille_idx] <= 1000 \
+            or pair[1 - permille_idx] < 0:
+        raise TermDecodeError(f"{what} out of range")
 
 
 class _DecCtx:
@@ -556,12 +587,13 @@ def _dec(data: bytes, pos: int, depth: int,
                     or not isinstance(items[2], tuple):
                 raise TermDecodeError("bad LogRecord shape")
             return LogRecord(items[0], items[1], items[2]), pos
-        # _T_TXN
+        # _T_TXN (6-arity pre-ISSUE-7 form, or 7 with a trace_ctx)
         from antidote_tpu.interdc.wire import InterDcTxn
 
-        if n != 6:
+        if n not in (6, 7):
             raise TermDecodeError("bad InterDcTxn arity")
-        dc_id, partition, prev, svc, ts, records = items
+        dc_id, partition, prev, svc, ts, records = items[:6]
+        trace_ctx = items[6] if n == 7 else None
         if svc is not None and not isinstance(svc, VC):
             raise TermDecodeError("bad snapshot_vc")
         if not (isinstance(partition, int) and isinstance(prev, int)
@@ -570,9 +602,13 @@ def _dec(data: bytes, pos: int, depth: int,
         if not isinstance(records, (tuple, list)) or any(
                 not isinstance(r, LogRecord) for r in records):
             raise TermDecodeError("bad records")
+        if trace_ctx is not None:
+            _check_trace_pair(trace_ctx, permille_idx=1,
+                              what="InterDcTxn trace_ctx")
         return InterDcTxn(dc_id=dc_id, partition=partition,
                           prev_log_opid=prev, snapshot_vc=svc,
-                          timestamp=ts, records=list(records)), pos
+                          timestamp=ts, records=list(records),
+                          trace_ctx=trace_ctx), pos
     raise TermDecodeError(f"unknown term tag {tag!r}")
 
 
@@ -584,14 +620,34 @@ def _dec_batch(data: bytes, pos: int, depth: int,
     partition, pos = _dec(data, pos, depth + 1, ctx)
     first_prev, pos = _dec(data, pos, depth + 1, ctx)
     ping_ts, pos = _dec(data, pos, depth + 1, ctx)
+    # pre-ISSUE-7 layout detection (rolling-upgrade compat): the old
+    # frame goes straight from ping_ts to the u32 txn count, whose
+    # high byte is <= 3 (frames cap at 64 MiB); every term tag the new
+    # trace-header position can legally start with is printable ASCII.
+    # An unupgraded peer's batches must keep decoding — dropping them
+    # as malformed would force its whole stream through per-txn gap
+    # repair until both sides upgrade.
+    _need(data, pos, 1)
+    pre_issue7 = data[pos] <= 3
+    if pre_issue7:
+        trace_hdr = None
+    else:
+        trace_hdr, pos = _dec(data, pos, depth + 1, ctx)
     if not isinstance(partition, int) or not isinstance(first_prev, int) \
             or not (ping_ts is None or isinstance(ping_ts, int)):
         raise TermDecodeError("bad InterDcBatch header")
+    if trace_hdr is not None:
+        _check_trace_pair(trace_hdr, permille_idx=0,
+                          what="InterDcBatch trace header")
     n, pos = _dec_u32(data, pos)
     if n == 0 or n > len(data) - pos:
         raise TermDecodeError("bad batch txn count")
     commit_ops, pos = _dec_varint_col(data, pos, n)
     commit_ts, pos = _dec_varint_col(data, pos, n)
+    if pre_issue7:
+        commit_wall = [0] * n  # no wall column: trace_ctx stays None
+    else:
+        commit_wall, pos = _dec_varint_col(data, pos, n, lo=0)
     n_ups_col, pos = _dec_varint_col(data, pos, n, lo=0, hi=len(data))
     _need(data, pos, n)
     cert_col = data[pos:pos + n]
@@ -686,9 +742,16 @@ def _dec_batch(data: bytes, pos: int, depth: int,
                        bool(cert_col[i]))
         records.append(LogRecord(OpId(dc_id, commit_ops[i]), txids[i],
                                  payload))
+        # per-txn trace context rebuilt from the wall column + the
+        # frame header's sample permille (0 wall = absent)
+        tctx = None
+        if commit_wall[i]:
+            tctx = (commit_wall[i],
+                    trace_hdr[0] if trace_hdr is not None else 0)
         txns.append(InterDcTxn(dc_id=dc_id, partition=partition,
                                prev_log_opid=prev, snapshot_vc=svcs[i],
-                               timestamp=commit_ts[i], records=records))
+                               timestamp=commit_ts[i], records=records,
+                               trace_ctx=tctx))
         prev = commit_ops[i]
     return InterDcBatch(dc_id=dc_id, partition=partition, _txns=txns,
-                        ping_ts=ping_ts), pos
+                        ping_ts=ping_ts, trace_hdr=trace_hdr), pos

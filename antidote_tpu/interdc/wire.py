@@ -45,11 +45,24 @@ class InterDcTxn:
     timestamp: int
     #: update records + the trailing commit record; [] = heartbeat
     records: List[LogRecord] = field(default_factory=list)
+    #: trace-propagation context stamped by the origin's sender
+    #: (ISSUE 7): ``(origin commit wallclock µs, tracer sample rate in
+    #: permille)``.  The wallclock is what remote-side visibility-lag
+    #: histograms subtract from; the permille lets the receiver replay
+    #: the origin's deterministic sampling decision so a sampled txn's
+    #: span tree stitches across DCs even when local rates differ.
+    #: None on heartbeats, pre-ISSUE-7 frames, and hand-built txns.
+    trace_ctx: Optional[Tuple[int, int]] = None
 
     # ------------------------------------------------------------ queries
 
     def is_ping(self) -> bool:
         return not self.records
+
+    def origin_commit_wall_us(self) -> Optional[int]:
+        """Origin commit wallclock carried by the trace context, or
+        None when the frame predates ISSUE 7 / was hand-built."""
+        return self.trace_ctx[0] if self.trace_ctx else None
 
     def last_opid(self) -> int:
         """New stream watermark after this txn (the commit record's opid,
@@ -138,6 +151,12 @@ class InterDcBatch:
     _txns: List["InterDcTxn"]
     #: piggybacked heartbeat stamp (min-prepared time), or None
     ping_ts: Optional[int] = None
+    #: compact per-frame trace header (ISSUE 7): ``(tracer sample rate
+    #: in permille, ship wallclock µs at frame close)``.  The per-txn
+    #: origin-commit wallclocks ride their own varint column (the
+    #: txns' ``trace_ctx``); the frame-level header carries what is
+    #: uniform across the frame.  None on pre-ISSUE-7 frames.
+    trace_hdr: Optional[Tuple[int, int]] = None
 
     # ------------------------------------------------------------ queries
 
@@ -173,7 +192,9 @@ class InterDcBatch:
 
     @staticmethod
     def from_txns(txns: List["InterDcTxn"],
-                  ping_ts: Optional[int] = None) -> "InterDcBatch":
+                  ping_ts: Optional[int] = None,
+                  trace_hdr: Optional[Tuple[int, int]] = None
+                  ) -> "InterDcBatch":
         assert txns, "empty batch (pings ship standalone)"
         head = txns[0]
         for a, b in zip(txns, txns[1:]):
@@ -182,7 +203,8 @@ class InterDcBatch:
             assert (b.dc_id, b.partition) == (a.dc_id, a.partition), \
                 "batch txns must share one stream"
         return InterDcBatch(dc_id=head.dc_id, partition=head.partition,
-                            _txns=list(txns), ping_ts=ping_ts)
+                            _txns=list(txns), ping_ts=ping_ts,
+                            trace_hdr=trace_hdr)
 
     # -------------------------------------------------------------- bytes
 

@@ -64,6 +64,7 @@ import numpy as np
 from antidote_tpu import stats
 from antidote_tpu.mat import store
 from antidote_tpu.obs.prof import kernel_span
+from antidote_tpu.obs.spans import tracer
 
 #: flush trigger kinds (the ``kind`` label of
 #: antidote_ingest_flushes_total): ``rows`` = the device_flush_ops
@@ -222,8 +223,13 @@ def packed_append(st, packed: jax.Array,
 # metrics
 
 def note_flush(kind: str) -> None:
-    """Count one flush event by trigger kind."""
+    """Count one flush event by trigger kind.  The instant also lands
+    on the trace timeline (ISSUE 7): a sampled txn's journey shows the
+    packed flush that made its staged ops device-visible right after
+    its ``depgate_admit`` span — untagged, so partial sample rates
+    thin it instead of flooding the ring."""
     stats.registry.ingest_flushes.inc(kind=kind)
+    tracer.instant("ingest_flush", "device", kind=kind)
 
 
 def note_dispatch(ops: int, h2d_bytes: int) -> None:

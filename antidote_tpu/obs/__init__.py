@@ -8,18 +8,24 @@ halves natively for the TPU serving stack:
   every plane (coordinator → log → device plane → inter-DC →
   dep-gate), held in a bounded in-process ring, queryable in tests and
   exportable as Chrome ``trace_event`` JSON (loadable in Perfetto
-  alongside the JAX profiler captures ``antidote_tpu/tracing.py``
+  alongside the JAX profiler captures :mod:`antidote_tpu.obs.prof`
   produces).
 - :mod:`antidote_tpu.obs.events` — a per-subsystem flight recorder:
   bounded rings of structured events, dumped to disk automatically on
   txn aborts, error-monitor trips, and probe violations.
-- :mod:`antidote_tpu.obs.probe` — online self-checks (the set_aw
-  read-inclusion probe chasing the VERDICT round-5 transient miss).
+- :mod:`antidote_tpu.obs.probe` — online self-checks: the set_aw
+  read-inclusion probe (chasing the VERDICT round-5 transient miss)
+  and the ISSUE 7 causal-probe auditor (write→remote-read staleness +
+  causal-order tripwire).
 - :mod:`antidote_tpu.obs.prof` — the device-plane profiler (ISSUE 2):
   kernel spans over the jitted mat/ and interdc entry points,
   compile-cache-miss counters, device-buffer high-watermarks, and the
-  XProf capture API (absorbed from ``antidote_tpu/tracing.py``, which
-  remains a re-export shim).
+  XProf capture API (the old ``antidote_tpu.tracing`` shim was retired
+  to a one-release import error, ISSUE 7).
+- :mod:`antidote_tpu.obs.pipeline` — the pipeline snapshot (ISSUE 7):
+  every registered DC's ship buffers, SubBuf gap state, gate
+  backlogs, ingest staging, and stable watermarks as ONE JSON
+  document, served at ``/debug/pipeline``.
 
 Everything here is process-global, mirroring ``stats.registry`` (the
 reference's metrics are BEAM-node-global the same way): all DCs in a

@@ -1,0 +1,176 @@
+"""Pipeline snapshot — the whole replication pipeline as ONE object.
+
+PRs 1-6 instrumented each plane separately (spans, kernel profiler,
+GATE_*/INGEST_*/SHIP_* counters); what no single surface could answer
+is "where is the pipeline holding data RIGHT NOW?"  This module
+aggregates, in one JSON document per registered DataCenter:
+
+- **ship**: each outbound stream's staged-txn depth, estimated bytes,
+  oldest-staged age, and outbox length (the async sender's buffer —
+  antidote_tpu/interdc/sender.py);
+- **sub_bufs**: each inbound (origin, partition) stream's gap state,
+  buffered-txn count, and opid watermark (interdc/sub_buf.py);
+- **gates**: each partition's dependency-gate backlog, per-origin
+  queue depths, applied watermark vector, and device-ring occupancy
+  (interdc/dep.py);
+- **ingest**: each partition's materializer staging — rows coalescing
+  toward the next packed flush, per type plane, with the oldest-row
+  age (mat/device_plane.py staging for mat/ingest.py);
+- **stable**: the published stable snapshot and each partition's
+  safe-time vector (the quantity the VIS_* safe-time-lag gauges age).
+
+Served at ``GET /debug/pipeline`` by the metrics server (stats.py),
+embedded in causal-probe violation dumps (obs/probe.py), and attached
+to the causal checker's failure forensics (tests/causal_core.py).
+
+Registration is by weakref: every DataCenter registers itself at
+construction and unregisters at close, so a leaked test DC cannot pin
+itself alive through this module.  All reads are defensive — a racy
+or half-built DC yields a partial section, never an exception (a
+diagnostic read must not take the server down).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from typing import Any, Dict, List
+
+from antidote_tpu.obs.events import _jsonable
+
+_lock = threading.Lock()
+_endpoints: List["weakref.ref"] = []
+
+
+def register(dc) -> None:
+    """Track a DC assembly for pipeline snapshots (weakly)."""
+    with _lock:
+        _endpoints.append(weakref.ref(dc))
+
+
+def unregister(dc) -> None:
+    with _lock:
+        _endpoints[:] = [r for r in _endpoints
+                         if r() is not None and r() is not dc]
+
+
+def endpoints() -> list:
+    """Live registered DC assemblies (also the causal probe's peer
+    discovery, obs/probe.py)."""
+    with _lock:
+        out = []
+        for r in _endpoints:
+            dc = r()
+            if dc is not None:
+                out.append(dc)
+        return out
+
+
+def _section(fn):
+    """Run one snapshot section; a failure becomes an error marker
+    instead of killing the whole document."""
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — diagnostics must not throw
+        return {"error": repr(e)}
+
+
+def _ship_section(dc) -> Dict[str, Any]:
+    senders = getattr(dc, "senders", [])
+    if isinstance(senders, dict):  # federation: {partition: sender}
+        senders = senders.values()
+    out = {}
+    for sender in senders:
+        out[str(sender.partition)] = sender.queue_stats()
+    return out
+
+
+def _sub_buf_section(dc) -> Dict[str, Any]:
+    out = {}
+    for (origin, p), buf in dict(getattr(dc, "sub_bufs", {})).items():
+        out[f"{origin}:{p}"] = buf.gap_stats()
+    return out
+
+
+def _gate_section(dc) -> Dict[str, Any]:
+    gates = getattr(dc, "dep_gates", None)
+    if gates is None:  # federation: {partition: gate}
+        gates = getattr(dc, "gates", {})
+    items = gates.items() if isinstance(gates, dict) else enumerate(gates)
+    return {str(p): gate.queue_stats() for p, gate in items}
+
+
+def _ingest_section(dc) -> Dict[str, Any]:
+    now_us = time.monotonic_ns() // 1000
+    out: Dict[str, Any] = {}
+    node = getattr(dc, "node", None)
+    for p, pm in enumerate(getattr(node, "partitions", [])):
+        dev = getattr(pm, "device", None)
+        if dev is None:
+            continue
+        planes = {}
+        staged_total = 0
+        oldest_age_us = 0
+        for tn, plane in getattr(dev, "planes", {}).items():
+            rows = getattr(plane, "rows", None)
+            if not rows:
+                continue
+            n = len(rows)
+            staged_total += n
+            age = max(now_us - getattr(plane, "_stage_t0_us", now_us), 0)
+            oldest_age_us = max(oldest_age_us, age)
+            planes[tn] = {"staged_rows": n, "oldest_age_us": age}
+        out[str(p)] = {"staged_rows": staged_total,
+                       "oldest_age_us": oldest_age_us,
+                       "planes": planes}
+    return out
+
+
+def _stable_section(dc) -> Dict[str, Any]:
+    stable = getattr(dc, "stable", None)
+    if stable is None:
+        return {}
+    out: Dict[str, Any] = {
+        "snapshot": {str(k): v
+                     for k, v in dict(stable.get_stable_snapshot()).items()}
+    }
+    per_part = {}
+    for p, src in enumerate(getattr(stable, "sources", []) or []):
+        per_part[str(p)] = {str(k): v for k, v in dict(src()).items()}
+    out["per_partition"] = per_part
+    return out
+
+
+def dc_snapshot(dc) -> Dict[str, Any]:
+    """One DC's pipeline state, every section independently guarded."""
+    return {
+        "ship": _section(lambda: _ship_section(dc)),
+        "sub_bufs": _section(lambda: _sub_buf_section(dc)),
+        "gates": _section(lambda: _gate_section(dc)),
+        "ingest": _section(lambda: _ingest_section(dc)),
+        "stable": _section(lambda: _stable_section(dc)),
+        "connected_dcs": _section(
+            lambda: [str(d) for d in getattr(dc, "connected_dcs", [])]),
+    }
+
+
+def snapshot() -> Dict[str, Any]:
+    """The /debug/pipeline body: every registered DC's pipeline state
+    plus the wallclock it was taken at."""
+    dcs = {}
+    for dc in endpoints():
+        try:
+            name = str(dc.node.dc_id)
+            member = getattr(dc, "member_index", None)
+            if member is not None:  # federation: one entry per member
+                name = f"{name}[{member}]"
+        except Exception:  # noqa: BLE001 — half-closed DC
+            continue
+        dcs[name] = dc_snapshot(dc)
+    return {"at_us": time.time_ns() // 1000, "dcs": dcs}
+
+
+def snapshot_json() -> str:
+    return json.dumps(_jsonable(snapshot()))

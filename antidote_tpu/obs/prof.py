@@ -1,7 +1,7 @@
 """Device-plane profiler — the kernel-span layer over the jitted hot
-paths, plus the XProf capture API (absorbed from antidote_tpu/tracing.py
-so the process has ONE tracing namespace; tracing.py remains a
-re-export shim for existing imports).
+paths, plus the XProf capture API (absorbed from the old
+antidote_tpu.tracing module so the process has ONE tracing namespace;
+that shim is retired to a one-release import error, ISSUE 7).
 
 PR 1 made the *host* planes observable (txid spans, flight recorder,
 stage histograms); the fused XLA/Pallas programs in antidote_tpu/mat/
@@ -55,8 +55,7 @@ from typing import Any, Callable, Dict, Optional
 from antidote_tpu.obs.spans import tracer
 
 # ------------------------------------------------------------------ capture
-# (moved verbatim from antidote_tpu/tracing.py — one capture at a time,
-# mirroring jax.profiler's own constraint)
+# (one capture at a time, mirroring jax.profiler's own constraint)
 
 _capture_lock = threading.Lock()
 _active_dir: Optional[str] = None

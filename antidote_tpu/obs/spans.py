@@ -20,7 +20,7 @@ when the rate is 1.0.
 
 Export is Chrome ``trace_event`` JSON ("X" complete events), loadable
 in Perfetto / chrome://tracing next to the JAX profiler captures
-(antidote_tpu/tracing.py); ``ts`` is epoch microseconds so captures
+(antidote_tpu/obs/prof.py); ``ts`` is epoch microseconds so captures
 from several processes align on one timeline.
 """
 
@@ -78,6 +78,20 @@ class Span:
 
 _SPAN_IDS = itertools.count(1)
 _tls = threading.local()
+
+
+def txid_decision(txid, rate: float) -> bool:
+    """The deterministic per-txid sampling decision at ``rate`` —
+    crc32 of the txid repr, stable across processes.  Exposed as a
+    module function because the wire's trace header (ISSUE 7) carries
+    the ORIGIN's sample rate: a receiver replays the origin's decision
+    through this same function so a sampled txn's remote-side spans
+    record even when the local rate differs."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    return (zlib.crc32(repr(txid).encode()) % 10_000) < rate * 10_000
 
 
 class _NullSpan:
@@ -202,12 +216,49 @@ class Tracer:
         cache = self._decision_cache
         hit = cache.get(txid)
         if hit is None:
-            hit = (zlib.crc32(repr(txid).encode()) % 10_000) \
-                < rate * 10_000
+            hit = txid_decision(txid, rate)
             if len(cache) >= 8192:  # txids are transient; drop en masse
                 cache.clear()
             cache[txid] = hit
         return hit
+
+    def adopt(self, txid, decision: bool) -> None:
+        """Seed the decision cache with the ORIGIN DC's sampling
+        decision for a replicated txn (computed from the wire trace
+        header's carried sample rate, ISSUE 7) so the remote halves of
+        a sampled txn's tree record even when the local rate differs.
+        Only consulted at partial local rates: rate 0 stays fully off
+        (the operator turned tracing off) and rate 1 already records
+        everything — both short-circuit before the cache."""
+        cache = self._decision_cache
+        if len(cache) >= 8192:
+            cache.clear()
+        cache[txid] = bool(decision)
+
+    def adopt_from_wire(self, hdr, txns) -> None:
+        """Replay the ORIGIN's deterministic sampling decisions from a
+        wire trace header ``(sample permille, ship wall µs)`` over a
+        frame's txns — the ONE receive-side adoption rule
+        (interdc/dc.py and cluster/federation.py both route here).
+
+        Skip rules: no header means no origin decision to replay; a
+        permille of 0 means the origin wasn't tracing, so there is no
+        origin decision either — seeding False would silently override
+        THIS DC's own partial-rate sampling for that origin's whole
+        stream.  And only partial local rates consult the cache at
+        all (0 stays off, 1 records everything), so the crc32 loop is
+        skipped outside that regime.  The permille is clamped to 1000:
+        the decode layer rejects out-of-range values from the wire,
+        but in-process senders are not the only callers."""
+        if hdr is None or hdr[0] <= 0 \
+                or not 0.0 < self.sample_rate < 1.0:
+            return
+        rate = min(hdr[0], 1000) / 1000.0
+        for txn in txns:
+            txid = (getattr(txn.records[-1], "txid", None)
+                    if txn.records else None)
+            if txid is not None:
+                self.adopt(txid, txid_decision(txid, rate))
 
     # ------------------------------------------------------------ recording
 

@@ -165,6 +165,72 @@ class Histogram:
         yield f"{self.name}_count {cum}"
 
 
+class LabeledHistogram:
+    """Histogram with label dimensions (the per-peer visibility-lag
+    family: one child histogram per (dc, peer), like client_golang's
+    HistogramVec).  Children share one bucket ladder; exposition emits
+    the standard _bucket/_sum/_count triple per child."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, buckets: Tuple[float, ...],
+                 labels: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self.label_names = labels
+        self._children: Dict[Tuple, list] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict) -> Tuple:
+        return tuple(labels.get(n, "") for n in self.label_names)
+
+    def observe(self, v: float, **labels) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        key = self._key(labels)
+        with self._lock:
+            counts = self._children.get(key)
+            if counts is None:
+                counts = self._children[key] = \
+                    [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+            counts[i] += 1
+            self._sums[key] += v
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return sum(self._children.get(self._key(labels), ()))
+
+    def counts(self, **labels) -> list:
+        """Per-bucket raw counts (+Inf tail last) — the monotonicity
+        checks in tests read these directly."""
+        with self._lock:
+            return list(self._children.get(
+                self._key(labels), [0] * (len(self.buckets) + 1)))
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            items = [(k, list(c), self._sums[k])
+                     for k, c in self._children.items()]
+        for key, counts, total in items:
+            pairs = [(n, v) for n, v in zip(self.label_names, key)]
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                lbl = _fmt_labels(
+                    self.label_names + ("le",), key + (_fmt(b),))
+                yield f"{self.name}_bucket{lbl} {cum}"
+            cum += counts[-1]
+            lbl = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
+            yield f"{self.name}_bucket{lbl} {cum}"
+            plain = _fmt_labels(self.label_names, key)
+            yield f"{self.name}_sum{plain} {_fmt(total)}"
+            yield f"{self.name}_count{plain} {cum}"
+
+
 def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
@@ -366,6 +432,45 @@ class Registry:
             "antidote_ship_wire_bytes_per_txn",
             "Encoded wire bytes per shipped txn over the process "
             "lifetime (txn-carrying frames only)")
+        self.ship_subscriber_send = LabeledGauge(
+            "antidote_ship_subscriber_send_seconds",
+            "Duration of the most recent pub-frame send to each TCP "
+            "subscriber (Python fan-out mode).  The per-subscriber "
+            "loop is serial, so one slow peer delays every later one "
+            "— a climbing series here is the publish-stall ROADMAP "
+            "flags before it bites a many-peer mesh",
+            labels=("peer",))
+        # ---- transaction-journey / visibility plane (ISSUE 7):
+        # commit-at-origin -> causally-visible-at-remote is the
+        # quantity Cure/GentleRain optimize; these families make it a
+        # first-class SLO.  The lag histogram is observed at ingest-
+        # visibility time (dependency-gate apply) from the origin
+        # commit wallclock the wire now carries; buckets span 1 ms (in-
+        # process delivery) to 60 s (a partitioned peer catching up).
+        vis_buckets = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                       1.0, 5.0, 15.0, 60.0)
+        self.vis_lag = LabeledHistogram(
+            "antidote_vis_visibility_lag_seconds",
+            "Origin-commit wallclock to local ingest-visibility "
+            "(dependency-gate apply) per replicated txn, as observed "
+            "by each local DC (dc) per origin peer (peer)",
+            buckets=vis_buckets, labels=("dc", "peer"))
+        self.vis_safe_time_lag = LabeledGauge(
+            "antidote_vis_safe_time_lag_seconds",
+            "Local-clock age of each partition's safe/stable time "
+            "(the min entry of its dep-gate watermark + min-prepared "
+            "vector) — the GST lag a causal read may wait on",
+            labels=("dc", "partition"))
+        self.vis_probe_staleness = Histogram(
+            "antidote_vis_probe_staleness_seconds",
+            "Observed write->remote-causal-read round-trip staleness "
+            "of the causal-probe auditor (antidote_tpu/obs/probe.py)",
+            buckets=vis_buckets)
+        self.vis_probe_violations = Counter(
+            "antidote_vis_probe_violations_total",
+            "Causal-order violations the probe auditor observed (a "
+            "causal read at the probe write's commit clock missed the "
+            "element); each one dumps the flight recorder")
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -385,7 +490,10 @@ class Registry:
                 self.ingest_ops_per_dispatch,
                 self.ship_frames, self.ship_txns, self.ship_bytes,
                 self.ship_piggybacked_pings, self.ship_queue_depth,
-                self.ship_txns_per_frame, self.ship_bytes_per_txn)
+                self.ship_txns_per_frame, self.ship_bytes_per_txn,
+                self.ship_subscriber_send,
+                self.vis_lag, self.vis_safe_time_lag,
+                self.vis_probe_staleness, self.vis_probe_violations)
 
     def exposition(self) -> str:
         lines = []
@@ -521,7 +629,7 @@ class StalenessSampler:
 
     def __init__(self, stable_vc_source, now_us, reg: Optional[Registry] = None,
                  period_s: float = 10.0, peers_source=None,
-                 local_dc: str = ""):
+                 local_dc: str = "", safe_time_sources=None):
         self.stable_vc_source = stable_vc_source
         self.now_us = now_us
         self.registry = reg or registry
@@ -531,6 +639,11 @@ class StalenessSampler:
         #: the observing DC's id — the gauge's ``dc`` label, so several
         #: DCs in one process don't clobber each other's peer series
         self.local_dc = str(local_dc)
+        #: () -> iterable of (partition, vc): each partition's safe-
+        #: time vector (dep-gate watermarks + min-prepared) — feeds the
+        #: per-partition safe-time-lag gauge (ISSUE 7) on the same
+        #: cadence as the staleness histogram
+        self.safe_time_sources = safe_time_sources
         self._lag_peers: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -555,6 +668,11 @@ class StalenessSampler:
             self.registry.replication_lag.remove(dc=self.local_dc,
                                                  peer=str(gone))
         self._lag_peers = peers
+        if self.safe_time_sources is not None:
+            for p, vc in self.safe_time_sources():
+                self.registry.vis_safe_time_lag.set(
+                    sample_staleness_ms(vc, now_us) / 1e3,
+                    dc=self.local_dc, partition=str(p))
         return staleness_ms
 
     def start(self) -> None:
@@ -610,6 +728,11 @@ class MetricsServer:
                     from antidote_tpu.obs.prof import profiler
 
                     body = _json.dumps(profiler.snapshot()).encode()
+                    ctype = "application/json"
+                elif path == "/debug/pipeline":
+                    from antidote_tpu.obs import pipeline
+
+                    body = pipeline.snapshot_json().encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -1,25 +1,14 @@
-"""Re-export shim — the profile-capture API lives in
-:mod:`antidote_tpu.obs.prof` now (ISSUE 2: one tracing namespace, not
-two).  The capture functions, the kernel-span layer, and the txid span
-tree all share the obs/ package; this module survives only so existing
-imports (``from antidote_tpu import tracing``) keep working.
-
-    with tracing.profile("/tmp/trace"):        # capture a window
-        ... run traffic ...
-
-    db.start_profiling("/tmp/trace")           # or explicit start/stop
-    db.stop_profiling()
-
-Annotations are no-ops outside an active capture (TraceAnnotation is
-cheap), so they stay on permanently in the hot paths.
+"""RETIRED — the profile-capture API lives in
+:mod:`antidote_tpu.obs.prof` (ISSUE 2 absorbed it; ISSUE 7 retires
+this shim after the PR-2 call-site migration).  This module survives
+one release as an import error so stale imports fail with a pointer
+instead of an AttributeError three frames later; it will be deleted
+next release.
 """
 
-from __future__ import annotations
-
-from antidote_tpu.obs.prof import (  # noqa: F401
-    active_dir,
-    annotate,
-    profile,
-    start,
-    stop,
-)
+raise ImportError(
+    "antidote_tpu.tracing was retired — use antidote_tpu.obs.prof: "
+    "prof.profile(dir)/prof.start(dir)/prof.stop() for XProf captures, "
+    "prof.annotate(name) for timeline annotations, and "
+    "db.start_profiling/stop_profiling on the API facade. "
+    "(This one-release import-error shim is deleted next release.)")

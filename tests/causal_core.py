@@ -19,6 +19,30 @@ N_WRITES = 24  # per writer
 N_READS = 30   # per reader session
 
 
+def forensics(reason: str, detail) -> str:
+    """Dump the flight recorder + the pipeline snapshot on a checker
+    failure (ISSUE 7 deflake satellite): the ~1/10 heavy-concurrency
+    flake (the round-5 device-fold KNOWN ISSUE's signature) was
+    undiagnosable post-hoc because by the time a human looked, the
+    window was gone.  Now every failure leaves
+    ``flightrec_causal_checker_*.json`` — recorder rings, recent
+    spans, the full pipeline state (ship buffers, SubBuf gaps, gate
+    backlogs, ingest staging, stable watermarks), and the failing
+    read's own detail — so the NEXT occurrence is evidence, not an
+    anecdote.  Returns a note naming the dump path for the assertion
+    message."""
+    try:
+        from antidote_tpu.obs import pipeline
+        from antidote_tpu.obs.events import recorder
+
+        path = recorder.dump(
+            reason, force=True,
+            extra={"detail": detail, "pipeline": pipeline.snapshot()})
+        return f" [forensics: {path}]" if path else ""
+    except Exception:  # noqa: BLE001 — forensics must not mask the
+        return ""      # assertion that triggered it
+
+
 def key_of(i):
     return (f"ck{i % N_KEYS}", "set_aw", "b")
 
@@ -142,6 +166,16 @@ def run_trace(writer_eps, reader_eps, tags=None,
                             cvcs = {e: dict(ct.items())
                                     for (e, _k), ct in writes.items()
                                     if e in missing}
+                        detail = {
+                            "rule": "session_monotonicity",
+                            "key": repr(o),
+                            "missing": sorted(repr(e) for e in missing),
+                            "missing_commit_vcs": {
+                                repr(e): v for e, v in cvcs.items()},
+                            "session_clock": (dict(clock.items())
+                                              if clock else None),
+                        }
+                        note = forensics("causal_checker", detail)
                         raise AssertionError(
                             f"session visibility shrank for {o}: "
                             f"{missing} disappeared; their commit VCs "
@@ -151,7 +185,8 @@ def run_trace(writer_eps, reader_eps, tags=None,
                             f"this is the round-5 KNOWN ISSUE: a device "
                             f"fold transiently losing an old op during "
                             f"concurrent same-key publish+flush "
-                            f"(CHANGES_r05.md), not a new regression")
+                            f"(CHANGES_r05.md), not a new regression"
+                            f"{note}")
                 prev = snap
                 clock = vc
         except Exception as e:
@@ -173,7 +208,11 @@ def validate(writes, reads, causal_floor=True):
     """Post-hoc rules.  ``causal_floor`` is the Clock-SI promise
     (wait_for_clock dominates the whole client clock); GentleRain
     waits only on the scalar GST, so its floor is not entry-wise —
-    downward closure and session monotonicity still apply."""
+    downward closure and session monotonicity still apply.
+
+    A violation dumps the flight recorder + pipeline snapshot
+    (``forensics``) before raising, so the ~1/10 flake leaves a
+    diagnosable record."""
     for clock, _vc, snap in reads:
         for key_i in range(N_KEYS):
             key = key_of(key_i)
@@ -183,11 +222,16 @@ def validate(writes, reads, causal_floor=True):
             # 1. causal floor: clock-dominated writes must be visible
             if causal_floor and clock is not None:
                 for e, wvc in owners.items():
-                    if wvc.le(clock):
-                        assert e in visible, (
+                    if wvc.le(clock) and e not in visible:
+                        note = forensics("causal_checker", {
+                            "rule": "causal_floor", "key": repr(key),
+                            "element": repr(e),
+                            "commit_vc": dict(wvc.items()),
+                            "read_clock": dict(clock.items())})
+                        raise AssertionError(
                             f"causal floor violated: write {e} with "
                             f"commit {dict(wvc.items())} <= read clock "
-                            f"{dict(clock.items())} is missing")
+                            f"{dict(clock.items())} is missing{note}")
             # 2. downward closure: visibility is a VC-order down-set
             # (a reader can glimpse an element a writer thread has not
             # recorded yet — its commit VC is unknown; skip those)
@@ -197,6 +241,12 @@ def validate(writes, reads, causal_floor=True):
                     continue
                 for e1, v1 in owners.items():
                     if e1 not in visible and v1.le(v2):
+                        note = forensics("causal_checker", {
+                            "rule": "downward_closure",
+                            "key": repr(key), "visible": repr(e2),
+                            "missing_earlier": repr(e1),
+                            "visible_vc": dict(v2.items()),
+                            "missing_vc": dict(v1.items())})
                         raise AssertionError(
                             f"snapshot not downward closed: {e2} "
-                            f"visible but earlier {e1} missing")
+                            f"visible but earlier {e1} missing{note}")

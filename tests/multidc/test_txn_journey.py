@@ -1,0 +1,370 @@
+"""Transaction-journey plane (ISSUE 7 acceptance): a sampled txn's
+span tree stitches across a 2-DC federation (origin + remote halves
+share the txid correlator), the VIS_* visibility-latency families
+populate from the carried origin-commit wallclock, /debug/pipeline
+serves the one-object pipeline snapshot, tools/txn_journey.py
+reconstructs the commit→visible chain from a recorded trace, and the
+causal-probe auditor measures real write→remote-read staleness (and
+alarms on a causal-order violation)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.config import Config
+from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+from antidote_tpu.interdc.transport import InProcBus
+from antidote_tpu.obs import pipeline, probe
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import tracer
+
+KEY = ("jk", "set_aw", "bkt")
+
+#: the journey's remote half — every name must appear for a sampled
+#: txn that replicated (the tentpole's stitched-tree contract)
+REMOTE_STAGES = {"interdc_rx", "subbuf_admit", "interdc_deliver",
+                 "depgate_admit", "interdc_visible"}
+ORIGIN_STAGES = {"txn_start", "txn_commit", "interdc_ship_stage"}
+
+
+@pytest.fixture
+def journey2(tmp_path):
+    """Two connected DCs, tracing at 1.0, fast samplers, probe armed."""
+    saved = (tracer.sample_rate, recorder.dump_dir)
+    tracer.clear()
+    recorder.clear()
+    bus = InProcBus()
+    dcs = []
+    for i in range(2):
+        cfg = Config(n_partitions=2, heartbeat_s=0.02,
+                     clock_wait_timeout_s=10.0,
+                     trace_sample_rate=1.0,
+                     staleness_sample_s=0.05,
+                     obs_causal_probe_s=0.05,
+                     flight_recorder_dir=str(tmp_path / "flightrec"))
+        dcs.append(DataCenter(f"dc{i + 1}", bus, config=cfg,
+                              data_dir=str(tmp_path / f"dc{i + 1}")))
+    connect_dcs(dcs)
+    for dc in dcs:
+        dc.start_bg_processes()
+    yield dcs
+    for dc in dcs:
+        dc.close()
+    (tracer.sample_rate, recorder.dump_dir) = saved
+    tracer.clear()
+    recorder.clear()
+
+
+def _await(predicate, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _commit_and_replicate(dc1, dc2, elem="alpha"):
+    tx = dc1.start_transaction()
+    dc1.update_objects([(KEY, "add", elem)], tx)
+    ct = dc1.commit_transaction(tx)
+    vals, _ = dc2.read_objects_static(ct, [KEY])
+    assert elem in vals[0]
+    _await(lambda: tracer.spans(txid=tx.txid, name="interdc_visible"),
+           what="remote visible instant")
+    return tx.txid, ct
+
+
+class TestStitchedSpanTree:
+    def test_origin_and_remote_halves_share_the_txid(self, journey2):
+        dc1, dc2 = journey2
+        txid, _ct = _commit_and_replicate(dc1, dc2)
+        names = {s.name for s in tracer.spans(txid=txid)}
+        assert ORIGIN_STAGES <= names, names
+        assert REMOTE_STAGES <= names, names
+        # one trace id across both halves: every span carries it
+        assert all(s.txid == txid for s in tracer.spans(txid=txid))
+        # and the chain is ordered: commit (origin) precedes the wire
+        # rx, which precedes the visible instant (remote)
+        t = {n: min(s.start_us for s in tracer.spans(txid=txid, name=n))
+             for n in ("txn_commit", "interdc_rx", "interdc_visible")}
+        assert t["txn_commit"] <= t["interdc_rx"] <= t["interdc_visible"]
+
+    def test_visible_instant_carries_the_measured_lag(self, journey2):
+        dc1, dc2 = journey2
+        txid, _ct = _commit_and_replicate(dc1, dc2, elem="beta")
+        vis = tracer.spans(txid=txid, name="interdc_visible")
+        assert vis and vis[0].args["origin"] == "dc1"
+        assert 0.0 <= vis[0].args["vis_lag_s"] < 15.0
+
+    def test_origin_sampling_decision_propagates(self, journey2):
+        """A receiver at a LOW local rate still records the remote half
+        of a txn the origin sampled: the frame trace header carries the
+        origin's rate, and the receiver replays its deterministic
+        decision (tracer.adopt)."""
+        dc1, dc2 = journey2
+        from antidote_tpu.obs.spans import txid_decision
+
+        # origin keeps rate 1.0 (the fixture); drop the receiver-side
+        # DECISION regime to partial by flipping the global rate right
+        # before delivery would decide.  The tracer is process-global,
+        # so emulate the cross-process case through adopt() directly:
+        txid = ("adopted", "txn")
+        assert not txid_decision(txid, 0.004)  # unsampled at 0.4%
+        tracer.sample_rate = 0.004
+        assert tracer.sampled(txid) is False
+        tracer.adopt(txid, True)  # the origin's carried decision
+        assert tracer.sampled(txid) is True
+        tracer.instant("remote_half", "interdc", txid=txid)
+        assert tracer.spans(txid=txid, name="remote_half")
+        tracer.sample_rate = 1.0
+
+    def test_non_tracing_origin_never_pins_local_sampling(self):
+        """A permille-0 trace header means the origin was NOT tracing
+        — there is no origin decision to replay, and seeding False
+        would silently disable this DC's own partial-rate sampling
+        for the whole stream (review finding)."""
+
+        class FakeTxn:
+            def __init__(self, txid):
+                self.records = [type("R", (), {"txid": txid})()]
+
+        saved = tracer.sample_rate
+        try:
+            # a txid the local 60% rate DOES sample
+            from antidote_tpu.obs.spans import txid_decision
+
+            txid = next(("t", i) for i in range(1000)
+                        if txid_decision(("t", i), 0.6))
+            tracer.sample_rate = 0.6
+            tracer.adopt_from_wire((0, 123), [FakeTxn(txid)])
+            assert tracer.sampled(txid) is True, \
+                "permille-0 header must not override local sampling"
+            # a real origin decision (permille 1000) DOES seed
+            unsampled = next(("u", i) for i in range(1000)
+                             if not txid_decision(("u", i), 0.6))
+            tracer.adopt_from_wire((1000, 123), [FakeTxn(unsampled)])
+            assert tracer.sampled(unsampled) is True
+        finally:
+            tracer.sample_rate = saved
+
+
+class TestVisibilityMetrics:
+    def test_visibility_lag_histogram_populates_per_peer(self, journey2):
+        dc1, dc2 = journey2
+        for i in range(3):
+            _commit_and_replicate(dc1, dc2, elem=f"v{i}")
+        h = stats.registry.vis_lag
+        assert h.count(dc="dc2", peer="dc1") >= 3
+        # cumulative bucket monotonicity (the panel contract):
+        # per-bucket raw counts are non-negative, so the running sum
+        # never decreases and ends at the count
+        counts = h.counts(dc="dc2", peer="dc1")
+        assert all(c >= 0 for c in counts)
+        cum = 0
+        for c in counts:
+            cum += c
+        assert cum == h.count(dc="dc2", peer="dc1")
+        text = stats.registry.exposition()
+        assert ('antidote_vis_visibility_lag_seconds_bucket'
+                '{dc="dc2",peer="dc1",le="+Inf"}') in text
+
+    def test_safe_time_lag_gauge_per_partition(self, journey2):
+        dc1, _dc2 = journey2
+        _await(lambda: stats.registry.vis_safe_time_lag.value(
+            dc="dc1", partition="0") is not None,
+            what="safe-time-lag sample")
+        for p in ("0", "1"):
+            lag = stats.registry.vis_safe_time_lag.value(
+                dc="dc1", partition=p)
+            assert lag is not None and lag >= 0.0
+
+    def test_histogram_is_monotone_under_load(self, journey2):
+        """Observing more txns never decreases any cumulative bucket
+        (VIS_* monotonicity — the satellite's explicit check)."""
+        dc1, dc2 = journey2
+
+        def cumulative():
+            counts = stats.registry.vis_lag.counts(dc="dc2", peer="dc1")
+            out, cum = [], 0
+            for c in counts:
+                cum += c
+                out.append(cum)
+            return out
+
+        _commit_and_replicate(dc1, dc2, elem="m0")
+        before = cumulative()
+        _commit_and_replicate(dc1, dc2, elem="m1")
+        after = cumulative()
+        assert all(b >= a for a, b in zip(before, after))
+        assert after[-1] > before[-1]
+
+
+class TestPipelineSnapshot:
+    SECTIONS = {"ship", "sub_bufs", "gates", "ingest", "stable",
+                "connected_dcs"}
+
+    def test_snapshot_schema(self, journey2):
+        dc1, dc2 = journey2
+        _commit_and_replicate(dc1, dc2, elem="p0")
+        snap = pipeline.snapshot()
+        assert set(snap) == {"at_us", "dcs"}
+        assert {"dc1", "dc2"} <= set(snap["dcs"])
+        for name in ("dc1", "dc2"):
+            d = snap["dcs"][name]
+            assert set(d) == self.SECTIONS, d.keys()
+            for p in ("0", "1"):
+                ship = d["ship"][p]
+                assert {"staged_txns", "staged_bytes", "oldest_age_us",
+                        "outbox_frames", "draining",
+                        "last_sent_opid"} <= set(ship)
+                gate = d["gates"][p]
+                assert {"pending", "queues", "applied_vc",
+                        "ring"} <= set(gate)
+            for stream in d["sub_bufs"].values():
+                assert {"state", "buffered_txns",
+                        "last_opid"} <= set(stream)
+            assert "snapshot" in d["stable"]
+            assert set(d["stable"]["per_partition"]) == {"0", "1"}
+        # the origin actually shipped: its stream watermark moved
+        assert any(s["last_sent_opid"] > 0
+                   for s in snap["dcs"]["dc1"]["ship"].values())
+
+    def test_debug_pipeline_endpoint(self, journey2):
+        dc1, dc2 = journey2
+        _commit_and_replicate(dc1, dc2, elem="p1")
+        srv = stats.MetricsServer(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/pipeline",
+                    timeout=10) as r:
+                doc = json.load(r)
+            assert {"dc1", "dc2"} <= set(doc["dcs"])
+            assert set(doc["dcs"]["dc1"]) == self.SECTIONS
+        finally:
+            srv.stop()
+
+
+class TestTxnJourneyCli:
+    def test_cli_prints_full_chain_with_latencies(self, journey2,
+                                                  tmp_path, capsys):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "..",
+            "tools"))
+        import txn_journey
+
+        dc1, dc2 = journey2
+        txid, _ct = _commit_and_replicate(dc1, dc2, elem="cli")
+        path = tracer.save(str(tmp_path / "spans.json"))
+        rc = txn_journey.main([json.dumps(list(txid)), "--file", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for stage in ("txn_commit", "interdc_ship_stage", "interdc_rx",
+                      "subbuf_admit", "depgate_admit",
+                      "interdc_visible"):
+            assert stage in out, out
+        assert "commit -> visible:" in out
+        assert "ms" in out  # per-stage latencies are printed
+
+        # --json emits machine-readable rows with the same chain
+        rc = txn_journey.main([json.dumps(list(txid)), "--file", path,
+                               "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["commit_to_visible_us"] > 0
+        stages = [r["stage"] for r in doc["stages"]]
+        assert stages.index("txn_commit") \
+            < stages.index("interdc_visible")
+
+        # --list surfaces the txid for operators who only have a dump
+        rc = txn_journey.main(["--list", "--file", path])
+        assert rc == 0
+        assert json.dumps(list(txid)) in capsys.readouterr().out
+
+
+class TestGapForensics:
+    def test_gap_and_repair_recorded_even_unsampled(self):
+        """Gap/repair events are rare diagnostics: they must reach the
+        flight recorder UNCONDITIONALLY, not ride the span sampler
+        (at the default 0.05 rate an untagged instant is thinned
+        ~19/20 — review finding)."""
+        from antidote_tpu.interdc.sub_buf import SubBuf
+        from antidote_tpu.interdc.wire import InterDcTxn
+        from antidote_tpu.oplog.records import LogRecord, OpId
+
+        saved = tracer.sample_rate
+        recorder.clear()
+        tracer.sample_rate = 0.0  # spans fully off
+        try:
+            def txn(prev, op, ts):
+                recs = [LogRecord(OpId("o", op), ("t", op),
+                                  ("commit", ("o", ts), None))]
+                return InterDcTxn(dc_id="o", partition=0,
+                                  prev_log_opid=prev, snapshot_vc=None,
+                                  timestamp=ts, records=recs)
+
+            delivered = []
+            buf = SubBuf("o", 0, deliver=delivered.append,
+                         fetch_range=lambda *a: [txn(0, 1, 10)])
+            buf.process(txn(1, 2, 20))  # gap: expected prev 0, got 1
+            assert len(delivered) == 2  # repair filled the hole
+            gaps = recorder.events("interdc", "subbuf_gap")
+            assert gaps and gaps[0][2]["expected"] == 0 \
+                and gaps[0][2]["got"] == 1
+            repairs = recorder.events("interdc", "subbuf_repair")
+            assert repairs and repairs[0][2]["fetched"] == 1 \
+                and repairs[0][2]["reachable"] is True
+        finally:
+            tracer.sample_rate = saved
+            recorder.clear()
+
+
+class TestCausalProbe:
+    def test_probe_measures_staleness_cleanly(self, journey2):
+        before = stats.registry.vis_probe_violations.value()
+        _await(lambda: recorder.events("probe", "causal_probe"),
+               what="a causal probe round")
+        assert stats.registry.vis_probe_staleness.count >= 1
+        assert stats.registry.vis_probe_violations.value() == before
+        ev = recorder.events("probe", "causal_probe")[-1][2]
+        assert ev["staleness_s"] >= 0.0
+        assert {ev["dc"], ev["peer"]} == {"dc1", "dc2"}
+
+    def test_probe_violation_alarms_and_dumps(self, journey2,
+                                              tmp_path):
+        """A reader that drops the probe element trips the violation
+        path: counter bump + forced flight-recorder dump embedding the
+        pipeline snapshot."""
+        dc1, _dc2 = journey2
+
+        class LyingReader:
+            """Peer facade whose causal read omits the element."""
+
+            def __init__(self, real):
+                self.node = real.node
+                self._real = real
+
+            def read_objects_static(self, clock, objs):
+                vals, vc = self._real.read_objects_static(clock, objs)
+                return [set()], vc
+
+        p = probe.CausalProbe(dc1, period_s=60.0)
+        real_peer = p._peers()[0]
+        lying = LyingReader(real_peer)
+        p._peers = lambda: [lying]
+        before = stats.registry.vis_probe_violations.value()
+        n_dumps = len(recorder.dumps)
+        assert p.run_once() == 1
+        assert stats.registry.vis_probe_violations.value() == before + 1
+        new = recorder.dumps[n_dumps:]
+        assert any("causal_probe" in d for d in new), new
+        body = json.load(open([d for d in new
+                               if "causal_probe" in d][-1]))
+        assert body["extra"]["writer_dc"] == "dc1"
+        assert "pipeline" in body["extra"]

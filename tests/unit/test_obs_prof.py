@@ -1,7 +1,9 @@
 """Device-plane profiler (ISSUE 2, antidote_tpu/obs/prof.py): the
 kernel-span layer's no-device/no-op discipline, compile-cache-miss
 attribution, txn-tree kernel child-spans, the /debug/prof endpoint,
-the /healthz ring-occupancy fields, and the tracing.py shim."""
+and the /healthz ring-occupancy fields.  (The tracing.py shim was
+retired to a one-release import error in ISSUE 7 —
+tests/unit/test_tracing.py pins that.)"""
 
 import json
 import time
@@ -12,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from antidote_tpu import stats, tracing
+from antidote_tpu import stats
 from antidote_tpu.obs import prof
 from antidote_tpu.obs.events import FlightRecorder, recorder
 from antidote_tpu.obs.prof import kernel_span, profiler
@@ -36,19 +38,6 @@ def _isolate_obs_globals(tmp_path):
     tracer.clear()
     recorder.clear()
     profiler.reset()
-
-
-# ------------------------------------------------------------------- shim
-
-
-def test_tracing_module_is_a_shim_over_obs_prof():
-    # one tracing namespace: the shim re-exports prof's capture API,
-    # so the two modules share the same capture state
-    assert tracing.annotate is prof.annotate
-    assert tracing.profile is prof.profile
-    assert tracing.start is prof.start
-    assert tracing.stop is prof.stop
-    assert tracing.active_dir is prof.active_dir
 
 
 # --------------------------------------------------------- no-op discipline
@@ -241,7 +230,6 @@ def test_capture_window_annotates_wrapped_kernels(tmp_path):
 
     with prof.profile(str(tmp_path)):
         assert prof.active_dir() == str(tmp_path)
-        assert tracing.active_dir() == str(tmp_path)  # shim shares it
         np.asarray(k(jnp.arange(128.0)))
     assert prof.active_dir() is None
     snap = profiler.snapshot()["kernels"]["cap_probe"]

@@ -120,6 +120,28 @@ def test_histogram_buckets_match_reference():
     assert "antidote_staleness_count 2" in text
 
 
+def test_labeled_histogram_exposition_and_counts():
+    """LabeledHistogram (ISSUE 7): per-child bucket/sum/count triples
+    with correct cumulative buckets and escaped labels — the
+    visibility-lag family's exposition contract."""
+    from antidote_tpu import stats
+
+    h = stats.LabeledHistogram("x_seconds", "help", buckets=(0.1, 1.0),
+                               labels=("dc", "peer"))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v, dc="a", peer="b")
+    h.observe(0.05, dc="a", peer='q"uote')
+    lines = list(h.expose())
+    assert 'x_seconds_bucket{dc="a",peer="b",le="0.1"} 1' in lines
+    assert 'x_seconds_bucket{dc="a",peer="b",le="1"} 2' in lines
+    assert 'x_seconds_bucket{dc="a",peer="b",le="+Inf"} 3' in lines
+    assert 'x_seconds_count{dc="a",peer="b"} 3' in lines
+    assert any('peer="q\\"uote"' in ln for ln in lines)
+    assert h.count(dc="a", peer="b") == 3
+    assert h.counts(dc="a", peer="b") == [1, 1, 1]
+    assert h.count(dc="never", peer="seen") == 0
+
+
 def test_http_exposition():
     reg = stats.Registry()
     reg.operations.inc(3, type="read")
@@ -163,19 +185,23 @@ class TestMonitoringStack:
         exposed = {line.split()[0].split("{")[0]
                    for line in text.splitlines()
                    if line and not line.startswith("#")}
-        # labeled families (e.g. the per-peer replication-lag gauge)
-        # expose no sample lines until a child exists — the TYPE line
-        # still proves the metric is registered and scrapeable
+        # labeled families (the per-peer replication-lag gauge, the
+        # per-peer visibility-lag histogram) expose no sample lines
+        # until a child exists — the TYPE line still proves the metric
+        # is registered and scrapeable
         labeled = {m.name for m in stats.registry.metrics()
-                   if isinstance(m, stats.LabeledGauge)}
+                   if isinstance(m, (stats.LabeledGauge,
+                                     stats.LabeledHistogram))}
         exposed |= {line.split()[2] for line in text.splitlines()
                     if line.startswith("# TYPE ")
                     and line.split()[2] in labeled}
         names, _dash = self._base_metrics()
         missing = set()
         for n in names:
-            # histogram queries use _sum/_count series of the base name
-            base = n.removesuffix("_sum").removesuffix("_count")
+            # histogram queries use _bucket/_sum/_count series of the
+            # base name
+            base = (n.removesuffix("_sum").removesuffix("_count")
+                    .removesuffix("_bucket"))
             if not any(e == n or e.startswith(base) for e in exposed):
                 missing.add(n)
         assert not missing, f"dashboard queries unexposed metrics: {missing}"

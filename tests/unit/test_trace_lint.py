@@ -166,3 +166,37 @@ def test_publish_rule_flags_untraced_publish_sites(tmp_path):
 
 def test_publish_rule_clean_on_repo():
     assert trace_lint.lint_publish_spans(trace_lint.repo_root()) == []
+
+
+def test_decode_rule_flags_untraced_decode_sites(tmp_path):
+    """ISSUE 7 rule: a function under interdc/ or cluster/ decoding a
+    wire frame (frame_from_bin / *.from_bin) without a span/instant is
+    a blind arrival site; instrumented ones and the decoder
+    definitions themselves pass."""
+    for sub in ("interdc", "cluster"):
+        d = tmp_path / "antidote_tpu" / sub
+        d.mkdir(parents=True)
+        (d / "newrx.py").write_text(
+            "from antidote_tpu.obs.spans import tracer\n"
+            "from antidote_tpu.interdc.wire import frame_from_bin\n"
+            "class R:\n"
+            "    def dark_deliver(self, data):\n"
+            "        return frame_from_bin(data)\n"
+            "    def dark_relay(self, bins):\n"
+            "        return [InterDcTxn.from_bin(b) for b in bins]\n"
+            "    def good_deliver(self, data):\n"
+            "        frame = frame_from_bin(data)\n"
+            "        tracer.instant('interdc_rx', 'interdc')\n"
+            "        return frame\n"
+            "    def unrelated(self, data):\n"
+            "        return data.decode()\n"
+            "def frame_from_bin(data):\n"
+            "    return data\n")
+    problems = trace_lint.lint_decode_instants(str(tmp_path))
+    flagged = sorted(p.split("::")[1].split(":")[0] for p in problems)
+    assert flagged == ["dark_deliver", "dark_deliver",
+                      "dark_relay", "dark_relay"]
+
+
+def test_decode_rule_clean_on_repo():
+    assert trace_lint.lint_decode_instants(trace_lint.repo_root()) == []

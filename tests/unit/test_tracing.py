@@ -1,34 +1,49 @@
-"""JAX profiler integration (antidote_tpu/tracing.py, SURVEY §5.1)."""
+"""JAX profiler capture API — lives in antidote_tpu.obs.prof since
+ISSUE 2; ISSUE 7 retired the ``antidote_tpu.tracing`` re-export shim
+to a one-release import error pointing there."""
 
 import os
 
 import jax.numpy as jnp
 import pytest
 
-from antidote_tpu import tracing
+from antidote_tpu.obs import prof
 
 
 def test_profile_captures_trace(tmp_path):
-    with tracing.profile(str(tmp_path)):
-        assert tracing.active_dir() == str(tmp_path)
-        with tracing.annotate("antidote_test_op"):
+    with prof.profile(str(tmp_path)):
+        assert prof.active_dir() == str(tmp_path)
+        with prof.annotate("antidote_test_op"):
             jnp.arange(512.0).sum().block_until_ready()
-    assert tracing.active_dir() is None
+    assert prof.active_dir() is None
     files = [f for _r, _d, fs in os.walk(tmp_path) for f in fs]
     assert files, "profiler produced no trace files"
 
 
 def test_double_start_rejected(tmp_path):
-    tracing.start(str(tmp_path))
+    prof.start(str(tmp_path))
     try:
         with pytest.raises(RuntimeError, match="already capturing"):
-            tracing.start(str(tmp_path))
+            prof.start(str(tmp_path))
     finally:
-        tracing.stop()
+        prof.stop()
     with pytest.raises(RuntimeError, match="no profiler"):
-        tracing.stop()
+        prof.stop()
 
 
 def test_annotation_without_capture_is_noop():
-    with tracing.annotate("idle"):
+    with prof.annotate("idle"):
         pass
+
+
+def test_retired_shim_raises_with_pointer():
+    """The one-release shim: importing the old module fails LOUDLY
+    with the forwarding address, not an AttributeError three frames
+    later (the ISSUE 7 retirement contract)."""
+    import importlib
+    import sys
+
+    sys.modules.pop("antidote_tpu.tracing", None)
+    with pytest.raises(ImportError, match="obs.prof"):
+        importlib.import_module("antidote_tpu.tracing")
+    sys.modules.pop("antidote_tpu.tracing", None)

@@ -59,11 +59,15 @@ _HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch", "/frame")
 #: txn, and ISSUE 6's encoded wire bytes per shipped txn) are the
 #: other face of the amortization stories; the "*/op" per-ingested-
 #: cost units (H2D bytes per op, dispatches per op) are the ingest
-#: plane's (ISSUE 4 first-class directions).
+#: plane's (ISSUE 4 first-class directions).  "us/txn" is the
+#: commit-path cost ISSUE 7's observability-overhead row reports —
+#: the journey plane taxing every commit must fail the gate — and
+#: "pct" its relative-overhead companion.
 _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  "b/txn", "bytes/txn", "dispatches/txn",
                  "b/op", "bytes/op", "dispatches/op",
-                 "frames/txn", "wire b/txn"}
+                 "frames/txn", "wire b/txn",
+                 "us/txn", "pct"}
 
 
 def repo_root() -> str:

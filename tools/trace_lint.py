@@ -26,6 +26,16 @@ ship worker moved publishing off the commit path, and an untraced
 publish site would make outbound frames invisible to the txid-
 correlated forensic hunts the obs plane exists for.
 
+ISSUE 7 adds the decode rule (the receive-side mirror of the publish
+rule): every function under antidote_tpu/interdc/ or
+antidote_tpu/cluster/ that DECODES a wire frame (``frame_from_bin`` /
+``*.from_bin``) must record the arrival instant with a span/instant —
+the visibility-latency SLOs subtract the carried origin-commit
+wallclock from arrival-side time, so an untraced decode site is a
+blind spot in every journey it feeds.  The decoder definitions
+themselves (functions *named* frame_from_bin / from_bin) are exempt:
+the rule binds call sites, where arrival happens.
+
 Runs standalone (``python tools/trace_lint.py``) and from tier-1
 (tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
 Purely static (ast), so it needs no JAX and runs in milliseconds.
@@ -68,10 +78,11 @@ ENTRY_POINTS: Dict[str, Dict[str, List[str]]] = {
 
 #: a call to <obj>.<attr> counts as instrumentation when (obj, attr)
 #: is one of these — the span/annotation surfaces of the obs plane
-#: (tracing.annotate kept for the shim; prof.annotate is the home)
+#: (the tracing.annotate shim form was retired with tracing.py,
+#: ISSUE 7; prof.annotate is the home)
 _INSTRUMENTED_CALLS = {
     ("tracer", "span"), ("tracer", "instant"),
-    ("tracing", "annotate"), ("prof", "annotate"),
+    ("prof", "annotate"),
 }
 
 #: packages whose public @jax.jit functions must carry @kernel_span
@@ -90,6 +101,14 @@ _INSTRUMENTED_DECORATORS = {"traced"}
 #: instrumented (ISSUE 6); the package the rule sweeps
 _PUBLISH_OWNERS = ("transport", "bus")
 _PUBLISH_DIR = os.path.join("antidote_tpu", "interdc")
+
+#: wire-frame decoder call names: a call to one of these (bare or as
+#: an attribute — ``frame_from_bin(data)`` / ``InterDcTxn.from_bin(b)``)
+#: marks the function as a frame-arrival site (ISSUE 7); the dirs the
+#: rule sweeps
+_DECODE_NAMES = ("frame_from_bin", "from_bin")
+_DECODE_DIRS = (os.path.join("antidote_tpu", "interdc"),
+                os.path.join("antidote_tpu", "cluster"))
 
 
 def _is_instrumented(fn: ast.FunctionDef) -> bool:
@@ -267,6 +286,51 @@ def lint_publish_spans(root: str) -> List[str]:
     return problems
 
 
+def _is_decode_call(node: ast.Call) -> bool:
+    """True for ``frame_from_bin(...)`` / ``wire.frame_from_bin(...)``
+    / ``InterDcTxn.from_bin(...)`` — any call whose terminal name is a
+    wire-frame decoder."""
+    f = node.func
+    name = getattr(f, "attr", getattr(f, "id", None))
+    return name in _DECODE_NAMES
+
+
+def lint_decode_instants(root: str) -> List[str]:
+    """ISSUE 7 rule: every function under the interdc/cluster packages
+    that decodes a wire frame must record the arrival instant with a
+    tracer span/instant — arrival-side time is half of every
+    visibility-latency measurement.  Functions NAMED like a decoder
+    (the wire.py definitions) are exempt; call sites are not."""
+    problems: List[str] = []
+    for rel_dir in _DECODE_DIRS:
+        d = os.path.join(root, rel_dir)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(d, fname)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name in _DECODE_NAMES:
+                    continue  # the decoder itself, not an arrival site
+                decodes = any(
+                    isinstance(c, ast.Call) and _is_decode_call(c)
+                    for c in ast.walk(node))
+                if decodes and not _is_instrumented(node):
+                    problems.append(
+                        f"{rel_dir}/{fname}::{node.name}: decodes a "
+                        "wire frame without recording the arrival "
+                        "instant — add tracer.instant/span (the "
+                        "visibility SLOs need arrival-side time, "
+                        "antidote_tpu/obs/spans.py)")
+    return problems
+
+
 def _methods(tree: ast.Module, cls_name: str) -> Dict[str, ast.FunctionDef]:
     for node in tree.body:
         if isinstance(node, ast.ClassDef) and node.name == cls_name:
@@ -302,6 +366,7 @@ def lint(root: str) -> List[str]:
                         "@traced")
     problems.extend(lint_kernel_spans(root))
     problems.extend(lint_publish_spans(root))
+    problems.extend(lint_decode_instants(root))
     return problems
 
 

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""txn_journey — reconstruct one transaction's commit→visible journey.
+
+Given a txid, reads the span store (a Chrome ``trace_event`` JSON file
+exported by ``tracer.save`` / ``GET /debug/spans``, or a live
+``/debug/spans`` endpoint) and prints the transaction's full journey
+through the replication pipeline with per-stage latencies:
+
+    origin commit → ship stage → frame publish → wire rx →
+    SubBuf admit → gate deliver → depgate admit → visible
+
+Multi-partition transactions cross several streams; each stage prints
+its FIRST occurrence on the chain (the journey's critical path runs
+through the first arrival) and the occurrence count, so a partition
+whose leg lagged is visible in the count column of later stages.
+
+Usage:
+    python tools/txn_journey.py '<txid>' --file spans.json
+    python tools/txn_journey.py '<txid>' --url http://host:3001
+    python tools/txn_journey.py --list --file spans.json   # known txids
+
+The txid argument matches the JSON form of the span's txid (tuple
+txids export as arrays: ``[1785..., 'a1b2']`` — quote it; a substring
+match is accepted when unambiguous).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+#: journey stages in pipeline order: (span name, human label).  Spans
+#: not listed here (kernel:*, device_stage, txn_update, ...) still
+#: print, appended under their own names — the chain is the spine, not
+#: a filter.
+STAGES = (
+    ("txn_start", "txn start (origin)"),
+    ("txn_commit", "commit (origin)"),
+    ("single_commit", "commit 1PC (origin)"),
+    ("interdc_ship_stage", "ship stage (origin)"),
+    ("interdc_send_batch", "frame publish (origin)"),
+    ("interdc_send", "frame publish (origin)"),
+    ("interdc_rx", "wire rx (remote)"),
+    ("subbuf_admit", "SubBuf admit (remote)"),
+    ("subbuf_gap_repair", "SubBuf gap repair (remote)"),
+    ("interdc_deliver", "gate deliver (remote)"),
+    ("depgate_admit", "depgate admit (remote)"),
+    ("interdc_visible", "visible (remote)"),
+)
+
+_STAGE_ORDER = {name: i for i, (name, _label) in enumerate(STAGES)}
+_STAGE_LABEL = dict(STAGES)
+
+
+def load_events(path: Optional[str] = None,
+                url: Optional[str] = None) -> List[dict]:
+    """The trace's event list from a file or a /debug/spans endpoint."""
+    if url is not None:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/debug/spans", timeout=10) as r:
+            doc = json.load(r)
+    else:
+        with open(path) as f:
+            doc = json.load(f)
+    return doc.get("traceEvents", [])
+
+
+def known_txids(events: List[dict]) -> List[str]:
+    """Distinct txids in the trace, JSON-encoded, first-seen order."""
+    seen: Dict[str, None] = {}
+    for e in events:
+        txid = (e.get("args") or {}).get("txid")
+        if txid is not None:
+            seen.setdefault(json.dumps(txid), None)
+    return list(seen)
+
+
+def match_txid(events: List[dict], wanted: str) -> Optional[str]:
+    """Resolve the user's txid string to a trace txid key: exact JSON
+    match first, then unambiguous substring."""
+    ids = known_txids(events)
+    if wanted in ids:
+        return wanted
+    hits = [t for t in ids if wanted in t]
+    if len(hits) == 1:
+        return hits[0]
+    if len(hits) > 1:
+        raise SystemExit(
+            f"txn_journey: {wanted!r} is ambiguous ({len(hits)} "
+            f"matches): {hits[:5]}")
+    return None
+
+
+def journey(events: List[dict], txid_key: str) -> List[dict]:
+    """The txn's journey rows: one per stage (first occurrence), in
+    timeline order, with deltas.  Each row: {stage, label, ts_us,
+    dur_us, count, delta_us (from previous stage), args}."""
+    mine = [e for e in events
+            if json.dumps((e.get("args") or {}).get("txid")) == txid_key]
+    mine.sort(key=lambda e: e["ts"])
+    first: Dict[str, dict] = {}
+    counts: Dict[str, int] = {}
+    for e in mine:
+        name = e["name"]
+        counts[name] = counts.get(name, 0) + 1
+        if name not in first:
+            first[name] = e
+    rows = []
+    prev_ts = None
+    for e in sorted(first.values(), key=lambda e: e["ts"]):
+        name = e["name"]
+        rows.append({
+            "stage": name,
+            "label": _STAGE_LABEL.get(name, name),
+            "ts_us": e["ts"],
+            "dur_us": e.get("dur", 0),
+            "count": counts[name],
+            "delta_us": (e["ts"] - prev_ts) if prev_ts is not None
+            else 0,
+            "args": {k: v for k, v in (e.get("args") or {}).items()
+                     if k != "txid"},
+        })
+        prev_ts = e["ts"]
+    return rows
+
+
+def total_visibility_us(rows: List[dict]) -> Optional[int]:
+    """Commit→visible wall time when both endpoints are in the trace."""
+    commit = next((r for r in rows
+                   if r["stage"] in ("txn_commit", "single_commit")),
+                  None)
+    visible = [r for r in rows if r["stage"] == "interdc_visible"]
+    if commit is None or not visible:
+        return None
+    return visible[-1]["ts_us"] - commit["ts_us"]
+
+
+def format_journey(txid_key: str, rows: List[dict]) -> str:
+    if not rows:
+        return (f"txn_journey: no spans for txid {txid_key} — was it "
+                "sampled?  (Config.trace_sample_rate; the journey "
+                "needs the txid's spans in the exported ring)")
+    out = [f"journey for txid {txid_key}:", ""]
+    out.append(f"  {'stage':<22} {'label':<26} {'+delta':>12} "
+               f"{'dur':>10} {'n':>3}")
+    for r in rows:
+        delta = f"+{r['delta_us'] / 1000.0:.3f}ms" if r["delta_us"] \
+            else ""
+        dur = f"{r['dur_us'] / 1000.0:.3f}ms" if r["dur_us"] else ""
+        extra = ""
+        if r["stage"] == "interdc_visible" \
+                and "vis_lag_s" in r["args"]:
+            extra = f"  vis_lag={r['args']['vis_lag_s'] * 1e3:.3f}ms"
+        out.append(f"  {r['stage']:<22} {r['label']:<26} {delta:>12} "
+                   f"{dur:>10} {r['count']:>3}{extra}")
+    total = total_visibility_us(rows)
+    if total is not None:
+        out += ["", f"  commit -> visible: {total / 1000.0:.3f} ms"]
+    missing = [name for name in ("interdc_rx", "depgate_admit",
+                                 "interdc_visible")
+               if not any(r["stage"] == name for r in rows)]
+    if missing:
+        out += ["", f"  note: remote stages missing ({missing}) — "
+                "either the txn never replicated, the remote half "
+                "lives in another process's span ring, or the ring "
+                "evicted it"]
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="reconstruct a transaction's commit->visible "
+                    "journey from the span store")
+    ap.add_argument("txid", nargs="?",
+                    help="txid to reconstruct (JSON form or unambiguous "
+                         "substring)")
+    ap.add_argument("--file", default=None,
+                    help="Chrome trace JSON (tracer.save / exported "
+                         "/debug/spans)")
+    ap.add_argument("--url", default=None,
+                    help="base URL of a live metrics server (fetches "
+                         "/debug/spans)")
+    ap.add_argument("--list", action="store_true",
+                    help="list txids present in the trace and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the journey rows as JSON instead of the "
+                         "table")
+    args = ap.parse_args(argv)
+    if not args.file and not args.url:
+        print("txn_journey: pass --file or --url", file=sys.stderr)
+        return 2
+    try:
+        events = load_events(path=args.file, url=args.url)
+    except (OSError, ValueError) as e:
+        print(f"txn_journey: cannot load trace: {e}", file=sys.stderr)
+        return 2
+    if args.list:
+        for t in known_txids(events):
+            print(t)
+        return 0
+    if not args.txid:
+        print("txn_journey: pass a txid (or --list)", file=sys.stderr)
+        return 2
+    key = match_txid(events, args.txid)
+    if key is None:
+        print(f"txn_journey: txid {args.txid!r} not in the trace "
+              f"({len(known_txids(events))} txids known; --list shows "
+              "them)", file=sys.stderr)
+        return 1
+    rows = journey(events, key)
+    if args.json:
+        print(json.dumps({"txid": key, "stages": rows,
+                          "commit_to_visible_us":
+                          total_visibility_us(rows)}))
+    else:
+        print(format_journey(key, rows))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
