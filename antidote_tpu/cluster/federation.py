@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from antidote_tpu.api import AntidoteTPU
 from antidote_tpu.clocks import VC
 from antidote_tpu.interdc import query as idc_query
 from antidote_tpu.interdc.dep import DependencyGate, gate_from_config
@@ -94,6 +95,10 @@ class NodeInterDc:
         self.srv = srv
         self.bus = bus
         self.node = node
+        #: client API over this member's node — answers remote
+        #: snapshot reads (idc_query.SNAPSHOT_READ) with full ring
+        #: routing, locally-owned slices on the read serve plane
+        self._api = AntidoteTPU(node=node)
         self.dc_id = node.dc_id
         self.member_index = sorted(srv.plane.members,
                                    key=repr).index(srv.node_id)
@@ -377,6 +382,16 @@ class NodeInterDc:
             return pm.scan_log(
                 lambda lg: idc_query.answer_log_read(
                     lg, self.dc_id, partition, first, last))
+        if kind == idc_query.SNAPSHOT_READ:
+            objects, clock = payload
+            # the federated remote-read leg (ISSUE 8): any member can
+            # answer — partitions this node does not own route over
+            # the node fabric (RemotePartition) inside the read, and
+            # locally-owned slices serve through the read serve plane
+            tracer.instant("interdc_snapshot_read", "interdc",
+                           origin=str(from_dc), keys=len(objects))
+            return idc_query.answer_snapshot_read(
+                self._api, objects, clock)
         if kind == idc_query.CHECK_UP:
             return True
         raise ValueError(f"unknown inter-DC query kind {kind!r}")

@@ -594,10 +594,14 @@ class NodeServer:
                         f"partition {p} not owned by "
                         f"{self.node_id!r} (stale ring at {origin!r}?)")
                 groups.append((pm, [tuple(i) for i in items]))
-            from antidote_tpu.txn.manager import read_many_fused
+            from antidote_tpu.mat.serve import read_groups
 
             try:
-                return read_many_fused(groups, snapshot_vc, txid)
+                # the owner-side serve plane (mat/serve.py): a peer's
+                # batched read coalesces with this member's own local
+                # readers; read_serve=False keeps the fused per-chip
+                # fold (txn/manager.read_many_fused) exactly
+                return read_groups(groups, snapshot_vc, txid)
             except PartitionRetired:
                 # raced a cutover mid-batch: refuse; the caller's
                 # per-partition fallback self-heals each slot
@@ -722,6 +726,17 @@ class NodeServer:
                 f"partition {p} not owned by {self.node_id!r} "
                 f"(stale ring at {origin!r}?)")
         try:
+            rs = getattr(pm, "read_server", None)
+            if method == "read_many" and rs is not None and rs.enabled:
+                # the remote-read leg of the serve plane (ISSUE 8):
+                # a peer's per-partition fallback read (coordinator
+                # _read_groups_fallback) coalesces with this owner's
+                # local readers instead of buying its own fold.  The
+                # proxy marshals txid POSITIONALLY (cluster/remote.py
+                # read_many) — dropping it would make the waiter's own
+                # prepared entry look foreign and lose trace joins
+                txid = args[2] if len(args) > 2 else kwargs.get("txid")
+                return rs.read_many(args[0], args[1], txid=txid)
             return getattr(pm, method)(*args, **kwargs)
         except PartitionRetired:
             # this call raced the cutover's drain refusal: it passed

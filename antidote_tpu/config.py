@@ -110,6 +110,25 @@ class Config:
     #: committer flushes INLINE — backpressure so a lagging flusher
     #: cannot let staged rows grow unboundedly
     mat_coalesce_rows: int = 8192
+    #: cross-transaction read-coalescing serve plane
+    #: (antidote_tpu/mat/serve.py): concurrent snapshot reads of a
+    #: partition stage into a short per-partition window and drain as
+    #: ONE gathered device fold per snapshot-compatible group
+    #: (Clock-SI rule: a group folds at the pointwise-max VC, valid
+    #: for every waiter it covers), with each waiter's read-your-
+    #: writes overlay applied on top by the coordinator.  False = the
+    #: per-txn read path (the benches' comparison baseline, like
+    #: mat_ingest / gate_device_ring / interdc_ship)
+    read_serve: bool = True
+    #: read-coalescing window, µs: once a drain leader observes OTHER
+    #: waiters staged it holds the window open this long so a burst is
+    #: served by one fold; a solo reader drains immediately (no added
+    #: latency on uncontended reads).  0 disables the hold — drains
+    #: still batch whatever staged while the previous drain ran
+    read_coalesce_us: int = 400
+    #: staged-key budget per window: past it the leader drains at once
+    #: (latency backpressure, the mat_coalesce_rows analogue)
+    read_coalesce_keys: int = 512
     #: run threshold device flushes/GCs on a background flusher thread
     #: (group commit: commits only stage; reads needing pending data
     #: still flush inline).  Committers flush inline past 4x the

@@ -460,6 +460,13 @@ class DataCenter(AntidoteTPU):
             return pm.scan_log(
                 lambda log: idc_query.answer_log_read(
                     log, self.node.dc_id, partition, first, last))
+        if kind == idc_query.SNAPSHOT_READ:
+            objects, clock = payload
+            # served through the read serve plane (ISSUE 8): the
+            # remote reader's fold coalesces with local readers
+            tracer.instant("interdc_snapshot_read", "interdc",
+                           origin=str(from_dc), keys=len(objects))
+            return idc_query.answer_snapshot_read(self, objects, clock)
         if kind == idc_query.CHECK_UP:
             return True
         if kind == idc_query.BCOUNTER_REQUEST:

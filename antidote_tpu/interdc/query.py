@@ -1,4 +1,4 @@
-"""Inter-DC query RPC: log-range repair reads.
+"""Inter-DC query RPC: log-range repair reads + remote snapshot reads.
 
 Client side mirrors inter_dc_query (reference src/inter_dc_query.erl:76-79)
 and the server side inter_dc_query_response (src/inter_dc_query_response.erl:97-126):
@@ -6,12 +6,20 @@ read the partition's whole log, reassemble transactions, and return the
 *locally-originated* ones whose commit-record opid falls in the requested
 range, with the prev-opid chain reconstructed so the requester's gap
 check can consume them like live frames.
+
+ISSUE 8 adds the SNAPSHOT_READ kind: a causal one-shot read of bound
+objects at a clock, answered at the remote DC through its read serve
+plane (api.read_objects_static's fast path — no interactive
+transaction, coalesced with the serving DC's own readers).  This is
+the cross-DC remote-read leg the causal probe and federated clients
+use instead of replaying log ranges for a value question.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
+from antidote_tpu.clocks import VC
 from antidote_tpu.interdc.transport import LinkDown, Transport
 from antidote_tpu.interdc.wire import InterDcTxn
 from antidote_tpu.oplog.records import TxnAssembler
@@ -19,6 +27,7 @@ from antidote_tpu.oplog.records import TxnAssembler
 LOG_READ = "log_read"
 BCOUNTER_REQUEST = "bcounter_request"
 CHECK_UP = "check_up"
+SNAPSHOT_READ = "snapshot_read"
 
 
 def fetch_log_range(transport: Transport, own_dc, origin_dc, partition: int,
@@ -56,3 +65,32 @@ def answer_log_read(partition_log, dc_id, partition: int, first: int,
             out.append(InterDcTxn.from_ops(dc_id, partition, prev, done))
         prev = commit_opid
     return out
+
+
+def fetch_snapshot_read(transport: Transport, own_dc, origin_dc,
+                        objects: List, clock: Optional[VC]
+                        ) -> Optional[Tuple[List, VC]]:
+    """Ask ``origin_dc`` for the values of ``objects`` (bound-object
+    tuples) at ``clock`` (None = its stable snapshot); returns
+    (values, snapshot VC) or None when the origin is unreachable.  The
+    payload crosses administrative domains, so clocks travel as plain
+    dicts (the termcodec VC form is for wire frames)."""
+    try:
+        values, vc = transport.request(
+            own_dc, origin_dc, SNAPSHOT_READ,
+            ([tuple(o) for o in objects],
+             None if clock is None else dict(clock)))
+    except LinkDown:
+        return None
+    return list(values), VC(vc)
+
+
+def answer_snapshot_read(db, objects, clock) -> Tuple[List, dict]:
+    """Server side: serve the one-shot causal read through the DC's
+    read serve plane (api.read_objects_static — the fast path when the
+    ring is local, the interactive path on a federated member whose
+    ring spans nodes), coalescing with the serving DC's own readers."""
+    values, vc = db.read_objects_static(
+        None if clock is None else VC(clock),
+        [tuple(o) for o in objects])
+    return values, dict(vc)

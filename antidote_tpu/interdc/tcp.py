@@ -72,6 +72,87 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
+class _SubSender:
+    """One Python-mode subscriber's bounded send worker (the ROADMAP
+    publish-stall satellite): ``publish`` only ENQUEUES a frame per
+    subscriber, and each subscriber's own worker thread drains its
+    queue — so one peer with a full TCP window delays nobody else, and
+    the publisher never blocks.  A queue that overflows (the peer
+    stalled past ``QUEUE_DEPTH`` frames) drops the subscriber, like
+    the native hub's bounded per-subscriber queues and ZMQ's
+    drop-on-slow PUB semantics; the peer resubscribes and the opid
+    watermark gap-repairs whatever it missed.  Per-send timing still
+    feeds ``antidote_ship_subscriber_send_seconds{peer}`` from the
+    worker — the gauge stays accurate per send, it just no longer
+    measures a stall every OTHER peer is paying for."""
+
+    QUEUE_DEPTH = 128
+
+    def __init__(self, conn: socket.socket, label: str, on_dead):
+        self.conn = conn
+        self.label = label
+        self._on_dead = on_dead
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(
+            maxsize=self.QUEUE_DEPTH)
+        self._dead = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"pub-send-{label}")
+        self._thread.start()
+
+    def offer(self, data: bytes) -> None:
+        """Non-blocking enqueue; overflow drops the subscriber (a
+        mid-stream stall would desync or convoy the stream anyway)."""
+        try:
+            self._q.put_nowait(data)
+        except queue.Full:
+            log.warning("pub: dropping stalled subscriber %r "
+                        "(send queue full)", self.label)
+            self._die()
+
+    def _run(self) -> None:
+        while True:
+            data = self._q.get()
+            if data is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                _send_frame(self.conn, data)
+            except OSError:
+                self._die()
+                return
+            stats.registry.ship_subscriber_send.set(
+                time.perf_counter() - t0, peer=self.label)
+            if self._dead:
+                # a concurrent _die (offer-side queue overflow) removed
+                # the gauge between our send and set: re-remove so a
+                # dropped subscriber can't leave a frozen series
+                stats.registry.ship_subscriber_send.remove(
+                    peer=self.label)
+                return
+
+    def _die(self) -> None:
+        if self._dead:
+            return
+        self._dead = True
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        stats.registry.ship_subscriber_send.remove(peer=self.label)
+        self._on_dead(self)
+
+    def close(self) -> None:
+        self._dead = True
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass  # worker will die on the closed socket
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
 class TcpTransport(Transport):
     """One DC's endpoint of the TCP fabric.  Construct one per DC
     process; ``register`` binds the listeners, ``connect`` subscribes to
@@ -89,11 +170,12 @@ class TcpTransport(Transport):
         self._dc_id: Any = None
         self._inbox: "queue.Queue[bytes]" = queue.Queue()
         self._handler: Optional[Callable[[Any, str, Any], Any]] = None
-        #: live subscriber connections to OUR pub listener (Python
-        #: mode): (socket, peer label) — the label feeds the per-
-        #: subscriber send-duration gauge (ISSUE 7 satellite: the
-        #: serial fan-out loop's stalls must be observable per peer)
-        self._subscribers: List[Tuple[socket.socket, str]] = []
+        #: live subscriber send workers on OUR pub listener (Python
+        #: mode): one _SubSender each — publish enqueues per
+        #: subscriber instead of sending serially, so a slow peer
+        #: cannot stall the stream (ISSUE 8 satellite; the per-peer
+        #: send-duration gauge from ISSUE 7 stays per-send accurate)
+        self._subscribers: List[_SubSender] = []
         #: target dc_id -> (addr, persistent request socket or None)
         self._peers: Dict[Any, Dict[str, Any]] = {}
         self._lock = threading.RLock()
@@ -202,14 +284,22 @@ class TcpTransport(Transport):
                 conn.close()
                 continue
             log.debug("pub: subscriber %r connected", peer)
-            # bounded send: one stalled subscriber (hung peer, full TCP
-            # window) must not block the publisher's commit path — on
-            # timeout the connection drops (mid-frame send would desync
-            # the stream anyway) and the peer resubscribes + gap-repairs,
-            # matching ZMQ's drop-on-slow PUB semantics
+            # bounded sends: each subscriber gets its own worker +
+            # queue (_SubSender), so a hung peer or full TCP window
+            # stalls only its own stream; the send timeout below
+            # bounds each individual send, after which the worker
+            # drops the connection (mid-frame would desync anyway)
+            # and the peer resubscribes + gap-repairs — ZMQ's
+            # drop-on-slow PUB semantics
             conn.settimeout(self.connect_timeout)
             with self._lock:
-                self._subscribers.append((conn, str(peer)))
+                self._subscribers.append(_SubSender(
+                    conn, str(peer), self._drop_subscriber))
+
+    def _drop_subscriber(self, sender: "_SubSender") -> None:
+        with self._lock:
+            if sender in self._subscribers:
+                self._subscribers.remove(sender)
 
     def publish(self, origin, data: bytes) -> None:
         with self._lock:
@@ -220,29 +310,13 @@ class TcpTransport(Transport):
             if self._hub is not None:
                 self._hub_lib.fab_publish(self._hub, data, len(data))
                 return
-            conns = list(self._subscribers)
-        dead = []
-        for conn, label in conns:
-            # per-subscriber send timing (ISSUE 7 satellite): this loop
-            # is SERIAL, so one peer with a full TCP window delays every
-            # later peer's frame by its whole send (ROADMAP's latent
-            # many-peer publish stall) — the gauge makes the culprit
-            # visible before it bites
-            t0 = time.perf_counter()
-            try:
-                _send_frame(conn, data)
-            except OSError:
-                dead.append((conn, label))
-            stats.registry.ship_subscriber_send.set(
-                time.perf_counter() - t0, peer=label)
-        if dead:
-            with self._lock:
-                for entry in dead:
-                    if entry in self._subscribers:
-                        self._subscribers.remove(entry)
-                    entry[0].close()
-                    stats.registry.ship_subscriber_send.remove(
-                        peer=entry[1])
+            senders = list(self._subscribers)
+        for sender in senders:
+            # enqueue-only fan-out: the per-subscriber workers send in
+            # parallel, so the publisher (and every healthy peer) is
+            # never behind one slow peer's TCP window (the ROADMAP
+            # publish-stall item, closed)
+            sender.offer(data)
 
     # ----------------------------------------------------- subscribe side
 
@@ -391,8 +465,8 @@ class TcpTransport(Transport):
                 except OSError:
                     pass
         with self._lock:
-            for conn, _label in self._subscribers:
-                conn.close()
+            for sender in self._subscribers:
+                sender.close()
             self._subscribers.clear()
             for peer in self._peers.values():
                 if peer["req_sock"] is not None:

@@ -192,9 +192,17 @@ class _Connection:
 
     def _static_read(self, req: pb.ApbStaticReadObjects):
         try:
+            from antidote_tpu.obs.spans import tracer
+
             clock = codec.clock_from_pb(req.clock)
             props = codec.props_from_pb(req.properties)
             objects = [codec.bound_from_pb(b) for b in req.objects]
+            # routed through the read serve plane (ISSUE 8): the one-
+            # shot read allocates no interactive transaction and
+            # coalesces with concurrent readers (mat/serve.py); the
+            # instant marks the PB arrival on the serve-stage timeline
+            tracer.instant("pb_static_read", "coordinator",
+                           keys=len(objects))
             values, commit_vc = self.db.read_objects_static(
                 clock, objects, props)
         except Exception as e:  # noqa: BLE001

@@ -471,6 +471,43 @@ class Registry:
             "Causal-order violations the probe auditor observed (a "
             "causal read at the probe write's commit clock missed the "
             "element); each one dumps the flight recorder")
+        # ---- coalesced read serve plane (ISSUE 8,
+        # antidote_tpu/mat/serve.py): the serving side of the ingest
+        # plane's economy.  Fewer fold dispatches per served key (and
+        # more waiters per drain fold) is the amortization the hot-
+        # shard read bench gates on; the cache counters feed its hit-
+        # ratio row.
+        self.read_dispatches = Counter(
+            "antidote_read_device_dispatches_total",
+            "Device fold captures on the serving read path (each is "
+            "at least one XLA program; legacy per-txn reads count "
+            "here too — the serve plane's amortization is fewer of "
+            "these per served key)")
+        self.read_serve_groups = Counter(
+            "antidote_read_serve_groups_total",
+            "Snapshot-compatible drain groups folded by the read "
+            "serve plane (one gathered dispatch each)")
+        self.read_serve_waiters = Counter(
+            "antidote_read_serve_waiters_total",
+            "Concurrent read calls served through the coalescing "
+            "window (N waiters sharing one drain group cost one fold "
+            "instead of N)")
+        self.read_coalesced_keys = Counter(
+            "antidote_read_coalesced_keys_total",
+            "Key reads served by serve-plane drain groups (waiter-"
+            "keys, not unique keys: N waiters of one hot key count N)")
+        self.read_cache_hits = Counter(
+            "antidote_read_cache_hits_total",
+            "Snapshot reads served from the frontier-keyed value "
+            "cache (no materialization at all)")
+        self.read_cache_misses = Counter(
+            "antidote_read_cache_misses_total",
+            "Snapshot reads that missed the value cache and paid a "
+            "materialization (device fold / host store / log replay)")
+        self.read_waiters_per_dispatch = Gauge(
+            "antidote_read_waiters_per_dispatch",
+            "Amortization ratio of the read serve plane: waiters "
+            "served per drain-group fold over the process lifetime")
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -493,7 +530,11 @@ class Registry:
                 self.ship_txns_per_frame, self.ship_bytes_per_txn,
                 self.ship_subscriber_send,
                 self.vis_lag, self.vis_safe_time_lag,
-                self.vis_probe_staleness, self.vis_probe_violations)
+                self.vis_probe_staleness, self.vis_probe_violations,
+                self.read_dispatches, self.read_serve_groups,
+                self.read_serve_waiters, self.read_coalesced_keys,
+                self.read_cache_hits, self.read_cache_misses,
+                self.read_waiters_per_dispatch)
 
     def exposition(self) -> str:
         lines = []

@@ -34,7 +34,6 @@ from antidote_tpu.txn.manager import (
     CertificationError,
     PartitionManager,
     _is_raw,
-    read_many_fused,
 )
 
 
@@ -273,17 +272,27 @@ class Coordinator:
 
     # ------------------------------------------------------------ lifecycle
 
-    def start_transaction(self, client_clock: Optional[VC] = None,
-                          properties: Optional[TxnProperties] = None
-                          ) -> Transaction:
-        props = properties or TxnProperties()
+    def snapshot_for(self, client_clock: Optional[VC],
+                     props: TxnProperties) -> VC:
+        """The Clock-SI snapshot rule — stable ⊔ client clock (after
+        the causal wait), local entry bumped to now — shared by
+        start_transaction and the static-read fast path
+        (api.read_objects_static): a one-shot read snapshots exactly
+        like a transaction, it just skips the transaction."""
         node = self.node
         if client_clock and props.update_clock:
             snap = self._wait_for_clock(client_clock).join(client_clock)
         else:
             snap = VC(node.stable_vc())
-        snap = snap.set_dc(node.dc_id, max(snap.get_dc(node.dc_id),
+        return snap.set_dc(node.dc_id, max(snap.get_dc(node.dc_id),
                                            node.clock.now_us()))
+
+    def start_transaction(self, client_clock: Optional[VC] = None,
+                          properties: Optional[TxnProperties] = None
+                          ) -> Transaction:
+        props = properties or TxnProperties()
+        node = self.node
+        snap = self.snapshot_for(client_clock, props)
         txid = (snap.get_dc(node.dc_id), _fresh_txid_suffix())
         stats.registry.open_transactions.inc()
         tracer.instant("txn_start", "coordinator", txid=txid,
@@ -460,15 +469,17 @@ class Coordinator:
                     else:
                         values.update(self._multi_or_fallback(
                             l, owner, payload, groups, tx))
-                if len(local_groups) == 1:
-                    pm, items = local_groups[0]
-                    values.update(pm.read_many(
-                        items, tx.snapshot_vc, txid=tx.txid))
-                elif local_groups:
-                    # multi-partition local read: fuse the device folds
-                    # per chip — at most n_devices programs, not one
-                    # per partition (manager.read_many_fused)
-                    values.update(read_many_fused(
+                if local_groups:
+                    # local partitions route through the read serve
+                    # plane (mat/serve.py): concurrent transactions'
+                    # snapshot reads coalesce into one gathered fold
+                    # per window; read_serve=False (or a bare pm
+                    # without a server) keeps the per-txn paths —
+                    # single-partition read_many / the fused cross-
+                    # partition fold (manager.read_many_fused)
+                    from antidote_tpu.mat.serve import read_groups
+
+                    values.update(read_groups(
                         local_groups, tx.snapshot_vc, txid=tx.txid))
             except BaseException:
                 # a local read failed mid-round: started remote calls

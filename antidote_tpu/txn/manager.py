@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from antidote_tpu import stats
 from antidote_tpu.clocks import VC
 from antidote_tpu.mat.device_plane import DevicePlane, ReadBelowBase
 from antidote_tpu.mat.host_store import HostStore
@@ -234,6 +235,12 @@ class PartitionManager:
         #: process never published, and a bottom-seeded state would
         #: disagree with the log-fallback read.
         self.seed_cache_on_first_publish = True
+        #: cross-transaction read-coalescing window fronting this
+        #: partition's snapshot reads (antidote_tpu/mat/serve.py) —
+        #: set by the Node's partition factory so the knobs route
+        #: through serve_from_config; None = no serve plane (direct
+        #: per-call reads, the bare-PartitionManager test tier)
+        self.read_server = None
         #: device reads in flight outside the lock (see read()): the
         #: append/gc kernels DONATE their input buffers, so a device
         #: mutation while a reader still holds the captured shard state
@@ -669,7 +676,9 @@ class PartitionManager:
                     if ent is not None and ent[0] is fr \
                             and (ent[3] or not need_exact):
                         ent[2] = 0
+                        stats.registry.read_cache_hits.inc()
                         return ent[1]
+                stats.registry.read_cache_misses.inc()
                 if need_exact:
                     value = self._read_from_log(key, type_name,
                                                 snapshot_vc, txid)
@@ -686,6 +695,7 @@ class PartitionManager:
                 except ReadBelowBase:
                     reader = False  # sentinel: log replay below
                 else:
+                    stats.registry.read_dispatches.inc()
                     self._dev_readers += 1
             else:
                 value = self._read_store(key, type_name, snapshot_vc, txid,
@@ -751,12 +761,15 @@ class PartitionManager:
             if ent is not None and ent[0] is fr \
                     and (ent[3] or not exact_state):
                 ent[2] = 0
+                stats.registry.read_cache_hits.inc()
                 return ent[1]
+        stats.registry.read_cache_misses.inc()
         if self.device is not None and self.device.owns(type_name, key):
             exact = self.device.state_exact(type_name, key)
             try:
                 if exact_state and not exact:
                     raise ReadBelowBase()  # lossy fold: exact replay
+                stats.registry.read_dispatches.inc()
                 value = self.device.read(key, type_name, read_vc,
                                          txid=txid)
             except ReadBelowBase:
@@ -829,6 +842,7 @@ class PartitionManager:
                         raise TimeoutError(
                             "batched read blocked on prepared txn")
             by_type: Dict[str, list] = {}
+            cache_hits = dev_misses = 0
             for key, type_name in items:
                 fr = self.key_frontier.get(key)
                 covers = fr is not None and (
@@ -838,15 +852,22 @@ class PartitionManager:
                     if ent is not None and ent[0] is fr:
                         ent[2] = 0
                         out[(key, type_name)] = ent[1]
+                        cache_hits += 1
                         continue
                 if self.device is not None and self.device.owns(
                         type_name, key):
+                    dev_misses += 1
                     by_type.setdefault(type_name, []).append(
                         (key, fr if covers else None,
                          self.device.state_exact(type_name, key)))
                 else:
+                    # _read_store counts its own cache hit/miss
                     out[(key, type_name)] = self._read_store(
                         key, type_name, snapshot_vc, txid)
+            if cache_hits:
+                stats.registry.read_cache_hits.inc(cache_hits)
+            if dev_misses:
+                stats.registry.read_cache_misses.inc(dev_misses)
             # flush EVERY type first, then create closures: a flush is
             # a buffer-donating device mutation, and quiescing for a
             # later type would deadlock on our own earlier closure's
@@ -865,6 +886,7 @@ class PartitionManager:
                 except ReadBelowBase:
                     closure = None  # whole batch from the log
                 else:
+                    stats.registry.read_dispatches.inc()
                     self._dev_readers += 1
                 dev_batches.append((type_name, pairs, closure))
         return out, dev_batches

@@ -632,6 +632,13 @@ class Node:
                     plane.place_on(devs[p % len(devs)])
         pm = PartitionManager(p, self.dc_id, log, self.clock,
                               device_plane=plane)
+        # cross-transaction read coalescing (mat/serve.py): the ONE
+        # construction path routes the Config knobs, so every local
+        # partition — boot, repartition, adopt_partition — gets the
+        # same window (the gate_from_config lesson)
+        from antidote_tpu.mat.serve import ReadServer, serve_from_config
+
+        pm.read_server = ReadServer(pm, serve_from_config(self.config))
         if plane is not None and self.config.device_async_flush:
             plane.flush_scheduler = (
                 lambda pl, _pm=pm: self._flusher.schedule(_pm, pl))

@@ -1,0 +1,212 @@
+"""Config 9: hot-shard concurrent readers — the read serve economy.
+
+Cure's snapshot reads are pure functions of ``(key, snapshot VC)``
+(PAPERS.md: Akkoorath et al., ICDCS 2016), yet before ISSUE 8 every
+transaction's read bought its own device fold — on a hot shard, N
+concurrent readers cost N kernel launches for the same answer (the
+read-dispatch stampede the 8-client txn bench's p99 showed).  This
+config drives the REAL coordinator path (``read_objects_static`` ->
+serve plane -> partition fold) twice — the coalescing window
+(``read_serve=True``) against the per-txn legacy leg — and measures
+the two ratios the regression gate enforces directionally:
+
+- ``read_waiters_per_dispatch`` (waiters/dispatch, must not fall):
+  concurrent read calls served per drain-group fold, the coalescing
+  amortization;
+- ``read_cache_hit_pct`` (hit pct, must not fall): share of steady
+  repeat reads served straight from the frontier-keyed value cache,
+  skipping the device entirely.
+
+The workload is the stampede the serve plane exists for: a writer
+bursts enough commits to retire each hot key's warm cache entry
+(write-only keys retire after ``_warm_writes_cap`` commits — the
+PR-4 cache discipline), then 8 readers hit the cold keys at once.
+Legacy: every reader that begins before the first fold's cache-put
+lands pays its own fold.  Serve: the window drains them as ONE
+gathered fold (all the readers' fresh snapshots cover the burst's
+frontier — the Clock-SI covered group).
+
+Value equivalence is asserted, not assumed: both legs apply the
+identical update tape, and every read of every round must return
+bit-for-bit the same values on both legs before any ratio is
+reported.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import threading
+
+from benches._util import emit, setup
+
+N_READERS = 8
+HOT_KEYS = 6
+
+
+def build_db(serve: bool, data_dir: str):
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.config import Config
+
+    # logging stays ON (device evictions replay the log — with it off
+    # an overflow-evicted key would lose its history); lanes cover a
+    # whole retire burst and the GC cadence folds each round's ops
+    # into the base so the hot keys STAY device-resident — the bench
+    # measures fold dispatch amortization, not eviction behavior
+    cfg = Config(n_partitions=1, metrics_port=None, read_serve=serve,
+                 device_lanes=64, device_gc_ops=192,
+                 device_key_capacity=4096)
+    return AntidoteTPU(dc_id="bench9", config=cfg, data_dir=data_dir)
+
+
+def _read_stats():
+    from antidote_tpu import stats
+
+    r = stats.registry
+    return {
+        "dispatches": r.read_dispatches.value(),
+        "groups": r.read_serve_groups.value(),
+        "waiters": r.read_serve_waiters.value(),
+        "hits": r.read_cache_hits.value(),
+        "misses": r.read_cache_misses.value(),
+    }
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in before}
+
+
+def run_leg(serve: bool, rounds: int):
+    """One leg's stampede sweep; returns (per-round read values,
+    stampede stat deltas, steady-phase stat deltas)."""
+    d = tempfile.mkdtemp(prefix="bench9_")
+    db = build_db(serve, d)
+    # counter_pn: its increment needs no state downstream, so the
+    # writer bursts touch no read-path counters — the stampede deltas
+    # measure the READERS only
+    keys = [(f"hot_{i:02d}", "counter_pn") for i in range(HOT_KEYS)]
+    # retire budget: _warm_writes_cap (32) commits with no read in
+    # between retire the warm entry, so the readers' round goes cold
+    burst = 33
+
+    values_log = []
+    try:
+        barrier = threading.Barrier(N_READERS + 1)
+        results = [None] * N_READERS
+        errors = []
+        stop = False
+
+        def reader(slot):
+            while True:
+                barrier.wait()
+                if stop:
+                    return
+                try:
+                    vals, _vc = db.read_objects_static(None, keys)
+                    results[slot] = vals
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+                barrier.wait()
+
+        threads = [threading.Thread(target=reader, args=(i,),
+                                    daemon=True)
+                   for i in range(N_READERS)]
+        for t in threads:
+            t.start()
+        s0 = _read_stats()
+        for r in range(rounds):
+            for key, _t in keys:
+                for _j in range(burst):
+                    db.update_objects_static(None, [
+                        ((key, "counter_pn"), "increment", 1)])
+            barrier.wait()   # release the stampede
+            barrier.wait()   # all readers done
+            assert not errors, errors[0]
+            # every reader of the round must see the full burst (the
+            # writer finished before the barrier, and each reader's
+            # fresh snapshot covers it — Clock-SI)
+            expected = [burst * (r + 1)] * HOT_KEYS
+            for vals in results:
+                assert vals == expected, (vals, expected)
+            values_log.append(list(results[0]))
+        stampede = _delta(s0, _read_stats())
+        # steady phase: stable keys, repeat reads — the cache's job
+        s1 = _read_stats()
+        for _ in range(rounds):
+            barrier.wait()
+            barrier.wait()
+            assert not errors, errors[0]
+        steady = _delta(s1, _read_stats())
+        stop = True
+        barrier.wait()  # release readers into the stop check
+        for t in threads:
+            t.join(timeout=5)
+    finally:
+        db.close()
+        shutil.rmtree(d, ignore_errors=True)
+    return values_log, stampede, steady
+
+
+def summary(rounds: int):
+    serve_vals, serve_stampede, serve_steady = run_leg(True, rounds)
+    legacy_vals, legacy_stampede, legacy_steady = run_leg(False, rounds)
+    # bit-for-bit value equivalence: identical update tape, identical
+    # reads, identical answers — the coalesced fold must not change a
+    # single value
+    assert serve_vals == legacy_vals, \
+        "serve plane diverged from legacy read values"
+
+    reads_per_round = N_READERS * HOT_KEYS
+    serve_reads = rounds * reads_per_round
+    legacy_reads = rounds * reads_per_round
+    serve_dpr = serve_stampede["dispatches"] / serve_reads
+    legacy_dpr = legacy_stampede["dispatches"] / max(legacy_reads, 1)
+    waiters_per_dispatch = (
+        serve_stampede["waiters"] / serve_stampede["groups"]
+        if serve_stampede["groups"] else 0.0)
+    steady_total = serve_steady["hits"] + serve_steady["misses"]
+    hit_pct = 100.0 * serve_steady["hits"] / max(steady_total, 1)
+    legacy_steady_total = (legacy_steady["hits"]
+                           + legacy_steady["misses"])
+    legacy_hit_pct = (100.0 * legacy_steady["hits"]
+                      / max(legacy_steady_total, 1))
+    return {
+        "rounds": rounds,
+        "serve_dispatches": serve_stampede["dispatches"],
+        "legacy_dispatches": legacy_stampede["dispatches"],
+        "serve_dispatches_per_read": round(serve_dpr, 4),
+        "legacy_dispatches_per_read": round(legacy_dpr, 4),
+        "dispatch_amortization_x": round(
+            legacy_dpr / serve_dpr, 2) if serve_dpr else float("inf"),
+        "waiters_per_dispatch": round(waiters_per_dispatch, 2),
+        "hit_pct": round(hit_pct, 2),
+        "legacy_hit_pct": round(legacy_hit_pct, 2),
+    }
+
+
+def main():
+    quick, _jax = setup()
+    rounds = 12 if quick else 40
+    s = summary(rounds)
+    # the ISSUE acceptance bar: >= 4x fewer read dispatches per served
+    # key than the per-txn legacy leg under the 8-reader stream
+    assert s["legacy_dispatches_per_read"] \
+        >= 4 * s["serve_dispatches_per_read"], (
+        "read serve plane under-amortized: "
+        f"{s['legacy_dispatches_per_read']} legacy vs "
+        f"{s['serve_dispatches_per_read']} serve dispatches/read")
+    emit("read_waiters_per_dispatch", s["waiters_per_dispatch"],
+         "waiters/dispatch", s["dispatch_amortization_x"],
+         serve_dispatches=s["serve_dispatches"],
+         legacy_dispatches=s["legacy_dispatches"],
+         serve_dispatches_per_read=s["serve_dispatches_per_read"],
+         legacy_dispatches_per_read=s["legacy_dispatches_per_read"],
+         rounds=s["rounds"], readers=N_READERS, hot_keys=HOT_KEYS)
+    emit("read_cache_hit_pct", s["hit_pct"], "hit pct",
+         round(s["hit_pct"] / max(s["legacy_hit_pct"], 1e-9), 3),
+         legacy_hit_pct=s["legacy_hit_pct"],
+         rounds=s["rounds"], readers=N_READERS, hot_keys=HOT_KEYS)
+
+
+if __name__ == "__main__":
+    main()

@@ -365,6 +365,131 @@ class TestNativeHub:
             bus.close()
 
 
+class TestPythonFanOutPool:
+    """ISSUE 8 satellite: the Python-mode pub fan-out no longer sends
+    serially — each subscriber has its own bounded send worker, so one
+    slow peer cannot stall the stream or the publisher."""
+
+    def _register(self, bus):
+        from antidote_tpu.interdc.wire import DcDescriptor
+
+        return bus.register(
+            DcDescriptor(dc_id="pydc", n_partitions=1,
+                         pub_addrs=(), logreader_addrs=()),
+            lambda *_a: None)
+
+    def _subscribe(self, bus, name):
+        from antidote_tpu.interdc import termcodec
+
+        (pub_addr,), _ = bus.local_addrs()
+        sub = socket.create_connection(tuple(pub_addr), timeout=5)
+        hello = termcodec.encode(name)
+        sub.sendall(len(hello).to_bytes(4, "big") + hello)
+        time.sleep(0.1)
+        return sub
+
+    def _recv_frames(self, sub, n):
+        sub.settimeout(10)
+        out = []
+        for _ in range(n):
+            hdr = b""
+            while len(hdr) < 4:
+                more = sub.recv(4 - len(hdr))
+                if not more:
+                    return out  # EOF
+                hdr += more
+            want = int.from_bytes(hdr, "big")
+            buf = b""
+            while len(buf) < want:
+                more = sub.recv(want - len(buf))
+                if not more:
+                    return out
+                buf += more
+            out.append(buf)
+        return out
+
+    def test_slow_subscriber_does_not_stall_fast_one(self):
+        import threading
+
+        bus = TcpTransport(native_pub=False, connect_timeout=1.0)
+        try:
+            self._register(bus)
+            fast = self._subscribe(bus, "fast")
+            slow = self._subscribe(bus, "slow")  # never reads
+            assert len(bus._subscribers) == 2
+            n, chunk = 300, b"y" * (64 * 1024)
+            got = []
+            drainer = threading.Thread(
+                target=lambda: got.extend(self._recv_frames(fast, n)),
+                daemon=True)
+            drainer.start()
+            t0 = time.monotonic()
+            for i in range(n):
+                bus.publish("pydc", i.to_bytes(4, "big") + chunk)
+                # ship-plane cadence (frames arrive per batch window,
+                # not in a tight loop): the healthy peer's worker keeps
+                # its bounded queue short while the stalled peer's
+                # fills and drops
+                time.sleep(0.001)
+            publish_wall = time.monotonic() - t0
+            # enqueue-only fan-out: the publisher never blocks behind
+            # the slow peer's full TCP window (~19 MB >> its buffers)
+            assert publish_wall < 5.0, publish_wall
+            drainer.join(timeout=20)
+            # the fast subscriber got EVERY frame, in publish order —
+            # it was never convoyed behind (or desynced by) the slow
+            # peer
+            assert len(got) == n, len(got)
+            assert [int.from_bytes(f[:4], "big") for f in got] \
+                == list(range(n))
+            # the stalled peer is dropped once its bounded queue
+            # overflows / its send times out — never kept frozen
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if len(bus._subscribers) == 1:
+                    break
+                bus.publish("pydc", chunk)
+                time.sleep(0.05)
+            labels = [s.label for s in bus._subscribers]
+            assert labels == ["fast"], labels
+            fast.close()
+            slow.close()
+        finally:
+            bus.close()
+
+    def test_per_peer_send_gauge_set_and_removed(self):
+        from antidote_tpu import stats
+
+        bus = TcpTransport(native_pub=False, connect_timeout=1.0)
+        try:
+            self._register(bus)
+            sub = self._subscribe(bus, "gauged")
+            bus.publish("pydc", b"frame")
+            self._recv_frames(sub, 1)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                v = stats.registry.ship_subscriber_send.value(
+                    peer="gauged")
+                if v is not None:
+                    break
+                time.sleep(0.01)
+            assert v is not None and v >= 0
+            sub.close()
+            # a dead peer's series drops with it (the worker notices
+            # on its next send)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                bus.publish("pydc", b"frame2")
+                if stats.registry.ship_subscriber_send.value(
+                        peer="gauged") is None:
+                    break
+                time.sleep(0.05)
+            assert stats.registry.ship_subscriber_send.value(
+                peer="gauged") is None
+        finally:
+            bus.close()
+
+
 class TestTcpNewTypes:
     """The round's new device-served types over the REAL socket
     transport: effects cross DC boundaries through the safe term codec

@@ -260,6 +260,13 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # regression back to one-frame-per-txn fails the gate
     ("txn/frame", 1), ("txns/frame", 1),
     ("wire B/txn", -1), ("frames/txn", -1),
+    # read serve family (ISSUE 8): waiters per drain fold and the
+    # cache hit ratio must not fall, fold dispatches per served read
+    # must not rise — a regression back to one fold per reader fails
+    # the gate.  Note "hit pct" is up while the plain "pct" overhead
+    # unit stays down.
+    ("waiters/dispatch", 1), ("hit pct", 1),
+    ("dispatches/read", -1), ("pct", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect

@@ -200,3 +200,33 @@ def test_decode_rule_flags_untraced_decode_sites(tmp_path):
 
 def test_decode_rule_clean_on_repo():
     assert trace_lint.lint_decode_instants(trace_lint.repo_root()) == []
+
+
+def test_fused_rule_flags_untraced_fused_read_sites(tmp_path):
+    """ISSUE 8 rule: a function under mat/ dispatching a gathered
+    fused_read fold without a span/instant is a dark serve-stage
+    kernel; instrumented callers and the definition itself pass."""
+    d = tmp_path / "antidote_tpu" / "mat"
+    d.mkdir(parents=True)
+    (d / "newserve.py").write_text(
+        "from antidote_tpu.obs.spans import tracer\n"
+        "from antidote_tpu.mat.device_plane import fused_read\n"
+        "class S:\n"
+        "    def dark_drain(self, splits):\n"
+        "        return fused_read(splits)\n"
+        "    def dark_attr(self, dp, splits):\n"
+        "        return dp.fused_read(splits)\n"
+        "    def good_drain(self, splits):\n"
+        "        with tracer.span('read_serve_fold', 'device'):\n"
+        "            return fused_read(splits)\n"
+        "    def unrelated(self, x):\n"
+        "        return x\n"
+        "def fused_read(splits):\n"
+        "    return splits\n")
+    problems = trace_lint.lint_fused_spans(str(tmp_path))
+    flagged = sorted(p.split("::")[1].split(":")[0] for p in problems)
+    assert flagged == ["dark_attr", "dark_drain"]
+
+
+def test_fused_rule_clean_on_repo():
+    assert trace_lint.lint_fused_spans(trace_lint.repo_root()) == []

@@ -53,7 +53,12 @@ _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 #: the shipping plane's wire amortization (ISSUE 6): txns per
 #: published batch frame sliding toward 1 means the wire has regressed
 #: to one frame per txn.
-_HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch", "/frame")
+#: "hit pct" is the read serve plane's cache-hit ratio (ISSUE 8): a
+#: falling hit percentage means repeat reads of stable keys stopped
+#: skipping the device — unlike the plain "pct" overhead unit below,
+#: bigger is better here.
+_HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch", "/frame",
+                           "hit pct")
 #: units whose value should not RISE (smaller is better).  The
 #: "*/txn" per-admitted-cost units (H2D bytes per txn, dispatches per
 #: txn, and ISSUE 6's encoded wire bytes per shipped txn) are the
@@ -67,7 +72,11 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  "b/txn", "bytes/txn", "dispatches/txn",
                  "b/op", "bytes/op", "dispatches/op",
                  "frames/txn", "wire b/txn",
-                 "us/txn", "pct"}
+                 "us/txn", "pct",
+                 # read serve plane (ISSUE 8): fold dispatches per
+                 # served key-read sliding UP means the coalescing
+                 # window regressed toward one fold per reader
+                 "dispatches/read"}
 
 
 def repo_root() -> str:

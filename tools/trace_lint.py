@@ -36,6 +36,15 @@ blind spot in every journey it feeds.  The decoder definitions
 themselves (functions *named* frame_from_bin / from_bin) are exempt:
 the rule binds call sites, where arrival happens.
 
+ISSUE 8 adds the fused-read rule: every function under
+antidote_tpu/mat/ that calls ``fused_read`` (the multi-fold one-
+dispatch device program) must carry a span/instant — the read serve
+plane moved these dispatches off the per-transaction call stack, and
+an untraced gathered fold would make the hottest read-path kernel
+invisible to the serve-stage latency panels and sampled txn trees.
+The definition itself (a function *named* fused_read) is exempt; call
+sites are not.
+
 Runs standalone (``python tools/trace_lint.py``) and from tier-1
 (tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
 Purely static (ast), so it needs no JAX and runs in milliseconds.
@@ -109,6 +118,13 @@ _PUBLISH_DIR = os.path.join("antidote_tpu", "interdc")
 _DECODE_NAMES = ("frame_from_bin", "from_bin")
 _DECODE_DIRS = (os.path.join("antidote_tpu", "interdc"),
                 os.path.join("antidote_tpu", "cluster"))
+
+#: gathered-fold call names: a call to one of these under mat/ (bare
+#: or as an attribute) is a serve-side one-dispatch device fold and
+#: must be instrumented (ISSUE 8); definitions are exempt like the
+#: decode rule's
+_FUSED_NAMES = ("fused_read",)
+_FUSED_DIRS = (os.path.join("antidote_tpu", "mat"),)
 
 
 def _is_instrumented(fn: ast.FunctionDef) -> bool:
@@ -331,6 +347,50 @@ def lint_decode_instants(root: str) -> List[str]:
     return problems
 
 
+def _is_fused_call(node: ast.Call) -> bool:
+    """True for ``fused_read(...)`` / ``device_plane.fused_read(...)``
+    — any call whose terminal name is a gathered-fold entry point."""
+    f = node.func
+    name = getattr(f, "attr", getattr(f, "id", None))
+    return name in _FUSED_NAMES
+
+
+def lint_fused_spans(root: str) -> List[str]:
+    """ISSUE 8 rule: every function under antidote_tpu/mat/ that
+    dispatches a gathered ``fused_read`` fold must carry a tracer
+    span/instant — the serve plane's one-dispatch folds are the read
+    path's hottest kernels and must stay on the serve-stage timeline.
+    Functions NAMED like the fold (the device_plane definition) are
+    exempt; call sites are not."""
+    problems: List[str] = []
+    for rel_dir in _FUSED_DIRS:
+        d = os.path.join(root, rel_dir)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(d, fname)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name in _FUSED_NAMES:
+                    continue  # the fold itself, not a dispatch site
+                fuses = any(
+                    isinstance(c, ast.Call) and _is_fused_call(c)
+                    for c in ast.walk(node))
+                if fuses and not _is_instrumented(node):
+                    problems.append(
+                        f"{rel_dir}/{fname}::{node.name}: dispatches "
+                        "a gathered fused_read fold without a tracer "
+                        "span/instant — the serve-stage latency "
+                        "panels go dark (antidote_tpu/obs/spans.py)")
+    return problems
+
+
 def _methods(tree: ast.Module, cls_name: str) -> Dict[str, ast.FunctionDef]:
     for node in tree.body:
         if isinstance(node, ast.ClassDef) and node.name == cls_name:
@@ -367,6 +427,7 @@ def lint(root: str) -> List[str]:
     problems.extend(lint_kernel_spans(root))
     problems.extend(lint_publish_spans(root))
     problems.extend(lint_decode_instants(root))
+    problems.extend(lint_fused_spans(root))
     return problems
 
 
