@@ -19,6 +19,37 @@ class Config:
     txn_prot: str = "clocksi"
     #: fsync the log on commit records (reference sync_log)
     sync_log: bool = False
+    #: group-commit durable-log plane (antidote_tpu/oplog/log.py):
+    #: commit-path appends STAGE framed record bytes per partition log
+    #: and concurrent committers share ONE buffered write + ONE fsync —
+    #: a caller-elected leader drains the window (a solo committer
+    #: syncs immediately), committers release the partition lock before
+    #: waiting on their durability ticket, and the batch write crosses
+    #: into the native backend once per drain (oplog_append_batch).
+    #: False = the exact per-record legacy path (one write + one inline
+    #: fsync per commit record, held across the partition lock — the
+    #: benches' comparison baseline, like mat_ingest / read_serve /
+    #: interdc_ship / gate_device_ring)
+    log_group: bool = True
+    #: group-commit window, µs: a drain leader with company (other
+    #: committers already waiting on durability tickets) holds the
+    #: drain open this long so a burst shares one fsync; a solo
+    #: committer drains immediately (zero added latency on uncontended
+    #: commits).  0 disables the hold — drains still batch whatever
+    #: staged while the previous fsync ran (self-clocking group commit)
+    log_group_us: int = 300
+    #: staged-record budget per log: past it the window closes at once
+    #: and, on the non-synced path, staged records are written through
+    #: (backpressure — staged bytes cannot grow unboundedly when
+    #: sync_on_commit never drains them)
+    log_group_records: int = 512
+    #: staged-BYTE budget per log (the interdc_ship_bytes analogue):
+    #: large-payload workloads write through well before the record
+    #: cap, bounding both the heap a partition log pins and the
+    #: process-crash loss window of the non-synced path (staged bytes
+    #: live in Python memory; written-through bytes reach the page
+    #: cache, which survives a process crash)
+    log_group_bytes: int = 256 * 1024
     #: append records to the durable log at all (reference enable_logging)
     enable_logging: bool = True
     #: rebuild the materializer caches from the log at boot

@@ -215,8 +215,30 @@ class _PlaneBase:
         #: per domain width instead of one per read)
         self._inf_rv = None
         #: set by the owning PartitionManager: evict a key's history to
-        #: the host store (log replay)
-        self.on_evict: Callable[[Any, str], None] = lambda k, t: None
+        #: the host store (log replay; ``state`` carries the pre-purge
+        #: device fold when there is no log to replay — see
+        #: ``evict_export``)
+        self.on_evict: Callable[..., None] = \
+            lambda k, t, state=None: None
+        #: set (via DevicePlane.set_evict_handler) when the owning
+        #: partition has NO durable log: an eviction must materialize
+        #: the key's host state from the device fold BEFORE dropping
+        #: the lanes — replaying the empty log silently zeroed the key
+        #: (the PR-7-flagged bug, reproduced on clean HEAD)
+        self.evict_export = False
+        #: same condition, shared with map sub-planes (which export at
+        #: the MAP level): drives the flush overflow path's emergency
+        #: fold — with no log, dropping an overflowed row is DATA LOSS,
+        #: so the ring folds fully into the base to make room first
+        self.no_log_replay = False
+        #: host-side join of every staged op's commit VC — the honest
+        #: base bound after an emergency full fold (ring ops are all
+        #: published, so their commit VCs are below this join).  Only
+        #: maintained when ``no_log_replay`` (DevicePlane.stage).
+        self._ring_vc_bound = VC()
+        #: re-entrancy guard: the export fold must not recurse through
+        #: a flush back into this key's own eviction
+        self._exporting: set = set()
         self.capacity = key_capacity
         self.st = self._init_state(key_capacity)
         #: background compile kicked on the FIRST staged op for this
@@ -469,6 +491,13 @@ class _PlaneBase:
             out.append((col, int(s)))
         return out
 
+    def _note_staged_vc(self, payload: Payload) -> None:
+        """Track the join of staged commit VCs (unlogged mode only) —
+        the honest base bound the emergency fold raises to."""
+        if self.no_log_replay:
+            self._ring_vc_bound = self._ring_vc_bound.join(
+                payload.commit_vc())
+
     def _commit_rows(self, key, idx: int, rows: List[tuple]) -> None:
         """Stage decoded rows — unless a growth-triggered flush evicted
         the key mid-stage (the migration replayed the log, which already
@@ -574,12 +603,35 @@ class _PlaneBase:
     def owns(self, key) -> bool:
         return key in self.key_index
 
+    def _export_evict_state(self, key):
+        """The key's latest device-fold state, captured BEFORE the
+        purge, when there is no log to replay (``evict_export``);
+        None otherwise.  Best-effort: a failed export falls back to
+        the (empty) log replay rather than wedging the eviction."""
+        if not self.evict_export or key in self._exporting:
+            return None
+        self._exporting.add(key)
+        try:
+            return self.read(key, None)
+        except Exception:  # noqa: BLE001 — export must not break evict
+            log.exception(
+                "evict-state export failed for %r (%s); the key's "
+                "unlogged history cannot migrate to the host store",
+                key, self.type_name)
+            return None
+        finally:
+            self._exporting.discard(key)
+
     def evict(self, key) -> None:
         """Purge the key's device rows and hand its history to the host
-        path (on_evict replays the log into the host store)."""
-        idx = self.key_index.pop(key, None)
+        path (on_evict replays the log into the host store; with no log
+        to replay, the pre-purge device fold travels along — the state
+        the host store is seeded from)."""
+        idx = self.key_index.get(key)
         if idx is None:
             return
+        state = self._export_evict_state(key)
+        self.key_index.pop(key, None)
         self.rows = [r for r in self.rows if r[0] != idx]
         self.pending_keys.discard(key)
         self.rev_keys[idx] = _Evicted
@@ -587,7 +639,7 @@ class _PlaneBase:
         log.debug("device plane: evicted %r (%s)", key, self.type_name)
         recorder.record("device", "evict", plane=self.type_name,
                         key=key)
-        self.on_evict(key, self.type_name)
+        self.on_evict(key, self.type_name, state)
 
     #: set by DevicePlane.stage when async flushing is wired: called
     #: with this plane to run flush/gc on the flusher thread
@@ -709,6 +761,33 @@ class _PlaneBase:
                     # once more at the same horizon (rows above it are
                     # untouched)
                     self._device_gc(gst)
+                if overflow2.any() and self.no_log_replay:
+                    # EMERGENCY fold (unlogged mode): dropping an
+                    # overflowed row here is permanent data loss — no
+                    # log exists to replay it from — so fold the WHOLE
+                    # ring into the base to free lanes and retry once
+                    # more.  Sound: every ring op is published, so the
+                    # host-side join of staged commit VCs bounds them;
+                    # reads below the raised base take the log-replay
+                    # path, which unlogged mode already degrades.
+                    inf = np.full(self.domain.d, _VC_INF,
+                                  dtype=np.int64)
+                    self._device_gc(inf)
+                    self._base_vc = self._base_vc.join(
+                        self._ring_vc_bound)
+                    self._has_base = True
+                    self._ops_since_gc = 0
+                    retry2 = [r for r, o in zip(retry, overflow2) if o]
+                    overflow3 = self._append_rows(retry2)
+                    if overflow3.any():
+                        # structural caps (slots / DC columns): the
+                        # rows are unrepresentable and, unlogged,
+                        # unrecoverable — keep the loss loud
+                        recorder.record(
+                            "device", "evict_lost_rows",
+                            plane=self.type_name,
+                            rows=int(overflow3.sum()))
+                    retry, overflow2 = retry2, overflow3
                 bad_keys = {self.rev_keys[r[0]]
                             for r, o in zip(retry, overflow2) if o}
                 for key in bad_keys:
@@ -1912,13 +1991,32 @@ class MapPlane:
         self._presence = make_presence() if make_presence else None
         if self._presence is not None:
             self._presence.on_evict = \
-                lambda mkey, t: self._sub_evicted(mkey)
+                lambda mkey, t, state=None: self._presence_evicted(
+                    mkey, state)
         #: map_key -> set of key_t ever staged on device.  Doubles as
         #: the plane's key directory (``key_index`` below) so operator
         #: surfaces can treat every plane uniformly.
         self.fields: Dict[Any, set] = {}
         self.pending_keys: set = set()
-        self.on_evict: Callable[[Any, str], None] = lambda k, t: None
+        self.on_evict: Callable[..., None] = \
+            lambda k, t, state=None: None
+        #: unlogged-eviction flags (see _PlaneBase): the MAP exports
+        #: the reassembled state; sub-planes only get the emergency-
+        #: fold behavior (no_log_replay, propagated at creation)
+        self.evict_export = False
+        self.no_log_replay = False
+        self._exporting: set = set()
+        #: set by a mid-decode eviction inside :meth:`stage`: the entry
+        #: subset the export could not cover (see _set_stage_residual)
+        self.stage_residual = None
+        #: (key_t, state) of the sub whose eviction triggered ours —
+        #: that sub's rows purged before our export ran (see
+        #: _sub_evicted)
+        self._evict_overlay = None
+        #: (mkey, visible-set) when the PRESENCE plane's eviction
+        #: triggered ours — its pre-purge fold replaces the export's
+        #: visibility filter (see _presence_evicted)
+        self._presence_vis_override = None
         self._evicting = None
         self._warm_kicked = False
 
@@ -1968,43 +2066,108 @@ class MapPlane:
         sub = self._subs.get(ntype)
         if sub is None:
             sub = self._make_sub(ntype)
-            sub.on_evict = lambda skey, t: self._sub_evicted(skey[0])
+            sub.on_evict = \
+                lambda skey, t, state=None: self._sub_evicted(
+                    skey, state)
+            sub.no_log_replay = self.no_log_replay
+            sub.evict_export = self.evict_export
             self._subs[ntype] = sub
         return sub
 
-    def _sub_evicted(self, mkey) -> None:
+    def _presence_evicted(self, mkey, state=None) -> None:
         if self._evicting == mkey:
             return  # our own purge loop
-        self.evict(mkey)
+        # the presence plane purged its rows BEFORE this map-level
+        # eviction can export, so the export's visibility filter would
+        # see an empty set and seed the host with {} (the zeroing bug,
+        # presence flavor): its own pre-purge export — the visibility
+        # SET — rides along and replaces the filter (unlogged mode)
+        self._presence_vis_override = (mkey, state) \
+            if state is not None else None
+        try:
+            self.evict(mkey)
+        finally:
+            self._presence_vis_override = None
+
+    def _sub_evicted(self, skey, state=None) -> None:
+        mkey, key_t = skey
+        if self._evicting == mkey:
+            return  # our own purge loop
+        # the triggering sub purged its rows BEFORE this map-level
+        # eviction can export — its own pre-purge export (``state``)
+        # is the only copy of that field's history; overlay it onto
+        # the map export (unlogged mode)
+        self._evict_overlay = (key_t, state) \
+            if key_t is not None and state is not None else None
+        try:
+            self.evict(mkey)
+        finally:
+            self._evict_overlay = None
 
     # -- write path ---------------------------------------------------------
 
+    def _note_staged_vc(self, payload: Payload) -> None:
+        """Top-level no-op (sub-planes track their own bounds at
+        :meth:`stage`, where the nested payloads are built)."""
+
     def stage(self, key, payload: Payload) -> None:
         """Decode one committed map effect into sub-plane stages; evicts
-        the whole map on any nested capacity miss."""
+        the whole map on any nested capacity miss.
+
+        ``stage_residual`` (consumed by DevicePlane.stage in unlogged
+        mode): when the eviction fires MID-decode, some of this op's
+        sub-entries were already staged and may be VISIBLE in the
+        eviction's exported state (map_rr: every staged field; map_go:
+        only fields that existed before this op — a new field's
+        presence rows stage last and were dropped) — re-applying the
+        FULL effect onto the seed would double-apply those.  The
+        residual is the entry subset the export could not have
+        covered."""
         _kind, entries = payload.effect
+        pre_fields = set(self.fields.get(key, ()))
         # register the key BEFORE any reject so evict() always runs the
         # migration (the op is already in the log, like _PlaneBase.stage)
         self.fields.setdefault(key, set())
+        self.stage_residual = None
         if any(kt[1] not in self.SUPPORTED for kt, _ in entries):
             self.evict(key)           # nested map / counter_fat / b
+            self.stage_residual = payload.effect  # nothing staged
             return
         staged = []
         for key_t, neff in entries:
             sub = self._sub(key_t[1])
             skey = (key, key_t)
-            sub.stage(skey, dc_replace(
-                payload, key=skey, type_name=key_t[1], effect=neff))
+            sub_payload = dc_replace(
+                payload, key=skey, type_name=key_t[1], effect=neff)
+            sub._note_staged_vc(sub_payload)
+            sub.stage(skey, sub_payload)
             if key not in self.fields:
-                return                # a sub capacity miss evicted us
+                # a sub capacity miss evicted us mid-decode
+                self._set_stage_residual(_kind, entries, staged,
+                                         pre_fields)
+                return
             self.fields[key].add(key_t)
             staged.append(key_t)
         if self._presence is not None and staged:
-            self._presence.stage(key, dc_replace(
-                payload, type_name="set_go", effect=tuple(staged)))
+            pres_payload = dc_replace(
+                payload, type_name="set_go", effect=tuple(staged))
+            self._presence._note_staged_vc(pres_payload)
+            self._presence.stage(key, pres_payload)
             if key not in self.fields:
+                self._set_stage_residual(_kind, entries, staged,
+                                         pre_fields)
                 return
         self.pending_keys.add(key)
+
+    def _set_stage_residual(self, kind, entries, staged,
+                            pre_fields) -> None:
+        """Entries of the current effect the mid-decode eviction's
+        export could NOT include: everything except fields both staged
+        AND visible at export time (see :meth:`stage`)."""
+        visible = set(staged) & pre_fields \
+            if self._presence is not None else set(staged)
+        residual = tuple(e for e in entries if e[0] not in visible)
+        self.stage_residual = (kind, residual) if residual else None
 
     _schedule = None
 
@@ -2024,24 +2187,77 @@ class MapPlane:
         for p in self._all_planes():
             p.gc(stable_vc)
 
+    def _export_evict_state(self, key):
+        """The reassembled map state, captured BEFORE the sub purges,
+        when there is no log to replay (see _PlaneBase).  A sub whose
+        own eviction triggered ours already purged its rows — its
+        pre-purge export rides in ``_evict_overlay`` and replaces that
+        field here."""
+        if not self.evict_export or key in self._exporting:
+            return None
+        self._exporting.add(key)
+        try:
+            if self._presence_vis_override is not None \
+                    and self._presence_vis_override[0] == key:
+                # the presence plane already purged: the normal read
+                # would filter every field against an empty visibility
+                # set — assemble from the (intact) sub planes and the
+                # presence's own pre-purge fold instead
+                vis = self._presence_vis_override[1] or frozenset()
+                state = {}
+                for key_t in self.fields.get(key, ()):
+                    if key_t not in vis:
+                        continue
+                    sub = self._subs.get(key_t[1])
+                    if sub is not None:
+                        state[key_t] = sub.read((key, key_t), None)
+            else:
+                state = self.read(key, None)
+        except Exception:  # noqa: BLE001 — export must not break evict
+            log.exception(
+                "map evict-state export failed for %r (%s)",
+                key, self.type_name)
+            return None
+        finally:
+            self._exporting.discard(key)
+        if self._evict_overlay is not None and isinstance(state, dict):
+            key_t, sub_state = self._evict_overlay
+            state = dict(state)
+            state[key_t] = sub_state
+        return state
+
     def evict(self, key) -> None:
         """Purge every synthetic key of the map and hand its history to
-        the host path (on_evict replays the map's log records)."""
+        the host path (on_evict replays the map's log records; with no
+        log, the pre-purge reassembled state travels along)."""
         if key not in self.fields:
             return
+        state = self._export_evict_state(key)
         self._evicting = key
         try:
-            for key_t in self.fields.pop(key):
+            for key_t in self.fields.pop(key, ()):
                 sub = self._subs.get(key_t[1])
                 if sub is not None:
-                    sub.evict((key, key_t))
+                    # our own purge: the map already exported; a per-
+                    # field export here would be O(fields) wasted folds
+                    prev = sub.evict_export
+                    sub.evict_export = False
+                    try:
+                        sub.evict((key, key_t))
+                    finally:
+                        sub.evict_export = prev
             if self._presence is not None:
-                self._presence.evict(key)
+                prev = self._presence.evict_export
+                self._presence.evict_export = False  # see sub note
+                try:
+                    self._presence.evict(key)
+                finally:
+                    self._presence.evict_export = prev
         finally:
             self._evicting = None
         self.pending_keys.discard(key)
         log.debug("device plane: evicted %r (%s)", key, self.type_name)
-        self.on_evict(key, self.type_name)
+        self.on_evict(key, self.type_name, state)
 
     # -- read path ----------------------------------------------------------
 
@@ -2182,6 +2398,9 @@ class DevicePlane:
         self.flush_scheduler = None
         #: keys evicted to the host path (sticky)
         self.host_only: set = set()
+        #: no-log-to-replay mode (set by set_evict_handler): evictions
+        #: export state and decode-reject ops bounce back to the caller
+        self._evict_export = False
         #: types whose dense representation collapses dot sets per DC —
         #: only sound under write-write certification (module doc).
         #: counter_pn and set_go mint no dots and are exempt.
@@ -2257,12 +2476,33 @@ class DevicePlane:
         for plane in self.planes.values():
             _place(plane)
 
-    def set_evict_handler(self, fn: Callable[[Any, str], None]) -> None:
-        def handler(key, type_name):
+    def set_evict_handler(self, fn: Callable[..., None],
+                          export_state: bool = False) -> None:
+        """Wire the eviction migration.  ``export_state=True`` marks a
+        partition with NO durable log: evictions then materialize the
+        key's state from the device fold before purging (the handler
+        receives it as ``state``) instead of replaying an empty log —
+        the PR-7-flagged silent-zeroing fix."""
+        def handler(key, type_name, state=None):
             self.host_only.add(key)
-            fn(key, type_name)
+            fn(key, type_name, state)
+        self._evict_export = export_state
         for p in self.planes.values():
             p.on_evict = handler
+            p.evict_export = export_state
+            p.no_log_replay = export_state
+            if isinstance(p, MapPlane):
+                for s in p._all_planes():
+                    s.no_log_replay = export_state
+                # subs export too: a sub-triggered map eviction purges
+                # the sub BEFORE the map-level export, and the sub's
+                # own pre-purge export is that field's only copy; the
+                # presence plane likewise (its fold IS the visibility
+                # set the map export filters by)
+                for s in p._subs.values():
+                    s.evict_export = export_state
+                if p._presence is not None:
+                    p._presence.evict_export = export_state
 
     def accepts(self, type_name: str, key) -> bool:
         return type_name in self.planes and key not in self.host_only
@@ -2272,7 +2512,16 @@ class DevicePlane:
         return p is not None and p.owns(key)
 
     def stage(self, key, type_name: str, payload: Payload,
-              stable_vc: Optional[VC]) -> None:
+              stable_vc: Optional[VC]):
+        """Route one committed effect to its type plane.  Returns the
+        BOUNCE effect (or None) when the key was evicted DURING the
+        decode (unlogged mode only): the bounced part never landed on
+        the device and the eviction's exported state predates it, so
+        the caller must land it on the host path itself — with a log
+        it would be replayed from there (PartitionManager._publish).
+        For maps the bounce is the residual entry subset the export
+        could not cover (MapPlane.stage_residual); for flat planes it
+        is the whole effect."""
         p = self.planes[type_name]
         if not p._warm_kicked:
             p.kick_warm()
@@ -2291,8 +2540,15 @@ class DevicePlane:
         recorder.record("device_stage", "stage", plane=type_name,
                         key=key, txid=payload.txid,
                         commit_time=payload.commit_time)
+        p._note_staged_vc(payload)
         p.stage(key, payload)
+        evicted_mid_decode = not p.owns(key)
         p.maybe_flush_gc(stable_vc)
+        if not (self._evict_export and evicted_mid_decode):
+            return None
+        if isinstance(p, MapPlane):
+            return p.stage_residual
+        return payload.effect
 
     def read(self, key, type_name: str, read_vc: Optional[VC],
              txid=None):

@@ -29,6 +29,7 @@ from antidote_tpu.mat.materializer import (
     Payload,
     SnapshotGetResponse,
     materialize,
+    materialize_eager,
     materialize_from_log,
 )
 
@@ -69,6 +70,50 @@ class HostStore:
 
     def entry_count(self) -> int:
         return len(self._data)
+
+    def seed_state(self, key, type_name: str, state,
+                   vc: Optional[VC] = None) -> None:
+        """Install a key whose ONLY content is a materialized snapshot
+        — the unlogged-eviction migration path (ISSUE 9 satellite): a
+        device plane dropping a key with no durable log to replay
+        hands its pre-purge fold state here instead of zeroing the
+        key.  Reads at clocks covering ``vc`` (the key's commit
+        frontier at eviction) serve the state, and later inserts apply
+        on top; reads strictly below it have no history to replay
+        anywhere — they take the pruned->log path, which is empty by
+        construction in unlogged mode."""
+        e = self._data.get(key)
+        if e is None:
+            e = self._data[key] = _KeyEntry(key, type_name)
+        elif e.type_name != type_name:
+            raise ValueError(
+                f"type mismatch for {key!r}: {e.type_name} vs {type_name}")
+        snap = MaterializedSnapshot(last_op_id=e.next_seq, value=state)
+        # an empty VC is <= every read clock, so a frontier-less seed
+        # (key evicted before any publish — not reachable in practice)
+        # still serves rather than vanishing behind _best_snapshot's
+        # None-vc skip
+        e.snapshots.insert(0, (vc if vc is not None else VC(), snap))
+        e.pruned = True
+
+    def apply_to_seed(self, key, type_name: str, effect) -> bool:
+        """Apply one committed effect directly ONTO the newest seeded
+        snapshot (the unlogged decode-reject bounce): the seed's VC
+        already covers the op's commit entry — the key's frontier was
+        joined before the device stage that rejected it — so inserting
+        it as an ordinary op would be skipped by the replay as
+        already-in-base.  Effects commute and the seed is the newest
+        state, so folding it in is exact.  False when the key has no
+        seeded snapshot (export failed): the caller inserts the op
+        normally instead."""
+        e = self._data.get(key)
+        if e is None or not e.snapshots or e.type_name != type_name:
+            return False
+        vc, snap = e.snapshots[0]
+        e.snapshots[0] = (vc, MaterializedSnapshot(
+            snap.last_op_id,
+            materialize_eager(type_name, snap.value, [effect])))
+        return True
 
     def insert(self, key, type_name: str, payload: Payload,
                stable_vc: Optional[VC] = None) -> None:

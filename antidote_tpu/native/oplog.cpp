@@ -117,6 +117,38 @@ int64_t oplog_append(void* h, const uint8_t* data, int64_t len) {
     return off;
 }
 
+// Append n records with ONE call and ONE buffered write (the group-
+// commit drain's crossing): `data` is the records' payloads
+// concatenated, `lens` their lengths.  Each record gets the standard
+// [len][crc] frame, so the on-disk bytes are identical to n
+// oplog_append calls.  Returns the FIRST record's offset, or -1.
+int64_t oplog_append_batch(void* h, const uint8_t* data,
+                           const int64_t* lens, int64_t n) {
+    OpLog* log = static_cast<OpLog*>(h);
+    int64_t total = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if (lens[i] <= 0) return -1;
+        total += (int64_t)kHeader + lens[i];
+    }
+    if (total == 0) return log->end;
+    std::string buf;
+    buf.reserve((size_t)total);
+    const uint8_t* p = data;
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t len32 = (uint32_t)lens[i];
+        uint32_t crc = crc32(p, (size_t)lens[i]);
+        buf.append(reinterpret_cast<const char*>(&len32), 4);
+        buf.append(reinterpret_cast<const char*>(&crc), 4);
+        buf.append(reinterpret_cast<const char*>(p), (size_t)lens[i]);
+        p += lens[i];
+    }
+    if (fwrite(buf.data(), 1, buf.size(), log->wf) != buf.size())
+        return -1;
+    int64_t off = log->end;
+    log->end += total;
+    return off;
+}
+
 int oplog_flush(void* h) {
     OpLog* log = static_cast<OpLog*>(h);
     return fflush(log->wf) == 0 ? 0 : -1;

@@ -17,7 +17,10 @@ aggregates, in one JSON document per registered DataCenter:
   toward the next packed flush, per type plane, with the oldest-row
   age (mat/device_plane.py staging for mat/ingest.py);
 - **stable**: the published stable snapshot and each partition's
-  safe-time vector (the quantity the VIS_* safe-time-lag gauges age).
+  safe-time vector (the quantity the VIS_* safe-time-lag gauges age);
+- **log**: each partition's durable-log group-commit state — staged
+  records/bytes, oldest staged age, written vs synced watermarks, and
+  the drain counters (oplog/log.py queue_stats, ISSUE 9).
 
 Served at ``GET /debug/pipeline`` by the metrics server (stats.py),
 embedded in causal-probe violation dumps (obs/probe.py), and attached
@@ -128,6 +131,18 @@ def _ingest_section(dc) -> Dict[str, Any]:
     return out
 
 
+def _log_section(dc) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    node = getattr(dc, "node", None)
+    for p, pm in enumerate(getattr(node, "partitions", [])):
+        plog = getattr(pm, "log", None)
+        stats_fn = getattr(plog, "log_stats", None)
+        if stats_fn is None:
+            continue  # remote member slice: not this process's log
+        out[str(p)] = stats_fn()
+    return out
+
+
 def _stable_section(dc) -> Dict[str, Any]:
     stable = getattr(dc, "stable", None)
     if stable is None:
@@ -150,6 +165,7 @@ def dc_snapshot(dc) -> Dict[str, Any]:
         "sub_bufs": _section(lambda: _sub_buf_section(dc)),
         "gates": _section(lambda: _gate_section(dc)),
         "ingest": _section(lambda: _ingest_section(dc)),
+        "log": _section(lambda: _log_section(dc)),
         "stable": _section(lambda: _stable_section(dc)),
         "connected_dcs": _section(
             lambda: [str(d) for d in getattr(dc, "connected_dcs", [])]),

@@ -9,6 +9,29 @@ The record store is byte-payload framing only; record semantics live in
 Backend: ctypes over antidote_tpu/native/oplog.cpp (built on demand); a
 pure-Python fallback with identical behavior exists for environments
 without a compiler and for differential testing.
+
+ISSUE 9 adds the **group-commit plane**: with :class:`GroupSettings`
+enabled, appends STAGE framed record bytes (offsets assigned
+immediately — staging preserves append order, so the logical offset IS
+the final file offset), and durability is ticket-based: a committer
+takes ``ticket = end_offset()`` after its commit record stages,
+releases its partition lock, and calls :meth:`wait_durable`.  The
+first waiter with no drain in flight leads: it may hold the window
+open (``group_us``, only while OTHER committers are waiting — a solo
+committer drains immediately, so uncontended commits pay zero added
+latency), then writes every staged record through the backend in ONE
+batch append (``oplog_append_batch`` — one ctypes crossing, one
+buffered write) and runs ONE fsync outside the handle lock; the synced
+watermark then covers every waiter staged before the write.  The
+on-disk format is byte-identical to the per-record legacy path
+(asserted by the crash-recovery differential tests), and
+``GroupSettings.enabled=False`` keeps the legacy write path exactly.
+
+The fsync itself runs OUTSIDE the handle lock via a refcounted close
+guard (the deliberately-deferred item of the round-2 sync design):
+``close()`` waits for in-flight backend IO instead of freeing the
+handle under a waiting fsync, so handoff byte-reads and migration
+scans no longer stall behind disk.
 """
 
 from __future__ import annotations
@@ -17,12 +40,50 @@ import ctypes
 import os
 import struct
 import threading
+import time
 import zlib
-from typing import Iterator, Optional, Tuple
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
 
+from antidote_tpu import stats
 from antidote_tpu.native.build import ensure_built
+from antidote_tpu.obs.spans import tracer
 
 _HEADER = struct.Struct("<II")  # len, crc32
+
+
+@dataclass(frozen=True)
+class GroupSettings:
+    """The group-commit plane's knobs — built from Config by
+    :func:`log_group_from_config` (the single factory) so every
+    assembly honors the same values (the gate_from_config lesson)."""
+
+    #: staged batch appends + ticket-based durability; False = the
+    #: exact per-record legacy path (the benches' comparison baseline)
+    enabled: bool = True
+    #: window, µs: a drain leader with company holds the fsync open
+    #: this long; a solo committer drains immediately
+    group_us: int = 300
+    #: staged-record budget: past it the window closes at once and the
+    #: non-synced path writes staged records through (backpressure)
+    group_records: int = 512
+    #: staged-byte budget: bounds the heap a log pins and the process-
+    #: crash loss window on the non-synced path (written-through bytes
+    #: reach the page cache, which survives a process crash)
+    group_bytes: int = 256 * 1024
+
+
+def log_group_from_config(config) -> GroupSettings:
+    """The one construction path for group-commit settings — Node's
+    partition factory routes through this, so single-node and cluster
+    assemblies cannot silently honor different knobs."""
+    if config is None:
+        return GroupSettings()
+    return GroupSettings(
+        enabled=config.log_group,
+        group_us=config.log_group_us,
+        group_records=config.log_group_records,
+        group_bytes=config.log_group_bytes)
 
 
 class _NativeBackend:
@@ -46,6 +107,10 @@ class _NativeBackend:
         lib.oplog_append.restype = ctypes.c_int64
         lib.oplog_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_int64]
+        lib.oplog_append_batch.restype = ctypes.c_int64
+        lib.oplog_append_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
         lib.oplog_flush.argtypes = [ctypes.c_void_p]
         lib.oplog_sync.argtypes = [ctypes.c_void_p]
         lib.oplog_recover.restype = ctypes.c_int64
@@ -65,7 +130,8 @@ class _NativeBackend:
 class DurableLog:
     """One append-only log file with CRC-framed records."""
 
-    def __init__(self, path: str, backend: str = "auto"):
+    def __init__(self, path: str, backend: str = "auto",
+                 group: Optional[GroupSettings] = None):
         self.path = path
         self._native = None
         self._py = None
@@ -74,8 +140,13 @@ class DurableLog:
         #: delivery thread, and calling into the C backend with a freed
         #: handle is a segfault, not an exception (caught live by
         #: tests/cluster/test_causal_federation.py restart chaos).  A
-        #: closed log raises OSError from append/read instead.
-        self._lock = threading.Lock()
+        #: closed log raises OSError from append/read instead.  A
+        #: Condition (not a bare Lock) so durability waiters and the
+        #: refcounted close guard can block on it.
+        self._lock = threading.Condition()
+        #: out-of-lock backend IO in flight (fsync): close() waits for
+        #: this to reach zero before freeing the handle
+        self._io_refs = 0
         lib = _NativeBackend.load() if backend in ("auto", "native") else None
         if lib is not None:
             h = lib.oplog_open(path.encode(), 1)
@@ -87,18 +158,78 @@ class DurableLog:
             raise RuntimeError("native oplog backend unavailable")
         else:
             self._py = _PyLog(path)
+        # ---- group-commit state (ISSUE 9); inert when _group is None
+        self._group = group if (group is not None and group.enabled) \
+            else None
+        end = self._backend_end_locked()
+        #: staged framed-record payloads, stage order == file order
+        self._staged: List[bytes] = []
+        self._staged_bytes = 0
+        #: logical end: written bytes + staged bytes (offset source)
+        self._logical_end = end
+        #: bytes written through the backend (buffered, not yet synced)
+        self._written_end = end
+        #: bytes covered by an fsync — the durability watermark tickets
+        #: compare against
+        self._synced_end = end
+        self._written_records = 0
+        self._synced_records = 0
+        #: per-instance drain accounting (the bench reads these so a
+        #: legacy leg in the same process cannot pollute the ratios)
+        self.fsyncs = 0
+        self.drained_records = 0
+        self.held_drains = 0
+        self._syncing = False
+        self._sync_waiters = 0
+        #: monotonic stamp of the first staged record since the last
+        #: drain (the group window opens here, the serve-plane recipe)
+        self._window_open: Optional[float] = None
 
     @property
     def backend_name(self) -> str:
         return "native" if self._native else "python"
 
+    @property
+    def group_active(self) -> bool:
+        return self._group is not None
+
+    def _backend_end_locked(self) -> int:
+        if self._native:
+            return self._native[0].oplog_end_offset(self._native[1])
+        if self._py is not None:
+            return self._py.end
+        raise OSError(f"log {self.path} is closed")
+
+    # ------------------------------------------------------------- append
+
     def append(self, payload: bytes) -> int:
-        """Buffered append; returns the record's offset."""
+        """Buffered append; returns the record's offset.  Group mode
+        stages the framed payload (one batch write per drain) — the
+        offset is assigned now and is exact: staging preserves order
+        and every backend write funnels through the staged queue."""
         if not payload:
             # recovery treats a zero-length frame as a torn tail; storing
             # one would truncate every later record on restart
             raise ValueError("empty log records are not allowed")
         with self._lock:
+            if self._group is not None:
+                if self._native is None and self._py is None:
+                    raise OSError(f"log {self.path} is closed")
+                off = self._logical_end
+                self._staged.append(payload)
+                self._staged_bytes += len(payload)
+                self._logical_end += _HEADER.size + len(payload)
+                if self._window_open is None:
+                    self._window_open = time.monotonic()
+                stats.registry.log_staged_records.inc()
+                if (len(self._staged) >= self._group.group_records
+                        or self._staged_bytes
+                        >= self._group.group_bytes):
+                    # backpressure: the non-synced path (updates under
+                    # sync_on_commit=False) must not grow the staged
+                    # queue unboundedly — write through (no fsync)
+                    self._write_staged_locked()
+                return off
             if self._native:
                 lib, h = self._native
                 off = lib.oplog_append(h, payload, len(payload))
@@ -109,8 +240,223 @@ class DurableLog:
                 raise OSError(f"log {self.path} is closed")
             return self._py.append(payload)
 
+    def append_batch(self, payloads: List[bytes]) -> int:
+        """Append many records with ONE backend crossing and one
+        buffered write; returns the first record's offset.  The drain
+        path funnels through here; callers with a batch in hand (log
+        replication replay, the resize fold) may use it directly."""
+        for p in payloads:
+            if not p:
+                raise ValueError("empty log records are not allowed")
+        with self._lock:
+            if self._group is not None:
+                if self._native is None and self._py is None:
+                    raise OSError(f"log {self.path} is closed")
+                off = self._logical_end
+                self._staged.extend(payloads)
+                self._staged_bytes += sum(len(p) for p in payloads)
+                self._logical_end += sum(
+                    _HEADER.size + len(p) for p in payloads)
+                if self._window_open is None:
+                    self._window_open = time.monotonic()
+                stats.registry.log_staged_records.inc(len(payloads))
+                if (len(self._staged) >= self._group.group_records
+                        or self._staged_bytes
+                        >= self._group.group_bytes):
+                    self._write_staged_locked()
+                return off
+            return self._append_batch_backend_locked(payloads)
+
+    def _append_batch_backend_locked(self, payloads: List[bytes]) -> int:
+        """One backend batch write; must run under self._lock."""
+        if self._native:
+            lib, h = self._native
+            n = len(payloads)
+            data = b"".join(payloads)
+            lens = (ctypes.c_int64 * n)(*(len(p) for p in payloads))
+            off = lib.oplog_append_batch(h, data, lens, n)
+            if off < 0:
+                raise OSError("batch append failed")
+            return off
+        if self._py is None:
+            raise OSError(f"log {self.path} is closed")
+        return self._py.append_batch(payloads)
+
+    def _write_staged_locked(self) -> None:
+        """Write every staged record through the backend (ONE batch
+        append — buffered, not yet synced).  Must run under
+        self._lock; preserves stage order so assigned offsets hold.
+
+        The staged queue is cleared only AFTER the backend accepted
+        the batch: a failed write (disk full, closed handle) must keep
+        the records staged — dropping them while ``_logical_end``
+        still counts their bytes would shift every later offset off
+        the real file, poisoning the op-id index and ``read()``."""
+        if not self._staged:
+            return
+        self._append_batch_backend_locked(self._staged)  # may raise
+        n = len(self._staged)
+        self._staged = []
+        self._staged_bytes = 0
+        self._window_open = None
+        self._written_end = self._logical_end  # all staged written
+        self._written_records += n
+        stats.registry.log_staged_records.dec(n)
+
+    # ----------------------------------------------------- durability plane
+
+    def durability_ticket(self) -> int:
+        """The logical end offset — everything appended so far is
+        durable once the synced watermark reaches it."""
+        with self._lock:
+            return self._logical_end
+
+    def wait_durable(self, ticket: int, timeout: float = 30.0) -> dict:
+        """Block until the synced watermark covers ``ticket``; the
+        caller MUST NOT hold its partition lock (that is the point:
+        commit-path fsyncs no longer serialize the partition).
+
+        Group commit by caller election: a waiter that finds no drain
+        in flight leads — holds the window open (``group_us``) only
+        while OTHER committers are waiting, writes the whole staged
+        queue as one batch and fsyncs once; everyone whose ticket the
+        new watermark covers returns.  Returns ``{led, records}`` for
+        the caller's instrumentation."""
+        if self._group is None:
+            return {"led": False, "records": 0}
+        deadline = time.monotonic() + timeout
+        info = {"led": False, "records": 0}
+        while True:
+            lead = False
+            with self._lock:
+                self._sync_waiters += 1
+                try:
+                    while self._synced_end < ticket and self._syncing:
+                        if self._native is None and self._py is None:
+                            raise OSError(
+                                f"log {self.path} closed during a "
+                                "durability wait")
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError(
+                                "durability ticket never covered "
+                                "(drain leader wedged?)")
+                        self._lock.wait(min(remaining, 0.1))
+                    if self._synced_end >= ticket:
+                        return info
+                    # coverage checked FIRST, deadline second: a
+                    # leader whose own slow-but-successful fsync
+                    # overran the timeout must ack, not raise for a
+                    # txn that is already durable.  The check still
+                    # bounds a leader whose drains never cover the
+                    # ticket (wedged accounting) — no hot re-election
+                    # loop.
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "durability ticket never covered (drain "
+                            "leader wedged?)")
+                    self._syncing = True
+                    lead = True
+                finally:
+                    self._sync_waiters -= 1
+            if lead:
+                try:
+                    info["led"] = True
+                    info["records"] = self._lead_drain()
+                finally:
+                    with self._lock:
+                        self._syncing = False
+                        self._lock.notify_all()
+
+    def _lead_drain(self) -> int:
+        """One group-commit drain: optional window hold (company only),
+        one batch write, one out-of-lock fsync, watermark advance.
+        Returns the number of records the fsync newly covered."""
+        s = self._group
+        reg = stats.registry
+        held = False
+        with self._lock:
+            if s.group_us > 0:
+                opened = self._window_open or time.monotonic()
+                deadline = opened + s.group_us / 1e6
+                # hold only while there is company: a solo committer
+                # pays zero added latency, a burst shares one fsync
+                while (self._sync_waiters > 0
+                       and len(self._staged) < s.group_records
+                       and self._staged_bytes < s.group_bytes):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    held = True
+                    self._lock.wait(remaining)
+            self._write_staged_locked()
+            target = self._written_end
+            target_records = self._written_records
+            n_cover = target_records - self._synced_records
+            io = self._io_begin_locked()
+        if io is None:
+            raise OSError(f"log {self.path} closed during a drain")
+        try:
+            with tracer.span("log_group_drain", "oplog",
+                             records=n_cover, held=held,
+                             path=os.path.basename(self.path)):
+                self._backend_sync(io)
+        finally:
+            with self._lock:
+                self._io_done_locked()
+                self._synced_end = max(self._synced_end, target)
+                # the snapshot captured WITH target, not the live
+                # counter: records written during the fsync are not
+                # covered by it and must count in the NEXT drain
+                self._synced_records = max(self._synced_records,
+                                           target_records)
+                self.fsyncs += 1
+                self.drained_records += n_cover
+                if held:
+                    self.held_drains += 1
+                self._lock.notify_all()
+        reg.log_fsyncs.inc()
+        reg.log_group_records.inc(n_cover)
+        reg.log_group_drains.inc(kind="held" if held else "solo")
+        reg.log_group_size.observe(n_cover)
+        fsyncs_total = reg.log_fsyncs.value()
+        if fsyncs_total:
+            reg.log_records_per_fsync.set(
+                reg.log_group_records.value() / fsyncs_total)
+        return n_cover
+
+    # ------------------------------------------------------------ IO guard
+
+    def _io_begin_locked(self):
+        """Capture the backend for out-of-lock IO, pinning it against
+        close(); returns None when the log is closed.  Must run under
+        self._lock; pair with :meth:`_io_done_locked`."""
+        if self._native is None and self._py is None:
+            return None
+        self._io_refs += 1
+        return self._native or self._py
+
+    def _io_done_locked(self) -> None:
+        self._io_refs -= 1
+        self._lock.notify_all()
+
+    def _backend_sync(self, io) -> None:
+        """flush + fsync on a pinned backend, OUTSIDE self._lock (the
+        stdio stream serializes concurrent writers internally, and
+        fsync covers at least every byte written before it started)."""
+        tracer.instant("log_fsync", "oplog",
+                       path=os.path.basename(self.path))
+        if isinstance(io, tuple):
+            io[0].oplog_sync(io[1])
+        else:
+            io.sync()
+
+    # ----------------------------------------------------------- flush/sync
+
     def flush(self) -> None:
         with self._lock:
+            if self._group is not None:
+                self._write_staged_locked()
             if self._native:
                 self._native[0].oplog_flush(self._native[1])
             elif self._py is not None:  # no-op on a closed log
@@ -119,28 +465,71 @@ class DurableLog:
     def sync(self) -> None:
         """Flush + fsync — the commit-path durability barrier.
 
-        Holds the log lock across the fsync: same-partition appenders
-        already serialize behind the partition lock at every call site,
-        so the extra exclusion is cross-path only (handoff byte reads,
-        migration scans — rare).  A refcounted close guard would keep
-        fsync out of the critical section; deliberately not attempted
-        hours before round end (memory safety first)."""
+        The fsync runs OUTSIDE the handle lock behind the refcounted
+        close guard, so cross-path readers (handoff byte reads,
+        migration scans) no longer stall behind disk; same-partition
+        appenders already serialize behind the partition lock at every
+        call site, exactly as before."""
         with self._lock:
-            if self._native:
-                self._native[0].oplog_sync(self._native[1])
-            elif self._py is not None:  # no-op on a closed log
-                self._py.sync()
+            if self._group is not None:
+                self._write_staged_locked()
+            target = self._written_end
+            target_records = self._written_records
+            n_cover = target_records - self._synced_records
+            io = self._io_begin_locked()
+        if io is None:
+            return  # closed log: no-op, like the legacy closed sync
+        try:
+            self._backend_sync(io)
+        finally:
+            with self._lock:
+                self._io_done_locked()
+                self.fsyncs += 1
+                if self._group is not None:
+                    self._synced_end = max(self._synced_end, target)
+                    self._synced_records = max(self._synced_records,
+                                               target_records)
+                    if n_cover:
+                        self.drained_records += n_cover
+                    self._lock.notify_all()
+        stats.registry.log_fsyncs.inc()
+        if self._group is not None and n_cover:
+            stats.registry.log_group_records.inc(n_cover)
+
+    def queue_stats(self) -> dict:
+        """Staging/durability state for the pipeline snapshot
+        (obs/pipeline.py ``log`` section)."""
+        with self._lock:
+            oldest_us = 0
+            if self._window_open is not None:
+                oldest_us = int(
+                    (time.monotonic() - self._window_open) * 1e6)
+            return {
+                "group": self._group is not None,
+                "staged_records": len(self._staged),
+                "staged_bytes": self._staged_bytes,
+                "oldest_staged_age_us": oldest_us,
+                "written_end": self._written_end,
+                "synced_end": self._synced_end,
+                "end": self._logical_end,
+                "fsyncs": self.fsyncs,
+                "drained_records": self.drained_records,
+            }
+
+    # --------------------------------------------------------------- reads
 
     def end_offset(self) -> int:
         with self._lock:
-            if self._native:
-                return self._native[0].oplog_end_offset(self._native[1])
-            if self._py is None:
-                raise OSError(f"log {self.path} is closed")
-            return self._py.end
+            if self._group is not None:
+                if self._native is None and self._py is None:
+                    raise OSError(f"log {self.path} is closed")
+                return self._logical_end
+            return self._backend_end_locked()
 
     def read(self, offset: int) -> Optional[bytes]:
         with self._lock:
+            if self._group is not None:
+                self._write_staged_locked()
             if self._native:
                 lib, h = self._native
                 n = 4096
@@ -179,12 +568,20 @@ class DurableLog:
 
     def close(self) -> None:
         with self._lock:
+            if self._group is not None and (self._native or self._py):
+                self._write_staged_locked()
+            # the refcounted close guard: an out-of-lock fsync still
+            # holds the handle — freeing it under the syncer is a
+            # segfault on the native backend, not an exception
+            while self._io_refs:
+                self._lock.wait()
             if self._native:
                 self._native[0].oplog_close(self._native[1])
                 self._native = None
             elif self._py:
                 self._py.close()
                 self._py = None
+            self._lock.notify_all()
 
 
 class _PyLog:
@@ -223,6 +620,19 @@ class _PyLog:
         self.f.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
         self.f.write(payload)
         self.end += _HEADER.size + len(payload)
+        return off
+
+    def append_batch(self, payloads: List[bytes]) -> int:
+        """Twin of the native oplog_append_batch: frame every payload
+        into one buffer and write it with a single call."""
+        off = self.end
+        buf = bytearray()
+        for p in payloads:
+            buf += _HEADER.pack(len(p), zlib.crc32(p))
+            buf += p
+        self.f.seek(0, os.SEEK_END)
+        self.f.write(bytes(buf))
+        self.end += len(buf)
         return off
 
     def flush(self) -> None:

@@ -508,6 +508,43 @@ class Registry:
             "antidote_read_waiters_per_dispatch",
             "Amortization ratio of the read serve plane: waiters "
             "served per drain-group fold over the process lifetime")
+        # ---- group-commit durable-log plane (ISSUE 9,
+        # antidote_tpu/oplog/log.py): the commit path's disk economy.
+        # Records made durable per fsync (up) is the amortization the
+        # group-commit bench gates on; the sync-wait histogram is what
+        # a committer pays between releasing the partition lock and its
+        # durability ticket being covered.
+        self.log_fsyncs = Counter(
+            "antidote_log_fsyncs_total",
+            "Durability fsyncs executed by the durable log (group-"
+            "commit drains and legacy per-commit syncs both count)")
+        self.log_group_records = Counter(
+            "antidote_log_group_records_total",
+            "Log records whose durability a group-commit drain newly "
+            "covered (updates/prepares riding a commit's fsync count)")
+        self.log_group_drains = Counter(
+            "antidote_log_group_drains_total",
+            "Group-commit drains by kind (solo = no other committer "
+            "waiting, drained immediately; held = the leader kept the "
+            "window open for company)",
+            labels=("kind",))
+        self.log_group_size = Histogram(
+            "antidote_log_group_size_records",
+            "Records made durable per group-commit drain",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024))
+        self.log_sync_wait = Histogram(
+            "antidote_log_sync_wait_seconds",
+            "Commit-path wait from durability-ticket issue (partition "
+            "lock already released) to the synced watermark covering "
+            "it", buckets=lat_buckets)
+        self.log_staged_records = Gauge(
+            "antidote_log_staged_records",
+            "Log records currently staged (framed, not yet written "
+            "through the backend) across every open durable log")
+        self.log_records_per_fsync = Gauge(
+            "antidote_log_records_per_fsync",
+            "Amortization ratio of the group-commit plane: records "
+            "made durable per fsync over the process lifetime")
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -534,7 +571,11 @@ class Registry:
                 self.read_dispatches, self.read_serve_groups,
                 self.read_serve_waiters, self.read_coalesced_keys,
                 self.read_cache_hits, self.read_cache_misses,
-                self.read_waiters_per_dispatch)
+                self.read_waiters_per_dispatch,
+                self.log_fsyncs, self.log_group_records,
+                self.log_group_drains, self.log_group_size,
+                self.log_sync_wait, self.log_staged_records,
+                self.log_records_per_fsync)
 
     def exposition(self) -> str:
         lines = []

@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from dataclasses import replace as dc_replace
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from antidote_tpu import stats
@@ -162,7 +163,13 @@ class PartitionManager:
         #: TPU data plane for supported types (None = host-only node)
         self.device = device_plane
         if device_plane is not None:
-            device_plane.set_evict_handler(self._migrate_key_to_host)
+            # export_state: with enable_logging=False there is no log
+            # to replay on eviction — the plane must materialize host
+            # state from the device fold BEFORE dropping the lanes
+            # (the PR-7-flagged silent-zeroing bug)
+            device_plane.set_evict_handler(
+                self._migrate_key_to_host,
+                export_state=not log.enabled)
         self.read_wait_timeout = read_wait_timeout
         #: owner-side downstream generation hooks (set by the Node):
         #: gen_downstream_cb(cls, op, state, ctx, key=) and the node's
@@ -480,16 +487,57 @@ class PartitionManager:
                     # eviction path, where the key's whole history (this
                     # op included, it is already in the log) migrates to
                     # the host store
-                    self.device.stage(key, type_name, payload, stable)
+                    bounce = self.device.stage(key, type_name, payload,
+                                               stable)
+                    if bounce is not None:
+                        # unlogged decode-reject eviction: the bounced
+                        # effect (whole op, or a map's residual entry
+                        # subset) never landed on the device and the
+                        # exported state predates it — there is no log
+                        # to replay it from, so fold it into the
+                        # seeded snapshot (whose VC — the frontier
+                        # joined above — already covers it; an
+                        # ordinary insert would be replay-skipped as
+                        # in-base), falling back to a plain insert
+                        # when the export itself failed
+                        if not self.store.apply_to_seed(
+                                key, type_name, bounce):
+                            self.store.insert(
+                                key, type_name,
+                                dc_replace(payload, effect=bounce),
+                                stable_vc=stable)
+                elif not self.log.enabled:
+                    # evicted while we waited: with a log the migration
+                    # replayed it (this op was appended first); without
+                    # one, the CONCURRENT eviction's export predates
+                    # this op AND its seed VC does not cover it (the
+                    # evictor joined its own frontier, not ours) — an
+                    # ordinary insert is correctly replay-gated
+                    self.store.insert(key, type_name, payload,
+                                      stable_vc=stable)
                 # else: evicted while we waited — the migration replayed
                 # the log, which already holds this op (every caller
                 # appends before publishing), so nothing more to insert
                 return
             if evict_route:
                 # eviction migrates the full log history — which already
-                # contains this op — so nothing more to insert
+                # contains this op — so nothing more to insert (with a
+                # log; unlogged, this op never staged so the export
+                # cannot cover it and it must land on the host here)
                 if self.device.owns(type_name, key):  # see re-check above
                     self.device.planes[type_name].evict(key)
+                    if not self.log.enabled:
+                        # OUR eviction: its seed VC is the frontier
+                        # joined above (covers this op) — fold in
+                        if not self.store.apply_to_seed(
+                                key, type_name, payload.effect):
+                            self.store.insert(key, type_name, payload,
+                                              stable_vc=stable)
+                elif not self.log.enabled:
+                    # evicted during the wait by another publisher:
+                    # that seed's VC predates this op — plain insert
+                    self.store.insert(key, type_name, payload,
+                                      stable_vc=stable)
                 return
         self.store.insert(key, type_name, payload, stable_vc=stable)
 
@@ -500,16 +548,31 @@ class PartitionManager:
         while self._dev_readers:
             self._lock.wait()
 
-    def _migrate_key_to_host(self, key, type_name: str) -> None:
+    def _migrate_key_to_host(self, key, type_name: str,
+                             state=None) -> None:
         """Device-plane eviction handler: rebuild the key's host-store
         entry from the durable log (runs under self._lock — the lock is
         re-entrant).  Drops the key's value-cache entry: a fold-derived
         inexact state must not survive the move to the host path, where
         the cache-hit checks no longer guard exactness (the host store
-        itself is exact by construction)."""
+        itself is exact by construction).
+
+        With ``enable_logging=False`` the replay yields nothing — the
+        pre-fix path silently ZEROED the key (PR-7 flag, reproduced on
+        clean HEAD).  The plane now exports the key's device-fold
+        ``state`` before dropping the lanes, and the host store is
+        seeded from it at the key's commit frontier: every read whose
+        snapshot covers the frontier (the overwhelmingly common shape)
+        serves the true value; reads below it have no history to
+        replay anywhere, exactly unlogged mode's existing contract."""
         self._val_cache.pop(key, None)
+        replayed = False
         for _seq, p in self.log.committed_payloads(key=key):
             self.store.insert(key, type_name, p)
+            replayed = True
+        if not replayed and state is not None:
+            self.store.seed_state(key, type_name, state,
+                                  self.key_frontier.get(key))
 
     def _mid_batch_migrated(self, pre_hosted: Optional[set], key) -> bool:
         """True when ``key`` was evicted to the host DURING the current
@@ -542,12 +605,24 @@ class PartitionManager:
         """Log the commit (fsync per config), publish the effects to the
         materializer store, release prepared state and wake blocked
         readers (reference commit handler src/clocksi_vnode.erl:499-531,
-        update_materializer :634-657)."""
+        update_materializer :634-657).
+
+        GROUP COMMIT (ISSUE 9): under the group-commit log plane with
+        ``sync_on_commit``, the commit record only STAGES inside the
+        lock; the committer takes a durability ticket, releases the
+        partition lock, and waits OUT OF LOCK for the synced watermark
+        to cover it — concurrent committers share one buffered write
+        and one fsync, and the partition's commit throughput stops
+        degenerating to its disk's fsync rate.  The commit is acked
+        (this method returns) only once the ticket is covered; the
+        legacy path (``Config.log_group=False``) keeps the inline
+        fsync under the lock exactly as before."""
         stable = self._stable_for_gc()  # before the lock (see __init__)
         with self._lock:
             self._mutate_check()
             self.log.append_commit(self.dc_id, txid, commit_time,
                                    snapshot_vc, certified)
+            ticket = self.log.commit_ticket()
             pre_hosted = self._pre_hosted()
             for key, type_name, effect in self._staged.pop(txid, []):
                 payload = Payload(
@@ -563,6 +638,11 @@ class PartitionManager:
                     self.committed[key] = commit_time
             self.prepared.pop(txid, None)
             self._lock.notify_all()
+        # durability gate OUTSIDE the partition lock: readers and other
+        # committers proceed while this committer waits out the shared
+        # fsync (its effects are already published — group commit
+        # trades the ack point, not the visibility point)
+        self.log.wait_durable(ticket, txid=txid)
 
     def single_commit(self, txid, snapshot_vc: VC,
                       certify: bool = True) -> int:
@@ -603,7 +683,7 @@ class PartitionManager:
                         if rec.kind() == "commit")
         with self._lock:
             self._mutate_check()
-            self.log.append_remote_group(records)
+            ticket = self.log.append_remote_group(records)
             pre_hosted = self._pre_hosted()
             for rec in records:
                 if rec.kind() != "update":
@@ -621,6 +701,9 @@ class PartitionManager:
                 else:
                     self._publish(key, type_name, payload, stable)
             self._lock.notify_all()
+        # remote applies ride the same group-commit durability gate as
+        # local commits (out of lock; see commit())
+        self.log.wait_durable(ticket)
 
     # --------------------------------------------------------------- reads
 

@@ -613,12 +613,19 @@ class Node:
         return os.path.join(self.data_dir, f"{self.dc_id}_p{p}.log")
 
     def _build_partition(self, p: int) -> PartitionManager:
+        # the ONE construction path for the group-commit knobs
+        # (oplog/log.py log_group_from_config — the gate_from_config
+        # lesson): boot, repartition, and adopt_partition all come
+        # through here, so no assembly can honor different settings
+        from antidote_tpu.oplog.log import log_group_from_config
+
         log = PartitionLog(
             self._log_path(p), partition=p,
             sync_on_commit=self.config.sync_log,
             enabled=self.config.enable_logging,
             on_append=(lambda rec, _p=p: self._on_log_append(_p, rec))
-            if self._on_log_append else None)
+            if self._on_log_append else None,
+            group=log_group_from_config(self.config))
         plane = None
         if self.config.device_store:
             from antidote_tpu.mat.device_plane import DevicePlane

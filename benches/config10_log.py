@@ -1,0 +1,203 @@
+"""Config 10: group-commit durable log — the commit path's disk economy.
+
+Cure's commit protocol (PAPERS.md: Akkoorath et al., ICDCS 2016) makes
+the log append the only synchronous durability step on the commit
+path, and before ISSUE 9 that step was strictly per-record: every
+committer paid its own fsync UNDER the partition lock, so a
+partition's commit throughput degenerated to its disk's fsync rate.
+This config drives an N-committer steady commit stream through the
+REAL PartitionLog append + durability path twice — the group-commit
+plane (``log_group=True``: staged batch appends, caller-elected drain
+leader, durability tickets redeemed off the partition lock) against
+the per-record legacy baseline — and measures the two quantities the
+regression gate enforces directionally:
+
+- ``log_records_per_fsync``      (records/fsync, must not fall): log
+  records made durable per fsync, the group-commit amortization;
+- ``log_commit_sync_us_per_txn`` (us/txn, must not rise): what the
+  committing thread pays per transaction for append + durability.
+
+Equivalence is asserted, not assumed: both legs' logs recover (fresh
+PartitionLog over the written file) to the same per-txn content and
+op-id watermarks, per-committer append order survives, and the solo
+leg (1 committer) must never hold the window open (the zero-added-
+latency contract: ``held_drains == 0``, one immediate drain per
+commit).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from benches._util import emit, setup
+
+
+def build_tapes(n_committers, txns_each, seed=13):
+    """Deterministic per-committer txn tapes: (txid, [(key, effect)],
+    commit_time, snapshot_vc) — identical input for both legs."""
+    import numpy as np
+
+    from antidote_tpu.clocks import VC
+
+    rng = np.random.default_rng(seed)
+    tapes = []
+    t = 1_700_000_000_000_000
+    for c in range(n_committers):
+        tape = []
+        for i in range(txns_each):
+            t += int(rng.integers(10, 50))
+            txid = ("dc1", c * 1_000_000 + i)
+            ups = [(f"acct_{int(rng.integers(0, 64)):03d}",
+                    int(rng.integers(1, 100)))
+                   for _ in range(int(rng.integers(1, 3)))]
+            tape.append((txid, ups, t, VC({"dc1": t - 5})))
+        tapes.append(tape)
+    return tapes
+
+
+def drive(path, tapes, grouped: bool, group_us=2000,
+          group_records=512):
+    """Run every committer thread through the real append+durability
+    path; returns per-leg measurements.  A shared lock stands in for
+    the partition lock: appends serialize under it (as in
+    PartitionManager.commit) and the durability wait runs OUTSIDE it —
+    exactly the contract the group plane changes and the legacy leg
+    keeps (whose fsync runs inline, under the lock)."""
+    from antidote_tpu.oplog.log import GroupSettings
+    from antidote_tpu.oplog.partition import PartitionLog
+
+    plog = PartitionLog(
+        path, partition=0, sync_on_commit=True,
+        group=GroupSettings(enabled=grouped, group_us=group_us,
+                            group_records=group_records))
+    plock = threading.Lock()
+    per_thread_s = [0.0] * len(tapes)
+    errs = []
+
+    def committer(ci, tape):
+        try:
+            t0 = time.perf_counter()
+            for txid, ups, ct, svc in tape:
+                with plock:
+                    for key, eff in ups:
+                        plog.append_update("dc1", txid, key,
+                                           "counter_pn", eff)
+                    plog.append_commit("dc1", txid, ct, svc)
+                    ticket = plog.commit_ticket()
+                plog.wait_durable(ticket, txid=txid)
+            per_thread_s[ci] = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=committer, args=(ci, tape))
+               for ci, tape in enumerate(tapes)]
+    wall0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - wall0
+    if errs:
+        raise errs[0]
+    n_txns = sum(len(t) for t in tapes)
+    n_records = sum(len(ups) + 1 for tape in tapes
+                    for _tx, ups, _ct, _svc in tape)
+    fsyncs = plog.log.fsyncs
+    held = plog.log.held_drains
+    plog.close()
+    return {
+        "txns": n_txns,
+        "records": n_records,
+        "fsyncs": fsyncs,
+        "held_drains": held,
+        "records_per_fsync": n_records / max(fsyncs, 1),
+        "commit_path_us_per_txn":
+            sum(per_thread_s) / n_txns * 1e6,
+        "wall_s": wall,
+    }
+
+
+def recovered_content(path):
+    """(op_counters, {txid: (sorted updates, commit_time)}) after a
+    fresh recovery over the written file — the equivalence quantity."""
+    from antidote_tpu.oplog.partition import PartitionLog
+
+    plog = PartitionLog(path, partition=0)
+    by_txid = {}
+    for _seq, p in plog.committed_payloads():
+        ups, _ct = by_txid.setdefault(p.txid, ([], p.commit_time))
+        ups.append((p.key, p.effect))
+    counters = dict(plog.op_counters)
+    plog.close()
+    return counters, {tx: (sorted(ups), ct)
+                      for tx, (ups, ct) in by_txid.items()}
+
+
+def expected_content(tapes):
+    return {txid: (sorted(ups), ct)
+            for tape in tapes for txid, ups, ct, _svc in tape}
+
+
+def run_leg(tmp, tapes, grouped, name):
+    import os
+
+    path = os.path.join(tmp, f"{name}.log")
+    res = drive(path, tapes, grouped=grouped)
+    counters, content = recovered_content(path)
+    # recovery equivalence: the written file replays to exactly the
+    # tape's transactions, whole op-id stream accounted for
+    assert content == expected_content(tapes), \
+        f"{name} leg recovery diverged from the input tape"
+    assert counters == {"dc1": res["records"]}
+    return res
+
+
+def main():
+    import tempfile
+
+    quick, _jax = setup()
+    n_committers = 8
+    txns_each = 100 if quick else 500
+    tapes = build_tapes(n_committers, txns_each)
+    with tempfile.TemporaryDirectory() as tmp:
+        grouped = run_leg(tmp, tapes, True, "grouped")
+        legacy = run_leg(tmp, tapes, False, "legacy")
+        # solo leg: a single committer must drain immediately, never
+        # holding the window (the zero-added-latency contract)
+        solo_tapes = build_tapes(1, txns_each)
+        solo = run_leg(tmp, solo_tapes, True, "solo")
+        assert solo["held_drains"] == 0, \
+            "a solo committer held the group window open"
+        assert solo["fsyncs"] == solo["txns"], \
+            "a solo committer's commits must each drain immediately"
+        solo_legacy = run_leg(tmp, solo_tapes, False, "solo_legacy")
+    # legacy = one fsync per commit record, by construction
+    assert legacy["fsyncs"] == legacy["txns"]
+    amort = grouped["records_per_fsync"] / legacy["records_per_fsync"]
+    sync_ratio = (legacy["commit_path_us_per_txn"]
+                  / max(grouped["commit_path_us_per_txn"], 1e-9))
+    emit("log_records_per_fsync",
+         round(grouped["records_per_fsync"], 2), "records/fsync",
+         round(amort, 2),
+         legacy_records_per_fsync=round(
+             legacy["records_per_fsync"], 2),
+         grouped_fsyncs=grouped["fsyncs"],
+         legacy_fsyncs=legacy["fsyncs"],
+         held_drains=grouped["held_drains"],
+         committers=n_committers, txns=grouped["txns"])
+    emit("log_commit_sync_us_per_txn",
+         round(grouped["commit_path_us_per_txn"], 2), "us/txn",
+         round(sync_ratio, 2),
+         legacy_us_per_txn=round(legacy["commit_path_us_per_txn"], 2),
+         solo_us_per_txn=round(solo["commit_path_us_per_txn"], 2),
+         solo_legacy_us_per_txn=round(
+             solo_legacy["commit_path_us_per_txn"], 2),
+         solo_fsyncs=solo["fsyncs"],
+         solo_held_drains=solo["held_drains"],
+         grouped_wall_s=round(grouped["wall_s"], 3),
+         legacy_wall_s=round(legacy["wall_s"], 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -204,7 +204,7 @@ class TestVisibilityMetrics:
 
 
 class TestPipelineSnapshot:
-    SECTIONS = {"ship", "sub_bufs", "gates", "ingest", "stable",
+    SECTIONS = {"ship", "sub_bufs", "gates", "ingest", "log", "stable",
                 "connected_dcs"}
 
     def test_snapshot_schema(self, journey2):
@@ -216,6 +216,13 @@ class TestPipelineSnapshot:
         for name in ("dc1", "dc2"):
             d = snap["dcs"][name]
             assert set(d) == self.SECTIONS, d.keys()
+            for p in ("0", "1"):
+                lg = d["log"][p]
+                assert lg["enabled"]
+                assert {"group", "staged_records", "staged_bytes",
+                        "oldest_staged_age_us", "written_end",
+                        "synced_end", "end", "fsyncs",
+                        "drained_records"} <= set(lg)
             for p in ("0", "1"):
                 ship = d["ship"][p]
                 assert {"staged_txns", "staged_bytes", "oldest_age_us",

@@ -267,9 +267,44 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # unit stays down.
     ("waiters/dispatch", 1), ("hit pct", 1),
     ("dispatches/read", -1), ("pct", -1),
+    # group-commit durable-log family (ISSUE 9): records per fsync
+    # must not fall (regression back to one fsync per commit), the
+    # commit-path sync cost per txn must not rise
+    ("records/fsync", 1), ("us/txn", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_log_plane_regression(tmp_path, capsys):
+    """ISSUE 9 synthetic two-round trajectory: round 2's group-commit
+    rows slide back toward per-commit fsyncs — records/fsync collapses
+    (down = regression) and the commit-path sync µs/txn balloons (up =
+    regression).  Both must fail."""
+    old = {"schema_version": 1, "round": 1, "dry_run": False,
+           "metrics": {
+               "log_records_per_fsync": {"value": 9.0,
+                                         "unit": "records/fsync"},
+               "log_commit_sync_us_per_txn": {"value": 120.0,
+                                              "unit": "us/txn"}},
+           "failures": {}}
+    new = {"schema_version": 1, "round": 2, "dry_run": False,
+           "metrics": {
+               "log_records_per_fsync": {"value": 2.1,
+                                         "unit": "records/fsync"},
+               "log_commit_sync_us_per_txn": {"value": 430.0,
+                                              "unit": "us/txn"}},
+           "failures": {}}
+    import json
+
+    op, np_ = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    op.write_text(json.dumps(old))
+    np_.write_text(json.dumps(new))
+    rc = bench_gate.main([str(op), str(np_)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "log_records_per_fsync" in err
+    assert "log_commit_sync_us_per_txn" in err
 
 
 def test_gate_fails_on_ship_plane_regression(tmp_path, capsys):

@@ -230,3 +230,39 @@ def test_fused_rule_flags_untraced_fused_read_sites(tmp_path):
 
 def test_fused_rule_clean_on_repo():
     assert trace_lint.lint_fused_spans(trace_lint.repo_root()) == []
+
+
+def test_sync_rule_flags_untraced_sync_sites(tmp_path):
+    """ISSUE 9 rule: a function under oplog/ calling the durability
+    barrier (sync/fsync/oplog_sync) without a span/instant is a dark
+    commit-path disk stall; instrumented callers and the barrier
+    definitions themselves (functions named ``sync``) pass."""
+    d = tmp_path / "antidote_tpu" / "oplog"
+    d.mkdir(parents=True)
+    (d / "newlog.py").write_text(
+        "import os\n"
+        "from antidote_tpu.obs.spans import tracer\n"
+        "class L:\n"
+        "    def dark_commit(self):\n"
+        "        self.log.sync()\n"
+        "    def dark_raw(self, fd):\n"
+        "        os.fsync(fd)\n"
+        "    def dark_native(self, lib, h):\n"
+        "        lib.oplog_sync(h)\n"
+        "    def good_drain(self):\n"
+        "        with tracer.span('log_group_drain', 'oplog'):\n"
+        "            self.log.sync()\n"
+        "    def good_inline(self):\n"
+        "        tracer.instant('log_sync_inline', 'oplog')\n"
+        "        self.log.sync()\n"
+        "    def sync(self):\n"
+        "        os.fsync(self.fd)\n"  # the barrier itself: exempt
+        "    def unrelated(self):\n"
+        "        return 1\n")
+    problems = trace_lint.lint_sync_spans(str(tmp_path))
+    flagged = sorted(p.split("::")[1].split(":")[0] for p in problems)
+    assert flagged == ["dark_commit", "dark_native", "dark_raw"]
+
+
+def test_sync_rule_clean_on_repo():
+    assert trace_lint.lint_sync_spans(trace_lint.repo_root()) == []

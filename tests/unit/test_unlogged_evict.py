@@ -1,0 +1,231 @@
+"""Regression: enable_logging=False device-plane evictions must not
+zero the key (ISSUE 9 satellite; flagged by PR 7, reproduced on clean
+HEAD).
+
+Pre-fix, any eviction — lane overflow, element-slot cap, DC-column cap
+— handed the key to ``PartitionManager._migrate_key_to_host``, which
+replayed the (empty) log into the host store: every element/count the
+key ever held vanished, silently.  The fix: with no log to replay, the
+plane (a) exports the key's device-fold state BEFORE dropping the
+lanes and the host store is seeded from it, (b) decode-rejected ops
+(which never landed on the device) bounce back to ``_publish`` and
+land on the host path directly, and (c) the flush overflow path folds
+the whole ring into the base before dropping rows (dropping an
+unlogged row is permanent data loss, not a cache miss).
+
+These tests FAIL on pre-fix HEAD (the reads come back empty/zero).
+"""
+
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.mat.device_plane import DevicePlane
+from antidote_tpu.oplog.partition import PartitionLog
+from antidote_tpu.txn.clock import HybridClock
+from antidote_tpu.txn.manager import PartitionManager
+
+
+def make_unlogged_pm(tmp_path, name="p0", **plane_kw):
+    log = PartitionLog(str(tmp_path / f"{name}.log"), partition=0,
+                       enabled=False)
+    plane = DevicePlane(**plane_kw)
+    return PartitionManager(0, "dc1", log, HybridClock(),
+                            device_plane=plane)
+
+
+def commit_one(pm, i, key, type_name, eff, t):
+    txid = ("dc1", 10_000 + i)
+    pm.stage_update(txid, key, type_name, eff)
+    pm.commit(txid, t, VC({"dc1": t - 1}))
+
+
+def test_slot_cap_evict_preserves_set(tmp_path):
+    """Element-slot cap eviction (decode reject): with no log, the
+    exported fold + the bounced current op must reconstruct the whole
+    set on the host path."""
+    pm = make_unlogged_pm(tmp_path, key_capacity=64, n_slots=4,
+                          max_slots=8, flush_ops=4, gc_ops=10**9)
+    elems = [f"e{i}" for i in range(20)]
+    t = 1000
+    for i, e in enumerate(elems):
+        t += 10
+        eff = ("add", ((e, ("dc1", t), ()),))
+        commit_one(pm, i, "hot", "set_aw", eff, t)
+    assert "hot" in pm.device.host_only, \
+        "test setup: the slot cap should have evicted the key"
+    state = pm.read("hot", "set_aw", None)
+    assert set(state) == set(elems), \
+        f"unlogged eviction lost elements: {sorted(set(elems) - set(state))}"
+    # a snapshot covering the frontier sees the same thing
+    state2 = pm.read("hot", "set_aw", VC({"dc1": t}))
+    assert set(state2) == set(elems)
+
+
+def test_lane_pressure_unlogged_counter_keeps_count(tmp_path):
+    """Lane-overflow pressure without a GC horizon: unlogged mode must
+    fold the ring rather than drop rows / zero the key on eviction."""
+    pm = make_unlogged_pm(tmp_path, key_capacity=64, n_lanes=2,
+                          flush_ops=1, gc_ops=10**9)
+    t = 1000
+    n = 25
+    for i in range(n):
+        t += 10
+        commit_one(pm, i, "cnt", "counter_pn", 1, t)
+    value = pm.read("cnt", "counter_pn", None)
+    assert value == n, f"unlogged lane pressure lost increments: {value}"
+
+
+def test_evict_export_state_flag_only_without_log(tmp_path):
+    """A LOGGED partition keeps the log-replay migration exactly (no
+    export fold on the eviction path)."""
+    log = PartitionLog(str(tmp_path / "logged.log"), partition=0,
+                       enabled=True)
+    plane = DevicePlane(key_capacity=64)
+    PartitionManager(0, "dc1", log, HybridClock(), device_plane=plane)
+    assert not plane._evict_export
+    assert all(not p.evict_export for p in plane.planes.values())
+    log.close()
+
+
+def test_unlogged_evicted_key_survives_later_ops(tmp_path):
+    """Ops committed AFTER the unlogged eviction keep applying on the
+    host path on top of the seeded state."""
+    pm = make_unlogged_pm(tmp_path, key_capacity=64, n_slots=4,
+                          max_slots=8, flush_ops=4, gc_ops=10**9)
+    t = 1000
+    elems = [f"e{i}" for i in range(12)]
+    for i, e in enumerate(elems):
+        t += 10
+        commit_one(pm, i, "k", "set_aw", ("add", ((e, ("dc1", t), ()),)), t)
+    assert "k" in pm.device.host_only
+    # post-evict commit routes straight to the host store
+    t += 10
+    commit_one(pm, 99, "k", "set_aw", ("add", (("late", ("dc1", t), ()),)), t)
+    state = pm.read("k", "set_aw", None)
+    assert set(state) == set(elems) | {"late"}
+
+
+def test_uncertified_commit_evict_route_keeps_state(tmp_path):
+    """The evict_route leg (uncertified commit of a dot-collapse type
+    on a device-resident key) must also survive unlogged: the export
+    predates the uncertified op, so the op folds into the seed."""
+    pm = make_unlogged_pm(tmp_path, key_capacity=64, n_slots=8,
+                          max_slots=64, flush_ops=4, gc_ops=10**9)
+    t = 1000
+    elems = [f"c{i}" for i in range(5)]
+    for i, e in enumerate(elems):
+        t += 10
+        commit_one(pm, i, "k", "set_aw", ("add", ((e, ("dc1", t), ()),)), t)
+    assert pm.device.owns("set_aw", "k")
+    # uncertified commit: dense dot collapse unsound -> evict_route
+    t += 10
+    txid = ("dc1", 999)
+    pm.stage_update(txid, "k", "set_aw",
+                    ("add", (("unc", ("dc1", t), ()),)))
+    pm.commit(txid, t, VC({"dc1": t - 1}), certified=False)
+    assert "k" in pm.device.host_only
+    state = pm.read("k", "set_aw", None)
+    assert set(state) == set(elems) | {"unc"}, \
+        f"evict_route lost: {(set(elems) | {'unc'}) - set(state)}"
+
+
+def test_map_mid_stage_evict_residual(tmp_path):
+    """A map effect whose SECOND field hits a capacity cap mid-decode
+    evicts the whole map; the already-staged first field is visible in
+    the export, so the bounce must apply only the RESIDUAL entries —
+    re-applying the whole effect would double-apply the counter (map_go: the warm fa field is visible in the export via its existing presence)."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.config import Config
+
+    db = AntidoteTPU("dcM", Config(
+        n_partitions=1, enable_logging=False, device_store=True,
+        device_slots=4, device_max_slots=8, device_flush_ops=4,
+        device_gc_ops=10**9, data_dir=str(tmp_path / "m")))
+    # warm both fields: fa counter at 3, fb set with 8 elements
+    # (saturating fb's slot cap)
+    for i in range(3):
+        tx = db.start_transaction()
+        db.update_objects([((("m", "map_go")), "update",
+                            (("fa", "counter_pn"), ("increment", 1)))],
+                          tx)
+        db.commit_transaction(tx)
+    for i in range(8):
+        tx = db.start_transaction()
+        db.update_objects([((("m", "map_go")), "update",
+                            (("fb", "set_aw"), ("add", f"s{i}")))], tx)
+        db.commit_transaction(tx)
+    pm = db.node.partitions[0]
+    assert "m" not in pm.device.host_only
+    # ONE effect touching fa then fb; fb's 9th element overflows the
+    # slot cap mid-decode and evicts the map
+    tx = db.start_transaction()
+    db.update_objects([((("m", "map_go")), "update",
+                        [(("fa", "counter_pn"), ("increment", 1)),
+                         (("fb", "set_aw"), ("add", "s-new"))])], tx)
+    db.commit_transaction(tx)
+    assert "m" in pm.device.host_only, \
+        "test setup: the map should have evicted on fb's slot cap"
+    tx = db.start_transaction()
+    (val,) = db.read_objects([("m", "map_go")], tx)
+    db.commit_transaction(tx)
+    state = {kt[0]: v for kt, v in val.items()}
+    assert set(state["fb"]) == {f"s{i}" for i in range(8)} | {"s-new"}
+    assert state["fa"] == 4, \
+        f"fa counter is {state['fa']}: the bounce double-applied " \
+        "(expected 4 = 3 warm + 1 in the evicting effect)"
+    db.close()
+
+
+def test_map_presence_evict_keeps_fields(tmp_path):
+    """A PRESENCE-plane-triggered map eviction (field count past the
+    slot cap) purges the visibility set before the map export can
+    filter by it — the presence's own pre-purge fold must replace the
+    filter, or the export seeds the host with {} (the zeroing bug,
+    presence flavor)."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.config import Config
+
+    db = AntidoteTPU("dcP", Config(
+        n_partitions=1, enable_logging=False, device_store=True,
+        device_slots=4, device_max_slots=8, device_flush_ops=4,
+        device_gc_ops=10**9, data_dir=str(tmp_path / "p")))
+    for i in range(9):  # the 9th field overflows the presence slots
+        tx = db.start_transaction()
+        db.update_objects([((("m", "map_go")), "update",
+                            ((f"f{i}", "counter_pn"),
+                             ("increment", 1)))], tx)
+        db.commit_transaction(tx)
+    pm = db.node.partitions[0]
+    assert "m" in pm.device.host_only, \
+        "test setup: the field-count cap should have evicted the map"
+    tx = db.start_transaction()
+    (val,) = db.read_objects([("m", "map_go")], tx)
+    db.commit_transaction(tx)
+    state = {kt[0]: v for kt, v in val.items()}
+    assert set(state) == {f"f{i}" for i in range(9)}, \
+        f"presence eviction lost fields: " \
+        f"{({f'f{i}' for i in range(9)}) - set(state)}"
+    assert all(v == 1 for v in state.values()), state
+    db.close()
+
+
+def test_prefix_behavior_reproduction(tmp_path):
+    """Pin the pre-fix failure mode: with the export disabled (the old
+    wiring), the eviction zeroes the key — the exact bug.  If this
+    starts passing, the reproduction setup no longer evicts and the
+    regression tests above have lost their teeth."""
+    pm = make_unlogged_pm(tmp_path, key_capacity=64, n_slots=4,
+                          max_slots=8, flush_ops=4, gc_ops=10**9)
+    # re-wire the handler the pre-fix way: no export
+    pm.device.set_evict_handler(pm._migrate_key_to_host,
+                                export_state=False)
+    t = 1000
+    elems = [f"e{i}" for i in range(20)]
+    for i, e in enumerate(elems):
+        t += 10
+        commit_one(pm, i, "hot", "set_aw", ("add", ((e, ("dc1", t), ()),)), t)
+    assert "hot" in pm.device.host_only
+    state = pm.read("hot", "set_aw", None)
+    assert set(state) != set(elems), \
+        "pre-fix wiring unexpectedly preserved the set — the " \
+        "reproduction no longer covers the bug"

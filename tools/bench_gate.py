@@ -56,9 +56,12 @@ _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 #: "hit pct" is the read serve plane's cache-hit ratio (ISSUE 8): a
 #: falling hit percentage means repeat reads of stable keys stopped
 #: skipping the device — unlike the plain "pct" overhead unit below,
-#: bigger is better here.
+#: bigger is better here.  "/fsync" is the group-commit durable-log
+#: plane's amortization (ISSUE 9): records made durable per fsync
+#: sliding toward the per-commit record count means the commit path
+#: has regressed to one fsync per transaction.
 _HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch", "/frame",
-                           "hit pct")
+                           "hit pct", "/fsync")
 #: units whose value should not RISE (smaller is better).  The
 #: "*/txn" per-admitted-cost units (H2D bytes per txn, dispatches per
 #: txn, and ISSUE 6's encoded wire bytes per shipped txn) are the

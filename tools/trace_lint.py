@@ -45,6 +45,15 @@ invisible to the serve-stage latency panels and sampled txn trees.
 The definition itself (a function *named* fused_read) is exempt; call
 sites are not.
 
+ISSUE 9 adds the sync rule: every function under antidote_tpu/oplog/
+that calls ``sync()`` / ``fsync`` (/ the native ``oplog_sync``) must
+carry a span or instant — the group-commit plane moved the fsync off
+the partition lock and between threads, and an untraced durability
+barrier would blind exactly the stall hunts the log_sync_wait /
+log_group_drain timeline exists for.  Functions NAMED like the
+barrier (``sync`` — the DurableLog/_PyLog definitions) are exempt;
+call sites are not.
+
 Runs standalone (``python tools/trace_lint.py``) and from tier-1
 (tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
 Purely static (ast), so it needs no JAX and runs in milliseconds.
@@ -125,6 +134,13 @@ _DECODE_DIRS = (os.path.join("antidote_tpu", "interdc"),
 #: decode rule's
 _FUSED_NAMES = ("fused_read",)
 _FUSED_DIRS = (os.path.join("antidote_tpu", "mat"),)
+
+#: durability-barrier call names under oplog/ (ISSUE 9): a call whose
+#: terminal name is one of these is an fsync (or the flush+fsync
+#: wrapper) and the calling function must be instrumented; functions
+#: NAMED "sync" are the barrier definitions themselves and are exempt
+_SYNC_NAMES = ("sync", "fsync", "oplog_sync")
+_SYNC_DIR = os.path.join("antidote_tpu", "oplog")
 
 
 def _is_instrumented(fn: ast.FunctionDef) -> bool:
@@ -391,6 +407,50 @@ def lint_fused_spans(root: str) -> List[str]:
     return problems
 
 
+def _is_sync_call(node: ast.Call) -> bool:
+    """True for ``self.log.sync()`` / ``os.fsync(fd)`` /
+    ``lib.oplog_sync(h)`` — any call whose terminal name is a
+    durability barrier."""
+    f = node.func
+    name = getattr(f, "attr", getattr(f, "id", None))
+    return name in _SYNC_NAMES
+
+
+def lint_sync_spans(root: str) -> List[str]:
+    """ISSUE 9 rule: every function under antidote_tpu/oplog/ with an
+    fsync/sync call site must also carry a span/instant/annotation, so
+    the durability barrier stays visible to the forensic plane as the
+    group-commit plane moves it between threads.  Functions named
+    ``sync`` (the DurableLog/_PyLog barrier definitions) are exempt;
+    call sites are not."""
+    problems: List[str] = []
+    d = os.path.join(root, _SYNC_DIR)
+    if not os.path.isdir(d):
+        return problems
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(d, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in _SYNC_NAMES:
+                continue  # the barrier definition, not a call site
+            syncs = any(
+                isinstance(c, ast.Call) and _is_sync_call(c)
+                for c in ast.walk(node))
+            if syncs and not _is_instrumented(node):
+                problems.append(
+                    f"{_SYNC_DIR}/{fname}::{node.name}: calls the "
+                    "durability barrier (sync/fsync) without a tracer "
+                    "span/instant — commit-path disk stalls go dark "
+                    "(antidote_tpu/obs/spans.py)")
+    return problems
+
+
 def _methods(tree: ast.Module, cls_name: str) -> Dict[str, ast.FunctionDef]:
     for node in tree.body:
         if isinstance(node, ast.ClassDef) and node.name == cls_name:
@@ -428,6 +488,7 @@ def lint(root: str) -> List[str]:
     problems.extend(lint_publish_spans(root))
     problems.extend(lint_decode_instants(root))
     problems.extend(lint_fused_spans(root))
+    problems.extend(lint_sync_spans(root))
     return problems
 
 
