@@ -115,6 +115,12 @@ class NodeInterDc:
             pm.log.on_append = (
                 lambda rec, _s=sender: _s.on_append(rec))
             self.senders[p] = sender
+            # checkpoint-truncation retention floor (ISSUE 10): same
+            # wiring as DataCenter's — ship watermark with peers, else
+            # unconstrained
+            pm.log.retention_opid_source = (
+                lambda _s=sender: _s.last_sent_opid if self.remote
+                else None)
         #: dependency gates for owned slices; their watermarks feed the
         #: node's stable tracker
         self.gates: Dict[int, DependencyGate] = {}
@@ -189,6 +195,9 @@ class NodeInterDc:
                 pm.log.on_append = (
                     lambda rec, _s=sender: _s.on_append(rec))
                 self.senders[p] = sender
+                pm.log.retention_opid_source = (
+                    lambda _s=sender: _s.last_sent_opid if self.remote
+                    else None)
                 g = gate_from_config(pm, self.dc_id,
                                      node.clock.now_us, node.config)
                 g.seed_clock(pm.log.max_commit_vc)
@@ -199,6 +208,7 @@ class NodeInterDc:
                         deliver=self._make_gate_deliver(p),
                         deliver_batch=self._make_gate_deliver_batch(p),
                         fetch_range=self._fetch_range,
+                        bootstrap=self._bootstrap_from_ckpt,
                         last_opid=pm.log.op_counters.get(dc_id, 0))
             for p in sorted(self.local - new_local):
                 gone = self.senders.pop(p, None)
@@ -252,6 +262,7 @@ class NodeInterDc:
                 deliver=self._make_gate_deliver(p),
                 deliver_batch=self._make_gate_deliver_batch(p),
                 fetch_range=self._fetch_range,
+                bootstrap=self._bootstrap_from_ckpt,
                 last_opid=self.node.partitions[p].log.op_counters.get(
                     desc.dc_id, 0))
         self.remote[desc.dc_id] = desc
@@ -356,6 +367,28 @@ class NodeInterDc:
         except LinkDown:
             return None
 
+    def _bootstrap_from_ckpt(self, origin_dc, partition: int
+                             ) -> Optional[int]:
+        """BELOW_FLOOR escalation (ISSUE 10), federated form: the
+        CKPT_READ routes to the remote MEMBER owning the partition
+        (the descriptor's ring) and the seeds install into this
+        member's local slice — mirrors DataCenter._bootstrap_from_ckpt."""
+        desc = self.remote.get(origin_dc)
+        if desc is None or partition not in self.local:
+            return None
+        target = (origin_dc, desc.ring[partition])
+        my_key = (self.dc_id, self.member_index)
+        try:
+            ans = self.bus.request(my_key, target, idc_query.CKPT_READ,
+                                   (partition,))
+        except LinkDown:
+            return None
+        if ans is None:
+            return None
+        return idc_query.install_ckpt_bootstrap(
+            self.node.partitions[partition], self.gates[partition],
+            origin_dc, partition, ans)
+
     # ------------------------------------------------------------ queries
 
     def _handle_query(self, from_dc, kind: str, payload) -> Any:
@@ -392,6 +425,16 @@ class NodeInterDc:
                            origin=str(from_dc), keys=len(objects))
             return idc_query.answer_snapshot_read(
                 self._api, objects, clock)
+        if kind == idc_query.CKPT_READ:
+            (partition,) = payload
+            if partition not in self.local:
+                raise ValueError(
+                    f"partition {partition} not owned by member "
+                    f"{self.member_index} of {self.dc_id!r}")
+            tracer.instant("interdc_ckpt_read", "interdc",
+                           origin=str(from_dc), partition=partition)
+            return idc_query.answer_ckpt_read(
+                self.node.partitions[partition], self.dc_id, partition)
         if kind == idc_query.CHECK_UP:
             return True
         raise ValueError(f"unknown inter-DC query kind {kind!r}")

@@ -634,7 +634,22 @@ class NodeServer:
             pm = self.node.partitions[p]
             if not isinstance(pm, PartitionManager):
                 raise RemoteCallError(f"partition {p} not local")
-            return pm.log.read_bytes(int(offset), int(max_bytes))
+            # the truncation base rides along (ISSUE 10): byte cursors
+            # are PHYSICAL file offsets now, and a checkpoint
+            # truncation rewrites the file — the puller must detect a
+            # mid-copy rewrite and restart, or its concatenation
+            # carries a silent CRC seam recovery would truncate at.
+            # The base is sampled BEFORE and AFTER the read: a
+            # truncation during the read would otherwise label old-
+            # layout bytes with the new base and defeat the check.
+            for _ in range(5):
+                b0 = self._log_trunc_base(pm)
+                data, end = pm.log.read_bytes(int(offset),
+                                              int(max_bytes))
+                if self._log_trunc_base(pm) == b0:
+                    return data, end, b0
+            raise RemoteCallError(
+                f"partition {p}: log kept truncating under the fetch")
         if kind == "handoff_begin":
             p, from_owner = payload
             return self._handoff_begin(int(p), from_owner)
@@ -648,9 +663,10 @@ class NodeServer:
             p, new_owner = payload
             return self._handoff_settle(int(p), new_owner)
         if kind == "handoff_cutover":
-            p, new_owner, b_cursor = payload
+            p, new_owner, b_cursor = payload[0], payload[1], payload[2]
+            b_base = int(payload[3]) if len(payload) > 3 else None
             return self._handoff_cutover(int(p), new_owner,
-                                         int(b_cursor))
+                                         int(b_cursor), b_base)
         if kind == "ring_update":
             ring_pairs, member_pairs, clients = payload
             self._apply_ring_update(
@@ -786,13 +802,25 @@ class NodeServer:
             return self._handoff_in.setdefault(
                 int(p), {"lock": threading.Lock(), "cancelled": False})
 
-    def _handoff_begin(self, p: int, from_owner) -> int:
+    @staticmethod
+    def _log_trunc_base(pm) -> int:
+        """The partition log's truncation base (0 when logging is off)
+        — the handoff byte-stream's layout epoch: a change means the
+        file was rewritten under the physical cursors."""
+        return pm.log.log.truncated_base if pm.log.enabled else 0
+
+    def _handoff_begin(self, p: int, from_owner):
         """Receiving side, serving phase: pull the partition's log in
         chunks from the current owner into a staged file, re-pulling
         until the remaining delta is small (the riak_core handoff fold
         while the vnode keeps serving, reference
-        src/logging_vnode.erl:781-812).  Returns the staged cursor; the
-        final tail arrives pushed by the owner's cutover."""
+        src/logging_vnode.erl:781-812).  Returns (staged cursor,
+        truncation base the copy is consistent with); the final tail
+        arrives pushed by the owner's cutover, which re-verifies the
+        base — a checkpoint truncation rewrites the log file, and a
+        cursor from the old layout concatenated with new-layout bytes
+        would hand recovery a silent CRC seam (everything after it
+        silently truncated at the receiver)."""
         if self.meta.get("cluster_resize") is not None:
             raise RemoteCallError(
                 "cluster resize in progress; no handoff may start")
@@ -802,19 +830,36 @@ class NodeServer:
             # attempt's settlement probe left behind
             ent["cancelled"] = False
         staged = self._staged_path(p)
-        cursor = 0
-        with open(staged, "wb") as f:
-            while True:
-                data, end = self._rpc(from_owner, "handoff_fetch",
-                                      (p, cursor, 4 << 20))
-                if data:
-                    f.write(data)
-                    cursor += len(data)
-                if end - cursor <= 65536:
-                    break
-            f.flush()
-            os.fsync(f.fileno())
-        return cursor
+        for _attempt in range(5):
+            cursor = 0
+            base = None
+            restart = False
+            with open(staged, "wb") as f:
+                while True:
+                    ans = self._rpc(from_owner, "handoff_fetch",
+                                    (p, cursor, 4 << 20))
+                    # a pre-truncation owner answers (data, end) with
+                    # no base — its log is never rewritten, so base 0
+                    # is exact (same mixed-version tolerance as the
+                    # cutover's len(payload) > 3 check)
+                    data, end, b = ans if len(ans) == 3 else (*ans, 0)
+                    if base is None:
+                        base = int(b)
+                    elif int(b) != base:
+                        restart = True  # rewritten mid-copy: rebuild
+                        break
+                    if data:
+                        f.write(data)
+                        cursor += len(data)
+                    if end - cursor <= 65536:
+                        break
+                f.flush()
+                os.fsync(f.fileno())
+            if not restart:
+                return cursor, int(base or 0)
+        raise RemoteCallError(
+            f"partition {p}: log kept truncating under the handoff "
+            "pre-copy; pause checkpoint truncation and re-drive")
 
     def _handoff_install(self, p: int, base_offset: int,
                          tail: bytes) -> bool:
@@ -855,6 +900,15 @@ class NodeServer:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(staged, self.node._log_path(p))
+            # a stale LOCAL checkpoint (from a previous ownership of
+            # this slot) describes a different log's layout — adopting
+            # it against the transferred file would seed wrong state
+            # and skip the prefix; the transferred log recovers by
+            # full scan (the .ckpt does not travel — ROADMAP)
+            try:
+                os.remove(self.node._log_path(p) + ".ckpt")
+            except OSError:
+                pass
             self.node.ring[p] = self.node_id
             self.node.adopt_partition(p)
             prev = self.plane.get_stable_snapshot() if self.plane \
@@ -900,7 +954,8 @@ class NodeServer:
         return not isinstance(self.node.partitions[p],
                               PartitionManager)
 
-    def _handoff_cutover(self, p: int, new_owner, b_cursor: int) -> bool:
+    def _handoff_cutover(self, p: int, new_owner, b_cursor: int,
+                         b_base: int | None = None) -> bool:
         """Owning side, cutover: drain the partition (park new mutating
         work, let prepared transactions resolve, drain local
         transactions via the TxnGate), push the final log tail to the
@@ -908,7 +963,16 @@ class NodeServer:
         wrong-owner redirect.  The stable contribution stays pinned at
         the transferred commit watermark until the global re-plan, so
         the DC snapshot cannot pass a commit the new owner is still
-        preparing (their clock advances past the watermark at adopt)."""
+        preparing (their clock advances past the watermark at adopt).
+
+        ``b_base``: the truncation base the receiver's pre-copy was
+        consistent with (None = caller predates the check) — a
+        checkpoint truncation since then rewrote the file, so the
+        byte cursor no longer addresses the layout the staged copy
+        was cut from; the cutover refuses (clean failure: the
+        partition un-retires and the driver re-drives, re-staging
+        from the new layout) instead of pushing a tail that would
+        seam the receiver's file and silently truncate at recovery."""
         pm = self.node.partitions[p]
         if not isinstance(pm, PartitionManager):
             raise RemoteCallError(
@@ -955,6 +1019,13 @@ class NodeServer:
                     # (advisor r04: cutover TOCTOU)
                     with pm._lock:
                         if not pm.prepared:
+                            if b_base is not None and \
+                                    self._log_trunc_base(pm) != b_base:
+                                raise RemoteCallError(
+                                    f"partition {p}: log truncated "
+                                    "during the handoff pre-copy "
+                                    "(layout epoch moved); re-drive "
+                                    "to re-stage")
                             pm.retired = True
                             tail, end = pm.log.read_bytes(
                                 b_cursor, 1 << 62)
@@ -1253,8 +1324,8 @@ class NodeServer:
                         f"old owner {old!r} could not settle its copy; "
                         f"resolve connectivity and re-drive")
                 continue
-            cursor = self._rpc(new, "handoff_begin", (p, old))
-            self._rpc(old, "handoff_cutover", (p, new, cursor))
+            cursor, base = self._rpc(new, "handoff_begin", (p, old))
+            self._rpc(old, "handoff_cutover", (p, new, cursor, base))
         clients = sorted(set(self._members) - owners, key=repr)
         payload = (list(new_ring.items()),
                    list(self._members.items()), clients)

@@ -50,10 +50,54 @@ class Config:
     #: live in Python memory; written-through bytes reach the page
     #: cache, which survives a process crash)
     log_group_bytes: int = 256 * 1024
+    #: publish commit effects only AFTER the durability ticket is
+    #: covered (strict durability-before-visibility ordering): under
+    #: the group-commit plane with sync_on_commit, the commit record
+    #: stages, the committer waits out the shared fsync, and only THEN
+    #: makes the effects visible (readers block on the prepared entry
+    #: meanwhile).  Default off keeps the reference's async-log-ack
+    #: window: visibility precedes durability, the ack follows the
+    #: fsync (the PR-8 ROADMAP remaining item; ordering asserted by
+    #: tests/unit/test_checkpoint.py)
+    publish_after_durable: bool = False
     #: append records to the durable log at all (reference enable_logging)
     enable_logging: bool = True
     #: rebuild the materializer caches from the log at boot
     recover_from_log: bool = True
+    #: per-partition checkpoint plane (antidote_tpu/oplog/checkpoint.py,
+    #: ISSUE 10): periodically fold every dirty key's materialized
+    #: state at a cut frontier (device keys via one batched fold per
+    #: type plane, host keys via the materializer) into an atomic
+    #: checksummed file; recovery becomes load-checkpoint +
+    #: replay-suffix (O(delta) in the ops past the cut), restarts
+    #: recover partitions in parallel, and eviction/read-below-base
+    #: replay seeds from the checkpoint instead of offset 0.  False
+    #: keeps today's full-scan recovery bit-for-bit (the benches'
+    #: comparison baseline, like log_group / mat_ingest / read_serve).
+    #: Requires recover_from_log: with boot-time recovery off there is
+    #: no recovery cost to cut, and the plane stays off (a truncation
+    #: could otherwise reclaim the only copy of history the seed set
+    #: never covered)
+    ckpt: bool = True
+    #: published-op watermark per partition: past it the next commit
+    #: writes a checkpoint
+    ckpt_ops: int = 4096
+    #: appended-byte watermark per partition log (the other trigger)
+    ckpt_bytes: int = 4 * 1024 * 1024
+    #: reclaim log bytes below the checkpoint cut (atomic rewrite
+    #: behind a truncation marker; logical offsets stay stable).
+    #: Bounded by the retention floor — min over peers of the inter-DC
+    #: ship/ack watermark — so connected peers' gap repair keeps
+    #: answering from the log; a peer beyond the floor gets the
+    #: explicit BELOW_FLOOR answer and bootstraps from the checkpoint
+    #: (interdc/query.py, interdc/sub_buf.py).  NOTE: ring resizes
+    #: fold FULL log histories and refuse to run over a truncated log
+    #: — disable this knob for deployments that resize in place.
+    ckpt_truncate: bool = True
+    #: opid safety margin kept below the peers' ship watermark when
+    #: truncating: ordinary gap repair (lost frames) stays served from
+    #: the log for this much recent history
+    ckpt_retain_ops: int = 4096
     #: number of partitions per node (reference ring size, default 16 prod
     #: / 4 in tests, config/vars.config:5)
     n_partitions: int = 4

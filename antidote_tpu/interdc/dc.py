@@ -220,6 +220,15 @@ class DataCenter(AntidoteTPU):
             self.senders[p].seed_watermark(
                 pm.log.op_counters.get(dc_id, 0))
             self.dep_gates[p].seed_clock(pm.log.max_commit_vc)
+            # retention floor for checkpoint truncation (ISSUE 10):
+            # with peers subscribed, keep log history back to the ship
+            # watermark (minus the retain_ops margin — applied in the
+            # partition log) so ordinary gap repair stays answerable;
+            # with no peers, truncation may reach the cut and a later
+            # join bootstraps from the checkpoint
+            pm.log.retention_opid_source = (
+                lambda _s=self.senders[p]:
+                _s.last_sent_opid if self.connected_dcs else None)
 
     # ---------------------------------------------------------- membership
 
@@ -267,6 +276,7 @@ class DataCenter(AntidoteTPU):
                 deliver=self._make_gate_deliver(p),
                 deliver_batch=self._make_gate_deliver_batch(p),
                 fetch_range=self._fetch_range,
+                bootstrap=self._bootstrap_from_ckpt,
                 # crash recovery: resume the stream where the local log
                 # left off (reference src/inter_dc_sub_buf.erl:58-76)
                 last_opid=self.node.partitions[p].log.op_counters.get(
@@ -450,6 +460,23 @@ class DataCenter(AntidoteTPU):
         return idc_query.fetch_log_range(self.bus, self.node.dc_id,
                                          origin_dc, partition, first, last)
 
+    def _bootstrap_from_ckpt(self, origin_dc, partition: int
+                             ) -> Optional[int]:
+        """BELOW_FLOOR escalation (ISSUE 10): fetch the origin's
+        partition checkpoint, merge its seed states into the local
+        partition (local concurrent writes survive — the seeds are
+        VC-gated merge bases, PartitionManager.bootstrap_seed), seed
+        the dependency gate's clock with the cut frontier, and return
+        the origin's commit watermark at the cut for the SubBuf to
+        jump to.  None = unreachable / origin does not checkpoint."""
+        ans = idc_query.fetch_ckpt_bootstrap(
+            self.bus, self.node.dc_id, origin_dc, partition)
+        if ans is None:
+            return None
+        return idc_query.install_ckpt_bootstrap(
+            self.node.partitions[partition], self.dep_gates[partition],
+            origin_dc, partition, ans)
+
     # ------------------------------------------------------------ queries
 
     def _handle_query(self, from_dc, kind: str, payload) -> Any:
@@ -467,6 +494,15 @@ class DataCenter(AntidoteTPU):
             tracer.instant("interdc_snapshot_read", "interdc",
                            origin=str(from_dc), keys=len(objects))
             return idc_query.answer_snapshot_read(self, objects, clock)
+        if kind == idc_query.CKPT_READ:
+            (partition,) = payload
+            # a remote SubBuf fell below our retention floor: cut a
+            # fresh checkpoint and hand over the seed states (ISSUE 10)
+            tracer.instant("interdc_ckpt_read", "interdc",
+                           origin=str(from_dc), partition=partition)
+            return idc_query.answer_ckpt_read(
+                self.node.partitions[partition], self.node.dc_id,
+                partition)
         if kind == idc_query.CHECK_UP:
             return True
         if kind == idc_query.BCOUNTER_REQUEST:

@@ -13,20 +13,52 @@ plane (api.read_objects_static's fast path — no interactive
 transaction, coalesced with the serving DC's own readers).  This is
 the cross-DC remote-read leg the causal probe and federated clients
 use instead of replaying log ranges for a value question.
+
+ISSUE 10 adds retention awareness: a LOG_READ whose range reaches
+below the origin's truncation floor gets the explicit BELOW_FLOOR
+answer (the records are reclaimed — their history lives in the
+origin's checkpoint), and the CKPT_READ kind fetches that checkpoint:
+per-key seed states at the cut frontier plus the stream watermarks.
+The requesting SubBuf escalates a BELOW_FLOOR repair to a
+CKPT_READ bootstrap (seed state + suffix) instead of wedging in
+gap-repair retries (interdc/sub_buf.py).
 """
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Tuple
 
 from antidote_tpu.clocks import VC
 from antidote_tpu.interdc.transport import LinkDown, Transport
 from antidote_tpu.interdc.wire import InterDcTxn
+from antidote_tpu.obs.spans import tracer
+from antidote_tpu.oplog.partition import BelowRetentionFloor
+
+log = logging.getLogger(__name__)
 
 LOG_READ = "log_read"
 BCOUNTER_REQUEST = "bcounter_request"
 CHECK_UP = "check_up"
 SNAPSHOT_READ = "snapshot_read"
+CKPT_READ = "ckpt_read"
+
+#: first element of a LOG_READ answer that could not be served because
+#: the range lies below the origin's retention floor
+BELOW_FLOOR = "below_floor"
+
+
+def below_floor_answer(floor: int) -> Tuple[str, int]:
+    """The LOG_READ answer for a range the origin's log no longer
+    holds: (marker, the origin's floor commit opid)."""
+    return (BELOW_FLOOR, int(floor))
+
+
+def is_below_floor(ans) -> bool:
+    """True iff ``ans`` is a BELOW_FLOOR answer (and not a txn list —
+    a real answer is a list, never a 2-tuple led by the marker)."""
+    return (isinstance(ans, tuple) and len(ans) == 2
+            and ans[0] == BELOW_FLOOR)
 
 
 def fetch_log_range(transport: Transport, own_dc, origin_dc, partition: int,
@@ -52,10 +84,19 @@ def answer_log_read(partition_log, dc_id, partition: int, first: int,
     sequence itself — identical to what the live sender produced, since
     its watermark is always the previous commit record's opid
     (antidote_tpu/interdc/sender.py).
+
+    A range reaching below a TRUNCATED prefix answers BELOW_FLOOR
+    (ISSUE 10): a silently partial answer would let the requester
+    advance its watermark past history it never received, so the
+    impossibility is explicit and the requester bootstraps from the
+    checkpoint instead.
     """
-    return [InterDcTxn.from_ops(dc_id, partition, prev, done)
-            for prev, done in partition_log.committed_txns_in_range(
-                dc_id, first, last)]
+    try:
+        return [InterDcTxn.from_ops(dc_id, partition, prev, done)
+                for prev, done in partition_log.committed_txns_in_range(
+                    dc_id, first, last)]
+    except BelowRetentionFloor as e:
+        return below_floor_answer(e.floor)
 
 
 def fetch_snapshot_read(transport: Transport, own_dc, origin_dc,
@@ -74,6 +115,73 @@ def fetch_snapshot_read(transport: Transport, own_dc, origin_dc,
     except LinkDown:
         return None
     return list(values), VC(vc)
+
+
+def fetch_ckpt_bootstrap(transport: Transport, own_dc, origin_dc,
+                         partition: int) -> Optional[dict]:
+    """Ask ``origin_dc`` for its partition checkpoint (the BELOW_FLOOR
+    escalation): {keys: {key: (type, state, vc dict)}, clock: vc dict,
+    commit_opid, op_counter} or None when the origin is unreachable or
+    does not checkpoint (the requester keeps buffering and retries)."""
+    try:
+        return transport.request(own_dc, origin_dc, CKPT_READ,
+                                 (partition,))
+    except LinkDown:
+        return None
+
+
+def install_ckpt_bootstrap(pm, gate, origin_dc, partition: int,
+                           ans: dict) -> int:
+    """Receiver-side install of a CKPT_READ answer — the ONE home for
+    the bootstrap semantics (DataCenter and the federated member both
+    route here; the PR-6 adopt_from_wire lesson): merge the origin's
+    seed states into the local partition (local concurrent writes
+    survive — PartitionManager.bootstrap_seed), seed the dependency
+    gate's clock with the cut frontier, and return the origin's
+    commit watermark at the cut for the SubBuf to jump to."""
+    with tracer.span("ckpt_bootstrap_install", "interdc",
+                     origin=str(origin_dc), partition=partition,
+                     keys=len(ans["keys"])):
+        pm.bootstrap_seed(
+            ((key, tn, state, VC(vc))
+             for key, (tn, state, vc) in ans["keys"].items()),
+            origin_dc=origin_dc, op_counter=ans["op_counter"])
+        gate.seed_clock(VC(ans["clock"]))
+        # make the seeds DURABLE before the caller jumps the stream
+        # watermark: they exist only in the host store, but the jump is
+        # made durable by the very next suffix append — a crash before
+        # the next watermark-triggered checkpoint would recover the
+        # advanced watermark with no seeds and silently serve holes for
+        # the origin's below-cut history, with nothing left to
+        # re-request.  A failed (or disabled, Config.ckpt=False)
+        # persist keeps the live install — only crash-durability is at
+        # risk — but must be loud.
+        try:
+            persisted = pm.checkpoint_now()
+        except Exception:  # noqa: BLE001 — never fail the install
+            persisted = None
+            log.exception(
+                "checkpoint after ckpt bootstrap of partition %d from "
+                "%s failed", partition, origin_dc)
+        if persisted is None:
+            log.error(
+                "partition %d: bootstrap seeds from %s are NOT durable "
+                "(checkpointing disabled or failed) — a crash before "
+                "the next checkpoint loses the origin's below-cut "
+                "history", partition, origin_dc)
+    return ans["commit_opid"]
+
+
+def answer_ckpt_read(pm, own_dc, partition: int) -> Optional[dict]:
+    """Server side of CKPT_READ: cut a fresh checkpoint on the owning
+    PartitionManager and answer with its seeds + watermarks (None when
+    checkpointing is disabled)."""
+    ans = pm.ckpt_bootstrap_answer(own_dc)
+    if ans is None:
+        return None
+    # clocks cross administrative domains as plain dicts, like
+    # SNAPSHOT_READ's (the termcodec VC form is for wire frames)
+    return ans
 
 
 def answer_snapshot_read(db, objects, clock) -> Tuple[List, dict]:

@@ -22,6 +22,17 @@ appends the whole arrival in one ring scatter and admits it with one
 fixpoint instead of per-txn passes.  Duplicate prefixes inside a
 re-sent batch drop txn-by-txn; a gap anywhere buffers the remainder
 and triggers the same repair fetch as the per-txn path.
+
+ISSUE 10 adds the retention-aware escalation: when the origin has
+TRUNCATED its log below the requested repair range, the fetch answers
+the explicit BELOW_FLOOR marker instead of a txn list.  A SubBuf with
+a ``bootstrap`` callback then re-seeds from the origin's checkpoint —
+the callback installs the origin's per-key seed states + clocks into
+the local partition and returns the origin's commit watermark at its
+cut; the stream watermark jumps there and ordinary repair fetches the
+retained suffix.  Without the callback (or while the origin is
+unreachable) the stream stays ``buffering`` and retries on the next
+frame — behind, but never wedged on an answer that cannot come.
 """
 
 from __future__ import annotations
@@ -30,6 +41,8 @@ import time
 from collections import deque
 from typing import Any, Callable, List, Optional
 
+from antidote_tpu import stats
+from antidote_tpu.interdc import query as idc_query
 from antidote_tpu.interdc.wire import InterDcTxn
 from antidote_tpu.obs.events import recorder
 from antidote_tpu.obs.spans import tracer
@@ -53,7 +66,9 @@ class SubBuf:
                                        Optional[List[InterDcTxn]]],
                  last_opid: int = 0,
                  deliver_batch: Optional[
-                     Callable[[List[InterDcTxn]], None]] = None):
+                     Callable[[List[InterDcTxn]], None]] = None,
+                 bootstrap: Optional[Callable[[Any, int],
+                                              Optional[int]]] = None):
         self.origin_dc = origin_dc
         self.partition = partition
         #: hand one txn to the dependency gate
@@ -63,8 +78,14 @@ class SubBuf:
         self._deliver_batch = deliver_batch
         #: fetch_range(origin_dc, partition, first, last) -> [InterDcTxn]
         #: or None when the origin is unreachable (repair retried on the
-        #: next incoming frame)
+        #: next incoming frame), or a BELOW_FLOOR marker (ISSUE 10)
         self._fetch_range = fetch_range
+        #: bootstrap(origin_dc, partition) -> new watermark opid or
+        #: None — the BELOW_FLOOR escalation: install the origin's
+        #: checkpoint seed states locally and return its commit
+        #: watermark at the cut (wired by the DC layer; None = no
+        #: escalation available, stay buffering)
+        self._bootstrap = bootstrap
         self.last_opid = last_opid
         self.state = "normal"  # | "buffering"
         self._queue: deque = deque()
@@ -185,6 +206,37 @@ class SubBuf:
                             dur_s=round(time.perf_counter() - t0, 6))
             if missing is None:
                 return  # origin unreachable; retry on next frame
+            if idc_query.is_below_floor(missing):
+                # the origin truncated its log below the requested
+                # range (ISSUE 10): no repair answer can ever come —
+                # escalate to a checkpoint-state bootstrap (seed state
+                # + suffix) instead of wedging in repair retries
+                recorder.record("interdc", "subbuf_below_floor",
+                                origin=str(self.origin_dc),
+                                partition=self.partition,
+                                first=self.last_opid + 1,
+                                floor=missing[1])
+                if self._bootstrap is None:
+                    return  # no escalation wired: stay buffering
+                with tracer.span("subbuf_bootstrap", "interdc",
+                                 origin=str(self.origin_dc),
+                                 partition=self.partition,
+                                 floor=missing[1]):
+                    new_wm = self._bootstrap(self.origin_dc,
+                                             self.partition)
+                recorder.record("interdc", "subbuf_bootstrap",
+                                origin=str(self.origin_dc),
+                                partition=self.partition,
+                                watermark=new_wm,
+                                ok=new_wm is not None)
+                if new_wm is None or int(new_wm) <= self.last_opid:
+                    # unreachable, no checkpoint, or no progress (the
+                    # origin's cut is not past our watermark yet) —
+                    # retry on the next frame rather than spin
+                    return
+                stats.registry.ckpt_bootstraps.inc()
+                self.last_opid = int(new_wm)
+                continue  # drain the queue / repair above the cut
             for txn in sorted(missing, key=lambda t: t.last_opid()):
                 if txn.last_opid() > self.last_opid:
                     _note_admit(txn)

@@ -58,7 +58,9 @@ class HostStore:
     """One partition's in-memory versioned store."""
 
     def __init__(self, log_fallback: Optional[Callable[..., list]] = None,
-                 has_history: Optional[Callable[[Any], bool]] = None):
+                 has_history: Optional[Callable[[Any], bool]] = None,
+                 seed_source: Optional[Callable[[Any], Optional[tuple]]]
+                 = None):
         #: key -> entry
         self._data: Dict[Any, _KeyEntry] = {}
         #: optional PartitionLog.committed_payloads for cache misses
@@ -67,12 +69,18 @@ class HostStore:
         #: without it, a read of a never-written key scans the whole log
         #: just to find nothing, every time
         self._has_history = has_history
+        #: optional PartitionLog.seed_for (ISSUE 10): the checkpoint's
+        #: (type_name, state, frontier VC) base for a key — a cache-
+        #: miss entry built from the log fallback starts from it, so
+        #: the (possibly truncated) below-cut history never replays
+        self._seed_source = seed_source
 
     def entry_count(self) -> int:
         return len(self._data)
 
     def seed_state(self, key, type_name: str, state,
-                   vc: Optional[VC] = None) -> None:
+                   vc: Optional[VC] = None,
+                   base_op_id: Optional[int] = None) -> None:
         """Install a key whose ONLY content is a materialized snapshot
         — the unlogged-eviction migration path (ISSUE 9 satellite): a
         device plane dropping a key with no durable log to replay
@@ -81,14 +89,25 @@ class HostStore:
         frontier at eviction) serve the state, and later inserts apply
         on top; reads strictly below it have no history to replay
         anywhere — they take the pruned->log path, which is empty by
-        construction in unlogged mode."""
+        construction in unlogged mode.
+
+        ``base_op_id`` (ISSUE 10 bootstrap): which existing ops the
+        snapshot claims to contain.  The default (``e.next_seq``) says
+        ALL of them — right when the state was folded from this
+        replica's own history (eviction export, checkpoint seed at
+        recovery).  A checkpoint-BOOTSTRAP seed from another DC passes
+        0: local ops it never saw must re-apply on top, and the ones
+        it did fold are replay-gated by the seed's VC
+        (op_covered_by)."""
         e = self._data.get(key)
         if e is None:
             e = self._data[key] = _KeyEntry(key, type_name)
         elif e.type_name != type_name:
             raise ValueError(
                 f"type mismatch for {key!r}: {e.type_name} vs {type_name}")
-        snap = MaterializedSnapshot(last_op_id=e.next_seq, value=state)
+        snap = MaterializedSnapshot(
+            last_op_id=(e.next_seq if base_op_id is None
+                        else base_op_id), value=state)
         # an empty VC is <= every read clock, so a frontier-less seed
         # (key evicted before any publish — not reachable in practice)
         # still serves rather than vanishing behind _best_snapshot's
@@ -155,12 +174,22 @@ class HostStore:
         e = self._data.get(key)
         if e is None:
             e = _KeyEntry(key, type_name)
+            seed = self._seed_source(key) if self._seed_source \
+                is not None else None
+            if seed is not None and seed[0] == type_name:
+                # checkpoint base (ISSUE 10): the entry starts from
+                # the folded state at the cut; the fallback below only
+                # contributes the retained suffix, and any of its ops
+                # the seed already folded are replay-gated by its VC
+                e.snapshots.insert(
+                    0, (seed[2], MaterializedSnapshot(0, seed[1])))
+                e.pruned = True
             if self._log_fallback is not None and (
                     self._has_history is None or self._has_history(key)):
                 for i, p in self._log_fallback(key=key):
                     e.next_seq += 1
                     e.ops.insert(0, (e.next_seq, p))
-            if e.ops:
+            if e.ops or e.snapshots:
                 self._data[key] = e
             else:
                 return get_type(type_name).new(), None
@@ -172,12 +201,20 @@ class HostStore:
         if base_vc is None and e.pruned:
             # history below every cached snapshot was GC'd — replay the
             # log (reference get_from_snapshot_log,
-            # src/materializer_vnode.erl:415-419)
+            # src/materializer_vnode.erl:415-419).  A checkpoint-seeded
+            # key forces the ASSEMBLING scan: its per-key index only
+            # covers the suffix past the cut, and a read that landed
+            # here was not based on the seed — the scan is exact while
+            # the below-cut bytes remain (ISSUE 10)
             if self._log_fallback is None:
                 raise LookupError(
                     "read below pruned history and no log fallback")
-            res = materialize_from_log(
-                e.type_name, self._log_fallback(key=e.key), read_vc, txid)
+            seeded = (self._seed_source is not None
+                      and self._seed_source(e.key) is not None)
+            payloads = self._log_fallback(key=e.key, scan=True) \
+                if seeded else self._log_fallback(key=e.key)
+            res = materialize_from_log(e.type_name, payloads, read_vc,
+                                       txid)
             return res.value, res.snapshot_vc
         resp = SnapshotGetResponse(
             snapshot_time=base_vc,

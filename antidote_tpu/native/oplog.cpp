@@ -102,6 +102,49 @@ int64_t oplog_recover(void* h) {
     return off;
 }
 
+// Like oplog_recover but resumes validation at `start` — a durable
+// watermark the caller trusts (a checkpoint cut, ISSUE 10): a torn
+// tail can only live at the END of an append-only file, bytes below
+// the cut were validated by the run that wrote them, and every later
+// read re-checks its record's CRC anyway — so open-time recovery cost
+// becomes O(suffix), not O(file).  Returns the recovered end offset;
+// -2 when `start` is not a valid record boundary (the caller falls
+// back to the full scan — a bogus resume point must never truncate
+// good data), -1 on error.
+int64_t oplog_recover_from(void* h, int64_t start) {
+    OpLog* log = static_cast<OpLog*>(h);
+    struct stat st;
+    if (fstat(log->fd, &st) != 0) return -1;
+    int64_t size = st.st_size, off = start;
+    if (start < 0 || start > size) return -2;
+    uint8_t hdr[kHeader];
+    std::string buf;
+    bool validated_one = false;
+    while (off + (int64_t)kHeader <= size) {
+        if (pread(log->fd, hdr, kHeader, off) != (ssize_t)kHeader) break;
+        uint32_t len, crc;
+        memcpy(&len, hdr, 4);
+        memcpy(&crc, hdr + 4, 4);
+        if (len == 0 || off + (int64_t)kHeader + len > size) break;
+        buf.resize(len);
+        if (pread(log->fd, &buf[0], len, off + kHeader) != (ssize_t)len) break;
+        if (crc32(reinterpret_cast<const uint8_t*>(buf.data()), len) != crc)
+            break;
+        off += kHeader + len;
+        validated_one = true;
+    }
+    if (off < size && !validated_one)
+        return -2;  // first suffix record invalid: bogus start or a
+                    // tail torn right at the cut — full scan decides
+    if (off < size) {
+        if (ftruncate(log->fd, off) != 0) return -1;
+    }
+    log->end = off;
+    fflush(log->wf);
+    fseeko(log->wf, 0, SEEK_END);
+    return off;
+}
+
 // Append one record; returns its start offset, or -1.
 int64_t oplog_append(void* h, const uint8_t* data, int64_t len) {
     OpLog* log = static_cast<OpLog*>(h);

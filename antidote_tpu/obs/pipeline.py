@@ -20,7 +20,11 @@ aggregates, in one JSON document per registered DataCenter:
   safe-time vector (the quantity the VIS_* safe-time-lag gauges age);
 - **log**: each partition's durable-log group-commit state — staged
   records/bytes, oldest staged age, written vs synced watermarks, and
-  the drain counters (oplog/log.py queue_stats, ISSUE 9).
+  the drain counters (oplog/log.py queue_stats, ISSUE 9) — plus the
+  retention view (ISSUE 10): on-disk file size, retained vs truncated
+  logical bytes, and the newest checkpoint's age/keys/cut
+  (oplog/partition.py log_stats, which also refreshes the
+  LOG_*/CKPT_* growth gauges).
 
 Served at ``GET /debug/pipeline`` by the metrics server (stats.py),
 embedded in causal-probe violation dumps (obs/probe.py), and attached

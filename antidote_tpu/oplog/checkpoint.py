@@ -1,0 +1,212 @@
+"""Per-partition checkpoint store — the snapshot half of O(delta)
+recovery and log truncation (ISSUE 10).
+
+The reference keeps per-key materialized snapshots precisely so reads
+and recovery replay only a log *suffix* (reference
+src/materializer_vnode.erl:36-47, 415-419), and Cure-style
+geo-replication assumes stable state below the causal cut never needs
+re-derivation from the op log.  Before this plane our log grew without
+bound and every cold path paid for it: restart scanned the whole
+partition log, and every eviction or read-below-base replayed a key's
+entire committed history.
+
+A checkpoint document is ONE pickled dict per partition:
+
+- ``cut_offset``: the log's logical end when the cut was taken (under
+  the partition lock) — recovery replays only records at/after it;
+- ``op_counters`` / ``max_commit_vc``: the log watermarks at the cut,
+  so the suffix scan starts from correct seeds instead of offset 0;
+- ``pending``: the in-flight (staged-but-uncommitted) update records
+  at the cut, ``(txid, offset, record bytes)`` in offset order — a txn
+  whose updates precede the cut but whose commit lands after it
+  reassembles from this prefeed (the TxnAssembler's cut-crossing
+  state);
+- ``keys``: ``{key: (type_name, state, frontier VC)}`` — every dirty
+  key's materialized latest value at the cut, folded from the device
+  plane (one batched fold per type through the PR-8 ``export_state``
+  machinery) or the host materializer.  Exactly the seed
+  ``HostStore.seed_state`` installs: reads covering the frontier serve
+  the state, suffix ops apply on top, replay-gating skips in-base ops;
+- ``commit_watermarks``: per-origin last commit opid at the cut — the
+  prev-opid chain seed for gap-repair answers above the cut, and the
+  watermark a bootstrapping remote SubBuf jumps to;
+- ``clock``: the join of every seed frontier (the dependency-clock
+  seed a bootstrap hands the receiving gate).
+
+The file write is atomic and checksummed: frame to a temp file, fsync,
+rename — a crash mid-checkpoint leaves the previous checkpoint intact,
+and recovery then replays the (longer) suffix from the previous cut.
+A torn/corrupt file fails the CRC and loads as None (full-scan
+recovery), never as a half-document.
+
+``ckpt_from_config`` is the one construction path (the
+gate_from_config lesson): Node's partition factory routes through it,
+so boot, repartition, and adopt_partition cannot honor different
+knobs.  ``Config.ckpt=False`` builds no store at all — recovery,
+eviction replay, and gap repair keep today's behavior bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from antidote_tpu import stats
+from antidote_tpu.obs.spans import tracer
+
+#: checkpoint file framing: magic + [u32 len][u32 crc32(body)][body]
+_MAGIC = b"ATPCKPT1"
+_FRAME = struct.Struct("<II")
+
+#: document schema version (bump on layout change; unknown versions
+#: load as None — full-scan recovery, never a misread document)
+DOC_VERSION = 1
+
+
+@dataclass(frozen=True)
+class CheckpointSettings:
+    """The checkpoint plane's knobs — built from Config by
+    :func:`ckpt_from_config` (the single factory)."""
+
+    #: write checkpoints at all; False = no store, today's recovery
+    enabled: bool = True
+    #: published-op watermark: a partition checkpoints after this many
+    #: ops since its last cut
+    every_ops: int = 4096
+    #: appended-byte watermark: ... or after this many new log bytes
+    every_bytes: int = 4 * 1024 * 1024
+    #: reclaim log bytes below the cut after a successful checkpoint
+    #: (gated by the retention floor — see PartitionLog.truncate)
+    truncate: bool = True
+    #: opid safety margin kept BELOW the peers' ship watermark when
+    #: truncating: ordinary gap repair (lost frames) keeps answering
+    #: from the log for this much recent history, so only a peer that
+    #: fell further behind pays the checkpoint-bootstrap escalation
+    retain_ops: int = 4096
+
+
+def ckpt_from_config(config) -> CheckpointSettings:
+    """The one construction path for checkpoint settings."""
+    if config is None:
+        return CheckpointSettings()
+    return CheckpointSettings(
+        enabled=config.ckpt,
+        every_ops=config.ckpt_ops,
+        every_bytes=config.ckpt_bytes,
+        truncate=config.ckpt_truncate,
+        retain_ops=config.ckpt_retain_ops)
+
+
+class CheckpointStore:
+    """Atomic load/store of one partition's checkpoint document."""
+
+    def __init__(self, path: str, settings: CheckpointSettings):
+        self.path = path
+        self.settings = settings
+
+    # ------------------------------------------------------------- load
+
+    def load_doc(self) -> Optional[dict]:
+        """The current checkpoint document, or None when absent, torn,
+        or from an unknown schema (recovery then falls back to the full
+        scan — a bad checkpoint degrades cost, never correctness)."""
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        with tracer.span("ckpt_load", "oplog",
+                         path=os.path.basename(self.path),
+                         bytes=len(raw)):
+            doc = self._parse(raw)
+        return doc
+
+    @staticmethod
+    def _parse(raw: bytes) -> Optional[dict]:
+        hdr = len(_MAGIC) + _FRAME.size
+        if len(raw) < hdr or not raw.startswith(_MAGIC):
+            return None
+        ln, crc = _FRAME.unpack(raw[len(_MAGIC):hdr])
+        body = raw[hdr:hdr + ln]
+        if len(body) < ln or zlib.crc32(body) != crc:
+            return None  # torn mid-write / bit rot: CRC catches it
+        try:
+            doc = pickle.loads(body)
+        except Exception:  # noqa: BLE001 — a corrupt doc must load None
+            return None
+        if not isinstance(doc, dict) or doc.get("version") != DOC_VERSION:
+            return None
+        return doc
+
+    # ------------------------------------------------------------ store
+
+    def write_doc(self, doc: dict) -> int:
+        """Atomically persist ``doc``; returns the file size.  The
+        write is temp + fsync + rename, so a crash at ANY byte leaves
+        either the previous checkpoint or the new one — never a blend
+        (proven by the truncate-at-every-byte differential in
+        tests/unit/test_checkpoint.py)."""
+        t0 = time.perf_counter()
+        body = pickle.dumps(doc, protocol=pickle.HIGHEST_PROTOCOL)
+        raw = _MAGIC + _FRAME.pack(len(body), zlib.crc32(body)) + body
+        tmp = self.path + ".tmp"
+        with tracer.span("ckpt_write", "oplog",
+                         path=os.path.basename(self.path),
+                         bytes=len(raw), keys=len(doc.get("keys", ()))):
+            with open(tmp, "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            _fsync_dir(os.path.dirname(self.path))
+        reg = stats.registry
+        reg.ckpt_writes.inc()
+        reg.ckpt_duration.observe(time.perf_counter() - t0)
+        return len(raw)
+
+    def delete(self) -> None:
+        for p in (self.path, self.path + ".tmp"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def _fsync_dir(d: str) -> None:
+    """Durable rename: fsync the containing directory (best-effort —
+    not every fs exposes a directory fd)."""
+    try:
+        fd = os.open(d or ".", os.O_RDONLY)
+    except OSError:
+        return
+    tracer.instant("ckpt_dir_fsync", "oplog", dir=os.path.basename(d))
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def empty_doc(partition: int) -> dict:
+    """A fresh document skeleton (the writer fills the capture in)."""
+    return {
+        "version": DOC_VERSION,
+        "partition": partition,
+        "cut_offset": 0,
+        "op_counters": {},
+        "max_commit_vc": {},
+        "commit_watermarks": {},
+        "repair_floors": {},
+        "op_floors": {},
+        "pending": [],
+        "pending_floor": 0,
+        "keys": {},
+        "clock": {},
+        "wall_us": time.time_ns() // 1000,
+    }

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import struct
 import threading
 import time
@@ -50,6 +51,54 @@ from antidote_tpu.native.build import ensure_built
 from antidote_tpu.obs.spans import tracer
 
 _HEADER = struct.Struct("<II")  # len, crc32
+
+#: truncation-marker payload (ISSUE 10): when log bytes below a
+#: checkpoint cut are reclaimed, the rewritten file STARTS with one
+#: ordinary CRC-framed record whose payload is this magic + the first
+#: retained record's LOGICAL offset.  Every offset ever handed out
+#: (op-id index, key-commit index, durability tickets, checkpoint
+#: cuts) stays valid across truncation: the log translates logical <->
+#: physical by the marker's delta, and the native scanner needs no
+#: change (the marker is a well-formed record it skips like any other).
+_TRUNC_MAGIC = b"ATPTRUNC\x01"
+_TRUNC_BASE = struct.Struct("<q")
+#: framed size of a truncation-marker record (constant by construction)
+TRUNC_MARKER_LEN = _HEADER.size + len(_TRUNC_MAGIC) + _TRUNC_BASE.size
+
+
+def _trunc_marker(base: int) -> bytes:
+    payload = _TRUNC_MAGIC + _TRUNC_BASE.pack(base)
+    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _parse_trunc_marker(payload: Optional[bytes]) -> Optional[int]:
+    """The marker's logical base, or None when ``payload`` is not a
+    truncation marker."""
+    if payload is None or not payload.startswith(_TRUNC_MAGIC):
+        return None
+    if len(payload) != len(_TRUNC_MAGIC) + _TRUNC_BASE.size:
+        return None
+    return _TRUNC_BASE.unpack(payload[len(_TRUNC_MAGIC):])[0]
+
+
+def _peek_trunc_base(path: str) -> int:
+    """The truncation base of the log at ``path``, read raw (no
+    backend open needed — the recovery-hint translation runs before
+    the backend exists); 0 on a never-truncated/absent log."""
+    try:
+        with open(path, "rb") as f:
+            hdr = f.read(_HEADER.size)
+            if len(hdr) < _HEADER.size:
+                return 0
+            ln, crc = _HEADER.unpack(hdr)
+            if ln != len(_TRUNC_MAGIC) + _TRUNC_BASE.size:
+                return 0
+            payload = f.read(ln)
+            if len(payload) < ln or zlib.crc32(payload) != crc:
+                return 0
+            return _parse_trunc_marker(payload) or 0
+    except OSError:
+        return 0
 
 
 @dataclass(frozen=True)
@@ -107,6 +156,16 @@ class _NativeBackend:
         lib.oplog_append.restype = ctypes.c_int64
         lib.oplog_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_int64]
+        try:
+            lib.oplog_recover_from.restype = ctypes.c_int64
+            lib.oplog_recover_from.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int64]
+            lib.has_recover_from = True
+        except AttributeError:
+            # a stale prebuilt .so without the ISSUE-10 symbol (no
+            # compiler to rebuild): recovery falls back to the full
+            # scan — slower, never wrong
+            lib.has_recover_from = False
         lib.oplog_append_batch.restype = ctypes.c_int64
         lib.oplog_append_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p,
@@ -131,10 +190,23 @@ class DurableLog:
     """One append-only log file with CRC-framed records."""
 
     def __init__(self, path: str, backend: str = "auto",
-                 group: Optional[GroupSettings] = None):
+                 group: Optional[GroupSettings] = None,
+                 recover_hint: int = 0):
+        #: ``recover_hint``: LOGICAL offset the caller trusts as a
+        #: valid record boundary with only durable data below it (a
+        #: checkpoint cut, ISSUE 10) — open-time torn-tail recovery
+        #: then validates only the suffix past it, O(delta) instead of
+        #: O(file).  A hint that turns out not to be a boundary falls
+        #: back to the full scan; 0 = always full scan.
         self.path = path
         self._native = None
         self._py = None
+        # a stray rewrite temp is a truncation the crash beat to the
+        # rename: the original file is intact and authoritative
+        try:
+            os.remove(path + ".trunc-tmp")
+        except OSError:
+            pass
         #: guards every native-handle use against close(): a member
         #: shutdown can race an in-flight remote-apply append on a
         #: delivery thread, and calling into the C backend with a freed
@@ -147,21 +219,42 @@ class DurableLog:
         #: out-of-lock backend IO in flight (fsync): close() waits for
         #: this to reach zero before freeing the handle
         self._io_refs = 0
+        phys_hint = 0
+        if recover_hint > 0:
+            base = _peek_trunc_base(path)
+            delta = (base - TRUNC_MARKER_LEN) if base else 0
+            if recover_hint >= base:
+                phys_hint = recover_hint - delta
         lib = _NativeBackend.load() if backend in ("auto", "native") else None
         if lib is not None:
             h = lib.oplog_open(path.encode(), 1)
             if not h:
                 raise OSError(f"cannot open log {path}")
             self._native = (lib, ctypes.c_void_p(h))
-            lib.oplog_recover(self._native[1])
+            recovered = -2
+            if phys_hint > 0 and lib.has_recover_from:
+                recovered = lib.oplog_recover_from(self._native[1],
+                                                   phys_hint)
+            if recovered < 0:
+                lib.oplog_recover(self._native[1])
         elif backend == "native":
             raise RuntimeError("native oplog backend unavailable")
         else:
-            self._py = _PyLog(path)
+            self._py = _PyLog(path, recover_hint=phys_hint)
+        #: truncation state (ISSUE 10): logical offsets are stable
+        #: across truncation — ``_base`` is the first retained logical
+        #: offset, ``_delta`` the logical-minus-physical shift every
+        #: retained record carries (0 on a never-truncated log)
+        self._base = 0
+        self._delta = 0
+        base = _parse_trunc_marker(self._backend_read_locked(0))
+        if base is not None:
+            self._base = base
+            self._delta = base - TRUNC_MARKER_LEN
         # ---- group-commit state (ISSUE 9); inert when _group is None
         self._group = group if (group is not None and group.enabled) \
             else None
-        end = self._backend_end_locked()
+        end = self._backend_end_locked() + self._delta
         #: staged framed-record payloads, stage order == file order
         self._staged: List[bytes] = []
         self._staged_bytes = 0
@@ -193,12 +286,38 @@ class DurableLog:
     def group_active(self) -> bool:
         return self._group is not None
 
+    @property
+    def truncated_base(self) -> int:
+        """First logical offset still on disk (0 = never truncated)."""
+        return self._base
+
     def _backend_end_locked(self) -> int:
+        """PHYSICAL end of the backing file (callers add _delta)."""
         if self._native:
             return self._native[0].oplog_end_offset(self._native[1])
         if self._py is not None:
             return self._py.end
         raise OSError(f"log {self.path} is closed")
+
+    def _backend_read_locked(self, phys: int) -> Optional[bytes]:
+        """Record payload at PHYSICAL offset ``phys`` (None at/past
+        end or on corruption); must run under self._lock."""
+        if phys < 0:
+            return None
+        if self._native:
+            lib, h = self._native
+            n = 4096
+            while True:
+                buf = ctypes.create_string_buffer(n)
+                got = lib.oplog_read(h, phys, buf, n)
+                if got < 0:
+                    return None
+                if got <= n:
+                    return buf.raw[:got]
+                n = int(got)
+        if self._py is None:
+            raise OSError(f"log {self.path} is closed")
+        return self._py.read(phys)
 
     # ------------------------------------------------------------- append
 
@@ -235,10 +354,10 @@ class DurableLog:
                 off = lib.oplog_append(h, payload, len(payload))
                 if off < 0:
                     raise OSError("append failed")
-                return off
+                return off + self._delta
             if self._py is None:
                 raise OSError(f"log {self.path} is closed")
-            return self._py.append(payload)
+            return self._py.append(payload) + self._delta
 
     def append_batch(self, payloads: List[bytes]) -> int:
         """Append many records with ONE backend crossing and one
@@ -268,7 +387,8 @@ class DurableLog:
             return self._append_batch_backend_locked(payloads)
 
     def _append_batch_backend_locked(self, payloads: List[bytes]) -> int:
-        """One backend batch write; must run under self._lock."""
+        """One backend batch write; must run under self._lock.
+        Returns the first record's LOGICAL offset."""
         if self._native:
             lib, h = self._native
             n = len(payloads)
@@ -277,10 +397,10 @@ class DurableLog:
             off = lib.oplog_append_batch(h, data, lens, n)
             if off < 0:
                 raise OSError("batch append failed")
-            return off
+            return off + self._delta
         if self._py is None:
             raise OSError(f"log {self.path} is closed")
-        return self._py.append_batch(payloads)
+        return self._py.append_batch(payloads) + self._delta
 
     def _write_staged_locked(self) -> None:
         """Write every staged record through the backend (ONE batch
@@ -524,29 +644,26 @@ class DurableLog:
                 if self._native is None and self._py is None:
                     raise OSError(f"log {self.path} is closed")
                 return self._logical_end
-            return self._backend_end_locked()
+            return self._backend_end_locked() + self._delta
 
     def read(self, offset: int) -> Optional[bytes]:
+        """Record payload at LOGICAL ``offset``; None past the end or
+        below the truncation base (those bytes are reclaimed — callers
+        serve that history from the checkpoint seed instead)."""
         with self._lock:
             if self._group is not None:
                 self._write_staged_locked()
-            if self._native:
-                lib, h = self._native
-                n = 4096
-                while True:
-                    buf = ctypes.create_string_buffer(n)
-                    got = lib.oplog_read(h, offset, buf, n)
-                    if got < 0:
-                        return None
-                    if got <= n:
-                        return buf.raw[:got]
-                    n = int(got)
-            if self._py is None:
+            if offset < self._base:
+                return None
+            if self._native is None and self._py is None:
                 raise OSError(f"log {self.path} is closed")
-            return self._py.read(offset)
+            return self._backend_read_locked(offset - self._delta)
 
     def scan(self, offset: int = 0) -> Iterator[Tuple[int, bytes]]:
-        """Iterate (offset, payload) from ``offset`` to the end."""
+        """Iterate (offset, payload) from LOGICAL ``offset`` to the
+        end; starts below the truncation base clamp to it (the bytes
+        below are gone, and their history lives in the checkpoint)."""
+        offset = max(offset, self._base)
         while True:
             payload = self.read(offset)
             if payload is None:
@@ -555,16 +672,102 @@ class DurableLog:
             with self._lock:
                 if self._native:
                     nxt = self._native[0].oplog_next(
-                        self._native[1], offset)
+                        self._native[1], offset - self._delta)
                 elif self._py is not None:
-                    nxt = self._py.next_offset(offset)
+                    nxt = self._py.next_offset(offset - self._delta)
                 else:
                     # closed mid-scan: a silent partial history would
                     # be served as a successful replay
                     raise OSError(f"log {self.path} closed mid-scan")
+                if nxt >= 0:
+                    nxt += self._delta
             if nxt < 0:
                 return
             offset = nxt
+
+    # -------------------------------------------------------- truncation
+
+    def truncate_below(self, offset: int) -> int:
+        """Reclaim log bytes below LOGICAL ``offset`` (ISSUE 10): the
+        retained suffix is rewritten behind a truncation-marker record
+        and atomically renamed over the log, so every logical offset
+        ever handed out keeps resolving to the same record and a crash
+        at any point leaves either the old or the new file.  Returns
+        the (possibly unchanged) truncation base; no-op at or below
+        the current base.  Callers gate the cut by the checkpoint and
+        the retention floor (oplog/partition.py) — the log itself only
+        guarantees mechanics, not retention policy."""
+        with self._lock:
+            if self._native is None and self._py is None:
+                raise OSError(f"log {self.path} is closed")
+            if self._group is not None:
+                self._write_staged_locked()
+            if self._native:
+                self._native[0].oplog_flush(self._native[1])
+            else:
+                self._py.flush()
+            end_logical = self._backend_end_locked() + self._delta
+            offset = min(offset, end_logical)
+            if offset <= self._base:
+                return self._base
+            old_base = self._base
+            # an out-of-lock fsync still holds the handle we are about
+            # to close — wait it out (same guard as close())
+            while self._io_refs:
+                self._lock.wait()
+            with tracer.span("log_truncate", "oplog",
+                             path=os.path.basename(self.path),
+                             base=offset, reclaimed=offset - old_base):
+                tmp = self.path + ".trunc-tmp"
+                with open(self.path, "rb") as src, \
+                        open(tmp, "wb") as f:
+                    src.seek(offset - self._delta)
+                    f.write(_trunc_marker(offset))
+                    # chunked copy: the retained suffix can be hundreds
+                    # of MB (the retention floor holds the cut back for
+                    # lagging peers) — one read() would spike RSS by
+                    # the whole window per truncation
+                    shutil.copyfileobj(src, f, 1 << 20)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+                self._reopen_backend_locked()
+            self._base = offset
+            self._delta = offset - TRUNC_MARKER_LEN
+            if self._group is not None:
+                # the whole rewritten file was just fsynced: written
+                # and synced watermarks cover its logical end
+                end = self._backend_end_locked() + self._delta
+                self._logical_end = end
+                self._written_end = end
+                self._synced_end = max(self._synced_end, end)
+            stats.registry.log_truncated_bytes.inc(offset - old_base)
+            self._lock.notify_all()
+            return self._base
+
+    def _reopen_backend_locked(self) -> None:
+        """Swap the backend handle onto the (just-renamed) file — the
+        old handle points at the unlinked inode.  The rewritten file
+        was composed and fsynced by US moments ago, so open-time
+        recovery SKIPS re-validating it (resume at the file size): a
+        full CRC re-scan of possibly hundreds of retained MB would run
+        under both the log and partition locks."""
+        size = os.path.getsize(self.path)
+        if self._native:
+            lib, h = self._native
+            lib.oplog_close(h)
+            self._native = None
+            nh = lib.oplog_open(self.path.encode(), 1)
+            if not nh:
+                raise OSError(f"cannot reopen log {self.path}")
+            self._native = (lib, ctypes.c_void_p(nh))
+            if lib.has_recover_from and \
+                    lib.oplog_recover_from(self._native[1], size) >= 0:
+                return
+            lib.oplog_recover(self._native[1])
+        elif self._py is not None:
+            self._py.close()
+            self._py = _PyLog(self.path, recover_hint=size)
 
     def close(self) -> None:
         with self._lock:
@@ -587,16 +790,24 @@ class DurableLog:
 class _PyLog:
     """Pure-Python twin of the native backend (same on-disk format)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, recover_hint: int = 0):
         self.f = open(path, "a+b")
         self.f.seek(0, os.SEEK_END)
         self.end = self.f.tell()
-        self._recover()
+        if recover_hint <= 0 or not self._recover(recover_hint):
+            self._recover(0)
 
-    def _recover(self) -> None:
+    def _recover(self, start: int) -> bool:
+        """Validate records from PHYSICAL ``start`` and truncate a
+        torn tail (the oplog_recover_from twin).  False when ``start``
+        is not a valid record boundary — the caller reruns from 0 (a
+        bogus resume point must never truncate good data)."""
         self.f.flush()
         size = os.fstat(self.f.fileno()).st_size
-        off = 0
+        if start < 0 or start > size:
+            return False
+        off = start
+        validated_one = False
         while off + _HEADER.size <= size:
             self.f.seek(off)
             hdr = self.f.read(_HEADER.size)
@@ -609,10 +820,14 @@ class _PyLog:
             if len(payload) < ln or zlib.crc32(payload) != crc:
                 break
             off += _HEADER.size + ln
+            validated_one = True
+        if off < size and start > 0 and not validated_one:
+            return False
         if off < size:
             self.f.truncate(off)
         self.end = off
         self.f.seek(0, os.SEEK_END)
+        return True
 
     def append(self, payload: bytes) -> int:
         off = self.end

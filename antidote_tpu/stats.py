@@ -545,6 +545,53 @@ class Registry:
             "antidote_log_records_per_fsync",
             "Amortization ratio of the group-commit plane: records "
             "made durable per fsync over the process lifetime")
+        # ---- checkpoint + log-truncation plane (ISSUE 10,
+        # antidote_tpu/oplog/checkpoint.py): the cold-path economy.
+        # Retained/file byte gauges are what makes on-disk log growth
+        # observable at all (nothing reported it before); checkpoint
+        # age is the recovery-cost bound an operator alarms on (the
+        # suffix a restart replays grows with it).
+        self.log_retained_bytes = LabeledGauge(
+            "antidote_log_retained_bytes",
+            "Logical log bytes above the truncation base per "
+            "partition (what recovery's suffix scan can still read)",
+            labels=("partition",))
+        self.log_file_bytes = LabeledGauge(
+            "antidote_log_file_bytes",
+            "On-disk log file size per partition (retained records "
+            "plus the truncation marker)", labels=("partition",))
+        self.log_truncated_bytes = Counter(
+            "antidote_log_truncated_bytes_total",
+            "Logical log bytes reclaimed by checkpoint truncation")
+        self.ckpt_writes = Counter(
+            "antidote_ckpt_writes_total",
+            "Checkpoint documents atomically persisted")
+        self.ckpt_duration = Histogram(
+            "antidote_ckpt_duration_seconds",
+            "Wall time of one checkpoint write (fold + pickle + fsync "
+            "+ rename)", buckets=lat_buckets)
+        self.ckpt_age = LabeledGauge(
+            "antidote_ckpt_age_seconds",
+            "Age of the partition's newest checkpoint (the recovery "
+            "suffix a restart replays grows with this)",
+            labels=("partition",))
+        self.ckpt_keys = LabeledGauge(
+            "antidote_ckpt_keys",
+            "Materialized key seeds carried by the partition's newest "
+            "checkpoint", labels=("partition",))
+        self.ckpt_truncations = Counter(
+            "antidote_ckpt_truncations_total",
+            "Log truncations performed after checkpoint writes")
+        self.ckpt_bootstraps = Counter(
+            "antidote_ckpt_bootstraps_total",
+            "SubBuf checkpoint-state bootstraps (a gap repair answered "
+            "BELOW_FLOOR and the stream re-seeded from the origin's "
+            "checkpoint instead of wedging in repair retries)")
+        self.ckpt_recovery = Histogram(
+            "antidote_ckpt_recovery_seconds",
+            "Per-partition recovery wall time at boot (checkpoint "
+            "load + suffix replay; the recovery-time trend panel)",
+            buckets=lat_buckets + (30.0, 120.0))
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -575,7 +622,12 @@ class Registry:
                 self.log_fsyncs, self.log_group_records,
                 self.log_group_drains, self.log_group_size,
                 self.log_sync_wait, self.log_staged_records,
-                self.log_records_per_fsync)
+                self.log_records_per_fsync,
+                self.log_retained_bytes, self.log_file_bytes,
+                self.log_truncated_bytes, self.ckpt_writes,
+                self.ckpt_duration, self.ckpt_age, self.ckpt_keys,
+                self.ckpt_truncations, self.ckpt_bootstraps,
+                self.ckpt_recovery)
 
     def exposition(self) -> str:
         lines = []

@@ -25,10 +25,15 @@ from antidote_tpu.clocks import VC
 from antidote_tpu.mat.device_plane import DevicePlane, ReadBelowBase
 from antidote_tpu.mat.host_store import HostStore
 from antidote_tpu.mat.materializer import (
+    MaterializedSnapshot,
     Payload,
+    SnapshotGetResponse,
+    materialize,
     materialize_eager,
     materialize_from_log,
 )
+from antidote_tpu.obs.events import recorder
+from antidote_tpu.obs.spans import tracer
 from antidote_tpu.oplog.partition import PartitionLog
 from antidote_tpu.oplog.records import commit_certified
 from antidote_tpu.txn.clock import HybridClock
@@ -157,9 +162,11 @@ class PartitionManager:
         self.partition = partition
         self.dc_id = dc_id
         self.log = log
+        log.own_dc = dc_id  # the stream the retention floor protects
         self.clock = clock
         self.store = HostStore(log_fallback=log.committed_payloads,
-                               has_history=log.keys_seen.__contains__)
+                               has_history=log.keys_seen.__contains__,
+                               seed_source=log.seed_for)
         #: TPU data plane for supported types (None = host-only node)
         self.device = device_plane
         if device_plane is not None:
@@ -254,6 +261,30 @@ class PartitionManager:
         #: would hand the reader deleted buffers — writers wait for
         #: readers to drain (readers share; mutations exclusive)
         self._dev_readers = 0
+        #: strict durability-before-visibility ordering (ISSUE 10
+        #: satellite, Config.publish_after_durable): commit/apply
+        #: publish their effects only after the durability ticket is
+        #: covered.  Set by the Node's partition factory.
+        self.publish_after_durable = False
+        #: deferred publishes in flight (publish_after_durable): txns
+        #: whose commit record is appended but whose effects are not
+        #: yet in the store.  A checkpoint cut taken inside that window
+        #: would put the commit record BELOW the cut while the seed
+        #: fold misses the effect — the txn would vanish from seed AND
+        #: suffix on recovery — so checkpoint_now quiesces this to 0
+        #: before capturing the cut.
+        self._defer_unpublished = 0
+        #: keys published since the last checkpoint cut (key -> type):
+        #: the incremental fold set of checkpoint_now
+        self._ckpt_dirty: Dict[Any, str] = {}
+        #: published-op / appended-byte counters driving the
+        #: watermark-triggered checkpoint (maybe_checkpoint)
+        self._ckpt_ops = 0
+        self._ckpt_last_end = log.suffix_start if log.enabled else 0
+        #: one checkpoint writer at a time: the persist runs outside
+        #: the partition lock, and unserialized writers could land
+        #: documents on disk out of cut order
+        self._ckpt_inflight = False
 
     # ----------------------------------------------------------- log scans
 
@@ -436,6 +467,10 @@ class PartitionManager:
         fr_old = self.key_frontier.get(key)
         fr_new = (fr_old or VC()).join(payload.commit_vc())
         self.key_frontier[key] = fr_new
+        # checkpoint dirty set (ISSUE 10): this key's folded seed is
+        # stale from here until the next cut re-folds it
+        self._ckpt_dirty[key] = type_name
+        self._ckpt_ops += 1
         # keep the commit-frontier value cache WARM instead of popping
         # it: apply the committed effect to the cached state (the
         # reference materializer applies updates onto its cached
@@ -567,6 +602,16 @@ class PartitionManager:
         replay anywhere, exactly unlogged mode's existing contract."""
         self._val_cache.pop(key, None)
         replayed = False
+        seed = self.log.seed_for(key)
+        if seed is not None and seed[0] == type_name:
+            # checkpoint-seeded migration (ISSUE 10): the host entry
+            # starts from the folded state at the cut, and the log
+            # replay below only contributes the retained suffix —
+            # ops already inside the seed are replay-gated by its VC
+            # (op_covered_by), so the pre-truncation full history and
+            # the post-truncation suffix both reassemble exactly
+            self.store.seed_state(key, type_name, seed[1], seed[2])
+            replayed = True
         for _seq, p in self.log.committed_payloads(key=key):
             self.store.insert(key, type_name, p)
             replayed = True
@@ -595,6 +640,8 @@ class PartitionManager:
         self.key_frontier[key] = (fr_old or VC()).join(
             payload.commit_vc())
         self._val_cache.pop(key, None)
+        self._ckpt_dirty[key] = payload.type_name
+        self._ckpt_ops += 1
 
     def _pre_hosted(self) -> Optional[set]:
         return set(self.device.host_only) if self.device is not None \
@@ -623,26 +670,61 @@ class PartitionManager:
             self.log.append_commit(self.dc_id, txid, commit_time,
                                    snapshot_vc, certified)
             ticket = self.log.commit_ticket()
-            pre_hosted = self._pre_hosted()
-            for key, type_name, effect in self._staged.pop(txid, []):
-                payload = Payload(
-                    key=key, type_name=type_name, effect=effect,
-                    commit_dc=self.dc_id, commit_time=commit_time,
-                    snapshot_vc=snapshot_vc, txid=txid,
-                    certified=certified)
-                if self._mid_batch_migrated(pre_hosted, key):
-                    self._note_skipped_publish(key, payload)
-                else:
-                    self._publish(key, type_name, payload, stable)
-                if commit_time > self.committed.get(key, 0):
-                    self.committed[key] = commit_time
-            self.prepared.pop(txid, None)
-            self._lock.notify_all()
+            defer = self.publish_after_durable and ticket is not None
+            if defer:
+                self._defer_unpublished += 1
+            else:
+                self._publish_commit_locked(txid, commit_time,
+                                            snapshot_vc, certified,
+                                            stable)
         # durability gate OUTSIDE the partition lock: readers and other
         # committers proceed while this committer waits out the shared
         # fsync (its effects are already published — group commit
-        # trades the ack point, not the visibility point)
-        self.log.wait_durable(ticket, txid=txid)
+        # trades the ack point, not the visibility point).  Under
+        # Config.publish_after_durable the order flips: the effects
+        # publish only once the ticket is covered (strict durability-
+        # before-visibility; the prepared entry keeps conflicting
+        # readers blocked across the wait, so no torn visibility).
+        # The deferred publish runs even when the WAIT fails (wedged
+        # drain leader, close race): the commit record is already in
+        # the log — recovery would replay it — and leaving the
+        # prepared entry behind would wedge every conflicting reader
+        # forever; the error still propagates (the ack fails).
+        try:
+            self.log.wait_durable(ticket, txid=txid)
+        finally:
+            if defer:
+                with self._lock:
+                    try:
+                        self._publish_commit_locked(txid, commit_time,
+                                                    snapshot_vc,
+                                                    certified, stable)
+                    finally:
+                        self._defer_unpublished -= 1
+                        self._lock.notify_all()
+        self.maybe_checkpoint()
+
+    def _publish_commit_locked(self, txid, commit_time: int,
+                               snapshot_vc: VC, certified: bool,
+                               stable: Optional[VC]) -> None:
+        """The visibility half of commit(): publish the staged
+        effects, release the prepared entry, wake blocked readers.
+        Must run under self._lock."""
+        pre_hosted = self._pre_hosted()
+        for key, type_name, effect in self._staged.pop(txid, []):
+            payload = Payload(
+                key=key, type_name=type_name, effect=effect,
+                commit_dc=self.dc_id, commit_time=commit_time,
+                snapshot_vc=snapshot_vc, txid=txid,
+                certified=certified)
+            if self._mid_batch_migrated(pre_hosted, key):
+                self._note_skipped_publish(key, payload)
+            else:
+                self._publish(key, type_name, payload, stable)
+            if commit_time > self.committed.get(key, 0):
+                self.committed[key] = commit_time
+        self.prepared.pop(txid, None)
+        self._lock.notify_all()
 
     def single_commit(self, txid, snapshot_vc: VC,
                       certify: bool = True) -> int:
@@ -681,9 +763,8 @@ class PartitionManager:
         stable = self._stable_for_gc()  # before the lock (see __init__)
         certified = all(commit_certified(rec.payload) for rec in records
                         if rec.kind() == "commit")
-        with self._lock:
-            self._mutate_check()
-            ticket = self.log.append_remote_group(records)
+
+        def publish_locked():
             pre_hosted = self._pre_hosted()
             for rec in records:
                 if rec.kind() != "update":
@@ -701,9 +782,34 @@ class PartitionManager:
                 else:
                     self._publish(key, type_name, payload, stable)
             self._lock.notify_all()
+
+        with self._lock:
+            self._mutate_check()
+            ticket = self.log.append_remote_group(records)
+            defer = self.publish_after_durable and ticket is not None
+            if defer:
+                self._defer_unpublished += 1
+            else:
+                publish_locked()
         # remote applies ride the same group-commit durability gate as
-        # local commits (out of lock; see commit())
-        self.log.wait_durable(ticket)
+        # local commits (out of lock; see commit()); under
+        # publish_after_durable the publish follows the covered ticket
+        # (the gate delivers causally-ordered batches from one thread,
+        # so the flipped order cannot reorder two batches), and — like
+        # commit() — still runs when the wait itself fails: the
+        # records are appended and the gate already advanced past this
+        # batch, so skipping the publish would silently drop it
+        try:
+            self.log.wait_durable(ticket)
+        finally:
+            if defer:
+                with self._lock:
+                    try:
+                        publish_locked()
+                    finally:
+                        self._defer_unpublished -= 1
+                        self._lock.notify_all()
+        self.maybe_checkpoint()
 
     # --------------------------------------------------------------- reads
 
@@ -870,8 +976,32 @@ class PartitionManager:
 
     def _read_from_log(self, key, type_name: str, read_vc: Optional[VC],
                        txid=None) -> Any:
-        """Full log replay for one key (reference get_from_snapshot_log,
-        src/materializer_vnode.erl:415-419)."""
+        """Log replay for one key (reference get_from_snapshot_log,
+        src/materializer_vnode.erl:415-419).  With a checkpoint seed
+        covering the read, the replay starts from the folded state at
+        the cut and applies only the retained suffix (O(delta)) —
+        which is also what keeps this path exact after truncation
+        reclaimed the below-cut bytes."""
+        seed = self.log.seed_for(key)
+        if seed is not None and seed[0] == type_name:
+            _tn, state, vc = seed
+            if read_vc is None or vc.le(read_vc):
+                payloads = self.log.committed_payloads(key=key)
+                resp = SnapshotGetResponse(
+                    snapshot_time=vc, ops=list(reversed(payloads)),
+                    materialized=MaterializedSnapshot(0, state))
+                return materialize(type_name, txid, read_vc,
+                                   resp).value
+            # the seed cannot base this read (below/concurrent with
+            # its frontier) and the per-key index only covers the
+            # suffix: the assembling whole-log scan is the exact
+            # answer while the below-cut bytes remain; once truncated
+            # it degrades to the retained history (the documented
+            # unlogged-mode-style contract for reads below the cut)
+            return materialize_from_log(
+                type_name, self.log.committed_payloads(key=key,
+                                                       scan=True),
+                read_vc, txid).value
         return materialize_from_log(
             type_name, self.log.committed_payloads(key=key), read_vc,
             txid).value
@@ -1031,6 +1161,219 @@ class PartitionManager:
                     self._dev_readers -= pending_readers
                     self._lock.notify_all()
         return out
+
+    # --------------------------------------------------------- checkpoint
+
+    def maybe_checkpoint(self) -> None:
+        """Watermark-gated checkpoint trigger, called at the tail of
+        commit/apply_remote (outside the partition lock).  Cheap when
+        not due; a failing checkpoint is logged and retried at the
+        next watermark — it is a cost optimization and must never fail
+        the commit that happened to trip it."""
+        ck = self.log.ckpt
+        if ck is None or not self.log.enabled:
+            return
+        s = ck.settings
+        if self._ckpt_ops < s.every_ops:
+            try:
+                end = self.log.log.end_offset()
+            except OSError:
+                return  # closing
+            if end - self._ckpt_last_end < s.every_bytes:
+                return
+        try:
+            self.checkpoint_now()
+        except Exception:  # noqa: BLE001 — see docstring
+            log.exception("checkpoint of partition %d failed; will "
+                          "retry at the next watermark", self.partition)
+            # reset the counters so a persistent failure does not turn
+            # into a checkpoint attempt per commit; a failure BECAUSE
+            # the log is closing must not escape either (the commit
+            # this rode on is already durable and published)
+            self._ckpt_ops = 0
+            try:
+                self._ckpt_last_end = self.log.log.end_offset()
+            except OSError:
+                pass
+
+    def checkpoint_now(self) -> Optional[dict]:
+        """Cut + fold + persist one checkpoint for this partition
+        (ISSUE 10): under the partition lock (readers quiesced — the
+        device folds below swap donated buffers), capture the log cut,
+        fold every key published since the previous cut — device-
+        resident keys via ONE batched fold per type plane (the PR-8
+        export machinery's read_many path), host keys via the
+        materializer, state-lossy device folds via the exact log
+        replay — and hand the document to the log for the atomic write
+        (+ retention-gated truncation).  Returns the document, or None
+        when checkpointing is disabled."""
+        if self.log.ckpt is None or not self.log.enabled:
+            return None
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._ckpt_inflight:
+                # another thread is mid-checkpoint (its persist runs
+                # outside this lock): reuse its document rather than
+                # stacking writers — the inflight guard is also what
+                # keeps documents landing on disk in cut order
+                return self.log.ckpt_doc
+            self._ckpt_inflight = True
+        dirty: Dict[Any, str] = {}
+        try:
+            with self._lock, \
+                    tracer.span("ckpt_cut", "oplog",
+                                partition=self.partition):
+                # the cut asserts "everything below me is in the seed
+                # fold": a deferred publish in flight (commit record
+                # appended, effects not yet in the store) would break
+                # that — its txn would land below the cut yet in
+                # neither seed nor suffix.  Wait both quiescent; the
+                # condition wait releases the lock, so the deferred
+                # committers' publishes (and device readers) drain.
+                while self._dev_readers or self._defer_unpublished:
+                    self._lock.wait()
+                doc = self.log.capture_cut()
+                dirty, self._ckpt_dirty = self._ckpt_dirty, {}
+                self._ckpt_fold(doc, dirty)
+            # make the log durable UP TO the cut before the document
+            # claims it: open-time recovery resumes validation at the
+            # cut precisely because bytes below it are trusted durable
+            # — a cut over page-cache-only bytes would skip validating
+            # data a power loss corrupted.  Out of the partition lock,
+            # like the persist (one extra fsync per checkpoint).
+            self.log.log.sync()
+            # the persist (pickle + double fsync + rename) runs OUT of
+            # the partition lock — commits and reads proceed while the
+            # document lands (the PR-8 no-fsync-under-the-lock lesson)
+            self.log.persist_checkpoint(doc)
+            with self._lock:
+                self.log.adopt_checkpoint(doc)
+                self._ckpt_ops = 0
+                self._ckpt_last_end = doc["cut_offset"]
+            recorder.record("oplog", "ckpt_cut_done",
+                            partition=self.partition,
+                            keys=len(doc["keys"]), dirty=len(dirty),
+                            dur_s=round(time.perf_counter() - t0, 4))
+            return doc
+        except BaseException:
+            # a failed fold/write must NOT lose the dirty set: the
+            # next (successful) checkpoint would carry these keys'
+            # PREVIOUS-cut seeds while its cut moved past their ops —
+            # re-folding them is what keeps seed+suffix exact.
+            # Publishes during the failure window merged their own
+            # entries; theirs win (newer).
+            with self._lock:
+                merged = dict(dirty)
+                merged.update(self._ckpt_dirty)
+                self._ckpt_dirty = merged
+            raise
+        finally:
+            with self._lock:
+                self._ckpt_inflight = False
+                self._lock.notify_all()
+
+    def _ckpt_fold(self, doc: dict, dirty: Dict[Any, str]) -> None:
+        """Fold the dirty keys into ``doc`` (the capture half of
+        :meth:`checkpoint_now`); runs under self._lock with device
+        readers quiesced."""
+        # carry the previous cut's seeds forward; re-fold only the
+        # dirty keys (the incremental economy)
+        keys = {k: (tn, state, dict(vc))
+                for k, (tn, state, vc) in self.log.ckpt_seeds.items()}
+        clock = VC(self.log.ckpt_doc["clock"]) \
+            if self.log.ckpt_doc else VC()
+        by_type: Dict[str, list] = {}
+        host_items = []
+        for key, tn in dirty.items():
+            if self.device is not None \
+                    and self.device.owns(tn, key) \
+                    and self.device.state_exact(tn, key):
+                by_type.setdefault(tn, []).append(key)
+            else:
+                host_items.append((key, tn))
+        folded: Dict[Any, Tuple[str, Any]] = {}
+        for tn, ks in by_type.items():
+            got = self.device.read_many(ks, tn, None)
+            for k in ks:
+                if k in got:
+                    folded[k] = (tn, got[k])
+                else:  # evicted mid-flush: host path below
+                    host_items.append((k, tn))
+        for key, tn in host_items:
+            if self.device is not None and self.device.owns(tn, key):
+                # STATE_LOSSY fold (set_rw/flag_dw/lossy maps): a
+                # collapsed state seeded into the host store would
+                # feed downstream generation and under-cancel at
+                # exact replicas — replay the (still complete) log
+                # instead; exact by construction
+                folded[key] = (tn, self._read_from_log(key, tn, None))
+            else:
+                folded[key] = (tn, self.store.read(key, tn, None)[0])
+        for key, (tn, state) in folded.items():
+            fr = self.key_frontier.get(key) or VC()
+            keys[key] = (tn, state, dict(fr))
+            clock = clock.join(fr)
+        doc["keys"] = keys
+        doc["clock"] = dict(clock)
+
+    def install_ckpt_seeds(self) -> None:
+        """Boot-time half of checkpoint recovery: install every seed
+        into the materializer plane (host store snapshot at the seed's
+        frontier + key frontier) BEFORE the suffix replay applies the
+        ops past the cut on top.  Seeded keys stay on the host path
+        (the device plane cannot ingest a folded base state — noted in
+        ROADMAP); must run under self._lock."""
+        if not self.log.ckpt_seeds:
+            return
+        for key, (tn, state, vc) in self.log.ckpt_seeds.items():
+            self.store.seed_state(key, tn, state, vc)
+            self.key_frontier[key] = (
+                self.key_frontier.get(key) or VC()).join(vc)
+            if self.device is not None:
+                self.device.host_only.add(key)
+
+    def ckpt_bootstrap_answer(self, own_dc) -> Optional[dict]:
+        """Server side of the CKPT_READ inter-DC query (a remote
+        SubBuf whose gap repair hit BELOW_FLOOR): cut a FRESH
+        checkpoint — the freshest cut both maximizes the watermark the
+        requester jumps to and is exactly as cheap as the dirty set —
+        and answer with the seeds + clocks.  None when checkpointing
+        is off (the requester keeps buffering and retries)."""
+        doc = self.checkpoint_now()
+        if doc is None:
+            return None
+        return {
+            "keys": dict(doc["keys"]),
+            "clock": dict(doc["clock"]),
+            "commit_opid": doc["commit_watermarks"].get(own_dc, 0),
+            "op_counter": doc["op_counters"].get(own_dc, 0),
+        }
+
+    def bootstrap_seed(self, items, origin_dc=None, op_counter=0
+                       ) -> None:
+        """Receiver side of a checkpoint bootstrap: install the
+        origin's seed states as MERGE bases.  A key the device plane
+        owns evicts to the host first (migrating its local history),
+        then the seed lands with ``base_op_id=0`` so every local op
+        NOT covered by the seed's VC re-applies on top — local
+        concurrent writes survive, ops the origin had already folded
+        are replay-gated by the VC.  ``items``: iterable of
+        (key, type_name, state, VC)."""
+        with self._lock:
+            self._wait_device_quiesce()
+            for key, tn, state, vc in items:
+                if self.device is not None and self.device.owns(tn, key):
+                    self.device.planes[tn].evict(key)
+                self.store.seed_state(key, tn, state, vc, base_op_id=0)
+                self.key_frontier[key] = (
+                    self.key_frontier.get(key) or VC()).join(vc)
+                self._val_cache.pop(key, None)
+                self._ckpt_dirty[key] = tn
+            if origin_dc is not None:
+                self.log.op_counters[origin_dc] = max(
+                    self.log.op_counters.get(origin_dc, 0),
+                    int(op_counter))
+            self._lock.notify_all()
 
     # ------------------------------------------------------- stable plane
 

@@ -404,6 +404,7 @@ class Node:
             raise RuntimeError(
                 "repartition folds the durable logs; enable_logging=False "
                 "leaves nothing to redistribute")
+        self._refuse_truncated_resize()
 
         # 1. reassemble committed txn groups across ALL old logs (the
         #    whole history fits one host pass; resizes are rare)
@@ -489,12 +490,29 @@ class Node:
             except OSError:
                 pass
 
+    def _refuse_truncated_resize(self) -> None:
+        """Ring resizes fold FULL log histories into re-cut logs; a
+        checkpoint-truncated log has reclaimed its below-cut records,
+        so the fold would silently lose them — refuse loudly instead
+        (Config.ckpt_truncate=False for deployments that resize in
+        place; noted in ROADMAP)."""
+        for pm in self._local_partitions():
+            if isinstance(pm, PartitionManager) and pm.log.enabled \
+                    and pm.log.log.truncated_base > 0:
+                raise RuntimeError(
+                    f"partition {pm.partition}'s log is truncated "
+                    "below its checkpoint cut; a resize fold would "
+                    "lose the reclaimed history — disable "
+                    "Config.ckpt_truncate for resizable deployments")
+
     def build_resize_fold(self, new_n: int, own_slot=None) -> LiveFold:
         """LiveFold from this process's partitions toward width
         ``new_n``.  ``own_slot(q) -> bool`` restricts the staged logs
         to the slots this process will own — a single-process node
         stages all of them; ClusterNode passes its ring-slice filter
-        (cluster/node.py)."""
+        (cluster/node.py).  Refuses truncated logs like repartition —
+        the fold scans full histories."""
+        self._refuse_truncated_resize()
         parts = [(p, pm) for p, pm in enumerate(self.partitions)
                  if isinstance(pm, PartitionManager)]
         new_logs = {}
@@ -595,6 +613,15 @@ class Node:
             live = self._log_path(p)
             if os.path.exists(live):
                 os.replace(live, live + ".pre-resize")
+        # stale checkpoints must not survive the swap: a doc captured
+        # against the pre-resize layout would otherwise be adopted by
+        # the re-cut log (its cut is just a byte offset) and recovery
+        # would seed old-routing state + skip the new log's prefix
+        for p in range(max(new_n, old_n)):
+            try:
+                os.remove(self._log_path(p) + ".ckpt")
+            except OSError:
+                pass
         os.remove(self._resize_journal_path())
 
     def _resume_interrupted_resize(self) -> None:
@@ -613,19 +640,35 @@ class Node:
         return os.path.join(self.data_dir, f"{self.dc_id}_p{p}.log")
 
     def _build_partition(self, p: int) -> PartitionManager:
-        # the ONE construction path for the group-commit knobs
-        # (oplog/log.py log_group_from_config — the gate_from_config
-        # lesson): boot, repartition, and adopt_partition all come
-        # through here, so no assembly can honor different settings
+        # the ONE construction path for the group-commit AND checkpoint
+        # knobs (oplog/log.py log_group_from_config + oplog/checkpoint
+        # ckpt_from_config — the gate_from_config lesson): boot,
+        # repartition, and adopt_partition all come through here, so no
+        # assembly can honor different settings
+        from antidote_tpu.oplog.checkpoint import (
+            CheckpointStore,
+            ckpt_from_config,
+        )
         from antidote_tpu.oplog.log import log_group_from_config
 
+        cks = ckpt_from_config(self.config)
+        # the plane needs BOTH logging and boot-time recovery: with
+        # recover_from_log=False nothing ever replays (there is no
+        # recovery cost to cut), the seed/dirty sets never cover keys
+        # whose history predates this process — and a truncation would
+        # then reclaim the ONLY copy of their state
+        ckpt = CheckpointStore(self._log_path(p) + ".ckpt", cks) \
+            if (cks.enabled and self.config.enable_logging
+                and self.config.recover_from_log) else None
         log = PartitionLog(
             self._log_path(p), partition=p,
             sync_on_commit=self.config.sync_log,
+            backend=self.config.extra.get("oplog_backend", "auto"),
             enabled=self.config.enable_logging,
             on_append=(lambda rec, _p=p: self._on_log_append(_p, rec))
             if self._on_log_append else None,
-            group=log_group_from_config(self.config))
+            group=log_group_from_config(self.config),
+            checkpoint=ckpt)
         plane = None
         if self.config.device_store:
             from antidote_tpu.mat.device_plane import DevicePlane
@@ -654,6 +697,7 @@ class Node:
         # the partition that holds the state — manager._resolve_raw_ops)
         pm.gen_downstream_cb = self.gen_downstream
         pm.mint_dot_cb = self.mint_dot
+        pm.publish_after_durable = self.config.publish_after_durable
         # recovery-off + logging-on: the log may hold history this
         # process never published — a bottom-seeded warm cache would
         # disagree with log-fallback reads (see PartitionManager)
@@ -784,27 +828,66 @@ class Node:
     def _recover_stores(self) -> None:
         """Rebuild materializer caches from the durable logs at boot
         (reference materializer_vnode load_from_log,
-        src/materializer_vnode.erl:123-131, 288-319)."""
-        recovered_vc = VC()
-        for pm in self._local_partitions():
+        src/materializer_vnode.erl:123-131, 288-319).
+
+        ISSUE 10: per partition this is now checkpoint-seeded —
+        install the cut's folded key states, then replay ONLY the log
+        suffix past the cut (O(delta) however long the log grew) —
+        and partitions recover IN PARALLEL: their locks, logs, and
+        stores are disjoint, so a restart's wall time is the slowest
+        partition, not the sum."""
+        from antidote_tpu import stats as _stats
+
+        def recover_one(pm: PartitionManager) -> VC:
+            t0 = time.perf_counter()
+            with pm._lock:
+                pm.install_ckpt_seeds()
             pre_hosted = pm._pre_hosted()
-            for _seq, payload in pm.log.committed_payloads():
+            # the recovered commit join is a safe fold horizon for
+            # replay-time device flushes: every replayed op lies at or
+            # below it and nothing else is in flight (it is the same
+            # horizon the post-replay gc folds at).  Without one, a
+            # replay whose ingest window expires mid-stream (the
+            # parallel-recovery interleaving makes that routine) hits
+            # the ring-overflow retry with NO gc horizon and evicts
+            # hot keys to the host path — values stay correct, the
+            # device economy silently vanishes.
+            stable = pm.log.max_commit_vc
+            stable = stable if stable else None
+            for _seq, payload in pm.log.suffix_payloads():
                 with pm._lock:
                     if pm._mid_batch_migrated(pre_hosted, payload.key):
                         pm._note_skipped_publish(payload.key, payload)
                     else:
                         pm._publish(payload.key, payload.type_name,
-                                    payload, None)
+                                    payload, stable)
                 if payload.commit_dc != self.dc_id:
                     # replicated records are durable too, but the
-                    # certification tables are local-only — exactly as on
-                    # the live apply_remote path; loading remote commit
-                    # times here would make certify() compare local
-                    # snapshot times against another DC's clock
+                    # certification tables are local-only — exactly as
+                    # on the live apply_remote path; loading remote
+                    # commit times here would make certify() compare
+                    # local snapshot times against another DC's clock
                     continue
                 if payload.commit_time > pm.committed.get(payload.key, 0):
                     pm.committed[payload.key] = payload.commit_time
-            recovered_vc = recovered_vc.join(pm.log.max_commit_vc)
+            _stats.registry.ckpt_recovery.observe(
+                time.perf_counter() - t0)
+            return pm.log.max_commit_vc
+
+        pms = self._local_partitions()
+        recovered_vc = VC()
+        if len(pms) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            workers = min(len(pms), max(2, os.cpu_count() or 2))
+            with ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="recover") as ex:
+                for vc in ex.map(recover_one, pms):
+                    recovered_vc = recovered_vc.join(vc)
+        else:
+            for pm in pms:
+                recovered_vc = recovered_vc.join(recover_one(pm))
         # keep commit timestamps monotone across the restart
         self.clock.advance_to(recovered_vc.get_dc(self.dc_id))
         if recovered_vc:
@@ -826,14 +909,19 @@ class Node:
         this node's future commit times stay monotone for the moved
         keys."""
         pm = self._build_partition(p)
+        with pm._lock:
+            pm.install_ckpt_seeds()
         pre_hosted = pm._pre_hosted()
-        for _seq, payload in pm.log.committed_payloads():
+        # same safe replay-time fold horizon as _recover_stores
+        stable = pm.log.max_commit_vc
+        stable = stable if stable else None
+        for _seq, payload in pm.log.suffix_payloads():
             with pm._lock:
                 if pm._mid_batch_migrated(pre_hosted, payload.key):
                     pm._note_skipped_publish(payload.key, payload)
                 else:
                     pm._publish(payload.key, payload.type_name,
-                                payload, None)
+                                payload, stable)
             if payload.commit_dc != self.dc_id:
                 continue
             if payload.commit_time > pm.committed.get(payload.key, 0):

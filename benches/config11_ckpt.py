@@ -1,0 +1,190 @@
+"""Config 11: checkpoint + log truncation — cold-path cost vs log length.
+
+Before ISSUE 10 every cold path scaled with TOTAL log volume: restart
+scanned the whole partition log, and an eviction / read-below-base
+replayed a key's entire committed history.  The checkpoint plane makes
+recovery load-checkpoint + replay-suffix and seeds replays from the
+cut, so those costs must track the DELTA past the cut, not the log.
+
+This config drives the same per-key workload at two lengths — a short
+log and one grown 50x past the checkpoint cut — through the REAL
+Node recovery path, asserts the recovered state of every key is
+bit-identical between (checkpoint + suffix) and a full-scan oracle on
+every leg, and emits the two quantities the regression gate enforces
+directionally:
+
+- ``ckpt_recovery_ms_per_mb``    (ms/mb, must not rise): restart
+  wall-time per MB of on-disk log on the GROWN leg — a linear rescan
+  multiplies this straight back up;
+- ``ckpt_replay_ops_per_evict``  (ops/evict, must not rise): ops a
+  key replay (the eviction-migration / read-below-base unit) pays on
+  the grown leg — seeded replays pay the suffix, offset-0 replays pay
+  the whole history.
+
+The acceptance bound (grown-leg restart within 1.2x of the short leg)
+is asserted inline, with the full-scan oracle's time reported for
+scale.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+from benches._util import emit, setup
+
+
+def _build(data_dir, n_txns, ckpt: bool, truncate: bool = False,
+           seed=31):
+    """Commit ``n_txns`` single-partition counter txns through the real
+    manager path; returns the node (caller closes)."""
+    import numpy as np
+
+    from antidote_tpu.clocks import VC
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(device_store=False, n_partitions=2, ckpt=ckpt,
+                 ckpt_truncate=truncate, ckpt_ops=1 << 30,
+                 ckpt_bytes=1 << 40, data_dir=data_dir)
+    node = Node(dc_id="dc1", config=cfg)
+    rng = np.random.default_rng(seed)
+    for i in range(n_txns):
+        key = f"acct_{int(rng.integers(0, 48)):03d}"
+        pm = node.partition_of(key)
+        txid = ("dc1", 10_000_000 + i)
+        pm.stage_update(txid, key, "counter_pn",
+                        int(rng.integers(1, 9)))
+        pm.single_commit(txid, VC({"dc1": node.clock.now_us()}),
+                         certify=False)
+    return node, cfg
+
+
+def _values(node):
+    out = {}
+    for pm in node.partitions:
+        for key in pm.log.keys_seen:
+            out[key] = pm.value_snapshot(key, "counter_pn")
+    return out
+
+
+def _log_mb(data_dir):
+    total = 0
+    for f in os.listdir(data_dir):
+        if f.endswith(".log"):
+            total += os.path.getsize(os.path.join(data_dir, f))
+    return total / (1024 * 1024)
+
+
+def _recover(data_dir, ckpt: bool):
+    """(wall seconds, recovered values, replay ops per key-evict unit)
+    of a fresh Node recovery over ``data_dir``."""
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(device_store=False, n_partitions=2, ckpt=ckpt,
+                 ckpt_truncate=False, data_dir=data_dir)
+    t0 = time.perf_counter()
+    node = Node(dc_id="dc1", config=cfg)
+    wall = time.perf_counter() - t0
+    vals = _values(node)
+    # the eviction / read-below-base replay unit: ops a per-key replay
+    # pays.  Seeded recoveries hold only the suffix in key_commits;
+    # offset-0 recoveries hold the key's whole history.
+    replay_ops = []
+    for pm in node.partitions:
+        for key in pm.log.keys_seen:
+            replay_ops.append(len(pm.log.committed_payloads(key=key)))
+    node.close()
+    per_evict = sum(replay_ops) / max(len(replay_ops), 1)
+    return wall, vals, per_evict
+
+
+def _leg(tmp, name, n_txns):
+    """Build a log of ``n_txns`` committed txns, cut a checkpoint at
+    the top, then append a FIXED 16-txn tail delta — the suffix the
+    seeded recovery pays for, identical across legs.  Returns
+    measurements of the ckpt recovery AND the full-scan oracle
+    (equivalence asserted)."""
+    d = os.path.join(tmp, name)
+    node, _cfg = _build(d, n_txns, ckpt=True)
+    for pm in node.partitions:
+        pm.checkpoint_now()
+    import numpy as np
+
+    from antidote_tpu.clocks import VC
+
+    rng = np.random.default_rng(101)
+    for i in range(16):
+        key = f"acct_{int(rng.integers(0, 48)):03d}"
+        pm = node.partition_of(key)
+        txid = ("dc1", 30_000_000 + i)
+        pm.stage_update(txid, key, "counter_pn", 1)
+        pm.single_commit(txid, VC({"dc1": node.clock.now_us()}),
+                         certify=False)
+    node.close()
+    mb = _log_mb(d)
+    wall_ckpt, vals_ckpt, per_evict = _recover(d, ckpt=True)
+    # full-scan oracle: same bytes, checkpoints ignored
+    oracle_dir = d + "_oracle"
+    shutil.copytree(d, oracle_dir)
+    for f in os.listdir(oracle_dir):
+        if f.endswith(".ckpt"):
+            os.remove(os.path.join(oracle_dir, f))
+    wall_scan, vals_scan, per_evict_scan = _recover(oracle_dir,
+                                                    ckpt=False)
+    assert vals_ckpt == vals_scan, \
+        f"{name}: checkpoint recovery diverged from the full scan"
+    return {
+        "txns": n_txns,
+        "log_mb": mb,
+        "recover_s": wall_ckpt,
+        "scan_recover_s": wall_scan,
+        "replay_ops_per_evict": per_evict,
+        "scan_replay_ops_per_evict": per_evict_scan,
+    }
+
+
+def main():
+    import tempfile
+
+    quick, _jax = setup()
+    base = 400 if quick else 1200
+    with tempfile.TemporaryDirectory() as tmp:
+        short = _leg(tmp, "short", base)
+        grown = _leg(tmp, "grown", base * 50)
+    # the acceptance bound: recovery cost tracks the suffix, not the
+    # truncated/checkpointed volume.  Wall clocks on shared CI boxes
+    # jitter, so the inline assert allows 1.2x plus a 50 ms absolute
+    # floor; the emitted per-MB number is what the gate trends.
+    bound = short["recover_s"] * 1.2 + 0.05
+    assert grown["recover_s"] <= bound, (
+        f"grown-leg restart {grown['recover_s']:.3f}s exceeded "
+        f"{bound:.3f}s (short leg {short['recover_s']:.3f}s) — "
+        "recovery is scaling with log volume again")
+    assert grown["replay_ops_per_evict"] <= \
+        short["replay_ops_per_evict"] * 1.2 + 1, \
+        "evict-replay cost is scaling with log volume again"
+    ms_per_mb = grown["recover_s"] * 1e3 / max(grown["log_mb"], 1e-9)
+    scan_ms_per_mb = (grown["scan_recover_s"] * 1e3
+                      / max(grown["log_mb"], 1e-9))
+    emit("ckpt_recovery_ms_per_mb", round(ms_per_mb, 2), "ms/mb",
+         round(scan_ms_per_mb / max(ms_per_mb, 1e-9), 2),
+         scan_ms_per_mb=round(scan_ms_per_mb, 2),
+         grown_recover_s=round(grown["recover_s"], 4),
+         short_recover_s=round(short["recover_s"], 4),
+         scan_recover_s=round(grown["scan_recover_s"], 4),
+         log_mb=round(grown["log_mb"], 2), txns=grown["txns"])
+    emit("ckpt_replay_ops_per_evict",
+         round(grown["replay_ops_per_evict"], 2), "ops/evict",
+         round(grown["scan_replay_ops_per_evict"]
+               / max(grown["replay_ops_per_evict"], 1e-9), 2),
+         scan_ops_per_evict=round(
+             grown["scan_replay_ops_per_evict"], 2),
+         short_ops_per_evict=round(
+             short["replay_ops_per_evict"], 2))
+
+
+if __name__ == "__main__":
+    main()

@@ -190,8 +190,8 @@ def test_crash_between_cutover_and_replan_resolves_via_journal(tmp_path):
 
         # transfer partition 0 to j2 WITHOUT the ring_update step (the
         # driver "crashed" right after the cutover)
-        cursor = servers[0]._rpc("j2", "handoff_begin", (0, "j0"))
-        servers[0]._rpc("j0", "handoff_cutover", (0, "j2", cursor))
+        cursor, base = servers[0]._rpc("j2", "handoff_begin", (0, "j0"))
+        servers[0]._rpc("j0", "handoff_cutover", (0, "j2", cursor, base))
         assert servers[0].meta.get("handoff_out") == {0: "j2"}
 
         servers[0].close()
@@ -330,9 +330,9 @@ def test_install_applied_but_reply_lost_retires_old_owner(tmp_path):
             raise RemoteCallError("injected: reply lost")
 
         recv._handoff_install = applied_but_reply_lost
-        cursor = servers[0]._rpc("a2", "handoff_begin", (0, "a0"))
+        cursor, base = servers[0]._rpc("a2", "handoff_begin", (0, "a0"))
         with pytest.raises(RemoteCallError):
-            servers[0]._rpc("a0", "handoff_cutover", (0, "a2", cursor))
+            servers[0]._rpc("a0", "handoff_cutover", (0, "a2", cursor, base))
 
         # exactly one live owner: the receiver
         assert isinstance(servers[0].node.partitions[0], RemotePartition)
@@ -362,9 +362,9 @@ def test_install_never_applied_resumes_ownership(tmp_path):
             raise RemoteCallError("injected: install refused")
 
         recv._handoff_install = never_applied
-        cursor = servers[0]._rpc("b2", "handoff_begin", (0, "b0"))
+        cursor, base = servers[0]._rpc("b2", "handoff_begin", (0, "b0"))
         with pytest.raises(RemoteCallError):
-            servers[0]._rpc("b0", "handoff_cutover", (0, "b2", cursor))
+            servers[0]._rpc("b0", "handoff_cutover", (0, "b2", cursor, base))
 
         pm = servers[0].node.partitions[0]
         assert isinstance(pm, PartitionManager)
@@ -407,9 +407,9 @@ def test_install_in_doubt_parks_then_retry_resolves(tmp_path):
             return orig_req(target, kind, payload)
 
         servers[0].link.request = peer_gone
-        cursor = servers[0]._rpc("c2", "handoff_begin", (0, "c0"))
+        cursor, base = servers[0]._rpc("c2", "handoff_begin", (0, "c0"))
         with pytest.raises(RemoteCallError):
-            servers[0]._rpc("c0", "handoff_cutover", (0, "c2", cursor))
+            servers[0]._rpc("c0", "handoff_cutover", (0, "c2", cursor, base))
 
         assert servers[0]._handoff[0]["state"] == "in_doubt"
         assert servers[0].meta.get("handoff_out") == {0: "c2"}
@@ -421,7 +421,7 @@ def test_install_in_doubt_parks_then_retry_resolves(tmp_path):
         # receiver returns: the retry finishes the transfer
         servers[0].link.request = orig_req
         del recv._handoff_install  # restore the real bound method
-        servers[0]._rpc("c0", "handoff_cutover", (0, "c2", cursor))
+        servers[0]._rpc("c0", "handoff_cutover", (0, "c2", cursor, base))
         assert servers[0]._handoff[0]["state"] == "retired"
         assert isinstance(recv.node.partitions[0], PartitionManager)
         tx = recv.api.start_transaction()
@@ -454,8 +454,8 @@ def test_restart_with_receiver_down_parks_in_doubt(tmp_path):
         api.update_objects([((0, "counter_pn", "b"), "increment", 3)],
                            tx)
         api.commit_transaction(tx)
-        cursor = servers[0]._rpc("d2", "handoff_begin", (0, "d0"))
-        servers[0]._rpc("d0", "handoff_cutover", (0, "d2", cursor))
+        cursor, base = servers[0]._rpc("d2", "handoff_begin", (0, "d0"))
+        servers[0]._rpc("d0", "handoff_cutover", (0, "d2", cursor, base))
         servers[0].close()
         extra.close()  # receiver gone before the old owner restarts
 

@@ -1,0 +1,270 @@
+"""Truncated-then-bootstrapped remote SubBuf stream (ISSUE 10).
+
+When an origin's log-truncation cut passed the range a remote SubBuf
+asks gap repair for, the origin answers BELOW_FLOOR instead of a txn
+list, and the requester escalates to a checkpoint-state bootstrap:
+fetch the origin's per-key seed states + watermarks (CKPT_READ), jump
+the stream watermark to the cut, and let ordinary repair fetch the
+retained suffix — instead of wedging in repair retries forever.
+"""
+
+import pytest
+
+from antidote_tpu.clocks import VC
+from antidote_tpu.interdc import query as idc_query
+from antidote_tpu.interdc.sub_buf import SubBuf
+
+from tests.multidc.conftest import make_cluster
+from tests.multidc.test_replication import read_counter, update_counter
+
+
+@pytest.fixture
+def ckpt_pair(bus, tmp_path):
+    dcs = make_cluster(
+        bus, tmp_path, 2, n_partitions=2, device_store=False,
+        ckpt=True, ckpt_truncate=True, ckpt_retain_ops=0)
+    yield dcs
+    for dc in dcs:
+        dc.close()
+
+
+def _pump_all(dcs, rounds=6):
+    import time
+
+    for _ in range(rounds):
+        for dc in dcs:
+            dc.tick_heartbeats()
+        for dc in dcs:
+            dc.pump()
+        time.sleep(0.01)  # let the async ship workers drain staged txns
+
+
+def test_below_floor_answer_shape(tmp_path):
+    """answer_log_read over a truncated range returns the explicit
+    BELOW_FLOOR marker, and is_below_floor recognizes only it."""
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(device_store=False, n_partitions=1, ckpt=True,
+                 ckpt_truncate=True, ckpt_retain_ops=0,
+                 data_dir=str(tmp_path / "n"))
+    node = Node(dc_id="dc1", config=cfg)
+    pm = node.partitions[0]
+    for i in range(30):
+        txid = ("dc1", i)
+        pm.stage_update(txid, "k", "counter_pn", 1)
+        pm.single_commit(txid, VC({"dc1": node.clock.now_us()}),
+                         certify=False)
+    pm.checkpoint_now()
+    floor = pm.log.commit_floor["dc1"]
+    assert floor > 0
+    ans = pm.scan_log(lambda lg: idc_query.answer_log_read(
+        lg, "dc1", 0, 1, floor))
+    assert idc_query.is_below_floor(ans)
+    assert ans[1] == floor
+    for i in range(5):  # retained suffix past the cut
+        txid = ("dc1", 100 + i)
+        pm.stage_update(txid, "k", "counter_pn", 1)
+        pm.single_commit(txid, VC({"dc1": node.clock.now_us()}),
+                         certify=False)
+    ok = pm.scan_log(lambda lg: idc_query.answer_log_read(
+        lg, "dc1", 0, floor + 1, pm.log.op_counters["dc1"]))
+    assert not idc_query.is_below_floor(ok) and ok
+    assert not idc_query.is_below_floor([])
+    assert not idc_query.is_below_floor(None)
+    node.close()
+
+
+def test_subbuf_without_bootstrap_stays_buffering(tmp_path):
+    """The pre-ISSUE-10 wedge, pinned: a BELOW_FLOOR answer with no
+    bootstrap callback keeps the stream buffering (it retries later)
+    instead of advancing past a hole it cannot fill."""
+    delivered = []
+    buf = SubBuf("dcX", 0, deliver=delivered.append,
+                 fetch_range=lambda *a: idc_query.below_floor_answer(40))
+    buf.process(_fake_txn(prev=50, n=51))
+    assert buf.state == "buffering"
+    assert not delivered
+    assert buf.last_opid == 0
+
+
+def test_subbuf_bootstrap_escalation_unit(tmp_path):
+    """BELOW_FLOOR → bootstrap callback → watermark jump → ordinary
+    repair above the floor drains the queue."""
+    delivered = []
+    repairs = []
+    boots = []
+
+    def fetch_range(origin, partition, first, last):
+        repairs.append((first, last))
+        if first <= 40:
+            return idc_query.below_floor_answer(40)
+        return [_fake_txn(prev=p, n=p + 1)
+                for p in range(first - 1, last)]
+
+    def bootstrap(origin, partition):
+        boots.append((origin, partition))
+        return 40  # the origin's commit watermark at its cut
+
+    buf = SubBuf("dcX", 3, deliver=delivered.append,
+                 fetch_range=fetch_range, bootstrap=bootstrap)
+    buf.process(_fake_txn(prev=50, n=51))
+    assert boots == [("dcX", 3)]
+    assert buf.state == "normal"
+    assert buf.last_opid == 51
+    # repair asked below the floor once, then resumed above it
+    assert repairs[0] == (1, 50)
+    assert repairs[1] == (41, 50)
+    assert [t.last_opid() for t in delivered] == list(range(41, 52))
+
+
+def _fake_txn(prev: int, n: int):
+    from antidote_tpu.interdc.wire import InterDcTxn
+    from antidote_tpu.oplog.records import OpId, commit_record
+
+    rec = commit_record(OpId("dcX", n), ("dcX", n), "dcX", 1000 + n,
+                        VC({"dcX": 999 + n}))
+    return InterDcTxn.from_ops("dcX", 3, prev, [rec])
+
+
+class TestEndToEndBootstrap:
+    def test_truncated_stream_bootstraps_and_converges(self, ckpt_pair):
+        from antidote_tpu import stats
+
+        boots0 = stats.registry.ckpt_bootstraps.value()
+        dc1, dc2 = ckpt_pair
+        bus = dc1.bus
+        key = "boot_ctr"
+        ct = None
+        for _ in range(5):
+            ct = update_counter(dc1, key, clock=ct)
+        _pump_all(ckpt_pair)
+        assert read_counter(dc2, key, ct) == 5
+
+        # dc2 goes dark; dc1 keeps committing far past retention and
+        # truncates its logs below the shipped watermark
+        bus.set_drop_rx("dc2", True)
+        for _ in range(40):
+            ct = update_counter(dc1, key, clock=ct)
+        for pm in dc1.node.partitions:
+            pm.checkpoint_now()
+        assert any(pm.log.log.truncated_base > 0
+                   for pm in dc1.node.partitions), \
+            "the grown log never truncated"
+        # the range dc2 will ask for is gone at dc1
+        p = dc1.node.partition_index(key)
+        floor = dc1.node.partitions[p].log.commit_floor.get("dc1", 0)
+        assert floor > 0
+
+        # dc2 comes back: the next live frame opens a gap whose repair
+        # answers BELOW_FLOOR, and the bootstrap fills it
+        bus.set_drop_rx("dc2", False)
+        ct = update_counter(dc1, key, clock=ct)
+        _pump_all(ckpt_pair, rounds=10)
+        assert read_counter(dc2, key, ct) == 46
+        assert stats.registry.ckpt_bootstraps.value() > boots0, \
+            "the stream converged without the bootstrap escalation " \
+            "— the scenario no longer exercises BELOW_FLOOR"
+        buf = dc2.sub_bufs[("dc1", p)]
+        assert buf.state == "normal"
+        assert buf.last_opid >= floor
+
+        # and the stream keeps flowing normally afterwards
+        ct = update_counter(dc1, key, clock=ct)
+        _pump_all(ckpt_pair)
+        assert read_counter(dc2, key, ct) == 47
+
+    def test_bootstrap_seeds_survive_receiver_restart(self, bus,
+                                                      tmp_path):
+        """The installed seeds must be DURABLE before the stream
+        watermark jumps: the jump is persisted by the next suffix
+        append, so a receiver crash after the bootstrap (and before
+        any watermark-triggered local checkpoint) would otherwise
+        recover the advanced watermark with no seeds — the origin's
+        below-cut history silently gone, with nothing left to
+        re-request (pre-fix: the restarted reader sees ~7, not 47)."""
+        import time
+
+        from antidote_tpu.config import Config
+        from antidote_tpu.interdc.dc import DataCenter
+
+        kw = dict(n_partitions=2, device_store=False, ckpt=True,
+                  ckpt_truncate=True, ckpt_retain_ops=0,
+                  heartbeat_s=0.02, clock_wait_timeout_s=10.0)
+        dcs = make_cluster(bus, tmp_path, 2, **kw)
+        try:
+            dc1, dc2 = dcs
+            key = "boot_crash_ctr"
+            ct = None
+            for _ in range(5):
+                ct = update_counter(dc1, key, clock=ct)
+            _pump_all(dcs)
+            assert read_counter(dc2, key, ct) == 5
+            bus.set_drop_rx("dc2", True)
+            for _ in range(40):
+                ct = update_counter(dc1, key, clock=ct)
+            for pm in dc1.node.partitions:
+                pm.checkpoint_now()
+            assert any(pm.log.log.truncated_base > 0
+                       for pm in dc1.node.partitions)
+            bus.set_drop_rx("dc2", False)
+            ct = update_counter(dc1, key, clock=ct)
+            _pump_all(dcs, rounds=10)
+            assert read_counter(dc2, key, ct) == 46  # bootstrapped
+
+            # one more LIVE txn after the bootstrap: its append makes
+            # the jumped stream watermark durable in dc2's log (the
+            # recovered op_counters resume past the cut, so the gap
+            # never re-fires) — without it a crash loses seeds AND
+            # watermark together and a re-bootstrap self-heals
+            ct = update_counter(dc1, key, clock=ct)
+            _pump_all(dcs, rounds=10)
+            assert read_counter(dc2, key, ct) == 47
+
+            # "kill -9" dc2 right after; restart from its data dir —
+            # the seeded below-cut history must be back
+            dcs[1].close()
+            dc2b = DataCenter("dc2", bus, config=Config(**kw),
+                              data_dir=str(tmp_path / "dc2"))
+            dcs[1] = dc2b
+            dc2b.start_bg_processes()
+            deadline = time.monotonic() + 10.0
+            while True:
+                _pump_all(dcs, rounds=2)
+                if read_counter(dc2b, key, None) >= 47:
+                    break
+                assert time.monotonic() < deadline, \
+                    "bootstrap seeds lost across the receiver restart"
+            assert read_counter(dc2b, key, ct) == 47
+        finally:
+            for dc in dcs:
+                dc.close()
+
+    def test_bootstrap_preserves_local_concurrent_writes(self,
+                                                         ckpt_pair):
+        """Seeding a bootstrap state must MERGE with ops the receiver
+        already has (its own concurrent writes survive)."""
+        dc1, dc2 = ckpt_pair
+        bus = dc1.bus
+        key = "merge_ctr"
+        ct1 = update_counter(dc1, key)
+        _pump_all(ckpt_pair)
+        bus.set_drop_rx("dc2", True)
+        bus.set_drop_rx("dc1", True)
+        for _ in range(39):
+            ct1 = update_counter(dc1, key, clock=ct1)
+        # dc2 writes CONCURRENTLY while dark
+        ct2 = update_counter(dc2, key)
+        for pm in dc1.node.partitions:
+            pm.checkpoint_now()
+        assert any(pm.log.log.truncated_base > 0
+                   for pm in dc1.node.partitions)
+        bus.set_drop_rx("dc2", False)
+        bus.set_drop_rx("dc1", False)
+        ct1 = update_counter(dc1, key, clock=ct1)
+        _pump_all(ckpt_pair, rounds=10)
+        from antidote_tpu.clocks import vc_max
+
+        merged = vc_max([ct1, ct2])
+        assert read_counter(dc2, key, merged) == 42
+        assert read_counter(dc1, key, merged) == 42

@@ -271,9 +271,43 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # must not fall (regression back to one fsync per commit), the
     # commit-path sync cost per txn must not rise
     ("records/fsync", 1), ("us/txn", -1),
+    # checkpoint family (ISSUE 10): restart ms per on-disk MB and ops
+    # replayed per key eviction must not rise — either means a cold
+    # path is scaling with total log volume again
+    ("ms/mb", -1), ("ops/evict", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_ckpt_plane_regression(tmp_path, capsys):
+    """ISSUE 10 synthetic two-round trajectory: round 2's recovery
+    cost per MB and evict-replay ops balloon (cold paths scaling with
+    log volume again) — both must fail."""
+    old = {"schema_version": 1, "round": 1, "dry_run": False,
+           "metrics": {
+               "ckpt_recovery_ms_per_mb": {"value": 12.0,
+                                           "unit": "ms/mb"},
+               "ckpt_replay_ops_per_evict": {"value": 4.0,
+                                             "unit": "ops/evict"}},
+           "failures": {}}
+    new = {"schema_version": 1, "round": 2, "dry_run": False,
+           "metrics": {
+               "ckpt_recovery_ms_per_mb": {"value": 240.0,
+                                           "unit": "ms/mb"},
+               "ckpt_replay_ops_per_evict": {"value": 55.0,
+                                             "unit": "ops/evict"}},
+           "failures": {}}
+    import json
+
+    op, np_ = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    op.write_text(json.dumps(old))
+    np_.write_text(json.dumps(new))
+    rc = bench_gate.main([str(op), str(np_)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "ckpt_recovery_ms_per_mb" in err
+    assert "ckpt_replay_ops_per_evict" in err
 
 
 def test_gate_fails_on_log_plane_regression(tmp_path, capsys):

@@ -266,3 +266,40 @@ def test_sync_rule_flags_untraced_sync_sites(tmp_path):
 
 def test_sync_rule_clean_on_repo():
     assert trace_lint.lint_sync_spans(trace_lint.repo_root()) == []
+
+
+def test_ckpt_rule_flags_untraced_ckpt_io_sites(tmp_path):
+    """ISSUE 10 rule: a function under oplog/ performing checkpoint IO
+    (write_doc / load_doc / truncate_below) without a span/instant is
+    a dark cold-path disk move; instrumented callers and the IO
+    definitions themselves pass."""
+    d = tmp_path / "antidote_tpu" / "oplog"
+    d.mkdir(parents=True)
+    (d / "newckpt.py").write_text(
+        "from antidote_tpu.obs.spans import tracer\n"
+        "class P:\n"
+        "    def dark_commit_ckpt(self, doc):\n"
+        "        self.ckpt.write_doc(doc)\n"
+        "    def dark_recover(self):\n"
+        "        return self.ckpt.load_doc()\n"
+        "    def dark_trunc(self, off):\n"
+        "        self.log.truncate_below(off)\n"
+        "    def good_commit(self, doc):\n"
+        "        with tracer.span('ckpt_write', 'oplog'):\n"
+        "            self.ckpt.write_doc(doc)\n"
+        "    def good_trunc(self, off):\n"
+        "        tracer.instant('ckpt_truncate', 'oplog')\n"
+        "        self.log.truncate_below(off)\n"
+        "    def write_doc(self, doc):\n"  # the IO itself: exempt
+        "        return doc\n"
+        "    def load_doc(self):\n"  # likewise\n
+        "        return None\n"
+        "    def unrelated(self):\n"
+        "        return 1\n")
+    problems = trace_lint.lint_ckpt_spans(str(tmp_path))
+    flagged = sorted(p.split("::")[1].split(":")[0] for p in problems)
+    assert flagged == ["dark_commit_ckpt", "dark_recover", "dark_trunc"]
+
+
+def test_ckpt_rule_clean_on_repo():
+    assert trace_lint.lint_ckpt_spans(trace_lint.repo_root()) == []

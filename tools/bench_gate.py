@@ -79,7 +79,12 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  # read serve plane (ISSUE 8): fold dispatches per
                  # served key-read sliding UP means the coalescing
                  # window regressed toward one fold per reader
-                 "dispatches/read"}
+                 "dispatches/read",
+                 # checkpoint plane (ISSUE 10): restart wall-time per
+                 # MB of on-disk log and ops replayed per key eviction
+                 # — either rising means a cold path is scaling with
+                 # total log volume again instead of the suffix
+                 "ms/mb", "ops/evict"}
 
 
 def repo_root() -> str:

@@ -54,6 +54,17 @@ log_group_drain timeline exists for.  Functions NAMED like the
 barrier (``sync`` — the DurableLog/_PyLog definitions) are exempt;
 call sites are not.
 
+ISSUE 10 adds the checkpoint-IO rule: every function under
+antidote_tpu/oplog/ that performs checkpoint IO — writing/loading the
+checkpoint document (``write_doc`` / ``load_doc``) or truncating the
+log (``truncate_below``) — must carry a span or instant.  These are
+the cold-path disk moves recovery-time and retention forensics hinge
+on (ckpt_write/ckpt_load spans, the log_truncate span, the CKPT_*
+gauges), and they run from commit tails and remote bootstrap answers
+— an untraced site would make a multi-second checkpoint stall
+unattributable.  The IO definitions themselves (functions NAMED
+write_doc / load_doc / truncate_below) are exempt; call sites are not.
+
 Runs standalone (``python tools/trace_lint.py``) and from tier-1
 (tests/unit/test_trace_lint.py); exit code 0 = fully instrumented.
 Purely static (ast), so it needs no JAX and runs in milliseconds.
@@ -141,6 +152,13 @@ _FUSED_DIRS = (os.path.join("antidote_tpu", "mat"),)
 #: NAMED "sync" are the barrier definitions themselves and are exempt
 _SYNC_NAMES = ("sync", "fsync", "oplog_sync")
 _SYNC_DIR = os.path.join("antidote_tpu", "oplog")
+
+#: checkpoint-IO call names under oplog/ (ISSUE 10): a call whose
+#: terminal name is one of these moves checkpoint/retention state on
+#: disk and the calling function must be instrumented; functions NAMED
+#: like the IO primitives are the definitions themselves and exempt
+_CKPT_IO_NAMES = ("write_doc", "load_doc", "truncate_below")
+_CKPT_DIR = os.path.join("antidote_tpu", "oplog")
 
 
 def _is_instrumented(fn: ast.FunctionDef) -> bool:
@@ -451,6 +469,51 @@ def lint_sync_spans(root: str) -> List[str]:
     return problems
 
 
+def _is_ckpt_io_call(node: ast.Call) -> bool:
+    """True for ``self.ckpt.write_doc(...)`` / ``store.load_doc(...)``
+    / ``self.log.truncate_below(...)`` — any call whose terminal name
+    is a checkpoint-IO primitive."""
+    f = node.func
+    name = getattr(f, "attr", getattr(f, "id", None))
+    return name in _CKPT_IO_NAMES
+
+
+def lint_ckpt_spans(root: str) -> List[str]:
+    """ISSUE 10 rule: every function under antidote_tpu/oplog/ with a
+    checkpoint-IO call site (write_doc / load_doc / truncate_below)
+    must also carry a span/instant/annotation — checkpoint writes,
+    recovery loads, and log truncations are the cold-path disk moves
+    the CKPT_* forensics attribute stalls to.  Functions named like
+    the IO primitives are the definitions themselves and exempt."""
+    problems: List[str] = []
+    d = os.path.join(root, _CKPT_DIR)
+    if not os.path.isdir(d):
+        return problems
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(d, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in _CKPT_IO_NAMES:
+                continue  # the IO definition, not a call site
+            does_io = any(
+                isinstance(c, ast.Call) and _is_ckpt_io_call(c)
+                for c in ast.walk(node))
+            if does_io and not _is_instrumented(node):
+                problems.append(
+                    f"{_CKPT_DIR}/{fname}::{node.name}: performs "
+                    "checkpoint IO (write_doc/load_doc/truncate_below) "
+                    "without a tracer span/instant — checkpoint and "
+                    "truncation stalls go dark "
+                    "(antidote_tpu/obs/spans.py)")
+    return problems
+
+
 def _methods(tree: ast.Module, cls_name: str) -> Dict[str, ast.FunctionDef]:
     for node in tree.body:
         if isinstance(node, ast.ClassDef) and node.name == cls_name:
@@ -489,6 +552,7 @@ def lint(root: str) -> List[str]:
     problems.extend(lint_decode_instants(root))
     problems.extend(lint_fused_spans(root))
     problems.extend(lint_sync_spans(root))
+    problems.extend(lint_ckpt_spans(root))
     return problems
 
 
