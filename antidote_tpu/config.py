@@ -103,10 +103,6 @@ class Config:
     n_partitions: int = 4
     #: data directory for durable logs / metadata
     data_dir: str = "antidote_data"
-    #: metadata gossip / stable-time tick, seconds (reference 1 s)
-    meta_sleep_s: float = 1.0
-    #: partition VC push throttle, seconds (reference 100 ms)
-    vc_push_s: float = 0.1
     #: stable-snapshot read cache TTL, seconds.  Every transaction start
     #: reads the stable snapshot; computing it sweeps all partitions'
     #: min-prepared (a lock per partition — a convoy under concurrent

@@ -212,6 +212,10 @@ class InterDcLogSender:
                     "ship buffer backpressure timed out (%d staged) — "
                     "staging anyway", len(self._buf))
                 break
+            # lock-ok: deliberate commit-rate throttle — bounded by
+            # _BACKPRESSURE_TIMEOUT_S, releases the sender lock while
+            # sleeping; the committer's partition lock is the point
+            # (back-pressure must reach the commit path to matter)
             self._cv.wait(remaining)
         if not self._buf:
             self._buf_since = time.monotonic()
@@ -366,6 +370,9 @@ class InterDcLogSender:
                     with tracer.span("interdc_send_batch", "interdc",
                                      partition=self.partition,
                                      dc=str(self.dc_id), txns=ntxns):
+                        # lock-ok: _pub_lock EXISTS to order publishes
+                        # — only the async ship worker and close take
+                        # it, never the commit path
                         self.transport.publish(self.dc_id, frame)
                     for txn in meta.txns():
                         txid = getattr(txn.records[-1], "txid", None)
@@ -381,6 +388,8 @@ class InterDcLogSender:
                     with tracer.span("interdc_send", "interdc",
                                      txid=meta, partition=self.partition,
                                      dc=str(self.dc_id)):
+                        # lock-ok: publish-ordering lock (see above) —
+                        # the legacy per-txn frame path
                         self.transport.publish(self.dc_id, frame)
                     recorder.record("interdc", "send", txid=meta,
                                     partition=self.partition)
@@ -388,6 +397,8 @@ class InterDcLogSender:
                     with tracer.span("interdc_send_ping", "interdc",
                                      partition=self.partition,
                                      dc=str(self.dc_id)):
+                        # lock-ok: publish-ordering lock (see above) —
+                        # standalone heartbeat frames
                         self.transport.publish(self.dc_id, frame)
                 _note_frame(kind, len(frame), ntxns, piggy)
 

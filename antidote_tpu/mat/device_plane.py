@@ -197,7 +197,7 @@ class _PlaneBase:
         #: flushes, the staging window, and the row budget.  Built by
         #: the one factory (ingest_from_config) at the DevicePlane /
         #: sharded-store assembly so every plane honors the same knobs.
-        self._ingest = ingest_settings or ingest.IngestSettings()
+        self._ingest = ingest_settings or ingest.ingest_from_config(None)
         #: monotonic µs stamp of the oldest staged row (drives the
         #: coalescing window); meaningless while ``rows`` is empty
         self._stage_t0_us = 0
@@ -2361,7 +2361,7 @@ class DevicePlane:
             # stores build their settings from the same call, so the
             # single-shard and mesh assemblies honor the same knobs
             ingest_settings = ingest.ingest_from_config(config)
-        ing = ingest_settings or ingest.IngestSettings()
+        ing = ingest_settings or ingest.ingest_from_config(None)
         slotted = {"set_aw": OrsetPlane, "register_mv": MvregPlane,
                    "set_rw": RwsetPlane, "set_go": SetGoPlane}
         flat = {"counter_pn": CounterPlane, "register_lww": LwwPlane,

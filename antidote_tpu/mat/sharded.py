@@ -89,7 +89,7 @@ class _ShardedBase:
         #: coalesced-ingest knobs — built by the SAME factory the
         #: DevicePlane uses (ingest.ingest_from_config), so the mesh
         #: and single-shard assemblies honor identical knobs
-        self.ingest = ingest_settings or ingest.IngestSettings()
+        self.ingest = ingest_settings or ingest.ingest_from_config(None)
         self.st = self._shard_state(st)
         self._jits = {}
 

@@ -55,7 +55,12 @@ class StableMetaData:
             return
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
+            # lock-ok: the stable-meta KV is tiny (a handful of VCs)
+            # and writes ride the 1 s gossip cadence; persisting under
+            # the lock is what keeps the file a consistent snapshot
             pickle.dump(self._kv, f, protocol=pickle.HIGHEST_PROTOCOL)
+        # lock-ok: same audit — an atomic rename of a tiny file on the
+        # gossip cadence, ordered with the update it persists
         os.replace(tmp, self.path)
 
     # ------------------------------------------------- well-known entries

@@ -58,6 +58,7 @@ from typing import Optional
 
 from antidote_tpu import stats
 from antidote_tpu.obs.spans import tracer
+from antidote_tpu.oplog.log import _fsync_dir
 
 #: checkpoint file framing: magic + [u32 len][u32 crc32(body)][body]
 _MAGIC = b"ATPCKPT1"
@@ -163,7 +164,8 @@ class CheckpointStore:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
-            _fsync_dir(os.path.dirname(self.path))
+            _fsync_dir(os.path.dirname(self.path),
+                       instant="ckpt_dir_fsync")
         reg = stats.registry
         reg.ckpt_writes.inc()
         reg.ckpt_duration.observe(time.perf_counter() - t0)
@@ -175,22 +177,6 @@ class CheckpointStore:
                 os.remove(p)
             except OSError:
                 pass
-
-
-def _fsync_dir(d: str) -> None:
-    """Durable rename: fsync the containing directory (best-effort —
-    not every fs exposes a directory fd)."""
-    try:
-        fd = os.open(d or ".", os.O_RDONLY)
-    except OSError:
-        return
-    tracer.instant("ckpt_dir_fsync", "oplog", dir=os.path.basename(d))
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
 
 
 def empty_doc(partition: int) -> dict:

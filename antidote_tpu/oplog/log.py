@@ -38,7 +38,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import shutil
 import struct
 import threading
 import time
@@ -99,6 +98,46 @@ def _peek_trunc_base(path: str) -> int:
             return _parse_trunc_marker(payload) or 0
     except OSError:
         return 0
+
+
+def _copy_range(src, dst, nbytes: int, chunk: int = 1 << 20) -> None:
+    """Copy exactly ``nbytes`` from ``src`` to ``dst`` in bounded
+    chunks — the truncation tail copy must stop at the file end
+    captured under the lock (an unbounded ``copyfileobj`` would chase
+    concurrent appends and could tear a half-written record); 1 MB
+    chunks keep RSS flat when the retained suffix is hundreds of MB.
+    A short read is an ERROR, not an end condition: silently keeping
+    fewer bytes would let the commit rename a log missing bytes in the
+    middle — recovery's parse stops at the seam and everything above
+    it is lost without a word."""
+    while nbytes > 0:
+        buf = src.read(min(chunk, nbytes))
+        if not buf:
+            raise OSError(
+                f"truncation copy came up {nbytes} bytes short of the "
+                "end captured under the lock — refusing to stage a "
+                "log with a hole")
+        dst.write(buf)
+        nbytes -= len(buf)
+
+
+def _fsync_dir(d: str, instant: str = "log_dir_fsync") -> None:
+    """Durable rename: fsync the containing directory so a power cut
+    cannot resurrect the pre-rename inode (best-effort — not every fs
+    exposes a directory fd).  The ONE copy of this discipline: the
+    checkpoint writer's rename imports it too (``instant`` names the
+    trace event per caller)."""
+    try:
+        fd = os.open(d or ".", os.O_RDONLY)
+    except OSError:
+        return
+    tracer.instant(instant, "oplog", dir=os.path.basename(d))
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 @dataclass(frozen=True)
@@ -219,6 +258,21 @@ class DurableLog:
         #: out-of-lock backend IO in flight (fsync): close() waits for
         #: this to reach zero before freeing the handle
         self._io_refs = 0
+        #: a stage_truncate_below tail copy is composing the rewrite
+        #: temp — a second stager would race the one temp path
+        self._trunc_staging = False
+        #: generation counter stamped into stage tokens: abort/commit
+        #: act only on the stage currently in flight, so a late abort
+        #: of an already-consumed token cannot unlink a NEWER stage's
+        #: temp out from under it
+        self._trunc_seq = 0
+        # a crash between stage and commit strands a fully composed
+        # (retained-suffix-sized) temp nothing will ever redeem — no
+        # stage can be in flight at construction, so it is garbage
+        try:
+            os.remove(path + ".trunc-tmp")
+        except OSError:
+            pass
         phys_hint = 0
         if recover_hint > 0:
             base = _peek_trunc_base(path)
@@ -696,10 +750,68 @@ class DurableLog:
         the (possibly unchanged) truncation base; no-op at or below
         the current base.  Callers gate the cut by the checkpoint and
         the retention floor (oplog/partition.py) — the log itself only
-        guarantees mechanics, not retention policy."""
+        guarantees mechanics, not retention policy.
+
+        Two phases (ISSUE 11): :meth:`stage_truncate_below` composes
+        the rewritten file OUTSIDE every lock — the retained tail can
+        be hundreds of MB (the retention floor holds the cut back for
+        lagging peers), and the PR-9 form copied it under both the
+        handle lock and the caller's partition lock, stalling every
+        commit for the whole copy — and :meth:`commit_truncate`
+        re-validates the cut, catches up the (bounded) bytes appended
+        during the copy, and atomically renames under the lock.  This
+        wrapper runs both back to back for callers that hold no lock
+        (tests, resize tooling); the checkpoint plane drives the
+        phases itself so the partition lock is held only for the
+        cheap commit.
+
+        One-shot means one-shot: if another driver's stage is in
+        flight the wrapper WAITS it out and retries rather than
+        silently returning the old base — a success-looking return
+        with zero bytes reclaimed gave tooling no signal to retry."""
+        idle_refusal = False
+        while True:
+            stage = self.stage_truncate_below(offset)
+            if stage is not None:
+                return self.commit_truncate(stage)
+            with self._lock:
+                busy = self._trunc_staging
+                base = self._base
+            if busy:
+                idle_refusal = False
+                time.sleep(0.002)
+                continue
+            if offset <= base:
+                return base  # genuine no-op: at/below the live base
+            # not busy, yet the stage refused a cut above the base:
+            # either a racing stage committed between our attempt and
+            # the flag sample (retry once — the next attempt runs
+            # unraced) or the cut clamps to the live end (base ==
+            # logical end: nothing retained to rewrite; a second idle
+            # refusal confirms it)
+            if idle_refusal:
+                return base
+            idle_refusal = True
+
+    def stage_truncate_below(self, offset: int) -> Optional[dict]:
+        """Phase 1 of a truncation: compose ``<log>.trunc-tmp`` —
+        truncation marker + the retained suffix at/above LOGICAL
+        ``offset``, bounded by the file end captured under the lock —
+        then flush+fsync it, ALL outside the handle lock (appends,
+        reads, and commits proceed during the copy).  Returns the
+        stage token :meth:`commit_truncate` redeems, or None when the
+        cut is a no-op (at/below the current base) or another stage is
+        already in flight (the caller's next checkpoint retries).
+
+        Callers serialize stage->commit pairs (the checkpoint plane's
+        ``_ckpt_inflight`` guard); the ``_trunc_staging`` flag is the
+        belt to that suspenders — two concurrent stagers would race
+        one temp path."""
         with self._lock:
             if self._native is None and self._py is None:
                 raise OSError(f"log {self.path} is closed")
+            if self._trunc_staging:
+                return None
             if self._group is not None:
                 self._write_staged_locked()
             if self._native:
@@ -709,41 +821,162 @@ class DurableLog:
             end_logical = self._backend_end_locked() + self._delta
             offset = min(offset, end_logical)
             if offset <= self._base:
-                return self._base
-            old_base = self._base
-            # an out-of-lock fsync still holds the handle we are about
-            # to close — wait it out (same guard as close())
-            while self._io_refs:
-                self._lock.wait()
-            with tracer.span("log_truncate", "oplog",
+                return None
+            self._trunc_staging = True
+            self._trunc_seq += 1
+            seq = self._trunc_seq
+            delta = self._delta
+            staged_end_phys = end_logical - delta
+        tmp = self.path + ".trunc-tmp"
+        try:
+            with tracer.span("log_truncate_stage", "oplog",
                              path=os.path.basename(self.path),
-                             base=offset, reclaimed=offset - old_base):
-                tmp = self.path + ".trunc-tmp"
-                with open(self.path, "rb") as src, \
-                        open(tmp, "wb") as f:
-                    src.seek(offset - self._delta)
+                             base=offset,
+                             bytes=staged_end_phys - (offset - delta)):
+                with open(self.path, "rb") as src, open(tmp, "wb") as f:
+                    src.seek(offset - delta)
                     f.write(_trunc_marker(offset))
-                    # chunked copy: the retained suffix can be hundreds
-                    # of MB (the retention floor holds the cut back for
-                    # lagging peers) — one read() would spike RSS by
-                    # the whole window per truncation
-                    shutil.copyfileobj(src, f, 1 << 20)
+                    # bounded chunked copy up to the captured end:
+                    # concurrent appends land PAST it and are caught
+                    # up under the lock at commit; copying an
+                    # unbounded growing tail here could chase a busy
+                    # writer forever (and risk copying a half-written
+                    # buffered record)
+                    _copy_range(src, f, staged_end_phys
+                                - (offset - delta))
                     f.flush()
                     os.fsync(f.fileno())
-                os.replace(tmp, self.path)
-                self._reopen_backend_locked()
-            self._base = offset
-            self._delta = offset - TRUNC_MARKER_LEN
-            if self._group is not None:
-                # the whole rewritten file was just fsynced: written
-                # and synced watermarks cover its logical end
-                end = self._backend_end_locked() + self._delta
-                self._logical_end = end
-                self._written_end = end
-                self._synced_end = max(self._synced_end, end)
-            stats.registry.log_truncated_bytes.inc(offset - old_base)
-            self._lock.notify_all()
-            return self._base
+            return {"offset": offset, "delta": delta, "seq": seq,
+                    "staged_end_phys": staged_end_phys, "tmp": tmp}
+        except BaseException:
+            # unlink BEFORE the flag drops, under the lock: clearing
+            # first would let a new stager open this same path and
+            # then lose its temp to our late remove
+            with self._lock:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                self._trunc_staging = False
+            raise
+
+    def abort_truncate(self, stage: dict) -> None:
+        """Discard a staged truncation that will never be committed
+        (the checkpoint failed between stage and commit): clear the
+        in-flight flag and remove the temp so the next checkpoint can
+        stage afresh.  Idempotent — a no-op after a successful commit
+        (the rename consumed the temp, the flag is already down).
+        Ownership-checked: the token's generation must match the stage
+        currently in flight — aborting a consumed token while a NEWER
+        stage is composing must not unlink that stage's temp.  The
+        unlink runs under the lock, BEFORE the flag drops — the other
+        order would let a fresh stage open the shared temp path and
+        then lose it to this late remove."""
+        with self._lock:
+            if not (self._trunc_staging
+                    and stage.get("seq") == self._trunc_seq):
+                return  # consumed, superseded, or never ours
+            try:
+                os.remove(stage["tmp"])
+            except OSError:
+                pass
+            self._trunc_staging = False
+
+    def commit_truncate(self, stage: dict) -> int:
+        """Phase 2: under the handle lock, re-validate the staged cut,
+        append the (bounded — whatever arrived during the copy) byte
+        delta to the temp file, fsync it, atomically rename over the
+        log, and swap the backend handle.  Returns the new truncation
+        base.  The blocking calls below are audited rather than moved:
+        the catch-up is bounded by the stage->commit window, and the
+        rename must serialize against appenders or a racing append
+        would land on the unlinked inode and vanish."""
+        tmp = stage["tmp"]
+        offset = stage["offset"]
+        committed = False
+        with self._lock:
+            # ownership check OUTSIDE the try: a stale token (aborted,
+            # or a newer stage took the slot) must fail loudly WITHOUT
+            # the finally below clearing the live stage's flag or
+            # unlinking its temp
+            if not (self._trunc_staging
+                    and stage.get("seq") == self._trunc_seq):
+                raise OSError(
+                    f"stale truncation stage for {self.path}: token "
+                    "was aborted or superseded — re-stage before "
+                    "committing")
+            try:
+                if self._native is None and self._py is None:
+                    raise OSError(f"log {self.path} is closed")
+                if offset <= self._base:
+                    return self._base  # superseded: nothing to do
+                if self._group is not None:
+                    self._write_staged_locked()
+                if self._native:
+                    self._native[0].oplog_flush(self._native[1])
+                else:
+                    self._py.flush()
+                old_base = self._base
+                # an out-of-lock fsync still holds the handle we are
+                # about to close — wait it out (same guard as close())
+                while self._io_refs:
+                    self._lock.wait()
+                cur_end_phys = self._backend_end_locked()
+                catchup = cur_end_phys - stage["staged_end_phys"]
+                with tracer.span("log_truncate", "oplog",
+                                 path=os.path.basename(self.path),
+                                 base=offset, catchup_bytes=catchup,
+                                 reclaimed=offset - old_base):
+                    if catchup > 0:
+                        # "r+b", NOT "ab": a vanished temp must raise,
+                        # not be silently recreated as a marker-less
+                        # catch-up-only file the rename would install
+                        # over the whole log
+                        with open(self.path, "rb") as src, \
+                                open(tmp, "r+b") as f:
+                            src.seek(stage["staged_end_phys"])
+                            f.seek(0, os.SEEK_END)
+                            _copy_range(src, f, catchup)
+                            f.flush()
+                            # lock-ok: bounded by the stage->commit
+                            # window (bytes appended DURING the tail
+                            # copy), not by the retained suffix — the
+                            # unbounded copy already ran out of lock
+                            os.fsync(f.fileno())
+                    # lock-ok: the rename must serialize against
+                    # appenders — a racing append to the old inode
+                    # would be lost; metadata-only, no data copy here
+                    os.replace(tmp, self.path)
+                    # lock-ok: directory fsync pins the rename — the
+                    # watermark bump below marks catch-up bytes
+                    # durable, and without this a power cut could
+                    # resurrect the old inode whose tail was never
+                    # fsynced (an acked commit gone on recovery)
+                    _fsync_dir(os.path.dirname(self.path))
+                    committed = True
+                    self._reopen_backend_locked()
+                self._base = offset
+                self._delta = offset - TRUNC_MARKER_LEN
+                if self._group is not None:
+                    # the whole rewritten file was just fsynced:
+                    # written and synced watermarks cover its end
+                    end = self._backend_end_locked() + self._delta
+                    self._logical_end = end
+                    self._written_end = end
+                    self._synced_end = max(self._synced_end, end)
+                stats.registry.log_truncated_bytes.inc(
+                    offset - old_base)
+                return self._base
+            finally:
+                self._trunc_staging = False
+                self._lock.notify_all()
+                if not committed:
+                    # superseded/failed commit: the staged file is
+                    # stale — never leave it to poison a later stage
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
 
     def _reopen_backend_locked(self) -> None:
         """Swap the backend handle onto the (just-renamed) file — the

@@ -197,6 +197,10 @@ class PartitionLog:
                 # this exact sequencing as the bench baseline)
                 tracer.instant("log_sync_inline", "oplog",
                                txid=rec.txid, partition=self.partition)
+                # lock-ok: legacy per-record path (Config.log_group=
+                # False) — the inline fsync under the partition lock
+                # IS the bench baseline being preserved; the group
+                # plane defers durability to out-of-lock tickets
                 self.log.sync()
             self._index(rec, off)
         if self.on_append is not None:
@@ -320,6 +324,9 @@ class PartitionLog:
             tracer.instant("log_sync_inline", "oplog",
                            partition=self.partition,
                            records=len(records))
+            # lock-ok: legacy per-record path (Config.log_group=False)
+            # — the remote-apply inline fsync matches the local
+            # commit path's baseline sequencing exactly
             self.log.sync()
         return None
 
@@ -697,13 +704,49 @@ class PartitionLog:
                        cut=doc["cut_offset"], keys=len(doc["keys"]))
         self.ckpt.write_doc(doc)
 
-    def adopt_checkpoint(self, doc: dict) -> None:
+    def stage_truncation(self, doc: dict) -> Optional[dict]:
+        """Phase 1 of the document's truncation plan — compose the
+        rewritten log file (truncation marker + retained suffix) via
+        :meth:`DurableLog.stage_truncate_below`, OUTSIDE the partition
+        lock: the retained tail can be hundreds of MB (the retention
+        floor holds the cut back for lagging peers) and the PR-9 form
+        copied it with every commit stalled behind the lock.  Returns
+        the stage token :meth:`adopt_checkpoint` redeems, or None when
+        truncation is off, the cut is a no-op, or another stage is in
+        flight (the caller's next checkpoint retries).  The cut is
+        bounded by the retention floor — ``min`` over peers of the
+        inter-DC ship/ack watermark minus the ``retain_ops`` margin —
+        so the persisted floors describe exactly the file the commit
+        leaves behind."""
+        if self.ckpt is None or not self.ckpt.settings.truncate:
+            return None
+        cut = min(doc.get("trunc_cut", 0), doc["cut_offset"],
+                  doc["pending_floor"])
+        if cut <= self.log.truncated_base:
+            return None
+        token = self.log.stage_truncate_below(cut)
+        if token is None:
+            return None
+        return {"cut": cut, "token": token}
+
+    def abort_truncation(self, trunc_stage: dict) -> None:
+        """Discard a :meth:`stage_truncation` token whose checkpoint
+        failed before :meth:`adopt_checkpoint` could redeem it — the
+        stage/abort pair lives at ONE layer so callers never unwrap
+        the DurableLog token themselves.  Idempotent after a landed
+        commit (the token's generation no longer matches)."""
+        self.log.abort_truncate(trunc_stage["token"])
+
+    def adopt_checkpoint(self, doc: dict,
+                         trunc_stage: Optional[dict] = None) -> None:
         """Make a persisted document's seeds live for the replay paths
         (eviction migration, read-below-base, host-store cache misses)
-        and reclaim log bytes below its cut when the settings and the
-        retention floor allow.  Must run under the owning partition's
-        lock, like :meth:`capture_cut` — the seed swap and the index
-        prune race the readers otherwise."""
+        and commit the staged truncation of log bytes below its cut
+        (``trunc_stage``, from :meth:`stage_truncation` — run BEFORE
+        taking the partition lock; only the bounded catch-up + rename
+        half runs here).  Must run under the owning partition's lock,
+        like :meth:`capture_cut` — the seed swap and the index prune
+        race the readers otherwise."""
         self.ckpt_doc = doc
         self.ckpt_seeds = {
             key: (tn, state, VC(vc))
@@ -712,19 +755,15 @@ class PartitionLog:
                                      partition=str(self.partition))
         recorder.record("oplog", "ckpt_write", partition=self.partition,
                         cut=doc["cut_offset"], keys=len(doc["keys"]))
-        if self.ckpt.settings.truncate:
-            self._truncate_to(doc)
+        if trunc_stage is not None:
+            self._commit_truncation(doc, trunc_stage)
 
-    def _truncate_to(self, doc: dict) -> None:
-        """Execute the document's truncation plan: reclaim log bytes
-        below the cut it CAPTURED (bounded then by the retention floor
-        — ``min`` over peers of the inter-DC ship/ack watermark minus
-        the ``retain_ops`` margin), so the persisted floors describe
-        exactly the file this truncation leaves behind."""
-        cut = min(doc.get("trunc_cut", 0), doc["cut_offset"],
-                  doc["pending_floor"])
-        if cut <= self.log.truncated_base:
-            return
+    def _commit_truncation(self, doc: dict, trunc_stage: dict) -> None:
+        """Phase 2: redeem the staged rewrite — re-validate + bounded
+        catch-up + atomic rename inside :meth:`DurableLog.
+        commit_truncate` — and advance the below-base answer floors to
+        match the file the rename left behind."""
+        cut = trunc_stage["cut"]
         tracer.instant("ckpt_truncate", "oplog",
                        partition=self.partition, cut=cut)
         # the document's floors were derived for exactly trunc_cut; a
@@ -732,7 +771,13 @@ class PartitionLog:
         # this same min) re-derives BEFORE the base advances
         floors = (doc["repair_floors"], doc["op_floors"]) \
             if doc.get("trunc_cut") == cut else self._floors_at(cut)
-        base = self.log.truncate_below(cut)
+        base = self.log.commit_truncate(trunc_stage["token"])
+        if base > cut:
+            # superseded: someone already truncated PAST our cut (a
+            # superseded commit_truncate returns the higher live base,
+            # never less) — our floors were derived for the lower cut
+            # and would under-fence the reclaimed window
+            return
         self.note_truncated(base, floors=floors)
         stats.registry.ckpt_truncations.inc()
         recorder.record("oplog", "log_truncate",

@@ -1219,6 +1219,7 @@ class PartitionManager:
                 return self.log.ckpt_doc
             self._ckpt_inflight = True
         dirty: Dict[Any, str] = {}
+        trunc: Optional[dict] = None
         try:
             with self._lock, \
                     tracer.span("ckpt_cut", "oplog",
@@ -1246,8 +1247,18 @@ class PartitionManager:
             # the partition lock — commits and reads proceed while the
             # document lands (the PR-8 no-fsync-under-the-lock lesson)
             self.log.persist_checkpoint(doc)
+            # the truncation tail copy (possibly hundreds of retained
+            # MB) stages OUT here too; only the bounded catch-up +
+            # atomic rename runs under the lock inside adopt (ISSUE 11
+            # — the ROADMAP "stage the rewrite out of the lock" item)
+            trunc = self.log.stage_truncation(doc)
             with self._lock:
-                self.log.adopt_checkpoint(doc)
+                # lock-ok: adopt redeems the staged truncation — the
+                # BOUNDED half (catch-up of bytes appended during the
+                # copy, atomic rename, directory fsync) runs under the
+                # partition lock by design; the unbounded tail copy
+                # already staged out above
+                self.log.adopt_checkpoint(doc, trunc)
                 self._ckpt_ops = 0
                 self._ckpt_last_end = doc["cut_offset"]
             recorder.record("oplog", "ckpt_cut_done",
@@ -1266,6 +1277,11 @@ class PartitionManager:
                 merged = dict(dirty)
                 merged.update(self._ckpt_dirty)
                 self._ckpt_dirty = merged
+            if trunc is not None:
+                # a stage that will never be committed wedges every
+                # future truncation behind the in-flight flag — drop
+                # it (idempotent no-op if the commit did land)
+                self.log.abort_truncation(trunc)
             raise
         finally:
             with self._lock:

@@ -1,0 +1,387 @@
+"""tier-1 hook for tools/concurrency_lint.py — the concurrency
+discipline the PR-8/PR-9 review rounds taught (no blocking IO under a
+lock, a global lock acquisition order, config knobs routed through the
+*_from_config factories) can't silently rot (ISSUE 11).  Fixture tests
+prove each rule family actually fires; the clean-repo runs prove the
+current tree satisfies them."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "tools"))
+import concurrency_lint  # noqa: E402
+
+
+def _write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+
+
+def _lint(root, tag):
+    return [p for p in concurrency_lint.lint(str(root))
+            if f"[{tag}]" in p]
+
+
+# ------------------------------------------------------- repo is clean
+
+def test_repo_is_clean():
+    problems = concurrency_lint.lint(concurrency_lint.repo_root())
+    assert not problems, "\n".join(problems)
+
+
+def test_standalone_main_exit_code():
+    assert concurrency_lint.main([]) == 0
+
+
+# ------------------------------------------- rule 1: blocking-under-lock
+
+def test_blocking_call_under_lock_fires(tmp_path):
+    """The PR-8 bug shape: an fsync inside a ``with self._lock:``
+    region is flagged; the same call outside the region passes."""
+    _write(tmp_path, "antidote_tpu/newlog.py",
+           "import os\n"
+           "class L:\n"
+           "    def bad_commit(self):\n"
+           "        with self._lock:\n"
+           "            os.fsync(self.fd)\n"
+           "    def good_commit(self):\n"
+           "        with self._lock:\n"
+           "            off = self.end\n"
+           "        os.fsync(self.fd)\n")
+    problems = _lint(tmp_path, "lock-blocking")
+    assert len(problems) == 1
+    assert "newlog.py:5" in problems[0]
+    assert "fsync" in problems[0]
+
+
+def test_transitive_blocking_through_call_graph(tmp_path):
+    """Exactly how the PR-8 fsync hid: the lock region calls a helper
+    whose helper fsyncs — flagged with the witness path."""
+    _write(tmp_path, "antidote_tpu/newlog.py",
+           "import os\n"
+           "class L:\n"
+           "    def commit(self):\n"
+           "        with self._lock:\n"
+           "            self._persist()\n"
+           "    def _persist(self):\n"
+           "        self._really_persist()\n"
+           "    def _really_persist(self):\n"
+           "        os.fsync(self.fd)\n")
+    problems = _lint(tmp_path, "lock-blocking")
+    assert len(problems) == 1
+    assert "newlog.py:5" in problems[0]
+    assert "_persist" in problems[0] and "fsync" in problems[0]
+
+
+def test_repo_blocking_primitives_are_facts(tmp_path):
+    """This repo's own blocking primitives (wait_durable, the
+    truncation rewrite, checkpoint IO) are blocking facts, not just
+    os-level calls — their documented 'must not hold the partition
+    lock' contracts are machine-enforced."""
+    _write(tmp_path, "antidote_tpu/newmgr.py",
+           "class M:\n"
+           "    def bad_commit(self, ticket):\n"
+           "        with self._lock:\n"
+           "            self.log.wait_durable(ticket)\n"
+           "    def bad_ckpt(self, cut):\n"
+           "        with self._lock:\n"
+           "            self.log.truncate_below(cut)\n")
+    problems = _lint(tmp_path, "lock-blocking")
+    assert len(problems) == 2
+    assert any("durability wait" in p for p in problems)
+    assert any("log-suffix rewrite" in p for p in problems)
+
+
+def test_lock_ok_with_reason_suppresses(tmp_path):
+    """An audited ``# lock-ok: <reason>`` on the blocking line keeps
+    the site out of the findings — and covers callers reached through
+    the call graph too (one audited source line, N call sites)."""
+    _write(tmp_path, "antidote_tpu/newlog.py",
+           "import os\n"
+           "class L:\n"
+           "    def commit(self):\n"
+           "        with self._lock:\n"
+           "            self._persist()\n"
+           "    def inline_commit(self):\n"
+           "        with self._lock:\n"
+           "            os.fsync(self.fd)  # lock-ok: bench baseline\n"
+           "    def _persist(self):\n"
+           "        os.fsync(self.fd)  # lock-ok: tiny bounded file\n")
+    assert _lint(tmp_path, "lock-blocking") == []
+
+
+def test_lock_ok_on_preceding_comment_line_attaches(tmp_path):
+    """Reasons rarely fit beside the call: a comment-only ``# lock-ok:
+    <reason>`` line (or block) audits the next code line."""
+    _write(tmp_path, "antidote_tpu/newlog.py",
+           "import os\n"
+           "class L:\n"
+           "    def commit(self):\n"
+           "        with self._lock:\n"
+           "            # lock-ok: the fsync is what the lock orders\n"
+           "            # — two-line audit comment\n"
+           "            os.fsync(self.fd)\n")
+    assert _lint(tmp_path, "lock-blocking") == []
+
+
+def test_bare_lock_ok_is_a_finding_and_does_not_suppress(tmp_path):
+    """Suppression hygiene (ISSUE 11 satellite): ``# lock-ok`` without
+    a reason defeats the audit trail — it is itself a finding AND the
+    blocking call it decorates stays flagged."""
+    _write(tmp_path, "antidote_tpu/newlog.py",
+           "import os\n"
+           "class L:\n"
+           "    def commit(self):\n"
+           "        with self._lock:\n"
+           "            os.fsync(self.fd)  # lock-ok\n")
+    assert len(_lint(tmp_path, "lock-ok-reason")) == 1
+    assert len(_lint(tmp_path, "lock-blocking")) == 1
+
+
+def test_lock_ok_inside_string_literal_is_not_a_suppression(tmp_path):
+    """The literal text ``# lock-ok`` inside a docstring or error
+    message is prose, not an audit: it must neither suppress a
+    following code line nor register as a (here: bare) suppression
+    site for the reason-hygiene rule — the scan is over real COMMENT
+    tokens, not raw-line substrings."""
+    _write(tmp_path, "antidote_tpu/newdoc.py",
+           "import os\n"
+           "class L:\n"
+           "    def bad_commit(self):\n"
+           "        '''A bare\n"
+           "# lock-ok\n"
+           "        without a reason defeats the audit.'''\n"
+           "        with self._lock:\n"
+           "            os.fsync(self.fd)\n")
+    assert len(_lint(tmp_path, "lock-blocking")) == 1
+    assert _lint(tmp_path, "lock-ok-reason") == []
+
+
+def test_wait_on_held_condition_is_exempt(tmp_path):
+    """Waiting on the condition you hold is the release-and-sleep
+    idiom (the wait RELEASES the lock); waiting on any other object
+    while holding a lock is the hazard."""
+    _write(tmp_path, "antidote_tpu/newmgr.py",
+           "class M:\n"
+           "    def good_drain(self):\n"
+           "        with self._lock:\n"
+           "            while self.busy:\n"
+           "                self._lock.wait()\n"
+           "    def bad_drain(self):\n"
+           "        with self._lock:\n"
+           "            self.done_ev.wait()\n")
+    problems = _lint(tmp_path, "lock-blocking")
+    assert len(problems) == 1
+    assert "bad_drain" in problems[0]
+
+
+def test_condition_wrapping_a_lock_aliases_to_it(tmp_path):
+    """``self._cv = threading.Condition(self._lock)`` shares the lock:
+    waiting on the cv while holding the lock is the same
+    release-and-sleep idiom, not a second lock."""
+    _write(tmp_path, "antidote_tpu/newship.py",
+           "import threading\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._cv = threading.Condition(self._lock)\n"
+           "    def stage(self):\n"
+           "        with self._lock:\n"
+           "            while self.full:\n"
+           "                self._cv.wait()\n")
+    declared = concurrency_lint._DECLARED_LOCKS
+    saved = dict(declared)
+    declared["antidote_tpu/newship.py"] = {"_cv"}
+    try:
+        assert _lint(tmp_path, "lock-blocking") == []
+    finally:
+        declared.clear()
+        declared.update(saved)
+
+
+# ---------------------------------------------------- rule 2: lock-order
+
+def test_lock_order_cycle_fires_with_witness(tmp_path):
+    """Opposite nesting orders across two paths deadlock under
+    contention — the global acquisition-order graph catches it even
+    though each function alone looks fine."""
+    _write(tmp_path, "antidote_tpu/newplane.py",
+           "class P:\n"
+           "    def ship(self):\n"
+           "        with self._ship_lock:\n"
+           "            with self._ack_lock:\n"
+           "                pass\n"
+           "    def ack(self):\n"
+           "        with self._ack_lock:\n"
+           "            with self._ship_lock:\n"
+           "                pass\n")
+    problems = _lint(tmp_path, "lock-order")
+    assert len(problems) == 1
+    assert "cycle" in problems[0]
+    assert "P._ship_lock" in problems[0] and "P._ack_lock" in problems[0]
+    # the witness edges name the functions that create each edge
+    assert "P.ship" in problems[0] and "P.ack" in problems[0]
+
+
+def test_lock_order_cycle_through_call_graph(tmp_path):
+    """A cycle only visible across a call: f holds A and calls g which
+    takes B, while h nests B -> A directly."""
+    _write(tmp_path, "antidote_tpu/newplane.py",
+           "class P:\n"
+           "    def f(self):\n"
+           "        with self._a_lock:\n"
+           "            self.g()\n"
+           "    def g(self):\n"
+           "        with self._b_lock:\n"
+           "            pass\n"
+           "    def h(self):\n"
+           "        with self._b_lock:\n"
+           "            with self._a_lock:\n"
+           "                pass\n")
+    problems = _lint(tmp_path, "lock-order")
+    assert len(problems) == 1
+    assert "cycle" in problems[0]
+
+
+def test_nested_reacquire_is_self_deadlock(tmp_path):
+    """Re-entering the same non-reentrant lock in one function is a
+    guaranteed self-deadlock; an RLock is exempt."""
+    _write(tmp_path, "antidote_tpu/newstore.py",
+           "import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._relock = threading.RLock()\n"
+           "    def bad(self):\n"
+           "        with self._lock:\n"
+           "            with self._lock:\n"
+           "                pass\n"
+           "    def fine(self):\n"
+           "        with self._relock:\n"
+           "            with self._relock:\n"
+           "                pass\n")
+    problems = _lint(tmp_path, "lock-order")
+    assert len(problems) == 1
+    assert "self-deadlock" in problems[0] and "A.bad" in problems[0]
+
+
+def test_consistent_order_is_clean(tmp_path):
+    """Same nesting order everywhere: no finding."""
+    _write(tmp_path, "antidote_tpu/newplane.py",
+           "class P:\n"
+           "    def ship(self):\n"
+           "        with self._ship_lock:\n"
+           "            with self._ack_lock:\n"
+           "                pass\n"
+           "    def drain(self):\n"
+           "        with self._ship_lock:\n"
+           "            with self._ack_lock:\n"
+           "                pass\n")
+    assert _lint(tmp_path, "lock-order") == []
+
+
+# ------------------------------------------ rule 3: knob routing + cov
+
+_CONFIG_FIXTURE = (
+    "class Config:\n"
+    "    used_knob: int = 1\n"
+    "    other_knob: float = 0.5\n")
+
+
+def test_out_of_factory_construction_fires(tmp_path):
+    """The gate_from_config lesson: constructing a config-routed
+    settings class outside its blessed factory module invents defaults
+    the knobs never reach; the blessed module itself passes."""
+    _write(tmp_path, "antidote_tpu/config.py", _CONFIG_FIXTURE)
+    _write(tmp_path, "antidote_tpu/use.py",
+           "def f(config):\n"
+           "    return config.used_knob + config.other_knob\n")
+    _write(tmp_path, "antidote_tpu/mat/ingest.py",
+           "class IngestSettings:\n"
+           "    pass\n"
+           "def ingest_from_config(config):\n"
+           "    return IngestSettings()\n")
+    _write(tmp_path, "antidote_tpu/mat/rogue.py",
+           "from antidote_tpu.mat.ingest import IngestSettings\n"
+           "def assemble():\n"
+           "    return IngestSettings()\n")
+    problems = _lint(tmp_path, "knob-routing")
+    assert len(problems) == 1
+    assert "rogue.py" in problems[0]
+    assert "IngestSettings" in problems[0]
+    assert "ingest.py" in problems[0]  # points at the blessed factory
+
+
+def test_unknown_knob_read_fires(tmp_path):
+    """Reading Config.<typo> silently falls through to getattr
+    defaults at runtime — statically flagged."""
+    _write(tmp_path, "antidote_tpu/config.py", _CONFIG_FIXTURE)
+    _write(tmp_path, "antidote_tpu/use.py",
+           "def f(config):\n"
+           "    return config.used_knob + config.other_knob\n"
+           "def g(self):\n"
+           "    return self.config.used_knbo\n")
+    problems = _lint(tmp_path, "knob-unknown")
+    assert len(problems) == 1
+    assert "used_knbo" in problems[0]
+
+
+def test_dead_knob_fires(tmp_path):
+    """A declared knob nothing reads is a promise the system does not
+    keep — the PR-11 sweep deleted two of these from the real tree."""
+    _write(tmp_path, "antidote_tpu/config.py", _CONFIG_FIXTURE)
+    _write(tmp_path, "antidote_tpu/use.py",
+           "def f(config):\n"
+           "    return config.used_knob\n")
+    problems = _lint(tmp_path, "knob-dead")
+    assert len(problems) == 1
+    assert "other_knob" in problems[0]
+
+
+def test_knob_reads_in_benches_count_for_coverage(tmp_path):
+    """bench-only knobs are still routed knobs: a read under benches/
+    keeps the knob alive (the coverage sweep spans antidote_tpu/,
+    benches/, tools/ and bench.py)."""
+    _write(tmp_path, "antidote_tpu/config.py", _CONFIG_FIXTURE)
+    _write(tmp_path, "antidote_tpu/use.py",
+           "def f(config):\n"
+           "    return config.used_knob\n")
+    _write(tmp_path, "benches/newbench.py",
+           "def run(cfg):\n"
+           "    return cfg.other_knob\n")
+    assert _lint(tmp_path, "knob-dead") == []
+
+
+def test_all_fixture_rules_are_tagged():
+    """Every fixture above keys off a [tag] the module actually
+    emits — guard the tag names against drift."""
+    src = open(concurrency_lint.__file__).read()
+    for tag in ("lock-blocking", "lock-ok-reason", "lock-order",
+                "knob-routing", "knob-unknown", "knob-dead"):
+        assert f"[{tag}]" in src
+
+
+# --------------------------------------- the flagship fix stays fixed
+
+def test_truncation_tail_copy_is_not_audited_under_lock():
+    """The ISSUE-11 acceptance bar: the staged truncation tail copy
+    (stage_truncate_below's chunked suffix copy) runs OUTSIDE the
+    locks and needs no `# lock-ok` — only the bounded catch-up +
+    rename inside commit_truncate carries audits."""
+    root = concurrency_lint.repo_root()
+    src = open(os.path.join(root, "antidote_tpu", "oplog",
+                            "log.py")).read()
+    stage = src.split("def stage_truncate_below", 1)[1]
+    stage = stage.split("def abort_truncate", 1)[0]
+    assert "_copy_range" in stage, "the staged tail copy moved?"
+    assert "# lock-ok" not in stage, \
+        "the staged tail copy must not need an audit — it runs " \
+        "outside the locks by construction"
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -153,3 +153,77 @@ def test_logging_disabled(tmp_path):
     assert plog.committed_payloads() == []  # nothing durable
     assert not (tmp_path / "off").exists()
     plog.close()
+
+
+# ----------------------------------------- staged truncation (ISSUE 11)
+
+
+def test_staged_truncation_interleaves_appends(tmp_path, backend):
+    """The two-phase truncation contract: the tail copy stages out of
+    the handle lock, appends land while the stage is open, and the
+    commit's bounded catch-up folds them into the rewritten file —
+    nothing rides the old inode into the unlink."""
+    p = str(tmp_path / "log")
+    log = DurableLog(p, backend=backend)
+    offs = [log.append(f"rec{i}".encode()) for i in range(50)]
+    log.sync()
+    cut = offs[30]
+    tok = log.stage_truncate_below(cut)
+    assert tok is not None
+    # one stage in flight at a time — a second is refused, not queued
+    assert log.stage_truncate_below(offs[40]) is None
+    # appends proceed mid-stage (the handle lock is NOT held) and are
+    # exactly what commit_truncate's catch-up must preserve
+    extra = [log.append(f"late{i}".encode()) for i in range(5)]
+    log.flush()
+    assert log.commit_truncate(tok) == cut
+    assert log.truncated_base == cut
+    assert not os.path.exists(p + ".trunc-tmp")
+    assert log.read(offs[29]) is None      # below the base: reclaimed
+    assert log.read(offs[31]) == b"rec31"  # retained suffix intact
+    assert log.read(extra[-1]) == b"late4"  # catch-up bytes intact
+    log.close()
+    re = DurableLog(p, backend=backend)
+    assert re.truncated_base == cut
+    assert [b for _, b in re.scan()] == \
+        [f"rec{i}".encode() for i in range(30, 50)] + \
+        [f"late{i}".encode() for i in range(5)]
+    re.close()
+
+
+def test_staged_truncation_abort_clears_inflight(tmp_path, backend):
+    """An aborted stage (checkpoint failed between the phases) removes
+    the temp and releases the in-flight flag so the next checkpoint
+    can stage afresh; abort after a successful commit is a no-op."""
+    p = str(tmp_path / "log")
+    log = DurableLog(p, backend=backend)
+    offs = [log.append(f"rec{i}".encode()) for i in range(20)]
+    log.sync()
+    tok = log.stage_truncate_below(offs[10])
+    log.abort_truncate(tok)
+    assert not os.path.exists(p + ".trunc-tmp")
+    # an aborted token is dead: committing it must fail loudly, never
+    # rename a recreated (marker-less) temp over the log
+    with pytest.raises(OSError, match="stale"):
+        log.commit_truncate(tok)
+    tok2 = log.stage_truncate_below(offs[10])
+    assert tok2 is not None
+    assert log.commit_truncate(tok2) == offs[10]
+    log.abort_truncate(tok2)  # idempotent after the rename
+    assert log.truncated_base == offs[10]
+    assert log.read(offs[11]) == b"rec11"
+    log.close()
+
+
+def test_truncate_below_wrapper_still_one_shot(tmp_path, backend):
+    """Lock-free callers (tests, resize tooling) keep the one-call
+    form: truncate_below stages + commits back to back."""
+    p = str(tmp_path / "log")
+    log = DurableLog(p, backend=backend)
+    offs = [log.append(f"rec{i}".encode()) for i in range(20)]
+    log.sync()
+    assert log.truncate_below(offs[15]) == offs[15]
+    assert log.truncate_below(offs[3]) == offs[15]  # no-op below base
+    assert [b for _, b in log.scan()] == \
+        [f"rec{i}".encode() for i in range(15, 20)]
+    log.close()

@@ -1,0 +1,124 @@
+"""tier-1 gate for tools/static_suite.py — the ONE repo-clean hook for
+every static pass (ISSUE 11 satellite).  analysis_gate, trace_lint and
+concurrency_lint each grew their own CI test; a pass added without a
+hook silently missed CI.  This file gates ``static_suite.PASSES``
+itself, so appending a pass there is all a new analyzer needs —
+``test_repo_is_clean`` picks it up from that commit on.  The per-pass
+fixture tests (each rule actually fires) stay with their analyzers:
+test_analysis_gate.py / test_trace_lint.py / test_concurrency_lint.py;
+the stats-dashboard pass lives in the suite and is fixtured HERE."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "tools"))
+import static_suite  # noqa: E402
+
+
+def test_repo_is_clean():
+    """The single repo-clean gate: every registered pass, zero
+    findings.  A failure message carries the pass-prefixed findings."""
+    problems = static_suite.run(static_suite.repo_root())
+    assert not problems, "\n".join(problems)
+
+
+def test_standalone_main_exit_code(monkeypatch, capsys):
+    """main's arg/exit plumbing — against a stubbed clean pass list:
+    test_repo_is_clean already paid for the real 4-pass sweep and
+    running it twice doubles this file's tier-1 cost for no coverage."""
+    monkeypatch.setattr(static_suite, "PASSES",
+                        (("stub", lambda root: []),))
+    assert static_suite.main([]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_registry_covers_every_analyzer():
+    """The suite is the aggregation point — all three standalone
+    analyzers plus the suite-resident stats-dashboard rule.  If an
+    analyzer is added to tools/ it must land here too (that is the
+    point of the suite), and this list is the reminder."""
+    assert [name for name, _ in static_suite.PASSES] == \
+        ["analysis_gate", "trace_lint", "concurrency_lint",
+         "stats-dashboard"]
+
+
+def test_findings_route_with_pass_prefix(monkeypatch):
+    """run() aggregates findings verbatim under ``<pass>: `` so a CI
+    failure names the analyzer to re-run standalone."""
+    monkeypatch.setattr(
+        static_suite, "PASSES",
+        (("quiet", lambda root: []),
+         ("noisy", lambda root: ["x.py:1: [boom] broken"])))
+    assert static_suite.run("ignored-root") == \
+        ["noisy: x.py:1: [boom] broken"]
+
+
+def test_main_exit_code_nonzero_on_findings(monkeypatch, capsys):
+    monkeypatch.setattr(
+        static_suite, "PASSES",
+        (("noisy", lambda root: ["x.py:1: [boom] broken"]),))
+    assert static_suite.main(["ignored-root"]) == 1
+    assert "noisy: x.py:1: [boom] broken" in capsys.readouterr().err
+
+
+# ------------------------------------------------ stats-dashboard rule
+
+def _stats_fixture(tmp_path, readme_text):
+    pkg = tmp_path / "antidote_tpu"
+    pkg.mkdir()
+    (pkg / "stats.py").write_text(
+        "class Counter:\n"
+        "    def __init__(self, name, help=''):\n"
+        "        self.name = name\n"
+        "registry_ghost = Counter('antidote_ghost_total', 'dark')\n")
+    mon = tmp_path / "monitoring"
+    mon.mkdir()
+    (mon / "README.md").write_text(readme_text)
+    return str(tmp_path)
+
+
+def test_stats_dashboard_rule_fires(tmp_path):
+    """A family registered in stats.py but absent from both dashboard
+    docs is flagged by name (ISSUE 11 satellite: PR 5-9 hand-kept this
+    mapping; a dark metric is a dashboard hole nobody notices until an
+    incident)."""
+    root = _stats_fixture(tmp_path, "# monitoring\nnothing here\n")
+    problems = static_suite.lint_stats_dashboard(root)
+    assert len(problems) == 1
+    assert "antidote_ghost_total" in problems[0]
+    assert "[stats-dashboard]" in problems[0]
+
+
+def test_stats_dashboard_rule_accepts_documented_family(tmp_path):
+    root = _stats_fixture(
+        tmp_path, "# monitoring\n`antidote_ghost_total` — counts.\n")
+    assert static_suite.lint_stats_dashboard(root) == []
+
+
+def test_stats_dashboard_rule_flags_missing_docs(tmp_path):
+    """No dashboard docs at all is itself a finding — a silently
+    vacuous pass would defeat the rule."""
+    root = _stats_fixture(tmp_path, "")
+    os.remove(os.path.join(root, "monitoring", "README.md"))
+    problems = static_suite.lint_stats_dashboard(root)
+    assert len(problems) == 1
+    assert "no dashboard docs" in problems[0]
+
+
+def test_stats_dashboard_rule_is_not_vacuous_on_the_repo():
+    """The extractor sees the real registry: the repo's stats.py
+    registers dozens of families (63 at ISSUE 11), each of which this
+    rule checked against the monitoring docs.  Guard the floor so a
+    stats.py refactor that breaks the AST walk fails loudly instead of
+    passing on zero families."""
+    import ast
+    stats_py = os.path.join(static_suite.repo_root(),
+                            "antidote_tpu", "stats.py")
+    with open(stats_py) as f:
+        tree = ast.parse(f.read())
+    fams = [n for n in ast.walk(tree)
+            if isinstance(n, ast.Call)
+            and getattr(n.func, "id", None) in static_suite._METRIC_CLASSES
+            and n.args and isinstance(n.args[0], ast.Constant)]
+    assert len(fams) >= 40

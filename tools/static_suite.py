@@ -1,0 +1,149 @@
+"""static_suite — the one entry point for every static pass (ISSUE 11).
+
+The reference wires dialyzer/elvis into ``make test`` as a single
+stage; our analyzers grew one at a time (analysis_gate in PR 1,
+trace_lint in PR 1-10, concurrency_lint in PR 11) and each needed its
+own CI hook — a new rule that forgot its hook silently missed CI.
+This module is the aggregation point:
+
+    python -m tools.static_suite          # exit 0 = the repo is clean
+
+runs, over the ONE shared path list (``SUITE_PATHS``):
+
+- **analysis_gate** — surface hygiene: syntax, unused imports, bare
+  except, mutable defaults, duplicate defs, literal compares
+  (suppress with ``# noqa``)
+- **trace_lint** — observability coverage: entry-point spans, kernel
+  spans, publish/decode instants, sync/checkpoint IO spans
+- **concurrency_lint** — concurrency discipline: blocking calls under
+  a lock (suppress with ``# lock-ok: <reason>``), lock acquisition
+  order, config-knob routing + coverage
+- **stats-dashboard** (lives here) — every metric family registered
+  in antidote_tpu/stats.py must appear in the Grafana dashboard or
+  monitoring/README.md: PR 5-9 each hand-maintained that mapping and
+  a dark metric is a dashboard hole nobody notices until an incident
+  [stats-dashboard]
+
+tests/unit/test_static_suite.py runs :func:`run` repo-clean as the
+single tier-1 gate, so an analyzer added to ``PASSES`` is gated from
+the commit that adds it.  To add a pass: write ``lint(root) ->
+[str]`` in a tools/ module, append ``(name, fn)`` to ``PASSES``, and
+add a fixture test proving the rule fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Callable, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import analysis_gate  # noqa: E402
+import concurrency_lint  # noqa: E402
+import trace_lint  # noqa: E402
+
+#: the one shared path list: everything the hygiene pass sweeps.  The
+#: deeper passes (trace_lint / concurrency_lint) take the repo root
+#: and restrict themselves to the package dirs they understand.
+SUITE_PATHS = analysis_gate.DEFAULT_PATHS
+
+#: metric-class constructors whose first argument is the family name
+_METRIC_CLASSES = ("Counter", "Gauge", "LabeledGauge", "Histogram")
+
+#: documentation surfaces a metric family must appear in (either)
+_DASHBOARD_DOCS = (
+    os.path.join("monitoring", "antidote-tpu-dashboard.json"),
+    os.path.join("monitoring", "README.md"),
+)
+
+
+def _gate(root: str) -> List[str]:
+    from pathlib import Path
+    return [f"{path}:{line}: [{code}] {msg}"
+            for path, line, code, msg
+            in analysis_gate.run(SUITE_PATHS, root=Path(root))]
+
+
+def lint_stats_dashboard(root: str) -> List[str]:
+    """Every metric family name registered in antidote_tpu/stats.py
+    must appear in the packaged Grafana dashboard or the monitoring
+    README — a registered-but-undocumented family is invisible
+    exactly when someone needs it (PR 5-9 hand-kept this mapping)."""
+    stats_py = os.path.join(root, "antidote_tpu", "stats.py")
+    if not os.path.exists(stats_py):
+        return [f"antidote_tpu/stats.py: [stats-dashboard] missing — "
+                "the metrics registry moved?"]
+    with open(stats_py) as f:
+        tree = ast.parse(f.read(), filename=stats_py)
+    families: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and getattr(node.func, "id", None) in _METRIC_CLASSES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            families.append((node.args[0].value, node.lineno))
+    corpus = ""
+    missing_docs = []
+    for rel in _DASHBOARD_DOCS:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            with open(path) as f:
+                corpus += f.read()
+        else:
+            missing_docs.append(rel)
+    if not corpus:
+        return [f"{' / '.join(missing_docs)}: [stats-dashboard] no "
+                "dashboard docs found — the monitoring/ surface moved?"]
+    problems = []
+    for name, lineno in sorted(families):
+        if name not in corpus:
+            problems.append(
+                f"antidote_tpu/stats.py:{lineno}: [stats-dashboard] "
+                f"metric family {name!r} is registered but appears in "
+                f"neither {' nor '.join(_DASHBOARD_DOCS)} — add a "
+                "panel or document it in the README")
+    return problems
+
+
+#: (name, lint) — every pass the suite runs; the tier-1 gate iterates
+#: THIS list, so appending here is all a new analyzer needs for CI
+PASSES: Tuple[Tuple[str, Callable[[str], List[str]]], ...] = (
+    ("analysis_gate", _gate),
+    ("trace_lint", trace_lint.lint),
+    ("concurrency_lint", concurrency_lint.lint),
+    ("stats-dashboard", lint_stats_dashboard),
+)
+
+
+def run(root: str | None = None) -> List[str]:
+    """Every pass's findings, prefixed with the pass name."""
+    root = root or repo_root()
+    problems: List[str] = []
+    for name, fn in PASSES:
+        problems.extend(f"{name}: {p}" for p in fn(root))
+    return problems
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else repo_root()
+    problems = run(root)
+    if problems:
+        print(f"static_suite: {len(problems)} finding(s) across "
+              f"{len(PASSES)} passes:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(f"static_suite: OK — {len(PASSES)} passes clean "
+          f"({', '.join(n for n, _ in PASSES)})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
