@@ -9,6 +9,37 @@ rebuild's node dimension: a :class:`NodeServer` per OS process, a ring
 mapping partitions to nodes, cross-node partition RPC over the node
 fabric, and a two-level stable-time plane (per-node tracker fold +
 cross-node summary gossip).
+
+Transport selection (ISSUE 12): ``Config.fabric_native`` routes BOTH
+hot-path transports — the intra-cluster node fabric
+(:func:`~antidote_tpu.cluster.node.build_link`) and the inter-DC
+publish fan-out (``interdc.tcp.transport_from_config``) — through one
+knob, the ``*_from_config`` factory being the ONE construction path
+(concurrency_lint's [knob-routing] rule pins every call site):
+
+====================  =========================  =========================
+``fabric_native``     node fabric (intra-DC)     publish fan-out (inter-DC)
+====================  =========================  =========================
+``"auto"`` (default)  ``NativeNodeLink`` when    C++ hub when built, else
+                      the C++ endpoint built,    the staged zero-copy
+                      else Python ``NodeLink``   Python fan-out (one
+                      (warning logged)           framing, shared views)
+``True``              ``NativeNodeLink``;        C++ hub; ``register``
+                      ``RuntimeError`` without   raises without a
+                      a compiler                 compiler
+``False``             Python ``NodeLink``,       legacy per-subscriber
+                      bit-for-bit the legacy     framing, bit-for-bit —
+                      path                       the bench baseline
+====================  =========================  =========================
+
+With no compiler, ``"auto"`` degrades to pure Python everywhere and
+everything still works — the native planes are a latency
+optimization, never a correctness dependency.  The two wire framings
+do not interoperate, so every member of one cluster must resolve to
+the same plane (``create_dc_cluster`` refuses a mixed fabric);
+Python-NodeLink and native-NodeLink peers still answer
+byte-identically (tests/cluster/test_fabric_interop.py), so a
+whole-cluster flip of the knob is invisible above the transport.
 """
 
 from antidote_tpu.cluster.link import NodeLink  # noqa: F401

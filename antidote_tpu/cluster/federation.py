@@ -404,6 +404,16 @@ class NodeInterDc:
                     bins = self.srv.link.request(
                         owner, "idc_log_read",
                         (partition, first, last))
+                    if idc_query.is_below_floor(bins):
+                        # the owner reclaimed the range: relay the
+                        # explicit marker so the requester escalates
+                        # to the checkpoint bootstrap instead of
+                        # reading a decode crash as a dead peer
+                        tracer.instant("interdc_repair_relay",
+                                       "interdc", partition=partition,
+                                       first=first, last=last,
+                                       below_floor=True)
+                        return bins
                     tracer.instant("interdc_repair_relay", "interdc",
                                    partition=partition, first=first,
                                    last=last, frames=len(bins))

@@ -184,7 +184,8 @@ class NodeLink:
         srv.bind((self.host, self._port))
         srv.listen(64)
         self._srv = srv
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="antidote-nl-accept").start()
         return srv.getsockname()[:2]
 
     def local_addr(self) -> Tuple[str, int]:
@@ -201,7 +202,8 @@ class NodeLink:
             with self._lock:
                 self._accepted.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name="antidote-nl-serve").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         with conn:

@@ -22,6 +22,19 @@ with it: termcodec payloads ``(origin, rid, kind, payload)``, typed
 error replies, and the server-side AtMostOnceCache keyed by (origin,
 rid) — a retry after a transport error re-sends the SAME rid so
 non-idempotent RPCs stay exactly-once.
+
+ISSUE 12 adds the NATIVE ANSWER PLANE: after a worker answers a
+read-only RPC the ``answer_policy`` marks cacheable (deterministic at
+the served state — an explicit-clock snapshot read, a gap-repair
+range fully below the commit watermark, a handoff byte-read), the
+reply bytes are PUBLISHED to the C++ endpoint keyed by the request's
+(origin, kind, payload) bytes; an identical repeat — a retry, a
+repair storm, a puller's re-fetch — is then answered by the event
+thread with the GIL never taken.  Answers are byte-identical to the
+Python handler's by construction (the published bytes ARE its reply),
+and ``invalidate_answers`` clears the table wholesale whenever served
+state moves under it (log truncation, ring/ownership changes — wired
+by cluster/node.py).
 """
 
 from __future__ import annotations
@@ -32,6 +45,7 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from antidote_tpu import stats
 from antidote_tpu.interdc import termcodec
 from antidote_tpu.interdc.transport import LinkDown
 from antidote_tpu.cluster.link import (
@@ -140,6 +154,25 @@ class _Lib:
         self.nl_free = quick.nl_free
         self.nl_free.restype = None
         self.nl_free.argtypes = [ctypes.c_void_p]
+        # the published-answer plane (ISSUE 12): all bookkeeping-only
+        # (map insert / clear / counter reads under the endpoint
+        # mutex, whose holders never block) — quick class
+        self.nl_publish = quick.nl_publish
+        self.nl_publish.restype = None
+        self.nl_publish.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_long, ctypes.c_char_p,
+                                    ctypes.c_long, ctypes.c_ulonglong]
+        self.nl_publish_clear = quick.nl_publish_clear
+        self.nl_publish_clear.restype = None
+        self.nl_publish_clear.argtypes = [ctypes.c_void_p]
+        self.nl_pub_gen = quick.nl_pub_gen
+        self.nl_pub_gen.restype = ctypes.c_ulonglong
+        self.nl_pub_gen.argtypes = [ctypes.c_void_p]
+        self.nl_counters = quick.nl_counters
+        self.nl_counters.restype = ctypes.c_int
+        self.nl_counters.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.c_int]
 
 
 def native_available() -> bool:
@@ -185,6 +218,13 @@ class NativeNodeLink:
         self._batch_max = batch_max
         self._workers: List[threading.Thread] = []
         self._handler: Optional[Callable[[Any, str, Any], Any]] = None
+        #: native answer plane (ISSUE 12): ``answer_policy(kind,
+        #: payload) -> bool`` marks a successfully-answered read-only
+        #: RPC publishable — its reply bytes install in the C++
+        #: endpoint's table and identical repeats are answered on the
+        #: event thread without the GIL.  None = nothing publishes
+        #: (the plane stays cold; every request takes the worker path)
+        self.answer_policy: Optional[Callable[[str, Any], bool]] = None
         self._amo = AtMostOnceCache(request_timeout=request_timeout)
         self._lock = threading.Lock()
         self._peer_idx: Dict[Any, int] = {}
@@ -208,8 +248,9 @@ class NativeNodeLink:
     def serve(self, handler: Callable[[Any, str, Any], Any]
               ) -> Tuple[str, int]:
         self._handler = handler
-        for _ in range(self._n_workers):
-            t = threading.Thread(target=self._worker, daemon=True)
+        for i in range(self._n_workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"antidote-nl-worker-{i}")
             t.start()
             self._workers.append(t)
         return self.local_addr()
@@ -241,13 +282,43 @@ class NativeNodeLink:
             while pos < n:
                 conn_token = int.from_bytes(raw[pos:pos + 8], "big")
                 corr = int.from_bytes(raw[pos + 8:pos + 16], "big")
-                plen = int.from_bytes(raw[pos + 16:pos + 20], "big")
+                rid_s = int.from_bytes(raw[pos + 16:pos + 20], "big")
+                rid_e = int.from_bytes(raw[pos + 20:pos + 24], "big")
+                plen = int.from_bytes(raw[pos + 24:pos + 28], "big")
+                frame = raw[pos + 28:pos + 28 + plen]
                 kind = "?"
+                ok = False
+                publishable = False
+                gen = 0
+                policy = self.answer_policy
                 try:
-                    origin, rid, kind, payload = termcodec.decode(
-                        raw[pos + 20:pos + 20 + plen])
+                    origin, rid, kind, payload = termcodec.decode(frame)
+                    if policy is not None and rid_s > 0:
+                        # publishability decided BEFORE the handler
+                        # (conservative: the watermark checks only
+                        # grow) — and the invalidation generation
+                        # captured with it, so a clear racing the
+                        # handler makes nl_publish drop this answer
+                        # instead of resurrecting it into the fresh
+                        # table
+                        gen = self._lib.nl_pub_gen(self._h)
+                        try:
+                            publishable = bool(policy(kind, payload))
+                        except Exception:  # noqa: BLE001 — the policy
+                            # must never fail a request
+                            log.exception("answer policy failed (%s)",
+                                          kind)
                     reply = self._amo.answer(origin, rid, kind, payload,
                                              self._handler)
+                    ok = True
+                    if publishable:
+                        # the GIL-entry counter per served read: a
+                        # request the native table COULD have answered
+                        # but that entered the interpreter instead
+                        # (native/py is the answer plane's true hit
+                        # ratio); counted only on a SERVED answer — a
+                        # handler that raised answered nothing
+                        stats.registry.fabric_py_answers.inc(kind=kind)
                 except Exception as e:  # noqa: BLE001 — must answer
                     if _err_kind(e) == "generic":
                         log.exception("node RPC handler failed (%s)",
@@ -261,7 +332,51 @@ class NativeNodeLink:
                 # microsecond C call that costs this timeslice nothing.
                 self._lib.nl_reply(self._h, conn_token, corr, reply,
                                    len(reply))
-                pos += 20 + plen
+                if ok and publishable:
+                    # the request key is the frame with the rid
+                    # spliced out (the C++ lookup splices
+                    # identically); the published bytes ARE this
+                    # reply — a native answer is byte-identical to
+                    # the Python handler's
+                    key = frame[:rid_s] + frame[rid_e:]
+                    self._lib.nl_publish(self._h, key, len(key),
+                                         reply, len(reply), gen)
+                pos += 28 + plen
+
+    # ----------------------------------------------------- answer plane
+
+    def invalidate_answers(self) -> None:
+        """Drop every published answer — the wholesale invalidation
+        for any state change that could make one stale (log
+        truncation, ring/ownership moves).  Coarse on purpose: these
+        events are rare, re-publication is one Python round per key,
+        and a finer-grained map would have to prove which keys a
+        truncation touched.  A no-op on a closed endpoint (truncation
+        hooks can fire during teardown)."""
+        try:
+            self._track()
+        except LinkDown:
+            return
+        try:
+            self._lib.nl_publish_clear(self._h)
+        finally:
+            self._untrack()
+
+    def fabric_counters(self) -> dict:
+        """{native_answered, published, inq_depth} from the endpoint —
+        the native-answer economy's observable face (stats.py FABRIC_*
+        gauges and /debug/pipeline pull from here)."""
+        out = (ctypes.c_ulonglong * 3)()
+        try:
+            self._track()
+        except LinkDown:
+            return {}
+        try:
+            n = self._lib.nl_counters(self._h, out, 3)
+        finally:
+            self._untrack()
+        keys = ("native_answered", "published", "inq_depth")
+        return {k: int(out[i]) for i, k in enumerate(keys[:n])}
 
     # ------------------------------------------------------------- client
 

@@ -59,20 +59,36 @@ _HANDOFF_PARKED = frozenset({
 
 def build_link(node_id, host: str = "127.0.0.1", port: int = 0,
                config: Optional[Config] = None):
-    """The DC's node-fabric endpoint: the native IO plane when built
-    (C++ event loop, GIL-free waits, pipelined requests —
-    cluster/nativelink.py), else the pure-Python NodeLink.  Both speak
-    the same termcodec payloads over different wire framings, so every
-    member of one cluster must pick the same plane — which they do, by
+    """The DC's node-fabric endpoint, routed by ``Config.fabric_native``
+    (the ONE construction path — the gate_from_config discipline):
+    "auto" picks the native IO plane when built (C++ event loop,
+    GIL-free waits, pipelined requests, the published-answer plane —
+    cluster/nativelink.py) and falls back to the pure-Python NodeLink;
+    True requires native and fails loudly without a compiler; False
+    keeps the exact legacy NodeLink path.  Both speak the same
+    termcodec payloads over different wire framings, so every member
+    of one cluster must pick the same plane — which they do, by
     sharing the Config default and the same build environment."""
     cfg = config or Config()
-    if cfg.node_fabric == "native":
+    if cfg.fabric_native not in ("auto", True, False):
+        # fail loudly: treating an unknown value as "auto" would route
+        # e.g. fabric_native="python" (a plausible guess at the legacy
+        # knob) to the NATIVE plane — the opposite of the request
+        raise ValueError(
+            f"Config.fabric_native must be 'auto', True, or False "
+            f"(got {cfg.fabric_native!r})")
+    if cfg.fabric_native is not False:
         from antidote_tpu.cluster import nativelink
 
         if nativelink.native_available():
             return nativelink.NativeNodeLink(
                 node_id, host=host, port=port,
                 workers=cfg.fabric_workers)
+        if cfg.fabric_native is True:
+            raise RuntimeError(
+                "Config.fabric_native=True but the native node fabric "
+                "is unavailable (no C++ toolchain); install g++ or "
+                "set fabric_native to 'auto'/False")
         log.warning("native node fabric unavailable; falling back to "
                     "the Python NodeLink")
     return NodeLink(node_id, host=host, port=port)
@@ -388,7 +404,7 @@ class NodeServer:
                 f"fabric mismatch: plan requires {fabric!r} but "
                 f"{self.node_id!r} runs {self.fabric_kind()!r} (native "
                 "fabric unavailable here? fix the build or set "
-                "Config.node_fabric='python' cluster-wide)")
+                "Config.fabric_native=False cluster-wide)")
         owners = set(ring.values())
         if not owners <= set(members):
             raise ValueError(
@@ -421,11 +437,79 @@ class NodeServer:
             prev_stable=VC(last) if last else None)
         node.wait_hook = self._wait_hook
         self.api = AntidoteTPU(node=node)
+        self._refresh_fabric_plane()
         self._gossip = threading.Thread(target=self._gossip_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="antidote-nl-gossip")
         self._gossip.start()
         self._assembled.set()
         self.meta.mark_started()
+
+    # ------------------------------------------------ native answer plane
+
+    def _refresh_fabric_plane(self) -> None:
+        """(Re)arm the native answer plane (ISSUE 12) over the CURRENT
+        ring slice: drop every published answer (ownership or log
+        layout may have moved under them) and re-wire the truncation
+        hooks so a checkpoint truncation — the one event that can
+        change bytes a published idc_log_read / handoff_fetch answer
+        was cut from — clears the table.  A no-op on the Python
+        NodeLink (no native endpoint to publish into)."""
+        link = self.link
+        if not hasattr(link, "invalidate_answers"):
+            return
+        link.invalidate_answers()
+        link.answer_policy = self._fabric_answer_policy
+        if self.node is not None:
+            for pm in self.node._local_partitions():
+                pm.log.on_truncate = self._invalidate_fabric_answers
+
+    def _invalidate_fabric_answers(self) -> None:
+        if hasattr(self.link, "invalidate_answers"):
+            self.link.invalidate_answers()
+
+    def _fabric_answer_policy(self, kind: str, payload) -> bool:
+        """Which successfully-answered node RPCs may be published for
+        GIL-free native repeats.  The bar is DETERMINISM AT THE SERVED
+        STATE: the answer must stay byte-valid until an invalidation
+        event (_refresh_fabric_plane / the truncation hook) clears it.
+
+        - ``snap_read`` at an EXPLICIT clock: Clock-SI fixes the value
+          set at a covered clock forever (later commits stamp higher);
+          a clockless read serves the moving stable snapshot — never
+          published.
+        - ``idc_log_read`` whose range is fully below this DC's commit
+          watermark: the log is append-only and new commits mint
+          HIGHER opids, so a fully-past range's answer is immutable —
+          until truncation reclaims it, which clears the table.
+        - ``handoff_fetch``: log bytes at an offset are immutable
+          modulo truncation (cleared); a stale ``end`` only makes the
+          puller stage less before the cutover's tail push — safe.
+        - ``ring`` / ``check_up``: constant between ring changes,
+          which re-arm the plane.
+        """
+        try:
+            if kind == "check_up":
+                return True
+            if kind == "ring":
+                return self.node is not None
+            if kind == "snap_read":
+                return payload[1] is not None
+            if self.node is None:
+                return False
+            if kind == "idc_log_read":
+                p, _first, last = payload
+                pm = self.node.partitions[int(p)]
+                return (isinstance(pm, PartitionManager)
+                        and pm.log.enabled
+                        and int(last) <= pm.log.op_counters.get(
+                            self.node.dc_id, 0))
+            if kind == "handoff_fetch":
+                pm = self.node.partitions[int(payload[0])]
+                return isinstance(pm, PartitionManager)
+        except (TypeError, ValueError, IndexError, KeyError):
+            return False
+        return False
 
     def _install_stable_plane(self, prev_stable: Optional[VC] = None
                               ) -> None:
@@ -513,15 +597,54 @@ class NodeServer:
             return
         summary = self.plane.local_summary()
         now = time.monotonic()
-        for peer in self.link.peers():
-            if self._peer_backoff.get(peer, 0) > now:
-                continue
+        peers = [p for p in self.link.peers()
+                 if self._peer_backoff.get(p, 0) <= now]
+        if hasattr(self.link, "request_many"):
+            # pipelined broadcast (ISSUE 12): every peer's gossip
+            # frame rides the native endpoint concurrently and the
+            # round collects in ONE GIL-free wait — a slow peer costs
+            # its own timeout, not a serial convoy ahead of the rest
             try:
-                self.link.request(peer, "gossip",
-                                  (self.node_id, summary))
-                self._peer_backoff.pop(peer, None)
-            except Exception:  # noqa: BLE001 — down peer
-                self._peer_backoff[peer] = now + 2.0
+                results = self.link.request_many(
+                    [(p, "gossip", (self.node_id, summary))
+                     for p in peers])
+            except Exception:  # noqa: BLE001 — closing endpoint
+                return
+            for peer, (ok, _val) in zip(peers, results):
+                if ok:
+                    self._peer_backoff.pop(peer, None)
+                else:
+                    self._peer_backoff[peer] = now + 2.0
+        else:
+            for peer in peers:
+                try:
+                    self.link.request(peer, "gossip",
+                                      (self.node_id, summary))
+                    self._peer_backoff.pop(peer, None)
+                except Exception:  # noqa: BLE001 — down peer
+                    self._peer_backoff[peer] = now + 2.0
+        self._refresh_fabric_gauges()
+
+    def _refresh_fabric_gauges(self, counters=None) -> None:
+        """Pull the C++ endpoint's answer-plane counters into the
+        FABRIC_* gauges (native answers never enter Python, so nothing
+        Python-side can increment a Counter for them); rides the
+        gossip cadence, plus every /debug/pipeline read — which passes
+        its already-pulled dict so one snapshot feeds both the section
+        and the gauges (one ctypes crossing, no disagreement)."""
+        if counters is None:
+            pull = getattr(self.link, "fabric_counters", None)
+            if pull is None:
+                return
+            counters = pull()
+        from antidote_tpu import stats
+
+        c = counters
+        if "native_answered" in c:
+            stats.registry.fabric_native_answered.set(
+                c["native_answered"])
+        if "published" in c:
+            stats.registry.fabric_published.set(c["published"])
 
     # ----------------------------------------------------------- RPC server
 
@@ -618,17 +741,40 @@ class NodeServer:
             # intra-DC forward of a federated gap-repair query: a
             # remote DC with a pre-handoff descriptor asked the wrong
             # member; the partition's CURRENT owner answers from its
-            # log (see federation._handle_query)
+            # log (see federation._handle_query).  Fully-past ranges
+            # are publishable for native repeats (the answer plane's
+            # gap-repair leg — O(range) preads off the PR-8 index,
+            # repeats served without the GIL).
             from antidote_tpu.interdc import query as idc_query
 
             p, first, last = payload
             pm = self.node.partitions[int(p)]
             if not isinstance(pm, PartitionManager):
                 raise RemoteCallError(f"partition {p} not local")
-            txns = pm.scan_log(
+            ans = pm.scan_log(
                 lambda lg: idc_query.answer_log_read(
                     lg, self.node.dc_id, int(p), first, last))
-            return [t.to_bin() for t in txns]
+            if idc_query.is_below_floor(ans):
+                # the explicit reclaimed-range marker must survive the
+                # relay verbatim — a crash here would turn a loud
+                # BELOW_FLOOR into a generic repair failure and hide
+                # the checkpoint-bootstrap escalation from the peer
+                return ans
+            return [t.to_bin() for t in ans]
+        if kind == "snap_read":
+            # one-shot causal read at a clock over the node fabric —
+            # the intra-cluster SNAPSHOT_READ (interdc/query.py) leg:
+            # any member answers (non-owned slices route over the
+            # fabric inside the read), and explicit-clock answers are
+            # publishable — a repeat (probe rounds, a retried client)
+            # is served by the C++ event thread with the GIL never
+            # taken
+            self._require_serving()
+            from antidote_tpu.interdc import query as idc_query
+
+            objects, clock = payload
+            return idc_query.answer_snapshot_read(
+                self.api, objects, clock)
         if kind == "handoff_fetch":
             p, offset, max_bytes = payload
             pm = self.node.partitions[p]
@@ -914,6 +1060,7 @@ class NodeServer:
             prev = self.plane.get_stable_snapshot() if self.plane \
                 else None
             self._install_stable_plane(prev_stable=prev)
+            self._refresh_fabric_plane()
             if self.on_ring_change is not None:
                 self.on_ring_change()
             self.meta.put("cluster_plan",
@@ -1091,6 +1238,7 @@ class NodeServer:
             self.link, new_owner, p)
         self._install_stable_plane(
             prev_stable=self.plane.get_stable_snapshot())
+        self._refresh_fabric_plane()
         if self.on_ring_change is not None:
             self.on_ring_change()
         if pm is not None:
@@ -1205,6 +1353,7 @@ class NodeServer:
                 out.pop(p)
             self.meta.put("handoff_out", out)
         self._install_stable_plane(prev_stable=prev)
+        self._refresh_fabric_plane()
         if self.on_ring_change is not None:
             self.on_ring_change()
         self.meta.put("cluster_plan",
@@ -1609,6 +1758,7 @@ class NodeServer:
         self._install_stable_plane(
             prev_stable=self.plane.get_stable_snapshot()
             if self.plane else None)
+        self._refresh_fabric_plane()
         if self.on_ring_change is not None:
             self.on_ring_change()
         return "committed"
@@ -1682,7 +1832,7 @@ def create_dc_cluster(dc_id, n_partitions: int,
     if len(kinds) > 1:
         raise RuntimeError(
             f"members run different fabrics {sorted(kinds)!r}; the "
-        "framings do not interoperate — align Config.node_fabric")
+        "framings do not interoperate — align Config.fabric_native")
     ring = plan_ring(n_partitions, [s.node_id for s in servers])
     client_ids = [c.node_id for c in clients]
     for s in list(servers) + list(clients):

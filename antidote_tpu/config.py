@@ -120,11 +120,18 @@ class Config:
     #: follows heartbeat_s, so existing single-knob tunings keep
     #: working; set explicitly to decouple.
     cluster_gossip_s: float | None = None
-    #: intra-DC node fabric IO plane: "native" = C++ event loop with
-    #: GIL-free waits + pipelined requests (cluster/nativelink.py),
-    #: falling back to the pure-Python NodeLink when no compiler is
-    #: available; "python" forces the fallback
-    node_fabric: str = "native"
+    #: native fabric routing (ISSUE 12) — ONE knob for both fabrics:
+    #: the intra-DC node link (cluster/nativelink.py: C++ event loop,
+    #: GIL-free waits, pipelined requests, the published-answer plane)
+    #: and the inter-DC publish fan-out (interdc/tcp.py: native hub /
+    #: staged zero-copy Python fan-out).  "auto" (default) uses the
+    #: native planes when the C++ toolchain builds them and falls back
+    #: to Python otherwise; True REQUIRES them (boot fails loudly
+    #: without a compiler); False routes every call site through the
+    #: exact legacy Python paths — NodeLink and the per-subscriber
+    #: framed TcpTransport fan-out, bit-for-bit — as the benches'
+    #: comparison baseline (like log_group / read_serve / interdc_ship)
+    fabric_native: bool | str = "auto"
     #: worker threads answering node RPCs on the native fabric (the
     #: reference's per-vnode read-server pool is 20,
     #: include/antidote.hrl:28)

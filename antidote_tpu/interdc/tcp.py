@@ -23,6 +23,21 @@ or hosts* form a cluster:
 Everything on both channels is the safe tagged term codec
 (antidote_tpu/interdc/termcodec.py) — never pickle: peers are other
 administrative domains.
+
+ISSUE 12 — zero-copy fan-out: a published frame is STAGED once
+(header + payload framed a single time) and every subscriber's send
+worker writes views of that one staging buffer; the per-subscriber
+header re-framing the pre-ISSUE-12 Python mode paid (one fresh bytes
+object per subscriber per frame) survives only behind
+``Config.fabric_native=False`` as the bench baseline, counted by the
+``antidote_fabric_pub_subscriber_copies_total`` family the config12
+bench gates on.  The native hub already stages once in C++ and shares
+the frame by refcount across subscriber queues; its bindings are now
+split by GIL policy like cluster/nativelink.py's (quick bookkeeping
+via PyDLL, the blocking create/publish/close class via CDLL — the
+[gil-policy] lint rule pins the table), and ``fab_publish`` runs
+OUTSIDE the transport lock behind a busy-refcount so publishers never
+convoy on it and close() cannot free the hub under a call.
 """
 
 from __future__ import annotations
@@ -84,19 +99,27 @@ class _SubSender:
     watermark gap-repairs whatever it missed.  Per-send timing still
     feeds ``antidote_ship_subscriber_send_seconds{peer}`` from the
     worker — the gauge stays accurate per send, it just no longer
-    measures a stall every OTHER peer is paying for."""
+    measures a stall every OTHER peer is paying for.
+
+    ``framed=True`` (the ISSUE-12 staged mode) means offered buffers
+    already carry their length header — ONE staging shared by every
+    subscriber, this worker writes it verbatim (zero per-subscriber
+    copies); ``framed=False`` keeps the legacy per-subscriber header
+    concat as the fabric_native=False bench baseline."""
 
     QUEUE_DEPTH = 128
 
-    def __init__(self, conn: socket.socket, label: str, on_dead):
+    def __init__(self, conn: socket.socket, label: str, on_dead,
+                 framed: bool = False):
         self.conn = conn
         self.label = label
+        self.framed = framed
         self._on_dead = on_dead
         self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(
             maxsize=self.QUEUE_DEPTH)
         self._dead = False
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name=f"pub-send-{label}")
+            target=self._run, daemon=True, name=f"antidote-sub-{label}")
         self._thread.start()
 
     def offer(self, data: bytes) -> None:
@@ -104,6 +127,13 @@ class _SubSender:
         mid-stream stall would desync or convoy the stream anyway)."""
         try:
             self._q.put_nowait(data)
+            stats.registry.pub_queue_depth.set(self._q.qsize(),
+                                               peer=self.label)
+            if self._dead:
+                # a concurrent _die (worker send failure) removed the
+                # gauge between our put and set: re-remove so a
+                # dropped subscriber can't leave a frozen series
+                stats.registry.pub_queue_depth.remove(peer=self.label)
         except queue.Full:
             log.warning("pub: dropping stalled subscriber %r "
                         "(send queue full)", self.label)
@@ -116,18 +146,26 @@ class _SubSender:
                 return
             t0 = time.perf_counter()
             try:
-                _send_frame(self.conn, data)
+                if self.framed:
+                    # staged zero-copy path: the shared buffer goes
+                    # out as-is — no per-subscriber bytes are built
+                    self.conn.sendall(data)
+                else:
+                    _send_frame(self.conn, data)
             except OSError:
                 self._die()
                 return
             stats.registry.ship_subscriber_send.set(
                 time.perf_counter() - t0, peer=self.label)
+            stats.registry.pub_queue_depth.set(self._q.qsize(),
+                                               peer=self.label)
             if self._dead:
                 # a concurrent _die (offer-side queue overflow) removed
                 # the gauge between our send and set: re-remove so a
                 # dropped subscriber can't leave a frozen series
                 stats.registry.ship_subscriber_send.remove(
                     peer=self.label)
+                stats.registry.pub_queue_depth.remove(peer=self.label)
                 return
 
     def _die(self) -> None:
@@ -139,6 +177,7 @@ class _SubSender:
         except OSError:
             pass
         stats.registry.ship_subscriber_send.remove(peer=self.label)
+        stats.registry.pub_queue_depth.remove(peer=self.label)
         self._on_dead(self)
 
     def close(self) -> None:
@@ -153,10 +192,64 @@ class _SubSender:
             pass
 
 
+class _FabLib:
+    """Dual ctypes binding of the native hub, split by GIL policy
+    exactly like cluster/nativelink.py's _Lib (the [gil-policy] lint
+    rule pins both tables):
+
+    - BLOCKING class binds via ``CDLL`` (GIL released): fab_create
+      binds a socket, fab_close joins the event thread, and
+      fab_publish / fab_sub_count / fab_queued_bytes contend the hub
+      mutex the EVENT THREAD holds across its whole per-poll
+      subscriber sweep (pump_hello/pump_send over every queued frame)
+      — a PyDLL call parked on that mutex would freeze every Python
+      thread for the sweep's duration.  None may run inside a lock
+      region.
+    - QUICK bookkeeping (fab_port — an immutable field read, no
+      mutex) binds via ``PyDLL`` (GIL held): a CDLL call re-acquires
+      the GIL on return, which against busy threads costs up to a
+      scheduler timeslice per call.
+    """
+
+    def __init__(self, path: str):
+        import ctypes
+
+        quick = ctypes.PyDLL(path)
+        slow = ctypes.CDLL(path)
+        self.fab_create = slow.fab_create
+        self.fab_create.restype = ctypes.c_void_p
+        self.fab_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        self.fab_publish = slow.fab_publish
+        self.fab_publish.restype = ctypes.c_int
+        self.fab_publish.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        self.fab_close = slow.fab_close
+        self.fab_close.restype = None
+        self.fab_close.argtypes = [ctypes.c_void_p]
+        self.fab_port = quick.fab_port
+        self.fab_port.restype = ctypes.c_int
+        self.fab_port.argtypes = [ctypes.c_void_p]
+        self.fab_sub_count = slow.fab_sub_count
+        self.fab_sub_count.restype = ctypes.c_int
+        self.fab_sub_count.argtypes = [ctypes.c_void_p]
+        self.fab_queued_bytes = slow.fab_queued_bytes
+        self.fab_queued_bytes.restype = ctypes.c_longlong
+        self.fab_queued_bytes.argtypes = [ctypes.c_void_p]
+
+
 class TcpTransport(Transport):
     """One DC's endpoint of the TCP fabric.  Construct one per DC
-    process; ``register`` binds the listeners, ``connect`` subscribes to
-    a peer discovered via descriptor exchange."""
+    process (``transport_from_config`` is the Config-routed path);
+    ``register`` binds the listeners, ``connect`` subscribes to a peer
+    discovered via descriptor exchange.
+
+    ``native_pub`` selects the publish fan-out plane: "auto" = the C++
+    hub when g++ built it, else the staged Python fan-out; True =
+    require the hub; "python" = force the staged Python fan-out (one
+    framing shared by every subscriber — tests and the config12 bench
+    pin the staged plane with it even where the hub builds); False =
+    the exact legacy Python path (per-subscriber framing), the
+    Config.fabric_native=False bench baseline."""
 
     def __init__(self, host: str = "127.0.0.1", pub_port: int = 0,
                  query_port: int = 0, connect_timeout: float = 5.0,
@@ -191,6 +284,19 @@ class TcpTransport(Transport):
         self._native_pub = native_pub
         self._hub = None
         self._hub_lib = None
+        #: publishers currently inside fab_publish — close() must not
+        #: fab_close (which frees the C++ object) under them; the call
+        #: itself runs OUTSIDE self._lock so publishers never convoy
+        #: on the transport lock (and the [gil-policy] rule holds)
+        self._hub_busy = 0
+        self._hub_cv = threading.Condition(self._lock)
+        #: last hub gauge pull (fab_sub_count/fab_queued_bytes take
+        #: the hub mutex — sampled on a cadence, not per frame)
+        self._hub_gauge_t = 0.0
+        #: staged zero-copy Python fan-out (ISSUE 12): frame once,
+        #: every subscriber sends views of the one staging buffer.
+        #: False only under the full-legacy knob — the bench baseline.
+        self._staged = native_pub is not False
 
     # ------------------------------------------------------------ registry
 
@@ -199,37 +305,27 @@ class TcpTransport(Transport):
                  ) -> "queue.Queue[bytes]":
         self._dc_id = desc.dc_id
         self._handler = query_handler
-        if self._native_pub:
+        if self._native_pub and self._native_pub != "python":
             self._hub = self._open_native_hub()
         if self._hub is None:
             if self._native_pub is True:
                 raise RuntimeError("native pub hub unavailable "
                                    "(g++ missing or build failed)")
             self._pub_srv = self._bind(self._pub_port)
-            self._spawn(self._accept_pub_loop)
+            self._spawn(self._accept_pub_loop,
+                        name="antidote-fab-pub-accept")
         self._query_srv = self._bind(self._query_port)
-        self._spawn(self._accept_query_loop)
+        self._spawn(self._accept_query_loop,
+                    name="antidote-fab-query-accept")
         return self._inbox
 
     def _open_native_hub(self):
-        import ctypes
-
         from antidote_tpu.native.build import ensure_built
 
         so = ensure_built("fabric")
         if so is None:
             return None
-        lib = ctypes.CDLL(so)
-        lib.fab_create.restype = ctypes.c_void_p
-        lib.fab_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.fab_port.restype = ctypes.c_int
-        lib.fab_port.argtypes = [ctypes.c_void_p]
-        lib.fab_publish.restype = ctypes.c_int
-        lib.fab_publish.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
-        lib.fab_sub_count.restype = ctypes.c_int
-        lib.fab_sub_count.argtypes = [ctypes.c_void_p]
-        lib.fab_close.argtypes = [ctypes.c_void_p]
+        lib = _FabLib(so)
         hub = lib.fab_create(self.host.encode(), self._pub_port)
         if not hub:
             return None
@@ -261,8 +357,13 @@ class TcpTransport(Transport):
         srv.listen(64)
         return srv
 
-    def _spawn(self, fn, *args) -> None:
-        t = threading.Thread(target=fn, args=args, daemon=True)
+    def _spawn(self, fn, *args, name: Optional[str] = None) -> None:
+        # every fabric thread carries a component name (ISSUE 12):
+        # /debug/pipeline's threads section and the causal-probe dumps
+        # attribute a blocked send to "antidote-fab-..." instead of
+        # Thread-N
+        t = threading.Thread(target=fn, args=args, daemon=True,
+                             name=name or "antidote-fab-io")
         t.start()
         self._threads.append(t)
 
@@ -294,7 +395,8 @@ class TcpTransport(Transport):
             conn.settimeout(self.connect_timeout)
             with self._lock:
                 self._subscribers.append(_SubSender(
-                    conn, str(peer), self._drop_subscriber))
+                    conn, str(peer), self._drop_subscriber,
+                    framed=self._staged))
 
     def _drop_subscriber(self, sender: "_SubSender") -> None:
         with self._lock:
@@ -303,20 +405,62 @@ class TcpTransport(Transport):
 
     def publish(self, origin, data: bytes) -> None:
         with self._lock:
-            # under the lock: close() frees the hub (fab_close deletes
-            # the C++ object), so an unlocked fab_publish could race a
-            # teardown into freed memory.  fab_publish itself never
-            # blocks (queue copy only), so the hold is short.
-            if self._hub is not None:
-                self._hub_lib.fab_publish(self._hub, data, len(data))
-                return
-            senders = list(self._subscribers)
-        for sender in senders:
-            # enqueue-only fan-out: the per-subscriber workers send in
-            # parallel, so the publisher (and every healthy peer) is
-            # never behind one slow peer's TCP window (the ROADMAP
-            # publish-stall item, closed)
-            sender.offer(data)
+            hub = self._hub
+            if hub is not None:
+                # the busy refcount (not the lock) protects the hub
+                # pointer across the call: close() waits it out before
+                # fab_close frees the C++ object, and fab_publish —
+                # a CDLL call that can contend the hub mutex against
+                # an event thread mid-send — runs OUTSIDE the
+                # transport lock so publishers never convoy on it
+                # (the [gil-policy] rule)
+                self._hub_busy += 1
+            else:
+                senders = list(self._subscribers)
+        if hub is not None:
+            try:
+                self._hub_lib.fab_publish(hub, data, len(data))
+                stats.registry.pub_frames.inc()
+                # gauge pulls contend the hub mutex against the event
+                # thread's send sweep (CDLL — GIL released), so they
+                # ride a cadence instead of every frame: two extra
+                # mutex+GIL crossings per frame would tax the hot
+                # publish path for a gauge nobody reads that often
+                now = time.monotonic()
+                if now - self._hub_gauge_t >= 0.05:
+                    self._hub_gauge_t = now
+                    stats.registry.pub_fanout.set(
+                        self._hub_lib.fab_sub_count(hub))
+                    stats.registry.hub_queued_bytes.set(
+                        self._hub_lib.fab_queued_bytes(hub))
+            finally:
+                with self._hub_cv:
+                    self._hub_busy -= 1
+                    self._hub_cv.notify_all()
+            return
+        # enqueue-only fan-out: the per-subscriber workers send in
+        # parallel, so the publisher (and every healthy peer) is
+        # never behind one slow peer's TCP window (the ROADMAP
+        # publish-stall item, closed)
+        stats.registry.pub_frames.inc()
+        if self._staged:
+            # ISSUE 12 zero-copy: header + payload framed ONCE; every
+            # subscriber's worker writes views of this one staging
+            # buffer verbatim (framed=True) — zero per-subscriber
+            # Python copies, asserted structurally by the config12
+            # bench via the copies-per-frame counter
+            staged = struct.pack(">I", len(data)) + data
+            stats.registry.pub_fanout.set(len(senders))
+            for sender in senders:
+                sender.offer(staged)
+        else:
+            for sender in senders:
+                # legacy baseline (fabric_native=False): each worker
+                # re-frames the payload — one fresh bytes object per
+                # subscriber per frame, the copy the staged path
+                # eliminates
+                stats.registry.pub_sub_copies.inc()
+                sender.offer(data)
 
     # ----------------------------------------------------- subscribe side
 
@@ -342,7 +486,8 @@ class TcpTransport(Transport):
             with self._lock:
                 self._peers.pop(desc.dc_id, None)
             raise
-        self._spawn(self._subscribe_loop, desc.dc_id)
+        self._spawn(self._subscribe_loop, desc.dc_id,
+                    name=f"antidote-fab-subscribe-{desc.dc_id}")
 
     def _subscribe_loop(self, target) -> None:
         """Dial the peer's pub listener; deliver frames to the inbox;
@@ -383,7 +528,8 @@ class TcpTransport(Transport):
                 conn, _addr = self._query_srv.accept()
             except OSError:
                 return
-            self._spawn(self._serve_query_conn, conn)
+            self._spawn(self._serve_query_conn, conn,
+                        name="antidote-fab-query-serve")
 
     def _serve_query_conn(self, conn: socket.socket) -> None:
         with conn:
@@ -446,10 +592,26 @@ class TcpTransport(Transport):
         with self._lock:
             hub, self._hub = self._hub, None
         if hub is not None:
-            # freed outside the lock (joins the event thread); no
-            # publisher can hold the pointer: they read it under the
-            # lock and call through while still holding it
-            self._hub_lib.fab_close(hub)
+            with self._hub_cv:
+                # publishers inside fab_publish pinned the hub with the
+                # busy refcount; fab_close deletes the C++ object, so
+                # wait them out (the shut publishers drain in µs — the
+                # call is a queue copy, never a send)
+                drained = self._hub_cv.wait_for(
+                    lambda: self._hub_busy == 0, timeout=5.0)
+            if drained:
+                # freed outside the lock (joins the event thread); no
+                # new publisher can reach it: they read self._hub
+                # under the lock, and it is None now
+                self._hub_lib.fab_close(hub)
+            else:
+                # a publisher is STILL inside fab_publish after the
+                # grace period (a starved thread on a loaded box):
+                # freeing the hub under its live call would be a
+                # use-after-free — leak it instead (one event thread +
+                # a few buffers, once, at shutdown)
+                log.error("pub hub close timed out with a publisher "
+                          "still in fab_publish; leaking the hub")
         for srv in (self._pub_srv, self._query_srv):
             if srv is not None:
                 try:
@@ -472,3 +634,26 @@ class TcpTransport(Transport):
                 if peer["req_sock"] is not None:
                     peer["req_sock"].close()
                     peer["req_sock"] = None
+
+
+def transport_from_config(config=None, **kwargs) -> TcpTransport:
+    """The ONE Config-routed TcpTransport construction path (the
+    gate_from_config discipline, pinned by concurrency_lint's
+    [knob-routing] rule): ``Config.fabric_native`` selects the publish
+    fan-out plane — "auto" uses the C++ hub when the toolchain built
+    it and the staged zero-copy Python fan-out otherwise; ``True``
+    requires the hub (register fails loudly without a compiler);
+    ``False`` keeps the exact legacy per-subscriber-framing Python
+    path, bit-for-bit, as the benches' comparison baseline."""
+    from antidote_tpu.config import Config
+
+    cfg = config or Config()
+    if cfg.fabric_native not in ("auto", True, False):
+        # "python" is a valid DIRECT TcpTransport mode (tests/benches
+        # pin the staged fan-out with it) but not a valid Config knob:
+        # build_link would route the same value to the NATIVE node
+        # fabric — fail loudly instead of splitting the cluster
+        raise ValueError(
+            f"Config.fabric_native must be 'auto', True, or False "
+            f"(got {cfg.fabric_native!r})")
+    return TcpTransport(native_pub=cfg.fabric_native, **kwargs)

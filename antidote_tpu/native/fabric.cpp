@@ -283,6 +283,18 @@ int fab_sub_count(void* hp) {
     return (int)h->subs.size();
 }
 
+// Total bytes queued across every live subscriber's bounded queue —
+// the backpressure face of the hub for the FABRIC_* gauges (a rising
+// value means a peer is draining slower than the stream publishes).
+long long fab_queued_bytes(void* hp) {
+    Hub* h = (Hub*)hp;
+    std::lock_guard<std::mutex> g(h->mu);
+    long long total = 0;
+    for (auto& s : h->subs)
+        if (!s->dead) total += (long long)s->queued_bytes;
+    return total;
+}
+
 void fab_close(void* hp) {
     Hub* h = (Hub*)hp;
     {

@@ -28,7 +28,27 @@
 // where length counts the payload only.  Payloads are the same
 // termcodec frames the Python NodeLink speaks; the at-most-once
 // request cache and all protocol semantics stay in Python
-// (antidote_tpu/cluster/link.py) — this file is transport only.
+// (antidote_tpu/cluster/link.py) — this file is transport only, with
+// ONE protocol-aware addition (ISSUE 12):
+//
+// - the PUBLISHED-ANSWER table: Python publishes (request key ->
+//   encoded reply) pairs for registered read-only RPCs, and the event
+//   thread answers a matching inbound request directly — the reply is
+//   queued without the interpreter ever waking, so a busy peer's GIL
+//   (the 1-4 ms scheduler-latency floor) stops taxing hot reads
+//   (SNAPSHOT_READ at a covered clock, gap-repair ranges off the PR-8
+//   index, handoff byte-reads).  The key is the request frame with the
+//   per-request rid element spliced out: termcodec encodes the 4-tuple
+//   (origin, rid, kind, payload) as concatenated element terms, and
+//   the rid (ints, never memoized) cannot shift the string/VC memo
+//   state, so origin+kind+payload bytes are a stable identity — the
+//   origin MUST stay in the key because later memo back-references can
+//   point into strings it registered.  A miss (nothing published,
+//   frontier moved, unparseable frame) falls through to the Python
+//   worker path unchanged — the universal fallback.  Published answers
+//   are deterministic reply bytes, so a retry of an rid answered
+//   natively reads the same bytes the at-most-once cache would have
+//   remembered: exactly-once semantics are preserved without it.
 //
 // C ABI for ctypes (no pybind11 in this environment).
 
@@ -123,6 +143,10 @@ struct InMsg {
     uint64_t token;
     uint64_t corr;
     Bytes payload;
+    //: byte span of the rid element within payload (0,0 = the frame
+    //: did not parse as a 4-tuple request — never publishable)
+    uint32_t rid_start = 0;
+    uint32_t rid_end = 0;
 };
 
 enum PendSt { P_WAIT = 0, P_DONE = 1, P_FAIL = 2 };
@@ -155,6 +179,22 @@ struct Ep {
     uint64_t next_token = 1;
     uint64_t next_corr = 1;
     bool stop = false;
+    //: the published-answer table (ISSUE 12): request key -> encoded
+    //: reply, consulted by the event thread before waking Python.
+    //: Bounded FIFO (pub_order) so a hot server cannot grow it
+    //: without limit; Python clears it wholesale on any state change
+    //: that could invalidate an answer (truncation, ring moves).
+    std::unordered_map<std::string, Bytes> published;
+    std::deque<std::string> pub_order;
+    size_t pub_cap = 4096;
+    uint64_t native_answered = 0;
+    //: invalidation generation: bumped by every wholesale clear, and
+    //: nl_publish only installs an answer published AT the current
+    //: generation — a worker that computed its reply before a
+    //: truncation/ring move cleared the table cannot resurrect the
+    //: stale answer afterwards (the check and the insert share the
+    //: endpoint mutex, so there is no re-publish window)
+    uint64_t pub_gen = 0;
 };
 
 void set_nonblock(int fd) {
@@ -234,7 +274,90 @@ bool pump_read(Conn* c, std::vector<Parsed>* out) {
     }
 }
 
+// Skip one termcodec term starting at `pos`; returns the offset past
+// it, or -1 when the term is malformed / an unskippable tag (batch).
+// Mirrors antidote_tpu/interdc/termcodec.py's tag table — only the
+// SPANS matter here, never the values (memo back-references are fixed
+// width), so the skipper stays correct as long as the tag set is.
+long term_skip(const uint8_t* d, long len, long pos, int depth) {
+    if (depth > 64 || pos >= len) return -1;
+    uint8_t tag = d[pos];
+    long p = pos + 1;
+    uint32_t n = 0;
+    switch (tag) {
+        case 'N': case 'T': case 'F':
+            return p;
+        case '1':                       // int8 payload
+            return p + 1 <= len ? p + 1 : -1;
+        case 'r':                       // str backref, 1 byte
+            return p + 1 <= len ? p + 1 : -1;
+        case '8': case 'f':             // int64 / double
+            return p + 8 <= len ? p + 8 : -1;
+        case 'Q': case 'v':             // str / VC backref, u32
+            return p + 4 <= len ? p + 4 : -1;
+        case 'C': case 'S':             // bytes / str, 1-byte length
+            if (p + 1 > len) return -1;
+            n = d[p];
+            p += 1;
+            return p + (long)n <= len ? p + (long)n : -1;
+        case 'i': case 'b': case 's':   // length-prefixed payloads
+            if (p + 4 > len) return -1;
+            n = rd_u32(d + p);
+            p += 4;
+            return p + (long)n <= len ? p + (long)n : -1;
+        case 'u':                       // tuple, 1-byte count
+            if (p + 1 > len) return -1;
+            n = d[p];
+            p += 1;
+            break;
+        case 't': case 'l': case 'e': case 'z': case 'd':
+        case 'V': case 'O': case 'R': case 'X':  // u32-count sequences
+            if (p + 4 > len) return -1;
+            n = rd_u32(d + p);
+            p += 4;
+            break;
+        default:                        // 'Y' batch / unknown: bail
+            return -1;
+    }
+    if ((long)n > len - p) return -1;   // each item needs >= 1 byte
+    for (uint32_t i = 0; i < n; i++) {
+        p = term_skip(d, len, p, depth + 1);
+        if (p < 0) return -1;
+    }
+    return p;
+}
+
+// Locate the rid element's span inside a request frame — the 4-tuple
+// (origin, rid, kind, payload) always encodes as tag 'u', count 4.
+// Returns false when the frame is not that shape (a hand-built or
+// hostile frame: never answered natively, never published).
+bool rid_span(const uint8_t* d, long len, uint32_t* rid_s,
+              uint32_t* rid_e) {
+    if (len < 2 || d[0] != 'u' || d[1] != 4) return false;
+    long e0 = term_skip(d, len, 2, 0);
+    if (e0 <= 0) return false;
+    long e1 = term_skip(d, len, e0, 0);
+    if (e1 <= 0 || e1 > 0xFFFFFFFFL || len > 0xFFFFFFFFL) return false;
+    *rid_s = (uint32_t)e0;
+    *rid_e = (uint32_t)e1;
+    return true;
+}
+
+// Queue a reply frame on a server conn (event thread, under ep->mu).
+void queue_reply(Conn* c, uint64_t corr, const Bytes& payload) {
+    auto frame = std::make_shared<std::vector<uint8_t>>(
+        kHdr + payload->size());
+    wr_u32(frame->data(), (uint32_t)payload->size());
+    wr_u64(frame->data() + 4, corr);
+    memcpy(frame->data() + kHdr, payload->data(), payload->size());
+    c->wq.push_back({frame, 0, 0});
+}
+
 // Deliver a readiness sweep's parsed frames under ONE brief lock.
+// Inbound requests consult the published-answer table first: a hit is
+// answered right here on the event thread (the reply lands on the
+// conn's write queue; the next poll iteration sees POLLOUT) and the
+// interpreter never wakes — the GIL-free read-serving path (ISSUE 12).
 void deliver_all(Ep* ep, std::vector<Parsed>* parsed) {
     if (parsed->empty()) return;
     bool any_in = false, any_done = false;
@@ -251,8 +374,25 @@ void deliver_all(Ep* ep, std::vector<Parsed>* parsed) {
                 }
                 // unknown corr: the waiter timed out and cancelled
             } else {
+                uint32_t rs = 0, re = 0;
+                bool keyed = rid_span(p.body->data(),
+                                      (long)p.body->size(), &rs, &re);
+                if (keyed && !ep->published.empty()) {
+                    std::string key;
+                    key.reserve(p.body->size() - (re - rs));
+                    key.append((const char*)p.body->data(), rs);
+                    key.append((const char*)p.body->data() + re,
+                               p.body->size() - re);
+                    auto hit = ep->published.find(key);
+                    if (hit != ep->published.end()) {
+                        queue_reply(p.conn, p.corr, hit->second);
+                        ep->native_answered++;
+                        continue;
+                    }
+                }
                 ep->inq.push_back(
-                    {p.conn->token, p.corr, std::move(p.body)});
+                    {p.conn->token, p.corr, std::move(p.body),
+                     keyed ? rs : 0, keyed ? re : 0});
                 any_in = true;
             }
         }
@@ -638,9 +778,12 @@ void nl_drop_peer(void* hp, int peer) {
 // whole queue inside it collapses N GIL acquisitions into one (the
 // same amortization a BEAM scheduler gets by running a vnode's mailbox
 // to empty).  Packs up to max_msgs messages, each
-// [8B conn token][8B corr][4B len][payload].  Returns bytes written,
-// 0 on timeout, -1 when the endpoint closed, or -(needed) when the
-// FIRST message alone exceeds cap (message stays queued).
+// [8B conn token][8B corr][4B rid start][4B rid end][4B len][payload]
+// — the rid span locates the per-request id inside the payload so the
+// worker can splice it out when publishing the answer (0,0 = frame did
+// not parse as a request tuple; never publishable).  Returns bytes
+// written, 0 on timeout, -1 when the endpoint closed, or -(needed)
+// when the FIRST message alone exceeds cap (message stays queued).
 long nl_recv_batch(void* hp, uint8_t* out, long cap, int timeout_ms,
                    int max_msgs) {
     Ep* ep = (Ep*)hp;
@@ -654,19 +797,87 @@ long nl_recv_batch(void* hp, uint8_t* out, long cap, int timeout_ms,
     int n = 0;
     while (!ep->inq.empty() && n < max_msgs) {
         InMsg& m = ep->inq.front();
-        long need = 20 + (long)m.payload->size();
+        long need = 28 + (long)m.payload->size();
         if (written + need > cap)
             return written > 0 ? written : -need;
         wr_u64(out + written, m.token);
         wr_u64(out + written + 8, m.corr);
-        wr_u32(out + written + 16, (uint32_t)m.payload->size());
-        memcpy(out + written + 20, m.payload->data(),
+        wr_u32(out + written + 16, m.rid_start);
+        wr_u32(out + written + 20, m.rid_end);
+        wr_u32(out + written + 24, (uint32_t)m.payload->size());
+        memcpy(out + written + 28, m.payload->data(),
                m.payload->size());
         written += need;
         n++;
         ep->inq.pop_front();
     }
     return written;
+}
+
+// Publish one (request key -> reply payload) pair for the event
+// thread to answer without Python (see the file header).  Replaces an
+// existing entry; the table is a bounded FIFO — past the cap the
+// oldest published key is evicted (its requests fall back to the
+// Python path, which may re-publish).  Never blocks.  `gen` is the
+// invalidation generation the publisher read (nl_pub_gen) BEFORE
+// computing the answer: a clear that raced the handler bumped it, and
+// the stale answer is silently dropped here instead of resurrecting
+// into the freshly-cleared table.
+void nl_publish(void* hp, const uint8_t* key, long klen,
+                const uint8_t* reply, long rlen,
+                unsigned long long gen) {
+    Ep* ep = (Ep*)hp;
+    if (klen <= 0 || rlen < 0 || (size_t)rlen > kMaxFrame) return;
+    auto data = std::make_shared<std::vector<uint8_t>>(reply,
+                                                       reply + rlen);
+    std::string k((const char*)key, (size_t)klen);
+    std::lock_guard<std::mutex> g(ep->mu);
+    if (ep->stop || gen != ep->pub_gen) return;
+    auto it = ep->published.find(k);
+    if (it == ep->published.end()) {
+        ep->pub_order.push_back(k);
+        ep->published.emplace(std::move(k), std::move(data));
+        while (ep->published.size() > ep->pub_cap &&
+               !ep->pub_order.empty()) {
+            ep->published.erase(ep->pub_order.front());
+            ep->pub_order.pop_front();
+        }
+    } else {
+        it->second = std::move(data);
+    }
+}
+
+// Drop every published answer (the wholesale invalidation Python
+// calls on truncation / ring moves / ownership changes) and bump the
+// generation so in-flight answers computed against the old state
+// cannot publish after the clear.
+void nl_publish_clear(void* hp) {
+    Ep* ep = (Ep*)hp;
+    std::lock_guard<std::mutex> g(ep->mu);
+    ep->published.clear();
+    ep->pub_order.clear();
+    ep->pub_gen++;
+}
+
+// The current invalidation generation — read by the worker BEFORE it
+// runs a handler whose answer it may publish (see nl_publish).
+unsigned long long nl_pub_gen(void* hp) {
+    Ep* ep = (Ep*)hp;
+    std::lock_guard<std::mutex> g(ep->mu);
+    return ep->pub_gen;
+}
+
+// Endpoint counters: out[0] = requests answered natively (no GIL),
+// out[1] = live published entries, out[2] = inbound queue depth.
+// Returns the number of slots filled.
+int nl_counters(void* hp, unsigned long long* out, int n) {
+    Ep* ep = (Ep*)hp;
+    std::lock_guard<std::mutex> g(ep->mu);
+    int filled = 0;
+    if (n > 0) { out[0] = ep->native_answered; filled = 1; }
+    if (n > 1) { out[1] = ep->published.size(); filled = 2; }
+    if (n > 2) { out[2] = ep->inq.size(); filled = 3; }
+    return filled;
 }
 
 // Wait until EVERY listed corr is terminal (or timeout), then pack all

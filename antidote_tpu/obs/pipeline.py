@@ -24,7 +24,14 @@ aggregates, in one JSON document per registered DataCenter:
   retention view (ISSUE 10): on-disk file size, retained vs truncated
   logical bytes, and the newest checkpoint's age/keys/cut
   (oplog/partition.py log_stats, which also refreshes the
-  LOG_*/CKPT_* growth gauges).
+  LOG_*/CKPT_* growth gauges);
+- **fabric**: the node fabric's answer-plane economy (ISSUE 12) —
+  transport kind, native-answered RPC count (the GIL never taken),
+  live published answers, inbound queue depth (cluster/nativelink.py
+  fabric_counters; refreshes the FABRIC_* gauges on every read);
+- **threads** (top level): component-named live threads
+  (``antidote-fab-*`` / ``antidote-sub-*`` / ``antidote-nl-*``), so a
+  stall dump names the blocked component instead of ``Thread-N``.
 
 Served at ``GET /debug/pipeline`` by the metrics server (stats.py),
 embedded in causal-probe violation dumps (obs/probe.py), and attached
@@ -147,6 +154,44 @@ def _log_section(dc) -> Dict[str, Any]:
     return out
 
 
+def _fabric_section(dc) -> Dict[str, Any]:
+    """The node fabric's answer-plane economy (ISSUE 12): which
+    transport the member runs, and — on the native plane — how many
+    RPCs the C++ event threads answered from the published-answer
+    table without ever taking the GIL, how many answers are live, and
+    the inbound queue depth.  Empty for a DataCenter with no node
+    fabric (single-process ring)."""
+    srv = getattr(dc, "srv", None)
+    link = getattr(srv, "link", None)
+    if link is None:
+        return {}
+    out: Dict[str, Any] = {"kind": srv.fabric_kind()}
+    counters = getattr(link, "fabric_counters", None)
+    if counters is not None:
+        c = counters()
+        out.update(c)
+        # the FABRIC_* gauges refresh on every pipeline read as well
+        # as the gossip cadence (native answers never enter Python, so
+        # only a pull can observe them); the one pulled snapshot
+        # feeds both the section and the gauges
+        srv._refresh_fabric_gauges(c)
+    return out
+
+
+def _threads_section() -> Dict[str, int]:
+    """Component-named live threads (ISSUE 12): every transport /
+    fabric / sub-sender thread carries an ``antidote-*`` name
+    (``antidote-fab-*``, ``antidote-sub-*``, ``antidote-nl-*``), so
+    stall forensics and the causal-probe dumps attribute a blocked
+    send to a component instead of ``Thread-N``.  Name -> live count
+    (worker pools index their name stem)."""
+    out: Dict[str, int] = {}
+    for t in threading.enumerate():
+        if t.name.startswith("antidote-"):
+            out[t.name] = out.get(t.name, 0) + 1
+    return dict(sorted(out.items()))
+
+
 def _stable_section(dc) -> Dict[str, Any]:
     stable = getattr(dc, "stable", None)
     if stable is None:
@@ -171,6 +216,7 @@ def dc_snapshot(dc) -> Dict[str, Any]:
         "ingest": _section(lambda: _ingest_section(dc)),
         "log": _section(lambda: _log_section(dc)),
         "stable": _section(lambda: _stable_section(dc)),
+        "fabric": _section(lambda: _fabric_section(dc)),
         "connected_dcs": _section(
             lambda: [str(d) for d in getattr(dc, "connected_dcs", [])]),
     }
@@ -189,7 +235,8 @@ def snapshot() -> Dict[str, Any]:
         except Exception:  # noqa: BLE001 — half-closed DC
             continue
         dcs[name] = dc_snapshot(dc)
-    return {"at_us": time.time_ns() // 1000, "dcs": dcs}
+    return {"at_us": time.time_ns() // 1000, "dcs": dcs,
+            "threads": _section(_threads_section)}
 
 
 def snapshot_json() -> str:

@@ -170,6 +170,13 @@ class PartitionLog:
         #: this partition's own origin-DC id (set by the owning
         #: PartitionManager) — the stream the retention floor protects
         self.own_dc: Any = None
+        #: fired after a truncation prunes the indexes (ISSUE 12): the
+        #: node fabric clears its published-answer table here —
+        #: reclaimed bytes may back published gap-repair range answers
+        #: and handoff byte-reads, and truncation is the ONE event
+        #: that rewrites bytes under them (wired by cluster/node.py's
+        #: _refresh_fabric_plane)
+        self.on_truncate: Optional[Callable[[], None]] = None
         self._recover()
 
     # ------------------------------------------------------------- append
@@ -874,6 +881,8 @@ class PartitionLog:
         for dc, f in self._op_floor.items():
             self._op_index_floor[dc] = max(
                 self._op_index_floor.get(dc, 0), f)
+        if self.on_truncate is not None:
+            self.on_truncate()
 
     def seed_for(self, key) -> Optional[Tuple[str, Any, VC]]:
         """The checkpoint's (type_name, state, frontier VC) seed for

@@ -592,6 +592,55 @@ class Registry:
             "Per-partition recovery wall time at boot (checkpoint "
             "load + suffix replay; the recovery-time trend panel)",
             buckets=lat_buckets + (30.0, 120.0))
+        # ---- native node fabric + zero-copy publish fan-out (ISSUE
+        # 12, cluster/nativelink.py + interdc/tcp.py): the GIL-free
+        # answer plane's hit economy and the one-staging publish
+        # discipline.  fabric_native_answered / fabric_published are
+        # gauges PULLED from the C++ endpoint's counters (the native
+        # answers never enter Python, so nothing Python-side can
+        # increment a Counter for them) — refreshed by the NodeServer
+        # gossip tick and every /debug/pipeline read.
+        self.fabric_native_answered = Gauge(
+            "antidote_fabric_native_answered_total",
+            "Node RPCs answered by the C++ event thread from the "
+            "published-answer table — the GIL was never taken")
+        self.fabric_py_answers = Counter(
+            "antidote_fabric_py_answered_total",
+            "PUBLISHABLE node RPCs (the answer policy would cache "
+            "them) that entered the interpreter anyway — the "
+            "per-served-read GIL-entry counter; never-publishable "
+            "kinds (writes, gossip, 2PC) are excluded so the "
+            "native/py ratio is the answer plane's true hit rate",
+            labels=("kind",))
+        self.fabric_published = Gauge(
+            "antidote_fabric_published_answers",
+            "Live entries in the endpoint's published-answer table")
+        self.pub_frames = Counter(
+            "antidote_fabric_pub_frames_total",
+            "Inter-DC frames published through the fan-out plane — "
+            "the copies-per-frame denominator (the staged/native "
+            "paths frame each ONCE regardless of subscriber count; "
+            "the legacy path re-frames per subscriber)")
+        self.pub_sub_copies = Counter(
+            "antidote_fabric_pub_subscriber_copies_total",
+            "Python-side per-subscriber frame copies on the publish "
+            "path — zero on the staged/native paths; the legacy "
+            "fabric_native=False path pays one per subscriber (the "
+            "bench baseline, gated via fabric_pub_copies_per_frame)")
+        self.pub_fanout = Gauge(
+            "antidote_fabric_pub_fanout",
+            "Subscribers the most recent published frame was staged "
+            "to (the staged frame's refcount)")
+        self.pub_queue_depth = LabeledGauge(
+            "antidote_fabric_pub_queue_depth",
+            "Per-subscriber send-queue depth (frames) on the Python "
+            "fan-out plane; the native hub's analogue is its bounded "
+            "byte queue, exposed as fabric_hub_queued_bytes",
+            labels=("peer",))
+        self.hub_queued_bytes = Gauge(
+            "antidote_fabric_hub_queued_bytes",
+            "Bytes queued across the native publish hub's "
+            "per-subscriber bounded queues")
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -627,7 +676,11 @@ class Registry:
                 self.log_truncated_bytes, self.ckpt_writes,
                 self.ckpt_duration, self.ckpt_age, self.ckpt_keys,
                 self.ckpt_truncations, self.ckpt_bootstraps,
-                self.ckpt_recovery)
+                self.ckpt_recovery,
+                self.fabric_native_answered, self.fabric_py_answers,
+                self.fabric_published, self.pub_frames,
+                self.pub_sub_copies, self.pub_fanout,
+                self.pub_queue_depth, self.hub_queued_bytes)
 
     def exposition(self) -> str:
         lines = []

@@ -1,0 +1,314 @@
+"""Config 12: native node fabric — hot-path hop latency under a busy
+GIL, and the zero-copy publish fan-out.
+
+PRs 5-9 batched every plane, leaving the Python transport's GIL
+dependence as the floor under multi-node traffic: a busy peer's
+interpreter is needed just to read a frame off the socket, a 1-4 ms
+scheduler-latency tax per hop that the reference never pays (BEAM
+schedulers service vnode commands with no global lock).  ISSUE 12
+moved the hot paths native: the C++ endpoint's event threads answer
+published read-only RPCs without ever taking the GIL, the pipelined
+client waits GIL-free, and the publish fan-out stages each frame ONCE
+(refcounted views per subscriber) instead of re-framing per
+subscriber in Python.  This config measures both fronts against the
+exact legacy plane (``Config.fabric_native=False`` routing), with a
+deliberately BUSY GIL (spinner threads doing pure-Python arithmetic —
+the materializer/commit work a serving node does) contending every
+interpreter entry:
+
+- ``fabric_rpc_us_per_hop``        (us/hop, must not rise): p99
+  per-hop cost of an N-peer fan-out round of hot read RPCs — the
+  native leg pipelines the round through ``request_many`` and repeats
+  are answered by C++ event threads (GIL never taken); the legacy leg
+  is the serial Python NodeLink.  The ISSUE-12 acceptance bar (>= 3x
+  lower p99 than legacy under the busy GIL) is asserted in-bench.
+- ``fabric_pub_copies_per_frame``  (copies/frame, must not rise):
+  Python-side per-subscriber frame copies on an 8-subscriber publish
+  storm — structurally ZERO on the staged/native paths (one framing,
+  shared views), one per subscriber on the legacy path.
+
+Equivalence is asserted, not assumed: every RPC answer is
+byte-identical between the native and legacy legs (same decoded reply
+terms for the same request tape), the native leg proves the answer
+plane actually fired (endpoint counters), and the publish storm's
+delivery is byte-identical across ALL fan-out modes (legacy /
+staged / native hub), every subscriber, every frame, in order.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from benches._util import emit, setup
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[i]
+
+
+class _BusyGil:
+    """Spinner threads holding the interpreter busy — the serving
+    node's materializer/commit work, the load that makes every GIL
+    entry cost up to a scheduler timeslice."""
+
+    def __init__(self, n=2):
+        self._stop = False
+        self._threads = [threading.Thread(target=self._spin,
+                                          daemon=True)
+                         for _ in range(n)]
+
+    def _spin(self):
+        x = 0
+        while not self._stop:
+            x = (x * 1103515245 + 12345) % (1 << 31)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop = True
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+def _handler(origin, kind, payload):
+    """Deterministic read handler shared by BOTH legs: the reply is a
+    pure function of the request, so leg answers must be identical
+    term-for-term (the equivalence quantity) and repeats are
+    publishable (the answer plane's contract)."""
+    return ("val", kind, payload, sum(payload[1]))
+
+
+def _request_tape(n_peers, keys, rounds):
+    """Deterministic (peer, kind, payload) tape: a working set of hot
+    read requests cycled over the fan-out rounds (probe rounds, repair
+    storms, 2PC reads of hot keys — the repeat-heavy shape the answer
+    plane serves)."""
+    tape = []
+    for r in range(rounds):
+        calls = []
+        for p in range(n_peers):
+            k = keys[(r + p) % len(keys)]
+            calls.append((p, "snap_read",
+                          (f"key_{k}", tuple(range(k % 7 + 1)))))
+        tape.append(calls)
+    return tape
+
+
+def drive_rpc(native: bool, tape, n_peers):
+    """Run the fan-out tape against n_peers servers on the selected
+    plane; returns (per-hop latencies us, answers, native_answered)."""
+    from antidote_tpu.cluster.link import NodeLink
+    from antidote_tpu.cluster.nativelink import NativeNodeLink
+
+    mk = NativeNodeLink if native else NodeLink
+    servers = []
+    for i in range(n_peers):
+        srv = mk(f"srv{i}")
+        if native:
+            srv.answer_policy = lambda kind, payload: True
+        srv.serve(_handler)
+        servers.append(srv)
+    client = mk("cli")
+    for i, srv in enumerate(servers):
+        client.connect(i, srv.local_addr())
+    hop_us = []
+    answers = []
+    try:
+        for calls in tape:
+            t0 = time.perf_counter()
+            if native:
+                results = client.request_many(
+                    [(p, k, pl) for p, k, pl in calls])
+                got = []
+                for ok, val in results:
+                    assert ok, val
+                    got.append(val)
+            else:
+                got = [client.request(p, k, pl) for p, k, pl in calls]
+            dt = time.perf_counter() - t0
+            hop_us.append(dt / n_peers * 1e6)
+            answers.append(got)
+        answered = 0
+        if native:
+            answered = sum(
+                s.fabric_counters().get("native_answered", 0)
+                for s in servers)
+        return hop_us, answers, answered
+    finally:
+        client.close()
+        for s in servers:
+            s.close()
+
+
+def _recv_into(sub, n, out):
+    sub.settimeout(30)
+    for _ in range(n):
+        hdr = b""
+        while len(hdr) < 4:
+            more = sub.recv(4 - len(hdr))
+            if not more:
+                return
+            hdr += more
+        want = int.from_bytes(hdr, "big")
+        buf = b""
+        while len(buf) < want:
+            more = sub.recv(want - len(buf))
+            if not more:
+                return
+            buf += more
+        out.append(buf)
+
+
+#: frames per publish wave — safely under _SubSender.QUEUE_DEPTH
+#: (128) and the hub's per-subscriber byte bound.  The bounded
+#: queues DROP a peer that stalls past them by design (gap repair
+#: recovers it in production), but this bench asserts full
+#: byte-identical delivery, so it paces waves under the bound: each
+#: wave is a full-speed burst under the busy GIL (the copies-per-
+#: frame quantity is per-frame and unaffected by pacing), and the
+#: publisher waits for every subscriber's receipt before the next.
+_PUB_WAVE = 64
+
+
+def drive_publish(native_pub, frames, n_subs=8):
+    """One publish-storm leg: n_subs framed subscribers draining
+    concurrently, every frame published once in bounded waves;
+    returns (per-sub received frames, frames published, python
+    per-subscriber copies) from the shared stats registry's deltas."""
+    from antidote_tpu import stats
+    from antidote_tpu.interdc import termcodec
+    from antidote_tpu.interdc.tcp import TcpTransport, _send_frame
+    from antidote_tpu.interdc.wire import DcDescriptor
+
+    bus = TcpTransport(native_pub=native_pub)
+    try:
+        bus.register(DcDescriptor(dc_id="bench", n_partitions=1),
+                     lambda *_a: None)
+        (pub_addr,), _ = bus.local_addrs()
+        subs = []
+        got = [[] for _ in range(n_subs)]
+        for i in range(n_subs):
+            s = socket.create_connection(tuple(pub_addr), timeout=5)
+            _send_frame(s, termcodec.encode(f"sub{i}"))
+            subs.append(s)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if bus._hub is not None:
+                if bus._hub_lib.fab_sub_count(bus._hub) == n_subs:
+                    break
+            elif len(bus._subscribers) == n_subs:
+                break
+            time.sleep(0.01)
+        readers = [threading.Thread(target=_recv_into,
+                                    args=(s, len(frames), got[i]),
+                                    daemon=True)
+                   for i, s in enumerate(subs)]
+        for r in readers:
+            r.start()
+        f0 = stats.registry.pub_frames.value()
+        c0 = stats.registry.pub_sub_copies.value()
+        sent = 0
+        for f in frames:
+            bus.publish("bench", f)
+            sent += 1
+            if sent % _PUB_WAVE == 0 or sent == len(frames):
+                # wave barrier: all subscribers caught up before the
+                # next burst, so no bounded queue ever sees > one
+                # wave in flight
+                wave_end = time.monotonic() + 30
+                while (min(len(g) for g in got) < sent
+                       and time.monotonic() < wave_end):
+                    time.sleep(0.001)
+        for r in readers:
+            r.join(timeout=30)
+        f1 = stats.registry.pub_frames.value()
+        c1 = stats.registry.pub_sub_copies.value()
+        for s in subs:
+            s.close()
+        return got, f1 - f0, c1 - c0
+    finally:
+        bus.close()
+
+
+def main():
+    quick, _jax = setup()
+    n_peers = 4
+    keys = list(range(16))
+    rounds = 100 if quick else 400
+    tape = _request_tape(n_peers, keys, rounds)
+
+    # the ISSUE-12 acceptance bar: >= 3x lower p99 per hop under the
+    # busy GIL (measured headroom is far larger — the legacy hop pays
+    # a scheduler timeslice per frame read; the native repeat never
+    # enters the interpreter).  Equivalence is asserted on EVERY
+    # attempt; the p99 bar gets retries because a tail percentile
+    # over this many rounds is noisy when the BOX (not just the GIL)
+    # is loaded — e.g. a test suite sharing the cores.
+    for attempt in range(3):
+        with _BusyGil():
+            legacy_us, legacy_ans, _ = drive_rpc(False, tape, n_peers)
+            native_us, native_ans, answered = drive_rpc(True, tape,
+                                                        n_peers)
+        # equivalence: every answer identical term-for-term between
+        # legs
+        assert native_ans == legacy_ans, \
+            "native leg answers diverged from the Python NodeLink's"
+        # the answer plane actually fired: every repeat past the first
+        # pass over the working set is served without the GIL
+        assert answered > 0, "no RPC was answered natively"
+        legacy_p99 = _percentile(legacy_us, 0.99)
+        native_p99 = _percentile(native_us, 0.99)
+        ratio = legacy_p99 / max(native_p99, 1e-9)
+        if ratio >= 3.0:
+            break
+    assert ratio >= 3.0, \
+        f"native p99 {native_p99:.0f}us vs legacy {legacy_p99:.0f}us " \
+        f"({ratio:.1f}x) — under 3x after {attempt + 1} attempts"
+    emit("fabric_rpc_us_per_hop", round(native_p99, 1), "us/hop",
+         round(ratio, 2),
+         legacy_p99_us=round(legacy_p99, 1),
+         native_p50_us=round(_percentile(native_us, 0.5), 1),
+         legacy_p50_us=round(_percentile(legacy_us, 0.5), 1),
+         native_answered=answered,
+         rounds=rounds, peers=n_peers, busy_gil=True)
+
+    # ---- publish storm: 8 subscribers, byte-identical across modes
+    frames = [b"frame-%04d-" % i + b"x" * 256
+              for i in range(200 if quick else 1000)]
+    with _BusyGil():
+        legacy_got, legacy_frames, legacy_copies = drive_publish(
+            False, frames)
+        staged_got, staged_frames, staged_copies = drive_publish(
+            "python", frames)
+        auto_got, auto_frames, auto_copies = drive_publish(
+            "auto", frames)
+    for name, got in (("legacy", legacy_got), ("staged", staged_got),
+                      ("native", auto_got)):
+        for i, sub_frames in enumerate(got):
+            assert sub_frames == frames, \
+                f"{name} leg: subscriber {i} delivery diverged"
+    # structural: ONE frame encode, ZERO python per-subscriber copies
+    # on the staged/native paths; the legacy baseline pays exactly one
+    # per subscriber per frame
+    assert staged_frames == len(frames) and auto_frames == len(frames)
+    assert staged_copies == 0 and auto_copies == 0
+    assert legacy_copies == len(frames) * 8
+    emit("fabric_pub_copies_per_frame",
+         round(auto_copies / len(frames), 3), "copies/frame",
+         round(legacy_copies / len(frames), 2),
+         legacy_copies_per_frame=round(legacy_copies / len(frames), 2),
+         staged_copies_per_frame=round(
+             staged_copies / len(frames), 3),
+         subscribers=8, frames=len(frames),
+         native_hub=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,8 @@ CONFIGS = ("benches.config1_counter", "bench", "benches.config3_mvreg",
            "benches.config4_rga", "benches.config5_gst",
            "benches.config6_txn", "benches.config7_repl",
            "benches.config8_obs", "benches.config9_read",
-           "benches.config10_log", "benches.config11_ckpt")
+           "benches.config10_log", "benches.config11_ckpt",
+           "benches.config12_fabric")
 
 _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

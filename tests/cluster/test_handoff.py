@@ -507,7 +507,7 @@ def test_python_fabric_multi_partition_read(tmp_path):
     fuse — a multi-partition read spanning both works (regression:
     round-5 fused reads crashed on RemotePartition here)."""
     cfg = lambda: Config(n_partitions=8, heartbeat_s=0.05,
-                         node_fabric="python")
+                         fabric_native=False)
     servers = [
         NodeServer(f"py{i}", data_dir=str(tmp_path / f"py{i}"),
                    config=cfg())
@@ -537,7 +537,7 @@ def test_multi_partition_remote_read_is_one_rpc_per_owner(tmp_path):
     per owner member (the per-owner batched "part_multi", fused
     per-chip server-side), not once per partition."""
     cfg = lambda: Config(n_partitions=8, heartbeat_s=0.05,
-                         node_fabric="python")
+                         fabric_native=False)
     servers = [
         NodeServer(f"mo{i}", data_dir=str(tmp_path / f"mo{i}"),
                    config=cfg())

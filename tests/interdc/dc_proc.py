@@ -10,6 +10,7 @@ Commands (JSON per line on stdin; one JSON reply per line on stdout):
   {"cmd": "connect", "desc": [dc_id, n_partitions, [[host, pub]], [[host, q]]]}
   {"cmd": "update", "key": k, "type": t, "op": o, "arg": a, "clock": vc|null}
   {"cmd": "read", "key": k, "type": t, "clock": vc|null}
+  {"cmd": "fabric"}   — which publish plane is live (native hub?)
   {"cmd": "kill"}     — hard-exit without cleanup (crash injection)
   {"cmd": "exit"}     — graceful close
 """
@@ -29,7 +30,7 @@ jax.config.update("jax_enable_x64", True)
 from antidote_tpu.clocks import VC  # noqa: E402
 from antidote_tpu.config import Config  # noqa: E402
 from antidote_tpu.interdc.dc import DataCenter  # noqa: E402
-from antidote_tpu.interdc.tcp import TcpTransport  # noqa: E402
+from antidote_tpu.interdc.tcp import transport_from_config  # noqa: E402
 from antidote_tpu.interdc.wire import DcDescriptor  # noqa: E402
 
 
@@ -38,12 +39,11 @@ def main():
     data_dir = sys.argv[2]
     pub_port = int(sys.argv[3])
     query_port = int(sys.argv[4])
-    bus = TcpTransport(pub_port=pub_port, query_port=query_port)
-    dc = DataCenter(dc_id, bus,
-                    config=Config(n_partitions=2, heartbeat_s=0.02,
-                                  clock_wait_timeout_s=20.0,
-                                  sync_log=True),
-                    data_dir=data_dir)
+    cfg = Config(n_partitions=2, heartbeat_s=0.02,
+                 clock_wait_timeout_s=20.0, sync_log=True)
+    bus = transport_from_config(cfg, pub_port=pub_port,
+                                query_port=query_port)
+    dc = DataCenter(dc_id, bus, config=cfg, data_dir=data_dir)
     dc.start_bg_processes()
 
     def out(obj):
@@ -79,6 +79,9 @@ def main():
                 vals, cvc = dc.read_objects_static(
                     clock, [(req["key"], req["type"], "b")])
                 out({"value": vals[0], "clock": dict(cvc)})
+            elif cmd == "fabric":
+                out({"hub": bus._hub is not None,
+                     "staged": bus._staged})
             elif cmd == "kill":
                 os._exit(1)
             elif cmd == "exit":

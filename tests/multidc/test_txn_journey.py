@@ -205,13 +205,13 @@ class TestVisibilityMetrics:
 
 class TestPipelineSnapshot:
     SECTIONS = {"ship", "sub_bufs", "gates", "ingest", "log", "stable",
-                "connected_dcs"}
+                "fabric", "connected_dcs"}
 
     def test_snapshot_schema(self, journey2):
         dc1, dc2 = journey2
         _commit_and_replicate(dc1, dc2, elem="p0")
         snap = pipeline.snapshot()
-        assert set(snap) == {"at_us", "dcs"}
+        assert set(snap) == {"at_us", "dcs", "threads"}
         assert {"dc1", "dc2"} <= set(snap["dcs"])
         for name in ("dc1", "dc2"):
             d = snap["dcs"][name]

@@ -275,9 +275,43 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # replayed per key eviction must not rise — either means a cold
     # path is scaling with total log volume again
     ("ms/mb", -1), ("ops/evict", -1),
+    # native fabric family (ISSUE 12): p99 per-hop cost under the
+    # busy GIL and python-side publish copies per frame must not rise
+    ("us/hop", -1), ("copies/frame", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_fabric_plane_regression(tmp_path, capsys):
+    """ISSUE 12 synthetic two-round trajectory: round 2's p99 hop
+    cost balloons (hot reads re-entering the busy interpreter) and
+    publish copies per frame reappear (staged fan-out regressed to
+    per-subscriber re-framing) — both must fail."""
+    old = {"schema_version": 1, "round": 1, "dry_run": False,
+           "metrics": {
+               "fabric_rpc_us_per_hop": {"value": 80.0,
+                                         "unit": "us/hop"},
+               "fabric_pub_copies_per_frame": {"value": 0.0,
+                                               "unit": "copies/frame"}},
+           "failures": {}}
+    new = {"schema_version": 1, "round": 2, "dry_run": False,
+           "metrics": {
+               "fabric_rpc_us_per_hop": {"value": 2400.0,
+                                         "unit": "us/hop"},
+               "fabric_pub_copies_per_frame": {"value": 8.0,
+                                               "unit": "copies/frame"}},
+           "failures": {}}
+    import json
+
+    op, np_ = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    op.write_text(json.dumps(old))
+    np_.write_text(json.dumps(new))
+    rc = bench_gate.main([str(op), str(np_)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "fabric_rpc_us_per_hop" in err
+    assert "fabric_pub_copies_per_frame" in err
 
 
 def test_gate_fails_on_ckpt_plane_regression(tmp_path, capsys):

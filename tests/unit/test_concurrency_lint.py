@@ -356,12 +356,126 @@ def test_knob_reads_in_benches_count_for_coverage(tmp_path):
     assert _lint(tmp_path, "knob-dead") == []
 
 
+# --------------------------------------------- rule: [gil-policy]
+
+_DLL_FIXTURE = (
+    "import ctypes\n"
+    "class _Lib:\n"
+    "    def __init__(self, path):\n"
+    "        quick = ctypes.PyDLL(path)\n"
+    "        slow = ctypes.CDLL(path)\n")
+
+
+def test_gil_blocking_bound_via_pydll_fires(tmp_path):
+    """A blocking native entry point bound via PyDLL holds the GIL
+    across the whole blocking call — the exact failure the native IO
+    plane exists to avoid."""
+    _write(tmp_path, "antidote_tpu/newlink.py",
+           _DLL_FIXTURE +
+           "        self.nl_wait = quick.nl_wait\n"
+           "        self.nl_send = quick.nl_send\n")
+    problems = _lint(tmp_path, "gil-policy")
+    assert len(problems) == 1
+    assert "nl_wait" in problems[0] and "CDLL" in problems[0]
+
+
+def test_gil_quick_bound_via_cdll_fires(tmp_path):
+    """A quick bookkeeping entry point bound via CDLL pays a GIL
+    re-acquisition (up to a scheduler timeslice against busy threads)
+    for microseconds of C — the measured 4.4 ms start_request tax."""
+    _write(tmp_path, "antidote_tpu/newlink.py",
+           _DLL_FIXTURE +
+           "        self.nl_wait = slow.nl_wait\n"
+           "        self.nl_send = slow.nl_send\n")
+    problems = _lint(tmp_path, "gil-policy")
+    assert len(problems) == 1
+    assert "nl_send" in problems[0] and "PyDLL" in problems[0]
+
+
+def test_gil_probe_rebinding_classifies_by_assigned_name(tmp_path):
+    """``nl_wait_probe = quick.nl_wait`` is the deliberate zero-timeout
+    GIL-held probe — keyed by the ASSIGNED name, it is a quick entry
+    point and the PyDLL binding is correct (while ``nl_wait`` itself
+    still must come from the CDLL)."""
+    _write(tmp_path, "antidote_tpu/newlink.py",
+           _DLL_FIXTURE +
+           "        self.nl_wait = slow.nl_wait\n"
+           "        self.nl_wait_probe = quick.nl_wait\n")
+    assert _lint(tmp_path, "gil-policy") == []
+
+
+def test_gil_unclassified_binding_fires(tmp_path):
+    """The tables ARE the policy: an entry point in neither means
+    nobody decided its GIL class — itself a finding."""
+    _write(tmp_path, "antidote_tpu/newlink.py",
+           _DLL_FIXTURE +
+           "        self.nl_mystery = quick.nl_mystery\n")
+    problems = _lint(tmp_path, "gil-policy")
+    assert len(problems) == 1
+    assert "nl_mystery" in problems[0] and "unclassified" in problems[0]
+
+
+def test_gil_blocking_call_under_lock_fires(tmp_path):
+    """The tcp.py publish bug this rule was built against: fab_publish
+    (a CDLL call that can contend the hub mutex against an event
+    thread mid-send) inside the transport lock convoys every other
+    publisher; the same call outside the region passes."""
+    _write(tmp_path, "antidote_tpu/newtcp.py",
+           "class T:\n"
+           "    def bad_publish(self, data):\n"
+           "        with self._lock:\n"
+           "            if self._hub is not None:\n"
+           "                self._lib.fab_publish(self._hub, data,\n"
+           "                                      len(data))\n"
+           "    def good_publish(self, data):\n"
+           "        with self._lock:\n"
+           "            hub = self._hub\n"
+           "        self._lib.fab_publish(hub, data, len(data))\n")
+    problems = _lint(tmp_path, "gil-policy")
+    assert len(problems) == 1
+    assert "newtcp.py:5" in problems[0]
+    assert "fab_publish" in problems[0]
+
+
+def test_gil_blocking_reached_through_call_graph_under_lock(tmp_path):
+    """A lock region calling a helper that nl_waits is the same bug
+    one stack frame down — propagated like every blocking fact."""
+    _write(tmp_path, "antidote_tpu/newtcp.py",
+           "class T:\n"
+           "    def bad_round(self):\n"
+           "        with self._lock:\n"
+           "            self._collect_round()\n"
+           "    def _collect_round(self):\n"
+           "        self._lib.nl_wait(self._h, 1, None, 0, 100)\n")
+    problems = _lint(tmp_path, "gil-policy")
+    assert len(problems) == 1
+    assert "_collect_round" in problems[0] and "nl_wait" in problems[0]
+
+
+def test_fabric_endpoints_are_factory_routed(tmp_path):
+    """ISSUE 12 knob follow-through: NativeNodeLink and TcpTransport
+    are Config-routed (build_link / transport_from_config) — direct
+    construction elsewhere in the package bypasses fabric_native."""
+    _write(tmp_path, "antidote_tpu/config.py", _CONFIG_FIXTURE)
+    _write(tmp_path, "antidote_tpu/use.py",
+           "def f(config):\n"
+           "    return config.used_knob + config.other_knob\n")
+    _write(tmp_path, "antidote_tpu/rogue.py",
+           "from antidote_tpu.interdc.tcp import TcpTransport\n"
+           "def assemble():\n"
+           "    return TcpTransport()\n")
+    problems = _lint(tmp_path, "knob-routing")
+    assert len(problems) == 1
+    assert "TcpTransport" in problems[0]
+
+
 def test_all_fixture_rules_are_tagged():
     """Every fixture above keys off a [tag] the module actually
     emits — guard the tag names against drift."""
     src = open(concurrency_lint.__file__).read()
     for tag in ("lock-blocking", "lock-ok-reason", "lock-order",
-                "knob-routing", "knob-unknown", "knob-dead"):
+                "knob-routing", "knob-unknown", "knob-dead",
+                "gil-policy"):
         assert f"[{tag}]" in src
 
 

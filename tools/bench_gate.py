@@ -84,7 +84,13 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  # MB of on-disk log and ops replayed per key eviction
                  # — either rising means a cold path is scaling with
                  # total log volume again instead of the suffix
-                 "ms/mb", "ops/evict"}
+                 "ms/mb", "ops/evict",
+                 # native fabric (ISSUE 12): p99 per-hop RPC cost
+                 # under the busy-GIL load rising means hot reads are
+                 # re-entering the interpreter; python-side publish
+                 # copies per frame rising means the staged fan-out
+                 # regressed toward per-subscriber re-framing
+                 "us/hop", "copies/frame"}
 
 
 def repo_root() -> str:
@@ -96,10 +102,13 @@ def direction(unit: Optional[str]) -> int:
     if not unit:
         return 0
     u = str(unit).strip().lower()
-    if any(u.endswith(sfx) for sfx in _HIGHER_BETTER_SUFFIXES):
-        return 1
+    # exact lower-better entries outrank the higher-better suffix
+    # match: "copies/frame" (down, ISSUE 12) would otherwise hit the
+    # "/frame" suffix that exists for "txns/frame" (up)
     if u in _LOWER_BETTER:
         return -1
+    if any(u.endswith(sfx) for sfx in _HIGHER_BETTER_SUFFIXES):
+        return 1
     return 0
 
 
@@ -168,7 +177,14 @@ def compare(old: Dict, new: Dict,
             skipped.append((name, "non-numeric value"))
             continue
         if ov <= 0:
-            skipped.append((name, "non-positive baseline"))
+            if d == -1 and nv > 0:
+                # a lower-better metric leaving a ZERO baseline is a
+                # structural regression regardless of scale — the
+                # ISSUE-12 copies-per-frame counter's whole point is
+                # that zero IS the contract
+                regressions.append((name, ov, nv, float("inf")))
+            else:
+                skipped.append((name, "non-positive baseline"))
             continue
         change = (nv - ov) / ov
         goodness = change * d  # positive = better under the unit rule

@@ -47,6 +47,25 @@ Re-acquiring the SAME non-reentrant lock in one function (identical
 edges that only arise through calls are ignored (two instances of the
 same class are different locks).
 
+**GIL policy** [gil-policy]: the native fabrics bind ONE shared
+library twice, split by GIL policy (cluster/nativelink.py's ``_Lib``,
+interdc/tcp.py's ``_FabLib``): blocking entry points — the condition
+waits (``nl_wait``, ``nl_recv_batch``, ``nl_collect``), the
+socket-binding/teardown class (``nl_create``, ``nl_shutdown``,
+``fab_create``, ``fab_close``) and ``fab_publish`` (contends the hub
+mutex against an event thread mid-send) — must bind via ``CDLL`` (GIL
+released) and must never be CALLED inside a ``with <lock>:`` region
+(a GIL-releasing call under a lock hands the lock's whole wait chain
+to the scheduler); quick bookkeeping entry points must bind via
+``PyDLL`` (a CDLL call re-acquires the GIL on return, costing up to a
+scheduler timeslice against busy threads — measured at 4.4 ms per
+start_request before the split).  The two tables below ARE the
+policy: an entry point in neither is itself a finding, so a new
+binding must be classified before it ships.  Keyed by the ASSIGNED
+attribute name — ``self.nl_wait_probe = quick.nl_wait`` is the
+deliberate zero-timeout GIL-held probe binding, a distinct entry
+point with its own policy.
+
 **knob routing + coverage** [knob-*]: direct construction of a
 config-routed plane class (``_FACTORY_ROUTED``) anywhere in the
 package outside its blessed factory module is an error — the
@@ -90,6 +109,7 @@ _DECLARED_LOCKS: Dict[str, Set[str]] = {
     "antidote_tpu/mat/serve.py": {"_cond"},
     "antidote_tpu/interdc/sender.py": {"_cv"},
     "antidote_tpu/cluster/nativelink.py": {"_inflight_cv"},
+    "antidote_tpu/interdc/tcp.py": {"_hub_cv"},
 }
 
 #: config-routed plane classes -> modules blessed to construct them
@@ -111,6 +131,11 @@ _FACTORY_ROUTED: Dict[str, Tuple[str, ...]] = {
                    "antidote_tpu/txn/node.py"),
     "DevicePlane": ("antidote_tpu/mat/device_plane.py",
                     "antidote_tpu/txn/node.py"),
+    # fabric endpoints (ISSUE 12): Config.fabric_native routes them —
+    # build_link and transport_from_config are the construction paths
+    "NativeNodeLink": ("antidote_tpu/cluster/nativelink.py",
+                       "antidote_tpu/cluster/node.py"),
+    "TcpTransport": ("antidote_tpu/interdc/tcp.py",),
 }
 
 #: call names NEVER followed into a definition: methods of builtin
@@ -173,6 +198,39 @@ _BLOCKING_OWNED = {
 
 #: Condition/Event wait verbs (exempt when waiting on the held lock)
 _WAIT_NAMES = {"wait", "wait_for"}
+
+#: native fabric entry points that BLOCK (condition waits, socket
+#: bind/teardown, mutex contention against event threads): must bind
+#: via ctypes.CDLL — the GIL is released for the call — and must never
+#: be called inside a lock region (module docstring, [gil-policy]).
+#: Keyed by the ASSIGNED attribute name, so the deliberate GIL-held
+#: probe rebindings (nl_wait_probe = quick.nl_wait) classify
+#: separately.
+_GIL_BLOCKING = {
+    "nl_create": "socket bind",
+    "nl_wait": "reply condition wait",
+    "nl_recv_batch": "inbound-request condition wait",
+    "nl_collect": "fan-out collect wait",
+    "nl_shutdown": "event-thread join",
+    "fab_create": "socket bind",
+    "fab_publish": "hub-mutex send contention",
+    "fab_sub_count": "hub-mutex contention against the event "
+                     "thread's send sweep",
+    "fab_queued_bytes": "hub-mutex contention against the event "
+                        "thread's send sweep",
+    "fab_close": "event-thread join",
+}
+
+#: native fabric entry points that only do bookkeeping under the
+#: endpoint mutex (whose holders never block): must bind via
+#: ctypes.PyDLL — a CDLL call would pay a GIL re-acquisition (up to a
+#: scheduler timeslice against busy threads) for microseconds of C
+_GIL_QUICK = {
+    "nl_port", "nl_set_peer", "nl_send", "nl_cancel", "nl_drop_peer",
+    "nl_reply", "nl_free", "nl_publish", "nl_publish_clear",
+    "nl_counters", "nl_pub_gen", "nl_wait_probe", "nl_collect_probe",
+    "fab_port",
+}
 
 
 def _terminal(node: ast.expr) -> Optional[str]:
@@ -437,6 +495,13 @@ class _Analyzer:
                     if self._is_lock_expr(info, f.value) else \
                     f"{owner}.{name}"
                 return ("wait", f"{owner}.{name}", wl)
+            if name in _GIL_BLOCKING and fn.name != name:
+                # [gil-policy]: a GIL-releasing native call under a
+                # lock hands the lock's whole wait chain to the
+                # scheduler (and fab_publish can contend an event
+                # thread mid-send for the send's duration)
+                return ("gil", "GIL-releasing native call "
+                               f"{name} ({_GIL_BLOCKING[name]})", None)
             if name in _BLOCKING_ALWAYS and fn.name != name:
                 # a function NAMED like the primitive is its
                 # definition/wrapper, not a call-under-lock site
@@ -593,8 +658,9 @@ class _Analyzer:
                     continue
                 if self._suppressed(info, ln):
                     continue
+                tag = "gil-policy" if kind == "gil" else "lock-blocking"
                 problems.append(
-                    f"{fn.rel}:{ln}: [lock-blocking] {what} "
+                    f"{fn.rel}:{ln}: [{tag}] {what} "
                     f"({fn.qual}) inside lock region "
                     f"{{{', '.join(sorted(held))}}} — move it out or "
                     "audit with `# lock-ok: <reason>`")
@@ -615,8 +681,9 @@ class _Analyzer:
                 if self._suppressed(info, ln):
                     continue
                 kind, what, _wl, via = hit
+                tag = "gil-policy" if kind == "gil" else "lock-blocking"
                 problems.append(
-                    f"{fn.rel}:{ln}: [lock-blocking] call to "
+                    f"{fn.rel}:{ln}: [{tag}] call to "
                     f"{name}() under {{{', '.join(sorted(held))}}} "
                     f"reaches a {what} ({via}) — move it out or "
                     "audit with `# lock-ok: <reason>`")
@@ -779,6 +846,74 @@ class _Analyzer:
                     break  # one witness cycle is actionable enough
         return problems
 
+    # ----------------------------------------- rule: GIL binding policy
+
+    def lint_gil_bindings(self) -> List[str]:
+        """Every ``x.attr = <dll_var>.<sym>`` binding where <dll_var>
+        was assigned from ``ctypes.CDLL(...)`` / ``ctypes.PyDLL(...)``
+        must agree with the policy tables, keyed by the ASSIGNED
+        attribute name (``nl_wait_probe = quick.nl_wait`` is the
+        deliberate GIL-held probe, its own entry point).  A bound name
+        in neither table is itself a finding — the tables ARE the
+        policy, and an unclassified binding means nobody decided."""
+        problems: List[str] = []
+        for rel in sorted(self.files):
+            info = self.files[rel]
+            # dll handle vars per file: name -> "CDLL" | "PyDLL"
+            dll_vars: Dict[str, str] = {}
+            for node in ast.walk(info.tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                kind = _terminal(node.value.func)
+                if kind not in ("CDLL", "PyDLL"):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        dll_vars[t.id] = kind
+            if not dll_vars:
+                continue
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                v = node.value
+                if not (isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id in dll_vars):
+                    continue
+                policy = dll_vars[v.value.id]
+                for t in node.targets:
+                    bound = _terminal(t)
+                    if bound is None:
+                        continue
+                    if self._suppressed(info, node.lineno):
+                        continue
+                    if bound in _GIL_BLOCKING:
+                        if policy != "CDLL":
+                            problems.append(
+                                f"{rel}:{node.lineno}: [gil-policy] "
+                                f"blocking native entry point {bound} "
+                                f"({_GIL_BLOCKING[bound]}) bound via "
+                                "PyDLL — it holds the GIL across a "
+                                "blocking call; bind via CDLL")
+                    elif bound in _GIL_QUICK:
+                        if policy != "PyDLL":
+                            problems.append(
+                                f"{rel}:{node.lineno}: [gil-policy] "
+                                f"quick native entry point {bound} "
+                                "bound via CDLL — the GIL "
+                                "re-acquisition on return costs up to "
+                                "a scheduler timeslice per call; bind "
+                                "via PyDLL")
+                    else:
+                        problems.append(
+                            f"{rel}:{node.lineno}: [gil-policy] "
+                            f"unclassified native entry point {bound} "
+                            "bound from a ctypes library — add it to "
+                            "_GIL_BLOCKING or _GIL_QUICK (the tables "
+                            "are the policy)")
+        return problems
+
     # -------------------------------------- rule 3: knob routing + cov
 
     def lint_knobs(self) -> List[str]:
@@ -905,6 +1040,7 @@ def lint(root: str) -> List[str]:
     problems.extend(an.lint_blocking())
     problems.extend(an.lint_lock_ok_reasons())
     problems.extend(an.lint_lock_order())
+    problems.extend(an.lint_gil_bindings())
     problems.extend(an.lint_knobs())
     return problems
 
