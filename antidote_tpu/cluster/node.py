@@ -796,6 +796,20 @@ class NodeServer:
                     return data, end, b0
             raise RemoteCallError(
                 f"partition {p}: log kept truncating under the fetch")
+        if kind == "handoff_ckpt":
+            # the checkpoint as part of the transfer unit (ISSUE 13):
+            # manifest + immutable segments ship as one bundle, so a
+            # truncated donor's receiver recovers FULL state instead
+            # of suffix-only.  Segments never mutate, so no
+            # truncation-epoch dance — the puller pairs the bundle
+            # with a base-epoch re-check instead.
+            (p,) = payload
+            pm = self.node.partitions[int(p)]
+            if not isinstance(pm, PartitionManager):
+                raise RemoteCallError(f"partition {p} not local")
+            if not pm.log.enabled or pm.log.ckpt is None:
+                return None
+            return pm.log.ckpt.ship_bundle()
         if kind == "handoff_begin":
             p, from_owner = payload
             return self._handoff_begin(int(p), from_owner)
@@ -1001,8 +1015,51 @@ class NodeServer:
                         break
                 f.flush()
                 os.fsync(f.fileno())
-            if not restart:
-                return cursor, int(base or 0)
+            if restart:
+                continue
+            # checkpoint-shipping (ISSUE 13): pull the donor's
+            # manifest + segments AFTER the byte copy, then re-check
+            # the layout epoch — unchanged base means no truncation
+            # landed since the copy, so the bundle's cut is >= the
+            # staged base, and the cutover's own b_base check extends
+            # that guarantee to the pushed tail (the final file always
+            # contains the cut).  A pre-ISSUE-13 owner answers the
+            # fetch with an unknown-kind error: proceed without a
+            # bundle — the transferred log recovers by full scan
+            # exactly as before (suffix-only, loudly, if truncated).
+            bundle = None
+            for pull in range(3):
+                try:
+                    bundle = self._rpc(from_owner, "handoff_ckpt",
+                                       (p,))
+                    break
+                except RemoteCallError as e:
+                    if "unknown node RPC kind" in str(e):
+                        # pre-upgrade donor: it genuinely cannot ship
+                        log.info(
+                            "partition %d: donor %r predates "
+                            "checkpoint shipping; receiver will "
+                            "recover by full scan", p, from_owner)
+                        break
+                    if pull == 2:
+                        # a TRANSIENT failure must not silently ship
+                        # no bundle — that re-opens the truncated-
+                        # donor suffix-only hole this transfer unit
+                        # exists to close; loud, and the epoch
+                        # re-check below still gates consistency
+                        log.warning(
+                            "partition %d: checkpoint-bundle pull "
+                            "from %r failed 3x (%s); proceeding "
+                            "without it — a truncated donor's "
+                            "below-cut history will NOT transfer",
+                            p, from_owner, e)
+            ans = self._rpc(from_owner, "handoff_fetch",
+                            (p, cursor, 0))
+            b_now = int(ans[2]) if len(ans) == 3 else 0
+            if b_now != int(base or 0):
+                continue  # truncated since the copy: re-stage
+            ent["ckpt_bundle"] = bundle
+            return cursor, int(base or 0)
         raise RemoteCallError(
             f"partition {p}: log kept truncating under the handoff "
             "pre-copy; pause checkpoint truncation and re-drive")
@@ -1047,14 +1104,18 @@ class NodeServer:
                 os.fsync(f.fileno())
             os.replace(staged, self.node._log_path(p))
             # a stale LOCAL checkpoint (from a previous ownership of
-            # this slot) describes a different log's layout — adopting
-            # it against the transferred file would seed wrong state
-            # and skip the prefix; the transferred log recovers by
-            # full scan (the .ckpt does not travel — ROADMAP)
-            try:
-                os.remove(self.node._log_path(p) + ".ckpt")
-            except OSError:
-                pass
+            # this slot) describes a different log's layout — retire
+            # it (segments included) and install the donor's shipped
+            # bundle in its place (ISSUE 13): adoption then recovers
+            # checkpoint-seeded, FULL state even when the donor's
+            # below-cut bytes were truncated (pre-fix: suffix-only,
+            # loudly)
+            from antidote_tpu.oplog.checkpoint import (
+                install_shipped_bundle,
+            )
+
+            install_shipped_bundle(self.node._log_path(p) + ".ckpt",
+                                   ent.pop("ckpt_bundle", None))
             self.node.ring[p] = self.node_id
             self.node.adopt_partition(p)
             prev = self.plane.get_stable_snapshot() if self.plane \

@@ -98,6 +98,26 @@ class Config:
     #: truncating: ordinary gap repair (lost frames) stays served from
     #: the log for this much recent history
     ckpt_retain_ops: int = 4096
+    #: segmented checkpoint seed persistence (ISSUE 13): a watermark
+    #: checkpoint writes ONLY a dirty-delta seed segment (keys whose
+    #: frontier moved since the last cut) plus a small manifest, so
+    #: persist cost tracks CHURN instead of total keyspace — the
+    #: monolithic document re-pickled + double-fsynced the WHOLE
+    #: carried seed set at every cut.  Segments are immutable,
+    #: individually checksummed files; recovery reads each key's
+    #: newest segment entry; a caller-elected compaction folds them
+    #: when the dead-entry ratio crosses ckpt_seg_waste_frac.  False
+    #: keeps the PR-9 one-document checkpoint bit-for-bit (the
+    #: benches' comparison baseline, like ckpt / log_group); loading
+    #: follows the on-disk document's shape either way, so flipping
+    #: the knob across a restart recovers cleanly.
+    ckpt_segmented: bool = True
+    #: dead-entry fraction across seed segments past which the next
+    #: checkpoint compacts them into one (superseded per-key entries
+    #: accumulate one per re-fold of a dirty key; compaction is
+    #: caller-elected on the checkpointing thread — no background
+    #: thread, the mat/serve.py discipline)
+    ckpt_seg_waste_frac: float = 0.5
     #: number of partitions per node (reference ring size, default 16 prod
     #: / 4 in tests, config/vars.config:5)
     n_partitions: int = 4

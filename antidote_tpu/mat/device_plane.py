@@ -597,6 +597,21 @@ class _PlaneBase:
         subclass's ``_reader`` hook)."""
         return self.read_begin(key, read_vc)()
 
+    def seed_effects(self, state) -> Optional[list]:
+        """Effects that rebuild ``state`` exactly from bottom when
+        staged through this plane's own decoder — the checkpoint-seed
+        device re-init (ISSUE 13): a restarted node re-ingests each
+        folded seed as ordinary rows (the packed ingest upload) and
+        folds them into the device base at the seed frontier.  None =
+        this plane cannot represent a bare state as effects (RGA's
+        per-document trees, the STATE_LOSSY dot collapses) — the key
+        stays on the host path, exactly the pre-seed behavior.  The
+        round trip is the inverse of ``_reader``/the evict export:
+        seed_effects(read()) staged onto an empty plane reads back
+        identical (pinned per type by tests/unit/test_ckpt_segments
+        .py)."""
+        return None
+
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -931,6 +946,14 @@ class OrsetPlane(_PlaneBase):
                          ss_pairs))
         self._commit_rows(key, idx, rows)
 
+    def seed_effects(self, state):
+        # state: {elem: frozenset((actor, seq))} — one add per live
+        # dot, empty observed set (removes nothing): the union of dots
+        # IS the state, exactly what _reader reconstructs.  One ROW
+        # per effect, so the seeder can chunk-fold dot-heavy keys
+        # against the per-key lane budget.
+        return [("add", [(elem, dot, ())])
+                for elem, dots in state.items() for dot in dots]
 
     def _purge_idx(self, idx):
         self.st = store.orset_purge_keys(
@@ -1022,6 +1045,9 @@ class CounterPlane(_PlaneBase):
             (idx, int(payload.effect), op_dc_col,
              int(payload.commit_time), ss_pairs)])
 
+    def seed_effects(self, state):
+        # state: int — one delta op rebuilds it
+        return [int(state)] if state else []
 
     def _purge_idx(self, idx):
         self.st = store.counter_purge_keys(
@@ -1079,6 +1105,11 @@ class MvregPlane(OrsetPlane):
             (idx, slot, 1 if eff[0] == "asgn" else 0, dot_col or 0,
              int(seq), obs_pairs, op_dc_col, int(payload.commit_time),
              ss_pairs)])
+
+    def seed_effects(self, state):
+        # state: frozenset(((actor, seq), value)) — one un-observed
+        # assign per live (dot, value) pair
+        return [("asgn", v, dot, ()) for dot, v in state]
 
     def _device_gc(self, gst_dense):
         self.st = store.mvreg_gc(self.st, jnp.asarray(gst_dense))
@@ -1162,6 +1193,10 @@ class FlagEwPlane(OrsetPlane):
             (idx, 0, is_add, dot_col or 0, int(seq), obs_pairs,
              op_dc_col, int(payload.commit_time), ss_pairs)])
 
+    def seed_effects(self, state):
+        # state: frozenset((actor, seq)) enable dots — one
+        # un-observed enable per dot
+        return [("en", dot, ()) for dot in state]
 
     def _reader(self, st, idx, rv):
         domain = self.domain
@@ -1265,6 +1300,12 @@ class RwsetPlane(OrsetPlane):
                          op_dc_col, int(payload.commit_time), ss_pairs))
         self._commit_rows(key, idx, rows)
 
+
+    def seed_effects(self, state):
+        # STATE_LOSSY: the fold collapses per-DC dot sets, and a seed
+        # staged from the collapsed form would under-cancel at exact
+        # replicas — these keys recover host-path (log/seed replay)
+        return None
 
     def _purge_idx(self, idx):
         self.st = store.rwset_purge_keys(
@@ -1442,6 +1483,10 @@ class SetGoPlane(OrsetPlane):
                          int(payload.commit_time), ss_pairs))
         self._commit_rows(key, idx, rows)
 
+    def seed_effects(self, state):
+        # state: frozenset(elems) — one grow-only add (one row) per
+        # element, chunkable against the lane budget like set_aw's
+        return [(e,) for e in state]
 
     def _purge_idx(self, idx):
         self.st = store.setgo_purge_keys(
@@ -1597,6 +1642,11 @@ class LwwPlane(_PlaneBase):
             (idx, int(ts), tie, vid, op_dc_col,
              int(payload.commit_time), ss_pairs)])
 
+    def seed_effects(self, state):
+        # state: (ts, (actor, seq), value), or the unwritten bottom
+        # (0, (), None) — which needs no op at all
+        ts, tie, v = state
+        return [] if not tie and v is None else [(ts, tie, v)]
 
     def _purge_idx(self, idx):
         self.st = store.lww_purge_keys(
@@ -2510,6 +2560,74 @@ class DevicePlane:
     def owns(self, type_name: str, key) -> bool:
         p = self.planes.get(type_name)
         return p is not None and p.owns(key)
+
+    def seed_state(self, key, type_name: str, state, vc) -> bool:
+        """Install a checkpoint seed as DEVICE-resident base state
+        (ISSUE 13): decode the folded ``state`` back into plane rows
+        via the type's own effect decoder (``seed_effects`` — the
+        inverse of the evict/export fold, which already proves the
+        state round-trips) and stage them like any committed op; the
+        caller folds the staged rows into the device base at the seed
+        clock (``gc``), so base VC = seed frontier and a read below it
+        replay-gates to the log path exactly like
+        ``HostStore.seed_state``.  The synthetic payload's commit VC
+        is ``vc`` itself (snapshot = vc, commit entry drawn from it),
+        so any read covering the frontier includes every seed row.
+
+        Returns False — caller seeds the host path instead — when the
+        type has no state→effect decoding (maps, RGA, STATE_LOSSY
+        collapses), the key is already host-pinned, or a capacity miss
+        evicted it mid-seed (the eviction's migration already host-
+        seeded it from the checkpoint)."""
+        p = self.planes.get(type_name)
+        seed_fx = getattr(p, "seed_effects", None)
+        if p is None or seed_fx is None or not self.accepts(
+                type_name, key) or not vc:
+            return False
+        effs = seed_fx(state)
+        if effs is None:
+            return False
+        if not p._warm_kicked:
+            p.kick_warm()
+        tracer.instant("ckpt_seed_device", "device", key=key,
+                       type=type_name, effects=len(effs))
+        # commit VC == the seed frontier exactly: snapshot_vc carries
+        # the whole frontier and the commit entry is one of its own
+        # components, so the join adds nothing
+        dc, ct = max(vc.items(), key=lambda kv: kv[1])
+        # intern the frontier's DC columns UP FRONT, before any state
+        # lands: the caller's per-plane base fold (gc at the seed-
+        # clock join) relies on every accepted seed's frontier being
+        # internable — a bottom-state seed stages NO rows, so without
+        # this check it could smuggle an un-internable DC into the
+        # join, the fold's _ss_pairs would miss, and every seed in
+        # the plane would be left un-gated (served un-replayed below
+        # its frontier).  A frontier past the column capacity routes
+        # host-path like any other capacity miss.
+        if p._ss_pairs(VC(vc)) is None:
+            return False
+        p._key_idx(key)  # intern even a bottom-state seed (owns()=True)
+        # chunk against the per-key lane budget: a dot-heavy key's
+        # rows would overflow its ring lanes in one batch, and at boot
+        # there is no stable horizon for the overflow-retry fold —
+        # fold the staged chunk into the base at the seed frontier
+        # (its exact commit VC) and keep going
+        lanes = max(int(getattr(p, "n_lanes", 8)), 1)
+        for i, eff in enumerate(effs):
+            p.stage(key, Payload(
+                key=key, type_name=type_name, effect=eff,
+                commit_dc=dc, commit_time=int(ct), snapshot_vc=VC(vc),
+                txid=("ckpt-seed", 0), certified=True))
+            if not p.owns(key):
+                # capacity miss mid-seed: the eviction migrated the
+                # key (checkpoint seed + suffix replay) to the host
+                return False
+            if (i + 1) % lanes == 0 and i + 1 < len(effs):
+                p.gc(VC(vc))
+                if not p.owns(key):
+                    return False  # overflow eviction during the fold
+        stats.registry.ckpt_seed_device_keys.inc()
+        return True
 
     def stage(self, key, type_name: str, payload: Payload,
               stable_vc: Optional[VC]):

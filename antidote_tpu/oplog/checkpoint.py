@@ -44,24 +44,56 @@ gate_from_config lesson): Node's partition factory routes through it,
 so boot, repartition, and adopt_partition cannot honor different
 knobs.  ``Config.ckpt=False`` builds no store at all — recovery,
 eviction replay, and gap repair keep today's behavior bit-for-bit.
+
+**Segmented persistence (ISSUE 13).**  The one-document form above
+made every watermark checkpoint O(keyspace): the WHOLE carried seed
+set re-pickled and double-fsynced per cut, however small the churn.
+With ``Config.ckpt_segmented`` (default on) the seed set instead
+lives in immutable, individually checksummed **segment** files
+(same magic+len+crc framing, same torn-at-every-byte discipline) and
+the ``.ckpt`` file becomes a small **manifest** carrying the log cut,
+watermarks, floors, pending records, and the ordered segment list —
+a checkpoint then writes ONE dirty-delta segment (keys whose frontier
+moved since the previous cut) plus the manifest, O(churn).  Recovery
+merges segments oldest→newest so each key's NEWEST entry wins; a
+missing or torn segment refuses LOUDLY (the manifest loads as None
+and recovery falls back to the full scan — degraded cost, never a
+silent half-keyspace).  Superseded entries accumulate one per re-fold
+of a dirty key; when their fraction crosses ``seg_waste_frac`` the
+next checkpoint **compacts** — folds every live seed into one fresh
+segment, publishes a manifest listing only it, then unlinks the old
+segments — on the checkpointing thread (caller-elected, the
+mat/serve.py no-background-thread discipline).  A crash anywhere
+mid-compaction leaves the OLD manifest authoritative: segments are
+never mutated and the manifest rename is the single commit point.
+``Config.ckpt_segmented=False`` keeps the PR-9 monolithic document
+bit-for-bit (the bench baseline); loading follows the on-disk
+document's shape, so a knob flip across restarts recovers cleanly.
 """
 
 from __future__ import annotations
 
+import glob
+import logging
 import os
 import pickle
 import struct
 import time
 import zlib
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, List, Optional
 
 from antidote_tpu import stats
 from antidote_tpu.obs.spans import tracer
 from antidote_tpu.oplog.log import _fsync_dir
 
+log = logging.getLogger(__name__)
+
 #: checkpoint file framing: magic + [u32 len][u32 crc32(body)][body]
 _MAGIC = b"ATPCKPT1"
+#: seed-segment framing: same frame, its own magic — a segment file
+#: truncated/renamed over a manifest (or vice versa) must parse None
+_SEG_MAGIC = b"ATPCKSG1"
 _FRAME = struct.Struct("<II")
 
 #: document schema version (bump on layout change; unknown versions
@@ -89,6 +121,14 @@ class CheckpointSettings:
     #: from the log for this much recent history, so only a peer that
     #: fell further behind pays the checkpoint-bootstrap escalation
     retain_ops: int = 4096
+    #: dirty-delta segment persistence (ISSUE 13): a cut writes one
+    #: segment of the keys folded since the previous cut + a small
+    #: manifest, O(churn); False = the PR-9 whole-seed-set document,
+    #: bit-for-bit (the bench baseline)
+    segmented: bool = True
+    #: dead-entry fraction across segments past which the next
+    #: checkpoint compacts them into one
+    seg_waste_frac: float = 0.5
 
 
 def ckpt_from_config(config) -> CheckpointSettings:
@@ -100,22 +140,98 @@ def ckpt_from_config(config) -> CheckpointSettings:
         every_ops=config.ckpt_ops,
         every_bytes=config.ckpt_bytes,
         truncate=config.ckpt_truncate,
-        retain_ops=config.ckpt_retain_ops)
+        retain_ops=config.ckpt_retain_ops,
+        segmented=config.ckpt_segmented,
+        seg_waste_frac=config.ckpt_seg_waste_frac)
+
+
+def segment_glob(ckpt_path: str) -> List[str]:
+    """Every seed-segment file belonging to the checkpoint at
+    ``ckpt_path`` — the ONE owner of the on-disk naming, shared by the
+    store's sweep/delete and by every caller that retires a slot's
+    checkpoint wholesale (ring resize, handoff install)."""
+    return sorted(glob.glob(glob.escape(ckpt_path) + ".seg-*"))
+
+
+def delete_checkpoint_files(ckpt_path: str) -> None:
+    """Remove a slot's manifest/document, temp, and every segment —
+    ring resizes and handoff installs retire checkpoints by PATH
+    (their store object lives in another node's process, or nowhere)."""
+    for p in (ckpt_path, ckpt_path + ".tmp", *segment_glob(ckpt_path)):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def install_shipped_bundle(ckpt_path: str,
+                           bundle: Optional[dict]) -> None:
+    """Handoff receiver: retire whatever stale checkpoint lives at
+    ``ckpt_path`` (it describes a DIFFERENT log's layout) and, when
+    the donor shipped one, install its bundle so the transferred log
+    recovers checkpoint-seeded — FULL state even when the donor's
+    below-cut bytes were truncated (the pre-ISSUE-13 receiver
+    recovered suffix-only, loudly).  Lives here so the blessed module
+    constructs the store (the *_from_config factory discipline); the
+    settings are irrelevant to an install — only the paths are used,
+    and the adopting partition re-reads the files through its own
+    config-routed store."""
+    delete_checkpoint_files(ckpt_path)
+    if bundle:
+        CheckpointStore(ckpt_path,
+                        CheckpointSettings()).install_bundle(bundle)
 
 
 class CheckpointStore:
-    """Atomic load/store of one partition's checkpoint document."""
+    """Atomic load/store of one partition's checkpoint document —
+    monolithic (one pickled doc) or segmented (manifest + immutable
+    seed segments), per ``settings.segmented``."""
 
     def __init__(self, path: str, settings: CheckpointSettings):
         self.path = path
         self.settings = settings
+        #: next segment sequence number — never reused, so a staged
+        #: compaction output can never collide with a live segment
+        self._seg_seq = self._max_seg_seq() + 1
+
+    def _seg_path(self, seq: int) -> str:
+        return f"{self.path}.seg-{seq:08d}"
+
+    def _max_seg_seq(self) -> int:
+        top = 0
+        for p in segment_glob(self.path):
+            try:
+                top = max(top, int(p.rsplit("-", 1)[1]))
+            except ValueError:
+                continue
+        return top
+
+    def _sweep_segments(self, referenced: set) -> None:
+        """Unlink every on-disk segment whose basename is not in
+        ``referenced`` — the post-commit garbage sweep shared by the
+        segmented persist (compacted-away segments + crashed-persist
+        strays), the monolithic knob-flip (all of them), and the
+        bundle install (local strays the shipped manifest does not
+        list).  Only ever called AFTER the manifest that defines
+        ``referenced`` is durably in place."""
+        for p in segment_glob(self.path):
+            if os.path.basename(p) not in referenced:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------- load
 
     def load_doc(self) -> Optional[dict]:
         """The current checkpoint document, or None when absent, torn,
         or from an unknown schema (recovery then falls back to the full
-        scan — a bad checkpoint degrades cost, never correctness)."""
+        scan — a bad checkpoint degrades cost, never correctness).  A
+        segmented manifest loads its seed set by merging segments
+        oldest→newest (each key's newest entry wins); ANY listed
+        segment missing or torn refuses the whole document, loudly —
+        a silently partial seed set would recover a half-keyspace as
+        if it were everything."""
         try:
             with open(self.path, "rb") as f:
                 raw = f.read()
@@ -125,7 +241,49 @@ class CheckpointStore:
                          path=os.path.basename(self.path),
                          bytes=len(raw)):
             doc = self._parse(raw)
+            if doc is not None and "segments" in doc:
+                doc = self._load_segments(doc)
         return doc
+
+    def _load_segments(self, doc: dict) -> Optional[dict]:
+        """Materialize a manifest's seed set from its segment files."""
+        merged: Dict = {}
+        for name, _n_keys, _n_bytes in doc["segments"]:
+            entries = self._load_segment(
+                os.path.join(os.path.dirname(self.path) or ".", name))
+            if entries is None:
+                log.error(
+                    "checkpoint manifest %s lists segment %s but it "
+                    "is missing or torn — refusing the whole "
+                    "checkpoint (recovery falls back to the full "
+                    "scan)", self.path, name)
+                return None
+            merged.update(entries)
+        doc["keys"] = merged
+        return doc
+
+    @staticmethod
+    def _load_segment(path: str) -> Optional[dict]:
+        """A segment file's ``{key: (type_name, state, vc)}``, or None
+        when absent/torn/corrupt (same every-byte discipline as the
+        document parse)."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        hdr = len(_SEG_MAGIC) + _FRAME.size
+        if len(raw) < hdr or not raw.startswith(_SEG_MAGIC):
+            return None
+        ln, crc = _FRAME.unpack(raw[len(_SEG_MAGIC):hdr])
+        body = raw[hdr:hdr + ln]
+        if len(body) < ln or zlib.crc32(body) != crc:
+            return None
+        try:
+            entries = pickle.loads(body)
+        except Exception:  # noqa: BLE001 — corrupt segments load None
+            return None
+        return entries if isinstance(entries, dict) else None
 
     @staticmethod
     def _parse(raw: bytes) -> Optional[dict]:
@@ -145,6 +303,26 @@ class CheckpointStore:
         return doc
 
     # ------------------------------------------------------------ store
+
+    def persist(self, doc: dict) -> None:
+        """Persist one checkpoint — THE routing point of the
+        ``ckpt_segmented`` knob's write side: the monolithic document
+        (``write_doc``, the PR-9 bytes exactly) or a dirty-delta
+        segment + manifest.  ``doc`` carries the full merged seed set
+        in ``keys`` and, when the caller folded incrementally, the
+        dirty-only delta in ``delta`` (manager._ckpt_fold)."""
+        tracer.instant("ckpt_persist", "oplog",
+                       path=os.path.basename(self.path),
+                       segmented=self.settings.segmented)
+        if not self.settings.segmented:
+            doc.pop("delta", None)  # monolithic docs carry keys only
+            self.write_doc(doc)
+            # a knob flip back to monolithic strands the previous
+            # manifest's segments: the document just written carries
+            # every seed inline, so they are garbage now
+            self._sweep_segments(set())
+            return
+        self._persist_segmented(doc)
 
     def write_doc(self, doc: dict) -> int:
         """Atomically persist ``doc``; returns the file size.  The
@@ -171,12 +349,162 @@ class CheckpointStore:
         reg.ckpt_duration.observe(time.perf_counter() - t0)
         return len(raw)
 
+    def _write_segment(self, entries: dict) -> tuple:
+        """One immutable seed segment: frame, write, fsync.  No rename
+        dance — the file is not live until a MANIFEST lists it, and
+        the sequence numbering never reuses a name, so a crash leaves
+        only an unreferenced stray (swept by the next persist).
+        Returns (basename, n_keys, n_bytes)."""
+        seq = self._seg_seq
+        self._seg_seq += 1
+        path = self._seg_path(seq)
+        body = pickle.dumps(entries, protocol=pickle.HIGHEST_PROTOCOL)
+        raw = _SEG_MAGIC + _FRAME.pack(len(body), zlib.crc32(body)) \
+            + body
+        with tracer.span("ckpt_seg_write", "oplog",
+                         path=os.path.basename(path), bytes=len(raw),
+                         keys=len(entries)):
+            with open(path, "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+        return os.path.basename(path), len(entries), len(raw)
+
+    def _persist_segmented(self, doc: dict) -> None:
+        """Dirty-delta persist: write ONE segment holding the keys
+        folded since the previous cut, then the manifest (atomic
+        rename — the commit point).  Compaction is elected HERE, on
+        the checkpointing thread, when the superseded-entry fraction
+        across segments crosses ``seg_waste_frac``: every live seed
+        folds into one fresh segment and the manifest lists only it.
+        Old segments are unlinked only AFTER the new manifest landed —
+        a crash at any earlier byte leaves the previous manifest
+        authoritative over files that still all exist."""
+        t0 = time.perf_counter()
+        delta = doc.pop("delta", None)
+        if delta is None:
+            # no incremental fold (first cut, or a caller handing a
+            # fully-materialized doc): the whole seed set is the delta
+            delta = doc["keys"]
+        prev = doc.pop("prev_segments", [])
+        live = len(doc["keys"])
+        # elect compaction from the PROSPECTIVE shape (previous
+        # segments + the delta about to be written) BEFORE paying for
+        # the delta segment: a compacting cut writes ONLY the
+        # compacted segment — the delta is a subset of the live set,
+        # and writing-then-unlinking it would double the fsyncs on
+        # exactly the cuts that are already the most expensive
+        n_segs = len(prev) + (1 if delta else 0)
+        total = sum(n for _name, n, _b in prev) + len(delta)
+        dead_frac = (total - live) / total if total else 0.0
+        compacted = (n_segs > 1 and dead_frac >= max(
+            self.settings.seg_waste_frac, 1e-9))
+        if compacted:
+            segments = [self._write_segment(doc["keys"])]
+        else:
+            segments = list(prev)
+            if delta:
+                segments.append(self._write_segment(delta))
+        tracer.instant("ckpt_manifest", "oplog",
+                       path=os.path.basename(self.path),
+                       segments=len(segments), compacted=compacted)
+        keys = doc.pop("keys")  # the manifest carries the list, not
+        try:                    # the seed states themselves
+            doc["segments"] = segments
+            self.write_doc(doc)
+        finally:
+            doc["keys"] = keys
+        # post-commit sweep: everything the live manifest does not
+        # reference (compacted-away segments, strays from a crashed
+        # persist) is garbage now
+        self._sweep_segments({name for name, _n, _b in segments})
+        reg = stats.registry
+        if compacted:
+            reg.ckpt_seg_compactions.inc()
+        lbl = str(doc.get("partition", ""))
+        reg.ckpt_seg_count.set(len(segments), partition=lbl)
+        reg.ckpt_seg_bytes.set(sum(b for _n, _k, b in segments),
+                               partition=lbl)
+        total = sum(n for _name, n, _b in segments)
+        reg.ckpt_seg_dead_frac.set(
+            (total - live) / total if total else 0.0, partition=lbl)
+        if delta:
+            us = (time.perf_counter() - t0) * 1e6
+            reg.ckpt_seg_persist_us_per_key.set(us / len(delta))
+
     def delete(self) -> None:
-        for p in (self.path, self.path + ".tmp"):
+        delete_checkpoint_files(self.path)
+
+    # --------------------------------------------- handoff shipping
+
+    def ship_bundle(self) -> Optional[dict]:
+        """The checkpoint as one transferable unit (ISSUE 13 handoff):
+        raw manifest/document bytes + every referenced segment's raw
+        bytes.  Segments are immutable, so they copy without the
+        truncation-epoch dance the raw log needs; the only race is a
+        compaction unlinking a listed segment between the manifest
+        read and the segment read — bounded retries re-read the fresh
+        manifest.  None when no (valid) checkpoint exists."""
+        for _attempt in range(5):
             try:
-                os.remove(p)
+                with open(self.path, "rb") as f:
+                    manifest_raw = f.read()
             except OSError:
-                pass
+                return None
+            doc = self._parse(manifest_raw)
+            if doc is None:
+                return None
+            segs: Dict[str, bytes] = {}
+            ok = True
+            for name, _n, _b in doc.get("segments", ()):
+                try:
+                    with open(os.path.join(
+                            os.path.dirname(self.path) or ".",
+                            name), "rb") as f:
+                        segs[name] = f.read()
+                except OSError:
+                    ok = False  # compacted away mid-read: re-read
+                    break
+            if ok:
+                return {"manifest": manifest_raw, "segments": segs}
+        # exhausted: every attempt lost the read race to a compaction.
+        # RAISE rather than return None — None means "no checkpoint to
+        # ship" and the receiver proceeds quietly; a donor that HAS
+        # one but could not be read must surface as a retryable error
+        # so the puller's retry/warning path engages (a truncated
+        # donor's below-cut history silently not transferring is the
+        # exact hole this bundle exists to close)
+        raise OSError(
+            f"checkpoint bundle read at {self.path} kept losing to "
+            "concurrent compaction; retry the pull")
+
+    def install_bundle(self, bundle: dict) -> None:
+        """Install a shipped checkpoint at this store's path: segments
+        first (dead files until referenced), then the manifest via the
+        atomic temp+rename (the commit point), then a sweep of local
+        strays the shipped manifest does not list.  A torn install
+        (crash before the rename) leaves whatever manifest was live
+        before — never a blend."""
+        d = os.path.dirname(self.path) or "."
+        with tracer.span("ckpt_install_bundle", "oplog",
+                         path=os.path.basename(self.path),
+                         segments=len(bundle.get("segments", ()))):
+            for name, raw in bundle.get("segments", {}).items():
+                base = os.path.basename(name)  # no path traversal
+                with open(os.path.join(d, base), "wb") as f:
+                    f.write(raw)
+                    f.flush()
+                    os.fsync(f.fileno())
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(bundle["manifest"])
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            _fsync_dir(d, instant="ckpt_dir_fsync")
+        self._sweep_segments({os.path.basename(n)
+                              for n in bundle.get("segments", ())})
+        self._seg_seq = self._max_seg_seq() + 1
 
 
 def empty_doc(partition: int) -> dict:

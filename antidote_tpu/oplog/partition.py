@@ -698,18 +698,28 @@ class PartitionLog:
         return cf, of
 
     def persist_checkpoint(self, doc: dict) -> None:
-        """Atomically write ``doc`` to disk.  Deliberately does NOT
-        need the partition lock: the document is an immutable snapshot
-        once captured, and the pickle + double fsync + rename must not
-        stall the partition's commits and reads (the PR-8 no-fsync-
-        under-the-lock lesson).  The caller serializes writers
-        (PartitionManager._ckpt_inflight) so documents land in cut
-        order."""
+        """Atomically write ``doc`` to disk — the monolithic document
+        or, under ``ckpt_segmented``, one dirty-delta segment + the
+        manifest (CheckpointStore.persist routes the knob).
+        Deliberately does NOT need the partition lock: the document is
+        an immutable snapshot once captured, and the pickle + fsyncs +
+        rename must not stall the partition's commits and reads (the
+        PR-8 no-fsync-under-the-lock lesson).  The caller serializes
+        writers (PartitionManager._ckpt_inflight) so documents — and
+        segment/manifest pairs — land in cut order, which is also what
+        keeps compaction single-flight against a concurrent
+        checkpoint."""
         if self.ckpt is None:
             raise RuntimeError("checkpointing is disabled (Config.ckpt)")
         tracer.instant("ckpt_commit", "oplog", partition=self.partition,
                        cut=doc["cut_offset"], keys=len(doc["keys"]))
-        self.ckpt.write_doc(doc)
+        if self.ckpt.settings.segmented:
+            # the previous manifest's segment list is the base the new
+            # dirty-delta segment stacks on
+            doc["prev_segments"] = list(
+                self.ckpt_doc.get("segments", ())) \
+                if self.ckpt_doc else []
+        self.ckpt.persist(doc)
 
     def stage_truncation(self, doc: dict) -> Optional[dict]:
         """Phase 1 of the document's truncation plan — compose the
@@ -754,6 +764,7 @@ class PartitionLog:
         half runs here).  Must run under the owning partition's lock,
         like :meth:`capture_cut` — the seed swap and the index prune
         race the readers otherwise."""
+        doc.pop("delta", None)  # persisted (or folded into keys)
         self.ckpt_doc = doc
         self.ckpt_seeds = {
             key: (tn, state, VC(vc))

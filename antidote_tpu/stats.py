@@ -592,6 +592,40 @@ class Registry:
             "Per-partition recovery wall time at boot (checkpoint "
             "load + suffix replay; the recovery-time trend panel)",
             buckets=lat_buckets + (30.0, 120.0))
+        # ---- segmented checkpoint engine (ISSUE 13,
+        # antidote_tpu/oplog/checkpoint.py): persist cost tracks
+        # churn, not keyspace — the CKPT_SEG_* families watch the
+        # segment economy (count/bytes/dead fraction), the compaction
+        # cadence, the headline us-per-dirty-key amortization, and how
+        # many seeds a restart re-installed device-resident (the
+        # re-earned device economy)
+        self.ckpt_seg_count = LabeledGauge(
+            "antidote_ckpt_seg_count",
+            "Seed segments listed by the partition's newest "
+            "checkpoint manifest", labels=("partition",))
+        self.ckpt_seg_bytes = LabeledGauge(
+            "antidote_ckpt_seg_bytes",
+            "Total on-disk bytes across the partition's live seed "
+            "segments", labels=("partition",))
+        self.ckpt_seg_dead_frac = LabeledGauge(
+            "antidote_ckpt_seg_dead_frac",
+            "Superseded-entry fraction across the partition's seed "
+            "segments (compaction triggers past "
+            "Config.ckpt_seg_waste_frac)", labels=("partition",))
+        self.ckpt_seg_compactions = Counter(
+            "antidote_ckpt_seg_compactions_total",
+            "Segment compactions (live seeds folded into one fresh "
+            "segment on the checkpointing thread)")
+        self.ckpt_seg_persist_us_per_key = Gauge(
+            "antidote_ckpt_seg_persist_us_per_dirty_key",
+            "Microseconds the last segmented persist paid per dirty "
+            "key (segment pickle + fsync + manifest; the "
+            "churn-proportional headline the bench gates)")
+        self.ckpt_seed_device_keys = Counter(
+            "antidote_ckpt_seed_device_keys_total",
+            "Checkpoint seeds installed as device-resident bases at "
+            "recovery (previously device-resident keys serving from "
+            "the device again instead of pinning host-path)")
         # ---- native node fabric + zero-copy publish fan-out (ISSUE
         # 12, cluster/nativelink.py + interdc/tcp.py): the GIL-free
         # answer plane's hit economy and the one-staging publish
@@ -677,6 +711,10 @@ class Registry:
                 self.ckpt_duration, self.ckpt_age, self.ckpt_keys,
                 self.ckpt_truncations, self.ckpt_bootstraps,
                 self.ckpt_recovery,
+                self.ckpt_seg_count, self.ckpt_seg_bytes,
+                self.ckpt_seg_dead_frac, self.ckpt_seg_compactions,
+                self.ckpt_seg_persist_us_per_key,
+                self.ckpt_seed_device_keys,
                 self.fabric_native_answered, self.fabric_py_answers,
                 self.fabric_published, self.pub_frames,
                 self.pub_sub_copies, self.pub_fanout,

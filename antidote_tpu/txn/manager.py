@@ -1291,13 +1291,24 @@ class PartitionManager:
     def _ckpt_fold(self, doc: dict, dirty: Dict[Any, str]) -> None:
         """Fold the dirty keys into ``doc`` (the capture half of
         :meth:`checkpoint_now`); runs under self._lock with device
-        readers quiesced."""
-        # carry the previous cut's seeds forward; re-fold only the
-        # dirty keys (the incremental economy)
-        keys = {k: (tn, state, dict(vc))
-                for k, (tn, state, vc) in self.log.ckpt_seeds.items()}
-        clock = VC(self.log.ckpt_doc["clock"]) \
-            if self.log.ckpt_doc else VC()
+        readers quiesced.  Under ``ckpt_segmented`` the freshly folded
+        dirty entries ALSO land in ``doc["delta"]`` — the only part
+        the segmented persist serializes (O(churn)); the carried seeds
+        ride forward as shared references, never re-copied."""
+        prev_doc = self.log.ckpt_doc
+        segmented = (self.log.ckpt is not None
+                     and self.log.ckpt.settings.segmented)
+        if segmented and prev_doc is not None:
+            # pointer-copy the previous merged map: entries are
+            # immutable (tn, state, vc-dict) tuples, and re-copying
+            # every VC per cut was itself an O(keyspace) term
+            keys = dict(prev_doc["keys"])
+        else:
+            # carry the previous cut's seeds forward; re-fold only the
+            # dirty keys (the incremental economy)
+            keys = {k: (tn, state, dict(vc))
+                    for k, (tn, state, vc) in self.log.ckpt_seeds.items()}
+        clock = VC(prev_doc["clock"]) if prev_doc else VC()
         by_type: Dict[str, list] = {}
         host_items = []
         for key, tn in dirty.items():
@@ -1325,28 +1336,83 @@ class PartitionManager:
                 folded[key] = (tn, self._read_from_log(key, tn, None))
             else:
                 folded[key] = (tn, self.store.read(key, tn, None)[0])
+        delta: Dict[Any, tuple] = {}
         for key, (tn, state) in folded.items():
             fr = self.key_frontier.get(key) or VC()
-            keys[key] = (tn, state, dict(fr))
+            ent = (tn, state, dict(fr))
+            keys[key] = ent
+            delta[key] = ent
             clock = clock.join(fr)
         doc["keys"] = keys
+        if segmented:
+            # a previous MONOLITHIC document's carried seeds live in
+            # no segment — the first segmented cut after a knob flip
+            # must persist the full set or they would silently vanish
+            # from the manifest's merge
+            doc["delta"] = keys if (prev_doc is not None
+                                    and "segments" not in prev_doc) \
+                else delta
         doc["clock"] = dict(clock)
 
-    def install_ckpt_seeds(self) -> None:
+    def install_ckpt_seeds(self) -> set:
         """Boot-time half of checkpoint recovery: install every seed
-        into the materializer plane (host store snapshot at the seed's
-        frontier + key frontier) BEFORE the suffix replay applies the
-        ops past the cut on top.  Seeded keys stay on the host path
-        (the device plane cannot ingest a folded base state — noted in
-        ROADMAP); must run under self._lock."""
+        into its materializer plane BEFORE the suffix replay applies
+        the ops past the cut on top; must run under self._lock.
+        Returns the keys whose seeding EVICTED to the host mid-install
+        — their migration already replayed seed + suffix, so the
+        caller's suffix replay must skip (not re-publish) them.
+
+        ISSUE 13: seeds of types the device plane can re-ingest
+        (DevicePlane.seed_state — the folded state decoded back into
+        plane rows, uploaded through the packed ingest path) go back
+        DEVICE-resident, then fold into the device base at the
+        checkpoint clock, so a restarted node re-earns its device
+        economy instead of serving every previously device-resident
+        key host-path forever (the PR-9 remainder).  Types with no
+        state→effect decoding (maps, RGA, the STATE_LOSSY collapses)
+        keep the host seeding exactly as before; so does a key a
+        capacity miss evicts mid-seed (its eviction already migrated
+        the checkpoint seed to the host store)."""
         if not self.log.ckpt_seeds:
-            return
+            return set()
+        pre_hosted = set(self.device.host_only) \
+            if self.device is not None else set()
+        host_seeded: set = set()
+        dev_clocks: Dict[str, VC] = {}
         for key, (tn, state, vc) in self.log.ckpt_seeds.items():
-            self.store.seed_state(key, tn, state, vc)
+            if self.device is not None \
+                    and self.device.seed_state(key, tn, state, vc):
+                dev_clocks[tn] = dev_clocks.get(tn, VC()).join(vc)
+            elif not (self.device is not None
+                      and key in self.device.host_only):
+                # host path; mid-seed evictions (host_only) already
+                # seeded via their migration's checkpoint replay
+                self.store.seed_state(key, tn, state, vc)
+                host_seeded.add(key)
+                if self.device is not None:
+                    self.device.host_only.add(key)
             self.key_frontier[key] = (
                 self.key_frontier.get(key) or VC()).join(vc)
-            if self.device is not None:
-                self.device.host_only.add(key)
+        # fold the staged seed rows into each plane's device base at
+        # that plane's seed-clock join: the base VC then gates reads
+        # below a seed's frontier to the exact log-replay path — the
+        # device twin of HostStore seed replay-gating.  Per PLANE, not
+        # the document clock: seed_state interns every accepted
+        # frontier's DC columns up front (bottom-state seeds
+        # included), so the fold can never miss on a column-capacity
+        # check and leave seeds un-gated.
+        for tn, ck in dev_clocks.items():
+            self.device.planes[tn].gc(ck)
+        # keys a capacity/overflow eviction migrated DURING seeding:
+        # their migration replayed checkpoint seed + retained suffix
+        # into the host store, so the caller's suffix replay must SKIP
+        # their payloads (publishing them again would double-apply) —
+        # exactly the live _mid_batch_migrated contract
+        migrated = set()
+        if self.device is not None:
+            migrated = (set(self.device.host_only) - pre_hosted
+                        - host_seeded)
+        return migrated
 
     def ckpt_bootstrap_answer(self, own_dc) -> Optional[dict]:
         """Server side of the CKPT_READ inter-DC query (a remote

@@ -616,12 +616,13 @@ class Node:
         # stale checkpoints must not survive the swap: a doc captured
         # against the pre-resize layout would otherwise be adopted by
         # the re-cut log (its cut is just a byte offset) and recovery
-        # would seed old-routing state + skip the new log's prefix
+        # would seed old-routing state + skip the new log's prefix —
+        # segments included, or the next segmented cut at this path
+        # could stack fresh deltas onto pre-resize seed files
+        from antidote_tpu.oplog.checkpoint import delete_checkpoint_files
+
         for p in range(max(new_n, old_n)):
-            try:
-                os.remove(self._log_path(p) + ".ckpt")
-            except OSError:
-                pass
+            delete_checkpoint_files(self._log_path(p) + ".ckpt")
         os.remove(self._resize_journal_path())
 
     def _resume_interrupted_resize(self) -> None:
@@ -841,7 +842,7 @@ class Node:
         def recover_one(pm: PartitionManager) -> VC:
             t0 = time.perf_counter()
             with pm._lock:
-                pm.install_ckpt_seeds()
+                seed_migrated = pm.install_ckpt_seeds()
             pre_hosted = pm._pre_hosted()
             # the recovered commit join is a safe fold horizon for
             # replay-time device flushes: every replayed op lies at or
@@ -856,7 +857,12 @@ class Node:
             stable = stable if stable else None
             for _seq, payload in pm.log.suffix_payloads():
                 with pm._lock:
-                    if pm._mid_batch_migrated(pre_hosted, payload.key):
+                    # a key whose device seeding evicted mid-install
+                    # already replayed seed + suffix via its migration
+                    # — publishing again would double-apply (ISSUE 13)
+                    if payload.key in seed_migrated or \
+                            pm._mid_batch_migrated(pre_hosted,
+                                                   payload.key):
                         pm._note_skipped_publish(payload.key, payload)
                     else:
                         pm._publish(payload.key, payload.type_name,
@@ -910,14 +916,15 @@ class Node:
         keys."""
         pm = self._build_partition(p)
         with pm._lock:
-            pm.install_ckpt_seeds()
+            seed_migrated = pm.install_ckpt_seeds()
         pre_hosted = pm._pre_hosted()
         # same safe replay-time fold horizon as _recover_stores
         stable = pm.log.max_commit_vc
         stable = stable if stable else None
         for _seq, payload in pm.log.suffix_payloads():
             with pm._lock:
-                if pm._mid_batch_migrated(pre_hosted, payload.key):
+                if payload.key in seed_migrated or \
+                        pm._mid_batch_migrated(pre_hosted, payload.key):
                     pm._note_skipped_publish(payload.key, payload)
                 else:
                     pm._publish(payload.key, payload.type_name,

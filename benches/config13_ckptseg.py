@@ -1,0 +1,248 @@
+"""Config 13: segmented checkpoints — persist cost vs keyspace, and
+the device economy after a checkpoint-seeded restart.
+
+Before ISSUE 13 every watermark checkpoint re-pickled and
+double-fsynced the WHOLE carried seed set — O(keyspace) per cut,
+however small the churn — and a checkpoint-seeded restart pinned
+every previously device-resident key on the host path forever.  The
+segmented engine writes one dirty-delta segment + a small manifest
+per cut (O(churn)) and re-installs seeds as device-resident bases.
+
+This config drives IDENTICAL churn (same dirty-key count per cut) at
+two keyspace sizes (50x apart), measures checkpoint persist cost per
+dirty key on both legs, asserts the big leg stays within 1.5x of the
+small leg (the monolithic baseline's ratio — measured in-bench — is
+~keyspace-proportional), asserts recovered state is bit-identical to
+the full-scan oracle AND to the monolithic-document recovery per leg,
+and restarts a device-store node to measure how many checkpoint seeds
+came back device-resident.  Emits the two gate-enforced quantities:
+
+- ``ckpt_persist_us_per_dirty_key``  (us/key, must not rise):
+  checkpoint wall time per dirty key at the GROWN keyspace — a
+  keyspace-proportional persist multiplies this straight back up;
+- ``ckpt_restart_device_resident_pct``  (resident pct, must not
+  fall): checkpoint-seeded keys serving from the device again after
+  a restart — falling means restarts degrade to host-path serving.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import statistics
+import time
+
+from benches._util import emit, setup
+
+#: fixed churn set per checkpoint round — identical on both legs
+CHURN_KEYS = 32
+
+
+def _mk_node(data_dir, keyspace, segmented, device=False,
+             n_partitions=1):
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(device_store=device, n_partitions=n_partitions,
+                 ckpt=True, ckpt_segmented=segmented,
+                 ckpt_truncate=False, ckpt_ops=1 << 30,
+                 ckpt_bytes=1 << 40, data_dir=data_dir)
+    return Node(dc_id="dc1", config=cfg), cfg
+
+
+#: per-key payload weight: big enough that SERIALIZING the seed set
+#: dominates the cut (the O(keyspace) term under test), small enough
+#: that building the 50x leg stays cheap
+VAL_BYTES = 4096
+
+
+def _commit(node, n, key, tag="v"):
+    """One committed register_lww assign through the real manager
+    path; the VAL_BYTES payload is what makes a carried seed heavy."""
+    from antidote_tpu.clocks import VC
+
+    pm = node.partition_of(key)
+    txid = ("dc1", n)
+    val = f"{key}:{tag}:{n}:" + "x" * VAL_BYTES
+    eff = (node.clock.now_us(), ("dc1", n), val)
+    pm.stage_update(txid, key, "register_lww", eff)
+    pm.single_commit(txid, VC({"dc1": node.clock.now_us()}),
+                     certify=False)
+
+
+def _values(node):
+    out = {}
+    for pm in node.partitions:
+        for key in pm.log.keys_seen:
+            out[key] = pm.value_snapshot(key, "register_lww")
+    return out
+
+
+def _persist_leg(tmp, name, keyspace, segmented, rounds):
+    """Build ``keyspace`` committed keys, cut a base checkpoint, then
+    run ``rounds`` of (touch CHURN_KEYS keys -> checkpoint) measuring
+    each cut's wall time.  Returns (median us/dirty-key, final values,
+    data_dir)."""
+    d = os.path.join(tmp, name)
+    node, _cfg = _mk_node(d, keyspace, segmented)
+    n = 0
+    for i in range(keyspace):
+        _commit(node, n, f"k_{i:06d}")
+        n += 1
+    for pm in node.partitions:
+        assert pm.checkpoint_now() is not None  # the base cut
+    walls = []
+    for _r in range(rounds):
+        for i in range(CHURN_KEYS):
+            _commit(node, n, f"k_{i:06d}")
+            n += 1
+        t0 = time.perf_counter()
+        for pm in node.partitions:
+            assert pm.checkpoint_now() is not None
+        walls.append(time.perf_counter() - t0)
+    vals = _values(node)
+    node.close()
+    us_per_key = statistics.median(walls) * 1e6 / CHURN_KEYS
+    return us_per_key, vals, d
+
+
+def _assert_recovery_equivalence(tmp, name, d, segmented, want):
+    """Recovered state must be bit-identical to (a) the full-scan
+    oracle and (b) a recovery under the OPPOSITE knob over the same
+    bytes (loading follows the on-disk document's shape, so the
+    cross-knob pass is the 'monolithic oracle' for segmented legs) —
+    the knob changes cost, never content."""
+    node, _cfg = _mk_node(d, 0, segmented)
+    got = _values(node)
+    node.close()
+    assert got == want, f"{name}: live vs recovered state diverged"
+    cross, _cfg = _mk_node(d, 0, not segmented)
+    got_cross = _values(cross)
+    cross.close()
+    assert got == got_cross, \
+        f"{name}: recovery diverged across the ckpt_segmented knob"
+    oracle_dir = os.path.join(tmp, name + "_oracle")
+    shutil.copytree(d, oracle_dir)
+    from antidote_tpu.oplog.checkpoint import delete_checkpoint_files
+
+    for f in os.listdir(oracle_dir):
+        if f.endswith(".ckpt"):
+            delete_checkpoint_files(os.path.join(oracle_dir, f))
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.node import Node
+
+    oracle = Node(dc_id="dc1", config=Config(
+        device_store=False, n_partitions=1, ckpt=False,
+        data_dir=oracle_dir))
+    got_scan = _values(oracle)
+    oracle.close()
+    assert got == got_scan, \
+        f"{name}: checkpoint recovery diverged from the full scan"
+
+
+def _device_restart_leg(tmp, quick):
+    """Device-store node: commit counters, checkpoint, restart, count
+    checkpoint seeds serving from the DEVICE again; values asserted
+    bit-identical to the host full-scan oracle."""
+    d = os.path.join(tmp, "devleg")
+    node, cfg = _mk_node(d, 0, segmented=True, device=True)
+    n_keys = 16 if quick else 48
+    n = 0
+    for i in range(n_keys):
+        for r in range(4):
+            _commit(node, n, f"dev_{i:03d}", tag=f"r{r}")
+            n += 1
+    for pm in node.partitions:
+        assert pm.checkpoint_now() is not None
+    want = _values(node)
+    node.close()
+
+    t0 = time.perf_counter()
+    re_node, _ = _mk_node(d, 0, segmented=True, device=True)
+    restart_s = time.perf_counter() - t0
+    pm = re_node.partitions[0]
+    resident = sum(
+        1 for i in range(n_keys)
+        if pm.device.owns("register_lww", f"dev_{i:03d}")
+        and f"dev_{i:03d}" not in pm.device.host_only)
+    got = _values(re_node)
+    re_node.close()
+    assert got == want, "device-seeded restart diverged from live"
+    # host oracle: same bytes, full scan, no device store
+    oracle_dir = os.path.join(tmp, "devleg_oracle")
+    shutil.copytree(d, oracle_dir)
+    from antidote_tpu.oplog.checkpoint import delete_checkpoint_files
+
+    for f in os.listdir(oracle_dir):
+        if f.endswith(".ckpt"):
+            delete_checkpoint_files(os.path.join(oracle_dir, f))
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.node import Node
+
+    oracle = Node(dc_id="dc1", config=Config(
+        device_store=False, n_partitions=1, ckpt=False,
+        data_dir=oracle_dir))
+    got_oracle = _values(oracle)
+    oracle.close()
+    assert got == got_oracle, \
+        "device-seeded fold diverged from the host oracle"
+    return 100.0 * resident / n_keys, restart_s
+
+
+def main():
+    import tempfile
+
+    quick, _jax = setup()
+    small = 48
+    big = small * 50
+    rounds = 3 if quick else 5
+    with tempfile.TemporaryDirectory() as tmp:
+        # discarded warm-up leg: first-use costs (imports, allocator
+        # warmup, cold page cache) otherwise land entirely on the
+        # first measured leg and invert the comparison
+        _persist_leg(tmp, "warmup", small, True, 2)
+        # segmented: persist cost must track churn, not keyspace
+        seg_small, vals_s, d_s = _persist_leg(
+            tmp, "seg_small", small, True, rounds)
+        seg_big, vals_b, d_b = _persist_leg(
+            tmp, "seg_big", big, True, rounds)
+        _assert_recovery_equivalence(tmp, "seg_small", d_s, True,
+                                     vals_s)
+        _assert_recovery_equivalence(tmp, "seg_big", d_b, True,
+                                     vals_b)
+        # monolithic baseline, measured in-bench (expected ~50x)
+        mono_small, vals_ms, d_ms = _persist_leg(
+            tmp, "mono_small", small, False, rounds)
+        mono_big, vals_mb, d_mb = _persist_leg(
+            tmp, "mono_big", big, False, rounds)
+        _assert_recovery_equivalence(tmp, "mono_small", d_ms, False,
+                                     vals_ms)
+        _assert_recovery_equivalence(tmp, "mono_big", d_mb, False,
+                                     vals_mb)
+        # the acceptance bound: same churn at 50x keyspace stays
+        # within 1.5x (plus a 200us/key absolute floor for fsync
+        # jitter on shared CI boxes)
+        bound = seg_small * 1.5 + 200.0
+        assert seg_big <= bound, (
+            f"segmented persist at 50x keyspace pays "
+            f"{seg_big:.0f}us/key vs {seg_small:.0f}us/key — "
+            "checkpointing is scaling with keyspace again")
+        resident_pct, restart_s = _device_restart_leg(tmp, quick)
+        assert resident_pct > 0.0, \
+            "no checkpoint seed came back device-resident"
+    emit("ckpt_persist_us_per_dirty_key", round(seg_big, 1), "us/key",
+         round(mono_big / max(seg_big, 1e-9), 2),
+         seg_small_us_per_key=round(seg_small, 1),
+         mono_small_us_per_key=round(mono_small, 1),
+         mono_big_us_per_key=round(mono_big, 1),
+         keyspace_small=small, keyspace_big=big,
+         churn_keys=CHURN_KEYS,
+         seg_growth_x=round(seg_big / max(seg_small, 1e-9), 2),
+         mono_growth_x=round(mono_big / max(mono_small, 1e-9), 2))
+    emit("ckpt_restart_device_resident_pct", round(resident_pct, 1),
+         "resident pct", round(resident_pct / 100.0, 2),
+         restart_s=round(restart_s, 4))
+
+
+if __name__ == "__main__":
+    main()

@@ -616,3 +616,63 @@ def test_wide_txn_2pc_batches_per_owner(tmp_path):
     finally:
         for srv in servers:
             srv.close()
+
+
+def test_truncated_donor_handoff_recovers_full_state(tmp_path):
+    """Checkpoint-shipping handoff (ISSUE 13): the donor's ``.ckpt``
+    manifest + seed segments travel WITH the log bytes, so a receiver
+    adopting a TRUNCATED log recovers the below-cut history from the
+    shipped seeds.  Pre-fix the checkpoint did not travel: the
+    receiver full-scanned a log whose prefix was reclaimed and
+    recovered suffix-only (loudly) — the final read here pins that as
+    the regression (it would see only the post-truncation delta)."""
+    servers = [
+        NodeServer(f"t{i}", data_dir=str(tmp_path / f"t{i}"),
+                   config=_cfg())
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers)
+        api = servers[0].api
+        keys = [3, 11, 19]  # partition 3
+        cvc = None
+        for round_ in range(10):
+            tx = api.start_transaction(clock=cvc)
+            api.update_objects(
+                [((k, "counter_pn", "b"), "increment", 1)
+                 for k in keys], tx)
+            cvc = api.commit_transaction(tx)
+
+        donor = next(s for s in servers
+                     if isinstance(s.node.partitions[3],
+                                   PartitionManager))
+        pm = donor.node.partitions[3]
+        assert pm.checkpoint_now() is not None
+        assert pm.log.log.truncated_base > 0, \
+            "the donor's below-cut bytes must really be reclaimed"
+        # the post-truncation delta the pre-fix receiver was LIMITED to
+        tx = api.start_transaction(clock=cvc)
+        api.update_objects([((3, "counter_pn", "b"), "increment", 1)],
+                           tx)
+        cvc = api.commit_transaction(tx)
+
+        receiver = next(s for s in servers if s is not donor)
+        new_ring = dict(servers[0].node.ring)
+        new_ring[3] = receiver.node_id
+        servers[0].rebalance(new_ring)
+
+        pm2 = receiver.node.partitions[3]
+        assert isinstance(pm2, PartitionManager)
+        # the shipped checkpoint engaged: recovery was seeded, not a
+        # full scan of a reclaimed-prefix log
+        assert pm2.log.suffix_start > 0, \
+            "receiver did not adopt the shipped checkpoint"
+        tx = receiver.api.start_transaction(clock=cvc)
+        vals = receiver.api.read_objects(
+            [(k, "counter_pn", "b") for k in keys], tx)
+        receiver.api.commit_transaction(tx)
+        assert vals == [11, 10, 10], \
+            f"below-cut history lost across the handoff: {vals}"
+    finally:
+        for srv in servers:
+            srv.close()

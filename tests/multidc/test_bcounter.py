@@ -130,3 +130,69 @@ class TestPermissionTransfer:
         # a different requester is unaffected
         assert mgr.handle_remote_request(
             "dc3", ("bc_grace", "bkt", 2, "dc3")) is True
+
+
+class TestCheckpointSeededRecovery:
+    """ISSUE 13 satellite: bounded-counter PERMISSION state must
+    survive a checkpoint-seeded restart — rights live in the
+    counter_b CRDT state, and a recovery that lost the below-cut
+    history would grant from (or refuse on) a phantom rights table.
+    The leg is seed → restart → cross-DC transfer succeeds."""
+
+    def test_transfer_succeeds_after_seeded_restart(self, tmp_path):
+        import pytest as _pytest
+
+        from antidote_tpu.config import Config
+        from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+        from antidote_tpu.interdc.transport import InProcBus
+
+        bus = InProcBus()
+        kw = dict(n_partitions=2, device_store=False, ckpt=True,
+                  ckpt_truncate=True, ckpt_retain_ops=0,
+                  heartbeat_s=0.02, clock_wait_timeout_s=10.0)
+        dcs = [DataCenter(f"dc{i + 1}", bus, config=Config(**kw),
+                          data_dir=str(tmp_path / f"dc{i + 1}"))
+               for i in range(2)]
+        connect_dcs(dcs)
+        for dc in dcs:
+            dc.start_bg_processes()
+        try:
+            dc1, dc2 = dcs
+            bound = ("bc_seeded", "counter_b", "bkt")
+            ct = incr(dc1, 10, bound=bound)  # rights minted at dc1
+            wait_value(dc2, ct, 10, bound)
+            # cut + truncate: the rights history now lives ONLY in
+            # dc1's checkpoint seeds
+            for pm in dc1.node.partitions:
+                assert pm.checkpoint_now() is not None
+            assert any(pm.log.log.truncated_base > 0
+                       for pm in dc1.node.partitions), \
+                "the increment history was not truncated"
+            dcs[0].close()
+            dc1b = DataCenter("dc1", bus, config=Config(**kw),
+                              data_dir=str(tmp_path / "dc1"))
+            dcs[0] = dc1b
+            dc1b.start_bg_processes()
+            # the restarted holder still sees its rights
+            assert value(dc1b, ct, bound) == 10
+
+            # dc2 has no local rights: the decrement aborts, queues a
+            # transfer request, and the RESTARTED dc1 must grant from
+            # its seeded permission state
+            with _pytest.raises(TransactionAborted,
+                                match="no_permissions"):
+                decr(dc2, 6, clock=ct, bound=bound)
+            deadline = time.monotonic() + 10.0
+            ct2 = None
+            while ct2 is None:
+                try:
+                    ct2 = decr(dc2, 6, clock=ct, bound=bound)
+                except TransactionAborted:
+                    assert time.monotonic() < deadline, \
+                        "transfer never arrived from the restarted dc1"
+                    time.sleep(0.05)
+            for dc in dcs:
+                wait_value(dc, ct2, 4, bound)
+        finally:
+            for dc in dcs:
+                dc.close()

@@ -278,9 +278,44 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # native fabric family (ISSUE 12): p99 per-hop cost under the
     # busy GIL and python-side publish copies per frame must not rise
     ("us/hop", -1), ("copies/frame", -1),
+    # segmented checkpoints (ISSUE 13): persist cost per dirty key
+    # must not rise (keyspace-proportional again), device-resident
+    # restart fraction must not fall (host-path pinning again)
+    ("us/key", -1), ("resident pct", 1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_ckptseg_plane_regression(tmp_path, capsys):
+    """ISSUE 13 synthetic two-round trajectory: round 2's persist
+    cost per dirty key balloons (the cut re-serializing the keyspace
+    again) and the restart's device-resident fraction collapses
+    (seeds pinning host-path) — both directions must fail."""
+    old = {"schema_version": 1, "round": 1, "dry_run": False,
+           "metrics": {
+               "ckpt_persist_us_per_dirty_key": {"value": 500.0,
+                                                 "unit": "us/key"},
+               "ckpt_restart_device_resident_pct": {
+                   "value": 95.0, "unit": "resident pct"}},
+           "failures": {}}
+    new = {"schema_version": 1, "round": 2, "dry_run": False,
+           "metrics": {
+               "ckpt_persist_us_per_dirty_key": {"value": 24000.0,
+                                                 "unit": "us/key"},
+               "ckpt_restart_device_resident_pct": {
+                   "value": 2.0, "unit": "resident pct"}},
+           "failures": {}}
+    import json
+
+    op, np_ = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    op.write_text(json.dumps(old))
+    np_.write_text(json.dumps(new))
+    rc = bench_gate.main([str(op), str(np_)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "ckpt_persist_us_per_dirty_key" in err
+    assert "ckpt_restart_device_resident_pct" in err
 
 
 def test_gate_fails_on_fabric_plane_regression(tmp_path, capsys):

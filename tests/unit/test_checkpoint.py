@@ -409,9 +409,10 @@ def test_retention_floor_limits_truncation(tmp_path):
 def test_device_plane_checkpoint_recovery(tmp_path):
     """With the device store ON, checkpoint_now folds device-resident
     keys through the batched per-type fold; after a restart the seeds
-    serve from the host path (the plane cannot ingest a folded base),
-    the suffix replays on top, and every value matches the pre-restart
-    read — including fresh commits landing after recovery."""
+    re-install as DEVICE-resident bases (ISSUE 13 — the plane ingests
+    the folded state back as rows and folds it into the base at the
+    seed frontier), the suffix replays on top, and every value matches
+    the pre-restart read — including fresh commits after recovery."""
     cfg = _mk_cfg(tmp_path, device_store=True, ckpt=True,
                   ckpt_truncate=True, n_partitions=1)
     node = Node(dc_id="dc1", config=cfg)
@@ -431,10 +432,16 @@ def test_device_plane_checkpoint_recovery(tmp_path):
     pm2 = re.partitions[0]
     assert pm2.log.suffix_start > 0
     assert _all_values(re) == want
-    # seeded keys stay host-path (host_only) — and keep working for
-    # NEW commits after the restart
-    for k in dev_keys:
-        assert k in pm2.device.host_only
+    # seeded keys of ingestable types serve from the DEVICE again —
+    # the restarted node re-earned its device economy (pre-ISSUE-13
+    # they pinned host_only forever) — and keep working for NEW
+    # commits after the restart
+    tn_of = {k: doc["keys"][k][0] for k in dev_keys}
+    back = [k for k in dev_keys
+            if pm2.device.owns(tn_of[k], k)
+            and k not in pm2.device.host_only]
+    assert back == dev_keys, \
+        f"seeded keys stuck host-path: {set(dev_keys) - set(back)}"
     before = pm2.value_snapshot("ctr_0", "counter_pn")
     _commit(re, 777777, [("ctr_0", "counter_pn", 5)])
     assert pm2.value_snapshot("ctr_0", "counter_pn") == before + 5

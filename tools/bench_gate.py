@@ -60,8 +60,11 @@ _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 #: plane's amortization (ISSUE 9): records made durable per fsync
 #: sliding toward the per-commit record count means the commit path
 #: has regressed to one fsync per transaction.
+#: "resident pct" (ISSUE 13): previously device-resident keys serving
+#: from the device again after a checkpoint-seeded restart — sliding
+#: DOWN means restarts are pinning keys host-path again
 _HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch", "/frame",
-                           "hit pct", "/fsync")
+                           "hit pct", "/fsync", "resident pct")
 #: units whose value should not RISE (smaller is better).  The
 #: "*/txn" per-admitted-cost units (H2D bytes per txn, dispatches per
 #: txn, and ISSUE 6's encoded wire bytes per shipped txn) are the
@@ -90,7 +93,11 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  # re-entering the interpreter; python-side publish
                  # copies per frame rising means the staged fan-out
                  # regressed toward per-subscriber re-framing
-                 "us/hop", "copies/frame"}
+                 "us/hop", "copies/frame",
+                 # segmented checkpoints (ISSUE 13): persist cost per
+                 # dirty key rising means checkpointing is scaling
+                 # with keyspace again instead of churn
+                 "us/key"}
 
 
 def repo_root() -> str:
