@@ -43,6 +43,7 @@ from antidote_tpu.config import Config
 from antidote_tpu.meta.gossip import StableTimeTracker
 from antidote_tpu.meta.sender import MetaDataSender
 from antidote_tpu.meta.stable_store import StableMetaData
+from antidote_tpu.oplog.log import _fsync_dir
 from antidote_tpu.txn.manager import PartitionManager, PartitionRetired
 from antidote_tpu.txn.node import Node
 
@@ -1103,6 +1104,11 @@ class NodeServer:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(staged, self.node._log_path(p))
+            # pin the promotion rename before adopting: the bundle
+            # install below also dir-fsyncs, but only when the donor
+            # shipped one — the log publish must not depend on that
+            _fsync_dir(os.path.dirname(self.node._log_path(p)),
+                       instant="handoff_install_fsync")
             # a stale LOCAL checkpoint (from a previous ownership of
             # this slot) describes a different log's layout — retire
             # it (segments included) and install the donor's shipped
@@ -1309,6 +1315,11 @@ class NodeServer:
                 pm.retired = True
             pm.log.close()
             if os.path.exists(pm.log.path):
+                # dur-ok: retire rename of an already-closed log — no
+                # temp bytes to pin (the inode's content is unchanged)
+                # and a rename lost to a power cut only re-surfaces
+                # the .handedoff copy at the old path, which restart
+                # resolution re-retires from the persisted plan
                 os.replace(pm.log.path, pm.log.path + ".handedoff")
         self._handoff[p] = {"state": "retired", "new_owner": new_owner}
 
@@ -1784,6 +1795,9 @@ class NodeServer:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, journal)
+            # pin the journal rename before acting on it (ISSUE 15 —
+            # the single-node resize paths carry the same discipline)
+            _fsync_dir(node.data_dir, instant="resize_journal_fsync")
             # the new plan persists BEFORE the swap clears the
             # journal: at every crash point either the journal or the
             # persisted plan carries the new width (restart reconciles

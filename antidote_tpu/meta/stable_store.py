@@ -13,6 +13,8 @@ import pickle
 import threading
 from typing import Any, Dict, Optional
 
+from antidote_tpu.oplog.log import _fsync_dir
+
 
 class StableMetaData:
     def __init__(self, path: Optional[str], recover: bool = True):
@@ -32,6 +34,9 @@ class StableMetaData:
     def put(self, key, value) -> None:
         with self._lock:
             self._kv[key] = value
+            # lock-ok: persist-under-lock is this store's design — a
+            # tiny KV on the 1 s gossip cadence, and the lock is what
+            # keeps each on-disk snapshot a consistent cut
             self._persist()
 
     def merge_update(self, key, value, merge) -> None:
@@ -39,11 +44,15 @@ class StableMetaData:
         broadcast_meta_data_merge, src/stable_meta_data_server.erl:180-190)."""
         with self._lock:
             self._kv[key] = merge(self._kv.get(key), value)
+            # lock-ok: same audit as put — consistent-cut persist on
+            # the gossip cadence
             self._persist()
 
     def delete(self, key) -> None:
         with self._lock:
             self._kv.pop(key, None)
+            # lock-ok: same audit as put — consistent-cut persist on
+            # the gossip cadence
             self._persist()
 
     def keys(self):
@@ -59,9 +68,20 @@ class StableMetaData:
             # and writes ride the 1 s gossip cadence; persisting under
             # the lock is what keeps the file a consistent snapshot
             pickle.dump(self._kv, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            # lock-ok: same audit — without the fsync the rename
+            # below publishes page-cache bytes, and a power cut
+            # could lose the has_started flag an acked restart
+            # contract depends on (the ISSUE-15 sweep found this
+            # write was never pinned at all)
+            os.fsync(f.fileno())
         # lock-ok: same audit — an atomic rename of a tiny file on the
         # gossip cadence, ordered with the update it persists
         os.replace(tmp, self.path)
+        # lock-ok: same audit — the directory fsync pins the rename
+        # (a lost rename re-reads the previous consistent KV, but the
+        # durable-publish protocol is one discipline, not a menu)
+        _fsync_dir(os.path.dirname(self.path), instant="meta_dir_fsync")
 
     # ------------------------------------------------- well-known entries
 

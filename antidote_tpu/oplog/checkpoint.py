@@ -176,6 +176,13 @@ def install_shipped_bundle(ckpt_path: str,
     settings are irrelevant to an install — only the paths are used,
     and the adopting partition re-reads the files through its own
     config-routed store."""
+    # dur-ok: deliberately unlink-BEFORE-commit — the stale local
+    # checkpoint describes a DIFFERENT log's layout and must not
+    # survive even a crash before the shipped bundle's manifest
+    # rename lands: recovery over the transferred log with no
+    # checkpoint falls back to the full scan (degraded cost), while
+    # adopting the stale one would seed wrong state (the PR-12
+    # stale-adoption bug this function exists to prevent)
     delete_checkpoint_files(ckpt_path)
     if bundle:
         CheckpointStore(ckpt_path,

@@ -20,6 +20,7 @@ from typing import Any, Callable, List, Optional, Tuple
 from antidote_tpu.clocks import VC
 from antidote_tpu.config import Config
 from antidote_tpu.hooks import HookRegistry
+from antidote_tpu.oplog.log import _fsync_dir
 from antidote_tpu.oplog.partition import PartitionLog
 from antidote_tpu.oplog.records import commit_certified
 from antidote_tpu.txn.clock import HybridClock
@@ -429,6 +430,9 @@ class Node:
                         for p in range(new_n)]
         for path in resize_paths:
             if os.path.exists(path):
+                # dur-ok: stale strays from a resize attempt that died
+                # before its journal landed — garbage with no
+                # successor, not files this run's commit supersedes
                 os.remove(path)
         new_logs = [
             PartitionLog(path, partition=p, sync_on_commit=False,
@@ -466,6 +470,11 @@ class Node:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, journal)
+        # the journal IS the commit point of the whole swap: pin its
+        # rename before acting on it (ISSUE 15 — a resurrected
+        # pre-journal dir after a power cut would boot the old width
+        # over already-swapped logs)
+        _fsync_dir(self.data_dir, instant="resize_journal_fsync")
         self._complete_resize_swap(old_n, new_n)
 
         # 4. rebuild partitions + materializer via standard recovery
@@ -585,6 +594,9 @@ class Node:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, journal)
+            # pin the journal rename before acting on it (ISSUE 15 —
+            # same discipline as the quiesced repartition above)
+            _fsync_dir(self.data_dir, instant="resize_journal_fsync")
             self._complete_resize_swap(old_n, new_n)
             self.config.n_partitions = new_n
             self.partitions = [self._build_partition(p)
@@ -606,6 +618,13 @@ class Node:
             staged = live + ".resize"
             if not os.path.exists(staged):
                 continue  # this slot's swap already completed
+            # the staged fold never fsynced per commit (it is garbage
+            # until the journal lands); pin its bytes BEFORE the
+            # rename publishes them — without this, a power cut after
+            # the swap could install a page-cache-torn log whose
+            # recovery silently truncates at the seam (ISSUE 15)
+            with open(staged, "rb") as f:
+                os.fsync(f.fileno())
             if os.path.exists(live):
                 os.replace(live, live + ".pre-resize")
             os.replace(staged, live)
@@ -613,6 +632,11 @@ class Node:
             live = self._log_path(p)
             if os.path.exists(live):
                 os.replace(live, live + ".pre-resize")
+        # the swap's renames must be durable BEFORE the journal
+        # clears: unordered metadata could persist the journal unlink
+        # but lose the renames — a boot with no journal over
+        # half-swapped logs
+        _fsync_dir(self.data_dir, instant="resize_swap_fsync")
         # stale checkpoints must not survive the swap: a doc captured
         # against the pre-resize layout would otherwise be adopted by
         # the re-cut log (its cut is just a byte offset) and recovery

@@ -1,0 +1,489 @@
+"""tier-1 hook for tools/durability_lint.py — the durability-protocol
+discipline three review rounds (PRs 9, 10, 12) each re-derived by hand
+(temp+fsync+rename+dir-fsync publishes, unlink only after the commit
+point, immutable segments, loud recovery, torn-frame pairing) encoded
+as a static pass (ISSUE 15).  Fixture tests prove each rule family
+actually fires — including the three historical review-round bugs as
+regressions — and the clean-repo run proves the current tree satisfies
+them."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "tools"))
+import durability_lint  # noqa: E402
+
+
+def _write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+
+
+def _lint(root, tag):
+    return [p for p in durability_lint.lint(str(root))
+            if f"[{tag}]" in p]
+
+
+# ------------------------------------------------------- repo is clean
+
+def test_repo_is_clean():
+    problems = durability_lint.lint(durability_lint.repo_root())
+    assert not problems, "\n".join(problems)
+
+
+def test_standalone_main_exit_code():
+    assert durability_lint.main([]) == 0
+
+
+# ------------------------------------------- rule 1: atomic-publish
+
+def test_rename_without_fsync_fires(tmp_path):
+    """A rename that publishes bytes never fsynced can publish page
+    cache — an acked commit gone on power cut; the full protocol
+    passes."""
+    _write(tmp_path, "antidote_tpu/oplog/newstore.py",
+           "import os\n"
+           "def bad_publish(doc, path):\n"
+           "    tmp = path + '.tmp'\n"
+           "    with open(tmp, 'wb') as f:\n"
+           "        f.write(doc)\n"
+           "    os.replace(tmp, path)\n"
+           "    _fsync_dir(os.path.dirname(path))\n"
+           "def _fsync_dir(d):\n"
+           "    fd = os.open(d, os.O_RDONLY)\n"
+           "    os.fsync(fd)\n"
+           "    os.close(fd)\n")
+    problems = _lint(tmp_path, "atomic-publish")
+    # the unsynced temp write is ALSO its own finding (same family)
+    assert any("newstore.py:6" in p and "never fsynced" in p
+               for p in problems)
+
+
+def test_full_publish_protocol_is_clean(tmp_path):
+    _write(tmp_path, "antidote_tpu/oplog/newstore.py",
+           "import os\n"
+           "def good_publish(doc, path):\n"
+           "    tmp = path + '.tmp'\n"
+           "    with open(tmp, 'wb') as f:\n"
+           "        f.write(doc)\n"
+           "        f.flush()\n"
+           "        os.fsync(f.fileno())\n"
+           "    os.replace(tmp, path)\n"
+           "    _fsync_dir(os.path.dirname(path))\n"
+           "def _fsync_dir(d):\n"
+           "    fd = os.open(d, os.O_RDONLY)\n"
+           "    os.fsync(fd)\n"
+           "    os.close(fd)\n")
+    assert _lint(tmp_path, "atomic-publish") == []
+
+
+def test_regression_truncation_rename_missing_dir_fsync(tmp_path):
+    """Historical review-round bug #1 (PR 9/10): the truncation
+    commit renamed the rewritten log but never fsynced the directory —
+    a power cut could resurrect the pre-rename inode whose tail was
+    never fsynced (an acked commit gone on recovery).  The shape that
+    shipped, reduced: fsync of the temp present, directory fsync
+    absent."""
+    _write(tmp_path, "antidote_tpu/oplog/newlog.py",
+           "import os\n"
+           "class L:\n"
+           "    def commit_truncate(self, tmp):\n"
+           "        with open(tmp, 'r+b') as f:\n"
+           "            f.flush()\n"
+           "            os.fsync(f.fileno())\n"
+           "        os.replace(tmp, self.path)\n"
+           "        self._reopen()\n"
+           "    def _reopen(self):\n"
+           "        pass\n")
+    problems = _lint(tmp_path, "atomic-publish")
+    assert len(problems) == 1
+    assert "newlog.py:7" in problems[0]
+    assert "directory fsync" in problems[0]
+
+
+def test_fsync_through_call_graph_path_satisfies(tmp_path):
+    """The protocol propagates like every call-graph fact: a helper
+    that fsyncs covers its caller's publish path, and a helper that
+    does NOT leaves the rename exposed."""
+    _write(tmp_path, "antidote_tpu/oplog/newstore.py",
+           "import os\n"
+           "class S:\n"
+           "    def publish(self, doc, path):\n"
+           "        self._write_temp(doc, path + '.tmp')\n"
+           "        os.replace(path + '.tmp', path)\n"
+           "        self._pin_dir(path)\n"
+           "    def _write_temp(self, doc, tmp):\n"
+           "        with open(tmp, 'wb') as f:\n"
+           "            f.write(doc)\n"
+           "            os.fsync(f.fileno())\n"
+           "    def _pin_dir(self, path):\n"
+           "        _fsync_dir(os.path.dirname(path))\n"
+           "def _fsync_dir(d):\n"
+           "    fd = os.open(d, os.O_RDONLY)\n"
+           "    os.fsync(fd)\n"
+           "    os.close(fd)\n")
+    assert _lint(tmp_path, "atomic-publish") == []
+
+
+def test_call_cycle_does_not_mask_reachable_fsync(tmp_path):
+    """Cycle-cut memo regression (found in review): with a call cycle
+    a -> b -> c -> a where c fsyncs, visiting c FIRST must not poison
+    the memo with b's cycle-truncated (empty) fact set — a's rename
+    reaches the fsync acyclically and must not be flagged.  Missing
+    facts INVENT findings in this lint's polarity, so cut-tainted
+    results are never memoized."""
+    _write(tmp_path, "antidote_tpu/oplog/newcycle.py",
+           "import os\n"
+           "def c(path):\n"           # scanned first: its DFS is the
+           "    a(path)\n"            # one that cuts the back edge
+           "    os.fsync(0)\n"
+           "def b(path):\n"
+           "    c(path)\n"
+           "def a(path):\n"
+           "    with open(path + '.tmp', 'wb') as f:\n"
+           "        f.write(b'x')\n"
+           "    b(path)\n"
+           "    os.replace(path + '.tmp', path)\n")
+    problems = _lint(tmp_path, "atomic-publish")
+    # the ONLY legitimate finding is the missing directory fsync;
+    # neither 'never fsynced' form may fire — b -> c reaches one
+    assert len(problems) == 1, "\n".join(problems)
+    assert "directory fsync" in problems[0]
+
+
+def test_durable_write_never_fsynced_fires(tmp_path):
+    """A durable-module write with no fsync anywhere on its path is a
+    promise the disk does not keep — even without a rename."""
+    _write(tmp_path, "antidote_tpu/oplog/newseg.py",
+           "def write_segment(entries, path):\n"
+           "    with open(path, 'wb') as f:\n"
+           "        f.write(entries)\n")
+    problems = _lint(tmp_path, "atomic-publish")
+    assert len(problems) == 1
+    assert "never fsynced" in problems[0]
+
+
+def test_dur_ok_with_reason_suppresses_and_bare_is_finding(tmp_path):
+    """`# dur-ok: <reason>` audits a deviation; a bare `# dur-ok`
+    defeats the audit trail — itself a finding AND no suppression."""
+    _write(tmp_path, "antidote_tpu/oplog/newstore.py",
+           "import os\n"
+           "def audited(doc, path):\n"
+           "    # dur-ok: test-only scratch file, not a durable artifact\n"
+           "    os.replace(path + '.tmp', path)\n"
+           "def bare(doc, path):\n"
+           "    os.replace(path + '.tmp', path)  # dur-ok\n")
+    publish = _lint(tmp_path, "atomic-publish")
+    assert len(publish) == 2  # bare() stays flagged, both sub-rules
+    assert all("bare" in p for p in publish)
+    reasons = _lint(tmp_path, "dur-ok-reason")
+    assert len(reasons) == 1
+    assert "newstore.py:6" in reasons[0]
+
+
+# -------------------------------------------- rule 2: commit-point
+
+def test_regression_compaction_unlink_before_manifest(tmp_path):
+    """Historical review-round bug #2 (PR 12): compaction unlinked the
+    superseded segments BEFORE the new manifest's rename landed — a
+    crash between them loses both the old segments and the commit
+    (the old manifest stays authoritative over files that no longer
+    exist).  Reduced to its shape: remove, then replace."""
+    _write(tmp_path, "antidote_tpu/oplog/newckpt.py",
+           "import os\n"
+           "class C:\n"
+           "    def compact(self, old_segs, tmp):\n"
+           "        for s in old_segs:\n"
+           "            os.remove(s)\n"
+           "        os.fsync(0)\n"
+           "        os.replace(tmp, self.path)\n"
+           "        _fsync_dir('.')\n"
+           "def _fsync_dir(d):\n"
+           "    os.fsync(os.open(d, os.O_RDONLY))\n")
+    problems = _lint(tmp_path, "commit-point")
+    assert len(problems) == 1
+    assert "newckpt.py:5" in problems[0]
+    assert "BEFORE" in problems[0]
+
+
+def test_unlink_after_commit_is_clean(tmp_path):
+    _write(tmp_path, "antidote_tpu/oplog/newckpt.py",
+           "import os\n"
+           "class C:\n"
+           "    def compact(self, old_segs, tmp):\n"
+           "        os.fsync(0)\n"
+           "        os.replace(tmp, self.path)\n"
+           "        _fsync_dir('.')\n"
+           "        for s in old_segs:\n"
+           "            os.remove(s)\n"
+           "def _fsync_dir(d):\n"
+           "    os.fsync(os.open(d, os.O_RDONLY))\n")
+    assert _lint(tmp_path, "commit-point") == []
+
+
+def test_declared_deleter_before_commit_primitive_fires(tmp_path):
+    """The repo's wholesale deleters (delete_checkpoint_files,
+    _sweep_segments) and commit primitives (write_doc, ...) count as
+    events too — the install_shipped_bundle shape is visible without
+    resolving either call."""
+    _write(tmp_path, "antidote_tpu/oplog/newinstall.py",
+           "import os\n"
+           "def adopt(store, bundle, path):\n"
+           "    delete_checkpoint_files(path)\n"
+           "    store.write_doc(bundle)\n")
+    problems = _lint(tmp_path, "commit-point")
+    assert len(problems) == 1
+    assert "delete_checkpoint_files" in problems[0]
+
+
+def test_cleanup_only_function_is_exempt(tmp_path):
+    """Unlinks in a function with NO commit point are retirement/
+    cleanup paths (delete_checkpoint_files itself, abort paths, stray
+    sweeps) — the rule orders unlinks against commits, it does not
+    ban deletion."""
+    _write(tmp_path, "antidote_tpu/oplog/newclean.py",
+           "import os\n"
+           "def retire(paths):\n"
+           "    for p in paths:\n"
+           "        os.remove(p)\n")
+    assert _lint(tmp_path, "commit-point") == []
+
+
+# ------------------------------------------ rule 3: immutable-file
+
+def test_regression_stale_checkpoint_adoption_shape(tmp_path):
+    """Historical review-round bug #3 (PR 12): a ring-resize rewrote
+    the log under a surviving checkpoint, and the next segmented cut
+    stacked fresh deltas onto pre-resize seed files — rewritten bytes
+    under a manifest that believed them immutable, adopted as seed
+    state.  The immutable-file rule catches the write half: nobody
+    outside the blessed creation module opens a `.seg-` file for
+    write/append/update."""
+    _write(tmp_path, "antidote_tpu/txn/newresize.py",
+           "def patch_seed(self, seq, delta):\n"
+           "    with open(self.path + '.seg-%08d' % seq, 'r+b') as f:\n"
+           "        f.write(delta)\n")
+    problems = _lint(tmp_path, "immutable-file")
+    assert len(problems) == 1
+    assert "newresize.py:2" in problems[0]
+    assert ".seg-" in problems[0]
+
+
+def test_blessed_module_may_create_segments(tmp_path):
+    """The blessed creation module writes segments by design — and the
+    path-constant scan sees through a local assignment to a path-
+    constructor helper (the _seg_path idiom)."""
+    _write(tmp_path, "antidote_tpu/oplog/checkpoint.py",
+           "import os\n"
+           "class CheckpointStore:\n"
+           "    def _seg_path(self, seq):\n"
+           "        return f'{self.path}.seg-{seq:08d}'\n"
+           "    def _write_segment(self, entries, seq):\n"
+           "        path = self._seg_path(seq)\n"
+           "        with open(path, 'wb') as f:\n"
+           "            f.write(entries)\n"
+           "            os.fsync(f.fileno())\n")
+    assert _lint(tmp_path, "immutable-file") == []
+    # the SAME shape outside the blessed module fires
+    _write(tmp_path, "antidote_tpu/mat/rogue.py",
+           "import os\n"
+           "class R:\n"
+           "    def _seg_path(self, seq):\n"
+           "        return f'{self.path}.seg-{seq:08d}'\n"
+           "    def clobber(self, seq):\n"
+           "        path = self._seg_path(seq)\n"
+           "        with open(path, 'wb') as f:\n"
+           "            f.write(b'x')\n")
+    problems = _lint(tmp_path, "immutable-file")
+    assert len(problems) == 1
+    assert "rogue.py" in problems[0]
+
+
+def test_retired_log_classes_have_no_writers(tmp_path):
+    """.handedoff / .pre-resize logs are created only by rename —
+    opening one for append anywhere is a finding."""
+    _write(tmp_path, "antidote_tpu/cluster/newhand.py",
+           "def touch_up(path):\n"
+           "    with open(path + '.handedoff', 'ab') as f:\n"
+           "        f.write(b'oops')\n")
+    problems = _lint(tmp_path, "immutable-file")
+    assert len(problems) == 1
+    assert "created only by rename" in problems[0]
+
+
+def test_reading_immutable_files_is_fine(tmp_path):
+    _write(tmp_path, "antidote_tpu/cluster/newship.py",
+           "def ship(path):\n"
+           "    with open(path + '.seg-00000001', 'rb') as f:\n"
+           "        return f.read()\n")
+    assert _lint(tmp_path, "immutable-file") == []
+
+
+# ----------------------------------------- rule 4: loud-recovery
+
+def test_silent_swallow_over_parse_fires(tmp_path):
+    """A silent `except: pass` over durable-state parsing recovers a
+    half-truth as if it were everything — the exact shape the
+    torn-at-every-byte loaders exist to refuse."""
+    _write(tmp_path, "antidote_tpu/oplog/newload.py",
+           "import pickle\n"
+           "def load(raw):\n"
+           "    doc = {}\n"
+           "    try:\n"
+           "        doc = pickle.loads(raw)\n"
+           "    except Exception:\n"
+           "        pass\n"
+           "    return doc\n")
+    problems = _lint(tmp_path, "loud-recovery")
+    assert len(problems) == 1
+    assert "newload.py:6" in problems[0]
+
+
+def test_documented_refusals_are_loud(tmp_path):
+    """return-None refusals, raises, and logged degradations are the
+    documented contracts — all pass."""
+    _write(tmp_path, "antidote_tpu/oplog/newload.py",
+           "import logging\n"
+           "import pickle\n"
+           "log = logging.getLogger(__name__)\n"
+           "def load_none(raw):\n"
+           "    try:\n"
+           "        return pickle.loads(raw)\n"
+           "    except Exception:\n"
+           "        return None\n"
+           "def load_raise(raw):\n"
+           "    try:\n"
+           "        return pickle.loads(raw)\n"
+           "    except Exception as e:\n"
+           "        raise OSError(f'torn: {e}')\n"
+           "def load_logged(raw, out):\n"
+           "    try:\n"
+           "        out.append(pickle.loads(raw))\n"
+           "    except Exception:\n"
+           "        log.error('torn frame skipped')\n")
+    assert _lint(tmp_path, "loud-recovery") == []
+
+
+def test_cleanup_handlers_are_exempt(tmp_path):
+    """Best-effort cleanup (`os.remove` under `except OSError: pass`)
+    is not durable-state parsing — the rule keys off what the try
+    block READS."""
+    _write(tmp_path, "antidote_tpu/oplog/newclean.py",
+           "import os\n"
+           "def sweep(paths):\n"
+           "    for p in paths:\n"
+           "        try:\n"
+           "            os.remove(p)\n"
+           "        except OSError:\n"
+           "            pass\n")
+    assert _lint(tmp_path, "loud-recovery") == []
+
+
+def test_recovery_sweep_is_scoped(tmp_path):
+    """The loud-recovery sweep covers the declared recovery modules,
+    not every swallow in the package (a best-effort stats path outside
+    them is a different discipline's problem)."""
+    _write(tmp_path, "antidote_tpu/obs/newdump.py",
+           "import pickle\n"
+           "def maybe(raw):\n"
+           "    try:\n"
+           "        return pickle.loads(raw)\n"
+           "    except Exception:\n"
+           "        pass\n")
+    assert _lint(tmp_path, "loud-recovery") == []
+
+
+# ------------------------------------------- rule 5: torn-frame
+
+def test_unregistered_magic_fires(tmp_path):
+    """The registry is the contract: a framed-format magic shipped
+    without a _FRAMED_FORMATS entry means nobody paired it with a
+    loader and an every-byte-torn test."""
+    _write(tmp_path, "antidote_tpu/oplog/newframe.py",
+           "_NEW_MAGIC = b'ATPNEWF1'\n"
+           "def write_frame(body):\n"
+           "    return _NEW_MAGIC + body\n")
+    problems = _lint(tmp_path, "torn-frame")
+    assert len(problems) == 1
+    assert "_NEW_MAGIC" in problems[0]
+    assert "not registered" in problems[0]
+
+
+def test_registry_detects_rotted_hook():
+    """A registered torn-test hook that no longer exists in the test
+    file is drift the rule reports — the real repo's registry is
+    validated (clean) by test_repo_is_clean; here the contract is
+    broken on purpose."""
+    key = ("antidote_tpu/oplog/log.py", "_TRUNC_MAGIC")
+    saved = dict(durability_lint._FRAMED_FORMATS[key])
+    durability_lint._FRAMED_FORMATS[key]["torn_hook"] = \
+        "test_that_does_not_exist_anywhere"
+    try:
+        problems = [p for p in durability_lint.lint(
+            durability_lint.repo_root()) if "[torn-frame]" in p]
+        assert len(problems) == 1
+        assert "no longer exercised" in problems[0] \
+            or "not found" in problems[0]
+    finally:
+        durability_lint._FRAMED_FORMATS[key] = saved
+
+
+def test_magic_scan_is_scoped_to_durable_modules(tmp_path):
+    """Wire-format magics outside the durable-write modules (interdc
+    frames live in RAM and sockets, not on disk) are not this rule's
+    business."""
+    _write(tmp_path, "antidote_tpu/interdc/newwire.py",
+           "_WIRE_MAGIC = b'ATPWIRE1'\n")
+    assert _lint(tmp_path, "torn-frame") == []
+
+
+# --------------------------------------------------- tag inventory
+
+def test_all_fixture_rules_are_tagged():
+    """Every fixture above keys off a [tag] the module actually
+    emits — guard the tag names against drift."""
+    src = open(durability_lint.__file__).read()
+    for tag in ("atomic-publish", "commit-point", "immutable-file",
+                "loud-recovery", "torn-frame", "dur-ok-reason"):
+        assert f"[{tag}]" in src
+
+
+# --------------------------------------- the flagship fixes stay fixed
+
+def test_stable_meta_persist_carries_full_protocol():
+    """The ISSUE-15 sweep's flagship find: the stable-meta KV (which
+    carries has_started, DC descriptors, the cluster plan) was
+    published by bare rename — never fsynced at all.  Pin the fixed
+    shape: fsync before the rename, directory fsync after."""
+    root = durability_lint.repo_root()
+    src = open(os.path.join(root, "antidote_tpu", "meta",
+                            "stable_store.py")).read()
+    body = src.split("def _persist", 1)[1].split("def ", 1)[0]
+    assert "os.fsync" in body, "the temp fsync disappeared?"
+    assert "_fsync_dir" in body, "the directory fsync disappeared?"
+    assert body.index("os.fsync") < body.index("os.replace") \
+        < body.index("_fsync_dir"), "protocol order broke"
+
+
+def test_resize_swap_pins_staged_bytes():
+    """The resize swap's other sweep find: staged .resize logs were
+    never fsynced before the journaled swap published them — a power
+    cut after the swap could install a page-cache-torn log.  The fix
+    fsyncs each staged file before its rename and the directory
+    before the journal clears."""
+    root = durability_lint.repo_root()
+    src = open(os.path.join(root, "antidote_tpu", "txn",
+                            "node.py")).read()
+    body = src.split("def _complete_resize_swap", 1)[1] \
+        .split("\n    def ", 1)[0]
+    assert "os.fsync" in body
+    assert "_fsync_dir" in body
+    assert body.index("_fsync_dir") < body.index("os.remove")
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -158,6 +158,35 @@ def test_logging_disabled(tmp_path):
 # ----------------------------------------- staged truncation (ISSUE 11)
 
 
+def test_trunc_marker_torn_at_every_byte_reads_base_zero(tmp_path):
+    """The truncation marker is a framed on-disk format (CRC frame +
+    magic + base), so it carries the every-byte-torn contract the
+    durability lint's [torn-frame] registry pins: a marker torn at ANY
+    byte must read as base 0 (never-truncated — recovery then treats
+    the file as an ordinary log and the torn record as a torn tail),
+    never as a garbage base that would shift every logical offset."""
+    from antidote_tpu.oplog.log import _peek_trunc_base, _trunc_marker
+
+    p = str(tmp_path / "log")
+    raw = _trunc_marker(123456)
+    for cut in range(len(raw)):
+        with open(p, "wb") as f:
+            f.write(raw[:cut])
+        assert _peek_trunc_base(p) == 0, \
+            f"torn marker prefix of {cut} bytes parsed a base"
+    # bit rot inside the frame must fail the CRC, not parse
+    for i in range(len(raw)):
+        corrupt = bytearray(raw)
+        corrupt[i] ^= 0xFF
+        with open(p, "wb") as f:
+            f.write(bytes(corrupt))
+        assert _peek_trunc_base(p) == 0, \
+            f"corrupt marker byte {i} parsed a base"
+    with open(p, "wb") as f:
+        f.write(raw)
+    assert _peek_trunc_base(p) == 123456  # the intact marker parses
+
+
 def test_staged_truncation_interleaves_appends(tmp_path, backend):
     """The two-phase truncation contract: the tail copy stages out of
     the handle lock, appends land while the stage is open, and the

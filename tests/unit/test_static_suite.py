@@ -34,13 +34,13 @@ def test_standalone_main_exit_code(monkeypatch, capsys):
 
 
 def test_registry_covers_every_analyzer():
-    """The suite is the aggregation point — all three standalone
+    """The suite is the aggregation point — all four standalone
     analyzers plus the suite-resident stats-dashboard rule.  If an
     analyzer is added to tools/ it must land here too (that is the
     point of the suite), and this list is the reminder."""
     assert [name for name, _ in static_suite.PASSES] == \
         ["analysis_gate", "trace_lint", "concurrency_lint",
-         "stats-dashboard"]
+         "durability_lint", "stats-dashboard"]
 
 
 def test_findings_route_with_pass_prefix(monkeypatch):
@@ -60,6 +60,38 @@ def test_main_exit_code_nonzero_on_findings(monkeypatch, capsys):
         (("noisy", lambda root: ["x.py:1: [boom] broken"]),))
     assert static_suite.main(["ignored-root"]) == 1
     assert "noisy: x.py:1: [boom] broken" in capsys.readouterr().err
+
+
+# ----------------------------------------------- --json (ISSUE 15)
+
+def test_json_output_is_machine_readable(monkeypatch, capsys):
+    """`--json` emits per-pass findings, counts and wall-clock ms so
+    the CI log is greppable and a slow pass is attributable — against
+    a stubbed pass list (the real sweep is test_repo_is_clean's)."""
+    import json
+    monkeypatch.setattr(
+        static_suite, "PASSES",
+        (("quiet", lambda root: []),
+         ("noisy", lambda root: ["x.py:1: [boom] broken"])))
+    assert static_suite.main(["--json", "ignored-root"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False
+    assert doc["total_findings"] == 1
+    names = [p["name"] for p in doc["passes"]]
+    assert names == ["quiet", "noisy"]
+    for p in doc["passes"]:
+        assert set(p) == {"name", "findings", "count", "ms"}
+        assert p["ms"] >= 0
+    assert doc["passes"][1]["findings"] == ["x.py:1: [boom] broken"]
+
+
+def test_json_clean_exit_zero(monkeypatch, capsys):
+    import json
+    monkeypatch.setattr(static_suite, "PASSES",
+                        (("stub", lambda root: []),))
+    assert static_suite.main(["--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True and doc["total_findings"] == 0
 
 
 # ------------------------------------------------ stats-dashboard rule

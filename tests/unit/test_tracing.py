@@ -1,6 +1,8 @@
 """JAX profiler capture API — lives in antidote_tpu.obs.prof since
 ISSUE 2; ISSUE 7 retired the ``antidote_tpu.tracing`` re-export shim
-to a one-release import error pointing there."""
+to a one-release import error, and ISSUE 15 deleted the shim outright
+(it had outlived its one release by five) — a stale import now fails
+as a plain ModuleNotFoundError like any other dead path."""
 
 import os
 
@@ -34,16 +36,3 @@ def test_double_start_rejected(tmp_path):
 def test_annotation_without_capture_is_noop():
     with prof.annotate("idle"):
         pass
-
-
-def test_retired_shim_raises_with_pointer():
-    """The one-release shim: importing the old module fails LOUDLY
-    with the forwarding address, not an AttributeError three frames
-    later (the ISSUE 7 retirement contract)."""
-    import importlib
-    import sys
-
-    sys.modules.pop("antidote_tpu.tracing", None)
-    with pytest.raises(ImportError, match="obs.prof"):
-        importlib.import_module("antidote_tpu.tracing")
-    sys.modules.pop("antidote_tpu.tracing", None)

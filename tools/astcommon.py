@@ -1,0 +1,186 @@
+"""astcommon — shared AST infrastructure for the static analyzers.
+
+concurrency_lint (ISSUE 11) grew an intra-package call-graph builder
+and a tokenize-based suppression scanner; durability_lint (ISSUE 15)
+needs both, byte-for-byte.  Two copies of "resolve ``self.m()`` within
+the class, otherwise only names defined exactly once in the package"
+would drift — the first analyzer to fix a resolution bug would
+silently leave the other one wrong — so the shared halves live here
+and both lints import them:
+
+- :func:`terminal` / :data:`NO_RESOLVE` — call-name extraction and the
+  builtin-method shadowing table (``int.to_bytes`` resolved to
+  ``LogRecord.to_bytes`` was the prototype false positive; following a
+  builtin-type method invents call chains that do not exist).
+- :class:`FileInfo` / :func:`load_package` — parse every module under
+  a package dir and scan its suppression comments (``# lock-ok:`` /
+  ``# dur-ok:`` — the marker is a parameter) via tokenize COMMENT
+  tokens, never substring-on-raw-lines: the literal marker text inside
+  a docstring or error message must not become a phantom suppression
+  of the next code line.  A comment-only marker line attaches to the
+  next code line (audit reasons rarely fit beside the call).
+- :class:`CallIndex` — name/class indices over collected functions and
+  the one resolution rule (ambiguity never invents a finding).
+
+Pure stdlib, no package imports — the suite stays millisecond-fast
+with no JAX.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from typing import Dict, List, Optional, Tuple
+
+#: call names NEVER followed into a definition: methods of builtin
+#: types (``txid.to_bytes`` is int's, ``d.get`` is dict's) shadow
+#: same-named package functions, and following them invents call
+#: chains that do not exist.  This also means per-record codec calls
+#: (``LogRecord.from_bytes``) are not followed — deliberate:
+#: record-level pickle is the log's codec and rides inside lock-held
+#: read paths by design; the blocking rules target document-level
+#: ``pickle.dumps``/``loads`` sites.
+NO_RESOLVE = {
+    "to_bytes", "from_bytes", "encode", "decode", "get", "items",
+    "keys", "values", "update", "pop", "popitem", "append", "extend",
+    "add", "remove", "discard", "clear", "copy", "join", "split",
+    "rsplit", "strip", "replace", "format", "count", "index",
+    "insert", "sort", "reverse", "setdefault", "startswith",
+    "endswith", "lower", "upper", "seek", "tell", "dump", "dumps",
+    "load", "loads", "send", "recv", "put", "read", "write",
+}
+
+
+def terminal(node: ast.expr) -> Optional[str]:
+    """The terminal name of an expression: ``self.log.sync`` ->
+    ``sync``, ``os`` -> ``os``; None for subscripts/calls/etc."""
+    return getattr(node, "attr", getattr(node, "id", None))
+
+
+class FileInfo:
+    """One parsed module + its suppression comments for ``marker``."""
+
+    def __init__(self, rel: str, tree: ast.Module, src: str,
+                 marker: str):
+        self.rel = rel
+        self.tree = tree
+        self.src = src
+        self.lines = src.splitlines()
+        self.marker = marker
+        #: line -> suppression reason; a ``# <marker>: <reason>`` on a
+        #: comment-only line attaches to the next code line
+        self.suppress: Dict[int, str] = {}
+        #: (comment line, reason) as written — the reason-hygiene rule
+        #: reports at the comment itself
+        self.suppress_sites: List[Tuple[int, str]] = []
+        prefix = f"# {marker}"
+        n = len(self.lines)
+        try:
+            toks = list(tokenize.generate_tokens(
+                io.StringIO(src).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            toks = []
+        for tok in toks:
+            if tok.type != tokenize.COMMENT \
+                    or not tok.string.startswith(prefix):
+                continue
+            i = tok.start[0]
+            reason = tok.string.split(prefix, 1)[1] \
+                .lstrip(": ").strip()
+            self.suppress_sites.append((i, reason))
+            target = i
+            if not tok.line[:tok.start[1]].strip():
+                # comment-only line: attach to the next code line
+                j = i + 1
+                while j <= n and (not self.lines[j - 1].strip()
+                                  or self.lines[j - 1].strip()
+                                  .startswith("#")):
+                    j += 1
+                target = j
+            self.suppress.setdefault(target, reason)
+
+    def suppressed(self, lineno: int) -> bool:
+        """True when ``lineno`` carries a REASONED suppression — a
+        bare marker registers as a site (for the reason-hygiene rule)
+        but never suppresses."""
+        return bool(self.suppress.get(lineno))
+
+
+def load_package(root: str, package_dir: str, marker: str,
+                 ) -> Tuple[Dict[str, FileInfo], List[str]]:
+    """Parse every ``.py`` under ``root/package_dir`` into FileInfos
+    keyed by repo-relative path; syntax errors come back as findings
+    (the caller tags them)."""
+    files: Dict[str, FileInfo] = {}
+    problems: List[str] = []
+    pkg = os.path.join(root, package_dir)
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "_build")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path) as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError as e:
+                problems.append(f"{rel}:{e.lineno or 0}: "
+                                f"[syntax] {e.msg}")
+                continue
+            files[rel] = FileInfo(rel, tree, src, marker)
+    return files, problems
+
+
+def walk_functions(tree: ast.Module):
+    """Yield ``(enclosing class name or None, FunctionDef)`` for every
+    function in the module, including nested defs (which get their
+    own scope — their body runs at call time, not in the enclosing
+    region)."""
+
+    def walk(node, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from walk(child, cls)
+            else:
+                yield from walk(child, cls)
+
+    yield from walk(tree, None)
+
+
+class CallIndex:
+    """Name/class indices over collected function objects (anything
+    with ``.name`` and ``.cls``) + the one call-resolution rule:
+    ``self.m()`` resolves within the class; otherwise only names
+    defined exactly once in the package resolve — ambiguity never
+    invents a finding."""
+
+    def __init__(self):
+        self.by_name: Dict[str, List] = {}
+        self.by_cls: Dict[Tuple[str, str], object] = {}
+
+    def add(self, fn) -> None:
+        self.by_name.setdefault(fn.name, []).append(fn)
+        if fn.cls:
+            self.by_cls[(fn.cls, fn.name)] = fn
+
+    def resolve(self, caller_cls: Optional[str], name: str,
+                owner: Optional[str]):
+        if name in NO_RESOLVE:
+            return None  # builtin-type method shadowing (see table)
+        if owner == "self" and caller_cls:
+            fn = self.by_cls.get((caller_cls, name))
+            if fn is not None:
+                return fn
+        cands = self.by_name.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None

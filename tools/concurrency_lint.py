@@ -85,11 +85,12 @@ tests: tests/unit/test_concurrency_lint.py.
 from __future__ import annotations
 
 import ast
-import io
 import os
 import sys
-import tokenize
 from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import astcommon  # noqa: E402 — shared call-graph + suppression infra
 
 #: package swept for lock discipline and knob routing (tests and
 #: benches intentionally build variant assemblies and hold the GIL in
@@ -138,24 +139,9 @@ _FACTORY_ROUTED: Dict[str, Tuple[str, ...]] = {
     "TcpTransport": ("antidote_tpu/interdc/tcp.py",),
 }
 
-#: call names NEVER followed into a definition: methods of builtin
-#: types (``txid.to_bytes`` is int's, ``d.get`` is dict's) shadow
-#: same-named package functions, and following them invents call
-#: chains that do not exist (``int.to_bytes`` resolved to
-#: ``LogRecord.to_bytes`` was the prototype false positive).  This
-#: also means per-record codec calls (``LogRecord.from_bytes``) are
-#: not followed — deliberate: record-level pickle is the log's codec
-#: and rides inside lock-held read paths by design; the rule targets
-#: document-level ``pickle.dumps``/``loads`` sites.
-_NO_RESOLVE = {
-    "to_bytes", "from_bytes", "encode", "decode", "get", "items",
-    "keys", "values", "update", "pop", "popitem", "append", "extend",
-    "add", "remove", "discard", "clear", "copy", "join", "split",
-    "rsplit", "strip", "replace", "format", "count", "index",
-    "insert", "sort", "reverse", "setdefault", "startswith",
-    "endswith", "lower", "upper", "seek", "tell", "dump", "dumps",
-    "load", "loads", "send", "recv", "put", "read", "write",
-}
+#: builtin-type method shadowing table — factored to astcommon (ISSUE
+#: 15) so durability_lint's call resolution cannot drift from ours
+_NO_RESOLVE = astcommon.NO_RESOLVE
 
 #: owners whose ``publish`` is the inter-DC pub/sub wire send (the
 #: trace_lint _PUBLISH_OWNERS contract); a meta entry's monotone
@@ -233,58 +219,19 @@ _GIL_QUICK = {
 }
 
 
-def _terminal(node: ast.expr) -> Optional[str]:
-    return getattr(node, "attr", getattr(node, "id", None))
+#: call-name extraction — shared with durability_lint (astcommon)
+_terminal = astcommon.terminal
+
+#: one parsed module + its ``# lock-ok`` suppressions (tokenize-based
+#: COMMENT scan, comment-only lines attach to the next code line —
+#: see astcommon.FileInfo, factored out for durability_lint's dur-ok)
+_FileInfo = astcommon.FileInfo
 
 
 def _expr_key(node: ast.expr) -> str:
     """Stable identity of a lock expression (``self._lock`` ==
     ``self._lock``) — ast.dump is deterministic for our purposes."""
     return ast.dump(node)
-
-
-class _FileInfo:
-    """One parsed module's functions, lock kinds and knob reads."""
-
-    def __init__(self, rel: str, tree: ast.Module, src: str):
-        self.rel = rel
-        self.tree = tree
-        self.src = src
-        self.lines = src.splitlines()
-        #: line -> suppression reason; a ``# lock-ok: <reason>`` on a
-        #: comment-only line attaches to the next code line (reasons
-        #: rarely fit beside the call they audit).  Scanned via
-        #: tokenize COMMENT tokens, not substring-on-raw-lines — the
-        #: literal text inside a docstring or error message must not
-        #: become a phantom suppression of the next code line.
-        self.lock_ok: Dict[int, str] = {}
-        #: (comment line, reason) as written — the reason-hygiene rule
-        #: reports at the comment itself
-        self.lock_ok_sites: List[Tuple[int, str]] = []
-        n = len(self.lines)
-        try:
-            toks = list(tokenize.generate_tokens(
-                io.StringIO(src).readline))
-        except (tokenize.TokenError, IndentationError, SyntaxError):
-            toks = []
-        for tok in toks:
-            if tok.type != tokenize.COMMENT \
-                    or not tok.string.startswith("# lock-ok"):
-                continue
-            i = tok.start[0]
-            reason = tok.string.split("# lock-ok", 1)[1] \
-                .lstrip(": ").strip()
-            self.lock_ok_sites.append((i, reason))
-            target = i
-            if not tok.line[:tok.start[1]].strip():
-                # comment-only line: attach to the next code line
-                j = i + 1
-                while j <= n and (not self.lines[j - 1].strip()
-                                  or self.lines[j - 1].strip()
-                                  .startswith("#")):
-                    j += 1
-                target = j
-            self.lock_ok.setdefault(target, reason)
 
 
 class _Func:
@@ -319,10 +266,8 @@ class _Analyzer:
         self.root = root
         self.files: Dict[str, _FileInfo] = {}
         self.funcs: List[_Func] = []
-        #: name -> funcs with that name (call resolution)
-        self.by_name: Dict[str, List[_Func]] = {}
-        #: (cls, name) -> func
-        self.by_cls: Dict[Tuple[str, str], _Func] = {}
+        #: name/class call-resolution indices (astcommon.CallIndex)
+        self.calls = astcommon.CallIndex()
         #: lock attr -> classes assigning it (owner-type heuristic)
         self.attr_owners: Dict[str, Set[str]] = {}
         #: (class, cv_attr) -> lock_attr for condition variables built
@@ -341,26 +286,8 @@ class _Analyzer:
     # ------------------------------------------------------------ parse
 
     def load(self) -> List[str]:
-        problems: List[str] = []
-        pkg = os.path.join(self.root, PACKAGE_DIR)
-        for dirpath, dirnames, filenames in os.walk(pkg):
-            dirnames[:] = [d for d in dirnames
-                           if d not in ("__pycache__", "_build")]
-            for fname in sorted(filenames):
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                rel = os.path.relpath(path, self.root)
-                with open(path) as f:
-                    src = f.read()
-                try:
-                    tree = ast.parse(src, filename=path)
-                except SyntaxError as e:
-                    problems.append(f"{rel}:{e.lineno or 0}: "
-                                    f"[syntax] {e.msg}")
-                    continue
-                info = _FileInfo(rel, tree, src)
-                self.files[rel] = info
+        self.files, problems = astcommon.load_package(
+            self.root, PACKAGE_DIR, marker="lock-ok")
         # pass 1: class metadata (lock attrs, Condition aliases) from
         # EVERY file — the function scan below resolves lock identity
         # across modules, so it must see the whole package's metadata
@@ -370,9 +297,7 @@ class _Analyzer:
         for rel in sorted(self.files):
             self._collect_funcs(self.files[rel])
         for fn in self.funcs:
-            self.by_name.setdefault(fn.name, []).append(fn)
-            if fn.cls:
-                self.by_cls[(fn.cls, fn.name)] = fn
+            self.calls.add(fn)
         return problems
 
     def _collect_funcs(self, info: _FileInfo) -> None:
@@ -539,7 +464,7 @@ class _Analyzer:
                     # propagated findings are covered by the one
                     # source-site audit (the legacy inline-fsync
                     # pattern: one audited line, five call sites)
-                    if cls is not None and not info.lock_ok.get(
+                    if cls is not None and not info.suppress.get(
                             child.lineno):
                         kind, what, wl = cls
                         fn.blocking.append(
@@ -589,16 +514,7 @@ class _Analyzer:
 
     def resolve(self, caller: _Func, name: str,
                 owner: Optional[str]) -> Optional[_Func]:
-        if name in _NO_RESOLVE:
-            return None  # builtin-type method shadowing (see table)
-        if owner == "self" and caller.cls:
-            fn = self.by_cls.get((caller.cls, name))
-            if fn is not None:
-                return fn
-        cands = self.by_name.get(name, [])
-        if len(cands) == 1:
-            return cands[0]
-        return None
+        return self.calls.resolve(caller.cls, name, owner)
 
     # ------------------------------------------ transitive blocking set
 
@@ -710,16 +626,14 @@ class _Analyzer:
         return False
 
     def _suppressed(self, info: _FileInfo, lineno: int) -> bool:
-        if lineno not in info.lock_ok:
-            return False
-        return bool(info.lock_ok[lineno])
+        return info.suppressed(lineno)
 
     def lint_lock_ok_reasons(self) -> List[str]:
         """A ``# lock-ok`` with no reason defeats the audit trail the
         suppression exists to create — itself a finding."""
         problems = []
         for rel in sorted(self.files):
-            for ln, reason in self.files[rel].lock_ok_sites:
+            for ln, reason in self.files[rel].suppress_sites:
                 if not reason:
                     problems.append(
                         f"{rel}:{ln}: [lock-ok-reason] `# lock-ok` "

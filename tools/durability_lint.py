@@ -1,0 +1,684 @@
+#!/usr/bin/env python
+"""durability_lint — the durability-protocol analyzer (ISSUE 15).
+
+The reference's durability plane (``logging_vnode`` over ``disk_log``)
+trusts the runtime; ours is a hand-audited crash-safety discipline —
+temp+fsync+rename+dir-fsync publishes, immutable checksummed segments,
+manifest-rename commit points, torn-at-every-byte loaders — spread
+across the fsync/rename/replace sites of oplog/, and three separate
+review rounds (PRs 9, 10, 12) each found ordering bugs in it by hand:
+a missing directory fsync after the truncation rename, unlink-before-
+commit in compaction, stale-checkpoint adoption against rewritten
+bytes.  This lint turns that review-round discipline into an AST pass
+(the concurrency_lint mold, propagating through the same intra-package
+call graph via tools/astcommon.py).  Five rule families, all pure-ast:
+
+**atomic publish** [atomic-publish]: a durable artifact becomes live
+by ``os.replace``/``os.rename``; the protocol is temp + flush+fsync +
+rename + directory fsync.  Every rename in the package must be
+preceded on the same call-graph path by an fsync of the written bytes
+(``os.fsync``/``fdatasync``/``sync``/``oplog_sync``, directly or
+through a resolvable call) and followed by a directory fsync
+(``_fsync_dir``, ditto) — without the first, the rename can publish
+bytes still in the page cache (an acked commit gone on power cut);
+without the second, the rename itself can be lost (the resurrected
+pre-rename inode, the exact PR-9 truncation bug).  Additionally every
+``with open(..., "w"/"wb"/...)`` in the declared durable-write
+modules (``_DURABLE_WRITE_MODULES`` — the table IS the policy for
+what counts as a durable artifact) must reach an fsync before the
+function ends: a durable write that is never fsynced is a promise the
+disk does not keep.
+
+**commit-point ordering** [commit-point]: an ``os.unlink``/
+``os.remove`` of a superseded durable file must be dominated by the
+rename commit point that obsoletes it — the PR-12 compaction/manifest
+discipline: old segments unlink only AFTER the new manifest landed,
+so a crash at any earlier byte leaves the previous manifest
+authoritative over files that all still exist.  Mechanically: in any
+function that performs a commit (a direct rename, or a call to a
+``_COMMITTERS`` primitive), every unlink event (direct, or a call to
+a ``_DELETERS`` primitive) must come after a commit event; an unlink
+with no commit before it is the unlink-before-commit bug.  Functions
+with no commit event are pure cleanup/retirement paths and exempt.
+
+**immutable files** [immutable-file]: file classes declared immutable
+in ``_DECLARED_IMMUTABLE`` (checkpoint seed segments, retired
+``.handedoff``/``.pre-resize`` logs) must never be opened for
+write/append/update outside their blessed creation modules — the
+whole recovery story rests on their bytes never changing after the
+manifest commit (the PR-12 stale-adoption bug was exactly rewritten
+bytes under a checkpoint that believed them immutable).  Detection
+follows string constants in the open's path expression, through local
+assignments and one level of resolvable path-constructor calls.
+
+**loud recovery** [loud-recovery]: exception handlers in the recovery
+/load modules (``_RECOVERY_PATHS``: oplog/, the stable-meta store)
+whose try block parses durable state (``pickle.loads``/``load``,
+struct ``unpack``, ``from_bytes``) must raise, log, or return the
+documented ``None``/sentinel refusal — a silent ``except: pass`` over
+durable-state parsing recovers a half-truth as if it were everything.
+Best-effort cleanup handlers (``os.remove`` and friends) are exempt:
+the rule keys off what the try block READS, not that it excepts.
+
+**torn-frame registry** [torn-frame]: every framed on-disk format
+(magic + len + crc — any ``*MAGIC*`` bytes constant in the durable
+modules) must be registered in ``_FRAMED_FORMATS`` with its paired
+loader and the every-byte-torn test that exercises it, the way the
+stats-dashboard rule pins metric families to panels.  An unregistered
+magic means a writer shipped without a torn-tail story; a registered
+loader or torn-test hook that no longer exists means the story
+rotted.
+
+Suppression is an audited ``# dur-ok: <reason>`` on the finding line
+(or a comment-only line above it), scanned via tokenize like lock-ok;
+a bare ``# dur-ok`` without a reason is itself a finding
+[dur-ok-reason] — the audit trail is the point.
+
+Runs standalone (``python tools/durability_lint.py [root]``) and as
+part of ``python -m tools.static_suite``; exit 0 = clean.  Fixture
+tests: tests/unit/test_durability_lint.py — including the three
+historical review-round bugs as regressions each rule must catch.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import astcommon  # noqa: E402 — shared call-graph + suppression infra
+
+#: package swept (tests and benches tear files deliberately)
+PACKAGE_DIR = "antidote_tpu"
+
+#: modules whose file WRITES are durable artifacts — the
+#: write-never-fsynced check and the torn-frame magic scan run here.
+#: Entries ending in "/" are directory prefixes.  The table is the
+#: policy: a new module that persists durable state must be listed
+#: before its writes are protocol-checked (and a module doing casual
+#: file IO — obs dumps, bench outputs — stays out).  Renames, unlinks
+#: and immutable-file writes are swept package-wide regardless: an
+#: os.replace is a durable publish wherever it appears.
+_DURABLE_WRITE_MODULES: Tuple[str, ...] = (
+    "antidote_tpu/oplog/",
+    "antidote_tpu/meta/stable_store.py",
+    "antidote_tpu/txn/node.py",
+    "antidote_tpu/cluster/node.py",
+)
+
+#: immutable file classes: path marker -> modules blessed to open
+#: them for write (creation only; the defining module is NOT
+#: implicitly blessed — list it).  Grow this when a new immutable
+#: artifact class ships; an empty tuple means NOBODY writes one in
+#: place (they are created only by rename).
+_DECLARED_IMMUTABLE: Dict[str, Tuple[str, ...]] = {
+    # checkpoint seed segments: immutable once a manifest lists them
+    # (checkpoint.py creates them and installs shipped copies)
+    ".seg-": ("antidote_tpu/oplog/checkpoint.py",),
+    # retired logs displaced by a handoff cutover / ring resize: kept
+    # as forensic history, never reopened for write
+    ".handedoff": (),
+    ".pre-resize": (),
+}
+
+#: recovery/load modules for the loud-recovery sweep ("/" suffix =
+#: directory prefix): where a swallowed parse failure recovers a
+#: half-truth as if it were everything
+_RECOVERY_PATHS: Tuple[str, ...] = (
+    "antidote_tpu/oplog/",
+    "antidote_tpu/meta/stable_store.py",
+)
+
+#: call names that PARSE durable state (deserialization, not raw IO:
+#: a retry loop around a raw read is not a parse path)
+_PARSE_CALLS = {"loads", "load", "unpack", "from_bytes"}
+
+#: terminal call names that are an fsync of written bytes
+_FSYNC_NAMES = {"fsync", "fdatasync", "sync", "oplog_sync"}
+
+#: the one directory-fsync primitive (oplog/log._fsync_dir — "the ONE
+#: copy of this discipline", its docstring says; this rule holds the
+#: package to that)
+_DIR_FSYNC_NAME = "_fsync_dir"
+
+#: repo primitives that ARE a commit point (they rename internally) —
+#: commit-point ordering counts a call to one as the commit event
+_COMMITTERS = {"write_doc", "install_bundle", "commit_truncate"}
+
+#: repo primitives that unlink durable files wholesale — counted as
+#: unlink events by commit-point ordering
+_DELETERS = {"delete_checkpoint_files", "_sweep_segments"}
+
+#: open() modes that write (read-only modes are never a finding)
+_WRITE_MODE_CHARS = ("w", "a", "+", "x")
+
+#: framed on-disk formats: (module rel, magic var name) -> contract.
+#: ``loader`` must be a function in the same module; ``torn_test``
+#: must exist and contain ``torn_hook`` (the every-byte-torn test
+#: name).  Registering here is part of shipping a framed writer.
+_FRAMED_FORMATS: Dict[Tuple[str, str], Dict[str, str]] = {
+    ("antidote_tpu/oplog/checkpoint.py", "_MAGIC"): {
+        "loader": "_parse",
+        "torn_test": "tests/unit/test_checkpoint.py",
+        "torn_hook": "test_truncated_at_every_byte_loads_previous_or_none",
+    },
+    ("antidote_tpu/oplog/checkpoint.py", "_SEG_MAGIC"): {
+        "loader": "_load_segment",
+        "torn_test": "tests/unit/test_ckpt_segments.py",
+        "torn_hook": "test_torn_segment_at_every_byte_refuses_whole_checkpoint",
+    },
+    ("antidote_tpu/oplog/log.py", "_TRUNC_MAGIC"): {
+        "loader": "_parse_trunc_marker",
+        "torn_test": "tests/unit/test_oplog.py",
+        "torn_hook": "test_trunc_marker_torn_at_every_byte_reads_base_zero",
+    },
+}
+
+
+def _in_paths(rel: str, paths: Tuple[str, ...]) -> bool:
+    return any(rel.startswith(p) if p.endswith("/") else rel == p
+               for p in paths)
+
+
+def _is_write_mode(mode: str) -> bool:
+    return any(c in mode for c in _WRITE_MODE_CHARS)
+
+
+class _Func:
+    """One function's durability events, line-ordered."""
+
+    def __init__(self, rel: str, cls: Optional[str], node):
+        self.rel = rel
+        self.cls = cls
+        self.node = node
+        self.name = node.name
+        #: direct rename lines (os.replace / os.rename)
+        self.renames: List[int] = []
+        #: direct unlink lines (os.remove / os.unlink)
+        self.unlinks: List[int] = []
+        #: direct fsync lines (_FSYNC_NAMES terminals)
+        self.fsyncs: List[int] = []
+        #: direct directory-fsync lines (_fsync_dir)
+        self.dir_fsyncs: List[int] = []
+        #: ``with open(..., <write mode>)`` lines
+        self.writes: List[int] = []
+        #: every call site: (name, owner, lineno)
+        self.calls: List[Tuple[str, Optional[str], int]] = []
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+class _Analyzer:
+    def __init__(self, root: str):
+        self.root = root
+        self.files: Dict[str, astcommon.FileInfo] = {}
+        self.funcs: List[_Func] = []
+        self.calls = astcommon.CallIndex()
+
+    # ------------------------------------------------------------ parse
+
+    def load(self) -> List[str]:
+        self.files, problems = astcommon.load_package(
+            self.root, PACKAGE_DIR, marker="dur-ok")
+        for rel in sorted(self.files):
+            info = self.files[rel]
+            for cls, node in astcommon.walk_functions(info.tree):
+                fn = _Func(rel, cls, node)
+                self.funcs.append(fn)
+                self._scan_func(fn)
+        for fn in self.funcs:
+            self.calls.add(fn)
+        return problems
+
+    def _scan_func(self, fn: _Func) -> None:
+        """Collect one function's durability events; nested defs are
+        skipped (they scan as their own functions)."""
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        ctx = item.context_expr
+                        if isinstance(ctx, ast.Call) \
+                                and astcommon.terminal(ctx.func) \
+                                == "open" \
+                                and self._open_mode(ctx) is not None \
+                                and _is_write_mode(
+                                    self._open_mode(ctx)):
+                            fn.writes.append(ctx.lineno)
+                if isinstance(child, ast.Call):
+                    name = astcommon.terminal(child.func)
+                    owner = astcommon.terminal(child.func.value) \
+                        if isinstance(child.func, ast.Attribute) \
+                        else None
+                    if name:
+                        ln = child.lineno
+                        if owner == "os" and name in ("replace",
+                                                      "rename"):
+                            fn.renames.append(ln)
+                        elif owner == "os" and name in ("remove",
+                                                        "unlink"):
+                            fn.unlinks.append(ln)
+                        elif name in _FSYNC_NAMES and fn.name != name:
+                            # a function NAMED like the barrier is its
+                            # definition/wrapper, not an event site
+                            fn.fsyncs.append(ln)
+                        elif name == _DIR_FSYNC_NAME \
+                                and fn.name != name:
+                            fn.dir_fsyncs.append(ln)
+                        fn.calls.append((name, owner, ln))
+                visit(child)
+
+        visit(fn.node)
+
+    @staticmethod
+    def _open_mode(call: ast.Call) -> Optional[str]:
+        """The literal mode of an ``open()`` call, or None when absent
+        /non-constant (a computed mode never invents a finding)."""
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value,
+                                                        str):
+            return mode.value
+        return None
+
+    # --------------------------------------------- transitive IO facts
+
+    def _transitive(self) -> Dict[_Func, Set[str]]:
+        """func -> subset of {"fsync", "dirfsync"} reachable through
+        resolvable calls — how a helper's fsync covers its caller's
+        publish path (the same propagation that found the PR-8 hidden
+        fsync, pointed the other way: here reachability SATISFIES the
+        protocol instead of violating it).
+
+        Cycle discipline: a DFS that hits a function already on the
+        stack returns a LOWER BOUND (the back edge is cut), and
+        memoizing that bound would let one member of a call cycle
+        poison every caller's fact set — a rename whose acyclic path
+        reaches an fsync would be falsely flagged (missing facts here
+        INVENT findings, the opposite polarity of concurrency_lint's
+        blocking propagation, where missing facts only miss them).
+        So cut-tainted results are returned but never memoized; each
+        top-level traversal starts from an empty stack, visits every
+        reachable function once, and is therefore exact."""
+        memo: Dict[_Func, Set[str]] = {}
+
+        def go(fn: _Func, stack: Set[_Func]
+               ) -> Tuple[Set[str], bool]:
+            if fn in memo:
+                return memo[fn], True
+            if fn in stack:
+                return set(), False  # cycle cut: lower bound
+            stack.add(fn)
+            out: Set[str] = set()
+            clean = True
+            if fn.fsyncs:
+                out.add("fsync")
+            if fn.dir_fsyncs:
+                out.add("dirfsync")
+            for (name, owner, _ln) in fn.calls:
+                callee = self.calls.resolve(fn.cls, name, owner)
+                if callee is not None and callee is not fn:
+                    sub, sub_clean = go(callee, stack)
+                    out |= sub
+                    clean = clean and sub_clean
+            stack.discard(fn)
+            if clean:
+                memo[fn] = out
+            return out, clean
+
+        exact: Dict[_Func, Set[str]] = {}
+        for fn in self.funcs:
+            exact[fn] = go(fn, set())[0]
+        return exact
+
+    def _event_lines(self, fn: _Func, trans, fact: str,
+                     direct: List[int]) -> List[int]:
+        """Lines where ``fact`` holds: direct events plus call sites
+        whose callee transitively performs it."""
+        out = list(direct)
+        for (name, owner, ln) in fn.calls:
+            callee = self.calls.resolve(fn.cls, name, owner)
+            if callee is not None and callee is not fn \
+                    and fact in trans.get(callee, ()):
+                out.append(ln)
+        return sorted(out)
+
+    # ------------------------------------------- rule 1: atomic-publish
+
+    def lint_atomic_publish(self) -> List[str]:
+        problems: List[str] = []
+        trans = self._transitive()
+        for fn in self.funcs:
+            info = self.files[fn.rel]
+            if not (fn.renames or fn.writes):
+                continue
+            fsync_lines = self._event_lines(fn, trans, "fsync",
+                                            fn.fsyncs)
+            dirf_lines = self._event_lines(fn, trans, "dirfsync",
+                                           fn.dir_fsyncs)
+            for ln in fn.renames:
+                if info.suppressed(ln):
+                    continue
+                if not any(f < ln for f in fsync_lines):
+                    problems.append(
+                        f"{fn.rel}:{ln}: [atomic-publish] rename "
+                        f"publishes bytes never fsynced ({fn.qual}) — "
+                        "flush+fsync the written temp before the "
+                        "rename, or audit with `# dur-ok: <reason>`")
+                if not any(d > ln for d in dirf_lines):
+                    problems.append(
+                        f"{fn.rel}:{ln}: [atomic-publish] rename "
+                        f"without a directory fsync ({fn.qual}) — a "
+                        "power cut can resurrect the pre-rename "
+                        "inode; call _fsync_dir after the rename, or "
+                        "audit with `# dur-ok: <reason>`")
+            if _in_paths(fn.rel, _DURABLE_WRITE_MODULES):
+                for ln in fn.writes:
+                    if info.suppressed(ln):
+                        continue
+                    if not any(f >= ln for f in fsync_lines):
+                        problems.append(
+                            f"{fn.rel}:{ln}: [atomic-publish] durable "
+                            f"write is never fsynced ({fn.qual}) — "
+                            "the bytes live only in the page cache; "
+                            "fsync before anything depends on them, "
+                            "or audit with `# dur-ok: <reason>`")
+        return problems
+
+    # -------------------------------------------- rule 2: commit-point
+
+    def lint_commit_point(self) -> List[str]:
+        problems: List[str] = []
+        for fn in self.funcs:
+            info = self.files[fn.rel]
+            commits = list(fn.renames)
+            unlinks = [(ln, "os.remove/os.unlink")
+                       for ln in fn.unlinks]
+            for (name, _owner, ln) in fn.calls:
+                if name in _COMMITTERS:
+                    commits.append(ln)
+                elif name in _DELETERS:
+                    unlinks.append((ln, f"{name}()"))
+            if not commits:
+                continue  # pure cleanup/retirement path: exempt
+            for (ln, what) in sorted(unlinks):
+                if info.suppressed(ln):
+                    continue
+                if not any(c < ln for c in commits):
+                    problems.append(
+                        f"{fn.rel}:{ln}: [commit-point] {what} "
+                        f"unlinks a durable file BEFORE this "
+                        f"function's commit point lands ({fn.qual}) — "
+                        "a crash between them loses both the old "
+                        "file and the commit; unlink only after the "
+                        "rename, or audit with `# dur-ok: <reason>`")
+        return problems
+
+    # ------------------------------------------ rule 3: immutable-file
+
+    def lint_immutable(self) -> List[str]:
+        problems: List[str] = []
+        for rel in sorted(self.files):
+            info = self.files[rel]
+            for cls, node in astcommon.walk_functions(info.tree):
+                for call in ast.walk(node):
+                    if not (isinstance(call, ast.Call)
+                            and astcommon.terminal(call.func)
+                            == "open"):
+                        continue
+                    mode = self._open_mode(call)
+                    if mode is None or not _is_write_mode(mode):
+                        continue
+                    if not call.args:
+                        continue
+                    consts = self._path_constants(
+                        call.args[0], node, cls)
+                    for marker, blessed in sorted(
+                            _DECLARED_IMMUTABLE.items()):
+                        if not any(marker in c for c in consts):
+                            continue
+                        if rel in blessed:
+                            continue
+                        if info.suppressed(call.lineno):
+                            continue
+                        who = ", ".join(blessed) or \
+                            "nobody — this class is created only " \
+                            "by rename"
+                        problems.append(
+                            f"{rel}:{call.lineno}: [immutable-file] "
+                            f"opens a {marker!r} file with mode "
+                            f"{mode!r} outside its blessed creation "
+                            f"module(s) ({who}) — immutable "
+                            "artifacts must never be rewritten in "
+                            "place (the PR-12 stale-adoption "
+                            "lesson); recovery trusts their bytes")
+        return problems
+
+    def _path_constants(self, expr: ast.expr, func_node,
+                        cls: Optional[str]) -> List[str]:
+        """String constants reachable from a path expression: its own
+        subtree, the subtree assigned to a Name it references (local
+        dataflow, one level), and the body of a resolvable path-
+        constructor it calls (one level) — enough to see through
+        ``path = self._seg_path(seq)`` without real dataflow."""
+        out: List[str] = []
+
+        def consts_of(e) -> None:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str):
+                    out.append(n.value)
+
+        consts_of(expr)
+        names = {n.id for n in ast.walk(expr)
+                 if isinstance(n, ast.Name)}
+        for n in ast.walk(func_node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id in names:
+                        consts_of(n.value)
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                name = astcommon.terminal(n.func)
+                owner = astcommon.terminal(n.func.value) \
+                    if isinstance(n.func, ast.Attribute) else None
+                callee = self.calls.resolve(cls, name, owner) \
+                    if name else None
+                if callee is not None:
+                    consts_of(callee.node)
+        # one level deeper: calls inside the resolved assignments
+        # (``path = self._seg_path(seq)`` -> _seg_path's f-string)
+        for n in ast.walk(func_node):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id in names
+                    for t in n.targets):
+                for c in ast.walk(n.value):
+                    if isinstance(c, ast.Call):
+                        name = astcommon.terminal(c.func)
+                        owner = astcommon.terminal(c.func.value) \
+                            if isinstance(c.func, ast.Attribute) \
+                            else None
+                        callee = self.calls.resolve(cls, name, owner) \
+                            if name else None
+                        if callee is not None:
+                            consts_of(callee.node)
+        return out
+
+    # ----------------------------------------- rule 4: loud-recovery
+
+    def lint_loud_recovery(self) -> List[str]:
+        problems: List[str] = []
+        for rel in sorted(self.files):
+            if not _in_paths(rel, _RECOVERY_PATHS):
+                continue
+            info = self.files[rel]
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Try):
+                    continue
+                if not self._try_parses_durable_state(node):
+                    continue
+                for handler in node.handlers:
+                    if self._handler_is_loud(handler):
+                        continue
+                    if info.suppressed(handler.lineno):
+                        continue
+                    problems.append(
+                        f"{rel}:{handler.lineno}: [loud-recovery] "
+                        "silent exception handler over durable-state "
+                        "parsing — recovery must raise, log, or "
+                        "return the documented refusal; a swallowed "
+                        "parse failure serves a half-truth as "
+                        "everything (audit with `# dur-ok: <reason>` "
+                        "only if the swallow is the contract)")
+        return problems
+
+    @staticmethod
+    def _try_parses_durable_state(node: ast.Try) -> bool:
+        for sub in node.body:
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Call) and \
+                        astcommon.terminal(n.func) in _PARSE_CALLS:
+                    return True
+        return False
+
+    @staticmethod
+    def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, (ast.Raise, ast.Return)):
+                return True
+            if isinstance(n, ast.Call):
+                name = astcommon.terminal(n.func)
+                owner = astcommon.terminal(n.func.value) \
+                    if isinstance(n.func, ast.Attribute) else None
+                if owner in ("log", "logger", "logging") or name in (
+                        "error", "warning", "exception", "critical"):
+                    return True
+        return False
+
+    # ------------------------------------------- rule 5: torn-frame
+
+    def lint_torn_frame(self) -> List[str]:
+        problems: List[str] = []
+        seen: Set[Tuple[str, str]] = set()
+        for rel in sorted(self.files):
+            if not _in_paths(rel, _DURABLE_WRITE_MODULES):
+                continue
+            info = self.files[rel]
+            for node in ast.walk(info.tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, bytes)):
+                    continue
+                for t in node.targets:
+                    if not (isinstance(t, ast.Name)
+                            and "MAGIC" in t.id.upper()):
+                        continue
+                    key = (rel, t.id)
+                    seen.add(key)
+                    if key not in _FRAMED_FORMATS:
+                        problems.append(
+                            f"{rel}:{node.lineno}: [torn-frame] "
+                            f"framed-format magic {t.id} is not "
+                            "registered in _FRAMED_FORMATS — a framed "
+                            "writer ships WITH its paired loader and "
+                            "an every-byte-torn test (the registry is "
+                            "the contract)")
+        # registry drift: only entries whose module is in THIS tree
+        # (fixture roots carry none of the real modules)
+        for (rel, var), contract in sorted(_FRAMED_FORMATS.items()):
+            info = self.files.get(rel)
+            if info is None:
+                continue
+            if (rel, var) not in seen:
+                problems.append(
+                    f"{rel}: [torn-frame] registered magic {var} no "
+                    "longer exists — prune the _FRAMED_FORMATS entry "
+                    "or restore the format")
+                continue
+            loader = contract["loader"]
+            if not any(node.name == loader for _cls, node
+                       in astcommon.walk_functions(info.tree)):
+                problems.append(
+                    f"{rel}: [torn-frame] registered loader "
+                    f"{loader}() for {var} not found in the module — "
+                    "the torn-frame pairing rotted")
+            test_path = os.path.join(self.root, contract["torn_test"])
+            hook = contract["torn_hook"]
+            if not os.path.exists(test_path):
+                problems.append(
+                    f"{contract['torn_test']}: [torn-frame] torn test "
+                    f"file for {var} is missing")
+            else:
+                with open(test_path) as f:
+                    if hook not in f.read():
+                        problems.append(
+                            f"{contract['torn_test']}: [torn-frame] "
+                            f"every-byte-torn hook {hook} for {var} "
+                            "not found — the loader is no longer "
+                            "exercised against torn frames")
+        return problems
+
+    # --------------------------------------- suppression reason hygiene
+
+    def lint_dur_ok_reasons(self) -> List[str]:
+        """A ``# dur-ok`` with no reason defeats the audit trail the
+        suppression exists to create — itself a finding."""
+        problems = []
+        for rel in sorted(self.files):
+            for ln, reason in self.files[rel].suppress_sites:
+                if not reason:
+                    problems.append(
+                        f"{rel}:{ln}: [dur-ok-reason] `# dur-ok` "
+                        "without a reason — write `# dur-ok: <why "
+                        "this site may deviate from the durability "
+                        "protocol>`")
+        return problems
+
+
+def lint(root: str) -> List[str]:
+    an = _Analyzer(root)
+    problems = an.load()
+    problems.extend(an.lint_atomic_publish())
+    problems.extend(an.lint_commit_point())
+    problems.extend(an.lint_immutable())
+    problems.extend(an.lint_loud_recovery())
+    problems.extend(an.lint_torn_frame())
+    problems.extend(an.lint_dur_ok_reasons())
+    return problems
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else repo_root()
+    problems = lint(root)
+    if problems:
+        print(f"durability_lint: {len(problems)} finding(s):",
+              file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print("durability_lint: OK — publish protocol, commit-point "
+          "ordering, immutable files, recovery loudness, and the "
+          "torn-frame registry are clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,11 @@ runs, over the ONE shared path list (``SUITE_PATHS``):
 - **concurrency_lint** — concurrency discipline: blocking calls under
   a lock (suppress with ``# lock-ok: <reason>``), lock acquisition
   order, config-knob routing + coverage
+- **durability_lint** — durability protocol (ISSUE 15): atomic
+  publishes (fsync + rename + dir fsync), commit-point ordering
+  (unlink only after the rename that obsoletes), immutable-file and
+  torn-frame contracts, loud recovery (suppress with
+  ``# dur-ok: <reason>``)
 - **stats-dashboard** (lives here) — every metric family registered
   in antidote_tpu/stats.py must appear in the Grafana dashboard or
   monitoring/README.md: PR 5-9 each hand-maintained that mapping and
@@ -29,18 +34,27 @@ single tier-1 gate, so an analyzer added to ``PASSES`` is gated from
 the commit that adds it.  To add a pass: write ``lint(root) ->
 [str]`` in a tools/ module, append ``(name, fn)`` to ``PASSES``, and
 add a fixture test proving the rule fires.
+
+``--json`` (ISSUE 15 satellite) emits the machine-readable form —
+per-pass finding lists, counts, and wall-clock ms — so the CI log is
+greppable and a slow pass is attributable:
+
+    python -m tools.static_suite --json | jq '.passes[] | {name, ms}'
 """
 
 from __future__ import annotations
 
 import ast
+import json
 import os
 import sys
+import time
 from typing import Callable, List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import analysis_gate  # noqa: E402
 import concurrency_lint  # noqa: E402
+import durability_lint  # noqa: E402
 import trace_lint  # noqa: E402
 
 #: the one shared path list: everything the hygiene pass sweeps.  The
@@ -113,17 +127,34 @@ PASSES: Tuple[Tuple[str, Callable[[str], List[str]]], ...] = (
     ("analysis_gate", _gate),
     ("trace_lint", trace_lint.lint),
     ("concurrency_lint", concurrency_lint.lint),
+    ("durability_lint", durability_lint.lint),
     ("stats-dashboard", lint_stats_dashboard),
 )
 
 
+def run_timed(root: str | None = None) -> List[dict]:
+    """Every pass with its findings, count and wall-clock ms — the
+    machine-readable form ``--json`` emits, and what :func:`run`
+    flattens.  Timing rides along so a slow pass in CI is attributable
+    to its analyzer instead of 'the suite got slow'."""
+    root = root or repo_root()
+    out: List[dict] = []
+    for name, fn in PASSES:
+        t0 = time.perf_counter()
+        findings = fn(root)
+        out.append({
+            "name": name,
+            "findings": findings,
+            "count": len(findings),
+            "ms": round((time.perf_counter() - t0) * 1e3, 2),
+        })
+    return out
+
+
 def run(root: str | None = None) -> List[str]:
     """Every pass's findings, prefixed with the pass name."""
-    root = root or repo_root()
-    problems: List[str] = []
-    for name, fn in PASSES:
-        problems.extend(f"{name}: {p}" for p in fn(root))
-    return problems
+    return [f"{p['name']}: {f}"
+            for p in run_timed(root) for f in p["findings"]]
 
 
 def repo_root() -> str:
@@ -132,7 +163,19 @@ def repo_root() -> str:
 
 def main(argv: List[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else repo_root()
+    as_json = "--json" in argv
+    rest = [a for a in argv if a != "--json"]
+    root = rest[0] if rest else repo_root()
+    if as_json:
+        passes = run_timed(root)
+        total = sum(p["count"] for p in passes)
+        print(json.dumps({
+            "ok": total == 0,
+            "total_findings": total,
+            "total_ms": round(sum(p["ms"] for p in passes), 2),
+            "passes": passes,
+        }, indent=2))
+        return 1 if total else 0
     problems = run(root)
     if problems:
         print(f"static_suite: {len(problems)} finding(s) across "
