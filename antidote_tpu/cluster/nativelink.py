@@ -53,8 +53,12 @@ from antidote_tpu.cluster.link import (
     _err_kind,
     _raise_remote,
 )
+from antidote_tpu.obs import nativeobs
 
 log = logging.getLogger(__name__)
+
+#: events per telemetry drain call (ring capacity: one call empties it)
+_TEL_DRAIN_MAX = nativeobs.RING_CAPACITY
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -161,7 +165,8 @@ class _Lib:
         self.nl_publish.restype = None
         self.nl_publish.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_long, ctypes.c_char_p,
-                                    ctypes.c_long, ctypes.c_ulonglong]
+                                    ctypes.c_long, ctypes.c_ulonglong,
+                                    ctypes.c_int]
         self.nl_publish_clear = quick.nl_publish_clear
         self.nl_publish_clear.restype = None
         self.nl_publish_clear.argtypes = [ctypes.c_void_p]
@@ -173,6 +178,24 @@ class _Lib:
         self.nl_counters.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong),
             ctypes.c_int]
+        # the telemetry plane (ISSUE 16): the cursor/enable pair is
+        # atomics-only (no mutex, no syscall) — quick class; the drain
+        # is a bulk memcpy of up to 128 KiB — CDLL class, GIL released,
+        # never called inside a lock region
+        self.nl_tel_cursor = quick.nl_tel_cursor
+        self.nl_tel_cursor.restype = ctypes.c_int
+        self.nl_tel_cursor.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.c_int]
+        self.nl_tel_enable = quick.nl_tel_enable
+        self.nl_tel_enable.restype = None
+        self.nl_tel_enable.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        self.nl_tel_drain = slow.nl_tel_drain
+        self.nl_tel_drain.restype = ctypes.c_long
+        self.nl_tel_drain.argtypes = [
+            ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_void_p,
+            ctypes.c_long, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong)]
 
 
 def native_available() -> bool:
@@ -242,6 +265,18 @@ class NativeNodeLink:
         #: drains in microseconds once nl_shutdown ran
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        # telemetry plane (ISSUE 16): the drain cursor + cumulative
+        # overwrite losses live HERE (the C side only knows head); the
+        # buffer is reused across drains so the 50 ms cadence never
+        # allocates.  The watchdog probe is registered per endpoint —
+        # a process hosting several DCs watches each one's ring.
+        self._tel_tail = 0
+        self._tel_dropped = 0
+        self._tel_buf = ctypes.create_string_buffer(
+            nativeobs.EVENT_SIZE * _TEL_DRAIN_MAX)
+        self._tel_enabled = True
+        self._tel_name = f"nodelink:{node_id}"
+        nativeobs.watchdog.register(self._tel_name, self._tel_probe)
 
     # ------------------------------------------------------------- server
 
@@ -339,8 +374,13 @@ class NativeNodeLink:
                     # reply — a native answer is byte-identical to
                     # the Python handler's
                     key = frame[:rid_s] + frame[rid_e:]
-                    self._lib.nl_publish(self._h, key, len(key),
-                                         reply, len(reply), gen)
+                    # the interned kind id rides along so the event
+                    # thread's TEL_EV_ANSWER reports WHICH rpc it
+                    # served (interning is a dict hit on the worker
+                    # path — never the native answer path)
+                    self._lib.nl_publish(
+                        self._h, key, len(key), reply, len(reply), gen,
+                        nativeobs.kind_interner.id_of(kind))
                 pos += 28 + plen
 
     # ----------------------------------------------------- answer plane
@@ -377,6 +417,92 @@ class NativeNodeLink:
             self._untrack()
         keys = ("native_answered", "published", "inq_depth")
         return {k: int(out[i]) for i, k in enumerate(keys[:n])}
+
+    # ------------------------------------------------------ telemetry
+
+    def set_telemetry(self, on: bool) -> None:
+        """Flip event recording (Config.native_telemetry; heartbeats
+        keep beating either way, so the watchdog still works)."""
+        try:
+            self._track()
+        except LinkDown:
+            return
+        try:
+            self._lib.nl_tel_enable(self._h, 1 if on else 0)
+            self._tel_enabled = bool(on)
+        finally:
+            self._untrack()
+
+    def _tel_probe(self) -> int:
+        """Watchdog probe: the ring's last-heartbeat wall-ns (0 =
+        endpoint gone).  PyDLL cursor read — atomics only."""
+        out = (ctypes.c_ulonglong * 3)()
+        try:
+            self._track()
+        except LinkDown:
+            return 0
+        try:
+            self._lib.nl_tel_cursor(self._h, out, 3)
+        finally:
+            self._untrack()
+        return int(out[2])
+
+    def telemetry_drain(self, max_events: int = _TEL_DRAIN_MAX) -> int:
+        """Drain the endpoint's flight-recorder ring into the NATIVE_*
+        families; returns the events folded.  Rides the gossip tick /
+        /debug/pipeline pulls — never a hot path, and never inside a
+        lock region (nl_tel_drain is CDLL class)."""
+        try:
+            self._track()
+        except LinkDown:
+            return 0
+        try:
+            cur = (ctypes.c_ulonglong * 3)()
+            self._lib.nl_tel_cursor(self._h, cur, 3)
+            head, hb_wall = int(cur[0]), int(cur[2])
+            n = 0
+            if head != self._tel_tail:
+                new_tail = ctypes.c_ulonglong()
+                dropped = ctypes.c_ulonglong()
+                n = int(self._lib.nl_tel_drain(
+                    self._h, self._tel_tail, self._tel_buf,
+                    min(max_events, _TEL_DRAIN_MAX),
+                    ctypes.byref(new_tail), ctypes.byref(dropped)))
+                self._tel_tail = int(new_tail.value)
+                self._tel_dropped += int(dropped.value)
+                if n > 0:
+                    nativeobs.fold_events(
+                        nativeobs.decode_events(self._tel_buf, n))
+            nativeobs.publish_ring_gauges(
+                "nodelink", hb_wall, self._tel_dropped, head,
+                self._tel_tail)
+            return n
+        finally:
+            self._untrack()
+
+    def telemetry_info(self) -> dict:
+        """The ring's /debug/pipeline face: occupancy, losses,
+        heartbeat age (nativeobs-shaped; obs/pipeline.py embeds it)."""
+        out = (ctypes.c_ulonglong * 3)()
+        try:
+            self._track()
+        except LinkDown:
+            return {}
+        try:
+            self._lib.nl_tel_cursor(self._h, out, 3)
+        finally:
+            self._untrack()
+        head = int(out[0])
+        return {
+            "head": head,
+            "tail": self._tel_tail,
+            "occupancy": min(head - self._tel_tail,
+                             nativeobs.RING_CAPACITY),
+            "dropped_events": self._tel_dropped,
+            "heartbeat_count": int(out[1]),
+            "heartbeat_age_s": nativeobs.heartbeat_age_s(int(out[2])),
+            "enabled": self._tel_enabled,
+        }
 
     # ------------------------------------------------------------- client
 
@@ -611,6 +737,7 @@ class NativeNodeLink:
             if self._closed:
                 return
             self._closed = True
+        nativeobs.watchdog.unregister(self._tel_name)
         self._lib.nl_shutdown(self._h)
         for t in self._workers:
             t.join(timeout=5.0)

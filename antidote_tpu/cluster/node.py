@@ -82,9 +82,14 @@ def build_link(node_id, host: str = "127.0.0.1", port: int = 0,
         from antidote_tpu.cluster import nativelink
 
         if nativelink.native_available():
-            return nativelink.NativeNodeLink(
+            link = nativelink.NativeNodeLink(
                 node_id, host=host, port=port,
                 workers=cfg.fabric_workers)
+            if not cfg.native_telemetry:
+                # heartbeats keep beating with recording off, so the
+                # stall watchdog still covers this endpoint
+                link.set_telemetry(False)
+            return link
         if cfg.fabric_native is True:
             raise RuntimeError(
                 "Config.fabric_native=True but the native node fabric "
@@ -273,6 +278,12 @@ class NodeServer:
                 host, port = planned
         self.link = build_link(node_id, host=host, port=port,
                                config=self.config)
+        # native-plane flight recorder (ISSUE 16): the stall threshold
+        # is process-global like stats.registry — every node in one
+        # process shares the Config default anyway
+        from antidote_tpu.obs import nativeobs
+
+        nativeobs.watchdog.threshold_s = self.config.native_watchdog_s
         # every attribute _handle touches must exist BEFORE serve():
         # a restarting member's peers dial the advertised address the
         # moment it binds, and a gossip arriving mid-__init__ used to
@@ -625,6 +636,7 @@ class NodeServer:
                 except Exception:  # noqa: BLE001 — down peer
                     self._peer_backoff[peer] = now + 2.0
         self._refresh_fabric_gauges()
+        self._native_telemetry_tick()
 
     def _refresh_fabric_gauges(self, counters=None) -> None:
         """Pull the C++ endpoint's answer-plane counters into the
@@ -646,6 +658,22 @@ class NodeServer:
                 c["native_answered"])
         if "published" in c:
             stats.registry.fabric_published.set(c["published"])
+
+    def _native_telemetry_tick(self) -> None:
+        """Drain the node link's flight-recorder ring into the NATIVE_*
+        families and run the stall watchdog (ISSUE 16).  Rides the
+        gossip cadence like the fabric gauges — never a hot path; the
+        fabric hub's ring drains on the transport's own 50 ms gauge
+        cadence instead."""
+        from antidote_tpu.obs import nativeobs
+
+        drain = getattr(self.link, "telemetry_drain", None)
+        if drain is not None:
+            try:
+                drain()
+            except Exception:  # noqa: BLE001 — closing endpoint
+                pass
+        nativeobs.watchdog.check()
 
     # ----------------------------------------------------------- RPC server
 

@@ -156,6 +156,17 @@ class Config:
     #: reference's per-vnode read-server pool is 20,
     #: include/antidote.hrl:28)
     fabric_workers: int = 16
+    #: native-plane flight recorder (ISSUE 16): the C++ fabrics record
+    #: fixed-size events into wait-free rings that Python drains into
+    #: the NATIVE_* stats families and the sampled trace stream.
+    #: False stops event recording (the rings' heartbeats keep
+    #: beating, so the stall watchdog below still works)
+    native_telemetry: bool = True
+    #: native event-thread stall threshold, seconds: a ring heartbeat
+    #: older than this force-dumps the flight recorder with the
+    #: /debug/pipeline snapshot embedded (one dump per stall episode);
+    #: 0 disables the watchdog
+    native_watchdog_s: float = 5.0
     #: reload DC descriptors / env flags from disk at boot (reference
     #: recover_meta_data_on_start)
     recover_meta_data_on_start: bool = True

@@ -367,13 +367,30 @@ class InterDcLogSender:
                     # produced here, still ordered by _pub_lock
                     frame = frame.to_bin()
                 if kind == "batch":
+                    # a telemetry-capable transport (accepts_txids,
+                    # ISSUE 16) takes the frame's SAMPLED txids along
+                    # so the native hub can attribute the frame's
+                    # fan-out telemetry back to them (the native_fanout
+                    # span in txn_journey trees); every other transport
+                    # keeps the plain publish(origin, data) signature —
+                    # test stubs and external buses never see the kwarg
+                    txids = ()
+                    if getattr(self.transport, "accepts_txids", False):
+                        txids = tuple(
+                            txid for txn in meta.txns()
+                            if (txid := getattr(txn.records[-1], "txid",
+                                                None)) is not None
+                            and tracer.sampled(txid))
+                    # the kwarg only exists when the transport opted
+                    # in above — plain buses keep publish(origin, data)
+                    kw = {"txids": txids} if txids else {}
                     with tracer.span("interdc_send_batch", "interdc",
                                      partition=self.partition,
                                      dc=str(self.dc_id), txns=ntxns):
                         # lock-ok: _pub_lock EXISTS to order publishes
                         # — only the async ship worker and close take
                         # it, never the commit path
-                        self.transport.publish(self.dc_id, frame)
+                        self.transport.publish(self.dc_id, frame, **kw)
                     for txn in meta.txns():
                         txid = getattr(txn.records[-1], "txid", None)
                         tracer.instant("interdc_send", "interdc",

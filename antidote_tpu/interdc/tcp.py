@@ -48,9 +48,11 @@ import socket
 import struct
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from antidote_tpu import stats
+from antidote_tpu.obs import nativeobs
 from antidote_tpu.interdc import termcodec
 from antidote_tpu.interdc.transport import LinkDown, Transport
 from antidote_tpu.interdc.wire import DcDescriptor
@@ -209,6 +211,11 @@ class _FabLib:
       mutex) binds via ``PyDLL`` (GIL held): a CDLL call re-acquires
       the GIL on return, which against busy threads costs up to a
       scheduler timeslice per call.
+
+    The telemetry plane (ISSUE 16) splits the same way: the
+    cursor/enable pair is atomics-only (no mutex, no syscall) — quick
+    class; the drain is a bulk memcpy of up to 128 KiB — CDLL class,
+    GIL released, never called inside a lock region.
     """
 
     def __init__(self, path: str):
@@ -220,7 +227,9 @@ class _FabLib:
         self.fab_create.restype = ctypes.c_void_p
         self.fab_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
         self.fab_publish = slow.fab_publish
-        self.fab_publish.restype = ctypes.c_int
+        # returns the frame's publish seq (> 0, monotonic) — the key
+        # the telemetry drain joins SUB_DRAIN events back to txids on
+        self.fab_publish.restype = ctypes.c_longlong
         self.fab_publish.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
         self.fab_close = slow.fab_close
@@ -235,6 +244,20 @@ class _FabLib:
         self.fab_queued_bytes = slow.fab_queued_bytes
         self.fab_queued_bytes.restype = ctypes.c_longlong
         self.fab_queued_bytes.argtypes = [ctypes.c_void_p]
+        self.fab_tel_cursor = quick.fab_tel_cursor
+        self.fab_tel_cursor.restype = ctypes.c_int
+        self.fab_tel_cursor.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.c_int]
+        self.fab_tel_enable = quick.fab_tel_enable
+        self.fab_tel_enable.restype = None
+        self.fab_tel_enable.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        self.fab_tel_drain = slow.fab_tel_drain
+        self.fab_tel_drain.restype = ctypes.c_long
+        self.fab_tel_drain.argtypes = [
+            ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_void_p,
+            ctypes.c_long, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong)]
 
 
 class TcpTransport(Transport):
@@ -254,7 +277,8 @@ class TcpTransport(Transport):
     def __init__(self, host: str = "127.0.0.1", pub_port: int = 0,
                  query_port: int = 0, connect_timeout: float = 5.0,
                  request_timeout: float = 30.0,
-                 native_pub: "bool | str" = "auto"):
+                 native_pub: "bool | str" = "auto",
+                 telemetry: bool = True):
         self.host = host
         self._pub_port = pub_port
         self._query_port = query_port
@@ -293,6 +317,23 @@ class TcpTransport(Transport):
         #: last hub gauge pull (fab_sub_count/fab_queued_bytes take
         #: the hub mutex — sampled on a cadence, not per frame)
         self._hub_gauge_t = 0.0
+        #: telemetry plane (ISSUE 16): drain cursor + cumulative
+        #: overwrite losses live here (C only knows head); the buffer
+        #: is reused so the 50 ms cadence never allocates
+        self._tel_tail = 0
+        self._tel_dropped = 0
+        self._tel_buf = None  # allocated with the hub (_open_native_hub)
+        self._tel_enabled = bool(telemetry)
+        self._tel_name: Optional[str] = None
+        self._tel_lock = threading.Lock()
+        #: single-drainer guard: concurrent publishers hitting the
+        #: gauge cadence together must not interleave cursor updates;
+        #: losers skip (try-acquire) rather than convoy
+        self._tel_drain_lock = threading.Lock()
+        #: publish seq (low 32) -> sampled txids the frame carried;
+        #: bounded FIFO (oldest evicted) — the drain joins SUB_DRAIN
+        #: events back to txids to emit native_fanout spans
+        self._seq_txids: "OrderedDict[int, tuple]" = OrderedDict()
         #: staged zero-copy Python fan-out (ISSUE 12): frame once,
         #: every subscriber sends views of the one staging buffer.
         #: False only under the full-legacy knob — the bench baseline.
@@ -320,6 +361,8 @@ class TcpTransport(Transport):
         return self._inbox
 
     def _open_native_hub(self):
+        import ctypes
+
         from antidote_tpu.native.build import ensure_built
 
         so = ensure_built("fabric")
@@ -330,6 +373,15 @@ class TcpTransport(Transport):
         if not hub:
             return None
         self._hub_lib = lib
+        self._tel_buf = ctypes.create_string_buffer(
+            nativeobs.EVENT_SIZE * nativeobs.RING_CAPACITY)
+        # the watchdog probe outlives a single drain cadence: a hub
+        # whose PUBLISHERS go quiet still beats (the event thread
+        # polls), so a stale heartbeat really means a wedged thread
+        self._tel_name = f"fabric:{self._dc_id}"
+        nativeobs.watchdog.register(self._tel_name, self._tel_probe)
+        if not self._tel_enabled:
+            lib.fab_tel_enable(hub, 0)
         return hub
 
     def unregister(self, dc_id) -> None:
@@ -403,7 +455,17 @@ class TcpTransport(Transport):
             if sender in self._subscribers:
                 self._subscribers.remove(sender)
 
-    def publish(self, origin, data: bytes) -> None:
+    #: seq -> txids attribution entries kept live; frames the drain
+    #: never joins (unsampled cadence gaps) age out by eviction
+    _TEL_SEQ_CAP = 512
+
+    #: opt-in span-attribution capability: the log sender only passes
+    #: ``txids=`` to transports that declare this — the base
+    #: publish(origin, data) signature stays the contract for
+    #: everything else (test stubs, InProcBus, external buses)
+    accepts_txids = True
+
+    def publish(self, origin, data: bytes, txids: Tuple = ()) -> None:
         with self._lock:
             hub = self._hub
             if hub is not None:
@@ -419,8 +481,17 @@ class TcpTransport(Transport):
                 senders = list(self._subscribers)
         if hub is not None:
             try:
-                self._hub_lib.fab_publish(hub, data, len(data))
+                seq = int(self._hub_lib.fab_publish(hub, data, len(data)))
                 stats.registry.pub_frames.inc()
+                if txids and seq > 0:
+                    # remember which sampled txns rode this frame so
+                    # the telemetry drain can hang native_fanout spans
+                    # off its SUB_DRAIN events (seq is the join key;
+                    # the ring stores its low 32 bits)
+                    with self._tel_lock:
+                        self._seq_txids[seq & 0xFFFFFFFF] = tuple(txids)
+                        while len(self._seq_txids) > self._TEL_SEQ_CAP:
+                            self._seq_txids.popitem(last=False)
                 # gauge pulls contend the hub mutex against the event
                 # thread's send sweep (CDLL — GIL released), so they
                 # ride a cadence instead of every frame: two extra
@@ -433,6 +504,11 @@ class TcpTransport(Transport):
                         self._hub_lib.fab_sub_count(hub))
                     stats.registry.hub_queued_bytes.set(
                         self._hub_lib.fab_queued_bytes(hub))
+                    # the flight-recorder drain rides the same cadence
+                    # (never per frame): quick cursor read, then a CDLL
+                    # bulk copy only when events are pending — still
+                    # under the busy refcount, still outside the lock
+                    self._telemetry_drain(hub)
             finally:
                 with self._hub_cv:
                     self._hub_busy -= 1
@@ -461,6 +537,126 @@ class TcpTransport(Transport):
                 # eliminates
                 stats.registry.pub_sub_copies.inc()
                 sender.offer(data)
+
+    # ----------------------------------------------------- telemetry plane
+
+    def _pin_hub(self):
+        """Take the busy refcount on the live hub (None = no hub);
+        close() waits it out before fab_close frees the C++ object."""
+        with self._lock:
+            hub = self._hub
+            if hub is None:
+                return None
+            self._hub_busy += 1
+        return hub
+
+    def _unpin_hub(self) -> None:
+        with self._hub_cv:
+            self._hub_busy -= 1
+            self._hub_cv.notify_all()
+
+    def set_telemetry(self, on: bool) -> None:
+        """Flip native event recording (Config.native_telemetry).
+        Heartbeats keep beating either way, so the watchdog still
+        works with recording off."""
+        self._tel_enabled = bool(on)
+        hub = self._pin_hub()
+        if hub is None:
+            return
+        try:
+            self._hub_lib.fab_tel_enable(hub, 1 if on else 0)
+        finally:
+            self._unpin_hub()
+
+    def _tel_probe(self) -> int:
+        """Watchdog probe: the hub ring's last-heartbeat wall-ns
+        (0 = hub gone).  PyDLL cursor read — atomics only."""
+        import ctypes
+
+        hub = self._pin_hub()
+        if hub is None:
+            return 0
+        try:
+            out = (ctypes.c_ulonglong * 4)()
+            self._hub_lib.fab_tel_cursor(hub, out, 4)
+            return int(out[2])
+        finally:
+            self._unpin_hub()
+
+    def telemetry_drain(self,
+                        max_events: int = nativeobs.RING_CAPACITY) -> int:
+        """Drain the hub's flight-recorder ring into the NATIVE_*
+        families; returns events folded.  Public face for the gossip
+        tick and tests; publish()'s gauge cadence calls the pinned
+        inner helper directly."""
+        hub = self._pin_hub()
+        if hub is None:
+            return 0
+        try:
+            return self._telemetry_drain(hub, max_events)
+        finally:
+            self._unpin_hub()
+
+    def _telemetry_drain(self, hub,
+                         max_events: int = nativeobs.RING_CAPACITY) -> int:
+        """Caller holds the busy refcount.  Quick cursor read; CDLL
+        bulk copy only when events are pending (never inside a lock
+        region — the [gil-policy] drain class)."""
+        import ctypes
+
+        if not self._tel_drain_lock.acquire(blocking=False):
+            return 0  # another publisher is mid-drain; skip, not wait
+        try:
+            cur = (ctypes.c_ulonglong * 4)()
+            self._hub_lib.fab_tel_cursor(hub, cur, 4)
+            head, hb_wall, oldest = int(cur[0]), int(cur[2]), int(cur[3])
+            n = 0
+            if head != self._tel_tail and self._tel_buf is not None:
+                new_tail = ctypes.c_ulonglong()
+                dropped = ctypes.c_ulonglong()
+                n = int(self._hub_lib.fab_tel_drain(
+                    hub, self._tel_tail, self._tel_buf,
+                    min(max_events, nativeobs.RING_CAPACITY),
+                    ctypes.byref(new_tail), ctypes.byref(dropped)))
+                self._tel_tail = int(new_tail.value)
+                self._tel_dropped += int(dropped.value)
+                if n > 0:
+                    with self._tel_lock:
+                        seq_txids = dict(self._seq_txids)
+                    nativeobs.fold_events(
+                        nativeobs.decode_events(self._tel_buf, n),
+                        seq_txids=seq_txids)
+            nativeobs.publish_ring_gauges(
+                "fabric", hb_wall, self._tel_dropped, head,
+                self._tel_tail, oldest_enq_ns=oldest)
+            return n
+        finally:
+            self._tel_drain_lock.release()
+
+    def telemetry_info(self) -> dict:
+        """The hub ring's /debug/pipeline face: occupancy, losses,
+        heartbeat age (obs/pipeline.py embeds it)."""
+        import ctypes
+
+        hub = self._pin_hub()
+        if hub is None:
+            return {}
+        try:
+            out = (ctypes.c_ulonglong * 4)()
+            self._hub_lib.fab_tel_cursor(hub, out, 4)
+        finally:
+            self._unpin_hub()
+        head = int(out[0])
+        return {
+            "head": head,
+            "tail": self._tel_tail,
+            "occupancy": min(head - self._tel_tail,
+                             nativeobs.RING_CAPACITY),
+            "dropped_events": self._tel_dropped,
+            "heartbeat_count": int(out[1]),
+            "heartbeat_age_s": nativeobs.heartbeat_age_s(int(out[2])),
+            "enabled": self._tel_enabled,
+        }
 
     # ----------------------------------------------------- subscribe side
 
@@ -589,6 +785,8 @@ class TcpTransport(Transport):
 
     def close(self) -> None:
         self._stop.set()
+        if self._tel_name is not None:
+            nativeobs.watchdog.unregister(self._tel_name)
         with self._lock:
             hub, self._hub = self._hub, None
         if hub is not None:
@@ -656,4 +854,5 @@ def transport_from_config(config=None, **kwargs) -> TcpTransport:
         raise ValueError(
             f"Config.fabric_native must be 'auto', True, or False "
             f"(got {cfg.fabric_native!r})")
+    kwargs.setdefault("telemetry", cfg.native_telemetry)
     return TcpTransport(native_pub=cfg.fabric_native, **kwargs)

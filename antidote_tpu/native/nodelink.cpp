@@ -74,6 +74,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "tel_ring.h"
+
 namespace {
 
 constexpr size_t kMaxFrame = 256u << 20;  // payload cap, either direction
@@ -164,6 +166,14 @@ struct Peer {
     std::deque<OutFrame> predial;  // frames queued before the dial
 };
 
+//: a published answer plus the rpc-kind id Python interned for it —
+//: the TEL_EV_ANSWER event reports the kind, turning the flat
+//: native_answered count into a per-kind latency family (ISSUE 16)
+struct PubAns {
+    Bytes data;
+    uint16_t kind = 0;
+};
+
 struct Ep {
     int listen_fd = -1;
     uint16_t port = 0;
@@ -184,10 +194,14 @@ struct Ep {
     //: Bounded FIFO (pub_order) so a hot server cannot grow it
     //: without limit; Python clears it wholesale on any state change
     //: that could invalidate an answer (truncation, ring moves).
-    std::unordered_map<std::string, Bytes> published;
+    std::unordered_map<std::string, PubAns> published;
     std::deque<std::string> pub_order;
     size_t pub_cap = 4096;
     uint64_t native_answered = 0;
+    //: flight-recorder ring (ISSUE 16): written ONLY by the event
+    //: thread (deliver_all's native-answer branch, under the mutex it
+    //: already holds) — single producer, zero added crossings
+    tel::TelRing tel;
     //: invalidation generation: bumped by every wholesale clear, and
     //: nl_publish only installs an answer published AT the current
     //: generation — a worker that computed its reply before a
@@ -378,6 +392,7 @@ void deliver_all(Ep* ep, std::vector<Parsed>* parsed) {
                 bool keyed = rid_span(p.body->data(),
                                       (long)p.body->size(), &rs, &re);
                 if (keyed && !ep->published.empty()) {
+                    uint64_t t0 = tel::wall_ns();
                     std::string key;
                     key.reserve(p.body->size() - (re - rs));
                     key.append((const char*)p.body->data(), rs);
@@ -385,8 +400,16 @@ void deliver_all(Ep* ep, std::vector<Parsed>* parsed) {
                                p.body->size() - re);
                     auto hit = ep->published.find(key);
                     if (hit != ep->published.end()) {
-                        queue_reply(p.conn, p.corr, hit->second);
+                        queue_reply(p.conn, p.corr, hit->second.data);
                         ep->native_answered++;
+                        // dur = key build + lookup + reply queue: the
+                        // native answer's whole serve cost (the wire
+                        // halves live in the peer's own telemetry)
+                        ep->tel.emit(
+                            tel::TEL_EV_ANSWER, hit->second.kind,
+                            tel::sat_u32(tel::wall_ns() - t0),
+                            (uint32_t)hit->second.data->size(),
+                            (uint32_t)ep->pub_gen);
                         continue;
                     }
                 }
@@ -498,6 +521,7 @@ void event_loop(Ep* ep) {
     std::vector<Conn*> snap;
     std::vector<Parsed> parsed;
     for (;;) {
+        ep->tel.beat();  // liveness: frozen count+wall = wedged thread
         pfds.clear();
         snap.clear();
         {
@@ -643,6 +667,8 @@ void* nl_create(const char* host, int port) {
     ep->wake_w = pipefd[1];
     set_nonblock(ep->wake_r);
     set_nonblock(ep->wake_w);
+    ep->tel.beat();  // a watchdog probing before the thread's first
+                     // iteration must see "just born", not "wedged"
     ep->thread = std::thread(event_loop, ep);
     return ep;
 }
@@ -822,10 +848,13 @@ long nl_recv_batch(void* hp, uint8_t* out, long cap, int timeout_ms,
 // invalidation generation the publisher read (nl_pub_gen) BEFORE
 // computing the answer: a clear that raced the handler bumped it, and
 // the stale answer is silently dropped here instead of resurrecting
-// into the freshly-cleared table.
+// into the freshly-cleared table.  `kind` is the rpc-kind id the
+// Python side interned for this answer's RPC name (0 = unknown) — the
+// TEL_EV_ANSWER event reports it so native answer latency is a
+// per-kind family, not a flat count.
 void nl_publish(void* hp, const uint8_t* key, long klen,
                 const uint8_t* reply, long rlen,
-                unsigned long long gen) {
+                unsigned long long gen, int kind) {
     Ep* ep = (Ep*)hp;
     if (klen <= 0 || rlen < 0 || (size_t)rlen > kMaxFrame) return;
     auto data = std::make_shared<std::vector<uint8_t>>(reply,
@@ -836,14 +865,15 @@ void nl_publish(void* hp, const uint8_t* key, long klen,
     auto it = ep->published.find(k);
     if (it == ep->published.end()) {
         ep->pub_order.push_back(k);
-        ep->published.emplace(std::move(k), std::move(data));
+        ep->published.emplace(
+            std::move(k), PubAns{std::move(data), (uint16_t)kind});
         while (ep->published.size() > ep->pub_cap &&
                !ep->pub_order.empty()) {
             ep->published.erase(ep->pub_order.front());
             ep->pub_order.pop_front();
         }
     } else {
-        it->second = std::move(data);
+        it->second = PubAns{std::move(data), (uint16_t)kind};
     }
 }
 
@@ -957,6 +987,51 @@ int nl_reply(void* hp, unsigned long long conn_token,
         }
     }
     return 0;
+}
+
+// Telemetry cursor — atomics only (no mutex, no syscall): safe as a
+// PyDLL quick call from any thread, including inside lock regions.
+// out[0]=head (next event number), out[1]=heartbeat count,
+// out[2]=heartbeat wall-ns.  Returns slots filled.
+int nl_tel_cursor(void* hp, unsigned long long* out, int n) {
+    Ep* ep = (Ep*)hp;
+    int filled = 0;
+    if (n > 0) {
+        out[0] = ep->tel.head.load(std::memory_order_acquire);
+        filled = 1;
+    }
+    if (n > 1) {
+        out[1] = ep->tel.hb_count.load(std::memory_order_relaxed);
+        filled = 2;
+    }
+    if (n > 2) {
+        out[2] = ep->tel.hb_wall_ns.load(std::memory_order_relaxed);
+        filled = 3;
+    }
+    return filled;
+}
+
+// Bulk-copy events from the caller's cursor into buf (max_events *
+// 32 B).  Lock-free but a real memcpy of up to 128 KiB — CDLL class
+// (GIL released), never inside a lock region.  Returns events copied;
+// *new_tail advances past everything considered, *dropped counts
+// events overwritten before/during the copy (see tel_ring.h).
+long nl_tel_drain(void* hp, unsigned long long tail, uint8_t* buf,
+                  long max_events, unsigned long long* new_tail,
+                  unsigned long long* dropped) {
+    Ep* ep = (Ep*)hp;
+    uint64_t nt = 0, dr = 0;
+    long n = ep->tel.drain(tail, buf, max_events, &nt, &dr);
+    *new_tail = nt;
+    *dropped = dr;
+    return n;
+}
+
+// Flip event recording (heartbeats keep beating either way) — one
+// relaxed atomic store: PyDLL quick class.
+void nl_tel_enable(void* hp, int on) {
+    ((Ep*)hp)->tel.enabled.store(on ? 1 : 0,
+                                 std::memory_order_relaxed);
 }
 
 // Stop the event loop and fail every waiter.  Safe to call while other

@@ -29,9 +29,15 @@ aggregates, in one JSON document per registered DataCenter:
   transport kind, native-answered RPC count (the GIL never taken),
   live published answers, inbound queue depth (cluster/nativelink.py
   fabric_counters; refreshes the FABRIC_* gauges on every read);
+- **native**: the native-plane flight recorder's rings (ISSUE 16) —
+  per-ring occupancy, drain cursors, overwrite losses, and heartbeat
+  age for the node link's and the fabric hub's telemetry rings
+  (cluster/nativelink.py, interdc/tcp.py);
 - **threads** (top level): component-named live threads
-  (``antidote-fab-*`` / ``antidote-sub-*`` / ``antidote-nl-*``), so a
-  stall dump names the blocked component instead of ``Thread-N``.
+  (``antidote-fab-*`` / ``antidote-sub-*`` / ``antidote-nl-*``) with
+  live counts, so a stall dump names the blocked component instead of
+  ``Thread-N``; native C++ event threads appear as ``native-<ring>``
+  entries carrying their last-heartbeat age (ISSUE 16).
 
 Served at ``GET /debug/pipeline`` by the metrics server (stats.py),
 embedded in causal-probe violation dumps (obs/probe.py), and attached
@@ -178,17 +184,49 @@ def _fabric_section(dc) -> Dict[str, Any]:
     return out
 
 
-def _threads_section() -> Dict[str, int]:
+def _native_section(dc) -> Dict[str, Any]:
+    """The native-plane flight recorder's rings (ISSUE 16): per-ring
+    occupancy, drain cursors, cumulative overwrite losses, heartbeat
+    age, and the enable flag — the node link's ring and (when this DC
+    publishes through the C++ hub) the fabric hub's.  Quick cursor
+    reads only (atomics, PyDLL class); the DRAIN rides its own
+    cadences, never a pipeline read."""
+    out: Dict[str, Any] = {}
+    link = getattr(getattr(dc, "srv", None), "link", None)
+    info = getattr(link, "telemetry_info", None)
+    if info is not None:
+        d = info()
+        if d:
+            out["nodelink"] = d
+    info = getattr(getattr(dc, "bus", None), "telemetry_info", None)
+    if info is not None:
+        d = info()
+        if d:
+            out["fabric"] = d
+    return out
+
+
+def _threads_section() -> Dict[str, Any]:
     """Component-named live threads (ISSUE 12): every transport /
     fabric / sub-sender thread carries an ``antidote-*`` name
     (``antidote-fab-*``, ``antidote-sub-*``, ``antidote-nl-*``), so
     stall forensics and the causal-probe dumps attribute a blocked
-    send to a component instead of ``Thread-N``.  Name -> live count
-    (worker pools index their name stem)."""
-    out: Dict[str, int] = {}
+    send to a component instead of ``Thread-N``.  Name -> {"count":
+    live threads} (worker pools index their name stem).  Native event
+    threads live in C++ — invisible to ``threading.enumerate`` — so
+    they appear as ``native-<ring>`` entries carrying their ring's
+    last-heartbeat age (ISSUE 16): a stall dump shows which event
+    thread went QUIET, not merely that it was spawned."""
+    out: Dict[str, Any] = {}
     for t in threading.enumerate():
         if t.name.startswith("antidote-"):
-            out[t.name] = out.get(t.name, 0) + 1
+            entry = out.setdefault(t.name, {"count": 0})
+            entry["count"] += 1
+    from antidote_tpu.obs import nativeobs
+
+    for ring, age in nativeobs.watchdog.ages().items():
+        entry = out.setdefault(f"native-{ring}", {"count": 1})
+        entry["heartbeat_age_s"] = age
     return dict(sorted(out.items()))
 
 
@@ -217,6 +255,7 @@ def dc_snapshot(dc) -> Dict[str, Any]:
         "log": _section(lambda: _log_section(dc)),
         "stable": _section(lambda: _stable_section(dc)),
         "fabric": _section(lambda: _fabric_section(dc)),
+        "native": _section(lambda: _native_section(dc)),
         "connected_dcs": _section(
             lambda: [str(d) for d in getattr(dc, "connected_dcs", [])]),
     }

@@ -675,6 +675,58 @@ class Registry:
             "antidote_fabric_hub_queued_bytes",
             "Bytes queued across the native publish hub's "
             "per-subscriber bounded queues")
+        # ---- NATIVE_* telemetry families (ISSUE 16, obs/nativeobs.py):
+        # folded from the C++ flight-recorder rings on the existing
+        # 50 ms gauge cadence — the observability face of the paths PR
+        # 11 moved off the GIL.  Buckets reach down to 1 µs: a native
+        # answer is a hash lookup + queue push, orders of magnitude
+        # under the stage-latency ladder's 100 µs floor.
+        native_buckets = (0.000001, 0.000005, 0.00001, 0.00005,
+                          0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05)
+        self.native_answer_latency = LabeledHistogram(
+            "antidote_native_answer_latency_seconds",
+            "C++ event-thread serve time per natively answered RPC "
+            "(key build + table lookup + reply queue), by rpc kind — "
+            "the latency face of fabric_native_answered's flat count",
+            buckets=native_buckets, labels=("kind",))
+        self.native_pub_stage = Histogram(
+            "antidote_native_pub_stage_seconds",
+            "Native hub frame staging duration (one framing copy + "
+            "per-subscriber refcount pushes, under the hub mutex)",
+            buckets=native_buckets)
+        self.native_sub_queue_wait = Histogram(
+            "antidote_native_sub_queue_wait_seconds",
+            "Enqueue-to-last-byte-written time per subscriber frame "
+            "on the native hub (queue wait + socket send)",
+            buckets=native_buckets + (0.1, 0.5, 1.0))
+        self.native_frame_age = Gauge(
+            "antidote_native_frame_age_seconds",
+            "Age of the oldest frame still queued on any native-hub "
+            "subscriber at the last telemetry drain (0 = queues "
+            "empty) — a rising value means a peer is draining slower "
+            "than the stream publishes")
+        self.native_sub_enqueued = Counter(
+            "antidote_native_sub_enqueued_total",
+            "Per-subscriber frame enqueues on the native hub (the "
+            "fan-out numerator: enqueues / pub_frames = live fan-out)")
+        self.native_sub_dropped = Counter(
+            "antidote_native_sub_dropped_total",
+            "Subscribers dropped by the native hub for queue overflow "
+            "— each drop event's forensics (last-frame identity hash, "
+            "publish seq) land in the flight recorder")
+        self.native_ring_dropped = LabeledGauge(
+            "antidote_native_ring_dropped_total",
+            "Cumulative telemetry events lost to ring overwrite per "
+            "native ring (the consumer lagged the producer) — "
+            "telemetry loss is a statistic here, never backpressure",
+            labels=("ring",))
+        self.native_heartbeat_age = LabeledGauge(
+            "antidote_native_heartbeat_age_seconds",
+            "Wall-clock age of each native event thread's last "
+            "heartbeat at the last telemetry drain; the stall "
+            "watchdog force-dumps the flight recorder past "
+            "Config.native_watchdog_s",
+            labels=("ring",))
 
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
@@ -718,7 +770,11 @@ class Registry:
                 self.fabric_native_answered, self.fabric_py_answers,
                 self.fabric_published, self.pub_frames,
                 self.pub_sub_copies, self.pub_fanout,
-                self.pub_queue_depth, self.hub_queued_bytes)
+                self.pub_queue_depth, self.hub_queued_bytes,
+                self.native_answer_latency, self.native_pub_stage,
+                self.native_sub_queue_wait, self.native_frame_age,
+                self.native_sub_enqueued, self.native_sub_dropped,
+                self.native_ring_dropped, self.native_heartbeat_age)
 
     def exposition(self) -> str:
         lines = []

@@ -1,0 +1,177 @@
+"""Config 14: native telemetry plane — the flight recorder's overhead
+on the paths it observes (ISSUE 16).
+
+ISSUE 12 moved the hot answer/publish paths into C++ event threads the
+GIL never sees; ISSUE 16 made them observable again through
+single-producer lock-free rings (native/tel_ring.h) the event threads
+write with plain atomics.  The whole design is justified only if the
+recorder is effectively free on the paths it watches — a telemetry
+plane that taxes the hot path it instruments re-creates the problem
+the native fabric solved.  This config re-runs config12's busy-GIL
+fabric legs with the recorder ON vs OFF and gates exactly that:
+
+- ``nativeobs_overhead_pct``     (pct, must not rise): p99 per-hop
+  cost of the native RPC fan-out round with telemetry recording on,
+  relative to the same tape with recording off — the in-bench
+  acceptance bar is <= 3% (the producer path is a relaxed-atomics
+  slot write; anything visible at p99 means a lock or a GIL crossing
+  leaked into the event thread).
+- ``nativeobs_events_per_drain`` (events/drain, must not drop): how
+  many ring events each Python drain call folds — the amortization
+  quantity.  A collapsing value means the drain cadence is outrunning
+  the event rate and paying its fixed cost (cursor probe, GIL-free
+  bulk copy, decode loop) for trickles.
+
+The zero-copy publish contract is re-asserted WITH the recorder on:
+an 8-subscriber storm through the native hub must still do 0 Python
+per-subscriber copies and deliver byte-identically — staging events
+into the telemetry ring must never put the frame bytes back on a
+Python path.
+"""
+
+from __future__ import annotations
+
+from benches._util import emit, setup
+from benches.config12_fabric import (
+    _BusyGil,
+    _handler,
+    _percentile,
+    _request_tape,
+    drive_publish,
+)
+
+
+def drive_rpc_tel(telemetry: bool, tape, n_peers):
+    """config12's native RPC leg with the flight recorder toggled;
+    returns (per-hop latencies us, answers, native_answered,
+    events_drained, drain_calls).  The drain runs AFTER the timed
+    tape (the production cadence rides the gossip tick, never the
+    request path), so hop timings see only the producer-side cost —
+    the quantity under test."""
+    from antidote_tpu.cluster.nativelink import NativeNodeLink
+
+    servers = []
+    for i in range(n_peers):
+        srv = NativeNodeLink(f"srv{i}")
+        srv.answer_policy = lambda kind, payload: True
+        srv.set_telemetry(telemetry)
+        srv.serve(_handler)
+        servers.append(srv)
+    client = NativeNodeLink("cli")
+    client.set_telemetry(telemetry)
+    for i, srv in enumerate(servers):
+        client.connect(i, srv.local_addr())
+    import time
+
+    hop_us = []
+    answers = []
+    try:
+        for calls in tape:
+            t0 = time.perf_counter()
+            results = client.request_many(
+                [(p, k, pl) for p, k, pl in calls])
+            got = []
+            for ok, val in results:
+                assert ok, val
+                got.append(val)
+            dt = time.perf_counter() - t0
+            hop_us.append(dt / n_peers * 1e6)
+            answers.append(got)
+        answered = sum(
+            s.fabric_counters().get("native_answered", 0)
+            for s in servers)
+        events = drains = 0
+        for s in servers:
+            while True:
+                n = s.telemetry_drain()
+                if n <= 0:
+                    break
+                events += n
+                drains += 1
+        return hop_us, answers, answered, events, drains
+    finally:
+        client.close()
+        for s in servers:
+            s.close()
+
+
+def main():
+    quick, _jax = setup()
+    from antidote_tpu.native.build import ensure_built
+
+    if ensure_built("nodelink") is None or ensure_built("fabric") is None:
+        # no C++ toolchain: there is no native plane to observe, so
+        # there is no overhead to measure — skip loudly, emit nothing
+        print("config14_nativeobs: native toolchain unavailable — "
+              "skipping (nothing to measure)")
+        return
+
+    n_peers = 4
+    keys = list(range(16))
+    rounds = 100 if quick else 400
+    tape = _request_tape(n_peers, keys, rounds)
+
+    # recorder overhead on the native answer path: <= 3% on p99.  The
+    # true cost is a relaxed-atomics 32-byte slot write (~ns) under a
+    # ~100us hop, so the bar is really a leak detector — a mutex or
+    # GIL crossing smuggled onto the producer path shows up as tens of
+    # percent.  A tail percentile is noisy on a loaded box, so the bar
+    # gets config12's 3-attempt retry and keeps the best attempt.
+    best = None
+    for attempt in range(3):
+        with _BusyGil():
+            off_us, off_ans, off_answered, _e, _d = drive_rpc_tel(
+                False, tape, n_peers)
+            on_us, on_ans, on_answered, events, drains = drive_rpc_tel(
+                True, tape, n_peers)
+        # equivalence: recording must never change an answer
+        assert on_ans == off_ans, \
+            "answers diverged between recorder-on and recorder-off legs"
+        assert off_answered > 0 and on_answered > 0, \
+            "no RPC was answered natively"
+        # the recorder actually recorded: the ring drained the
+        # natively answered repeats the off leg left invisible
+        assert events > 0 and drains > 0, \
+            "telemetry ring drained no events with the recorder on"
+        off_p99 = _percentile(off_us, 0.99)
+        on_p99 = _percentile(on_us, 0.99)
+        overhead = (on_p99 - off_p99) / max(off_p99, 1e-9) * 100.0
+        if best is None or overhead < best[0]:
+            best = (overhead, on_p99, off_p99,
+                    _percentile(on_us, 0.5), _percentile(off_us, 0.5),
+                    events, drains, on_answered)
+        if overhead <= 3.0:
+            break
+    (overhead, on_p99, off_p99, on_p50, off_p50,
+     events, drains, answered) = best
+    assert overhead <= 3.0, \
+        f"recorder-on p99 {on_p99:.0f}us vs off {off_p99:.0f}us " \
+        f"(+{overhead:.1f}%) — over the 3% bar after " \
+        f"{attempt + 1} attempts"
+    emit("nativeobs_overhead_pct", round(max(overhead, 0.0), 2), "pct",
+         3.0,
+         on_p99_us=round(on_p99, 1), off_p99_us=round(off_p99, 1),
+         on_p50_us=round(on_p50, 1), off_p50_us=round(off_p50, 1),
+         native_answered=answered, rounds=rounds, peers=n_peers,
+         busy_gil=True)
+    emit("nativeobs_events_per_drain", round(events / drains, 1),
+         "events/drain", 1.0,
+         events=events, drains=drains)
+
+    # zero-copy contract with the recorder on: staging telemetry
+    # events must never put frame bytes back on a Python path
+    frames = [b"frame-%04d-" % i + b"x" * 256
+              for i in range(200 if quick else 1000)]
+    with _BusyGil():
+        got, n_frames, copies = drive_publish("auto", frames)
+    for i, sub_frames in enumerate(got):
+        assert sub_frames == frames, \
+            f"subscriber {i} delivery diverged with the recorder on"
+    assert n_frames == len(frames)
+    assert copies == 0, \
+        f"{copies} Python per-subscriber copies with the recorder on " \
+        "— the telemetry plane leaked frame bytes into Python"
+
+
+if __name__ == "__main__":
+    main()

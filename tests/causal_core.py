@@ -27,20 +27,124 @@ def forensics(reason: str, detail) -> str:
     window was gone.  Now every failure leaves
     ``flightrec_causal_checker_*.json`` — recorder rings, recent
     spans, the full pipeline state (ship buffers, SubBuf gaps, gate
-    backlogs, ingest staging, stable watermarks), and the failing
-    read's own detail — so the NEXT occurrence is evidence, not an
-    anecdote.  Returns a note naming the dump path for the assertion
-    message."""
+    backlogs, ingest staging, stable watermarks), the failing read's
+    own detail, and (ISSUE 16) every plane's device-fold state: the
+    seed-clock joins (base VC, staged-ring bound) plus — when the
+    detail carries the failing read's clock — the actual per-key
+    inclusion masks the device fold would compute for that clock, so
+    a round-5-shaped loss shows WHICH lane got excluded instead of
+    leaving the fold a black box.  Returns a note naming the dump
+    path for the assertion message."""
     try:
         from antidote_tpu.obs import pipeline
         from antidote_tpu.obs.events import recorder
 
-        path = recorder.dump(
-            reason, force=True,
-            extra={"detail": detail, "pipeline": pipeline.snapshot()})
+        extra = {"detail": detail, "pipeline": pipeline.snapshot()}
+        try:
+            extra["device_folds"] = _device_fold_forensics(detail)
+        except Exception:  # noqa: BLE001 — the fold dump is additive;
+            pass           # its failure must not cost the base dump
+        path = recorder.dump(reason, force=True, extra=extra)
         return f" [forensics: {path}]" if path else ""
     except Exception:  # noqa: BLE001 — forensics must not mask the
         return ""      # assertion that triggered it
+
+
+def _device_fold_forensics(detail) -> dict:
+    """Per-plane seed-clock joins and device-fold inclusion masks
+    (ISSUE 16 satellite).  For every registered DC's planes:
+    the base-snapshot VC the fold seeds from (with the has-base flag
+    and the staged-ring VC bound), and — when ``detail`` carries the
+    failing read's clock — the bool[K, L] inclusion mask
+    ``kernels.inclusion_mask`` computes for that clock, summarized
+    per key as valid/included/excluded-valid lane counts.  An
+    excluded-valid lane whose commit VC the clock dominates IS the
+    round-5 signature, now recorded instead of inferred."""
+    import numpy as np
+
+    from antidote_tpu.obs import pipeline
+
+    clock = None
+    if isinstance(detail, dict):
+        clock = detail.get("read_clock") or detail.get("session_clock")
+    out = {}
+    for dc in pipeline.endpoints():
+        try:
+            name = str(dc.node.dc_id)
+            member = getattr(dc, "member_index", None)
+            if member is not None:
+                name = f"{name}[{member}]"
+        except Exception:  # noqa: BLE001 — half-closed DC
+            continue
+        planes_out = {}
+        node = getattr(dc, "node", None)
+        for p, pm in enumerate(getattr(node, "partitions", [])):
+            dev = getattr(pm, "device", None)
+            if dev is None:
+                continue
+            for tn, plane in getattr(dev, "planes", {}).items():
+                try:
+                    entry = {
+                        "base_vc": {str(k): v for k, v in
+                                    plane._base_vc.items()},
+                        "has_base": bool(plane._has_base),
+                        "ring_vc_bound": {str(k): v for k, v in
+                                          plane._ring_vc_bound.items()},
+                        "staged_rows": len(plane.rows),
+                        "domain": [str(x) for x in plane.domain.dc_ids],
+                    }
+                    st = plane.st
+                    if clock is not None and all(
+                            hasattr(st, a) for a in
+                            ("op_dc", "op_ct", "op_ss", "valid2d",
+                             "base_vc", "has_base")):
+                        entry["inclusion"] = _inclusion_summary(
+                            plane, st, clock, np)
+                    planes_out[f"{p}:{tn}"] = entry
+                except Exception:  # noqa: BLE001 — a plane mid-flush
+                    continue       # yields a partial dump, never a throw
+        if planes_out:
+            out[name] = planes_out
+    return out
+
+
+def _inclusion_summary(plane, st, clock, np) -> dict:
+    """Run the REAL device-fold inclusion kernel for ``clock`` over one
+    plane's packed state and fold the bool[K, L] mask down to per-key
+    lane counts (keys with no valid lanes are omitted)."""
+    from antidote_tpu.mat import kernels
+
+    domain = plane.domain
+    # read-only densification: never register unseen DCs from a dump
+    read_vc = np.zeros((domain.d,), dtype=np.int64)
+    for dc_id, t in dict(clock).items():
+        if domain.contains(dc_id):
+            read_vc[domain.index_of(dc_id)] = int(t)
+    # shard states carry ONE base snapshot per shard (base_vc int[D],
+    # has_base scalar); broadcast to per-key shape exactly as the
+    # store's read paths do (mat/store.py orset_read)
+    K = st.op_dc.shape[0]
+    base_vc = np.asarray(st.base_vc)
+    if base_vc.ndim == 1:
+        base_vc = np.broadcast_to(base_vc, (K, base_vc.shape[0]))
+    has_base = np.asarray(st.has_base)
+    if has_base.ndim == 0:
+        has_base = np.broadcast_to(has_base, (K,))
+    mask = np.asarray(kernels.inclusion_mask(
+        st.op_dc, st.op_ct, st.op_ss, st.valid2d,
+        base_vc, has_base, read_vc))
+    valid = np.asarray(st.valid2d)
+    keys = {}
+    for ki in range(min(len(plane.rev_keys), valid.shape[0])):
+        v = int(valid[ki].sum())
+        if not v:
+            continue
+        keys[repr(plane.rev_keys[ki])] = {
+            "valid_lanes": v,
+            "included": int(mask[ki].sum()),
+            "excluded_valid": int((valid[ki] & ~mask[ki]).sum()),
+        }
+    return {"read_vc_dense": read_vc.tolist(), "keys": keys}
 
 
 def key_of(i):

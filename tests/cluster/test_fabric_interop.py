@@ -287,11 +287,11 @@ class TestAnswerPlaneDifferential:
         # the clear lands between the worker's gen capture and its
         # publish (exactly the truncation-mid-handler interleaving)
         lib.nl_publish_clear(h)
-        lib.nl_publish(h, key, len(key), reply, len(reply), gen)
+        lib.nl_publish(h, key, len(key), reply, len(reply), gen, 0)
         assert link.fabric_counters()["published"] == 0
         # the same publish at the CURRENT generation installs fine
         lib.nl_publish(h, key, len(key), reply, len(reply),
-                       lib.nl_pub_gen(h))
+                       lib.nl_pub_gen(h), 0)
         assert link.fabric_counters()["published"] == 1
         link.invalidate_answers()
         assert link.fabric_counters()["published"] == 0

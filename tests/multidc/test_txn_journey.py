@@ -220,7 +220,7 @@ class TestVisibilityMetrics:
 
 class TestPipelineSnapshot:
     SECTIONS = {"ship", "sub_bufs", "gates", "ingest", "log", "stable",
-                "fabric", "connected_dcs"}
+                "fabric", "native", "connected_dcs"}
 
     def test_snapshot_schema(self, journey2):
         dc1, dc2 = journey2

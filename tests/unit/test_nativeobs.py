@@ -1,0 +1,499 @@
+"""Native telemetry plane (ISSUE 16): ring drain semantics against
+BOTH implementations — the C++ TelRing (compiled through a test shim
+that injects the clock, so streams are deterministic) and the
+pure-Python ``_PyRing`` twin — plus the fold, gauge, heartbeat-age and
+stall-watchdog layers above them.
+
+The drain rules under test are the subtle ones: wrap-around lag
+skipping, the conservative torn-prefix discard (a producer writing
+event e overwrites slot ``e & (cap-1)`` BEFORE publishing head=e+1,
+so any copied index <= head-cap may be mid-overwrite), the full-ring
+edge that therefore loses exactly one event, and overwrite-under-read
+with a live concurrent producer.  Where the C++ toolchain is absent
+the twin still runs every semantic test (the skip guard is the
+fixture) — byte-identity and the concurrency stress are the only
+cpp-gated cases.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.obs import nativeobs
+from antidote_tpu.obs.nativeobs import (
+    EV_ANSWER,
+    EV_DROP,
+    EV_PUB_STAGE,
+    EV_SUB_DRAIN,
+    EV_SUB_ENQUEUE,
+    EVENT_SIZE,
+    RING_CAPACITY,
+    KindInterner,
+    NativeStallWatchdog,
+    TelEvent,
+    _PyRing,
+    decode_events,
+    fold_events,
+    heartbeat_age_s,
+    kind_interner,
+    publish_ring_gauges,
+)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..",
+    "antidote_tpu", "native")
+
+#: the production TelRing driven at the C ABI, with the wall clock
+#: injected by the caller: tr_emit replicates emit()'s exact slot
+#: write + release-store publish order (the only difference is where
+#: t_ns comes from), so drained streams are deterministic and can be
+#: compared byte-for-byte against the _PyRing twin.  drain()/beat()
+#: are the REAL production code paths.
+_SHIM_SRC = r"""
+#include <cstdint>
+#include "tel_ring.h"
+extern "C" {
+void* tr_new() { return new tel::TelRing(); }
+void tr_free(void* rp) { delete (tel::TelRing*)rp; }
+uint64_t tr_head(void* rp) {
+    return ((tel::TelRing*)rp)->head.load();
+}
+void tr_enable(void* rp, int on) {
+    ((tel::TelRing*)rp)->enabled.store(on);
+}
+void tr_beat(void* rp) { ((tel::TelRing*)rp)->beat(); }
+uint64_t tr_hb_count(void* rp) {
+    return ((tel::TelRing*)rp)->hb_count.load();
+}
+uint64_t tr_hb_wall(void* rp) {
+    return ((tel::TelRing*)rp)->hb_wall_ns.load();
+}
+void tr_emit(void* rp, uint64_t t_ns, uint32_t dur, uint32_t bytes,
+             uint16_t ev, uint16_t aux, uint32_t seq) {
+    tel::TelRing* r = (tel::TelRing*)rp;
+    if (!r->enabled.load(std::memory_order_relaxed)) return;
+    uint64_t h = r->head.load(std::memory_order_relaxed);
+    tel::TelEvent& e = r->slots[h & (tel::TelRing::kCap - 1)];
+    e.t_ns = t_ns; e.dur_ns = dur; e.bytes = bytes; e.ev = ev;
+    e.aux16 = aux; e.seq = seq; e.pad = 0;
+    r->head.store(h + 1, std::memory_order_release);
+}
+long tr_drain(void* rp, uint64_t tail, uint8_t* buf, long max_events,
+              uint64_t* new_tail, uint64_t* dropped) {
+    return ((tel::TelRing*)rp)->drain(tail, buf, max_events,
+                                      new_tail, dropped);
+}
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cpp_lib(tmp_path_factory):
+    """Compile the TelRing test shim; skip (never fail) without a
+    toolchain — the _PyRing twin carries the semantics there."""
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain — _PyRing twin covers semantics")
+    d = tmp_path_factory.mktemp("telring")
+    src = d / "shim.cpp"
+    src.write_text(_SHIM_SRC)
+    out = d / "libtelshim.so"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+             f"-I{os.path.abspath(_NATIVE_DIR)}", str(src), "-o",
+             str(out)],
+            check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:  # pragma: no cover
+        pytest.skip(f"TelRing shim did not compile: {e.stderr[-500:]}")
+    lib = ctypes.CDLL(str(out))
+    lib.tr_new.restype = ctypes.c_void_p
+    lib.tr_free.argtypes = [ctypes.c_void_p]
+    lib.tr_head.restype = ctypes.c_ulonglong
+    lib.tr_head.argtypes = [ctypes.c_void_p]
+    lib.tr_enable.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tr_beat.argtypes = [ctypes.c_void_p]
+    lib.tr_hb_count.restype = ctypes.c_ulonglong
+    lib.tr_hb_count.argtypes = [ctypes.c_void_p]
+    lib.tr_hb_wall.restype = ctypes.c_ulonglong
+    lib.tr_hb_wall.argtypes = [ctypes.c_void_p]
+    lib.tr_emit.argtypes = [
+        ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_uint,
+        ctypes.c_uint, ctypes.c_ushort, ctypes.c_ushort, ctypes.c_uint]
+    lib.tr_drain.restype = ctypes.c_long
+    lib.tr_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_void_p,
+        ctypes.c_long, ctypes.POINTER(ctypes.c_ulonglong),
+        ctypes.POINTER(ctypes.c_ulonglong)]
+    return lib
+
+
+class _CppRing:
+    """The C++ ring behind the _PyRing interface, so every semantic
+    test runs verbatim against both implementations."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.tr_new()
+
+    @property
+    def head(self):
+        return int(self._lib.tr_head(self._h))
+
+    def emit(self, ev, aux16, dur_ns, bytes_, seq, t_ns=0):
+        self._lib.tr_emit(self._h, t_ns, dur_ns, bytes_, ev, aux16, seq)
+
+    def beat(self):
+        self._lib.tr_beat(self._h)
+
+    @property
+    def hb_count(self):
+        return int(self._lib.tr_hb_count(self._h))
+
+    @property
+    def hb_wall_ns(self):
+        return int(self._lib.tr_hb_wall(self._h))
+
+    def enable(self, on):
+        self._lib.tr_enable(self._h, 1 if on else 0)
+
+    def drain(self, tail, max_events):
+        buf = ctypes.create_string_buffer(
+            EVENT_SIZE * max(1, min(max_events, RING_CAPACITY)))
+        new_tail = ctypes.c_ulonglong()
+        dropped = ctypes.c_ulonglong()
+        n = int(self._lib.tr_drain(
+            self._h, tail, buf, max_events,
+            ctypes.byref(new_tail), ctypes.byref(dropped)))
+        return (buf.raw[:n * EVENT_SIZE], int(new_tail.value),
+                int(dropped.value))
+
+    def close(self):
+        if self._h:
+            self._lib.tr_free(self._h)
+            self._h = None
+
+
+@pytest.fixture(params=["py", "cpp"])
+def ring(request):
+    """Each semantic test runs against BOTH ring implementations."""
+    if request.param == "py":
+        r = _PyRing()
+        r.enable = lambda on: setattr(r, "enabled", bool(on))
+        yield r
+    else:
+        r = _CppRing(request.getfixturevalue("cpp_lib"))
+        yield r
+        r.close()
+
+
+def _fill(ring, n, start=0):
+    """n deterministic events: seq == global index, fields derived."""
+    for i in range(start, start + n):
+        ring.emit(EV_ANSWER, i & 0xFFFF, i * 10, i * 3, i, t_ns=1000 + i)
+
+
+# --------------------------------------------------- drain semantics
+
+def test_drain_roundtrip_decodes_fields(ring):
+    _fill(ring, 10)
+    payload, new_tail, dropped = ring.drain(0, 100)
+    assert (new_tail, dropped) == (10, 0)
+    events = decode_events(payload, len(payload) // EVENT_SIZE)
+    assert len(events) == 10
+    for i, e in enumerate(events):
+        assert e == TelEvent(t_ns=1000 + i, dur_ns=i * 10, bytes=i * 3,
+                             ev=EV_ANSWER, aux16=i, seq=i)
+
+
+def test_partial_drain_resumes_at_cursor(ring):
+    _fill(ring, 50)
+    p1, t1, d1 = ring.drain(0, 20)
+    assert (len(p1) // EVENT_SIZE, t1, d1) == (20, 20, 0)
+    p2, t2, d2 = ring.drain(t1, 100)
+    assert (len(p2) // EVENT_SIZE, t2, d2) == (30, 50, 0)
+    seqs = [e.seq for e in decode_events(p1 + p2, 50)]
+    assert seqs == list(range(50))
+
+
+def test_wraparound_lag_skips_and_counts(ring):
+    """A consumer lagged past the ring loses the overwritten span to
+    the lag skip PLUS the torn-prefix discard — all counted."""
+    _fill(ring, RING_CAPACITY + 100)
+    payload, new_tail, dropped = ring.drain(0, RING_CAPACITY + 200)
+    n = len(payload) // EVENT_SIZE
+    assert n == RING_CAPACITY - 1
+    assert dropped == 101  # 100 lag-skipped + 1 torn prefix
+    assert new_tail == RING_CAPACITY + 100
+    seqs = [e.seq for e in decode_events(payload, n)]
+    assert seqs == list(range(101, RING_CAPACITY + 100))
+
+
+def test_full_ring_drain_loses_exactly_one(ring):
+    """The conservative torn rule's edge: draining an exactly-full
+    ring discards index 0 (a producer emitting event cap would be
+    mid-overwrite there), so one event is charged to ``dropped``."""
+    _fill(ring, RING_CAPACITY)
+    payload, new_tail, dropped = ring.drain(0, RING_CAPACITY)
+    n = len(payload) // EVENT_SIZE
+    assert (n, dropped, new_tail) == (RING_CAPACITY - 1, 1,
+                                      RING_CAPACITY)
+    events = decode_events(payload, n)
+    assert events[0].seq == 1 and events[-1].seq == RING_CAPACITY - 1
+
+
+def test_bogus_cursor_clamps_forward(ring):
+    _fill(ring, 3)
+    payload, new_tail, dropped = ring.drain(999, 100)
+    assert (payload, new_tail, dropped) == (b"", 3, 0)
+
+
+def test_disabled_ring_records_nothing(ring):
+    ring.enable(False)
+    _fill(ring, 5)
+    assert ring.head == 0
+    ring.enable(True)
+    _fill(ring, 2)
+    assert ring.head == 2
+
+
+def test_heartbeat_advances_count_and_wall(ring):
+    assert (ring.hb_count, ring.hb_wall_ns) == (0, 0)
+    ring.beat()
+    ring.beat()
+    assert ring.hb_count == 2
+    assert ring.hb_wall_ns > 0
+
+
+# ----------------------------------- C++ <-> Python twin equivalence
+
+def test_streams_byte_identical_across_implementations(cpp_lib):
+    """The same scripted scenario drained at the same cursors must
+    produce byte-identical payloads (and identical cursor/dropped
+    accounting) from the C++ ring and the _PyRing twin — the twin is
+    only a valid no-toolchain stand-in if the streams are
+    indistinguishable."""
+    cpp = _CppRing(cpp_lib)
+    py = _PyRing()
+    try:
+        script = [("emit", 10), ("drain", 6), ("emit", 60),
+                  ("drain", 4096), ("emit", RING_CAPACITY + 37),
+                  ("drain", 4096), ("drain", 4096)]
+        i = 0
+        cur_c = cur_p = 0
+        for op, arg in script:
+            if op == "emit":
+                _fill(cpp, arg, start=i)
+                _fill(py, arg, start=i)
+                i += arg
+            else:
+                pc, cur_c, dc = cpp.drain(cur_c, arg)
+                pp, cur_p, dp = py.drain(cur_p, arg)
+                assert pc == pp
+                assert (cur_c, dc) == (cur_p, dp)
+        assert cpp.head == py.head == i
+    finally:
+        cpp.close()
+
+
+def test_overwrite_under_read_never_yields_torn_events(cpp_lib):
+    """Live concurrency: a producer thread emitting through the real
+    release-store path while the consumer drains (ctypes releases the
+    GIL around both calls, so the race is real).  Every drained event
+    must be intact (seq strictly increasing, fields consistent with
+    its seq) and the accounting must balance: drained + dropped ==
+    emitted once the producer stops."""
+    total = 30_000
+    cpp = _CppRing(cpp_lib)
+    try:
+        def produce():
+            for j in range(total):
+                cpp.emit(EV_ANSWER, j & 0xFFFF, j & 0xFFFFFFFF, j * 3,
+                         j, t_ns=1000 + j)
+
+        t = threading.Thread(target=produce)
+        t.start()
+        tail = drained = dropped = 0
+        last_seq = -1
+        while t.is_alive() or tail < total:
+            payload, tail, d = cpp.drain(tail, RING_CAPACITY)
+            dropped += d
+            n = len(payload) // EVENT_SIZE
+            drained += n
+            for e in decode_events(payload, n):
+                assert e.seq > last_seq
+                last_seq = e.seq
+                # every field is a pure function of seq: a torn slot
+                # (half old event, half new) cannot satisfy all three
+                assert e.t_ns == 1000 + e.seq
+                assert e.bytes == e.seq * 3
+                assert e.aux16 == e.seq & 0xFFFF
+        t.join()
+        assert drained + dropped == total
+        assert drained > 0
+    finally:
+        cpp.close()
+
+
+# ------------------------------------------------- folds and gauges
+
+def test_fold_events_routes_every_kind_to_its_family():
+    reg = stats.Registry()
+    kid = kind_interner.id_of("snap_read")
+    events = [
+        TelEvent(1000, 500, 64, EV_ANSWER, kid, 7),
+        TelEvent(1001, 200, 128, EV_PUB_STAGE, 3, 8),
+        TelEvent(1002, 0, 128, EV_SUB_ENQUEUE, 5, 8),
+        TelEvent(1003, 900, 128, EV_SUB_DRAIN, 5, 8),
+        TelEvent(1004, 0, 128, EV_DROP, 0xBEEF, 8),
+        TelEvent(1005, 0, 0, 99, 0, 0),  # unknown kind: ignored
+    ]
+    assert fold_events(events, reg=reg) == len(events)
+    assert reg.native_answer_latency.count(kind="snap_read") == 1
+    assert reg.native_pub_stage.count == 1
+    assert reg.native_sub_enqueued.value() == 1
+    assert reg.native_sub_queue_wait.count == 1
+    assert reg.native_sub_dropped.value() == 1
+
+
+def test_fold_events_emits_one_fanout_span_per_txid(monkeypatch):
+    """A sub_drain whose publish seq the transport attributed to
+    sampled txids emits native_fanout spans — one per txid, on the
+    FIRST subscriber drain of that frame only."""
+    from antidote_tpu.obs import spans
+
+    recorded = []
+    monkeypatch.setattr(
+        spans.tracer, "record_span",
+        lambda name, cat, txid, start, dur, **a:
+        recorded.append((name, txid, start, dur, a)))
+    reg = stats.Registry()
+    events = [
+        TelEvent(5_000_000, 900_000, 128, EV_SUB_DRAIN, 5, 42),
+        TelEvent(5_100_000, 800_000, 128, EV_SUB_DRAIN, 6, 42),
+        TelEvent(5_200_000, 700_000, 256, EV_SUB_DRAIN, 5, 43),
+    ]
+    fold_events(events, reg=reg,
+                seq_txids={42: ((1, "aa"), (2, "bb")), 43: ()})
+    fanout = [r for r in recorded if r[0] == "native_fanout"]
+    assert [r[1] for r in fanout] == [(1, "aa"), (2, "bb")]
+    name, txid, start, dur, args = fanout[0]
+    assert start == (5_000_000 - 900_000) // 1000
+    assert dur == 900_000 // 1000
+    assert args["pub_seq"] == 42
+
+
+def test_publish_ring_gauges_and_heartbeat_age():
+    reg = stats.Registry()
+    now = 10_000_000_000
+    publish_ring_gauges("nodelink", now - 2_500_000_000, 17, 40, 30,
+                        now_ns=now, reg=reg)
+    assert reg.native_heartbeat_age.value(ring="nodelink") == \
+        pytest.approx(2.5)
+    assert reg.native_ring_dropped.value(ring="nodelink") == 17
+    publish_ring_gauges("fabric", 0, 0, 0, 0,
+                        oldest_enq_ns=now - 1_000_000_000, now_ns=now,
+                        reg=reg)
+    assert reg.native_heartbeat_age.value(ring="fabric") == 0.0
+    assert reg.native_frame_age.value() == pytest.approx(1.0)
+    # heartbeat-age math: 0 means "never beat", future-clamped at 0
+    assert heartbeat_age_s(0) is None
+    assert heartbeat_age_s(now - 2_500_000_000, now_ns=now) == \
+        pytest.approx(2.5)
+    assert heartbeat_age_s(now + 5, now_ns=now) == 0.0
+
+
+def test_kind_interner_roundtrip_and_unknown():
+    ki = KindInterner()
+    a = ki.id_of("snap_read")
+    assert a >= 1  # 0 is reserved for unknown
+    assert ki.id_of("snap_read") == a
+    b = ki.id_of("handoff_fetch")
+    assert b != a
+    assert ki.name_of(a) == "snap_read"
+    assert ki.name_of(12345) == "?"
+    assert ki.name_of(0) == "?"
+
+
+# ------------------------------------------------------------ watchdog
+
+def test_watchdog_trips_once_per_stall_episode(monkeypatch):
+    from antidote_tpu.obs import events as obs_events
+
+    dumps = []
+    monkeypatch.setattr(
+        obs_events.recorder, "dump",
+        lambda reason, force=False, extra=None:
+        dumps.append((reason, extra)) or "/tmp/fake")
+    wd = NativeStallWatchdog(threshold_s=1.0)
+    now = 50_000_000_000
+    hb = {"v": now - 5_000_000_000}  # 5 s stale
+    wd.register("nodelink:n0", lambda: hb["v"])
+    assert wd.check(now_ns=now) == ["nodelink:n0"]
+    assert dumps and dumps[0][0] == "native_stall"
+    assert dumps[0][1]["stalled"] == ["nodelink:n0"]
+    assert "pipeline" in dumps[0][1]
+    # latched: the same stall episode never dumps twice
+    assert wd.check(now_ns=now + 1_000_000_000) == []
+    # recovery re-arms, a fresh stall trips again
+    hb["v"] = now + 2_000_000_000
+    assert wd.check(now_ns=now + 2_000_000_000) == []
+    assert wd.check(now_ns=now + 9_000_000_000) == ["nodelink:n0"]
+    assert len(dumps) == 2
+    wd.unregister("nodelink:n0")
+    assert wd.ages() == {}
+
+
+def test_watchdog_disabled_and_unknown_probes():
+    wd = NativeStallWatchdog(threshold_s=0.0)
+    wd.register("r", lambda: 1)  # ancient heartbeat
+    assert wd.check() == []      # threshold 0 disables
+    wd2 = NativeStallWatchdog(threshold_s=1.0)
+    wd2.register("dead", lambda: 0)
+    wd2.register("raising", lambda: (_ for _ in ()).throw(OSError()))
+    assert wd2.ages() == {"dead": None, "raising": None}
+    assert wd2.check() == []  # unknown ages never trip
+
+
+# ------------------------------------- endpoint telemetry_info shapes
+
+_INFO_KEYS = {"head", "tail", "occupancy", "dropped_events",
+              "heartbeat_count", "heartbeat_age_s", "enabled"}
+
+
+def test_nodelink_telemetry_info_shape():
+    from antidote_tpu.cluster import nativelink
+
+    if not nativelink.native_available():
+        pytest.skip("no C++ toolchain")
+    link = nativelink.NativeNodeLink("tel-shape")
+    try:
+        info = link.telemetry_info()
+        assert set(info) == _INFO_KEYS
+        assert info["enabled"] is True
+        assert info["occupancy"] == info["head"] - info["tail"]
+    finally:
+        link.close()
+
+
+def test_tcp_transport_telemetry_info_shape():
+    from antidote_tpu.interdc.tcp import TcpTransport
+    from antidote_tpu.interdc.wire import DcDescriptor
+    from antidote_tpu.native.build import ensure_built
+
+    if ensure_built("fabric") is None:
+        pytest.skip("no C++ toolchain")
+    bus = TcpTransport(native_pub="auto")
+    try:
+        bus.register(DcDescriptor(dc_id="telshape", n_partitions=1),
+                     lambda *_a: None)
+        info = bus.telemetry_info()
+        assert set(info) == _INFO_KEYS
+        assert info["enabled"] is True
+        assert bus.telemetry_drain() >= 0
+    finally:
+        bus.close()

@@ -35,12 +35,13 @@ def test_standalone_main_exit_code(monkeypatch, capsys):
 
 def test_registry_covers_every_analyzer():
     """The suite is the aggregation point — all four standalone
-    analyzers plus the suite-resident stats-dashboard rule.  If an
-    analyzer is added to tools/ it must land here too (that is the
-    point of the suite), and this list is the reminder."""
+    analyzers plus the suite-resident stats-dashboard and
+    native-telemetry rules.  If an analyzer is added to tools/ it must
+    land here too (that is the point of the suite), and this list is
+    the reminder."""
     assert [name for name, _ in static_suite.PASSES] == \
         ["analysis_gate", "trace_lint", "concurrency_lint",
-         "durability_lint", "stats-dashboard"]
+         "durability_lint", "stats-dashboard", "native-telemetry"]
 
 
 def test_findings_route_with_pass_prefix(monkeypatch):
@@ -136,6 +137,131 @@ def test_stats_dashboard_rule_flags_missing_docs(tmp_path):
     problems = static_suite.lint_stats_dashboard(root)
     assert len(problems) == 1
     assert "no dashboard docs" in problems[0]
+
+
+# --------------------------------------------- native-telemetry rule
+
+_TEL_HEADER = (
+    "enum {\n"
+    "    TEL_EV_ANSWER = 1,\n"
+    "    TEL_EV_DROP = 2,\n"
+    "};\n")
+
+_NATIVEOBS = (
+    "EV_ANSWER = 1\n"
+    "EV_DROP = 2\n"
+    "EVENT_KINDS = {\n"
+    "    EV_ANSWER: 'answer',\n"
+    "    EV_DROP: 'drop',\n"
+    "}\n"
+    "EVENT_FAMILIES = {\n"
+    "    'answer': ('antidote_native_answer_latency_seconds',),\n"
+    "    'drop': ('antidote_native_sub_dropped_total',),\n"
+    "}\n")
+
+
+def _native_fixture(tmp_path, header=_TEL_HEADER, obs=_NATIVEOBS,
+                    stats_families=("antidote_native_answer_latency_seconds",
+                                    "antidote_native_sub_dropped_total"),
+                    readme="`antidote_native_answer_latency_seconds` "
+                           "`antidote_native_sub_dropped_total`"):
+    pkg = tmp_path / "antidote_tpu"
+    (pkg / "native").mkdir(parents=True)
+    (pkg / "obs").mkdir()
+    (pkg / "native" / "tel_ring.h").write_text(header)
+    (pkg / "obs" / "nativeobs.py").write_text(obs)
+    (pkg / "stats.py").write_text(
+        "class Counter:\n"
+        "    def __init__(self, name, help=''):\n"
+        "        self.name = name\n"
+        + "".join(f"m{i} = Counter('{f}', '')\n"
+                  for i, f in enumerate(stats_families)))
+    mon = tmp_path / "monitoring"
+    mon.mkdir()
+    (mon / "README.md").write_text(readme)
+    return str(tmp_path)
+
+
+def test_native_telemetry_rule_clean_fixture(tmp_path):
+    """All three surfaces aligned: no findings."""
+    assert static_suite.lint_native_telemetry(
+        _native_fixture(tmp_path)) == []
+
+
+def test_native_telemetry_rule_flags_undecoded_cpp_kind(tmp_path):
+    """A TEL_EV_* constant with no EVENT_KINDS decode entry is the
+    core rule: the C++ plane records it, the drain renders '?'."""
+    root = _native_fixture(
+        tmp_path, header=_TEL_HEADER + "enum { TEL_EV_GHOST = 9 };\n")
+    problems = static_suite.lint_native_telemetry(root)
+    assert len(problems) == 1
+    assert "TEL_EV_GHOST" in problems[0]
+    assert "[native-telemetry]" in problems[0]
+
+
+def test_native_telemetry_rule_flags_kind_with_no_family(tmp_path):
+    root = _native_fixture(
+        tmp_path,
+        obs=_NATIVEOBS.replace(
+            "    'drop': ('antidote_native_sub_dropped_total',),\n", ""))
+    problems = static_suite.lint_native_telemetry(root)
+    assert len(problems) == 1
+    assert "'drop'" in problems[0] and "no stats family" in problems[0]
+
+
+def test_native_telemetry_rule_flags_unregistered_family(tmp_path):
+    root = _native_fixture(
+        tmp_path,
+        stats_families=("antidote_native_answer_latency_seconds",))
+    problems = static_suite.lint_native_telemetry(root)
+    assert any("not registered" in p
+               and "antidote_native_sub_dropped_total" in p
+               for p in problems)
+
+
+def test_native_telemetry_rule_flags_undocumented_family(tmp_path):
+    root = _native_fixture(
+        tmp_path,
+        readme="`antidote_native_answer_latency_seconds` only")
+    problems = static_suite.lint_native_telemetry(root)
+    assert len(problems) == 1
+    assert "antidote_native_sub_dropped_total" in problems[0]
+    assert "neither" in problems[0]
+
+
+def test_native_telemetry_rule_flags_stale_decode_entry(tmp_path):
+    """Reverse drift: a Python decode id the C++ enum no longer
+    emits."""
+    root = _native_fixture(
+        tmp_path, header="enum { TEL_EV_ANSWER = 1 };\n")
+    problems = static_suite.lint_native_telemetry(root)
+    assert len(problems) == 1
+    assert "stale decode entry" in problems[0]
+
+
+def test_native_telemetry_rule_flags_missing_surfaces(tmp_path):
+    """A moved header or fold module is itself a finding — a silently
+    vacuous pass would defeat the rule."""
+    import shutil
+    root = _native_fixture(tmp_path)
+    os.remove(os.path.join(root, "antidote_tpu", "native", "tel_ring.h"))
+    problems = static_suite.lint_native_telemetry(root)
+    assert len(problems) == 1 and "missing" in problems[0]
+    root2 = _native_fixture(tmp_path / "b")
+    shutil.rmtree(os.path.join(root2, "antidote_tpu", "obs"))
+    problems = static_suite.lint_native_telemetry(root2)
+    assert len(problems) == 1 and "missing" in problems[0]
+
+
+def test_native_telemetry_rule_is_not_vacuous_on_the_repo():
+    """The repo's own header yields all five event kinds — guard the
+    floor so a tel_ring.h refactor that breaks the regex fails loudly
+    instead of passing on zero kinds."""
+    header = os.path.join(static_suite.repo_root(),
+                          static_suite._TEL_RING_H)
+    with open(header) as f:
+        kinds = static_suite._TEL_EV_RE.findall(f.read())
+    assert len(kinds) >= 5
 
 
 def test_stats_dashboard_rule_is_not_vacuous_on_the_repo():

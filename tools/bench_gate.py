@@ -63,8 +63,12 @@ _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 #: "resident pct" (ISSUE 13): previously device-resident keys serving
 #: from the device again after a checkpoint-seeded restart — sliding
 #: DOWN means restarts are pinning keys host-path again
+#: "/drain" (ISSUE 16): telemetry-ring events folded per drain call —
+#: sliding DOWN means the drain cadence is outrunning the native
+#: event rate and paying its fixed cost for trickles
 _HIGHER_BETTER_SUFFIXES = ("/s", "/sec", "/dispatch", "/frame",
-                           "hit pct", "/fsync", "resident pct")
+                           "hit pct", "/fsync", "resident pct",
+                           "/drain")
 #: units whose value should not RISE (smaller is better).  The
 #: "*/txn" per-admitted-cost units (H2D bytes per txn, dispatches per
 #: txn, and ISSUE 6's encoded wire bytes per shipped txn) are the

@@ -205,6 +205,11 @@ _GIL_BLOCKING = {
     "fab_queued_bytes": "hub-mutex contention against the event "
                         "thread's send sweep",
     "fab_close": "event-thread join",
+    # telemetry drains (ISSUE 16): bulk memcpy of up to 128 KiB out of
+    # the flight-recorder ring — long enough to CDLL, and never wanted
+    # inside a lock region anyway (they ride gauge/gossip cadences)
+    "nl_tel_drain": "telemetry ring bulk copy",
+    "fab_tel_drain": "telemetry ring bulk copy",
 }
 
 #: native fabric entry points that only do bookkeeping under the
@@ -216,6 +221,10 @@ _GIL_QUICK = {
     "nl_reply", "nl_free", "nl_publish", "nl_publish_clear",
     "nl_counters", "nl_pub_gen", "nl_wait_probe", "nl_collect_probe",
     "fab_port",
+    # telemetry cursor/enable (ISSUE 16): atomics-only — no mutex, no
+    # syscall; the watchdog probes them from Python-held paths
+    "nl_tel_cursor", "nl_tel_enable", "fab_tel_cursor",
+    "fab_tel_enable",
 }
 
 

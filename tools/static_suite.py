@@ -28,6 +28,12 @@ runs, over the ONE shared path list (``SUITE_PATHS``):
   monitoring/README.md: PR 5-9 each hand-maintained that mapping and
   a dark metric is a dashboard hole nobody notices until an incident
   [stats-dashboard]
+- **native-telemetry** (lives here, ISSUE 16) — every C++ flight-
+  recorder event kind (``TEL_EV_*`` in native/tel_ring.h) must have a
+  decode entry in obs/nativeobs.py, fold into at least one stats
+  family that is actually registered, and that family must appear in
+  the dashboard docs — a kind the C++ plane records but Python never
+  folds is telemetry written to /dev/null [native-telemetry]
 
 tests/unit/test_static_suite.py runs :func:`run` repo-clean as the
 single tier-1 gate, so an analyzer added to ``PASSES`` is gated from
@@ -47,6 +53,7 @@ from __future__ import annotations
 import ast
 import json
 import os
+import re
 import sys
 import time
 from typing import Callable, List, Tuple
@@ -63,7 +70,8 @@ import trace_lint  # noqa: E402
 SUITE_PATHS = analysis_gate.DEFAULT_PATHS
 
 #: metric-class constructors whose first argument is the family name
-_METRIC_CLASSES = ("Counter", "Gauge", "LabeledGauge", "Histogram")
+_METRIC_CLASSES = ("Counter", "Gauge", "LabeledGauge", "Histogram",
+                   "LabeledHistogram")
 
 #: documentation surfaces a metric family must appear in (either)
 _DASHBOARD_DOCS = (
@@ -121,6 +129,129 @@ def lint_stats_dashboard(root: str) -> List[str]:
     return problems
 
 
+#: the three surfaces the native-telemetry pass joins (ISSUE 16)
+_TEL_RING_H = os.path.join("antidote_tpu", "native", "tel_ring.h")
+_NATIVEOBS_PY = os.path.join("antidote_tpu", "obs", "nativeobs.py")
+
+_TEL_EV_RE = re.compile(r"\bTEL_EV_([A-Z0-9_]+)\s*=\s*(\d+)")
+
+
+def _registered_families(root: str) -> List[str]:
+    """Family names registered in antidote_tpu/stats.py (the same
+    extraction lint_stats_dashboard walks), [] if the file moved."""
+    stats_py = os.path.join(root, "antidote_tpu", "stats.py")
+    if not os.path.exists(stats_py):
+        return []
+    with open(stats_py) as f:
+        tree = ast.parse(f.read(), filename=stats_py)
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and getattr(node.func, "id", None) in _METRIC_CLASSES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append(node.args[0].value)
+    return out
+
+
+def lint_native_telemetry(root: str) -> List[str]:
+    """Join the three native-telemetry surfaces (ISSUE 16): every C++
+    event kind (``TEL_EV_*`` in native/tel_ring.h) must have a decode
+    entry in obs/nativeobs.py's EVENT_KINDS, fold into >= 1 family in
+    EVENT_FAMILIES, and each such family must be BOTH registered in
+    stats.py AND present in the dashboard docs.  A kind the event
+    threads record but the drain never folds — or folds into a family
+    nobody registered or charted — is telemetry written to /dev/null,
+    which is exactly the hole this plane exists to close."""
+    header = os.path.join(root, _TEL_RING_H)
+    obs_py = os.path.join(root, _NATIVEOBS_PY)
+    problems = []
+    if not os.path.exists(header):
+        return [f"{_TEL_RING_H}: [native-telemetry] missing — the "
+                "native telemetry ring moved?"]
+    if not os.path.exists(obs_py):
+        return [f"{_NATIVEOBS_PY}: [native-telemetry] missing — the "
+                "drain/fold module moved?"]
+    with open(header) as f:
+        cpp_kinds = {int(num): name
+                     for name, num in _TEL_EV_RE.findall(f.read())}
+    if not cpp_kinds:
+        return [f"{_TEL_RING_H}: [native-telemetry] no TEL_EV_* enum "
+                "constants parsed — the rule would be vacuous"]
+    with open(obs_py) as f:
+        tree = ast.parse(f.read(), filename=obs_py)
+    # module-level EV_* ints, then the two tables keyed through them
+    ev_consts, event_kinds, event_families = {}, {}, {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        tgt = node.targets[0].id
+        if (tgt.startswith("EV_")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            ev_consts[tgt] = node.value.value
+        elif tgt == "EVENT_KINDS" and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                kid = (ev_consts.get(k.id) if isinstance(k, ast.Name)
+                       else k.value if isinstance(k, ast.Constant)
+                       else None)
+                if kid is not None and isinstance(v, ast.Constant):
+                    event_kinds[kid] = v.value
+        elif tgt == "EVENT_FAMILIES" and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(v, (ast.Tuple, ast.List)):
+                    event_families[k.value] = [
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)]
+    registered = set(_registered_families(root))
+    corpus = ""
+    for rel in _DASHBOARD_DOCS:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            with open(path) as f:
+                corpus += f.read()
+    for kid in sorted(cpp_kinds):
+        cpp_name = f"TEL_EV_{cpp_kinds[kid]}"
+        kind = event_kinds.get(kid)
+        if kind is None:
+            problems.append(
+                f"{_TEL_RING_H}: [native-telemetry] C++ event kind "
+                f"{cpp_name} (id {kid}) has no decode entry in "
+                f"nativeobs.EVENT_KINDS — the drain renders it '?'")
+            continue
+        fams = event_families.get(kind, [])
+        if not fams:
+            problems.append(
+                f"{_NATIVEOBS_PY}: [native-telemetry] event kind "
+                f"{kind!r} ({cpp_name}) maps to no stats family in "
+                "EVENT_FAMILIES — folded events vanish")
+            continue
+        for fam in fams:
+            if fam not in registered:
+                problems.append(
+                    f"{_NATIVEOBS_PY}: [native-telemetry] family "
+                    f"{fam!r} (kind {kind!r}) is not registered in "
+                    "antidote_tpu/stats.py — the fold would KeyError "
+                    "or count into nothing")
+            if fam not in corpus:
+                problems.append(
+                    f"{_NATIVEOBS_PY}: [native-telemetry] family "
+                    f"{fam!r} (kind {kind!r}) appears in neither "
+                    f"{' nor '.join(_DASHBOARD_DOCS)} — add a panel "
+                    "or document it in the README")
+    # reverse direction: a Python-side kind id the C++ enum no longer
+    # emits is dead decode code the next reader trips over
+    for kid in sorted(set(event_kinds) - set(cpp_kinds)):
+        problems.append(
+            f"{_NATIVEOBS_PY}: [native-telemetry] EVENT_KINDS id "
+            f"{kid} ({event_kinds[kid]!r}) has no TEL_EV_* constant "
+            f"in {_TEL_RING_H} — stale decode entry")
+    return problems
+
+
 #: (name, lint) — every pass the suite runs; the tier-1 gate iterates
 #: THIS list, so appending here is all a new analyzer needs for CI
 PASSES: Tuple[Tuple[str, Callable[[str], List[str]]], ...] = (
@@ -129,6 +260,7 @@ PASSES: Tuple[Tuple[str, Callable[[str], List[str]]], ...] = (
     ("concurrency_lint", concurrency_lint.lint),
     ("durability_lint", durability_lint.lint),
     ("stats-dashboard", lint_stats_dashboard),
+    ("native-telemetry", lint_native_telemetry),
 )
 
 

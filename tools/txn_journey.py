@@ -42,6 +42,8 @@ STAGES = (
     ("interdc_ship_stage", "ship stage (origin)"),
     ("interdc_send_batch", "frame publish (origin)"),
     ("interdc_send", "frame publish (origin)"),
+    ("native_fanout", "native hub fan-out (origin)"),
+    ("native_answer", "native answer (C++)"),
     ("interdc_rx", "wire rx (remote)"),
     ("subbuf_admit", "SubBuf admit (remote)"),
     ("subbuf_gap_repair", "SubBuf gap repair (remote)"),
