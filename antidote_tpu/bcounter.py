@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from antidote_tpu import stats
 from antidote_tpu.crdt import DownstreamError, get_type
 from antidote_tpu.interdc import query as idc_query
 from antidote_tpu.interdc.transport import LinkDown
@@ -72,19 +73,28 @@ class BCounterMgr:
             return cls.gen_downstream(op, state, ctx)
         amount = op[1][0]
         try:
-            return cls.gen_downstream(op, state, ctx)
+            ds = cls.gen_downstream(op, state, ctx)
         except DownstreamError as e:
             # queue the shortfall for the periodic transfer pass — only
             # for a genuine rights shortfall, not op-validation errors
             # (reference queue_request, src/bcounter_mgr.erl:116-125)
             if key is not None and str(e) == "no_permissions":
                 available = cls.local_permissions(state, self.dc_id)
+                stats.registry.bcounter_denials.inc()
+                stats.registry.bcounter_rights_held.set(
+                    float(max(available, 0)), dc=str(self.dc_id))
                 missing = max(amount - max(available, 0), 1)
                 with self._lock:
                     bk = (key, bucket)
                     self._requests[bk] = max(
                         self._requests.get(bk, 0), missing)
             raise
+        # rights remaining after this decrement lands — the gauge the
+        # rights-economy Grafana panel trends (ISSUE 17)
+        stats.registry.bcounter_rights_held.set(
+            float(max(cls.local_permissions(state, self.dc_id) - amount,
+                      0)), dc=str(self.dc_id))
+        return ds
 
     def _normalize_arg(self, name: str, arg):
         """Clients may pass a bare amount; the replica id is always this
@@ -115,8 +125,12 @@ class BCounterMgr:
             requests = dict(self._requests)
             self._requests.clear()
             cutoff = time.monotonic() - self.grace_period_s
+            before = len(self._last_transfers)
             self._last_transfers = {
                 k: t for k, t in self._last_transfers.items() if t >= cutoff}
+            expired = before - len(self._last_transfers)
+        if expired:
+            stats.registry.bcounter_grace_expiries.inc(expired)
         for (key, bucket), needed in requests.items():
             self._request_remote(key, bucket, needed)
 
@@ -136,6 +150,8 @@ class BCounterMgr:
                     (key, bucket, ask, self.dc_id))
             except LinkDown:
                 continue
+            stats.registry.bcounter_transfer_requests.inc(
+                peer=str(remote_dc))
             remaining -= ask
 
     def _pref_list(self, key, bucket) -> List[Tuple[Any, int]]:
@@ -166,6 +182,7 @@ class BCounterMgr:
             last = self._last_transfers.get((bk, requester))
             if last is not None and \
                     time.monotonic() - last < self.grace_period_s:
+                stats.registry.bcounter_grace_suppressed.inc()
                 return False
         bound = (key, "counter_b", bucket)
         try:
@@ -180,4 +197,6 @@ class BCounterMgr:
             return False
         with self._lock:
             self._last_transfers[(bk, requester)] = time.monotonic()
+        stats.registry.bcounter_transfers_granted.inc(
+            peer=str(requester))
         return True

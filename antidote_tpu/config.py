@@ -326,4 +326,13 @@ class Config:
     #: (default — each round costs one txn per period plus a causal
     #: read per peer).
     obs_causal_probe_s: float = 0.0
+    #: fleet scrape period, seconds (ISSUE 17,
+    #: antidote_tpu/obs/fleet.py): each round merges the local
+    #: registry + pipeline plane with every remote endpoint listed in
+    #: ``extra["fleet_peers"]`` (``http://host:port`` metrics-server
+    #: roots), refreshes the FLEET_* gauges and re-judges the merged
+    #: samples against obs/slo.py's DEFAULT_OBJECTIVES (SLO_* gauges).
+    #: 0 disables (default): scraping stays caller-elected per the
+    #: mat/serve.py no-background-thread discipline.
+    fleet_scrape_s: float = 0.0
     extra: dict = field(default_factory=dict)

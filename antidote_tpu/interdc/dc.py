@@ -79,6 +79,7 @@ class DataCenter(AntidoteTPU):
         self._bc_worker: Optional[_Ticker] = None
         self._staleness: Optional[stats.StalenessSampler] = None
         self._causal_probe: Optional[obs_probe.CausalProbe] = None
+        self._fleet_scraper = None  # obs_fleet.FleetScraper when elected
         node.bcounter_mgr = BCounterMgr(self)
 
         # re-join DCs we knew before a restart; an unreachable peer must
@@ -338,6 +339,19 @@ class DataCenter(AntidoteTPU):
             self._causal_probe = obs_probe.CausalProbe(
                 self, period_s=self.node.config.obs_causal_probe_s)
             self._causal_probe.start()
+        if self._fleet_scraper is None \
+                and self.node.config.fleet_scrape_s > 0:
+            # fleet federation (ISSUE 17): remote peers come from
+            # extra["fleet_peers"] (metrics-server roots); the local
+            # registry + pipeline plane always federate
+            from antidote_tpu.obs import fleet as obs_fleet
+
+            self._fleet_scraper = obs_fleet.FleetScraper(
+                endpoints=list(
+                    self.node.config.extra.get("fleet_peers", ())),
+                period_s=self.node.config.fleet_scrape_s,
+                name=str(self.node.dc_id))
+            self._fleet_scraper.start()
         stats.install_error_monitor()
         if self.node.config.metrics_port is not None:
             # process-global: all DCs share one registry and one server
@@ -532,6 +546,9 @@ class DataCenter(AntidoteTPU):
         if self._causal_probe is not None:
             self._causal_probe.stop()
             self._causal_probe = None
+        if self._fleet_scraper is not None:
+            self._fleet_scraper.stop()
+            self._fleet_scraper = None
 
     def close(self) -> None:
         obs_pipeline.unregister(self)

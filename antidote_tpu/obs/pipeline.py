@@ -33,6 +33,10 @@ aggregates, in one JSON document per registered DataCenter:
   per-ring occupancy, drain cursors, overwrite losses, and heartbeat
   age for the node link's and the fabric hub's telemetry rings
   (cluster/nativelink.py, interdc/tcp.py);
+- **probe**: the causal-probe auditor's depth (ISSUE 17) — per-peer
+  write->read round-trip, per-peer violation counts, and the
+  last-violation wallclock (obs/probe.py peer_stats), so an SLO
+  breach on the probe families names the peer;
 - **threads** (top level): component-named live threads
   (``antidote-fab-*`` / ``antidote-sub-*`` / ``antidote-nl-*``) with
   live counts, so a stall dump names the blocked component instead of
@@ -53,6 +57,7 @@ diagnostic read must not take the server down).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 import weakref
@@ -60,8 +65,17 @@ from typing import Any, Dict, List
 
 from antidote_tpu.obs.events import _jsonable
 
+log = logging.getLogger(__name__)
+
 _lock = threading.Lock()
 _endpoints: List["weakref.ref"] = []
+
+#: sections whose last evaluation failed, keyed by section name — the
+#: first failure of an episode is logged, repeats stay quiet, and a
+#: success re-arms the latch (the watchdog episode-latch discipline,
+#: ISSUE 17: a permanently-broken section must not masquerade as an
+#: idle one)
+_section_failed: Dict[str, str] = {}
 
 
 def register(dc) -> None:
@@ -88,13 +102,23 @@ def endpoints() -> list:
         return out
 
 
-def _section(fn):
+def _section(name, fn):
     """Run one snapshot section; a failure becomes an error marker
-    instead of killing the whole document."""
+    instead of killing the whole document — but the FIRST failure of
+    each episode is logged (latched per section; a success re-arms),
+    so a section that broke forever is visible in the log exactly
+    once instead of silently reading as empty on every scrape."""
     try:
-        return fn()
+        out = fn()
     except Exception as e:  # noqa: BLE001 — diagnostics must not throw
+        if name not in _section_failed:
+            log.warning("pipeline snapshot section %s failed "
+                        "(latched — logged once per episode): %r",
+                        name, e, exc_info=True)
+        _section_failed[name] = repr(e)
         return {"error": repr(e)}
+    _section_failed.pop(name, None)
+    return out
 
 
 def _ship_section(dc) -> Dict[str, Any]:
@@ -245,18 +269,49 @@ def _stable_section(dc) -> Dict[str, Any]:
     return out
 
 
-def dc_snapshot(dc) -> Dict[str, Any]:
-    """One DC's pipeline state, every section independently guarded."""
+def _probe_section(dc) -> Dict[str, Any]:
+    """The causal probe's depth (ISSUE 17): per-peer round-trip and
+    last-violation wallclock, so an SLO breach on the probe families
+    attributes to a peer instead of a global counter."""
+    pr = getattr(dc, "_causal_probe", None)
+    if pr is None:
+        return {"enabled": False}
     return {
-        "ship": _section(lambda: _ship_section(dc)),
-        "sub_bufs": _section(lambda: _sub_buf_section(dc)),
-        "gates": _section(lambda: _gate_section(dc)),
-        "ingest": _section(lambda: _ingest_section(dc)),
-        "log": _section(lambda: _log_section(dc)),
-        "stable": _section(lambda: _stable_section(dc)),
-        "fabric": _section(lambda: _fabric_section(dc)),
-        "native": _section(lambda: _native_section(dc)),
+        "enabled": True,
+        "period_s": pr.period_s,
+        "rounds": pr.rounds,
+        "violations": pr.violations,
+        "last_violation_at_us": pr.last_violation_at_us,
+        "peers": pr.probe_stats(),
+    }
+
+
+def dc_snapshot(dc) -> Dict[str, Any]:
+    """One DC's pipeline state, every section independently guarded.
+    Section latch keys carry the DC name so one DC's broken section
+    cannot re-arm (or mask) another's."""
+    try:
+        who = str(dc.node.dc_id)
+    except Exception:  # noqa: BLE001 — half-built DC still snapshots
+        who = "?"
+    return {
+        "ship": _section(f"{who}.ship", lambda: _ship_section(dc)),
+        "sub_bufs": _section(f"{who}.sub_bufs",
+                             lambda: _sub_buf_section(dc)),
+        "gates": _section(f"{who}.gates", lambda: _gate_section(dc)),
+        "ingest": _section(f"{who}.ingest",
+                           lambda: _ingest_section(dc)),
+        "log": _section(f"{who}.log", lambda: _log_section(dc)),
+        "stable": _section(f"{who}.stable",
+                           lambda: _stable_section(dc)),
+        "fabric": _section(f"{who}.fabric",
+                           lambda: _fabric_section(dc)),
+        "native": _section(f"{who}.native",
+                           lambda: _native_section(dc)),
+        "probe": _section(f"{who}.probe",
+                          lambda: _probe_section(dc)),
         "connected_dcs": _section(
+            f"{who}.connected_dcs",
             lambda: [str(d) for d in getattr(dc, "connected_dcs", [])]),
     }
 
@@ -275,7 +330,7 @@ def snapshot() -> Dict[str, Any]:
             continue
         dcs[name] = dc_snapshot(dc)
     return {"at_us": time.time_ns() // 1000, "dcs": dcs,
-            "threads": _section(_threads_section)}
+            "threads": _section("threads", _threads_section)}
 
 
 def snapshot_json() -> str:

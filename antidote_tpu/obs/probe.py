@@ -92,6 +92,22 @@ class CausalProbe:
         self._thread = None
         self.rounds = 0
         self.violations = 0
+        #: per-peer depth (ISSUE 17): peer dc_id -> {rounds,
+        #: violations, last_rtt_s, last_violation_at_us} — the
+        #: attribution surface /debug/pipeline's probe section and
+        #: slo_report expose (the global counters cannot name a peer)
+        self.peer_stats: dict = {}
+        self.last_violation_at_us = None
+
+    def _peer_entry(self, peer_id) -> dict:
+        return self.peer_stats.setdefault(str(peer_id), {
+            "rounds": 0, "violations": 0, "last_rtt_s": None,
+            "last_violation_at_us": None})
+
+    def probe_stats(self) -> dict:
+        """Copy of the per-peer depth map (safe to serialize while the
+        probe thread keeps writing — entries are small flat dicts)."""
+        return {p: dict(v) for p, v in list(self.peer_stats.items())}
 
     def _key(self):
         return (f"__causal_probe__{self.local.node.dc_id}", "set_aw",
@@ -147,6 +163,12 @@ class CausalProbe:
                 continue
             staleness_s = time.perf_counter() - t0
             stats.registry.vis_probe_staleness.observe(staleness_s)
+            stats.registry.vis_probe_rtt.set(
+                staleness_s, dc=str(self.local.node.dc_id),
+                peer=str(peer.node.dc_id))
+            ps = self._peer_entry(peer.node.dc_id)
+            ps["rounds"] += 1
+            ps["last_rtt_s"] = round(staleness_s, 6)
             recorder.record("probe", "causal_probe",
                             dc=str(self.local.node.dc_id),
                             peer=str(peer.node.dc_id),
@@ -165,6 +187,10 @@ class CausalProbe:
                 pass
             if missing:
                 self.violations += 1
+                now_us = time.time_ns() // 1000
+                ps["violations"] += 1
+                ps["last_violation_at_us"] = now_us
+                self.last_violation_at_us = now_us
                 stats.registry.vis_probe_violations.inc()
                 from antidote_tpu.obs import pipeline
 

@@ -728,6 +728,74 @@ class Registry:
             "Config.native_watchdog_s",
             labels=("ring",))
 
+        # ---- bounded-counter rights economy (ISSUE 17, bcounter.py)
+        # the rights-transfer protocol is the first thing cross-DC
+        # chaos breaks, so it must be observable before chaos exists
+        self.bcounter_rights_held = LabeledGauge(
+            "antidote_bcounter_rights_held",
+            "Last-observed local decrement rights per DC (bounded "
+            "counters): available permissions after the most recent "
+            "local decrement or denial",
+            labels=("dc",))
+        self.bcounter_denials = Counter(
+            "antidote_bcounter_denials_total",
+            "Bounded-counter decrements aborted no_permissions — "
+            "each denial queues a rights-transfer request")
+        self.bcounter_transfer_requests = Counter(
+            "antidote_bcounter_transfer_requests_total",
+            "Rights-transfer requests sent to remote DCs, labelled "
+            "by the peer asked (the richest known holder)",
+            labels=("peer",))
+        self.bcounter_transfers_granted = Counter(
+            "antidote_bcounter_transfers_granted_total",
+            "Rights transfers this DC granted to remote requesters, "
+            "labelled by requester",
+            labels=("peer",))
+        self.bcounter_grace_suppressed = Counter(
+            "antidote_bcounter_grace_suppressed_total",
+            "Remote rights requests refused because the same "
+            "requester was granted within the grace period "
+            "(duplicate-request shedding, not a denial of rights)")
+        self.bcounter_grace_expiries = Counter(
+            "antidote_bcounter_grace_expiries_total",
+            "Grace-period entries expired by the periodic transfer "
+            "pass — each expiry re-opens a requester for grants")
+
+        # ---- fleet health plane (ISSUE 17, obs/fleet.py + obs/slo.py)
+        self.vis_probe_rtt = LabeledGauge(
+            "antidote_vis_probe_rtt_seconds",
+            "Last causal-probe write-to-read round-trip per "
+            "(dc, peer) — the per-peer attribution the global "
+            "staleness histogram cannot give",
+            labels=("dc", "peer"))
+        self.fleet_scrape_age = Gauge(
+            "antidote_fleet_scrape_age_seconds",
+            "Realized gap between the last two fleet scrapes — a "
+            "wedged scrape loop freezes this gauge")
+        self.fleet_sources = Gauge(
+            "antidote_fleet_sources",
+            "Sources merged into the last fleet snapshot (local + "
+            "reachable remote endpoints)")
+        self.fleet_scrape_errors = Counter(
+            "antidote_fleet_scrape_errors_total",
+            "Fleet scrape failures per unreachable source endpoint",
+            labels=("source",))
+        self.slo_burn_rate = LabeledGauge(
+            "antidote_slo_burn_rate",
+            "Error-budget burn rate per SLO objective from the last "
+            "evaluation (1.0 = budget exactly spent; obs/slo.py)",
+            labels=("objective",))
+        self.slo_budget_remaining = LabeledGauge(
+            "antidote_slo_error_budget_remaining",
+            "Remaining error-budget fraction per SLO objective from "
+            "the last evaluation (max(0, 1 - burn_rate))",
+            labels=("objective",))
+        self.slo_ok = LabeledGauge(
+            "antidote_slo_ok",
+            "1 when the SLO objective met its burn threshold at the "
+            "last evaluation, 0 when it breached",
+            labels=("objective",))
+
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
                 self.aborted_transactions, self.operations,
@@ -774,7 +842,17 @@ class Registry:
                 self.native_answer_latency, self.native_pub_stage,
                 self.native_sub_queue_wait, self.native_frame_age,
                 self.native_sub_enqueued, self.native_sub_dropped,
-                self.native_ring_dropped, self.native_heartbeat_age)
+                self.native_ring_dropped, self.native_heartbeat_age,
+                self.bcounter_rights_held, self.bcounter_denials,
+                self.bcounter_transfer_requests,
+                self.bcounter_transfers_granted,
+                self.bcounter_grace_suppressed,
+                self.bcounter_grace_expiries,
+                self.vis_probe_rtt,
+                self.fleet_scrape_age, self.fleet_sources,
+                self.fleet_scrape_errors,
+                self.slo_burn_rate, self.slo_budget_remaining,
+                self.slo_ok)
 
     def exposition(self) -> str:
         lines = []
@@ -1014,6 +1092,15 @@ class MetricsServer:
                     from antidote_tpu.obs import pipeline
 
                     body = pipeline.snapshot_json().encode()
+                    ctype = "application/json"
+                elif path == "/debug/health":
+                    import json as _json
+
+                    from antidote_tpu.obs import slo
+
+                    body = _json.dumps(
+                        slo.evaluate_registry(outer.registry),
+                        indent=1, sort_keys=True).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -1,0 +1,170 @@
+"""Config 15: fleet health plane — what scraping costs the commit
+path (ISSUE 17).
+
+obs/fleet.py federates every node's ``/metrics`` + ``/debug/pipeline``
+into one snapshot and obs/slo.py re-judges the merged samples per
+scrape.  The design premise is that federation is a background-cheap
+READ of surfaces the pipeline already maintains: the scrape loop must
+never show up in commit latency.  This config drives the same commit
+tape through a live 2-DC cluster twice — fleet scraping off vs an
+aggressive scrape loop plus a real HTTP metrics endpoint on — and
+gates exactly that:
+
+- ``fleet_scrape_overhead_pct`` (pct, must not rise): commit p99 with
+  the knob-gated scrape loop running at 250 ms (including HTTP
+  round-trips to a live metrics server and a full SLO evaluation per
+  round) relative to the unscraped leg — the in-bench acceptance bar
+  is <= 3%.  Anything visible at p99 means the scraper is contending
+  for a lock the commit path takes (or holding the GIL in long
+  uncooperative bursts), which is precisely the design violation the
+  bar exists to catch.
+- ``fleet_scrape_us`` (us/scrape, must not rise): wall cost of one
+  full fleet scrape (HTTP fetch + exposition parse + merge + SLO
+  verdict + gauge refresh) — rising means federation stopped being a
+  cheap read and started recomputing the pipeline.
+
+The production scrape cadence is seconds (``Config.fleet_scrape_s``);
+the 250 ms loop here is a deliberate 4-40x stress, and the scraped
+leg keeps committing until at least two full scrape rounds landed
+inside it, so the p99 comparison always contains real collisions.
+A scrape costs ~5-10 ms of which most is GIL-released socket wait;
+at a 250 ms cadence that is a <1% duty cycle, so a clean
+implementation sits far under the 3% bar while a lock shared with
+the commit path blows straight through it.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+
+from benches._util import emit, setup
+
+
+def _percentile(values, q):
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    idx = min(int(q * len(vals)), len(vals) - 1)
+    return vals[idx]
+
+
+def build_cluster(data_dir):
+    from antidote_tpu.config import Config
+    from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+    from antidote_tpu.interdc.transport import InProcBus
+
+    bus = InProcBus()
+    kw = dict(n_partitions=2, device_store=False, heartbeat_s=0.02,
+              clock_wait_timeout_s=10.0)
+    dcs = [DataCenter(f"dc{i + 1}", bus, config=Config(**kw),
+                      data_dir=f"{data_dir}/dc{i + 1}")
+           for i in range(2)]
+    connect_dcs(dcs)
+    for dc in dcs:
+        dc.start_bg_processes()
+    return dcs
+
+
+def drive_commits(dc, n, keys, until=None):
+    """At least n single-update commits on dc; per-txn latency in us.
+    With ``until``, keeps committing past n until the predicate holds
+    (bounded at 10n) — the scraped leg uses this to guarantee the
+    sample window actually contains scrape rounds."""
+    lat_us = []
+    i = 0
+    while i < n or (until is not None and not until() and i < n * 10):
+        bound = (keys[i % len(keys)], "counter_pn", "bench")
+        t0 = time.perf_counter()
+        dc.update_objects_static(None, [(bound, "increment", 1)])
+        lat_us.append((time.perf_counter() - t0) * 1e6)
+        i += 1
+    return lat_us
+
+
+def main():
+    quick, _jax = setup()
+    from antidote_tpu import stats
+    from antidote_tpu.obs.fleet import FleetScraper
+
+    n_txns = 4000 if quick else 12000
+    scrape_period_s = 0.25
+    keys = [f"fleet_{i:02d}" for i in range(16)]
+
+    with tempfile.TemporaryDirectory(prefix="bench_fleet_") as tmp:
+        dcs = build_cluster(tmp)
+        server = stats.MetricsServer(port=0)
+        server.start()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            dc1 = dcs[0]
+            drive_commits(dc1, n_txns // 4, keys)  # warmup
+
+            # a tail percentile is noisy on a loaded box: 3 attempts,
+            # keep the best (the config12/14 discipline)
+            best = None
+            for attempt in range(3):
+                off_us = drive_commits(dc1, n_txns, keys)
+                scraper = FleetScraper(endpoints=[url],
+                                       period_s=scrape_period_s,
+                                       name="bench")
+                scraper.start()
+                try:
+                    on_us = drive_commits(
+                        dc1, n_txns, keys,
+                        until=lambda: scraper.rounds >= 2)
+                finally:
+                    scraper.stop()
+                assert scraper.rounds >= 2, \
+                    "the scrape loop never completed two rounds — " \
+                    "the on leg measured nothing"
+                assert scraper.last_verdict is not None \
+                    and len(scraper.last_verdict["objectives"]) >= 6, \
+                    "the scrape rounds produced no SLO verdict"
+                off_p99 = _percentile(off_us, 0.99)
+                on_p99 = _percentile(on_us, 0.99)
+                overhead = (on_p99 - off_p99) / max(off_p99,
+                                                    1e-9) * 100.0
+                if best is None or overhead < best[0]:
+                    best = (overhead, on_p99, off_p99,
+                            _percentile(on_us, 0.5),
+                            _percentile(off_us, 0.5), scraper.rounds)
+                if overhead <= 3.0:
+                    break
+            (overhead, on_p99, off_p99, on_p50, off_p50,
+             rounds) = best
+            assert overhead <= 3.0, \
+                f"scraped commit p99 {on_p99:.0f}us vs unscraped " \
+                f"{off_p99:.0f}us (+{overhead:.1f}%) — over the 3% " \
+                f"bar after {attempt + 1} attempts"
+            emit("fleet_scrape_overhead_pct",
+                 round(max(overhead, 0.0), 2), "pct", 3.0,
+                 on_p99_us=round(on_p99, 1),
+                 off_p99_us=round(off_p99, 1),
+                 on_p50_us=round(on_p50, 1),
+                 off_p50_us=round(off_p50, 1),
+                 scrape_rounds=rounds, txns=n_txns,
+                 scrape_period_s=scrape_period_s)
+
+            # the absolute cost of one full scrape, measured alone
+            scraper = FleetScraper(endpoints=[url], name="bench-cost")
+            m = 20 if quick else 50
+            scraper.scrape_once()  # warm the HTTP connection path
+            t0 = time.perf_counter()
+            for _ in range(m):
+                snap = scraper.scrape_once()
+            per_scrape_us = (time.perf_counter() - t0) / m * 1e6
+            assert not snap["errors"], \
+                f"scrape errors against a live endpoint: {snap['errors']}"
+            emit("fleet_scrape_us", round(per_scrape_us, 1),
+                 "us/scrape", None,
+                 rounds=m, sources=len(snap["sources"]),
+                 objectives=len(snap["verdict"]["objectives"]))
+        finally:
+            server.stop()
+            for dc in dcs:
+                dc.close()
+
+
+if __name__ == "__main__":
+    main()

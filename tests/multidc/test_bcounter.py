@@ -132,6 +132,82 @@ class TestPermissionTransfer:
             "dc3", ("bc_grace", "bkt", 2, "dc3")) is True
 
 
+class TestBcounterMetrics:
+    """ISSUE 17 satellite: the rights-transfer economy is observable —
+    BCOUNTER_* families move with denials, grants, grace suppression
+    and transfer requests (deltas against the process-global registry,
+    which carries every prior test's history)."""
+
+    def test_denial_bumps_counter_and_rights_gauge(self, cluster3):
+        from antidote_tpu import stats
+
+        dc1 = cluster3[0]
+        bound = ("bc_met_deny", "counter_b", "bkt")
+        ct = incr(dc1, 3, bound=bound)
+        before = stats.registry.bcounter_denials.value()
+        with pytest.raises(TransactionAborted, match="no_permissions"):
+            decr(dc1, 5, clock=ct, bound=bound)
+        assert stats.registry.bcounter_denials.value() == before + 1
+        # the denial path refreshed the last-observed rights gauge
+        held = stats.registry.bcounter_rights_held.value(dc="dc1")
+        assert held is not None and held >= 0.0
+
+    def test_successful_decrement_updates_rights_gauge(self, cluster3):
+        from antidote_tpu import stats
+
+        dc1 = cluster3[0]
+        bound = ("bc_met_ok", "counter_b", "bkt")
+        ct = incr(dc1, 10, bound=bound)
+        decr(dc1, 4, clock=ct, bound=bound)
+        # after spending 4 of 10 freshly-minted rights the gauge
+        # reflects the remainder of the LAST counter touched
+        assert stats.registry.bcounter_rights_held.value(dc="dc1") \
+            == 6.0
+
+    def test_grant_and_grace_counters(self, cluster3):
+        from antidote_tpu import stats
+
+        dc1 = cluster3[0]
+        mgr = dc1.node.bcounter_mgr
+        reg = stats.registry
+        incr(dc1, 8, bound=("bc_met_grace", "counter_b", "bkt"))
+        granted0 = reg.bcounter_transfers_granted.value(peer="dc2")
+        suppressed0 = reg.bcounter_grace_suppressed.value()
+        assert mgr.handle_remote_request(
+            "dc2", ("bc_met_grace", "bkt", 2, "dc2")) is True
+        assert reg.bcounter_transfers_granted.value(peer="dc2") \
+            == granted0 + 1
+        assert reg.bcounter_grace_suppressed.value() == suppressed0
+        # the grace-period refusal is counted as suppression, not
+        # as another grant
+        assert mgr.handle_remote_request(
+            "dc2", ("bc_met_grace", "bkt", 2, "dc2")) is False
+        assert reg.bcounter_transfers_granted.value(peer="dc2") \
+            == granted0 + 1
+        assert reg.bcounter_grace_suppressed.value() == suppressed0 + 1
+
+    def test_transfer_request_counted_at_the_asker(self, cluster3):
+        from antidote_tpu import stats
+
+        dc1, dc2, dc3 = cluster3
+        reg = stats.registry
+        bound = ("bc_met_req", "counter_b", "bkt")
+        before = sum(
+            reg.bcounter_transfer_requests.value(peer=p)
+            for p in ("dc1", "dc2", "dc3"))
+        ct = incr(dc1, 10, bound=bound)
+        wait_value(dc2, ct, 10, bound)
+        with pytest.raises(TransactionAborted, match="no_permissions"):
+            decr(dc2, 6, clock=ct, bound=bound)
+        # the queued request goes out on the next transfer pass
+        deadline = time.monotonic() + 10.0
+        while sum(reg.bcounter_transfer_requests.value(peer=p)
+                  for p in ("dc1", "dc2", "dc3")) == before:
+            assert time.monotonic() < deadline, \
+                "no transfer request was ever counted"
+            time.sleep(0.05)
+
+
 class TestCheckpointSeededRecovery:
     """ISSUE 13 satellite: bounded-counter PERMISSION state must
     survive a checkpoint-seeded restart — rights live in the

@@ -220,7 +220,7 @@ class TestVisibilityMetrics:
 
 class TestPipelineSnapshot:
     SECTIONS = {"ship", "sub_bufs", "gates", "ingest", "log", "stable",
-                "fabric", "native", "connected_dcs"}
+                "fabric", "native", "probe", "connected_dcs"}
 
     def test_snapshot_schema(self, journey2):
         dc1, dc2 = journey2
@@ -251,6 +251,12 @@ class TestPipelineSnapshot:
                         "last_opid"} <= set(stream)
             assert "snapshot" in d["stable"]
             assert set(d["stable"]["per_partition"]) == {"0", "1"}
+            # the probe section (ISSUE 17): armed by the fixture's
+            # obs_causal_probe_s, carries the per-peer depth
+            pr = d["probe"]
+            assert pr["enabled"] is True
+            assert {"period_s", "rounds", "violations",
+                    "last_violation_at_us", "peers"} <= set(pr)
         # the origin actually shipped: its stream watermark moved
         assert any(s["last_sent_opid"] > 0
                    for s in snap["dcs"]["dc1"]["ship"].values())
@@ -266,6 +272,21 @@ class TestPipelineSnapshot:
                 doc = json.load(r)
             assert {"dc1", "dc2"} <= set(doc["dcs"])
             assert set(doc["dcs"]["dc1"]) == self.SECTIONS
+            # the same server now answers /debug/health (ISSUE 17)
+            # with the SLO verdict over its own registry
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/health",
+                    timeout=10) as r:
+                health = json.load(r)
+            assert {"at_us", "ok", "failing",
+                    "objectives"} <= set(health)
+            assert isinstance(health["ok"], bool)
+            assert isinstance(health["failing"], list)
+            assert len(health["objectives"]) >= 6
+            for name, obj in health["objectives"].items():
+                assert {"ok", "kind", "family", "target",
+                        "burn_rate", "budget_remaining",
+                        "no_data"} <= set(obj), (name, obj)
         finally:
             srv.stop()
 
@@ -308,6 +329,55 @@ class TestTxnJourneyCli:
         rc = txn_journey.main(["--list", "--file", path])
         assert rc == 0
         assert json.dumps(list(txid)) in capsys.readouterr().out
+
+    def test_cluster_mode_stitches_two_live_endpoints(self, journey2,
+                                                      capsys):
+        """--cluster url1,url2 (ISSUE 17): one cross-DC txn's origin
+        and remote spans fetched from two live /debug/spans endpoints
+        merge into a single tree with per-stage deltas.  Both
+        endpoints here serve the same process-global tracer — the
+        dedup by (name, ts, dur, pid, tid) must keep the merged
+        chain identical to a single endpoint's, not doubled."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "..",
+            "tools"))
+        import txn_journey
+
+        dc1, dc2 = journey2
+        txid, _ct = _commit_and_replicate(dc1, dc2, elem="fleet")
+        s1 = stats.MetricsServer(port=0).start()
+        s2 = stats.MetricsServer(port=0).start()
+        try:
+            u1 = f"http://127.0.0.1:{s1.port}"
+            u2 = f"http://127.0.0.1:{s2.port}"
+            rc = txn_journey.main([json.dumps(list(txid)),
+                                   "--cluster", f"{u1},{u2}",
+                                   "--json"])
+            doc = json.loads(capsys.readouterr().out)
+            assert rc == 0
+            stages = [r["stage"] for r in doc["stages"]]
+            # the stitched tree covers BOTH halves of the journey
+            assert ORIGIN_STAGES <= set(stages), stages
+            assert REMOTE_STAGES <= set(stages), stages
+            assert stages.index("txn_commit") \
+                < stages.index("interdc_visible")
+            assert doc["commit_to_visible_us"] > 0
+            # per-stage deltas are present and non-negative
+            assert all(r["delta_us"] is None or r["delta_us"] >= 0
+                       for r in doc["stages"])
+
+            # dedup: the 2-endpoint merge equals the 1-endpoint view
+            rc = txn_journey.main([json.dumps(list(txid)),
+                                   "--cluster", u1, "--json"])
+            single = json.loads(capsys.readouterr().out)
+            assert rc == 0
+            assert [r["stage"] for r in single["stages"]] == stages
+        finally:
+            s1.stop()
+            s2.stop()
 
 
 class TestGapForensics:

@@ -1,0 +1,210 @@
+"""SLO algebra + fleet federation unit surface (ISSUE 17):
+obs/slo.py's evaluators against synthetic samples (burn arithmetic,
+baseline windows, worst-offender attribution, the BURN_CAP strict-
+JSON contract), obs/fleet.py's exposition parser round-trip and
+src-label grafting, and obs/pipeline.py's first-failure section
+latch."""
+
+import json
+import logging
+
+from antidote_tpu import stats
+from antidote_tpu.obs import fleet, pipeline, slo
+from antidote_tpu.obs.slo import Objective
+
+FAM = "antidote_test_latency_seconds"
+
+
+def _hist(rows):
+    """rows: (labels, le->cumulative) -> bucket samples."""
+    out = []
+    for labels, by_le in rows:
+        for le, v in by_le.items():
+            out.append(({**labels, "le": le}, float(v)))
+    return {FAM + "_bucket": out}
+
+
+def _quant(target=1.0, q=0.99, threshold=1.0):
+    return Objective(name="t_p99", family=FAM, kind="quantile",
+                     target=target, quantile=q,
+                     burn_threshold=threshold)
+
+
+class TestQuantileEvaluator:
+    def test_within_budget(self):
+        # 100 obs, 1 beyond the 1.0s target: bad_frac 1% == allowed
+        s = _hist([({"dc": "a"},
+                    {"0.1": 90, "1.0": 99, "+Inf": 100})])
+        v = slo.evaluate(s, objectives=[_quant()])
+        o = v["objectives"]["t_p99"]
+        assert v["ok"] and o["ok"] and not o["no_data"]
+        assert o["burn_rate"] == 1.0
+        assert o["budget_remaining"] == 0.0
+        assert o["observations"] == 100 and o["bad_events"] == 1
+
+    def test_breach_burn_arithmetic(self):
+        # 5% beyond target at q=0.99: burn = 0.05 / 0.01 = 5
+        s = _hist([({}, {"1.0": 95, "+Inf": 100})])
+        v = slo.evaluate(s, objectives=[_quant()])
+        o = v["objectives"]["t_p99"]
+        assert not v["ok"] and v["failing"] == ["t_p99"]
+        assert o["burn_rate"] == 5.0 and o["budget_remaining"] == 0.0
+
+    def test_worst_label_group_decides(self):
+        # group a is clean; group b is 50% bad — the verdict must be
+        # b's burn with b's labels attributed
+        s = _hist([({"dc": "a"}, {"1.0": 100, "+Inf": 100}),
+                   ({"dc": "b"}, {"1.0": 50, "+Inf": 100})])
+        v = slo.evaluate(s, objectives=[_quant()])
+        o = v["objectives"]["t_p99"]
+        assert not o["ok"]
+        assert o["worst"]["labels"] == {"dc": "b"}
+        assert o["worst"]["bad"] == 50.0
+
+    def test_p_estimate_and_inf_tail(self):
+        s = _hist([({}, {"0.1": 99, "+Inf": 100})])
+        v = slo.evaluate(s, objectives=[_quant(target=5.0, q=0.5)])
+        o = v["objectives"]["t_p99"]
+        assert o["ok"]  # p50 well under 5s
+        assert o["worst"]["p_estimate"] == 0.1
+        # all mass in +Inf: the estimate is unknowable, not inf
+        s2 = _hist([({}, {"+Inf": 100})])
+        o2 = slo.evaluate(s2, objectives=[_quant()])[
+            "objectives"]["t_p99"]
+        assert o2["worst"]["p_estimate"] is None
+
+    def test_baseline_window_delta(self):
+        base = _hist([({}, {"1.0": 50, "+Inf": 100})])  # old: 50% bad
+        now = _hist([({}, {"1.0": 150, "+Inf": 200})])  # window: clean
+        healthy = slo.evaluate(
+            now, objectives=[_quant()],
+            baseline={FAM + "_bucket": base[FAM + "_bucket"]})
+        o = healthy["objectives"]["t_p99"]
+        assert o["ok"] and o["observations"] == 100 \
+            and o["bad_events"] == 0
+        # without the baseline the cumulative history breaches
+        assert not slo.evaluate(now, objectives=[_quant()])["ok"]
+
+    def test_no_data_is_ok_but_flagged(self):
+        v = slo.evaluate({}, objectives=[_quant()])
+        o = v["objectives"]["t_p99"]
+        assert v["ok"] and o["ok"] and o["no_data"]
+        assert o["burn_rate"] == 0.0 and o["budget_remaining"] == 1.0
+
+
+class TestCounterAndGaugeEvaluators:
+    def test_zero_target_counter_caps_not_inf(self):
+        obj = Objective(name="viol", family="x_total",
+                        kind="counter_max", target=0.0)
+        v = slo.evaluate({"x_total": [({}, 3.0)]}, objectives=[obj])
+        o = v["objectives"]["viol"]
+        assert not o["ok"] and o["value"] == 3.0
+        assert o["burn_rate"] == slo.BURN_CAP
+        assert o["budget_remaining"] == 0.0
+        json.dumps(v)  # BURN_CAP keeps the verdict strict JSON
+
+    def test_counter_baseline_delta_clamped(self):
+        obj = Objective(name="viol", family="x_total",
+                        kind="counter_max", target=0.0)
+        samples = {"x_total": [({"dc": "a"}, 5.0)]}
+        base = {"x_total": [({"dc": "a"}, 5.0)]}
+        v = slo.evaluate(samples, objectives=[obj], baseline=base)
+        assert v["objectives"]["viol"]["ok"]  # no NEW events
+        # a counter that went backwards (process restart) clamps to 0
+        v2 = slo.evaluate({"x_total": [({"dc": "a"}, 2.0)]},
+                          objectives=[obj], baseline=base)
+        assert v2["objectives"]["viol"]["ok"]
+
+    def test_gauge_max_worst_child(self):
+        obj = Objective(name="age", family="age_seconds",
+                        kind="gauge_max", target=10.0)
+        v = slo.evaluate(
+            {"age_seconds": [({"p": "0"}, 2.0), ({"p": "1"}, 25.0)]},
+            objectives=[obj])
+        o = v["objectives"]["age"]
+        assert not o["ok"]
+        assert o["burn_rate"] == 2.5
+        assert o["worst"]["labels"] == {"p": "1"}
+
+
+class TestVerdictSurface:
+    def test_default_registry_round_trip(self):
+        """exposition -> parse -> evaluate over a fresh registry:
+        every default objective judges, all no-data objectives pass,
+        and the verdict is strict JSON."""
+        reg = stats.Registry()
+        samples = fleet.parse_prometheus_text(reg.exposition())
+        v = slo.evaluate(samples)
+        assert len(v["objectives"]) >= 6 and v["ok"]
+        json.dumps(v)
+        assert set(v["objectives"]) == {o.name
+                                        for o in slo.DEFAULT_OBJECTIVES}
+
+    def test_refresh_gauges_mirrors_the_verdict(self):
+        s = _hist([({}, {"1.0": 50, "+Inf": 100})])
+        v = slo.evaluate(s, objectives=[_quant()])
+        slo.refresh_gauges(v)
+        reg = stats.registry
+        assert reg.slo_ok.value(objective="t_p99") == 0.0
+        assert reg.slo_burn_rate.value(objective="t_p99") == 50.0
+        assert reg.slo_budget_remaining.value(objective="t_p99") == 0.0
+
+
+class TestPrometheusParser:
+    def test_round_trip_with_escaped_labels(self):
+        reg = stats.Registry()
+        reg.vis_lag.observe(0.25, dc="d1", peer="d2")
+        samples = fleet.parse_prometheus_text(reg.exposition())
+        assert ("antidote_vis_visibility_lag_seconds_bucket"
+                in samples)
+        rows = samples["antidote_vis_visibility_lag_seconds_count"]
+        assert rows == [({"dc": "d1", "peer": "d2"}, 1.0)]
+        # escaped label values un-escape exactly once
+        text = 'm_total{k="a\\nb\\"c\\\\d"} 3\n# comment\nbare 1\n'
+        parsed = fleet.parse_prometheus_text(text)
+        assert parsed["m_total"] == [({"k": 'a\nb"c\\d'}, 3.0)]
+        assert parsed["bare"] == [({}, 1.0)]
+
+    def test_unparseable_lines_are_skipped(self):
+        parsed = fleet.parse_prometheus_text(
+            "ok 1\nthis is not a metric\nalso{broken 2\n")
+        assert parsed == {"ok": [({}, 1.0)]}
+
+    def test_merged_metrics_grafts_src(self):
+        snap = {"sources": {
+            "http://a": {"metrics": {"m": [({"x": "1"}, 2.0)]}},
+            "http://b": {"metrics": {"m": [({"x": "1"}, 4.0)]}},
+        }}
+        merged = fleet.merged_metrics(snap)
+        assert sorted(merged["m"], key=lambda r: r[0]["src"]) == [
+            ({"src": "http://a", "x": "1"}, 2.0),
+            ({"src": "http://b", "x": "1"}, 4.0)]
+
+
+class TestSectionLatch:
+    def test_first_failure_logs_then_latches(self, caplog):
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise RuntimeError("kaput")
+
+        pipeline._section_failed.pop("t.sect", None)
+        with caplog.at_level(logging.WARNING,
+                             logger="antidote_tpu.obs.pipeline"):
+            out = pipeline._section("t.sect", boom)
+            assert out == {"error": "RuntimeError('kaput')"}
+            first = [r for r in caplog.records
+                     if "t.sect" in r.getMessage()]
+            assert len(first) == 1  # the first failure logs
+            pipeline._section("t.sect", boom)
+            assert len([r for r in caplog.records
+                        if "t.sect" in r.getMessage()]) == 1  # latched
+            # success re-arms the latch...
+            assert pipeline._section("t.sect", dict) == {}
+            assert "t.sect" not in pipeline._section_failed
+            # ...so the NEXT episode logs again
+            pipeline._section("t.sect", boom)
+            assert len([r for r in caplog.records
+                        if "t.sect" in r.getMessage()]) == 2
+        pipeline._section_failed.pop("t.sect", None)

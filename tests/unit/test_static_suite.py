@@ -41,7 +41,8 @@ def test_registry_covers_every_analyzer():
     the reminder."""
     assert [name for name, _ in static_suite.PASSES] == \
         ["analysis_gate", "trace_lint", "concurrency_lint",
-         "durability_lint", "stats-dashboard", "native-telemetry"]
+         "durability_lint", "stats-dashboard", "native-telemetry",
+         "slo-coverage"]
 
 
 def test_findings_route_with_pass_prefix(monkeypatch):
@@ -280,3 +281,115 @@ def test_stats_dashboard_rule_is_not_vacuous_on_the_repo():
             and getattr(n.func, "id", None) in static_suite._METRIC_CLASSES
             and n.args and isinstance(n.args[0], ast.Constant)]
     assert len(fams) >= 40
+
+
+# ------------------------------------------------- slo-coverage rule
+
+_SLO_SRC = (
+    "DEFAULT_OBJECTIVES = (\n"
+    "    Objective(name='vis_p99', family='antidote_vis_seconds',\n"
+    "              kind='quantile', target=5.0),\n"
+    "    Objective('probe_viol', 'antidote_viol_total',\n"
+    "              kind='counter_max', target=0.0),\n"
+    ")\n")
+
+_SLO_README = (
+    "# monitoring\n"
+    "### SLO objectives\n"
+    "| objective | target |\n"
+    "| --- | --- |\n"
+    "| `vis_p99` | p99 <= 5 s |\n"
+    "| `probe_viol` | zero |\n"
+    "## next section\n")
+
+
+def _slo_fixture(tmp_path, slo_src=_SLO_SRC,
+                 stats_families=("antidote_vis_seconds",
+                                 "antidote_viol_total"),
+                 readme=_SLO_README):
+    pkg = tmp_path / "antidote_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "obs" / "slo.py").write_text(slo_src)
+    (pkg / "stats.py").write_text(
+        "class Counter:\n"
+        "    def __init__(self, name, help=''):\n"
+        "        self.name = name\n"
+        + "".join(f"m{i} = Counter('{f}', '')\n"
+                  for i, f in enumerate(stats_families)))
+    mon = tmp_path / "monitoring"
+    mon.mkdir()
+    (mon / "README.md").write_text(readme)
+    return str(tmp_path)
+
+
+def test_slo_coverage_clean_fixture(tmp_path):
+    """Objectives bind registered families, docs list exactly them:
+    no findings."""
+    assert static_suite.lint_slo_coverage(_slo_fixture(tmp_path)) == []
+
+
+def test_slo_coverage_flags_unregistered_family(tmp_path):
+    """An objective over a family stats.py never registers would
+    evaluate no-data-ok forever — the silent-guarantee failure the
+    forward direction exists for."""
+    root = _slo_fixture(tmp_path,
+                        stats_families=("antidote_vis_seconds",))
+    problems = static_suite.lint_slo_coverage(root)
+    assert len(problems) == 1
+    assert "antidote_viol_total" in problems[0]
+    assert "not registered" in problems[0]
+    assert "[slo-coverage]" in problems[0]
+
+
+def test_slo_coverage_flags_undocumented_objective(tmp_path):
+    root = _slo_fixture(
+        tmp_path,
+        readme=_SLO_README.replace("| `vis_p99` | p99 <= 5 s |\n", ""))
+    problems = static_suite.lint_slo_coverage(root)
+    assert len(problems) == 1
+    assert "'vis_p99'" in problems[0] and "neither" in problems[0]
+
+
+def test_slo_coverage_flags_stale_doc_row(tmp_path):
+    """Reverse drift: a README table row promising an objective that
+    no longer exists."""
+    root = _slo_fixture(
+        tmp_path,
+        readme=_SLO_README.replace(
+            "| `probe_viol` | zero |\n",
+            "| `probe_viol` | zero |\n| `ghost_obj` | gone |\n"))
+    problems = static_suite.lint_slo_coverage(root)
+    assert len(problems) == 1
+    assert "'ghost_obj'" in problems[0]
+    assert "stale doc row" in problems[0]
+
+
+def test_slo_coverage_flags_missing_surfaces(tmp_path):
+    """A moved slo.py or a README without the objectives table is
+    itself a finding — a silently vacuous pass would defeat the
+    rule."""
+    root = _slo_fixture(tmp_path)
+    os.remove(os.path.join(root, "antidote_tpu", "obs", "slo.py"))
+    problems = static_suite.lint_slo_coverage(root)
+    assert len(problems) == 1 and "missing" in problems[0]
+    root2 = _slo_fixture(tmp_path / "b",
+                         readme="# monitoring\n`vis_p99` "
+                                "`probe_viol` prose only\n")
+    problems = static_suite.lint_slo_coverage(root2)
+    assert len(problems) == 1
+    assert "no \"SLO objectives\" table rows" in problems[0]
+
+
+def test_slo_coverage_flags_empty_objectives(tmp_path):
+    root = _slo_fixture(tmp_path,
+                        slo_src="DEFAULT_OBJECTIVES = ()\n")
+    problems = static_suite.lint_slo_coverage(root)
+    assert len(problems) == 1 and "vacuous" in problems[0]
+
+
+def test_slo_coverage_is_not_vacuous_on_the_repo():
+    """The repo's own DEFAULT_OBJECTIVES parses to the acceptance
+    floor (>= 6 objectives) — guard it so an slo.py refactor that
+    breaks the AST walk fails loudly instead of passing on zero."""
+    entries = static_suite._slo_objectives(static_suite.repo_root())
+    assert entries is not None and len(entries) >= 6

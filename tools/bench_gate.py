@@ -101,7 +101,12 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  # segmented checkpoints (ISSUE 13): persist cost per
                  # dirty key rising means checkpointing is scaling
                  # with keyspace again instead of churn
-                 "us/key"}
+                 "us/key",
+                 # fleet health plane (ISSUE 17): wall cost of one
+                 # full fleet scrape (merge + SLO evaluation) rising
+                 # means federation stopped being a background-cheap
+                 # read of already-maintained surfaces
+                 "us/scrape"}
 
 
 def repo_root() -> str:

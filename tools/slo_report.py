@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""slo_report — scrape a fleet and emit the SLO verdict (ISSUE 17).
+
+The machine-readable health check the chaos plane, the re-sharding
+acceptance runs and operators consume: merge every endpoint's
+``/metrics`` into one samples set (obs/fleet.py), judge it against
+obs/slo.py's DEFAULT_OBJECTIVES, and print the verdict.
+
+Usage:
+    python -m tools.slo_report --cluster http://h1:3001,http://h2:3001
+    python -m tools.slo_report                  # this process's registry
+    python -m tools.slo_report --cluster ... --json
+    python -m tools.slo_report --save-baseline base.json   # window start
+    python -m tools.slo_report --baseline base.json        # window delta
+
+Counters and histograms are cumulative since each process started, so
+an absolute verdict conflates ancient history with now.  For "over
+the last window" semantics, ``--save-baseline`` snapshots the merged
+samples at window start and a later ``--baseline`` run judges only
+the delta — the shape the chaos plane's before/after legs need.
+
+Exit codes: 0 = every objective within budget, 1 = at least one
+objective breached, 2 = bad input / no reachable source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from antidote_tpu.obs import fleet, slo
+
+
+def _load_baseline(path: str):
+    with open(path) as f:
+        body = json.load(f)
+    samples = body.get("samples", body)
+    return {name: [(dict(labels), float(value))
+                   for labels, value in rows]
+            for name, rows in samples.items()}
+
+
+def _save_baseline(path: str, samples) -> None:
+    body = {"samples": {name: [[labels, value]
+                               for labels, value in rows]
+                        for name, rows in samples.items()}}
+    with open(path, "w") as f:
+        json.dump(body, f)
+
+
+def _human(verdict: dict) -> str:
+    lines = [f"fleet SLO verdict: "
+             f"{'OK' if verdict['ok'] else 'BREACHED'} "
+             f"({len(verdict['objectives'])} objectives, "
+             f"{len(verdict['failing'])} failing)"]
+    for name, v in sorted(verdict["objectives"].items()):
+        mark = "ok " if v["ok"] else "FAIL"
+        extra = " no-data" if v.get("no_data") else ""
+        worst = v.get("worst")
+        who = ""
+        if worst and worst.get("labels"):
+            who = " worst=" + ",".join(
+                f"{k}={val}" for k, val in sorted(
+                    worst["labels"].items()))
+        lines.append(
+            f"  {mark} {name:<24} burn={v['burn_rate']:<12g} "
+            f"budget={v['budget_remaining']:.3f}{extra}{who}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="scrape a fleet and emit the SLO verdict JSON")
+    ap.add_argument("--cluster", default=None,
+                    help="comma-separated metrics-server roots "
+                         "(http://host:port); default: this "
+                         "process's own registry")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw verdict JSON")
+    ap.add_argument("--baseline", default=None,
+                    help="samples snapshot to delta cumulative "
+                         "families against (window start)")
+    ap.add_argument("--save-baseline", default=None,
+                    help="write the merged samples snapshot here "
+                         "(the next run's --baseline)")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-endpoint HTTP timeout, seconds")
+    args = ap.parse_args(argv)
+
+    if args.cluster:
+        urls = [u.strip() for u in args.cluster.split(",") if u.strip()]
+        snap = fleet.fleet_snapshot(urls, include_local=False,
+                                    timeout=args.timeout)
+        for url, err in sorted(snap["errors"].items()):
+            print(f"slo_report: scrape failed for {url}: {err}",
+                  file=sys.stderr)
+        if not snap["sources"]:
+            print("slo_report: no reachable source", file=sys.stderr)
+            return 2
+        samples = fleet.merged_metrics(snap)
+    else:
+        samples = fleet.local_samples()
+
+    if args.save_baseline:
+        _save_baseline(args.save_baseline, samples)
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = _load_baseline(args.baseline)
+        except (OSError, ValueError, TypeError) as e:
+            print(f"slo_report: bad baseline {args.baseline}: {e!r}",
+                  file=sys.stderr)
+            return 2
+
+    verdict = slo.evaluate(samples, baseline=baseline)
+    if args.json:
+        print(json.dumps(verdict, indent=1, sort_keys=True))
+    else:
+        print(_human(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,6 +34,13 @@ runs, over the ONE shared path list (``SUITE_PATHS``):
   family that is actually registered, and that family must appear in
   the dashboard docs — a kind the C++ plane records but Python never
   folds is telemetry written to /dev/null [native-telemetry]
+- **slo-coverage** (lives here, ISSUE 17) — every SLO objective in
+  obs/slo.py's DEFAULT_OBJECTIVES must bind a metric family
+  registered in stats.py and be documented in the monitoring docs,
+  and every row of the README's "SLO objectives" table must name an
+  objective that still exists — an SLO over an unregistered family
+  evaluates no-data-ok forever, and a stale doc row promises a
+  guarantee nobody evaluates [slo-coverage]
 
 tests/unit/test_static_suite.py runs :func:`run` repo-clean as the
 single tier-1 gate, so an analyzer added to ``PASSES`` is gated from
@@ -252,6 +259,127 @@ def lint_native_telemetry(root: str) -> List[str]:
     return problems
 
 
+#: the surfaces the slo-coverage pass joins (ISSUE 17)
+_SLO_PY = os.path.join("antidote_tpu", "obs", "slo.py")
+_MONITORING_README = os.path.join("monitoring", "README.md")
+
+#: first-column backticked name of a row in the README's
+#: "SLO objectives" table
+_SLO_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`")
+
+
+def _slo_objectives(root: str):
+    """(name, family, lineno) per Objective(...) entry in slo.py's
+    DEFAULT_OBJECTIVES, parsed from the AST (keywords first, then
+    positionals), or None when the module is missing."""
+    path = os.path.join(root, _SLO_PY)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out: List[Tuple[str, str, int]] = []
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        if "DEFAULT_OBJECTIVES" not in targets:
+            continue
+        value = node.value
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            continue
+        for call in value.elts:
+            if not (isinstance(call, ast.Call)
+                    and getattr(call.func, "id", None) == "Objective"):
+                continue
+            fields = {}
+            for pos, arg in zip(("name", "family"), call.args):
+                if isinstance(arg, ast.Constant):
+                    fields[pos] = arg.value
+            for kw in call.keywords:
+                if kw.arg in ("name", "family") \
+                        and isinstance(kw.value, ast.Constant):
+                    fields[kw.arg] = kw.value.value
+            if "name" in fields and "family" in fields:
+                out.append((str(fields["name"]), str(fields["family"]),
+                            call.lineno))
+    return out
+
+
+def lint_slo_coverage(root: str) -> List[str]:
+    """Join the SLO surfaces (ISSUE 17), both directions: every
+    objective in obs/slo.py's DEFAULT_OBJECTIVES must bind a metric
+    family actually registered in stats.py (an SLO over an
+    unregistered family silently evaluates no-data-ok forever) and
+    must be documented in the monitoring docs; and every row of the
+    README's "SLO objectives" table must name an objective that still
+    exists (a stale doc row promises a guarantee nobody evaluates)."""
+    objectives = _slo_objectives(root)
+    if objectives is None:
+        return [f"{_SLO_PY}: [slo-coverage] missing — the SLO "
+                "module moved?"]
+    if not objectives:
+        return [f"{_SLO_PY}: [slo-coverage] no Objective entries "
+                "parsed from DEFAULT_OBJECTIVES — the rule would be "
+                "vacuous"]
+    problems: List[str] = []
+    registered = set(_registered_families(root))
+    corpus = ""
+    for rel in _DASHBOARD_DOCS:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            with open(path) as f:
+                corpus += f.read()
+    names = set()
+    for name, family, lineno in objectives:
+        names.add(name)
+        if family not in registered:
+            problems.append(
+                f"{_SLO_PY}:{lineno}: [slo-coverage] objective "
+                f"{name!r} binds family {family!r} which is not "
+                "registered in antidote_tpu/stats.py — it would "
+                "evaluate no-data-ok forever")
+        if name not in corpus:
+            problems.append(
+                f"{_SLO_PY}:{lineno}: [slo-coverage] objective "
+                f"{name!r} appears in neither "
+                f"{' nor '.join(_DASHBOARD_DOCS)} — document the SLO "
+                "in the README's \"SLO objectives\" table")
+    # reverse direction: the README's objectives table must not name
+    # objectives that no longer exist
+    readme = os.path.join(root, _MONITORING_README)
+    documented = []
+    in_table = False
+    if os.path.exists(readme):
+        with open(readme) as f:
+            for i, line in enumerate(f, 1):
+                if re.match(r"^#+ .*SLO objectives", line):
+                    in_table = True
+                    continue
+                if in_table and line.startswith("#"):
+                    in_table = False
+                if not in_table:
+                    continue
+                m = _SLO_ROW_RE.match(line)
+                if m:
+                    documented.append((m.group(1), i))
+    if not documented:
+        problems.append(
+            f"{_MONITORING_README}: [slo-coverage] no \"SLO "
+            "objectives\" table rows found — the docs surface the "
+            "reverse direction checks is missing")
+    for doc_name, lineno in documented:
+        if doc_name not in names:
+            problems.append(
+                f"{_MONITORING_README}:{lineno}: [slo-coverage] "
+                f"documented objective {doc_name!r} does not exist in "
+                f"{_SLO_PY} DEFAULT_OBJECTIVES — stale doc row")
+    return problems
+
+
 #: (name, lint) — every pass the suite runs; the tier-1 gate iterates
 #: THIS list, so appending here is all a new analyzer needs for CI
 PASSES: Tuple[Tuple[str, Callable[[str], List[str]]], ...] = (
@@ -261,6 +389,7 @@ PASSES: Tuple[Tuple[str, Callable[[str], List[str]]], ...] = (
     ("durability_lint", durability_lint.lint),
     ("stats-dashboard", lint_stats_dashboard),
     ("native-telemetry", lint_native_telemetry),
+    ("slo-coverage", lint_slo_coverage),
 )
 
 

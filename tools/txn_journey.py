@@ -17,7 +17,16 @@ whose leg lagged is visible in the count column of later stages.
 Usage:
     python tools/txn_journey.py '<txid>' --file spans.json
     python tools/txn_journey.py '<txid>' --url http://host:3001
+    python tools/txn_journey.py '<txid>' --cluster http://h1:3001,http://h2:3001
     python tools/txn_journey.py --list --file spans.json   # known txids
+
+``--cluster`` (ISSUE 17) fetches ``/debug/spans`` from EVERY listed
+endpoint and merges the events by txid before reconstructing, so a
+cross-DC journey stitches its origin half (commit, ship) and remote
+half (rx, admit, visible) from live processes instead of hand-merged
+trace files.  Events identical across endpoints (endpoints sharing
+one span ring, e.g. in-process clusters) are deduplicated by
+(name, ts, dur, pid, tid) so shared rings don't double-count stages.
 
 The txid argument matches the JSON form of the span's txid (tuple
 txids export as arrays: ``[1785..., 'a1b2']`` — quote it; a substring
@@ -69,6 +78,34 @@ def load_events(path: Optional[str] = None,
         with open(path) as f:
             doc = json.load(f)
     return doc.get("traceEvents", [])
+
+
+def load_cluster_events(urls: List[str]) -> List[dict]:
+    """Merged event list from every endpoint's /debug/spans, with
+    exact duplicates collapsed: endpoints that share one span ring
+    (several servers in one process) return the same events, and a
+    duplicated stage would double every journey row's count."""
+    merged: List[dict] = []
+    seen = set()
+    errors: List[str] = []
+    for url in urls:
+        try:
+            events = load_events(url=url)
+        except (OSError, ValueError) as e:
+            errors.append(f"{url}: {e}")
+            continue
+        for e in events:
+            key = (e.get("name"), e.get("ts"), e.get("dur"),
+                   e.get("pid"), e.get("tid"))
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append(e)
+    if errors and not merged:
+        raise OSError("no endpoint reachable: " + "; ".join(errors))
+    for err in errors:
+        print(f"txn_journey: skipped endpoint {err}", file=sys.stderr)
+    return merged
 
 
 def known_txids(events: List[dict]) -> List[str]:
@@ -186,17 +223,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--url", default=None,
                     help="base URL of a live metrics server (fetches "
                          "/debug/spans)")
+    ap.add_argument("--cluster", default=None,
+                    help="comma-separated base URLs; merges every "
+                         "endpoint's /debug/spans by txid so a "
+                         "cross-DC journey stitches from live "
+                         "processes")
     ap.add_argument("--list", action="store_true",
                     help="list txids present in the trace and exit")
     ap.add_argument("--json", action="store_true",
                     help="emit the journey rows as JSON instead of the "
                          "table")
     args = ap.parse_args(argv)
-    if not args.file and not args.url:
-        print("txn_journey: pass --file or --url", file=sys.stderr)
+    if not args.file and not args.url and not args.cluster:
+        print("txn_journey: pass --file, --url or --cluster",
+              file=sys.stderr)
         return 2
     try:
-        events = load_events(path=args.file, url=args.url)
+        if args.cluster:
+            urls = [u.strip() for u in args.cluster.split(",")
+                    if u.strip()]
+            events = load_cluster_events(urls)
+        else:
+            events = load_events(path=args.file, url=args.url)
     except (OSError, ValueError) as e:
         print(f"txn_journey: cannot load trace: {e}", file=sys.stderr)
         return 2
