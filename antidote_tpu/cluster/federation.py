@@ -32,6 +32,7 @@ from antidote_tpu.api import AntidoteTPU
 from antidote_tpu.clocks import VC
 from antidote_tpu.interdc import query as idc_query
 from antidote_tpu.interdc.dep import DependencyGate, gate_from_config
+from antidote_tpu.interdc.interest import interest_from_config
 from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
 from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
@@ -100,6 +101,10 @@ class NodeInterDc:
         #: routing, locally-owned slices on the read serve plane
         self._api = AntidoteTPU(node=node)
         self.dc_id = node.dc_id
+        #: this DC's interest spec (ISSUE 18) — None = full stream.
+        #: Every member advertises the SAME spec (it is config-routed),
+        #: so a remote DC's per-member subscriptions slice identically.
+        self.interest = interest_from_config(node.config)
         self.member_index = sorted(srv.plane.members,
                                    key=repr).index(srv.node_id)
         self.local = set(node.local_partition_indices())
@@ -135,6 +140,12 @@ class NodeInterDc:
         self.remote: Dict[Any, FederatedDescriptor] = {}
         self._rx_lock = threading.Lock()
         self._inbox = bus.register(self._self_desc(), self._handle_query)
+        if self.interest is not None:
+            # advertised per member key — remote senders cut this
+            # node's slice; a transport without the hook would silently
+            # ship full streams, so a spec'd member demands it loudly
+            bus.set_local_interest((self.dc_id, self.member_index),
+                                   self.interest)
         self._worker = InboxWorker(self._inbox, self._deliver)
         self._hb = None
         # stable sources: gate watermarks + own min-prepared per slice.
@@ -203,13 +214,17 @@ class NodeInterDc:
                 g.seed_clock(pm.log.max_commit_vc)
                 self.gates[p] = g
                 for dc_id in self.remote:
+                    if self.interest is not None:
+                        g.note_subscription(dc_id,
+                                            len(self.interest.ranges))
                     self.sub_bufs[(dc_id, p)] = SubBuf(
                         dc_id, p,
                         deliver=self._make_gate_deliver(p),
                         deliver_batch=self._make_gate_deliver_batch(p),
                         fetch_range=self._fetch_range,
                         bootstrap=self._bootstrap_from_ckpt,
-                        last_opid=pm.log.op_counters.get(dc_id, 0))
+                        last_opid=pm.log.op_counters.get(dc_id, 0),
+                        filtered=self.interest is not None)
             for p in sorted(self.local - new_local):
                 gone = self.senders.pop(p, None)
                 if gone is not None:
@@ -257,6 +272,11 @@ class NodeInterDc:
         for i in range(desc.n_members):
             self.bus.connect(my_key, desc.member_desc(i))
         for p in sorted(self.local):
+            if self.interest is not None:
+                # the dep gate's stable-time qualifier (ISSUE 18):
+                # this origin's stream is a partial subscription
+                self.gates[p].note_subscription(
+                    desc.dc_id, len(self.interest.ranges))
             self.sub_bufs[(desc.dc_id, p)] = SubBuf(
                 desc.dc_id, p,
                 deliver=self._make_gate_deliver(p),
@@ -264,7 +284,8 @@ class NodeInterDc:
                 fetch_range=self._fetch_range,
                 bootstrap=self._bootstrap_from_ckpt,
                 last_opid=self.node.partitions[p].log.op_counters.get(
-                    desc.dc_id, 0))
+                    desc.dc_id, 0),
+                filtered=self.interest is not None)
         self.remote[desc.dc_id] = desc
         for s in self.senders.values():
             s.enabled = True
@@ -358,12 +379,14 @@ class NodeInterDc:
             return None
         target = (origin_dc, desc.ring[partition])
         my_key = (self.dc_id, self.member_index)
+        payload = ((partition, first, last) if self.interest is None
+                   else (partition, first, last, self.interest.ranges))
         try:
             # the transport returns decoded InterDcTxn objects (termcodec
             # on TCP, live objects in-process) — same contract as
             # idc_query.fetch_log_range
             return self.bus.request(my_key, target, idc_query.LOG_READ,
-                                    (partition, first, last))
+                                    payload)
         except LinkDown:
             return None
 
@@ -378,9 +401,11 @@ class NodeInterDc:
             return None
         target = (origin_dc, desc.ring[partition])
         my_key = (self.dc_id, self.member_index)
+        payload = ((partition,) if self.interest is None
+                   else (partition, self.interest.ranges))
         try:
             ans = self.bus.request(my_key, target, idc_query.CKPT_READ,
-                                   (partition,))
+                                   payload)
         except LinkDown:
             return None
         if ans is None:
@@ -393,17 +418,25 @@ class NodeInterDc:
 
     def _handle_query(self, from_dc, kind: str, payload) -> Any:
         if kind == idc_query.LOG_READ:
-            partition, first, last = payload
+            if len(payload) == 4:
+                # the ranged form (ISSUE 18): a filtered subscriber's
+                # backfill — the 3-tuple stays the pre-upgrade shape
+                partition, first, last, ranges = payload
+            else:
+                partition, first, last = payload
+                ranges = None
             if partition not in self.local:
                 owner = self.node.ring.get(partition)
                 if owner is not None and owner != self.srv.node_id:
                     # the slice moved (cross-node handoff) after the
                     # remote DC cached our descriptor: forward over the
                     # node fabric to the current owner and relay its
-                    # answer — repair keeps routing across re-plans
+                    # answer — repair keeps routing across re-plans,
+                    # and the ranged form forwards verbatim
                     bins = self.srv.link.request(
                         owner, "idc_log_read",
-                        (partition, first, last))
+                        (partition, first, last) if ranges is None
+                        else (partition, first, last, ranges))
                     if idc_query.is_below_floor(bins):
                         # the owner reclaimed the range: relay the
                         # explicit marker so the requester escalates
@@ -424,7 +457,8 @@ class NodeInterDc:
             pm = self.node.partitions[partition]
             return pm.scan_log(
                 lambda lg: idc_query.answer_log_read(
-                    lg, self.dc_id, partition, first, last))
+                    lg, self.dc_id, partition, first, last,
+                    ranges=ranges))
         if kind == idc_query.SNAPSHOT_READ:
             objects, clock = payload
             # the federated remote-read leg (ISSUE 8): any member can
@@ -436,7 +470,11 @@ class NodeInterDc:
             return idc_query.answer_snapshot_read(
                 self._api, objects, clock)
         if kind == idc_query.CKPT_READ:
-            (partition,) = payload
+            if len(payload) == 2:
+                partition, ranges = payload  # ranged form (ISSUE 18)
+            else:
+                (partition,) = payload
+                ranges = None
             if partition not in self.local:
                 raise ValueError(
                     f"partition {partition} not owned by member "
@@ -444,7 +482,8 @@ class NodeInterDc:
             tracer.instant("interdc_ckpt_read", "interdc",
                            origin=str(from_dc), partition=partition)
             return idc_query.answer_ckpt_read(
-                self.node.partitions[partition], self.dc_id, partition)
+                self.node.partitions[partition], self.dc_id, partition,
+                ranges=ranges)
         if kind == idc_query.CHECK_UP:
             return True
         raise ValueError(f"unknown inter-DC query kind {kind!r}")

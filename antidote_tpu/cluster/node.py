@@ -510,7 +510,10 @@ class NodeServer:
             if self.node is None:
                 return False
             if kind == "idc_log_read":
-                p, _first, last = payload
+                # the ranged 4-tuple (ISSUE 18) is publishable too: the
+                # ranges are part of the payload key and a fully-past
+                # filtered answer is just as immutable
+                p, _first, last = payload[:3]
                 pm = self.node.partitions[int(p)]
                 return (isinstance(pm, PartitionManager)
                         and pm.log.enabled
@@ -776,13 +779,15 @@ class NodeServer:
             # repeats served without the GIL).
             from antidote_tpu.interdc import query as idc_query
 
-            p, first, last = payload
+            p, first, last = payload[:3]
+            ranges = payload[3] if len(payload) == 4 else None
             pm = self.node.partitions[int(p)]
             if not isinstance(pm, PartitionManager):
                 raise RemoteCallError(f"partition {p} not local")
             ans = pm.scan_log(
                 lambda lg: idc_query.answer_log_read(
-                    lg, self.node.dc_id, int(p), first, last))
+                    lg, self.node.dc_id, int(p), first, last,
+                    ranges=ranges))
             if idc_query.is_below_floor(ans):
                 # the explicit reclaimed-range marker must survive the
                 # relay verbatim — a crash here would turn a loud

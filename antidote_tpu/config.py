@@ -335,4 +335,19 @@ class Config:
     #: 0 disables (default): scraping stays caller-elected per the
     #: mat/serve.py no-background-thread discipline.
     fleet_scrape_s: float = 0.0
+    #: interest-routed replication master switch (ISSUE 18,
+    #: antidote_tpu/interdc/interest.py): when True the sender cuts
+    #: per-interest-class slices of every staged frame and each
+    #: subscriber receives only txns whose write-set intersects its
+    #: announced key ranges.  False (default-off first ship) preserves
+    #: today's wire bytes and fan-out behavior bit-for-bit; under True
+    #: a spec-less subscriber still gets the full stream untouched, so
+    #: pre-upgrade peers interoperate (docs/interest_routing.md).
+    interest_routing: bool = False
+    #: this DC's subscription: a set of half-open [lo, hi) string key
+    #: ranges, e.g. ``(("a", "m"),)``.  None = subscribe to the full
+    #: stream even when routing is on.  Validated loudly at DC start
+    #: (interest.InterestError on malformed/empty/overlapping ranges —
+    #: never a silent full or empty stream).
+    interest_ranges: tuple | None = None
     extra: dict = field(default_factory=dict)

@@ -29,6 +29,8 @@ from antidote_tpu.clocks import VC
 from antidote_tpu.config import Config
 from antidote_tpu.interdc import query as idc_query
 from antidote_tpu.interdc.dep import gate_from_config
+from antidote_tpu.interdc.interest import (InterestSpec,
+                                           interest_from_config)
 from antidote_tpu.interdc.sender import InterDcLogSender
 from antidote_tpu.interdc.sub_buf import SubBuf
 from antidote_tpu.interdc.transport import InboxWorker, LinkDown, Transport
@@ -39,6 +41,7 @@ from antidote_tpu.interdc.wire import (
     frame_from_bin,
 )
 from antidote_tpu.meta.device_stable import make_stable_tracker
+from antidote_tpu.oplog.partition import BelowRetentionFloor
 from antidote_tpu.meta.stable_store import StableMetaData
 from antidote_tpu.obs import pipeline as obs_pipeline
 from antidote_tpu.obs import probe as obs_probe
@@ -72,8 +75,20 @@ class DataCenter(AntidoteTPU):
         self._build_interdc_plumbing()
         node.wait_hook = self._wait_hook
 
+        #: this DC's interest spec (ISSUE 18, docs/interest_routing.md):
+        #: None = full stream.  Built through the one-factory knob hop
+        #: (loud InterestError at boot on a malformed interest_ranges)
+        #: and announced to the transport BEFORE any peer link forms,
+        #: so the restart re-join below subscribes already filtered.
+        self.interest = interest_from_config(cfg)
+
         self._rx_lock = threading.Lock()
         self._inbox = bus.register(self.descriptor(), self._handle_query)
+        if self.interest is not None:
+            # transports that cannot route interest (external stubs
+            # without the hook) simply deliver the full stream — a safe
+            # superset; only a declared spec needs the announcement
+            bus.set_local_interest(self.node.dc_id, self.interest)
         self._worker = InboxWorker(self._inbox, self._deliver)
         self._hb_worker: Optional[_Ticker] = None
         self._bc_worker: Optional[_Ticker] = None
@@ -281,10 +296,134 @@ class DataCenter(AntidoteTPU):
                 # crash recovery: resume the stream where the local log
                 # left off (reference src/inter_dc_sub_buf.erl:58-76)
                 last_opid=self.node.partitions[p].log.op_counters.get(
-                    desc.dc_id, 0))
+                    desc.dc_id, 0),
+                filtered=self.interest is not None)
+        if self.interest is not None:
+            # partial-subscription qualifier (ISSUE 18): surfaced in
+            # queue_stats so operators can tell a lagging origin from a
+            # partially-subscribed one; the gate's advancement rule is
+            # untouched — heartbeat pings are interest-independent
+            for g in self.dep_gates:
+                g.note_subscription(desc.dc_id,
+                                    len(self.interest.ranges))
         self.connected_dcs.append(desc.dc_id)
         for s in self.senders:
             s.enabled = True
+
+    def set_interest(self, ranges) -> None:
+        """Re-declare this DC's subscription at runtime (ISSUE 18,
+        docs/interest_routing.md §3).  Widening backfills lazily in two
+        halves: the sender starts a new interest-class chain at its
+        current stream base, so the SubBuf sees the first new-class
+        frame as an ordinary gap and the ranged LOG_READ / CKPT_READ
+        repair ships the widened history ABOVE the old class watermark;
+        the history BELOW it (txns of the new ranges elided while we
+        were not subscribed, now under the SubBuf's duplicate floor) is
+        fetched explicitly by :meth:`_backfill_widened`.  Validation is
+        loud — malformed ranges raise InterestError, and calling this
+        with routing off is a config error, not a silent no-op."""
+        if not self.node.config.interest_routing:
+            raise ValueError(
+                "set_interest requires Config.interest_routing=True")
+        spec = None if ranges is None else InterestSpec(ranges)
+        with self._rx_lock:
+            old = self.interest
+            self.interest = spec
+            self.bus.set_local_interest(self.node.dc_id, spec)
+            for buf in self.sub_bufs.values():
+                buf.filtered = spec is not None
+            for g in self.dep_gates:
+                for origin in self.connected_dcs:
+                    g.note_subscription(
+                        origin, None if spec is None
+                        else len(spec.ranges))
+        # outside _rx_lock: the backfill blocks on fetches and device
+        # quiesce, and its range sits at or BELOW the captured SubBuf
+        # watermarks — the live stream drops those opids as duplicates,
+        # so no delivery can race an apply into the backfilled span
+        if old is not None and spec != old:
+            self._backfill_widened(old)
+
+    def _backfill_widened(self, old: InterestSpec) -> None:
+        """Fetch the newly-subscribed ranges' history that sits BELOW
+        the stream watermarks (docs/interest_routing.md §3): those
+        txns were elided under the old spec, so the SubBuf's duplicate
+        floor would drop a re-delivery — they are fetched with the NEW
+        ranges over [1, watermark], the ones the OLD spec already
+        delivered are dropped (txn-granular match: exact regardless of
+        how the range sets overlap), and the remainder goes straight
+        to the dependency gate, which admits it like any repaired
+        arrival.  The old-spec filter alone is NOT exact: full-frame
+        fallbacks (spec races, identity slices) deliver supersets, so
+        a fetched txn may already be applied even though the old spec
+        did not match it — the local log's per-origin commit index
+        settles it exactly (opids at or below the local retention
+        floor were applied by definition: they are in our own
+        checkpoint).  BELOW_FLOOR at the ORIGIN escalates to the
+        ranged checkpoint: seed states merge in as VC-gated bases
+        (CRDT join — idempotent against anything already applied) and
+        the retained suffix (cut, watermark] tops up via LOG_READ.
+        Neither the SubBuf watermark nor the gate clock moves — both
+        describe the live stream, which this pre-history fill never
+        touches.  An unreachable origin is logged and skipped; its
+        below-watermark history stays out until the spec is
+        re-declared."""
+        new_ranges = None if self.interest is None else \
+            self.interest.ranges
+        for (origin, p), buf in sorted(self.sub_bufs.items(),
+                                       key=lambda kv: repr(kv[0])):
+            wm = buf.last_opid
+            if wm <= 0:
+                continue  # no history behind the watermark
+            stats.registry.interest_backfills.inc()
+            ans = idc_query.fetch_log_range(
+                self.bus, self.node.dc_id, origin, p, 1, wm,
+                ranges=new_ranges)
+            if ans is not None and idc_query.is_below_floor(ans):
+                ckpt = idc_query.fetch_ckpt_bootstrap(
+                    self.bus, self.node.dc_id, origin, p,
+                    ranges=new_ranges)
+                if ckpt is None:
+                    logging.getLogger(__name__).warning(
+                        "widen backfill of (%r, %d): origin below "
+                        "retention floor and not checkpointing — "
+                        "pre-watermark history of the new ranges is "
+                        "unavailable", origin, p)
+                    continue
+                # seeds only — origin_dc/op_counter stay untouched:
+                # the cut's commit watermark is the FULL stream's, and
+                # moving the per-origin counter to it would skip the
+                # old spec's retained suffix on a restart
+                self.node.partitions[p].bootstrap_seed(
+                    (key, tn, state, VC(vc))
+                    for key, (tn, state, vc) in ckpt["keys"].items())
+                cut = int(ckpt["commit_opid"])
+                ans = (idc_query.fetch_log_range(
+                    self.bus, self.node.dc_id, origin, p, cut + 1, wm,
+                    ranges=new_ranges) if cut < wm else [])
+            if ans is None or idc_query.is_below_floor(ans):
+                logging.getLogger(__name__).warning(
+                    "widen backfill of (%r, %d) failed (origin "
+                    "unreachable or still below floor) — retry by "
+                    "re-declaring the spec", origin, p)
+                continue
+            pm = self.node.partitions[p]
+            floor = 0
+            try:
+                applied = pm.scan_log(lambda lg: {
+                    done[-1].op_id.n for _prev, done in
+                    lg.committed_txns_in_range(origin, 1, wm)})
+            except BelowRetentionFloor as e:
+                floor = int(e.floor)
+                applied = pm.scan_log(lambda lg: {
+                    done[-1].op_id.n for _prev, done in
+                    lg.committed_txns_in_range(origin, floor + 1, wm)})
+            fresh = [t for t in sorted(ans, key=lambda t: t.last_opid())
+                     if not old.matches_txn(t)
+                     and t.last_opid() > floor
+                     and t.last_opid() not in applied]
+            if fresh:
+                self.dep_gates[p].enqueue_batch(fresh)
 
     def observe_dcs_sync(self, descs: List[DcDescriptor],
                          timeout: float = 30.0) -> None:
@@ -471,8 +610,9 @@ class DataCenter(AntidoteTPU):
 
     def _fetch_range(self, origin_dc, partition: int, first: int,
                      last: int) -> Optional[List[InterDcTxn]]:
-        return idc_query.fetch_log_range(self.bus, self.node.dc_id,
-                                         origin_dc, partition, first, last)
+        return idc_query.fetch_log_range(
+            self.bus, self.node.dc_id, origin_dc, partition, first, last,
+            ranges=None if self.interest is None else self.interest.ranges)
 
     def _bootstrap_from_ckpt(self, origin_dc, partition: int
                              ) -> Optional[int]:
@@ -484,7 +624,8 @@ class DataCenter(AntidoteTPU):
         the origin's commit watermark at the cut for the SubBuf to
         jump to.  None = unreachable / origin does not checkpoint."""
         ans = idc_query.fetch_ckpt_bootstrap(
-            self.bus, self.node.dc_id, origin_dc, partition)
+            self.bus, self.node.dc_id, origin_dc, partition,
+            ranges=None if self.interest is None else self.interest.ranges)
         if ans is None:
             return None
         return idc_query.install_ckpt_bootstrap(
@@ -495,12 +636,21 @@ class DataCenter(AntidoteTPU):
 
     def _handle_query(self, from_dc, kind: str, payload) -> Any:
         if kind == idc_query.LOG_READ:
-            partition, first, last = payload
+            # 3-arity = the pre-ISSUE-18 full answer; 4-arity carries
+            # the requester's interest ranges (validated loudly in
+            # answer_log_read — a hostile range set errors the request,
+            # never silently changes the answer)
+            if len(payload) == 4:
+                partition, first, last, ranges = payload
+            else:
+                partition, first, last = payload
+                ranges = None
             pm = self.node.partitions[partition]
             # runs on the requester's thread
             return pm.scan_log(
                 lambda log: idc_query.answer_log_read(
-                    log, self.node.dc_id, partition, first, last))
+                    log, self.node.dc_id, partition, first, last,
+                    ranges=ranges))
         if kind == idc_query.SNAPSHOT_READ:
             objects, clock = payload
             # served through the read serve plane (ISSUE 8): the
@@ -509,14 +659,20 @@ class DataCenter(AntidoteTPU):
                            origin=str(from_dc), keys=len(objects))
             return idc_query.answer_snapshot_read(self, objects, clock)
         if kind == idc_query.CKPT_READ:
-            (partition,) = payload
+            # 1-arity = the pre-ISSUE-18 full checkpoint; 2-arity
+            # carries the requester's interest ranges
+            if len(payload) == 2:
+                partition, ranges = payload
+            else:
+                (partition,) = payload
+                ranges = None
             # a remote SubBuf fell below our retention floor: cut a
             # fresh checkpoint and hand over the seed states (ISSUE 10)
             tracer.instant("interdc_ckpt_read", "interdc",
                            origin=str(from_dc), partition=partition)
             return idc_query.answer_ckpt_read(
                 self.node.partitions[partition], self.node.dc_id,
-                partition)
+                partition, ranges=ranges)
         if kind == idc_query.CHECK_UP:
             return True
         if kind == idc_query.BCOUNTER_REQUEST:

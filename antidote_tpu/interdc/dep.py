@@ -163,6 +163,14 @@ class DependencyGate:
         self.coalesce_us = coalesce_us
         #: dead-slot fraction past which the ring compacts (shrinks)
         self.compact_frac = compact_frac
+        #: origins this DC is PARTIALLY subscribed to (ISSUE 18,
+        #: docs/interest_routing.md §4): origin -> announced range
+        #: count.  A qualifier, not a gate rule — ``applied_vc[origin]``
+        #: for these origins means "applied within the subscribed
+        #: ranges"; advancement itself is untouched because heartbeat
+        #: pings are interest-independent and their min_prepared bounds
+        #: subscribed and elided txns alike.
+        self.subscribed_ranges: Dict[Any, int] = {}
         self._ring: Optional[_DeviceRing] = None
         self._cost_host: float | None = None
         self._cost_batched: float | None = None
@@ -180,6 +188,17 @@ class DependencyGate:
 
     def seed_clock(self, vc: VC) -> None:
         self.applied_vc = self.applied_vc.join(vc)
+
+    def note_subscription(self, origin, n_ranges: Optional[int]) -> None:
+        """Record that ``origin``'s stream is interest-filtered to
+        ``n_ranges`` key ranges (None = full subscription again) — the
+        partial-subscription qualifier queue_stats surfaces so an
+        operator can tell a lagging origin from a partially-subscribed
+        one (ISSUE 18)."""
+        if n_ranges is None:
+            self.subscribed_ranges.pop(origin, None)
+        else:
+            self.subscribed_ranges[origin] = int(n_ranges)
 
     # ------------------------------------------------------------- ingest
 
@@ -578,6 +597,11 @@ class DependencyGate:
                        if q},
             "applied_vc": {str(k): v
                            for k, v in dict(self.applied_vc).items()},
+            # partially-subscribed origins (ISSUE 18): their applied
+            # watermark means "within the subscribed ranges" — rendered
+            # so a lag investigation doesn't mistake filtering for it
+            "partial_origins": {str(o): n for o, n
+                                in self.subscribed_ranges.items()},
             "ring": ring,
         }
 

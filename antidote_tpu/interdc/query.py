@@ -30,6 +30,7 @@ import logging
 from typing import List, Optional, Tuple
 
 from antidote_tpu.clocks import VC
+from antidote_tpu.interdc.interest import InterestSpec
 from antidote_tpu.interdc.transport import LinkDown, Transport
 from antidote_tpu.interdc.wire import InterDcTxn
 from antidote_tpu.obs.spans import tracer
@@ -62,18 +63,25 @@ def is_below_floor(ans) -> bool:
 
 
 def fetch_log_range(transport: Transport, own_dc, origin_dc, partition: int,
-                    first: int, last: int) -> Optional[List[InterDcTxn]]:
+                    first: int, last: int,
+                    ranges: Optional[tuple] = None
+                    ) -> Optional[List[InterDcTxn]]:
     """Ask ``origin_dc`` for its committed txns with commit opid in
-    [first, last]; None when the origin is unreachable."""
+    [first, last]; None when the origin is unreachable.  ``ranges``
+    (ISSUE 18) restricts the answer to txns whose write-set intersects
+    the interest ranges — the widen-backfill path; the 3-tuple payload
+    stays the pre-upgrade full-answer form."""
+    payload = ((partition, first, last) if ranges is None
+               else (partition, first, last, tuple(ranges)))
     try:
-        return transport.request(own_dc, origin_dc, LOG_READ,
-                                 (partition, first, last))
+        return transport.request(own_dc, origin_dc, LOG_READ, payload)
     except LinkDown:
         return None
 
 
 def answer_log_read(partition_log, dc_id, partition: int, first: int,
-                    last: int) -> List[InterDcTxn]:
+                    last: int,
+                    ranges: Optional[tuple] = None) -> List[InterDcTxn]:
     """Server side: emit this DC's committed transactions whose commit
     opid is in range, through the partition log's per-origin op-id
     offset index (ISSUE 9) — O(requested range) file reads instead of
@@ -90,13 +98,25 @@ def answer_log_read(partition_log, dc_id, partition: int, first: int,
     advance its watermark past history it never received, so the
     impossibility is explicit and the requester bootstraps from the
     checkpoint instead.
+
+    ``ranges`` (ISSUE 18, validated loudly — InterestError on hostile
+    input) filters the answer to txns whose write-set intersects the
+    requester's interest, keeping the ORIGINAL prev chains: the
+    requester's SubBuf delivers repair answers by opid and advances
+    authoritatively over the whole requested range, so the elided
+    opids are covered without being shipped (docs/interest_routing.md
+    §3).
     """
+    spec = None if ranges is None else InterestSpec(ranges)
     try:
-        return [InterDcTxn.from_ops(dc_id, partition, prev, done)
+        txns = [InterDcTxn.from_ops(dc_id, partition, prev, done)
                 for prev, done in partition_log.committed_txns_in_range(
                     dc_id, first, last)]
     except BelowRetentionFloor as e:
         return below_floor_answer(e.floor)
+    if spec is not None:
+        txns = [t for t in txns if spec.matches_txn(t)]
+    return txns
 
 
 def fetch_snapshot_read(transport: Transport, own_dc, origin_dc,
@@ -118,14 +138,20 @@ def fetch_snapshot_read(transport: Transport, own_dc, origin_dc,
 
 
 def fetch_ckpt_bootstrap(transport: Transport, own_dc, origin_dc,
-                         partition: int) -> Optional[dict]:
+                         partition: int,
+                         ranges: Optional[tuple] = None
+                         ) -> Optional[dict]:
     """Ask ``origin_dc`` for its partition checkpoint (the BELOW_FLOOR
     escalation): {keys: {key: (type, state, vc dict)}, clock: vc dict,
     commit_opid, op_counter} or None when the origin is unreachable or
-    does not checkpoint (the requester keeps buffering and retries)."""
+    does not checkpoint (the requester keeps buffering and retries).
+    ``ranges`` (ISSUE 18) asks for only the seed keys intersecting the
+    requester's interest; the 1-tuple payload stays the pre-upgrade
+    full-checkpoint form."""
+    payload = (partition,) if ranges is None else (partition,
+                                                   tuple(ranges))
     try:
-        return transport.request(own_dc, origin_dc, CKPT_READ,
-                                 (partition,))
+        return transport.request(own_dc, origin_dc, CKPT_READ, payload)
     except LinkDown:
         return None
 
@@ -172,13 +198,23 @@ def install_ckpt_bootstrap(pm, gate, origin_dc, partition: int,
     return ans["commit_opid"]
 
 
-def answer_ckpt_read(pm, own_dc, partition: int) -> Optional[dict]:
+def answer_ckpt_read(pm, own_dc, partition: int,
+                     ranges: Optional[tuple] = None) -> Optional[dict]:
     """Server side of CKPT_READ: cut a fresh checkpoint on the owning
     PartitionManager and answer with its seeds + watermarks (None when
-    checkpointing is disabled)."""
+    checkpointing is disabled).  ``ranges`` (ISSUE 18, validated
+    loudly) keeps only the seed keys inside the requester's interest —
+    non-str keys are unclassifiable and always ship; the watermarks
+    stay the FULL checkpoint's (the requester's jump covers the elided
+    keys' history the same way a filtered repair answer does)."""
     ans = pm.ckpt_bootstrap_answer(own_dc)
     if ans is None:
         return None
+    if ranges is not None:
+        spec = InterestSpec(ranges)
+        ans = dict(ans)
+        ans["keys"] = {k: v for k, v in ans["keys"].items()
+                       if spec.matches_key(k)}
     # clocks cross administrative domains as plain dicts, like
     # SNAPSHOT_READ's (the termcodec VC form is for wire frames)
     return ans

@@ -38,6 +38,7 @@ from typing import List, Optional
 
 from antidote_tpu import stats
 from antidote_tpu.config import Config as _Config
+from antidote_tpu.interdc import interest as idc_interest
 from antidote_tpu.interdc import termcodec
 from antidote_tpu.interdc.transport import Transport
 from antidote_tpu.interdc.wire import InterDcBatch, InterDcTxn
@@ -138,6 +139,21 @@ class InterDcLogSender:
                            else config.interdc_ship_bytes)
         self.ship_txns = max(1, _KNOB["ship_txns"] if config is None
                              else config.interdc_ship_txns)
+        #: interest routing (ISSUE 18): when on AND the transport can
+        #: route slices, _drain_outbox cuts one slice per live interest
+        #: class before publishing.  Off (the default) the publish path
+        #: is bit-for-bit the pre-ISSUE-18 one — no classes queried, no
+        #: slices cut, the plain publish signature used.
+        self.interest_routing = (
+            _Config.__dataclass_fields__["interest_routing"].default
+            if config is None else config.interest_routing)
+        #: per-interest-class watermark chains (docs/interest_routing.md
+        #: §2): class_key -> opid of the last txn EMITTED to that class.
+        #: Initialized at the first frame a class is seen (that frame's
+        #: base) and advanced only on emission — both rules keep every
+        #: class's stream gapless without ever advancing past a skipped
+        #: txn.  Mutated only in _cut_slices, under ``_pub_lock``.
+        self._class_wm: dict = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         #: per-stream ordered outbox: (kind, txid, frame, ntxns,
@@ -326,10 +342,13 @@ class InterDcLogSender:
                     entry = ("batch", batch, batch.to_bin(), len(chunk),
                              ping is not None)
                 elif ping is not None:
-                    # drained-under-our-feet race: the stamp still flows
+                    # drained-under-our-feet race: the stamp still
+                    # flows.  The OBJECT rides the outbox (deferred
+                    # encode, like the ping() path) so interest slicing
+                    # can re-anchor it per class watermark.
                     txn = InterDcTxn.ping(self.dc_id, self.partition,
                                           ping_prev, ping)
-                    entry = ("ping", None, txn.to_bin(), 0, False)
+                    entry = ("ping", None, txn, 0, False)
             except Exception:  # noqa: BLE001 — the worker must survive
                 logging.getLogger(__name__).exception(
                     "ship frame encode failed (%d txns dropped to gap "
@@ -361,11 +380,30 @@ class InterDcLogSender:
                     if not self._outbox:
                         return
                     kind, meta, frame, ntxns, piggy = self._outbox.popleft()
+                # the frame OBJECT (batch rides in meta even when the
+                # ship worker pre-encoded; txn/ping entries defer) —
+                # interest slicing needs it to cut class subsequences
+                obj = meta if kind == "batch" else (
+                    frame if not isinstance(frame, bytes) else None)
                 if not isinstance(frame, bytes):
                     # deferred encode: entries staged under the
                     # watermark lock carry the object; the bytes are
                     # produced here, still ordered by _pub_lock
                     frame = frame.to_bin()
+                # interest routing (ISSUE 18): cut one slice per live
+                # interest class, under _pub_lock like the deferred
+                # encode (pure compute — never under the transport
+                # lock).  Routing off, or a transport that can't route
+                # (accepts_interest unset), or no spec'd subscriber:
+                # the publish below is bit-for-bit pre-ISSUE-18.
+                slice_kw = {}
+                if (self.interest_routing and obj is not None
+                        and getattr(self.transport, "accepts_interest",
+                                    False)):
+                    classes = self.transport.interest_classes()
+                    if classes:
+                        slice_kw = {"slices": self._cut_slices(
+                            kind, obj, len(frame), classes)}
                 if kind == "batch":
                     # a telemetry-capable transport (accepts_txids,
                     # ISSUE 16) takes the frame's SAMPLED txids along
@@ -384,6 +422,7 @@ class InterDcLogSender:
                     # the kwarg only exists when the transport opted
                     # in above — plain buses keep publish(origin, data)
                     kw = {"txids": txids} if txids else {}
+                    kw.update(slice_kw)
                     with tracer.span("interdc_send_batch", "interdc",
                                      partition=self.partition,
                                      dc=str(self.dc_id), txns=ntxns):
@@ -407,7 +446,8 @@ class InterDcLogSender:
                                      dc=str(self.dc_id)):
                         # lock-ok: publish-ordering lock (see above) —
                         # the legacy per-txn frame path
-                        self.transport.publish(self.dc_id, frame)
+                        self.transport.publish(self.dc_id, frame,
+                                               **slice_kw)
                     recorder.record("interdc", "send", txid=meta,
                                     partition=self.partition)
                 else:  # ping
@@ -416,8 +456,66 @@ class InterDcLogSender:
                                      dc=str(self.dc_id)):
                         # lock-ok: publish-ordering lock (see above) —
                         # standalone heartbeat frames
-                        self.transport.publish(self.dc_id, frame)
+                        self.transport.publish(self.dc_id, frame,
+                                               **slice_kw)
                 _note_frame(kind, len(frame), ntxns, piggy)
+
+    def _cut_slices(self, kind: str, obj, full_len: int,
+                    classes: dict) -> dict:
+        """One encoded slice per interest class for the frame about to
+        publish: {class_key: bytes | None}, None = the frame carries
+        nothing for that class.  A class whose slice would be identical
+        to the full frame (every txn matched, chain already aligned) is
+        simply ABSENT — the transport's absent-class fallback ships the
+        one full staging buffer, so all-match traffic costs zero extra
+        copies.  Runs under ``_pub_lock`` (pure compute + encode, like
+        the deferred to_bin above — never under the transport lock)."""
+        reg = stats.registry
+        slices: dict = {}
+        built = elided_total = saved = 0
+        for ck, spec in classes.items():
+            wm = self._class_wm.get(ck)
+            if wm is None:
+                # first frame this class is seen: its chain starts at
+                # this frame's base — earlier history is the receiver's
+                # ranged gap-repair's job, not the pub stream's
+                wm = (obj.first_prev_opid() if kind == "batch"
+                      else obj.prev_log_opid)
+            if kind == "batch":
+                sliced, new_wm, elided = idc_interest.slice_batch(
+                    obj, spec, wm)
+            elif kind == "txn":
+                sliced, new_wm, elided = idc_interest.slice_txn(
+                    obj, spec, wm)
+            else:
+                sliced, new_wm, elided = idc_interest.slice_ping(
+                    obj, spec, wm)
+            self._class_wm[ck] = new_wm
+            elided_total += elided
+            if sliced is None:
+                slices[ck] = None
+                saved += full_len
+                continue
+            base = (obj.first_prev_opid() if kind == "batch"
+                    else obj.prev_log_opid)
+            if elided == 0 and wm == base:
+                continue  # identical to the full frame: share it
+            data = sliced.to_bin()
+            slices[ck] = data
+            built += 1
+            saved += max(full_len - len(data), 0)
+        reg.interest_frames.inc()
+        if built:
+            reg.interest_slice_buffers.inc(built)
+        frames = reg.interest_frames.value()
+        if frames:
+            reg.interest_slices_per_frame.set(
+                reg.interest_slice_buffers.value() / frames)
+        if elided_total:
+            reg.interest_filtered_txns.inc(elided_total)
+        if saved:
+            reg.interest_filtered_bytes.inc(saved)
+        return slices
 
     # ----------------------------------------------------------- plumbing
 

@@ -68,9 +68,18 @@ class SubBuf:
                  deliver_batch: Optional[
                      Callable[[List[InterDcTxn]], None]] = None,
                  bootstrap: Optional[Callable[[Any, int],
-                                              Optional[int]]] = None):
+                                              Optional[int]]] = None,
+                 filtered: bool = False):
         self.origin_dc = origin_dc
         self.partition = partition
+        #: the local DC subscribed with an interest spec (ISSUE 18):
+        #: the stream is an interest-class subsequence and repair
+        #: fetches carry the ranges (via the DC's fetch_range /
+        #: bootstrap closures) — counted as backfills.  No delivery-
+        #: logic change: a filtered repair answer is covered by the
+        #: authoritative-advance rule below exactly like an aborted-txn
+        #: hole (docs/interest_routing.md §3).
+        self.filtered = filtered
         #: hand one txn to the dependency gate
         self._deliver = deliver
         #: hand a whole in-order arrival batch to the dependency gate
@@ -94,7 +103,7 @@ class SubBuf:
         """This stream's gap/repair state for the pipeline snapshot
         (obs/pipeline.py)."""
         return {"state": self.state, "buffered_txns": len(self._queue),
-                "last_opid": self.last_opid}
+                "last_opid": self.last_opid, "filtered": self.filtered}
 
     def process(self, txn: InterDcTxn) -> None:
         if self.state == "buffering":
@@ -186,6 +195,10 @@ class SubBuf:
                 # else: duplicate, drop
                 continue
             t0 = time.perf_counter()
+            if self.filtered:
+                # interest-routed stream: this fetch carries the local
+                # ranges — the widen-backfill path rides it (ISSUE 18)
+                stats.registry.interest_backfills.inc()
             with tracer.span("subbuf_gap_repair", "interdc",
                              origin=str(self.origin_dc),
                              partition=self.partition,

@@ -54,6 +54,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from antidote_tpu import stats
 from antidote_tpu.obs import nativeobs
 from antidote_tpu.interdc import termcodec
+from antidote_tpu.interdc.interest import (InterestError, hello_term,
+                                           parse_hello)
 from antidote_tpu.interdc.transport import LinkDown, Transport
 from antidote_tpu.interdc.wire import DcDescriptor
 
@@ -112,10 +114,14 @@ class _SubSender:
     QUEUE_DEPTH = 128
 
     def __init__(self, conn: socket.socket, label: str, on_dead,
-                 framed: bool = False):
+                 framed: bool = False, interest_spec=None):
         self.conn = conn
         self.label = label
         self.framed = framed
+        #: InterestSpec this peer announced in its hello, or None =
+        #: full stream (ISSUE 18); publish picks this peer's slice by
+        #: ``interest_spec.class_key()``
+        self.interest_spec = interest_spec
         self._on_dead = on_dead
         self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(
             maxsize=self.QUEUE_DEPTH)
@@ -293,6 +299,12 @@ class TcpTransport(Transport):
         #: cannot stall the stream (ISSUE 8 satellite; the per-peer
         #: send-duration gauge from ISSUE 7 stays per-send accurate)
         self._subscribers: List[_SubSender] = []
+        #: this endpoint's own interest spec (ISSUE 18) — announced in
+        #: the subscribe-side hello; None = full stream.  Read fresh at
+        #: every (re)dial, so a widened spec takes effect on reconnect
+        #: (docs/interest_routing.md §3 — the in-proc bus re-announces
+        #: immediately; TCP converges at the next resubscribe)
+        self._local_interest = None
         #: target dc_id -> (addr, persistent request socket or None)
         self._peers: Dict[Any, Dict[str, Any]] = {}
         self._lock = threading.RLock()
@@ -427,16 +439,30 @@ class TcpTransport(Transport):
                 conn, _addr = self._pub_srv.accept()
             except OSError:
                 return
-            # hello frame names the subscriber (diagnostics only)
+            # hello frame names the subscriber; an ISSUE-18 tagged
+            # hello additionally carries its interest spec.  A
+            # malformed spec closes the connection LOUDLY — the peer
+            # must never end up on a silent full or empty stream it
+            # didn't subscribe to
             try:
                 conn.settimeout(self.connect_timeout)
                 hello = _recv_frame(conn)
-                peer = termcodec.decode(hello) if hello else None
+                term = termcodec.decode(hello) if hello else None
+                peer, spec = parse_hello(term)
                 conn.settimeout(None)
+            except InterestError as e:
+                log.error("pub: rejecting subscriber with malformed "
+                          "interest spec: %s", e)
+                conn.close()
+                continue
             except (OSError, ValueError):
                 conn.close()
                 continue
-            log.debug("pub: subscriber %r connected", peer)
+            log.debug("pub: subscriber %r connected (interest=%s)",
+                      peer, spec.ranges if spec else "full")
+            if spec is not None:
+                stats.registry.interest_peer_ranges.set(
+                    len(spec.ranges), peer=str(peer))
             # bounded sends: each subscriber gets its own worker +
             # queue (_SubSender), so a hung peer or full TCP window
             # stalls only its own stream; the send timeout below
@@ -448,12 +474,15 @@ class TcpTransport(Transport):
             with self._lock:
                 self._subscribers.append(_SubSender(
                     conn, str(peer), self._drop_subscriber,
-                    framed=self._staged))
+                    framed=self._staged, interest_spec=spec))
 
     def _drop_subscriber(self, sender: "_SubSender") -> None:
         with self._lock:
             if sender in self._subscribers:
                 self._subscribers.remove(sender)
+        if sender.interest_spec is not None:
+            stats.registry.interest_peer_ranges.remove(
+                peer=sender.label)
 
     #: seq -> txids attribution entries kept live; frames the drain
     #: never joins (unsampled cadence gaps) age out by eviction
@@ -465,7 +494,28 @@ class TcpTransport(Transport):
     #: everything else (test stubs, InProcBus, external buses)
     accepts_txids = True
 
-    def publish(self, origin, data: bytes, txids: Tuple = ()) -> None:
+    #: interest-routing capability (ISSUE 18): the log sender only cuts
+    #: per-class slices (and passes ``slices=``) for transports that
+    #: declare this
+    accepts_interest = True
+
+    def set_local_interest(self, dc_id, spec) -> None:
+        with self._lock:
+            self._local_interest = spec
+
+    def interest_classes(self) -> Dict:
+        """Distinct interest specs across live Python-mode subscribers.
+        The native hub does not slice (docs/interest_routing.md non-
+        goal) and hub mode has no Python subscriber list, so this is
+        naturally empty there — hub peers get the full stream, a safe
+        superset."""
+        with self._lock:
+            return {s.interest_spec.class_key(): s.interest_spec
+                    for s in self._subscribers
+                    if s.interest_spec is not None}
+
+    def publish(self, origin, data: bytes, txids: Tuple = (),
+                slices=None) -> None:
         with self._lock:
             hub = self._hub
             if hub is not None:
@@ -524,17 +574,39 @@ class TcpTransport(Transport):
             # subscriber's worker writes views of this one staging
             # buffer verbatim (framed=True) — zero per-subscriber
             # Python copies, asserted structurally by the config12
-            # bench via the copies-per-frame counter
+            # bench via the copies-per-frame counter.  ISSUE 18
+            # generalizes "one buffer" to "one buffer per interest
+            # class": subscribers sharing a spec share one staged
+            # slice; spec-less subscribers (and classes the sender
+            # didn't cut — a hello that raced the class snapshot)
+            # still share the ONE full staging, bit-for-bit today's
             staged = struct.pack(">I", len(data)) + data
+            staged_by_class: Dict = {}
             stats.registry.pub_fanout.set(len(senders))
             for sender in senders:
-                sender.offer(staged)
+                spec = sender.interest_spec
+                if slices is None or spec is None:
+                    sender.offer(staged)
+                    continue
+                ck = spec.class_key()
+                if ck not in slices:
+                    sender.offer(staged)  # race fallback: full frame
+                    continue
+                payload = slices[ck]
+                if payload is None:
+                    continue  # frame elided for this class entirely
+                frame = staged_by_class.get(ck)
+                if frame is None:
+                    frame = struct.pack(">I", len(payload)) + payload
+                    staged_by_class[ck] = frame
+                sender.offer(frame)
         else:
             for sender in senders:
                 # legacy baseline (fabric_native=False): each worker
                 # re-frames the payload — one fresh bytes object per
                 # subscriber per frame, the copy the staged path
-                # eliminates
+                # eliminates (slices are a staged-mode feature; the
+                # baseline ships the full stream)
                 stats.registry.pub_sub_copies.inc()
                 sender.offer(data)
 
@@ -695,10 +767,16 @@ class TcpTransport(Transport):
             if peer is None:
                 return
             addr = tuple(peer["desc"].pub_addrs[0])
+            with self._lock:
+                spec = self._local_interest
             try:
                 sock = socket.create_connection(
                     addr, timeout=self.connect_timeout)
-                _send_frame(sock, termcodec.encode(self._dc_id))
+                # spec-less = the pre-upgrade plain-dc_id hello (full
+                # stream); the spec is re-read each dial so a widened
+                # interest takes effect on reconnect (ISSUE 18)
+                _send_frame(sock, termcodec.encode(
+                    hello_term(self._dc_id, spec)))
                 sock.settimeout(None)
                 backoff = 0.05
                 while not self._stop.is_set():

@@ -51,6 +51,21 @@ class Transport:
         descriptor, or None when addressing is by registry key (in-proc)."""
         return None
 
+    # ------------------------------------------------- interest routing
+    # (ISSUE 18, docs/interest_routing.md).  Transports that cannot
+    # route by interest keep these no-ops: every subscriber then gets
+    # the full stream, which is always a safe superset.
+
+    def set_local_interest(self, dc_id, spec) -> None:
+        """Announce this endpoint's interest spec (None = full stream)
+        to publishers — hello payload on TCP, registry entry in-proc."""
+
+    def interest_classes(self) -> Dict:
+        """{class_key: InterestSpec} of the distinct specs live
+        subscribers announced — the sender cuts one slice per entry.
+        Empty dict = nobody filters, stage the full frame only."""
+        return {}
+
 
 class InProcBus(Transport):
     """Registry of DCs in one process.
@@ -63,6 +78,10 @@ class InProcBus(Transport):
     logging_vnode does when it forwards appends).
     """
 
+    #: capability probe for Sender._drain_outbox: this transport can
+    #: route per-interest-class slices (ISSUE 18)
+    accepts_interest = True
+
     def __init__(self):
         self._lock = threading.RLock()
         #: dc_id -> (descriptor, inbox queue, query handler)
@@ -73,6 +92,9 @@ class InProcBus(Transport):
         #: dc_ids whose *inbound* pub/sub frames are dropped (message-loss
         #: injection for the gap-repair tests)
         self._drop_rx: set = set()
+        #: dc_id -> InterestSpec for subscribers that announced one
+        #: (spec-less DCs receive the full stream)
+        self._interest: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------ registry
 
@@ -87,6 +109,18 @@ class InProcBus(Transport):
     def unregister(self, dc_id) -> None:
         with self._lock:
             self._dcs.pop(dc_id, None)
+            self._interest.pop(dc_id, None)
+
+    def set_local_interest(self, dc_id, spec) -> None:
+        with self._lock:
+            if spec is None:
+                self._interest.pop(dc_id, None)
+            else:
+                self._interest[dc_id] = spec
+
+    def interest_classes(self) -> Dict:
+        with self._lock:
+            return {s.class_key(): s for s in self._interest.values()}
 
     def descriptor(self, dc_id) -> DcDescriptor:
         with self._lock:
@@ -123,15 +157,29 @@ class InProcBus(Transport):
 
     # ------------------------------------------------------------- channels
 
-    def publish(self, origin, data: bytes) -> None:
+    def publish(self, origin, data: bytes, slices=None) -> None:
         with self._lock:
             targets = [(dc_id, inbox) for dc_id, (_d, inbox, _q)
                        in self._dcs.items() if dc_id != origin]
-            targets = [(dc_id, inbox) for dc_id, inbox in targets
+            targets = [(dc_id, inbox, self._interest.get(dc_id))
+                       for dc_id, inbox in targets
                        if self.link_up(origin, dc_id)
                        and dc_id not in self._drop_rx]
-        for _dc_id, inbox in targets:
-            inbox.put(data)
+        for dc_id, inbox, spec in targets:
+            payload = data
+            if slices is not None and spec is not None:
+                # a class the sender didn't cut (spec raced in after
+                # the snapshot) falls back to the FULL frame — a safe
+                # superset, both chains share the origin opid numbering
+                payload = slices.get(spec.class_key(), data)
+                if payload is None:
+                    continue  # frame elided for this class entirely
+            self._deliver_to(dc_id, inbox, payload)
+
+    def _deliver_to(self, dc_id, inbox, payload: bytes) -> None:
+        """Single-subscriber delivery hop — a seam the interest bench's
+        metering bus overrides to count per-target delivered bytes."""
+        inbox.put(payload)
 
     def request(self, origin, target, kind: str, payload) -> Any:
         with self._lock:

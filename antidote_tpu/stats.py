@@ -761,6 +761,44 @@ class Registry:
             "Grace-period entries expired by the periodic transfer "
             "pass — each expiry re-opens a requester for grants")
 
+        # ---- interest-routed replication (ISSUE 18,
+        # interdc/interest.py + interdc/sender.py): the filtered
+        # fan-out's wire economy.  Full-stream clusters must read all
+        # zeros here — interest_slices_per_frame's zero IS the bench
+        # contract, like the ISSUE-12 copies-per-frame gauge.
+        self.interest_peer_ranges = LabeledGauge(
+            "antidote_interest_peer_subscribed_ranges",
+            "Key ranges in the interest spec each subscribed peer "
+            "announced in its hello (absent peer = spec-less = full "
+            "stream)",
+            labels=("peer",))
+        self.interest_frames = Counter(
+            "antidote_interest_frames_total",
+            "Published frames that went through interest slicing — "
+            "the slice-buffers-per-frame denominator")
+        self.interest_slice_buffers = Counter(
+            "antidote_interest_slice_buffers_total",
+            "Per-interest-class staged buffers cut across all sliced "
+            "frames (subscribers sharing a spec share one buffer)")
+        self.interest_slices_per_frame = Gauge(
+            "antidote_interest_slice_buffers_per_frame",
+            "Running slice buffers per sliced frame — 0 on a "
+            "full-stream cluster (the staged-once contract's "
+            "one-buffer baseline; bench-gated at zero)")
+        self.interest_filtered_txns = Counter(
+            "antidote_interest_filtered_txns_total",
+            "Txns elided from at least one interest-class slice "
+            "(summed per class: a txn skipped by 3 classes counts 3)")
+        self.interest_filtered_bytes = Counter(
+            "antidote_interest_filtered_bytes_total",
+            "Encoded bytes NOT shipped thanks to slicing, summed "
+            "over interest classes vs the full staged frame")
+        self.interest_backfills = Counter(
+            "antidote_interest_backfills_total",
+            "Gap-repair / bootstrap fetches issued with an interest "
+            "filter attached — interest widening converges through "
+            "these (docs/interest_routing.md §3)")
+
         # ---- fleet health plane (ISSUE 17, obs/fleet.py + obs/slo.py)
         self.vis_probe_rtt = LabeledGauge(
             "antidote_vis_probe_rtt_seconds",
@@ -848,6 +886,12 @@ class Registry:
                 self.bcounter_transfers_granted,
                 self.bcounter_grace_suppressed,
                 self.bcounter_grace_expiries,
+                self.interest_peer_ranges, self.interest_frames,
+                self.interest_slice_buffers,
+                self.interest_slices_per_frame,
+                self.interest_filtered_txns,
+                self.interest_filtered_bytes,
+                self.interest_backfills,
                 self.vis_probe_rtt,
                 self.fleet_scrape_age, self.fleet_sources,
                 self.fleet_scrape_errors,

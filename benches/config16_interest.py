@@ -1,0 +1,277 @@
+"""Config 16: interest-routed replication — the shipped-byte economy
+(ISSUE 18, docs/interest_routing.md).
+
+Cure-style full-mesh shipping (benches/config7_repl.py) pays O(DCs²)
+wire bytes: every committed txn reaches every DC whether or not the
+DC's users ever touch its keys.  Interest routing lets each subscriber
+announce key ranges; the sender stages the columnar frame ONCE and
+cuts per-interest-class slices, so a DC subscribed to 1/4 of the
+keyspace receives ~1/4 of the txn stream.  This config drives a 4-DC
+in-process cluster, each DC subscribing one keyspace quarter while
+every DC writes round-robin across the WHOLE keyspace, and gates:
+
+- ``interest_pub_bytes_per_txn`` (interest b/txn, must not rise):
+  delivered non-ping bytes per committed txn under quarter
+  subscriptions.  The in-bench bar is >= 3x below the full-mesh
+  oracle, and delivery is proven byte-identical within subscribed
+  ranges first: every DC's subscribed-quarter reads must equal the
+  oracle cluster's, with zero failed txns.
+- ``interest_backfill_ms`` (ms, must not rise): a DC widening its
+  interest MID-TRAFFIC (dc1: quarter 0 -> quarters 0+1) converges to
+  the oracle values of the newly-subscribed quarter through the lazy
+  backfill chain (below-watermark ranged LOG_READ + the new-class gap
+  repair) — wall time from ``set_interest`` to converged reads.
+- ``interest_fullstream_slice_buffers_per_frame`` (slices/frame, must
+  not rise off its zero baseline): with ``interest_routing=True`` but
+  every peer spec-less, the sender must cut ZERO slice buffers — the
+  staged-once fan-out is untouched and delivered bytes match the
+  routing-off oracle.  This is the "full-stream peers measurably
+  unchanged" contract.
+
+Standalone heartbeat pings are metered out (the MeterBus decodes each
+delivered frame): they are interest-INDEPENDENT by design — they carry
+the GST's min-prepared certificates (docs/interest_routing.md §4) —
+and their cadence-proportional bytes would otherwise let wall-clock
+noise dilute the txn-stream ratio the gate enforces.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+
+from benches._util import emit, setup
+
+N_KEYS = 256
+QUARTERS = (("k000", "k064"), ("k064", "k128"),
+            ("k128", "k192"), ("k192", "k256"))
+#: realistic payload weight so the ratio reflects txn bytes, not
+#: framing overhead
+PAD = "x" * 128
+BUCKET = "b16"
+
+
+def _key(i: int) -> str:
+    return f"k{i % N_KEYS:03d}"
+
+
+def _schedule(n_rounds: int, phase: int = 0):
+    """Deterministic write tape: (dc_index, key, element) per commit.
+    The 67 stride is co-prime with 256, so every writer sweeps the
+    whole keyspace — each subscriber's quarter receives txns from
+    every origin, and ~3/4 of every origin's stream is elided per
+    subscriber.  ``phase`` offsets the round tags so consecutive tapes
+    write distinct set elements."""
+    tape = []
+    for r in range(n_rounds):
+        for i in range(4):
+            k = _key((r * 4 + i) * 67)
+            tape.append((i, k, f"dc{i + 1}:{phase + r}:{PAD}"))
+    return tape
+
+
+def _expected(tape, lo: str, hi: str):
+    """{key: sorted element list} the CRDT must converge to for keys
+    in [lo, hi) — the schedule is the oracle for the widen leg."""
+    out: dict = {}
+    for _i, k, elem in tape:
+        if lo <= k < hi:
+            out.setdefault(k, set()).add(elem)
+    return {k: sorted(v) for k, v in out.items()}
+
+
+def make_meter_bus():
+    """InProcBus whose per-subscriber delivery hop counts delivered
+    txn-stream bytes (standalone pings excluded — see module doc)."""
+    from antidote_tpu.interdc.transport import InProcBus
+    from antidote_tpu.interdc.wire import InterDcBatch, frame_from_bin
+
+    class MeterBus(InProcBus):
+        def __init__(self):
+            super().__init__()
+            self._meter_lock = threading.Lock()
+            self.bytes_to: dict = {}
+            self.frames_to: dict = {}
+
+        def _deliver_to(self, dc_id, inbox, payload):
+            try:
+                f = frame_from_bin(payload)
+                ping = (not isinstance(f, InterDcBatch)) and f.is_ping()
+            except ValueError:
+                ping = False
+            if not ping:
+                with self._meter_lock:
+                    self.bytes_to[dc_id] = (
+                        self.bytes_to.get(dc_id, 0) + len(payload))
+                    self.frames_to[dc_id] = (
+                        self.frames_to.get(dc_id, 0) + 1)
+            super()._deliver_to(dc_id, inbox, payload)
+
+        def total_bytes(self) -> int:
+            with self._meter_lock:
+                return sum(self.bytes_to.values())
+
+    return MeterBus()
+
+
+def build_cluster(tmp: str, tag: str, routed: bool, ranged: bool):
+    """4 DCs on one metered bus.  ``routed`` flips the one config knob
+    under test; ``ranged`` additionally subscribes DC i to quarter i
+    (False = every peer spec-less: the full-stream leg)."""
+    from antidote_tpu.config import Config
+    from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+
+    bus = make_meter_bus()
+    dcs = []
+    for i in range(4):
+        kw = dict(n_partitions=2, device_store=False, heartbeat_s=0.2,
+                  clock_wait_timeout_s=30.0)
+        if routed:
+            kw["interest_routing"] = True
+            if ranged:
+                kw["interest_ranges"] = (QUARTERS[i],)
+        dcs.append(DataCenter(f"dc{i + 1}", bus, config=Config(**kw),
+                              data_dir=f"{tmp}/{tag}_dc{i + 1}"))
+    connect_dcs(dcs)
+    for dc in dcs:
+        dc.start_bg_processes()
+    return bus, dcs
+
+
+def drive(dcs, tape):
+    """Run the write tape; returns the commit VCs.  Any failed txn
+    raises out of the bench — the zero-failed-txns bar."""
+    cts = []
+    for i, k, elem in tape:
+        cts.append(dcs[i].update_objects_static(
+            None, [((k, "set_aw", BUCKET), "add", elem)]))
+    return cts
+
+
+def read_quarter(dc, quarter, clock):
+    """{key: sorted element list} of the quarter's written keys at
+    ``clock`` (waits on the stable snapshot like any causal read)."""
+    lo, hi = quarter
+    keys = sorted(k for k in (_key(i) for i in range(N_KEYS))
+                  if lo <= k < hi)
+    vals, _ = dc.read_objects_static(
+        clock, [(k, "set_aw", BUCKET) for k in keys])
+    return {k: sorted(v) for k, v in zip(keys, vals) if v}
+
+
+def run_leg(tmp, tag, routed, ranged, tape):
+    """One cluster run over the tape; returns (per-DC subscribed-
+    quarter read maps, delivered txn-stream bytes, commit VC merge,
+    the live dcs + bus for follow-on legs)."""
+    from antidote_tpu.clocks import vc_max
+
+    bus, dcs = build_cluster(tmp, tag, routed=routed, ranged=ranged)
+    cts = drive(dcs, tape)
+    merged = vc_max(cts)
+    views = [read_quarter(dc, QUARTERS[i], merged)
+             for i, dc in enumerate(dcs)]
+    # reads waited out delivery, so the meter now covers every shipped
+    # txn frame of the tape
+    return views, bus.total_bytes(), merged, bus, dcs
+
+
+def main():
+    quick, _jax = setup()
+    from antidote_tpu import stats
+    from antidote_tpu.clocks import vc_max
+
+    n_rounds = 48 if quick else 192
+    tape = _schedule(n_rounds)
+    n_txns = len(tape)
+
+    with tempfile.TemporaryDirectory(prefix="bench_interest_") as tmp:
+        # ---- full-mesh oracle --------------------------------------
+        full_views, full_bytes, _m, _bus, dcs = run_leg(
+            tmp, "full", routed=False, ranged=False, tape=tape)
+        for dc in dcs:
+            dc.close()
+
+        # ---- interest-routed leg + widen-mid-traffic ---------------
+        routed_views, routed_bytes, merged, bus, dcs = run_leg(
+            tmp, "routed", routed=True, ranged=True, tape=tape)
+        assert routed_views == full_views, \
+            "filtered delivery diverged from the full-mesh oracle " \
+            "within subscribed ranges"
+        full_bpt = full_bytes / n_txns
+        routed_bpt = routed_bytes / n_txns
+        ratio = full_bpt / max(routed_bpt, 1e-9)
+        assert ratio >= 3.0, \
+            f"quarter subscriptions shipped {routed_bpt:.0f} B/txn vs " \
+            f"full mesh {full_bpt:.0f} — only {ratio:.2f}x below the " \
+            f"3x bar"
+
+        # widen dc1 to quarters 0+1 in the middle of a second tape:
+        # history of quarter 1 must arrive via the lazy backfill, new
+        # traffic via the new interest-class chain — zero failed txns
+        tape2 = _schedule(n_rounds, phase=n_rounds)
+        half = len(tape2) // 2
+        cts2 = drive(dcs, tape2[:half])
+        backfills0 = stats.registry.interest_backfills.value()
+        t0 = time.perf_counter()
+        dcs[0].set_interest((QUARTERS[0], QUARTERS[1]))
+        cts2 += drive(dcs, tape2[half:])
+        merged2 = vc_max([merged] + cts2)
+        want_q1 = _expected(tape + tape2, *QUARTERS[1])
+        deadline = time.monotonic() + 60.0
+        while True:
+            got = read_quarter(dcs[0], QUARTERS[1], merged2)
+            if got == want_q1:
+                break
+            assert time.monotonic() < deadline, \
+                "widened quarter never converged through the backfill"
+            time.sleep(0.01)
+        backfill_ms = (time.perf_counter() - t0) * 1e3
+        backfills = stats.registry.interest_backfills.value() - backfills0
+        assert backfills > 0, \
+            "widen converged without ever touching the backfill path"
+        for dc in dcs:
+            dc.close()
+
+        # ---- full-stream leg: routing on, every peer spec-less -----
+        sb0 = stats.registry.interest_slice_buffers.value()
+        fr0 = stats.registry.interest_frames.value()
+        specless_views, specless_bytes, _m, bus3, dcs = run_leg(
+            tmp, "specless", routed=True, ranged=False, tape=tape)
+        frames3 = sum(bus3.frames_to.values())
+        for dc in dcs:
+            dc.close()
+        slice_buffers = stats.registry.interest_slice_buffers.value() - sb0
+        assert slice_buffers == 0, \
+            f"spec-less peers cost {slice_buffers} slice buffers — " \
+            f"the full-stream fan-out is no longer staged-once"
+        assert stats.registry.interest_frames.value() == fr0, \
+            "the slicing path ran on a cluster with no interest specs"
+        # commit-VC timestamps differ run to run, so byte equality to
+        # the oracle is approximate — 3% covers varint-width and
+        # ping-piggyback jitter (the structural check is the
+        # zero-slice-buffers assert above)
+        drift = abs(specless_bytes - full_bytes) / max(full_bytes, 1)
+        assert drift <= 0.03, \
+            f"spec-less delivery drifted {drift * 100:.2f}% in bytes " \
+            f"from the routing-off oracle"
+
+    emit("interest_pub_bytes_per_txn", round(routed_bpt, 1),
+         "interest b/txn", round(ratio, 2),
+         full_mesh_bytes_per_txn=round(full_bpt, 1),
+         txns=n_txns, dcs=4, quarters=len(QUARTERS),
+         delivered_bytes=routed_bytes,
+         full_mesh_delivered_bytes=full_bytes)
+    emit("interest_backfill_ms", round(backfill_ms, 1), "ms", None,
+         widened_keys=len(want_q1), backfill_fetches=int(backfills),
+         txns_mid_widen=len(tape2))
+    emit("interest_fullstream_slice_buffers_per_frame", 0.0,
+         "slices/frame", None,
+         delivered_frames=frames3,
+         specless_bytes=specless_bytes,
+         oracle_bytes=full_bytes,
+         byte_drift_pct=round(drift * 100, 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -258,6 +258,89 @@ def run_cluster(n_data, txns_per_client, K, tmp, n_clients=4,
                 p.kill()
 
 
+def run_bcounter(tmp):
+    """Bounded-counter rights-transfer economy (ISSUE 17) under the
+    txn-bench roof: a poor DC's denied decrement queues a transfer
+    request, the rich DC's periodic pass grants, and the retried
+    decrement lands.  Sequential and in-process, so honest on any
+    host.  Returns the BCOUNTER_* registry deltas plus the
+    denial-to-granted wall time, folded into the headline emit's
+    detail — the rights economy shows up in the bench record, not
+    just in tests."""
+    from antidote_tpu import stats
+    from antidote_tpu.api import TransactionAborted
+    from antidote_tpu.config import Config
+    from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+    from antidote_tpu.interdc.transport import InProcBus
+
+    reg = stats.registry
+    peers = ("dc1", "dc2")
+    bus = InProcBus()
+    kw = dict(n_partitions=2, device_store=False, heartbeat_s=0.02,
+              clock_wait_timeout_s=10.0)
+    dcs = [DataCenter(name, bus, config=Config(**kw),
+                      data_dir=os.path.join(tmp, f"bc_{name}"))
+           for name in peers]
+    connect_dcs(dcs)
+    for dc in dcs:
+        dc.start_bg_processes()
+    try:
+        dc1, dc2 = dcs
+        bound = ("bench_bc", "counter_b", "bkt")
+        denials0 = reg.bcounter_denials.value()
+        req0 = sum(reg.bcounter_transfer_requests.value(peer=p)
+                   for p in peers)
+        granted0 = sum(reg.bcounter_transfers_granted.value(peer=p)
+                       for p in peers)
+        ct = dc1.update_objects_static(
+            None, [(bound, "increment", 32)])
+        deadline = time.monotonic() + 10.0
+        while dc2.read_objects_static(ct, [bound])[0][0] != 32:
+            assert time.monotonic() < deadline, \
+                "bcounter mint never replicated to dc2"
+            time.sleep(0.01)
+
+        # all 32 rights live at dc1: dc2's decrement is denied, queues
+        # a transfer request, and the retry loop times the economy
+        t0 = time.perf_counter()
+        try:
+            dc2.update_objects_static(ct, [(bound, "decrement", 8)])
+            raise AssertionError(
+                "dc2 decremented without holding any rights")
+        except TransactionAborted:
+            pass
+        ct2 = None
+        while ct2 is None:
+            try:
+                ct2 = dc2.update_objects_static(
+                    ct, [(bound, "decrement", 8)])
+            except TransactionAborted:
+                assert time.monotonic() < deadline, \
+                    "rights transfer never arrived at dc2"
+                time.sleep(0.01)
+        grant_ms = (time.perf_counter() - t0) * 1e3
+        vals, _ = dc2.read_objects_static(ct2, [bound])
+        assert vals[0] == 24, f"bcounter converged to {vals[0]}, not 24"
+        denials = reg.bcounter_denials.value() - denials0
+        requests = sum(reg.bcounter_transfer_requests.value(peer=p)
+                       for p in peers) - req0
+        granted = sum(reg.bcounter_transfers_granted.value(peer=p)
+                      for p in peers) - granted0
+        assert denials >= 1 and requests >= 1 and granted >= 1, \
+            (denials, requests, granted)
+        return {"grant_latency_ms": round(grant_ms, 1),
+                "denials": int(denials),
+                "transfer_requests": int(requests),
+                "transfers_granted": int(granted),
+                "rights_held_dc1":
+                    reg.bcounter_rights_held.value(dc="dc1"),
+                "rights_held_dc2":
+                    reg.bcounter_rights_held.value(dc="dc2")}
+    finally:
+        for dc in dcs:
+            dc.close()
+
+
 def run_cluster_latency(tmp):
     """Single-threaded RPC latency decomposition for the cluster path
     — the scale-out proxy a starved box CAN measure honestly (round-4
@@ -352,6 +435,9 @@ def main():
             cluster_lat = run_cluster_latency(os.path.join(tmp, "L"))
         except Exception:  # noqa: BLE001 — a lat probe must not kill
             cluster_lat = None
+        # bounded-counter rights economy (ISSUE 17 metrics): loud —
+        # a broken transfer path must fail the bench, not vanish
+        bcounter = run_bcounter(os.path.join(tmp, "bc"))
         cluster_starved = cores < n_nodes + n_clients
         if cluster_starved:
             cluster_tput = cluster_tput_1 = cluster_aborts = None
@@ -389,6 +475,7 @@ def main():
          cluster_txn_per_sec=(round(cluster_tput)
                               if cluster_tput is not None else None),
          cluster_rpc_latency=cluster_lat,
+         bcounter=bcounter,
          cluster_starved=cluster_starved,
          cluster_nodes=n_nodes,
          cluster_clients=n_clients,

@@ -1,0 +1,435 @@
+"""Interest-routed replication (ISSUE 18): spec validation is loud,
+the wire forms reject hostile input, the slice functions pin the
+class-watermark chain rules, the sender's routing knob is a byte-level
+no-op without spec'd subscribers, and the TCP hello plane accepts a
+spec'd subscriber / closes a malformed one."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.clocks import VC
+from antidote_tpu.config import Config
+from antidote_tpu.interdc import termcodec
+from antidote_tpu.interdc.interest import (
+    HELLO_TAG,
+    SPEC_TAG,
+    SPEC_VERSION,
+    InterestError,
+    InterestSpec,
+    hello_term,
+    interest_from_config,
+    parse_hello,
+    slice_batch,
+    slice_ping,
+    slice_txn,
+)
+from antidote_tpu.interdc.sender import InterDcLogSender
+from antidote_tpu.interdc.wire import InterDcBatch, InterDcTxn, frame_from_bin
+from antidote_tpu.oplog.records import OpId, commit_record, update_record
+
+
+def mk_txn(i, opid, keys, dc="dc1", partition=0):
+    """One committed txn updating ``keys``; returns (txn, new_opid)."""
+    txid = (dc, 5000 + i)
+    prev = opid
+    recs = []
+    for k in keys:
+        opid += 1
+        recs.append(update_record(OpId(dc, opid), txid, k, "counter_pn",
+                                  ("increment", 1)))
+    opid += 1
+    recs.append(commit_record(OpId(dc, opid), txid, dc, 10_000 + i,
+                              VC({dc: 9_000 + i})))
+    return InterDcTxn.from_ops(dc, partition, prev, recs), opid
+
+
+class TestSpecValidation:
+    """Malformed specs are rejected at construction — never silently
+    downgraded to a full or empty stream."""
+
+    def test_empty_spec_rejected(self):
+        with pytest.raises(InterestError, match="empty"):
+            InterestSpec(())
+
+    def test_inverted_and_empty_ranges_rejected(self):
+        with pytest.raises(InterestError):
+            InterestSpec([("b", "a")])
+        with pytest.raises(InterestError):
+            InterestSpec([("a", "a")])
+
+    def test_overlapping_ranges_rejected(self):
+        with pytest.raises(InterestError, match="overlap"):
+            InterestSpec([("a", "m"), ("k", "z")])
+
+    def test_non_string_bounds_rejected(self):
+        with pytest.raises(InterestError):
+            InterestSpec([(1, 2)])
+        with pytest.raises(InterestError):
+            InterestSpec([("a",)])
+        with pytest.raises(InterestError):
+            InterestSpec(42)
+
+    def test_canonicalization_shares_class_identity(self):
+        """Range order must not split an interest class: two
+        subscribers declaring the same set share one slice buffer."""
+        a = InterestSpec([("k", "p"), ("a", "c")])
+        b = InterestSpec([("a", "c"), ("k", "p")])
+        assert a == b
+        assert a.class_key() == b.class_key()
+        assert a.ranges == (("a", "c"), ("k", "p"))
+
+    def test_adjacent_ranges_allowed(self):
+        InterestSpec([("a", "k"), ("k", "z")])  # half-open: no overlap
+
+
+class TestMatching:
+    def test_key_matching_half_open(self):
+        s = InterestSpec([("k10", "k20")])
+        assert s.matches_key("k10")
+        assert s.matches_key("k19")
+        assert not s.matches_key("k20")
+        assert not s.matches_key("k09")
+
+    def test_non_string_keys_ship_everywhere(self):
+        s = InterestSpec([("a", "b")])
+        assert s.matches_key(("composite", 1))
+        assert s.matches_key(42)
+
+    def test_txn_granular_whole_txn_on_any_match(self):
+        s = InterestSpec([("a", "b")])
+        t_in, _ = mk_txn(0, 0, ["zz", "aa"])  # one key inside
+        t_out, _ = mk_txn(1, 10, ["zz"])
+        assert s.matches_txn(t_in)
+        assert not s.matches_txn(t_out)
+
+    def test_updateless_txn_matches_every_spec(self):
+        ping = InterDcTxn.ping("dc1", 0, 7, 123)
+        assert InterestSpec([("a", "b")]).matches_txn(ping)
+
+
+class TestWireForms:
+    def test_spec_roundtrip(self):
+        s = InterestSpec([("a", "c"), ("k", "p")])
+        assert InterestSpec.from_wire(s.to_wire()) == s
+
+    @pytest.mark.parametrize("term", [
+        None,
+        "interest",
+        (SPEC_TAG,),
+        (SPEC_TAG, SPEC_VERSION),                       # missing ranges
+        (SPEC_TAG, SPEC_VERSION + 1, (("a", "b"),)),    # future version
+        ("not_interest", SPEC_VERSION, (("a", "b"),)),
+        (SPEC_TAG, SPEC_VERSION, ()),                   # empty
+        (SPEC_TAG, SPEC_VERSION, (("b", "a"),)),        # inverted
+        (SPEC_TAG, SPEC_VERSION, ((1, 2),)),            # non-str
+    ])
+    def test_hostile_spec_terms_raise(self, term):
+        with pytest.raises(InterestError):
+            InterestSpec.from_wire(term)
+
+    def test_specless_hello_is_preupgrade_form(self):
+        """A spec-less subscriber's hello is the plain dc_id — byte
+        compatible with every pre-ISSUE-18 acceptor."""
+        assert hello_term("dc7", None) == "dc7"
+        assert parse_hello("dc7") == ("dc7", None)
+
+    def test_tagged_hello_roundtrip(self):
+        s = InterestSpec([("a", "b")])
+        peer, spec = parse_hello(hello_term("dc7", s))
+        assert peer == "dc7" and spec == s
+
+    @pytest.mark.parametrize("term", [
+        (HELLO_TAG,),
+        (HELLO_TAG, SPEC_VERSION, "dc7"),               # no spec
+        (HELLO_TAG, SPEC_VERSION + 1, "dc7",
+         (SPEC_TAG, SPEC_VERSION, (("a", "b"),))),      # future hello
+        (HELLO_TAG, SPEC_VERSION, "dc7", "garbage"),
+        (HELLO_TAG, SPEC_VERSION, "dc7",
+         (SPEC_TAG, SPEC_VERSION, ())),                 # empty spec
+    ])
+    def test_hostile_hello_raises(self, term):
+        with pytest.raises(InterestError):
+            parse_hello(term)
+
+    def test_hello_survives_termcodec(self):
+        s = InterestSpec([("a", "c"), ("k", "p")])
+        term = termcodec.decode(termcodec.encode(hello_term("dc7", s)))
+        peer, spec = parse_hello(term)
+        assert peer == "dc7" and spec == s
+
+
+class TestFactory:
+    def test_spec_only_when_both_knobs_set(self):
+        assert interest_from_config(Config()) is None
+        assert interest_from_config(
+            Config(interest_routing=True)) is None
+        # ranges without the routing master switch stay inert
+        assert interest_from_config(
+            Config(interest_ranges=(("a", "b"),))) is None
+        spec = interest_from_config(Config(
+            interest_routing=True, interest_ranges=(("a", "b"),)))
+        assert spec == InterestSpec([("a", "b")])
+
+    def test_malformed_config_ranges_raise_at_construction(self):
+        with pytest.raises(InterestError):
+            interest_from_config(Config(interest_routing=True,
+                                        interest_ranges=(("b", "a"),)))
+
+
+class TestSliceChainRules:
+    """The class-watermark chain (docs/interest_routing.md §2): original
+    origin opid numbering, prev links rewritten gapless per class,
+    watermark moves only on emission."""
+
+    def spec(self):
+        return InterestSpec([("a", "f")])
+
+    def test_batch_subsequence_rewrites_prev_links(self):
+        t1, op = mk_txn(0, 100, ["aa"])       # match
+        t2, op = mk_txn(1, op, ["zz"])        # elided
+        t3, op = mk_txn(2, op, ["bb", "zz"])  # match (whole txn)
+        batch = InterDcBatch.from_txns([t1, t2, t3])
+        sliced, wm, elided = slice_batch(batch, self.spec(), 100)
+        assert elided == 1
+        txns = sliced.txns()
+        assert [t.records[-1].op_id.n for t in txns] == \
+            [t1.last_opid(), t3.last_opid()]  # ORIGINAL opids
+        assert txns[0].prev_log_opid == 100
+        assert txns[1].prev_log_opid == t1.last_opid()  # gapless chain
+        assert wm == t3.last_opid()
+        # the cut frame survives the wire
+        out = frame_from_bin(sliced.to_bin())
+        assert len(out.txns()) == 2
+
+    def test_no_match_no_ping_skips_frame_watermark_parked(self):
+        t, _ = mk_txn(0, 50, ["zz"])
+        batch = InterDcBatch.from_txns([t])
+        sliced, wm, elided = slice_batch(batch, self.spec(), 40)
+        assert sliced is None and wm == 40 and elided == 1
+
+    def test_no_match_with_piggyback_degenerates_to_class_ping(self):
+        """The ping must survive an all-elided frame: heartbeats are
+        interest-independent (the partial-subscription GST argument)."""
+        t, _ = mk_txn(0, 50, ["zz"])
+        batch = InterDcBatch.from_txns([t], ping_ts=777)
+        sliced, wm, _ = slice_batch(batch, self.spec(), 40)
+        assert isinstance(sliced, InterDcTxn) and sliced.is_ping()
+        assert sliced.prev_log_opid == 40  # anchored at the CLASS wm
+        assert sliced.timestamp == 777
+        assert wm == 40
+
+    def test_single_txn_slice(self):
+        t, _ = mk_txn(0, 10, ["aa"])
+        sliced, wm, elided = slice_txn(t, self.spec(), 3)
+        assert sliced.prev_log_opid == 3 and wm == t.last_opid()
+        assert elided == 0
+        sliced, wm, elided = slice_txn(t, InterestSpec([("x", "y")]), 3)
+        assert sliced is None and wm == 3 and elided == 1
+
+    def test_standalone_ping_always_emitted(self):
+        ping = InterDcTxn.ping("dc1", 0, 99, 555)
+        sliced, wm, _ = slice_ping(ping, self.spec(), 7)
+        assert sliced.is_ping() and sliced.prev_log_opid == 7
+        assert sliced.timestamp == 555 and wm == 7
+
+
+class _Capture:
+    """Plain pre-ISSUE-18 transport: publish(origin, data) only."""
+
+    def __init__(self):
+        self.frames = []
+        self._lock = threading.Lock()
+
+    def publish(self, origin, data):
+        with self._lock:
+            self.frames.append(bytes(data))
+
+
+class _InterestCapture(_Capture):
+    """Interest-capable transport stub: records the slices kwarg."""
+
+    accepts_interest = True
+
+    def __init__(self, classes=None):
+        super().__init__()
+        self.classes = dict(classes or {})
+        self.slice_log = []
+
+    def interest_classes(self):
+        return dict(self.classes)
+
+    def publish(self, origin, data, slices=None):
+        with self._lock:
+            self.frames.append(bytes(data))
+            self.slice_log.append(slices)
+
+
+def _feed(sender, n=6):
+    opid = 0
+    for i in range(n):
+        txid = ("dc1", 1000 + i)
+        key = "aa" if i % 2 == 0 else "zz"
+        opid += 1
+        sender.on_append(update_record(
+            OpId("dc1", opid), txid, key, "counter_pn",
+            ("increment", 1)))
+        opid += 1
+        sender.on_append(commit_record(
+            OpId("dc1", opid), txid, "dc1", 10_000 + i,
+            VC({"dc1": 9_000 + i})))
+    sender.flush_ship()
+    sender.close()
+
+
+def _cfg(**kw):
+    kw.setdefault("interdc_ship", True)
+    kw.setdefault("interdc_ship_txns", 4)
+    kw.setdefault("interdc_ship_us", 500_000)
+    return Config(**kw)
+
+
+@pytest.fixture
+def frozen_wall(monkeypatch):
+    """Pin the sender's wallclock: frames embed the ISSUE-7 trace
+    header (origin commit wall µs), so byte-for-byte comparisons
+    across runs need the clock held still."""
+    from antidote_tpu.interdc import sender as sender_mod
+
+    monkeypatch.setattr(sender_mod.time, "time_ns", lambda: 1_000_000)
+
+
+class TestSenderDeterminism:
+    """The default-off contract at the byte level: routing enabled with
+    no spec'd subscriber publishes bit-for-bit what routing-off does,
+    and cuts zero slice buffers."""
+
+    def test_routing_on_without_classes_is_bitforbit(self, frozen_wall):
+        frames = {}
+        for tag, routing, cap in (
+                ("off", False, _Capture()),
+                ("on_plain", True, _Capture()),
+                ("on_no_specs", True, _InterestCapture())):
+            s = InterDcLogSender(
+                "dc1", 0, cap, config=_cfg(interest_routing=routing))
+            _feed(s)
+            frames[tag] = cap.frames
+        assert frames["off"] == frames["on_plain"] == \
+            frames["on_no_specs"]
+
+    def test_no_specs_cuts_no_slices(self):
+        reg = stats.registry
+        sb0 = reg.interest_slice_buffers.value()
+        fr0 = reg.interest_frames.value()
+        cap = _InterestCapture()
+        s = InterDcLogSender("dc1", 0, cap,
+                             config=_cfg(interest_routing=True))
+        _feed(s)
+        assert reg.interest_slice_buffers.value() == sb0
+        assert reg.interest_frames.value() == fr0
+        assert all(sl is None for sl in cap.slice_log)
+
+    def test_spec_class_gets_subsequence_full_buffer_untouched(
+            self, frozen_wall):
+        """With a spec'd class the FULL staging buffer is still the
+        bit-for-bit routing-off frame; the class's slice carries only
+        the matching subsequence, chain-linked gaplessly."""
+        spec = InterestSpec([("a", "f")])
+        cap = _InterestCapture({spec.class_key(): spec})
+        s = InterDcLogSender("dc1", 0, cap,
+                             config=_cfg(interest_routing=True))
+        _feed(s)
+        ref = _Capture()
+        s2 = InterDcLogSender("dc1", 0, ref, config=_cfg())
+        _feed(s2)
+        assert cap.frames == ref.frames  # staged-once plane unchanged
+        sliced = [sl[spec.class_key()] for sl in cap.slice_log
+                  if sl and spec.class_key() in sl
+                  and sl[spec.class_key()] is not None]
+        assert sliced, "no slice was ever cut for the spec'd class"
+        prev_wm = None
+        for data in sliced:
+            f = frame_from_bin(data)
+            txns = f.txns() if isinstance(f, InterDcBatch) else \
+                ([] if f.is_ping() else [f])
+            for t in txns:
+                keys = [r.payload[1] for r in t.records
+                        if r.payload[0] == "update"]
+                assert any(spec.matches_key(k) for k in keys)
+                if prev_wm is not None:
+                    assert t.prev_log_opid == prev_wm
+                prev_wm = t.last_opid()
+
+
+class TestTcpHello:
+    """The acceptor side: a valid interest hello registers the spec'd
+    subscriber (gauge set), a malformed one closes the connection —
+    never a silent full or empty stream."""
+
+    def _transport(self):
+        from antidote_tpu.interdc.tcp import TcpTransport
+        from antidote_tpu.interdc.wire import DcDescriptor
+
+        bus = TcpTransport(native_pub=False)
+        bus.register(DcDescriptor(dc_id="pub_dc", n_partitions=1),
+                     lambda frm, kind, payload: None)
+        return bus
+
+    def _pub_addr(self, bus):
+        (pub_addr,), _query = bus.local_addrs()
+        return pub_addr
+
+    def test_valid_interest_hello_registers_spec(self):
+        from antidote_tpu.interdc import tcp as tcp_mod
+
+        bus = self._transport()
+        try:
+            host, port = self._pub_addr(bus)
+            spec = InterestSpec([("a", "b"), ("x", "z")])
+            sock = socket.create_connection((host, port), timeout=5)
+            try:
+                tcp_mod._send_frame(sock, termcodec.encode(
+                    hello_term("spec_peer", spec)))
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    with bus._lock:
+                        subs = list(bus._subscribers)
+                    if subs:
+                        break
+                    time.sleep(0.01)
+                assert subs and subs[0].interest_spec == spec
+                assert stats.registry.interest_peer_ranges.value(
+                    peer="spec_peer") == 2.0
+            finally:
+                sock.close()
+        finally:
+            bus.close()
+
+    @pytest.mark.parametrize("evil", [
+        (HELLO_TAG, SPEC_VERSION, "evil",
+         (SPEC_TAG, SPEC_VERSION, ())),                  # empty spec
+        (HELLO_TAG, SPEC_VERSION, "evil",
+         (SPEC_TAG, SPEC_VERSION, (("b", "a"),))),       # inverted
+        (HELLO_TAG, SPEC_VERSION + 9, "evil",
+         (SPEC_TAG, SPEC_VERSION, (("a", "b"),))),       # bad version
+    ])
+    def test_malformed_hello_closes_connection(self, evil):
+        from antidote_tpu.interdc import tcp as tcp_mod
+
+        bus = self._transport()
+        try:
+            host, port = self._pub_addr(bus)
+            sock = socket.create_connection((host, port), timeout=5)
+            try:
+                tcp_mod._send_frame(sock, termcodec.encode(evil))
+                sock.settimeout(5)
+                assert sock.recv(1) == b""  # server closed, loudly
+                with bus._lock:
+                    assert not bus._subscribers
+            finally:
+                sock.close()
+        finally:
+            bus.close()

@@ -1,0 +1,262 @@
+"""Interest-routed replication, end to end (ISSUE 18,
+docs/interest_routing.md): filtered delivery matches the full-stream
+values inside subscribed ranges, spec-less peers under routing=True are
+untouched, runtime re-subscription is validated loudly, a widening DC
+converges through the lazy backfill, and a partially-subscribed origin
+never wedges the global stable time.
+
+All clusters enable ``interest_routing`` on EVERY DC: slicing is
+SENDER-side, so the publishing DC's knob is the one that elides traffic
+(a routing-off sender ships full streams to spec'd subscribers — a safe
+superset)."""
+
+import time
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.clocks import vc_max
+from antidote_tpu.config import Config
+from antidote_tpu.interdc.dc import DataCenter, connect_dcs
+from antidote_tpu.interdc.interest import InterestError
+from antidote_tpu.interdc.transport import InProcBus
+
+from .conftest import make_cluster
+
+
+LOW, HIGH = ("ka", "km"), ("km", "kz")  # keyspace halves
+
+
+def add(dc, key, elem, clock=None):
+    return dc.update_objects_static(
+        clock, [((key, "set_aw", "bkt"), "add", elem)])
+
+
+def read_set(dc, key, clock):
+    vals, _ = dc.read_objects_static(clock, [(key, "set_aw", "bkt")])
+    return sorted(vals[0])
+
+
+def poll_set(dc, key, clock, want, timeout=15.0):
+    """Convergence after (re)subscription is asynchronous — backfill
+    fetches and the new class chain's gap repair land on background
+    cadences, so correctness here is 'converges', not 'is there on
+    the first read'."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if read_set(dc, key, clock) == want:
+            return
+        time.sleep(0.02)
+    assert read_set(dc, key, clock) == want
+
+
+def routed_cluster(bus, tmp_path, ranges_by_dc, n_dcs=None, **kw):
+    """Cluster with interest routing ON everywhere; DC i subscribes
+    ``ranges_by_dc[i]`` (None = spec-less full stream)."""
+    n = n_dcs or len(ranges_by_dc)
+    kw.setdefault("n_partitions", 2)
+    kw.setdefault("device_store", False)
+    kw.setdefault("heartbeat_s", 0.02)
+    kw.setdefault("clock_wait_timeout_s", 10.0)
+    dcs = []
+    for i in range(n):
+        cfg = Config(interest_routing=True,
+                     interest_ranges=ranges_by_dc[i], **kw)
+        dcs.append(DataCenter(f"dc{i + 1}", bus, config=cfg,
+                              data_dir=str(tmp_path / f"dc{i + 1}")))
+    connect_dcs(dcs)
+    for dc in dcs:
+        dc.start_bg_processes()
+    return dcs
+
+
+class TestFilteredDelivery:
+    def test_subscribed_range_converges_unsubscribed_elided(
+            self, tmp_path):
+        """dc2 subscribes the low half: low-half writes replicate,
+        high-half writes are elided from its stream (the value simply
+        never appears) while causal reads still complete — pings keep
+        the stable time moving."""
+        bus = InProcBus()
+        dcs = routed_cluster(bus, tmp_path, [None, (LOW,)])
+        try:
+            dc1, dc2 = dcs
+            ct = add(dc1, "kb_in", "x")
+            ct = add(dc1, "kx_out", "y", clock=ct)
+            poll_set(dc2, "kb_in", ct, ["x"])
+            # the causal read at dc1's commit clock COMPLETES (no GST
+            # wedge) and the elided key is simply absent
+            assert read_set(dc2, "kx_out", ct) == []
+            assert read_set(dc1, "kx_out", ct) == ["y"]
+        finally:
+            for dc in dcs:
+                dc.close()
+
+    def test_specless_peer_on_routing_cluster_gets_full_stream(
+            self, tmp_path):
+        """routing=True with no declared ranges anywhere: every value
+        replicates and the slicing path never runs — the bit-for-bit
+        contract's cluster-level face (the byte-level face is pinned
+        in tests/interdc/test_interest.py)."""
+        reg = stats.registry
+        sb0 = reg.interest_slice_buffers.value()
+        fr0 = reg.interest_frames.value()
+        bus = InProcBus()
+        dcs = routed_cluster(bus, tmp_path, [None, None])
+        try:
+            dc1, dc2 = dcs
+            ct = None
+            for i, key in enumerate(["ka_1", "kp_2", "kz_3"]):
+                ct = add(dc1, key, f"e{i}", clock=ct)
+            for i, key in enumerate(["ka_1", "kp_2", "kz_3"]):
+                poll_set(dc2, key, ct, [f"e{i}"])
+            assert reg.interest_slice_buffers.value() == sb0
+            assert reg.interest_frames.value() == fr0
+        finally:
+            for dc in dcs:
+                dc.close()
+
+    def test_mixed_cluster_specd_and_specless_subscribers(
+            self, tmp_path):
+        """One origin, one spec'd + one spec-less subscriber: the
+        spec-less peer sees everything, the spec'd one only its range."""
+        bus = InProcBus()
+        dcs = routed_cluster(bus, tmp_path, [None, (LOW,), None])
+        try:
+            dc1, dc2, dc3 = dcs
+            ct = add(dc1, "kb_in", "x")
+            ct = add(dc1, "kx_out", "y", clock=ct)
+            poll_set(dc3, "kx_out", ct, ["y"])  # full stream
+            poll_set(dc2, "kb_in", ct, ["x"])   # subscribed half
+            assert read_set(dc2, "kx_out", ct) == []
+        finally:
+            for dc in dcs:
+                dc.close()
+
+
+class TestSetInterestValidation:
+    def test_routing_off_is_a_config_error(self, cluster3):
+        with pytest.raises(ValueError, match="interest_routing"):
+            cluster3[0].set_interest((LOW,))
+
+    def test_malformed_ranges_rejected_loudly(self, tmp_path):
+        bus = InProcBus()
+        dcs = routed_cluster(bus, tmp_path, [(LOW,), (HIGH,)])
+        try:
+            dc1 = dcs[0]
+            with pytest.raises(InterestError):
+                dc1.set_interest(())                    # empty
+            with pytest.raises(InterestError):
+                dc1.set_interest((("b", "a"),))         # inverted
+            with pytest.raises(InterestError):
+                dc1.set_interest((("a", "m"), ("k", "z")))  # overlap
+            # the failed calls left the old subscription intact
+            assert dc1.interest.ranges == (LOW,)
+        finally:
+            for dc in dcs:
+                dc.close()
+
+
+class TestWidenBackfill:
+    def test_widen_mid_traffic_converges_via_backfill(self, tmp_path):
+        """dc2 subscribes the low half, traffic lands in both halves,
+        then dc2 widens to the full space: the high-half HISTORY
+        (below its stream watermarks, elided while unsubscribed)
+        arrives via the explicit ranged backfill, later traffic via
+        the new interest-class chain — and every write committed
+        during the widen succeeds (the zero-failed-txns bar)."""
+        reg = stats.registry
+        bus = InProcBus()
+        dcs = routed_cluster(bus, tmp_path, [None, (LOW,)])
+        try:
+            dc1, dc2 = dcs
+            ct = None
+            for i in range(6):
+                ct = add(dc1, "kb_in", f"a{i}", clock=ct)
+                ct = add(dc1, "kx_out", f"b{i}", clock=ct)
+            poll_set(dc2, "kb_in", ct, [f"a{i}" for i in range(6)])
+            assert read_set(dc2, "kx_out", ct) == []
+
+            backfills0 = reg.interest_backfills.value()
+            dc2.set_interest((("ka", "kz"),))
+            # mid-widen traffic from BOTH halves commits cleanly
+            for i in range(6, 9):
+                ct = add(dc1, "kb_in", f"a{i}", clock=ct)
+                ct = add(dc1, "kx_out", f"b{i}", clock=ct)
+            poll_set(dc2, "kx_out", ct, [f"b{i}" for i in range(9)])
+            poll_set(dc2, "kb_in", ct, [f"a{i}" for i in range(9)])
+            assert reg.interest_backfills.value() > backfills0, \
+                "widen converged without the backfill path running"
+        finally:
+            for dc in dcs:
+                dc.close()
+
+    def test_narrow_then_rewiden_no_duplicate_apply(self, tmp_path):
+        """Re-widening over history the DC already applied must dedup
+        against the local log's commit index (CRDT joins are
+        idempotent, but the dep gate must not be handed stale
+        causality): values stay exact, never doubled."""
+        bus = InProcBus()
+        dcs = routed_cluster(bus, tmp_path,
+                             [None, (("ka", "kz"),)])
+        try:
+            dc1, dc2 = dcs
+            ct = None
+            for i in range(4):
+                ct = add(dc1, "kx_out", f"b{i}", clock=ct)
+            poll_set(dc2, "kx_out", ct, [f"b{i}" for i in range(4)])
+            dc2.set_interest((LOW,))       # narrow: kx_out now elided
+            ct = add(dc1, "kb_in", "a0", clock=ct)
+            poll_set(dc2, "kb_in", ct, ["a0"])
+            dc2.set_interest((("ka", "kz"),))  # re-widen over history
+            ct = add(dc1, "kx_out", "b4", clock=ct)
+            poll_set(dc2, "kx_out", ct, [f"b{i}" for i in range(5)])
+        finally:
+            for dc in dcs:
+                dc.close()
+
+
+class TestPartialSubscriptionSafeTime:
+    def test_gst_advances_with_partially_subscribed_origin(
+            self, tmp_path):
+        """The acceptance pin: a cluster where every subscriber elides
+        most of an origin's stream still advances the global stable
+        time — heartbeat pings are interest-independent and carry the
+        min-prepared certificates, so causal reads at fresh commit
+        clocks keep completing instead of timing out."""
+        bus = InProcBus()
+        dcs = routed_cluster(bus, tmp_path, [(LOW,), (LOW,), (LOW,)])
+        try:
+            dc1, dc2, dc3 = dcs
+            # every write lands OUTSIDE everyone's subscription: no
+            # subscriber ever receives a data frame for them
+            ct = None
+            for i in range(5):
+                ct = add(dc1, "kx_out", f"v{i}", clock=ct)
+            # a snapshot read at dc1's newest commit clock on BOTH
+            # remotes completes well inside the clock-wait timeout
+            t0 = time.monotonic()
+            assert read_set(dc2, "kq_other", ct) == []
+            assert read_set(dc3, "kq_other", ct) == []
+            assert time.monotonic() - t0 < 8.0, \
+                "partially-subscribed origin wedged the stable time"
+            # and the dep gates report the partial subscription
+            qs = dc2.dep_gates[0].queue_stats()
+            assert "partial_origins" in qs
+        finally:
+            for dc in dcs:
+                dc.close()
+
+    def test_full_stream_cluster_unaffected_control(self, bus,
+                                                    tmp_path):
+        """Control for the pin above: the same shape with NO interest
+        routing behaves identically — catching a regression that
+        slowed full-mesh GST while the partial path stayed green."""
+        dcs = make_cluster(bus, tmp_path, 2, n_partitions=2)
+        try:
+            dc1, dc2 = dcs
+            ct = add(dc1, "kx_out", "v")
+            assert read_set(dc2, "kq_other", ct) == []
+        finally:
+            for dc in dcs:
+                dc.close()

@@ -106,7 +106,16 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  # full fleet scrape (merge + SLO evaluation) rising
                  # means federation stopped being a background-cheap
                  # read of already-maintained surfaces
-                 "us/scrape"}
+                 "us/scrape",
+                 # interest routing (ISSUE 18): delivered bytes per
+                 # txn under quarter subscriptions rising means the
+                 # per-interest-class slicing stopped eliding
+                 # unsubscribed traffic; slices cut per frame on a
+                 # spec-less cluster must stay at its ZERO baseline
+                 # (the inf structural-regression rule above) — must
+                 # be an exact entry because the "/frame" suffix is
+                 # higher-better (txns/frame, ISSUE 6)
+                 "interest b/txn", "slices/frame"}
 
 
 def repo_root() -> str:
