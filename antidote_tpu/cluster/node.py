@@ -844,6 +844,30 @@ class NodeServer:
             if not pm.log.enabled or pm.log.ckpt is None:
                 return None
             return pm.log.ckpt.ship_bundle()
+        if kind == "ckpt_manifest":
+            # streamed transfer, first message (ISSUE 19): manifest
+            # bytes + the ordered segment list the receiver's cursor
+            # walks.  None when the slot has no (valid) checkpoint.
+            (p,) = payload
+            pm = self.node.partitions[int(p)]
+            if not isinstance(pm, PartitionManager):
+                raise RemoteCallError(f"partition {p} not local")
+            if not pm.log.enabled or pm.log.ckpt is None:
+                return None
+            return pm.log.ckpt.bundle_manifest()
+        if kind == "ckpt_segs":
+            # streamed transfer, segment batch: raw bytes per name
+            # (None for a segment compacted away since the manifest —
+            # the receiver re-pulls the fresh manifest and resumes).
+            # The batch size is the RECEIVER's window; the donor just
+            # answers what it is asked.
+            p, names = payload
+            pm = self.node.partitions[int(p)]
+            if not isinstance(pm, PartitionManager):
+                raise RemoteCallError(f"partition {p} not local")
+            if not pm.log.enabled or pm.log.ckpt is None:
+                return [None for _ in names]
+            return [pm.log.ckpt.read_segment_raw(n) for n in names]
         if kind == "handoff_begin":
             p, from_owner = payload
             return self._handoff_begin(int(p), from_owner)
@@ -1003,6 +1027,96 @@ class NodeServer:
         file was rewritten under the physical cursors."""
         return pm.log.log.truncated_base if pm.log.enabled else 0
 
+    def _pull_bundle_streamed(self, p: int, from_owner):
+        """Segment-granular checkpoint pull (ISSUE 19): manifest
+        first, then segments in batches bounded by
+        Config.ckpt_stream_window_bytes — the receiver never holds
+        more than one window of un-staged bytes in flight
+        (backpressure), every validated segment is durably staged and
+        acked in a :class:`BundleCursor`, and a torn fetch or a donor
+        kill resumes at the first un-acked segment instead of
+        refetching the whole bundle.  A donor compacting mid-stream
+        answers a segment fetch with None: the fresh manifest is
+        re-adopted (``begin`` counts the discarded progress) and the
+        walk continues.  Returns the fully-acked cursor — committed
+        by _handoff_install AFTER the log promotion — or None when
+        the donor has no checkpoint.  Raises RemoteCallError when the
+        donor predates the streamed kinds (caller falls back to the
+        one-shot bundle) or when the pull cannot converge."""
+        from antidote_tpu import stats
+        from antidote_tpu.oplog.checkpoint import (
+            BundleCursor,
+            retry_bounded,
+        )
+
+        window = int(getattr(self.node.config,
+                             "ckpt_stream_window_bytes", 4 << 20))
+        cur = BundleCursor(self.node._log_path(p) + ".ckpt")
+
+        def pull_manifest():
+            stats.registry.stream_manifest_fetches.inc()
+            return self._rpc(from_owner, "ckpt_manifest", (p,))
+
+        # the FIRST manifest pull runs unretried so a pre-upgrade
+        # donor's unknown-kind error reaches the caller immediately
+        man = pull_manifest()
+        if man is None:
+            return None
+        cur.begin(man["manifest"])
+        strikes = 0
+        while True:
+            todo = cur.pending()
+            if not todo:
+                return cur
+            batch, acc = [], 0
+            for name, _k, nb in todo:
+                if batch and acc + int(nb) > window:
+                    break
+                batch.append(name)
+                acc += int(nb)
+            raws = retry_bounded(
+                lambda names=tuple(batch): self._rpc(
+                    from_owner, "ckpt_segs", (p, list(names))),
+                attempts=5,
+                what=(f"partition {p}: segment batch pull "
+                      f"from {from_owner!r}"),
+                counter=stats.registry.ckpt_seg_pull_retries,
+                base_delay_s=0.002, exceptions=(RemoteCallError,))
+            stale = False
+            before = cur.acked_segments()
+            try:
+                for name, raw in zip(batch, raws):
+                    if raw is None:
+                        stale = True  # compacted away mid-stream
+                        break
+                    cur.offer(name, raw)
+            except ValueError as e:
+                # torn fetch: offer refused it un-acked (counted in
+                # STREAM_TORN_FETCHES) — re-pull the same batch
+                log.warning("partition %d: %s", p, e)
+                stats.registry.ckpt_seg_pull_retries.inc()
+            if stale:
+                man = retry_bounded(
+                    pull_manifest, attempts=5,
+                    what=(f"partition {p}: manifest re-pull "
+                          f"from {from_owner!r}"),
+                    counter=stats.registry.ckpt_seg_pull_retries,
+                    base_delay_s=0.002, exceptions=(RemoteCallError,))
+                if man is None:
+                    cur.discard()
+                    return None  # donor dropped its checkpoint
+                cur.begin(man["manifest"])
+            # only NON-progress rounds (torn fetch, donor compaction)
+            # count toward the abort bound — a large bundle legally
+            # takes hundreds of clean windows
+            strikes = 0 if cur.acked_segments() > before else strikes + 1
+            if strikes > 8:
+                cur.discard()
+                raise RemoteCallError(
+                    f"partition {p}: streamed checkpoint pull from "
+                    f"{from_owner!r} kept losing to torn fetches or "
+                    "donor compaction; retry the handoff")
+
     def _handoff_begin(self, p: int, from_owner):
         """Receiving side, serving phase: pull the partition's log in
         chunks from the current owner into a staged file, re-pulling
@@ -1057,42 +1171,72 @@ class NodeServer:
             # landed since the copy, so the bundle's cut is >= the
             # staged base, and the cutover's own b_base check extends
             # that guarantee to the pushed tail (the final file always
-            # contains the cut).  A pre-ISSUE-13 owner answers the
-            # fetch with an unknown-kind error: proceed without a
+            # contains the cut).  With Config.ckpt_stream (ISSUE 19)
+            # the pull is segment-granular and cursor-resumable; a
+            # donor predating the streamed kinds falls back to the
+            # one-shot bundle below.  A pre-ISSUE-13 owner answers
+            # THAT with an unknown-kind error too: proceed without a
             # bundle — the transferred log recovers by full scan
             # exactly as before (suffix-only, loudly, if truncated).
             bundle = None
-            for pull in range(3):
+            ckpt_cursor = None
+            one_shot = not getattr(self.node.config, "ckpt_stream",
+                                   True)
+            if not one_shot:
                 try:
-                    bundle = self._rpc(from_owner, "handoff_ckpt",
-                                       (p,))
-                    break
+                    ckpt_cursor = self._pull_bundle_streamed(
+                        p, from_owner)
                 except RemoteCallError as e:
                     if "unknown node RPC kind" in str(e):
-                        # pre-upgrade donor: it genuinely cannot ship
-                        log.info(
-                            "partition %d: donor %r predates "
-                            "checkpoint shipping; receiver will "
-                            "recover by full scan", p, from_owner)
-                        break
-                    if pull == 2:
-                        # a TRANSIENT failure must not silently ship
-                        # no bundle — that re-opens the truncated-
-                        # donor suffix-only hole this transfer unit
-                        # exists to close; loud, and the epoch
-                        # re-check below still gates consistency
+                        one_shot = True  # pre-ISSUE-19 donor
+                    else:
                         log.warning(
-                            "partition %d: checkpoint-bundle pull "
-                            "from %r failed 3x (%s); proceeding "
-                            "without it — a truncated donor's "
-                            "below-cut history will NOT transfer",
+                            "partition %d: streamed checkpoint pull "
+                            "from %r failed (%s); proceeding without "
+                            "a bundle — a truncated donor's below-cut "
+                            "history will NOT transfer",
                             p, from_owner, e)
+                except ValueError as e:  # torn manifest: same stance
+                    log.warning(
+                        "partition %d: streamed checkpoint pull from "
+                        "%r refused (%s); proceeding without a bundle",
+                        p, from_owner, e)
+            if one_shot:
+                for pull in range(3):
+                    try:
+                        bundle = self._rpc(from_owner, "handoff_ckpt",
+                                           (p,))
+                        break
+                    except RemoteCallError as e:
+                        if "unknown node RPC kind" in str(e):
+                            # pre-upgrade donor: it cannot ship
+                            log.info(
+                                "partition %d: donor %r predates "
+                                "checkpoint shipping; receiver will "
+                                "recover by full scan", p, from_owner)
+                            break
+                        if pull == 2:
+                            # a TRANSIENT failure must not silently
+                            # ship no bundle — that re-opens the
+                            # truncated-donor suffix-only hole this
+                            # transfer unit exists to close; loud, and
+                            # the epoch re-check below still gates
+                            # consistency
+                            log.warning(
+                                "partition %d: checkpoint-bundle pull "
+                                "from %r failed 3x (%s); proceeding "
+                                "without it — a truncated donor's "
+                                "below-cut history will NOT transfer",
+                                p, from_owner, e)
             ans = self._rpc(from_owner, "handoff_fetch",
                             (p, cursor, 0))
             b_now = int(ans[2]) if len(ans) == 3 else 0
             if b_now != int(base or 0):
+                if ckpt_cursor is not None:
+                    ckpt_cursor.discard()
                 continue  # truncated since the copy: re-stage
             ent["ckpt_bundle"] = bundle
+            ent["ckpt_cursor"] = ckpt_cursor
             return cursor, int(base or 0)
         raise RemoteCallError(
             f"partition {p}: log kept truncating under the handoff "
@@ -1153,8 +1297,18 @@ class NodeServer:
                 install_shipped_bundle,
             )
 
-            install_shipped_bundle(self.node._log_path(p) + ".ckpt",
-                                   ent.pop("ckpt_bundle", None))
+            ckpt_cursor = ent.pop("ckpt_cursor", None)
+            if ckpt_cursor is not None:
+                # streamed pull (ISSUE 19): every segment is already
+                # validated + durably staged; commit retires the stale
+                # local checkpoint and publishes via the same
+                # segments-then-manifest rename discipline
+                ckpt_cursor.commit()
+                ent.pop("ckpt_bundle", None)
+            else:
+                install_shipped_bundle(
+                    self.node._log_path(p) + ".ckpt",
+                    ent.pop("ckpt_bundle", None))
             self.node.ring[p] = self.node_id
             self.node.adopt_partition(p)
             prev = self.plane.get_stable_snapshot() if self.plane \

@@ -90,9 +90,12 @@ class Config:
     #: ship/ack watermark — so connected peers' gap repair keeps
     #: answering from the log; a peer beyond the floor gets the
     #: explicit BELOW_FLOOR answer and bootstraps from the checkpoint
-    #: (interdc/query.py, interdc/sub_buf.py).  NOTE: ring resizes
-    #: fold FULL log histories and refuse to run over a truncated log
-    #: — disable this knob for deployments that resize in place.
+    #: (interdc/query.py, interdc/sub_buf.py).  NOTE: with
+    #: resize_from_ckpt on (the default) ring resizes fold from
+    #: checkpoint seeds + suffix replay and accept a truncated log;
+    #: only a deployment that BOTH truncates and forces the legacy
+    #: full-history fold (resize_from_ckpt=False) must disable this
+    #: knob before resizing in place.
     ckpt_truncate: bool = True
     #: opid safety margin kept below the peers' ship watermark when
     #: truncating: ordinary gap repair (lost frames) stays served from
@@ -118,6 +121,34 @@ class Config:
     #: caller-elected on the checkpointing thread — no background
     #: thread, the mat/serve.py discipline)
     ckpt_seg_waste_frac: float = 0.5
+    #: mmap-backed segment loads (ISSUE 19): manifest merges CRC and
+    #: decode each seed segment through a read-only page-cache mapping
+    #: instead of a full heap read(), so loading a merged seed set
+    #: larger than RAM never materializes more than one segment body
+    #: at a time.  False keeps the PR-12 read() path bit-for-bit.
+    ckpt_mmap: bool = True
+    #: checkpoint-seeded ring resizes (ISSUE 19): repartition /
+    #: resize_cluster fold each slot from the adopted checkpoint's
+    #: seeds + the retained log suffix — O(delta) per moved slot — and
+    #: accept truncated logs (the below-cut history rides in the
+    #: re-cut per-slot checkpoints, installed at the resize journal's
+    #: commit point).  A partition with no adopted checkpoint folds
+    #: its full history exactly as before.  False forces the legacy
+    #: full-history fold bit-for-bit (the bench baseline), including
+    #: the PR-9 truncated-log refusal.
+    resize_from_ckpt: bool = True
+    #: segment-granular checkpoint transfer (ISSUE 19): the handoff
+    #: bundle pull and the CKPT_READ bootstrap fetch the manifest
+    #: first, then segments through a resumable cursor — per-segment
+    #: ack watermark, torn fetches refused and re-pulled, exact resume
+    #: after a donor kill — instead of one whole-bundle message.
+    #: False keeps the one-shot ship/answer path bit-for-bit (the
+    #: bench baseline).
+    ckpt_stream: bool = True
+    #: in-flight byte budget per streamed transfer: a fetch round asks
+    #: for whole segments up to this many bytes (at least one), the
+    #: backpressure bound on donor reads and receiver staging memory
+    ckpt_stream_window_bytes: int = 4 * 1024 * 1024
     #: number of partitions per node (reference ring size, default 16 prod
     #: / 4 in tests, config/vars.config:5)
     n_partitions: int = 4

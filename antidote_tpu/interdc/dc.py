@@ -16,6 +16,7 @@ processes (SURVEY §2.7, §7).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import threading
@@ -50,6 +51,12 @@ from antidote_tpu.txn.node import Node
 
 
 class DataCenter(AntidoteTPU):
+    #: process-global streamed-cut identity (ISSUE 19): never reused
+    #: within a process, so a receiver's stale cursor can never match
+    #: a NEWER cut's pages by bid coincidence (a restarted server's
+    #: empty cache already answers None — a miss, not a collision)
+    _ckpt_bid = itertools.count(1)
+
     def __init__(self, dc_id, bus: Transport, config: Optional[Config] = None,
                  data_dir: Optional[str] = None):
         self.bus = bus
@@ -207,6 +214,13 @@ class DataCenter(AntidoteTPU):
         node = self.node
         dc_id = node.dc_id
         n = node.config.n_partitions
+        # streamed CKPT_READ state (ISSUE 19): served cut pages keyed
+        # (requester, partition) — latest bid only — and the client's
+        # resumable pull cursors.  Both describe the CURRENT ring, so
+        # a repartition rebuild drops them (a receiver quoting a
+        # pre-resize bid gets None per page and restarts cleanly)
+        self._ckpt_serve_cache = {}
+        self._ckpt_pull_state = {}
         # a rebuild (repartition) replaces the senders: stop the old
         # ship workers first so staged txns flush at the old width
         for s in getattr(self, "senders", []):
@@ -287,16 +301,42 @@ class DataCenter(AntidoteTPU):
         # sub_bufs before connected_dcs: the subscription is live, and a
         # frame passing the connected-guard must find its buffer
         for p in range(self.node.config.n_partitions):
+            # crash recovery: resume the stream where the local log
+            # left off (reference src/inter_dc_sub_buf.erl:58-76)
+            last = self.node.partitions[p].log.op_counters.get(
+                desc.dc_id, 0)
+            if self.node.partitions[p].log.renumbered:
+                # checkpoint-seeded resize (ISSUE 19): the re-cut log's
+                # per-origin counter is a LOCAL max-join over the old
+                # slots, while a peer that also resized renumbered its
+                # per-partition stream by its OWN join — the two no
+                # longer describe the same chain, so resuming from the
+                # local counter would mis-align gap repair (and lazy
+                # LOG_READ repair into renumbered history is fenced to
+                # BELOW_FLOOR anyway).  Re-handshake PROACTIVELY: a
+                # fresh checkpoint cut from the origin seeds VC-gated
+                # merge bases (idempotent against anything already
+                # applied) and hands back the watermark in the
+                # origin's CURRENT numbering.
+                tracer.instant("renumbered_bootstrap", "interdc",
+                               origin=str(desc.dc_id), partition=p)
+                wm = self._bootstrap_from_ckpt(desc.dc_id, p)
+                if wm is not None:
+                    last = wm
+                else:
+                    logging.getLogger(__name__).warning(
+                        "partition %d is renumbered (seeded resize) "
+                        "but origin %r is unreachable or not "
+                        "checkpointing — resuming its stream from the "
+                        "local counter; gap repair may escalate to a "
+                        "checkpoint bootstrap", p, desc.dc_id)
             self.sub_bufs[(desc.dc_id, p)] = SubBuf(
                 desc.dc_id, p,
                 deliver=self._make_gate_deliver(p),
                 deliver_batch=self._make_gate_deliver_batch(p),
                 fetch_range=self._fetch_range,
                 bootstrap=self._bootstrap_from_ckpt,
-                # crash recovery: resume the stream where the local log
-                # left off (reference src/inter_dc_sub_buf.erl:58-76)
-                last_opid=self.node.partitions[p].log.op_counters.get(
-                    desc.dc_id, 0),
+                last_opid=last,
                 filtered=self.interest is not None)
         if self.interest is not None:
             # partial-subscription qualifier (ISSUE 18): surfaced in
@@ -622,10 +662,45 @@ class DataCenter(AntidoteTPU):
         VC-gated merge bases, PartitionManager.bootstrap_seed), seed
         the dependency gate's clock with the cut frontier, and return
         the origin's commit watermark at the cut for the SubBuf to
-        jump to.  None = unreachable / origin does not checkpoint."""
+        jump to.  None = unreachable / origin does not checkpoint.
+
+        With Config.ckpt_stream (ISSUE 19) the cut arrives as a
+        manifest + validated pages under a bounded in-flight window,
+        and an origin kill mid-pull resumes at the first un-acked page
+        on the retry (the cursor state lives per (origin, partition)).
+        An origin predating the streamed kinds falls back to the
+        one-shot CKPT_READ."""
+        ranges = (None if self.interest is None
+                  else self.interest.ranges)
+        if getattr(self.node.config, "ckpt_stream", True):
+            state = self._ckpt_pull_state.setdefault(
+                (origin_dc, partition), {})
+            try:
+                ans = idc_query.fetch_ckpt_bootstrap_streamed(
+                    self.bus, self.node.dc_id, origin_dc, partition,
+                    ranges=ranges,
+                    window_bytes=int(getattr(
+                        self.node.config, "ckpt_stream_window_bytes",
+                        4 << 20)),
+                    state=state)
+            except Exception as e:  # noqa: BLE001 — version fallback
+                # an origin without the streamed kinds errors the
+                # manifest request (transport-dependent exception
+                # type); the one-shot path below serves it instead
+                logging.getLogger(__name__).info(
+                    "streamed ckpt bootstrap of (%r, %d) unavailable "
+                    "(%s); falling back to one-shot CKPT_READ",
+                    origin_dc, partition, e)
+            else:
+                if ans is None:
+                    return None
+                return idc_query.install_ckpt_bootstrap(
+                    self.node.partitions[partition],
+                    self.dep_gates[partition],
+                    origin_dc, partition, ans)
         ans = idc_query.fetch_ckpt_bootstrap(
             self.bus, self.node.dc_id, origin_dc, partition,
-            ranges=None if self.interest is None else self.interest.ranges)
+            ranges=ranges)
         if ans is None:
             return None
         return idc_query.install_ckpt_bootstrap(
@@ -673,6 +748,27 @@ class DataCenter(AntidoteTPU):
             return idc_query.answer_ckpt_read(
                 self.node.partitions[partition], self.node.dc_id,
                 partition, ranges=ranges)
+        if kind == idc_query.CKPT_MANIFEST:
+            partition, ranges, page_bytes = payload
+            tracer.instant("interdc_ckpt_manifest", "interdc",
+                           origin=str(from_dc), partition=partition)
+            man, pages = idc_query.answer_ckpt_manifest(
+                self.node.partitions[partition], self.node.dc_id,
+                partition, ranges=ranges, page_bytes=int(page_bytes),
+                bid=next(DataCenter._ckpt_bid))
+            if man is None:
+                return None
+            # only the LATEST cut per (requester, partition) stays
+            # cached: a re-pull supersedes the old bid, and a page
+            # fetch quoting it answers None (the receiver restarts)
+            self._ckpt_serve_cache[(from_dc, partition)] = (
+                man["bid"], pages)
+            return man
+        if kind == idc_query.CKPT_SEG:
+            partition, bid, names = payload
+            return idc_query.answer_ckpt_seg(
+                self._ckpt_serve_cache.get((from_dc, partition)),
+                bid, names)
         if kind == idc_query.CHECK_UP:
             return True
         if kind == idc_query.BCOUNTER_REQUEST:

@@ -27,6 +27,7 @@ gap-repair retries (interdc/sub_buf.py).
 from __future__ import annotations
 
 import logging
+import pickle
 from typing import List, Optional, Tuple
 
 from antidote_tpu.clocks import VC
@@ -43,6 +44,13 @@ BCOUNTER_REQUEST = "bcounter_request"
 CHECK_UP = "check_up"
 SNAPSHOT_READ = "snapshot_read"
 CKPT_READ = "ckpt_read"
+#: streamed CKPT_READ (ISSUE 19): the manifest message carries the cut
+#: watermarks plus an ordered page list; pages are fetched in batches
+#: bounded by the requester's window and validated per fetch, so a
+#: donor kill or torn fetch resumes at the first un-acked page instead
+#: of refetching the whole cut
+CKPT_MANIFEST = "ckpt_manifest"
+CKPT_SEG = "ckpt_seg"
 
 #: first element of a LOG_READ answer that could not be served because
 #: the range lies below the origin's retention floor
@@ -217,6 +225,179 @@ def answer_ckpt_read(pm, own_dc, partition: int,
                        if spec.matches_key(k)}
     # clocks cross administrative domains as plain dicts, like
     # SNAPSHOT_READ's (the termcodec VC form is for wire frames)
+    return ans
+
+
+def answer_ckpt_manifest(pm, own_dc, partition: int,
+                         ranges: Optional[tuple], page_bytes: int,
+                         bid: int):
+    """Server side of the streamed CKPT_READ (ISSUE 19): cut a fresh
+    checkpoint (same cut as :func:`answer_ckpt_read`) and split its
+    seed keys into CRC-framed pages of roughly ``page_bytes`` each —
+    framed exactly like on-disk bundle segments, so the receiver's
+    torn-fetch validation is shared.  Returns ``(manifest, pages)``
+    where the manifest carries the cut watermarks, ``bid`` (the cut's
+    identity — a page fetch quoting a stale bid answers None and the
+    receiver restarts), and the ordered ``(name, n_keys, n_bytes)``
+    page list; ``(None, None)`` when the partition does not
+    checkpoint.  The caller caches ``pages`` keyed by bid until the
+    next manifest request supersedes it."""
+    from antidote_tpu.oplog.checkpoint import frame_segment_bytes
+
+    ans = answer_ckpt_read(pm, own_dc, partition, ranges=ranges)
+    if ans is None:
+        return None, None
+    pages = {}
+    meta: List[Tuple[str, int, int]] = []
+    cur: dict = {}
+    cur_bytes = 0
+
+    def flush():
+        nonlocal cur, cur_bytes
+        if not cur:
+            return
+        name = f"page-{len(meta):06d}"
+        raw = frame_segment_bytes(cur)
+        pages[name] = raw
+        meta.append((name, len(cur), len(raw)))
+        cur = {}
+        cur_bytes = 0
+
+    for key, val in ans["keys"].items():
+        cur[key] = val
+        cur_bytes += len(pickle.dumps((key, val),
+                                      protocol=pickle.HIGHEST_PROTOCOL))
+        if cur_bytes >= max(1, int(page_bytes)):
+            flush()
+    flush()
+    man = {k: v for k, v in ans.items() if k != "keys"}
+    man["bid"] = int(bid)
+    man["segments"] = meta
+    return man, pages
+
+
+def answer_ckpt_seg(cache_entry, bid: int, names) -> List:
+    """Server side of a streamed page fetch: raw framed bytes per
+    requested name, or None per name when the quoted cut is no longer
+    cached (superseded by a newer manifest, or the server restarted) —
+    the receiver re-pulls the manifest and restarts its cursor."""
+    if cache_entry is None or cache_entry[0] != bid:
+        return [None for _ in names]
+    return [cache_entry[1].get(n) for n in names]
+
+
+def fetch_ckpt_bootstrap_streamed(transport: Transport, own_dc,
+                                  origin_dc, partition: int,
+                                  ranges: Optional[tuple],
+                                  window_bytes: int,
+                                  state: dict) -> Optional[dict]:
+    """Streamed CKPT_READ client (ISSUE 19): pull the manifest, then
+    pages in batches bounded by ``window_bytes`` (the in-flight byte
+    cap — backpressure against a huge cut), validating every fetch;
+    the per-page ack watermark lives in ``state`` (caller-owned, keyed
+    per (origin, partition)), so an origin kill or a torn fetch
+    resumes at the first un-acked page on the next call instead of
+    refetching the cut.  A bid change on re-pull (the origin re-cut or
+    restarted) restarts the cursor, counted in STREAM_RESTARTS /
+    STREAM_RESUME_REFETCH_BYTES.  Returns the assembled answer in the
+    exact :func:`fetch_ckpt_bootstrap` shape, or None when the origin
+    is unreachable (state preserved — the next call resumes) or does
+    not checkpoint (state cleared).  An origin that predates the
+    streamed kinds raises — the caller falls back to the one-shot
+    CKPT_READ."""
+    from antidote_tpu import stats
+    from antidote_tpu.oplog.checkpoint import _parse_segment_bytes
+
+    def _manifest():
+        stats.registry.stream_manifest_fetches.inc()
+        return transport.request(
+            own_dc, origin_dc, CKPT_MANIFEST,
+            (partition, None if ranges is None else tuple(ranges),
+             max(1, int(window_bytes) // 4)))
+
+    def _adopt(man):
+        state.clear()
+        state["bid"] = man["bid"]
+        state["segments"] = [tuple(s) for s in man["segments"]]
+        state["fields"] = {k: v for k, v in man.items()
+                           if k not in ("bid", "segments")}
+        state["pages"] = {}
+
+    try:
+        if "bid" not in state:
+            man = _manifest()
+            if man is None:
+                state.clear()
+                return None  # origin does not checkpoint
+            _adopt(man)
+        strikes = 0
+        while True:
+            todo = [m for m in state["segments"]
+                    if m[0] not in state["pages"]]
+            if not todo:
+                break
+            batch, acc = [], 0
+            for name, _k, nb in todo:
+                if batch and acc + int(nb) > int(window_bytes):
+                    break
+                batch.append(name)
+                acc += int(nb)
+            raws = transport.request(
+                own_dc, origin_dc, CKPT_SEG,
+                (partition, state["bid"], list(batch)))
+            progressed = False
+            stale = False
+            for name, raw in zip(batch, raws):
+                if raw is None:
+                    stale = True  # cut superseded / origin restarted
+                    break
+                entries = _parse_segment_bytes(raw)
+                if entries is None:
+                    stats.registry.stream_torn_fetches.inc()
+                    log.warning(
+                        "torn ckpt-stream page %r of partition %d "
+                        "from %r — re-pulling; resume at the last "
+                        "acked page", name, partition, origin_dc)
+                    break
+                state["pages"][name] = entries
+                stats.registry.stream_seg_fetches.inc()
+                stats.registry.stream_seg_bytes.inc(len(raw))
+                progressed = True
+            if stale:
+                man = _manifest()
+                if man is None:
+                    state.clear()
+                    return None  # origin dropped its checkpoint
+                if man["bid"] != state["bid"]:
+                    # acked progress is against a dead cut: discard
+                    # it, loudly counted
+                    refetch = sum(int(b) for n, _k, b
+                                  in state["segments"]
+                                  if n in state["pages"])
+                    stats.registry.stream_resume_refetch_bytes.inc(
+                        refetch)
+                    stats.registry.stream_restarts.inc()
+                    _adopt(man)
+            strikes = 0 if progressed else strikes + 1
+            if strikes > 8:
+                state.clear()
+                log.warning(
+                    "streamed ckpt bootstrap of partition %d from %r "
+                    "kept losing to torn fetches or re-cuts — giving "
+                    "up this round (the requester retries)",
+                    partition, origin_dc)
+                return None
+    except LinkDown:
+        # state preserved: the next call resumes at the first
+        # un-acked page against the same cached cut (the exact-resume
+        # contract; a donor restart answers None and restarts cleanly)
+        return None
+    keys: dict = {}
+    for name, _k, _b in state["segments"]:
+        keys.update(state["pages"][name])
+    ans = dict(state["fields"])
+    ans["keys"] = keys
+    state.clear()
     return ans
 
 

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import select
 import socket
 import struct
 import threading
@@ -301,10 +302,16 @@ class TcpTransport(Transport):
         self._subscribers: List[_SubSender] = []
         #: this endpoint's own interest spec (ISSUE 18) — announced in
         #: the subscribe-side hello; None = full stream.  Read fresh at
-        #: every (re)dial, so a widened spec takes effect on reconnect
-        #: (docs/interest_routing.md §3 — the in-proc bus re-announces
-        #: immediately; TCP converges at the next resubscribe)
+        #: every (re)dial, AND re-announced immediately on every live
+        #: sub connection when it changes (ISSUE 19): a widened
+        #: interest takes effect at the publisher without waiting for
+        #: a reconnect, matching the in-proc bus's immediacy
+        #: (docs/interest_routing.md §3)
         self._local_interest = None
+        #: serializes re-hello sends across live sub sockets (sendall
+        #: must not run under self._lock, and two concurrent
+        #: set_local_interest calls must not interleave frames)
+        self._rehello_lock = threading.Lock()
         #: target dc_id -> (addr, persistent request socket or None)
         self._peers: Dict[Any, Dict[str, Any]] = {}
         self._lock = threading.RLock()
@@ -472,9 +479,57 @@ class TcpTransport(Transport):
             # drop-on-slow PUB semantics
             conn.settimeout(self.connect_timeout)
             with self._lock:
-                self._subscribers.append(_SubSender(
+                sender = _SubSender(
                     conn, str(peer), self._drop_subscriber,
-                    framed=self._staged, interest_spec=spec))
+                    framed=self._staged, interest_spec=spec)
+                self._subscribers.append(sender)
+            # live re-SUBSCRIBE (ISSUE 19): the peer may re-send its
+            # hello on this same connection when its interest changes;
+            # a per-subscriber reader adopts the new spec immediately
+            self._spawn(self._rehello_loop, sender,
+                        name=f"antidote-fab-rehello-{peer}")
+
+    def _rehello_loop(self, sender: "_SubSender") -> None:
+        """Read re-sent hellos from one live subscriber connection and
+        adopt the new interest spec immediately (ISSUE 19) — the very
+        next published frame is sliced for the widened interest,
+        parity with the in-proc bus's immediate set_local_interest
+        (pre-ISSUE-19 TCP converged only at the next reconnect).  A
+        malformed re-hello drops the subscriber LOUDLY, exactly like a
+        malformed first hello; a pre-upgrade subscriber never writes,
+        so this reader just idles on select."""
+        conn = sender.conn
+        while not self._stop.is_set() and not sender._dead:
+            try:
+                ready, _, _ = select.select([conn], [], [], 0.25)
+            except (OSError, ValueError):
+                return  # connection closed under us
+            if not ready:
+                continue
+            try:
+                frame = _recv_frame(conn)
+            except (OSError, ValueError):
+                return
+            if frame is None:
+                return  # peer hung up; the send worker cleans up
+            try:
+                _peer, new_spec = parse_hello(termcodec.decode(frame))
+            except (InterestError, ValueError) as e:
+                log.error("pub: dropping subscriber %r after a "
+                          "malformed re-hello: %s", sender.label, e)
+                sender._die()
+                return
+            with self._lock:
+                sender.interest_spec = new_spec
+            if new_spec is not None:
+                stats.registry.interest_peer_ranges.set(
+                    len(new_spec.ranges), peer=sender.label)
+            else:
+                stats.registry.interest_peer_ranges.remove(
+                    peer=sender.label)
+            log.debug("pub: subscriber %r re-announced interest=%s",
+                      sender.label,
+                      new_spec.ranges if new_spec else "full")
 
     def _drop_subscriber(self, sender: "_SubSender") -> None:
         with self._lock:
@@ -500,8 +555,35 @@ class TcpTransport(Transport):
     accepts_interest = True
 
     def set_local_interest(self, dc_id, spec) -> None:
+        """Adopt the spec for future dials AND re-announce it NOW on
+        every live sub connection (ISSUE 19): the publisher's re-hello
+        reader adopts it before its next published frame, so a widened
+        interest starts filling immediately instead of at the next
+        reconnect.  A failed send closes that one connection — the
+        subscribe loop re-dials and the fresh hello carries the new
+        spec, so the announcement is never silently lost."""
         with self._lock:
             self._local_interest = spec
+            socks = [p.get("sub_sock") for p in self._peers.values()
+                     if p.get("sub_sock") is not None]
+        if self._dc_id is None or not socks:
+            return
+        payload = termcodec.encode(hello_term(self._dc_id, spec))
+        with self._rehello_lock:  # sends OUTSIDE self._lock, in order
+            for sock in socks:
+                try:
+                    # lock-ok: _rehello_lock EXISTS to order these
+                    # sends — racing widen calls must not interleave
+                    # hello frames on a live socket; it never nests
+                    # inside self._lock and guards nothing else
+                    _send_frame(sock, payload)
+                except OSError:
+                    # kick the subscribe loop into a re-dial, whose
+                    # hello re-reads the spec — non-fatal by design
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
 
     def interest_classes(self) -> Dict:
         """Distinct interest specs across live Python-mode subscribers.
@@ -774,16 +856,30 @@ class TcpTransport(Transport):
                     addr, timeout=self.connect_timeout)
                 # spec-less = the pre-upgrade plain-dc_id hello (full
                 # stream); the spec is re-read each dial so a widened
-                # interest takes effect on reconnect (ISSUE 18)
+                # interest takes effect on reconnect (ISSUE 18), and
+                # set_local_interest re-hellos the LIVE socket
+                # registered below so it also takes effect between
+                # reconnects (ISSUE 19)
                 _send_frame(sock, termcodec.encode(
                     hello_term(self._dc_id, spec)))
                 sock.settimeout(None)
+                with self._lock:
+                    live = self._peers.get(target)
+                    if live is not None:
+                        live["sub_sock"] = sock
                 backoff = 0.05
-                while not self._stop.is_set():
-                    frame = _recv_frame(sock)
-                    if frame is None:
-                        break
-                    self._inbox.put(frame)
+                try:
+                    while not self._stop.is_set():
+                        frame = _recv_frame(sock)
+                        if frame is None:
+                            break
+                        self._inbox.put(frame)
+                finally:
+                    with self._lock:
+                        live = self._peers.get(target)
+                        if live is not None \
+                                and live.get("sub_sock") is sock:
+                            live["sub_sock"] = None
                 sock.close()
             except (OSError, ValueError):
                 # ValueError = corrupt/desynced stream (oversized length

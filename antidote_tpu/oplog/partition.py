@@ -157,6 +157,24 @@ class PartitionLog:
         #: logical offset recovery's suffix scan started from (0 =
         #: full scan; >0 = checkpoint-seeded recovery engaged)
         self.suffix_start = 0
+        #: True when this log was produced by a checkpoint-SEEDED ring
+        #: resize (ISSUE 19): per-origin op numbers restarted from the
+        #: contributing checkpoints' counters instead of the dense
+        #: renumbering a full fold produces, so two DCs resizing the
+        #: same history independently may DISAGREE on stream numbering.
+        #: The inter-DC layer must re-handshake such partitions through
+        #: a checkpoint bootstrap rather than trust local counters as
+        #: subscription watermarks (interdc/dc.py observe_dc).
+        #: Persisted in the checkpoint document (capture_cut) so the
+        #: flag survives restarts until a fresh federation handshake
+        #: has re-based every stream.
+        self.renumbered = False
+        #: >0 while a live resize fold scans the suffix above the
+        #: current checkpoint cut: adopting a NEWER checkpoint must
+        #: not truncate the bytes the fold's cursor still needs
+        #: (hold_truncation / release_truncation; adopt_checkpoint
+        #: aborts the staged truncation instead of committing it)
+        self._trunc_hold = 0
         #: pending update records captured by the checkpoint cut, in
         #: offset order — the TxnAssembler prefeed for suffix replay
         self._suffix_prefeed: List[LogRecord] = []
@@ -488,7 +506,14 @@ class PartitionLog:
         an un-truncated log the caller falls back to the scan (all
         bytes still present), so a below-floor request stays exact."""
         floor = floors.get(dc, 0)
-        if first <= floor and self.log.truncated_base > 0:
+        # renumbered (checkpoint-seeded resize, ISSUE 19): the history
+        # below the floor never existed in THIS log's numbering — the
+        # file is whole (truncated_base == 0) yet the scan fallback
+        # would silently under-serve, so below-floor requests must
+        # escalate to the checkpoint bootstrap exactly as on a
+        # truncated log
+        if first <= floor and (self.log.truncated_base > 0
+                               or self.renumbered):
             raise BelowRetentionFloor(floor)
 
     def _records_in_range_scan(self, dc, first: int, last: int
@@ -655,6 +680,8 @@ class PartitionLog:
         cf, of = self._floors_at(trunc_cut)
         doc["repair_floors"] = cf
         doc["op_floors"] = of
+        if self.renumbered:
+            doc["renumbered"] = True
         return doc
 
     def _floors_at(self, base: int) -> Tuple[dict, dict]:
@@ -735,7 +762,8 @@ class PartitionLog:
         inter-DC ship/ack watermark minus the ``retain_ops`` margin —
         so the persisted floors describe exactly the file the commit
         leaves behind."""
-        if self.ckpt is None or not self.ckpt.settings.truncate:
+        if self.ckpt is None or not self.ckpt.settings.truncate \
+                or self._trunc_hold:
             return None
         cut = min(doc.get("trunc_cut", 0), doc["cut_offset"],
                   doc["pending_floor"])
@@ -774,7 +802,15 @@ class PartitionLog:
         recorder.record("oplog", "ckpt_write", partition=self.partition,
                         cut=doc["cut_offset"], keys=len(doc["keys"]))
         if trunc_stage is not None:
-            self._commit_truncation(doc, trunc_stage)
+            if self._trunc_hold:
+                # a live resize fold is scanning the suffix above the
+                # PREVIOUS cut (it froze the hold under this same
+                # lock): committing would reclaim bytes its cursor
+                # still needs — drop the stage; the next checkpoint
+                # retries the truncation
+                self.abort_truncation(trunc_stage)
+            else:
+                self._commit_truncation(doc, trunc_stage)
 
     def _commit_truncation(self, doc: dict, trunc_stage: dict) -> None:
         """Phase 2: redeem the staged rewrite — re-validate + bounded
@@ -895,6 +931,19 @@ class PartitionLog:
         if self.on_truncate is not None:
             self.on_truncate()
 
+    def hold_truncation(self) -> None:
+        """Pin the log's truncation base for the duration of a resize
+        fold's suffix scan (take under the partition lock, so the pin
+        and :meth:`adopt_checkpoint`'s commit decision serialize);
+        release with :meth:`release_truncation`.  While held,
+        :meth:`stage_truncation` declines and a staged truncation
+        reaching :meth:`adopt_checkpoint` aborts instead of
+        committing — checkpoints themselves keep landing."""
+        self._trunc_hold += 1
+
+    def release_truncation(self) -> None:
+        self._trunc_hold -= 1
+
     def seed_for(self, key) -> Optional[Tuple[str, Any, VC]]:
         """The checkpoint's (type_name, state, frontier VC) seed for
         ``key``, or None — what eviction migration, read-below-base
@@ -984,6 +1033,7 @@ class PartitionLog:
                 key: (tn, state, VC(vc))
                 for key, (tn, state, vc) in doc["keys"].items()}
             self.keys_seen.update(doc["keys"])
+            self.renumbered = bool(doc.get("renumbered", False))
             # cut-crossing txns: updates staged before the cut whose
             # commit lands in the suffix — prefeed the assembler state
             # exactly as the live run had it at the cut

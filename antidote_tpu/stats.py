@@ -799,6 +799,66 @@ class Registry:
             "filter attached — interest widening converges through "
             "these (docs/interest_routing.md §3)")
 
+        # ---- elastic keyspace (ISSUE 19, docs/resharding.md):
+        # checkpoint-seeded resizes + streamed segment bootstrap
+        self.ckpt_seg_ship_retries = Counter(
+            "antidote_ckpt_seg_ship_retries_total",
+            "Donor-side bundle reads retried past a concurrent "
+            "compaction (the bounded jittered retry that used to be "
+            "a log-only warning)")
+        self.ckpt_seg_pull_retries = Counter(
+            "antidote_ckpt_seg_pull_retries_total",
+            "Handoff receiver bundle pulls retried past a transient "
+            "donor failure")
+        self.reshard_resizes = Counter(
+            "antidote_reshard_resizes_total",
+            "Ring resizes / partition splits+merges completed")
+        self.reshard_seeded_slots = Counter(
+            "antidote_reshard_seeded_slots_total",
+            "Old slots folded checkpoint-seeded (seeds + suffix "
+            "replay, O(delta)) during a resize")
+        self.reshard_full_fold_slots = Counter(
+            "antidote_reshard_full_fold_slots_total",
+            "Old slots folded from log offset 0 during a resize (no "
+            "adopted checkpoint, or resize_from_ckpt off)")
+        self.reshard_moved_keys = Counter(
+            "antidote_reshard_moved_keys_total",
+            "Checkpoint seed entries routed to new slots by resizes")
+        self.reshard_replayed_txns = Counter(
+            "antidote_reshard_replayed_txns_total",
+            "Suffix transactions replayed into staged logs by "
+            "resizes — the O(delta) term a seeded fold pays instead "
+            "of full history")
+        self.reshard_duration = Histogram(
+            "antidote_reshard_fold_seconds",
+            "Wall seconds of one resize fold+swap",
+            buckets=(.01, .05, .1, .5, 1, 5, 30, 120))
+        self.stream_manifest_fetches = Counter(
+            "antidote_stream_manifest_fetches_total",
+            "Bundle manifests fetched by streamed transfers (handoff "
+            "pulls + CKPT_READ bootstraps)")
+        self.stream_seg_fetches = Counter(
+            "antidote_stream_seg_fetches_total",
+            "Segments fetched, validated, and acked by streamed "
+            "transfers")
+        self.stream_seg_bytes = Counter(
+            "antidote_stream_seg_bytes_total",
+            "Segment bytes fetched and acked by streamed transfers")
+        self.stream_torn_fetches = Counter(
+            "antidote_stream_torn_fetches_total",
+            "Segment fetches refused at the cursor (torn/short/CRC "
+            "mismatch) — each one resumed at the last acked segment")
+        self.stream_restarts = Counter(
+            "antidote_stream_restarts_total",
+            "Streamed transfers restarted because the donor's "
+            "manifest changed under the cursor (re-cut, compaction, "
+            "or a different donor after a kill)")
+        self.stream_resume_refetch_bytes = Counter(
+            "antidote_stream_resume_refetch_bytes_total",
+            "Previously acked segment bytes discarded by cursor "
+            "restarts — the numerator of the bench's "
+            "bootstrap_resume_refetch_pct")
+
         # ---- fleet health plane (ISSUE 17, obs/fleet.py + obs/slo.py)
         self.vis_probe_rtt = LabeledGauge(
             "antidote_vis_probe_rtt_seconds",
@@ -892,6 +952,13 @@ class Registry:
                 self.interest_filtered_txns,
                 self.interest_filtered_bytes,
                 self.interest_backfills,
+                self.ckpt_seg_ship_retries, self.ckpt_seg_pull_retries,
+                self.reshard_resizes, self.reshard_seeded_slots,
+                self.reshard_full_fold_slots, self.reshard_moved_keys,
+                self.reshard_replayed_txns, self.reshard_duration,
+                self.stream_manifest_fetches, self.stream_seg_fetches,
+                self.stream_seg_bytes, self.stream_torn_fetches,
+                self.stream_restarts, self.stream_resume_refetch_bytes,
                 self.vis_probe_rtt,
                 self.fleet_scrape_age, self.fleet_sources,
                 self.fleet_scrape_errors,

@@ -22,7 +22,7 @@ from antidote_tpu.config import Config
 from antidote_tpu.hooks import HookRegistry
 from antidote_tpu.oplog.log import _fsync_dir
 from antidote_tpu.oplog.partition import PartitionLog
-from antidote_tpu.oplog.records import commit_certified
+from antidote_tpu.oplog.records import LogRecord, commit_certified
 from antidote_tpu.txn.clock import HybridClock
 from antidote_tpu.txn.coordinator import Coordinator
 from antidote_tpu.txn.manager import PartitionManager
@@ -158,9 +158,19 @@ class LiveFold:
     FIRST commit copy in wall order (stage -> prepare -> commit), so
     any commit seen by pass k has all its updates below pass k+1's
     cursors — groups emit one pass after their commit is first seen,
-    and the quiesced final pass emits the rest."""
+    and the quiesced final pass emits the rest.
 
-    def __init__(self, parts, new_logs, route):
+    ISSUE 19 (checkpoint-seeded fold): a source partition carrying a
+    checkpoint starts its cursor AT THE CUT instead of 0 — the
+    below-cut history rides as routed seed states in the staged re-cut
+    checkpoints (Node.build_resize_fold), and ``prefeed`` injects the
+    cut-crossing pending update records so suffix commits reassemble.
+    ``post_fold`` runs inside final_pass after the staged logs close
+    (where the re-cut checkpoints stage); ``on_done`` runs exactly
+    once on final_pass OR discard (truncation-hold release)."""
+
+    def __init__(self, parts, new_logs, route, cursors=None,
+                 prefeed=None, post_fold=None, on_done=None):
         #: [(global index, PartitionManager)] — the logs folded FROM
         self.parts = list(parts)
         #: {global new index: PartitionLog} — the staged logs folded TO
@@ -168,10 +178,25 @@ class LiveFold:
         #: key -> global new partition index
         self.route = route
         self.cursors = {p: 0 for p, _pm in self.parts}
+        if cursors:
+            self.cursors.update(cursors)
         self._updates: dict = {}   # txid -> [update records]
         self._commits: dict = {}   # txid -> commit record (first wins)
         self._ready: list = []     # commit order, not yet emitted
         self._emitted: set = set()
+        for rec in (prefeed or ()):
+            # cut-crossing pending updates: staged below a seeded
+            # source's cut, commit lands in the suffix the cursors scan
+            if rec.kind() == "update":
+                self._updates.setdefault(rec.txid, []).append(rec)
+        self.post_fold = post_fold
+        self.on_done = on_done
+        self._done = False
+
+    def _release(self) -> None:
+        if not self._done and self.on_done is not None:
+            self.on_done()
+        self._done = True
 
     def scan_pass(self) -> int:
         """One cursor pass over every live log; returns the number of
@@ -238,6 +263,9 @@ class LiveFold:
         self._ready = []
         for lg in self.new_logs.values():
             lg.close()
+        if self.post_fold is not None:
+            self.post_fold(self)
+        self._release()
 
     def discard(self) -> None:
         """Abort-before-swap: close and DELETE the staged child logs.
@@ -253,6 +281,7 @@ class LiveFold:
             except OSError:
                 pass
         self.new_logs.clear()
+        self._release()
 
 
 class Node:
@@ -388,7 +417,17 @@ class Node:
         resize-rejoin case in tests/multidc/test_elasticity.py).
         Materializer state (host + device planes) is rebuilt by the
         standard recovery replay — handoff IS recovery from a
-        redistributed log."""
+        redistributed log.
+
+        ISSUE 19: partitions carrying a checkpoint fold SEEDED instead
+        (seeds route to the new slots, only the suffix past the cut
+        replays — O(delta), truncated logs accepted); their streams
+        renumber from the checkpoint bases and the new slots are marked
+        ``renumbered``, which the inter-DC layer re-bases through a
+        checkpoint bootstrap at the next federation handshake.  The
+        fold itself is the shared LiveFold machinery — on a quiesced
+        node the single final pass IS the whole fold, emitting exactly
+        the record sequence the pre-ISSUE-19 in-line fold wrote."""
         if new_n < 1:
             raise ValueError(f"new_n must be >= 1, got {new_n}")
         old_parts = self.partitions
@@ -405,57 +444,9 @@ class Node:
             raise RuntimeError(
                 "repartition folds the durable logs; enable_logging=False "
                 "leaves nothing to redistribute")
-        self._refuse_truncated_resize()
 
-        # 1. reassemble committed txn groups across ALL old logs (the
-        #    whole history fits one host pass; resizes are rare)
-        updates: dict = {}   # txid -> [update records]
-        commits: dict = {}   # txid -> commit record (first copy wins)
-        commit_order: list = []
-        for pm in old_parts:
-            for rec in pm.log.records():
-                kind = rec.kind()
-                if kind == "update":
-                    updates.setdefault(rec.txid, []).append(rec)
-                elif kind == "commit" and rec.txid not in commits:
-                    commits[rec.txid] = rec
-                    commit_order.append(rec.txid)
-                # prepares of committed txns are implied; dangling
-                # prepares/aborted txns do not survive the resize
-
-        # 2. replay each group once into fresh per-partition logs
-        #    (staged files never fsync per commit: they are discarded on
-        #    any crash before the journaled swap below)
-        resize_paths = [self._log_path(p) + ".resize"
-                        for p in range(new_n)]
-        for path in resize_paths:
-            if os.path.exists(path):
-                # dur-ok: stale strays from a resize attempt that died
-                # before its journal landed — garbage with no
-                # successor, not files this run's commit supersedes
-                os.remove(path)
-        new_logs = [
-            PartitionLog(path, partition=p, sync_on_commit=False,
-                         enabled=self.config.enable_logging)
-            for p, path in enumerate(resize_paths)
-        ]
-        for txid in commit_order:
-            rec = commits[txid]
-            dests: dict = {}
-            for u in updates.get(txid, ()):
-                dest = self.partition_index(u.payload[1], new_n)
-                dests.setdefault(dest, []).append(u)
-            (dc, ct) = rec.payload[1]
-            svc = rec.payload[2]
-            cert = commit_certified(rec.payload)
-            for p, ups in dests.items():
-                lg = new_logs[p]
-                for u in ups:
-                    lg.append_update(dc, txid, u.payload[1],
-                                     u.payload[2], u.payload[3])
-                lg.append_commit(dc, txid, ct, svc, certified=cert)
-        for lg in new_logs:
-            lg.close()
+        fold = self.build_resize_fold(new_n)
+        fold.final_pass()
 
         # 3. journaled swap: the per-file renames are not atomic as a
         #    group, so a journal marks the transition — a crash mid-swap
@@ -493,37 +484,96 @@ class Node:
         build_resize_fold and _complete_resize_swap) has ONE owner."""
         import glob as _glob
 
-        for f in _glob.glob(os.path.join(self.data_dir, "*.resize")):
+        for f in (_glob.glob(os.path.join(self.data_dir, "*.resize"))
+                  + _glob.glob(os.path.join(self.data_dir,
+                                            "*.resize.seg-*"))):
             try:
                 os.remove(f)
             except OSError:
                 pass
 
     def _refuse_truncated_resize(self) -> None:
-        """Ring resizes fold FULL log histories into re-cut logs; a
-        checkpoint-truncated log has reclaimed its below-cut records,
-        so the fold would silently lose them — refuse loudly instead
-        (Config.ckpt_truncate=False for deployments that resize in
-        place; noted in ROADMAP)."""
-        for pm in self._local_partitions():
-            if isinstance(pm, PartitionManager) and pm.log.enabled \
-                    and pm.log.log.truncated_base > 0:
+        """Legacy guard name (PR 9) kept for its callers/tests: now
+        delegates to the fold-source decision — a truncated log only
+        refuses when the checkpoint-seeded path (ISSUE 19) cannot
+        serve it."""
+        self._fold_sources()
+
+    def _fold_sources(self) -> dict:
+        """Per local old partition index: the checkpoint document a
+        SEEDED fold starts from, or None for the legacy full-history
+        fold from offset 0 (ISSUE 19).  The seeded path engages
+        whenever the partition carries a live checkpoint and
+        ``Config.resize_from_ckpt`` allows it — which is also what
+        makes a TRUNCATED log resizable: its reclaimed prefix lives in
+        the seeds.  A truncated partition with no usable checkpoint
+        refuses loudly (the pre-ISSUE-19 behavior): a full-history
+        fold would silently lose the reclaimed records."""
+        seeded_ok = getattr(self.config, "resize_from_ckpt", True)
+        out: dict = {}
+        for p, pm in enumerate(self.partitions):
+            if not isinstance(pm, PartitionManager) \
+                    or not pm.log.enabled:
+                continue
+            doc = pm.log.ckpt_doc \
+                if (seeded_ok and pm.log.ckpt is not None) else None
+            if doc is None and pm.log.log.truncated_base > 0:
                 raise RuntimeError(
                     f"partition {pm.partition}'s log is truncated "
-                    "below its checkpoint cut; a resize fold would "
-                    "lose the reclaimed history — disable "
-                    "Config.ckpt_truncate for resizable deployments")
+                    "below its checkpoint cut and no checkpoint-"
+                    "seeded fold is available (Config.resize_from_"
+                    "ckpt off, or the checkpoint is missing/torn); "
+                    "a full-history fold would lose the reclaimed "
+                    "records — refusing the resize")
+            out[p] = doc
+        return out
 
     def build_resize_fold(self, new_n: int, own_slot=None) -> LiveFold:
         """LiveFold from this process's partitions toward width
         ``new_n``.  ``own_slot(q) -> bool`` restricts the staged logs
         to the slots this process will own — a single-process node
         stages all of them; ClusterNode passes its ring-slice filter
-        (cluster/node.py).  Refuses truncated logs like repartition —
-        the fold scans full histories."""
-        self._refuse_truncated_resize()
+        (cluster/node.py).
+
+        ISSUE 19 — the seeded/legacy routing's ONE home: partitions
+        with a checkpoint fold from its seeds + suffix (cursor starts
+        at the cut, truncated logs accepted); the rest fold the full
+        history bit-for-bit.  When any source folds seeded, the fold's
+        final pass also stages one re-cut checkpoint per staged slot
+        (seeds routed by the new ring, counters/floors at the joined
+        checkpoint base, ``renumbered`` set) — nothing is live until
+        the resize journal commits and _complete_resize_swap renames
+        the staged manifest in, so a crash mid-resize leaves the old
+        ring's checkpoints authoritative."""
+        from antidote_tpu.oplog.checkpoint import (
+            ckpt_from_config,
+            discard_staged_resize_checkpoint,
+            empty_doc,
+            stage_resize_checkpoint,
+        )
+
         parts = [(p, pm) for p, pm in enumerate(self.partitions)
                  if isinstance(pm, PartitionManager)]
+        by_p = dict(parts)
+        held: list = []
+        # pin EVERY source's log before classifying seeded/full: an
+        # auto-checkpoint adopted mid-fold (live resizes serve while
+        # folding) must not truncate records a cursor has not scanned
+        # yet — for a FULL-fold source the reclaimed prefix lives only
+        # in a checkpoint this fold ignores and the swap deletes, so
+        # an unheld mid-fold cut is silent data loss.  Held for the
+        # fold's whole life; released via on_done (final_pass OR
+        # discard, whichever happens)
+        for _p, pm in parts:
+            with pm._lock:
+                pm.log.hold_truncation()
+                held.append(pm.log)
+        try:
+            sources = self._fold_sources()
+        except BaseException:
+            for lg in held:
+                lg.release_truncation()
+            raise
         new_logs = {}
         for q in range(new_n):
             if own_slot is not None and not own_slot(q):
@@ -531,13 +581,107 @@ class Node:
             path = self._log_path(q) + ".resize"
             if os.path.exists(path):
                 os.remove(path)
+            # a staged re-cut checkpoint from an earlier attempt that
+            # died pre-journal must not survive into this fold: the
+            # eventual swap would install it over logs it never
+            # described
+            discard_staged_resize_checkpoint(
+                self._log_path(q) + ".ckpt")
             new_logs[q] = PartitionLog(path, partition=q,
                                        sync_on_commit=False,
                                        enabled=True)
+        seeded = {p: doc for p, doc in sources.items()
+                  if doc is not None}
+        cursors: dict = {}
+        prefeed: list = []
+        base: dict = {}
+        clock: dict = {}
+        max_vc: dict = {}
+        seeds_by_slot: dict = {}
+        moved = 0
+        for p in sorted(seeded):
+            pm = by_p[p]
+            # the cut is pinned (truncation held above); re-read the
+            # doc under the partition lock so the cursor below starts
+            # at the SAME cut the seeds came from, even if a fresh
+            # checkpoint was adopted since _fold_sources looked
+            with pm._lock:
+                doc = pm.log.ckpt_doc
+            seeded[p] = doc
+            cursors[p] = doc["cut_offset"]
+            prefeed.extend(LogRecord.from_bytes(rb)
+                           for _txid, _off, rb in doc["pending"])
+        if seeded:
+            # per-origin numbering base for every staged slot: the
+            # join of the contributing cuts' op counters.  The suffix
+            # replay renumbers densely from base+1, and base itself
+            # fences the seed-covered history behind BELOW_FLOOR
+            # (re-cut repair_floors below) — a repair request under it
+            # has no bytes to answer from in the new numbering
+            for doc in seeded.values():
+                for o, n in doc["op_counters"].items():
+                    base[o] = max(base.get(o, 0), n)
+                for o, t in doc.get("clock", {}).items():
+                    clock[o] = max(clock.get(o, 0), t)
+                for o, t in doc["max_commit_vc"].items():
+                    max_vc[o] = max(max_vc.get(o, 0), t)
+            seeds_by_slot = {q: {} for q in new_logs}
+            for p, doc in seeded.items():
+                for key, entry in doc["keys"].items():
+                    q = self.partition_index(key, new_n)
+                    if q not in seeds_by_slot:
+                        raise RuntimeError(
+                            f"seed key {key!r} of partition {p} "
+                            f"routes to slot {q}, which this fold "
+                            "does not stage — sliced-fold ownership "
+                            "mismatch")
+                    seeds_by_slot[q][key] = entry
+                    moved += 1
+            for lg in new_logs.values():
+                # appended suffix records number densely from base+1
+                lg.op_counters.update(base)
+        t0 = time.perf_counter()
+
+        def release():
+            for lg in held:
+                lg.release_truncation()
+
+        def post_fold(fold: LiveFold) -> None:
+            from antidote_tpu import stats as _stats
+
+            reg = _stats.registry
+            reg.reshard_resizes.inc()
+            reg.reshard_duration.observe(time.perf_counter() - t0)
+            reg.reshard_replayed_txns.inc(len(fold._emitted))
+            reg.reshard_full_fold_slots.inc(len(sources) - len(seeded))
+            if not seeded:
+                return
+            reg.reshard_seeded_slots.inc(len(seeded))
+            reg.reshard_moved_keys.inc(moved)
+            cks = ckpt_from_config(self.config)
+            for q in fold.new_logs:
+                doc_q = empty_doc(q)
+                doc_q["op_counters"] = dict(base)
+                doc_q["max_commit_vc"] = dict(max_vc)
+                doc_q["commit_watermarks"] = dict(base)
+                doc_q["repair_floors"] = dict(base)
+                doc_q["op_floors"] = dict(base)
+                doc_q["keys"] = seeds_by_slot[q]
+                doc_q["clock"] = dict(clock)
+                # this slot's stream numbering diverged from any
+                # peer's independent fold of the same history: the
+                # inter-DC layer must re-base through a checkpoint
+                # bootstrap, never trust local counters as watermarks
+                doc_q["renumbered"] = True
+                stage_resize_checkpoint(
+                    self._log_path(q) + ".ckpt", doc_q, cks)
+
         # a key routed outside new_logs KeyErrors in the emit — a
         # correctness assert for sliced folds, not a silent drop
         return LiveFold(parts, new_logs,
-                        lambda k: self.partition_index(k, new_n))
+                        lambda k: self.partition_index(k, new_n),
+                        cursors=cursors, prefeed=prefeed,
+                        post_fold=post_fold, on_done=release)
 
     def repartition_live(self, new_n: int, max_passes: int = 6,
                          delta_threshold: int = 256) -> None:
@@ -643,11 +787,36 @@ class Node:
         # would seed old-routing state + skip the new log's prefix —
         # segments included, or the next segmented cut at this path
         # could stack fresh deltas onto pre-resize seed files
-        from antidote_tpu.oplog.checkpoint import delete_checkpoint_files
+        from antidote_tpu.oplog.checkpoint import (
+            commit_staged_resize_checkpoint,
+            delete_checkpoint_files,
+            discard_staged_resize_checkpoint,
+        )
 
         for p in range(max(new_n, old_n)):
-            delete_checkpoint_files(self._log_path(p) + ".ckpt")
+            cp = self._log_path(p) + ".ckpt"
+            # a slot with a staged re-cut checkpoint retires its old
+            # one inside commit_staged_resize_checkpoint below — the
+            # unconditional delete here would, on a crash re-run,
+            # destroy a re-cut checkpoint the previous run already
+            # committed (its seeds are the only copy of the pre-cut
+            # state; the re-cut log alone is just the suffix)
+            if not os.path.exists(cp + ".resize"):
+                delete_checkpoint_files(cp)
+        # seeded resize (ISSUE 19): each new slot's staged re-cut
+        # checkpoint links into place — idempotent (re-runs from the
+        # still-present staged files after any crash; returns False
+        # when nothing is staged), so the boot-time crash resume is
+        # safe; on a legacy fold no slot staged anything → no-op
+        for p in range(new_n):
+            commit_staged_resize_checkpoint(self._log_path(p) + ".ckpt")
         os.remove(self._resize_journal_path())
+        # past the journal removal no re-run can happen — the staged
+        # files served their purpose as the re-run marker; sweep them
+        # (a crash here just leaves strays the next resize's build
+        # discards before staging its own)
+        for p in range(new_n):
+            discard_staged_resize_checkpoint(self._log_path(p) + ".ckpt")
 
     def _resume_interrupted_resize(self) -> None:
         """Boot-time check: a journal on disk means a crash interrupted

@@ -1,0 +1,368 @@
+"""Config 17: elastic keyspace — checkpoint-seeded resize cost vs
+history depth, a live re-shard under the config6 client shape, and
+streamed segment bootstrap resuming after a donor kill.
+
+Before ISSUE 19 every ring resize re-folded every partition log from
+offset 0 — O(total history) per resize, however small the delta since
+the last checkpoint cut — and refused outright once truncation had
+dropped the folded prefix.  The seeded fold routes checkpoint seeds
+to their new slots and replays only the post-cut suffix, so resize
+cost tracks the churn delta per moved key, not history depth.  The
+streamed bootstrap planes add per-segment ack cursors: a donor kill
+mid-pull resumes at the watermark, refetching only what the restarted
+donor's fresh cut invalidated — never the whole bundle.
+
+Legs:
+
+- *seeded resize scaling*: identical churn + per-key history at two
+  keyspaces (50x apart); each leg's recovered ring state is asserted
+  bit-identical (per slot) to a full-history-fold oracle over a copy
+  of the same bytes; the big leg must stay within 1.5x of the small
+  leg per moved key (the full fold, measured in-bench on the oracle
+  copies, is the keyspace-proportional baseline);
+- *live re-shard under load*: 8 concurrent writer threads (the
+  config6 client count) commit through ``repartition_live``; zero
+  failed txns (cutover admission blocks surface as retried
+  TimeoutErrors, never losses — every counted commit is re-read
+  exactly), and the commit p99 across the resize window stays
+  bounded;
+- *donor kill*: stream a checkpoint bootstrap, kill the origin
+  mid-pull (its in-memory page cache dies with it), resume from the
+  caller-held cursor state, assert the assembled answer matches the
+  one-shot oracle; bytes refetched after the kill as a pct of all
+  segment bytes pulled stays bounded (a cursor that restarts from
+  zero pushes this toward 100).
+
+Emits the two gate-enforced quantities:
+
+- ``reshard_ms_per_moved_key``  (ms/moved key, must not rise):
+  seeded resize wall per moved slot-key at the GROWN keyspace —
+  a fold that re-reads whole logs multiplies this straight back up;
+- ``bootstrap_resume_refetch_pct``  (refetch pct, must not rise):
+  post-kill refetched bytes over total segment bytes pulled —
+  rising means the cursor stopped resuming at its ack watermark.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import statistics
+import threading
+import time
+
+from benches._util import emit, setup
+
+#: fixed churn set per leg — the post-cut suffix is identical on both
+#: keyspace legs, so only seed routing may scale with keyspace
+CHURN_KEYS = 32
+#: committed versions per key below the cut: the history the seeded
+#: fold must NOT replay (and the full-fold oracle must)
+HISTORY_ROUNDS = 3
+VAL_BYTES = 512
+
+
+def _mk_node(data_dir, seeded, n_partitions=2):
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.node import Node
+
+    cfg = Config(device_store=False, n_partitions=n_partitions,
+                 ckpt=True, ckpt_truncate=False, ckpt_ops=1 << 30,
+                 ckpt_bytes=1 << 40, resize_from_ckpt=seeded,
+                 data_dir=data_dir)
+    return Node(dc_id="dc1", config=cfg), cfg
+
+
+def _commit(node, n, key, tag="v"):
+    from antidote_tpu.clocks import VC
+
+    pm = node.partition_of(key)
+    txid = ("dc1", n)
+    val = f"{key}:{tag}:{n}:" + "x" * VAL_BYTES
+    eff = (node.clock.now_us(), ("dc1", n), val)
+    pm.stage_update(txid, key, "register_lww", eff)
+    pm.single_commit(txid, VC({"dc1": node.clock.now_us()}),
+                     certify=False)
+
+
+def _ring_state(node):
+    """Per-slot key->value maps: the bit-identical bar covers slot
+    OWNERSHIP, not just the merged global view."""
+    out = []
+    for pm in node.partitions:
+        out.append({k: pm.value_snapshot(k, "register_lww")
+                    for k in pm.log.keys_seen})
+    return out
+
+
+def _build(tmp, name, keyspace):
+    """Keyspace keys with HISTORY_ROUNDS versions each, one
+    checkpoint cut, then the CHURN_KEYS suffix; closed clean."""
+    d = os.path.join(tmp, name)
+    node, _cfg = _mk_node(d, seeded=True)
+    n = 0
+    for r in range(HISTORY_ROUNDS):
+        for i in range(keyspace):
+            _commit(node, n, f"k_{i:06d}", tag=f"r{r}")
+            n += 1
+    for pm in node.partitions:
+        assert pm.checkpoint_now() is not None
+    for i in range(CHURN_KEYS):
+        _commit(node, n, f"k_{i:06d}", tag="churn")
+        n += 1
+    node.close()
+    return d
+
+
+def _resize_leg(tmp, name, keyspace, repeats):
+    """Seeded 2->4 resize, measured, vs the full-history fold of a
+    byte-copy of the same data dir; asserts per-slot bit-equivalence
+    every round.  Returns (seeded ms/moved key, full-fold ms/moved
+    key, moved keys) — medians across ``repeats`` fresh builds."""
+    from antidote_tpu import stats
+
+    reg = stats.registry
+    seeded_ms, full_ms, moved_keys = [], [], 0
+    for r in range(repeats):
+        d = _build(tmp, f"{name}_{r}", keyspace)
+        oracle_d = d + "_oracle"
+        shutil.copytree(d, oracle_d)
+
+        node, _cfg = _mk_node(d, seeded=True)
+        moved0 = reg.reshard_moved_keys.value()
+        t0 = time.perf_counter()
+        node.repartition(4)
+        wall_s = time.perf_counter() - t0
+        moved = int(reg.reshard_moved_keys.value() - moved0)
+        state_s = _ring_state(node)
+        node.close()
+        assert moved > 0, f"{name}: seeded resize moved no keys"
+
+        onode, _cfg = _mk_node(oracle_d, seeded=False)
+        t0 = time.perf_counter()
+        onode.repartition(4)
+        wall_f = time.perf_counter() - t0
+        state_o = _ring_state(onode)
+        onode.close()
+        assert state_s == state_o, (
+            f"{name}: seeded ring state diverged from the "
+            "full-fold oracle")
+
+        # identical bytes -> identical routing: the oracle moves the
+        # same key set, so both walls normalize by the seeded count
+        seeded_ms.append(wall_s * 1e3 / moved)
+        full_ms.append(wall_f * 1e3 / moved)
+        moved_keys = moved
+        shutil.rmtree(d)
+        shutil.rmtree(oracle_d)
+    return (statistics.median(seeded_ms), statistics.median(full_ms),
+            moved_keys)
+
+
+def _live_leg(tmp, quick):
+    """8 writer threads commit counter increments through a live
+    4->8 resize: zero failed txns (admission blocks are retried,
+    every counted commit re-reads exactly), bounded commit p99."""
+    from antidote_tpu.api import AntidoteTPU
+    from antidote_tpu.clocks import vc_max
+    from antidote_tpu.config import Config
+    from antidote_tpu.txn.coordinator import TransactionAborted
+
+    db = AntidoteTPU(config=Config(
+        n_partitions=4, device_store=False,
+        data_dir=os.path.join(tmp, "live")))
+    committed = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+    errs, lat, retries, newest = [], [], [0], [None]
+
+    def writer(tid):
+        import random
+
+        rng = random.Random(tid)
+        try:
+            while not stop.is_set():
+                k = rng.randrange(64)
+                t0 = time.perf_counter()
+                try:
+                    ct = db.update_objects_static(
+                        None,
+                        [((k, "counter_pn", "b"), "increment", 1)])
+                except (TimeoutError, TransactionAborted):
+                    # cutover admission block / writer conflict: the
+                    # txn never committed — retried, never lost
+                    with lock:
+                        retries[0] += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    committed[k] = committed.get(k, 0) + 1
+                    lat.append((time.perf_counter(), dt))
+                    newest[0] = ct if newest[0] is None \
+                        else vc_max((newest[0], ct))
+        except Exception as e:  # noqa: BLE001 — asserted empty below
+            errs.append(e)
+
+    for k in range(64):
+        db.update_objects_static(
+            None, [((k, "counter_pn", "b"), "increment", 1)])
+        committed[k] = 1
+    threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2 if quick else 0.5)
+    r0 = time.perf_counter()
+    db.node.repartition_live(8)
+    r1 = time.perf_counter()
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "writer wedged across the cutover"
+    assert not errs, f"failed txns during the live re-shard: {errs}"
+    assert db.node.config.n_partitions == 8
+    # nothing lost, nothing doubled — read AT the merged commit
+    # clock (a causal read): the background stable snapshot may
+    # still trail the newest commits right after stop
+    for k, total in committed.items():
+        vals, _ = db.read_objects_static(
+            newest[0], [(k, "counter_pn", "b")])
+        assert vals[0] == total, (k, vals[0], total)
+    db.close()
+    window = [dt for (end, dt) in lat if end >= r0] or \
+        [dt for (_end, dt) in lat]
+    assert window, "no commits overlapped the live resize"
+    window.sort()
+    p99_ms = window[min(len(window) - 1,
+                        int(len(window) * 0.99))] * 1e3
+    assert p99_ms < 5000.0, (
+        f"commit p99 across the live re-shard hit {p99_ms:.0f}ms — "
+        "the cutover window is no longer bounded")
+    return p99_ms, len(lat), retries[0], (r1 - r0) * 1e3
+
+
+class _KillOnce:
+    """Transport wrapper: the Nth segment pull finds the donor dead —
+    its in-memory page cache (which dies with the process) is cleared
+    and the link drops once.  The resumed stream then sees a fresh
+    cut under a new bid, the cursor's restart path."""
+
+    def __init__(self, inner, donor_dc, kill_on):
+        from antidote_tpu.interdc import query as idc_query
+
+        self._inner = inner
+        self._donor = donor_dc
+        self._kill_on = kill_on
+        self._seg_kind = idc_query.CKPT_SEG
+        self.seg_calls = 0
+
+    def request(self, origin, target, kind, payload):
+        from antidote_tpu.interdc.transport import LinkDown
+
+        if kind == self._seg_kind:
+            self.seg_calls += 1
+            if self.seg_calls == self._kill_on:
+                self._donor._ckpt_serve_cache.clear()
+                raise LinkDown("donor killed mid-stream (bench)")
+        return self._inner.request(origin, target, kind, payload)
+
+
+def _donor_kill_leg(tmp, quick):
+    """Stream a bootstrap, kill the donor on the 3rd segment pull,
+    resume; the answer must match the one-shot oracle and the
+    refetch share must stay well under a from-zero restart."""
+    from antidote_tpu import stats
+    from antidote_tpu.config import Config
+    from antidote_tpu.interdc import InProcBus
+    from antidote_tpu.interdc import query as idc_query
+    from antidote_tpu.interdc.dc import DataCenter
+
+    reg = stats.registry
+    bus = InProcBus()
+    dc1 = DataCenter("dc1", bus, config=Config(
+        n_partitions=1, device_store=False, ckpt=True,
+        ckpt_ops=1 << 30, ckpt_bytes=1 << 40),
+        data_dir=os.path.join(tmp, "donor"))
+    try:
+        n_keys = 48 if quick else 96
+        for n in range(n_keys):
+            _commit(dc1.node, n, f"b_{n:04d}")
+        window = 8 * 1024  # small on purpose: many pages, many pulls
+        killer = _KillOnce(bus, dc1, kill_on=3)
+        bytes0 = reg.stream_seg_bytes.value()
+        refetch0 = reg.stream_resume_refetch_bytes.value()
+        state = {}
+        ans = idc_query.fetch_ckpt_bootstrap_streamed(
+            killer, "bench", "dc1", 0, None, window, state)
+        assert ans is None and state, \
+            "the donor kill did not interrupt the stream"
+        ans = idc_query.fetch_ckpt_bootstrap_streamed(
+            killer, "bench", "dc1", 0, None, window, state)
+        assert ans is not None, "resume after the donor kill failed"
+        total = reg.stream_seg_bytes.value() - bytes0
+        refetch = reg.stream_resume_refetch_bytes.value() - refetch0
+        oracle = idc_query.fetch_ckpt_bootstrap(bus, "bench", "dc1", 0)
+        assert oracle is not None
+        assert ans["keys"] == oracle["keys"], \
+            "resumed streamed answer diverged from the one-shot oracle"
+        pct = 100.0 * refetch / max(total, 1)
+        assert 0.0 < pct, (
+            "the kill forced no refetch — the donor restart was not "
+            "actually exercised")
+        assert pct < 75.0, (
+            f"{pct:.0f}% of segment bytes were refetched after the "
+            "donor kill — the cursor is restarting from zero")
+        return pct, int(total), int(refetch), killer.seg_calls
+    finally:
+        dc1.close()
+
+
+def main():
+    import tempfile
+
+    quick, _jax = setup()
+    small = 40
+    big = small * 50
+    repeats = 2 if quick else 3
+    with tempfile.TemporaryDirectory() as tmp:
+        # discarded warm-up: first-use costs (imports, allocator,
+        # cold page cache) must not land on the first measured leg
+        _resize_leg(tmp, "warmup", small, 1)
+        seeded_small, full_small, moved_small = _resize_leg(
+            tmp, "small", small, repeats)
+        seeded_big, full_big, moved_big = _resize_leg(
+            tmp, "big", big, 1)
+        # the acceptance bound: identical churn at 50x keyspace stays
+        # within 1.5x per moved key (plus a 3ms/key absolute floor
+        # for fsync jitter on shared CI boxes — the small leg moves
+        # few keys, so one slow fsync is milliseconds per key)
+        bound = seeded_small * 1.5 + 3.0
+        assert seeded_big <= bound, (
+            f"seeded resize at 50x keyspace pays "
+            f"{seeded_big:.2f}ms/moved key vs "
+            f"{seeded_small:.2f}ms/moved key — the fold is scaling "
+            "with history again")
+        p99_ms, n_commits, n_retries, cutover_ms = _live_leg(tmp,
+                                                             quick)
+        refetch_pct, total_b, refetch_b, seg_pulls = _donor_kill_leg(
+            tmp, quick)
+    emit("reshard_ms_per_moved_key", round(seeded_big, 3),
+         "ms/moved key", round(full_big / max(seeded_big, 1e-9), 2),
+         seeded_small_ms_per_key=round(seeded_small, 3),
+         full_small_ms_per_key=round(full_small, 3),
+         full_big_ms_per_key=round(full_big, 3),
+         keyspace_small=small, keyspace_big=big,
+         moved_keys_small=moved_small, moved_keys_big=moved_big,
+         churn_keys=CHURN_KEYS, history_rounds=HISTORY_ROUNDS,
+         live_commit_p99_ms=round(p99_ms, 2),
+         live_commits=n_commits, live_retries=n_retries,
+         live_cutover_ms=round(cutover_ms, 1))
+    emit("bootstrap_resume_refetch_pct", round(refetch_pct, 1),
+         "refetch pct", round(refetch_pct / 100.0, 2),
+         seg_bytes_total=total_b, seg_bytes_refetched=refetch_b,
+         seg_pulls=seg_pulls)
+
+
+if __name__ == "__main__":
+    main()

@@ -618,17 +618,22 @@ def test_wide_txn_2pc_batches_per_owner(tmp_path):
             srv.close()
 
 
-def test_truncated_donor_handoff_recovers_full_state(tmp_path):
+@pytest.mark.parametrize("stream", [True, False],
+                         ids=["stream", "oneshot"])
+def test_truncated_donor_handoff_recovers_full_state(tmp_path, stream):
     """Checkpoint-shipping handoff (ISSUE 13): the donor's ``.ckpt``
     manifest + seed segments travel WITH the log bytes, so a receiver
     adopting a TRUNCATED log recovers the below-cut history from the
     shipped seeds.  Pre-fix the checkpoint did not travel: the
     receiver full-scanned a log whose prefix was reclaimed and
     recovered suffix-only (loudly) — the final read here pins that as
-    the regression (it would see only the post-truncation delta)."""
+    the regression (it would see only the post-truncation delta).
+    Both ISSUE-19 knob positions must land the identical state: the
+    segment-cursor streamed pull and the legacy one-shot bundle."""
     servers = [
         NodeServer(f"t{i}", data_dir=str(tmp_path / f"t{i}"),
-                   config=_cfg())
+                   config=Config(n_partitions=8, heartbeat_s=0.05,
+                                 ckpt_stream=stream))
         for i in range(2)
     ]
     try:
@@ -673,6 +678,97 @@ def test_truncated_donor_handoff_recovers_full_state(tmp_path):
         receiver.api.commit_transaction(tx)
         assert vals == [11, 10, 10], \
             f"below-cut history lost across the handoff: {vals}"
+    finally:
+        for srv in servers:
+            srv.close()
+
+
+def test_donor_blip_mid_streamed_pull_resumes_at_ack(tmp_path):
+    """ISSUE 19: a donor blip (RemoteCallError) and a torn segment
+    fetch mid-streamed-pull both re-pull and resume at the cursor's
+    per-segment ack watermark — the handoff still lands the donor's
+    full below-cut history, and the faults never discard acked
+    progress (STREAM_RESUME_REFETCH_BYTES stays flat: the manifest
+    never changed, so nothing already staged is refetched)."""
+    from antidote_tpu import stats
+    from antidote_tpu.cluster.remote import RemoteCallError
+
+    def _cfg_tiny():
+        # window of 1 byte: every segment is its own pull round, so
+        # the ack watermark is exercised between faults
+        return Config(n_partitions=8, heartbeat_s=0.05,
+                      ckpt_stream_window_bytes=1)
+
+    servers = [
+        NodeServer(f"b{i}", data_dir=str(tmp_path / f"b{i}"),
+                   config=_cfg_tiny())
+        for i in range(2)
+    ]
+    try:
+        create_dc_cluster("dc1", 8, servers)
+        api = servers[0].api
+        donor = next(s for s in servers
+                     if isinstance(s.node.partitions[3],
+                                   PartitionManager))
+        pm = donor.node.partitions[3]
+        cvc = None
+        # three cuts over DISTINCT key sets: three live segments (no
+        # superseded entries, so compaction leaves the chain alone)
+        for round_ in range(3):
+            keys = [3 + 8 * (3 * round_ + j) for j in range(3)]
+            for _ in range(4):
+                tx = api.start_transaction(clock=cvc)
+                api.update_objects(
+                    [((k, "counter_pn", "b"), "increment", 1)
+                     for k in keys], tx)
+                cvc = api.commit_transaction(tx)
+            assert pm.checkpoint_now() is not None
+        assert pm.log.log.truncated_base > 0
+        man = pm.log.ckpt.bundle_manifest()
+        assert man is not None and len(man["segments"]) >= 3, \
+            "scenario needs a multi-segment bundle"
+
+        receiver = next(s for s in servers if s is not donor)
+        real = receiver._rpc
+        seg_calls = [0]
+
+        def rpc(target, kind, payload):
+            if kind == "ckpt_segs":
+                seg_calls[0] += 1
+                if seg_calls[0] == 1:
+                    raise RemoteCallError("donor vanished (test)")
+                if seg_calls[0] == 2:
+                    raws = real(target, kind, payload)
+                    return [None if r is None else r[: len(r) // 2]
+                            for r in raws]
+            return real(target, kind, payload)
+
+        receiver._rpc = rpc
+        torn0 = stats.registry.stream_torn_fetches.value()
+        retr0 = stats.registry.ckpt_seg_pull_retries.value()
+        refetch0 = stats.registry.stream_resume_refetch_bytes.value()
+
+        new_ring = dict(servers[0].node.ring)
+        new_ring[3] = receiver.node_id
+        servers[0].rebalance(new_ring)
+
+        pm2 = receiver.node.partitions[3]
+        assert isinstance(pm2, PartitionManager)
+        assert pm2.log.suffix_start > 0, \
+            "receiver did not adopt the streamed checkpoint"
+        assert seg_calls[0] > len(man["segments"]), \
+            "the faults were never injected into the segment pulls"
+        assert stats.registry.stream_torn_fetches.value() == torn0 + 1
+        assert stats.registry.ckpt_seg_pull_retries.value() > retr0
+        assert stats.registry.stream_resume_refetch_bytes.value() \
+            == refetch0, "acked progress was discarded and refetched"
+        all_keys = [3 + 8 * j for j in range(9)]
+        tx = receiver.api.start_transaction(clock=cvc)
+        vals = receiver.api.read_objects(
+            [(k, "counter_pn", "b") for k in all_keys], tx)
+        receiver.api.commit_transaction(tx)
+        assert vals == [4] * 9, \
+            f"below-cut history lost across the faulted pull: {vals}"
     finally:
         for srv in servers:
             srv.close()

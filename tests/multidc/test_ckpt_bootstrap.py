@@ -18,11 +18,14 @@ from tests.multidc.conftest import make_cluster
 from tests.multidc.test_replication import read_counter, update_counter
 
 
-@pytest.fixture
-def ckpt_pair(bus, tmp_path):
+# both ISSUE-19 knob positions: the streamed (page-cursor) bootstrap
+# and the legacy one-shot CKPT_READ must converge to the same state
+@pytest.fixture(params=[True, False], ids=["stream", "oneshot"])
+def ckpt_pair(request, bus, tmp_path):
     dcs = make_cluster(
         bus, tmp_path, 2, n_partitions=2, device_store=False,
-        ckpt=True, ckpt_truncate=True, ckpt_retain_ops=0)
+        ckpt=True, ckpt_truncate=True, ckpt_retain_ops=0,
+        ckpt_stream=request.param)
     yield dcs
     for dc in dcs:
         dc.close()
@@ -132,6 +135,7 @@ class TestEndToEndBootstrap:
         from antidote_tpu import stats
 
         boots0 = stats.registry.ckpt_bootstraps.value()
+        segf0 = stats.registry.stream_seg_fetches.value()
         dc1, dc2 = ckpt_pair
         bus = dc1.bus
         key = "boot_ctr"
@@ -165,6 +169,12 @@ class TestEndToEndBootstrap:
         assert stats.registry.ckpt_bootstraps.value() > boots0, \
             "the stream converged without the bootstrap escalation " \
             "— the scenario no longer exercises BELOW_FLOOR"
+        if dc2.node.config.ckpt_stream:
+            assert stats.registry.stream_seg_fetches.value() > segf0, \
+                "ckpt_stream=True bootstrapped without the page cursor"
+        else:
+            assert stats.registry.stream_seg_fetches.value() == segf0, \
+                "ckpt_stream=False still pulled streamed pages"
         buf = dc2.sub_bufs[("dc1", p)]
         assert buf.state == "normal"
         assert buf.last_opid >= floor

@@ -200,6 +200,75 @@ def test_both_dcs_resize_and_refederate(tmp_path):
         b2.close()
 
 
+def test_seeded_resize_refederation_rebootstraps_streams(tmp_path):
+    """ISSUE 19: both DCs resize SEEDED — checkpoints cut, logs
+    truncated, every stream renumbered by the fold's max-join — and
+    re-form the federation.  A renumbered slot's local per-origin
+    counter no longer describes the origin's chain, so the connect
+    handshake must re-bootstrap each such stream PROACTIVELY from a
+    fresh origin cut (the streamed CKPT_READ under the default knob)
+    instead of resuming mis-aligned opids; post-resize writes then
+    flow both ways."""
+    from antidote_tpu import stats
+
+    cfg = lambda n, **kw: Config(  # noqa: E731
+        n_partitions=n, heartbeat_s=0.02, clock_wait_timeout_s=10.0,
+        ckpt_ops=1 << 30, ckpt_bytes=1 << 40, ckpt_truncate=True,
+        **kw)
+    bus = InProcBus()
+    a = DataCenter("dcA", bus, config=cfg(2),
+                   data_dir=str(tmp_path / "a"))
+    b = DataCenter("dcB", bus, config=cfg(2),
+                   data_dir=str(tmp_path / "b"))
+    connect_dcs([a, b])
+    a.start_bg_processes()
+    b.start_bg_processes()
+    want, ct = seed(a, n_keys=6)
+    check(b, want, clock=ct)  # barrier: B holds A's full stream
+    a.close()
+    b.close()
+
+    bus2 = InProcBus()
+    a2 = DataCenter("dcA", bus2,
+                    config=cfg(2, recover_meta_data_on_start=False),
+                    data_dir=str(tmp_path / "a"))
+    b2 = DataCenter("dcB", bus2,
+                    config=cfg(2, recover_meta_data_on_start=False),
+                    data_dir=str(tmp_path / "b"))
+    try:
+        for dc in (a2, b2):
+            for pm in dc.node.partitions:
+                assert pm.checkpoint_now() is not None
+            assert any(pm.log.log.truncated_base > 0
+                       for pm in dc.node.partitions)
+            dc.repartition(4)
+            assert all(pm.log.renumbered
+                       for pm in dc.node.partitions
+                       if pm.log.keys_seen), \
+                "the resize was not checkpoint-seeded"
+        check(a2, want, clock=ct)
+        check(b2, want, clock=ct)
+        man0 = stats.registry.stream_manifest_fetches.value()
+        connect_dcs([a2, b2])
+        a2.start_bg_processes()
+        b2.start_bg_processes()
+        assert stats.registry.stream_manifest_fetches.value() > man0, \
+            "no proactive renumbered-stream bootstrap fired at connect"
+        ct2 = a2.update_objects_static(
+            None, [(("afterA", "counter_pn", "b"), "increment", 3)])
+        vals, _ = b2.read_objects_static(
+            ct2, [("afterA", "counter_pn", "b")])
+        assert vals[0] == 3
+        ct3 = b2.update_objects_static(
+            ct2, [(("afterB", "counter_pn", "b"), "increment", 4)])
+        vals, _ = a2.read_objects_static(
+            ct3, [("afterB", "counter_pn", "b")])
+        assert vals[0] == 4
+    finally:
+        a2.close()
+        b2.close()
+
+
 def test_crash_mid_swap_resumes_at_boot(tmp_path):
     """A crash between the journal write and the log swap must not lose
     history: the next boot finds the journal, finishes the swap, and
@@ -237,6 +306,74 @@ def test_crash_mid_swap_resumes_at_boot(tmp_path):
     db2.close()
 
 
+def test_seeded_crash_mid_swap_resumes_at_boot(tmp_path):
+    """ISSUE 19, the SEEDED variant of the crash-mid-swap resume: the
+    resize folds from checkpoint seeds over TRUNCATED source logs, so
+    the staged re-cut checkpoints are the only copy of the below-cut
+    history — the swap hard-links them into place, the staged files
+    survive as re-run sources, and a crash mid-swap must re-run the
+    whole install at boot (journal present) with nothing lost."""
+    import glob
+    import os
+
+    from antidote_tpu import stats
+
+    data = str(tmp_path / "d")
+    cfg = lambda: Config(n_partitions=2, data_dir=data,  # noqa: E731
+                         ckpt_ops=1 << 30, ckpt_bytes=1 << 40,
+                         ckpt_truncate=True)
+    db = AntidoteTPU(config=cfg())
+    want, _ = seed(db, n_keys=8)
+    node = db.node
+    for pm in node.partitions:
+        assert pm.checkpoint_now() is not None
+    assert any(pm.log.log.truncated_base > 0
+               for pm in node.partitions), \
+        "the below-cut bytes must really be reclaimed"
+    # a post-cut suffix the re-cut docs renumber the staged logs over
+    db.update_objects_static(
+        None, [(("c0", "counter_pn", "b"), "increment", 100)])
+    want[("c0", "counter_pn", "b")] = 101
+    moved0 = stats.registry.reshard_moved_keys.value()
+    old_repl = os.replace
+    calls = {"n": 0}
+
+    def exploding_replace(src, dst):
+        if src.endswith(".resize") or dst.endswith(".pre-resize"):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise OSError("simulated crash mid-swap")
+        return old_repl(src, dst)
+
+    os.replace = exploding_replace
+    try:
+        with pytest.raises(OSError, match="simulated crash"):
+            node.repartition(4)
+    finally:
+        os.replace = old_repl
+    assert stats.registry.reshard_moved_keys.value() > moved0, \
+        "the resize was not checkpoint-seeded (no moved keys counted)"
+    db.close()
+    assert os.path.exists(os.path.join(data, "dc1_resize.journal"))
+    db2 = AntidoteTPU(config=cfg())
+    assert db2.node.config.n_partitions == 4
+    assert not os.path.exists(os.path.join(data, "dc1_resize.journal"))
+    # the re-run markers were swept once the journal cleared
+    assert not glob.glob(os.path.join(data, "*.ckpt.resize*"))
+    # the new slots adopted their re-cut checkpoints (recovery was
+    # seeded — the staged suffix-only logs alone would lose the
+    # reclaimed prefix); a re-cut doc's cut sits at offset 0, so the
+    # adopted doc itself (renumbered marker included) is the signal
+    for pm in db2.node.partitions:
+        if not pm.log.keys_seen:
+            continue
+        doc = pm.log.ckpt_doc
+        assert doc is not None and doc.get("renumbered"), \
+            f"slot {pm.partition} recovered without its re-cut seeds"
+    check(db2, want)
+    db2.close()
+
+
 def test_stable_floor_restores_on_recovering_restart(tmp_path):
     """With recover_meta_data_on_start=True the persisted stable floor
     round-trips: a restarted DC whose peer is down still serves its
@@ -266,6 +403,55 @@ def test_stable_floor_restores_on_recovering_restart(tmp_path):
         check(a2, want)  # None-clock reads see everything
     finally:
         a2.close()
+
+
+def test_mid_fold_checkpoint_cannot_reclaim_unscanned_history(
+        tmp_path):
+    """ISSUE 19 regression (found by benches/config17_reshard's live
+    leg at 8 writers): an auto-checkpoint cut DURING a live fold must
+    not truncate a source log below the fold's cursors — for a
+    full-fold source the reclaimed prefix lives only in a checkpoint
+    the fold ignores (and the swap deletes), i.e. silent data loss.
+    build_resize_fold pins truncation on EVERY source for the fold's
+    life; the hold releases on final_pass or discard."""
+    db = AntidoteTPU(config=Config(
+        n_partitions=2, device_store=False, ckpt=True,
+        ckpt_truncate=True, ckpt_ops=1 << 30, ckpt_bytes=1 << 40,
+        data_dir=str(tmp_path / "mf")))
+    try:
+        for k in range(32):
+            db.update_objects_static(
+                None, [((k, "counter_pn", "b"), "increment", 1)])
+        node = db.node
+        # no checkpoint yet: both sources would fold FULL from 0
+        fold = node.build_resize_fold(4)
+        try:
+            pm = node.partitions[0]
+            # the cut lands mid-fold: it must adopt WITHOUT
+            # truncating (the staged truncation aborts under the
+            # fold's hold)
+            assert pm.checkpoint_now() is not None
+            assert pm.log.log.truncated_base == 0, \
+                "mid-fold checkpoint reclaimed history under the fold"
+        finally:
+            fold.discard()
+        # the hold released with the fold: the next cut truncates
+        # normally again
+        db.update_objects_static(
+            None, [((0, "counter_pn", "b"), "increment", 1)])
+        pm = node.partitions[0]
+        assert pm.checkpoint_now() is not None
+        assert pm.log.log.truncated_base > 0, \
+            "truncation never resumed after the fold released"
+        # and a live resize over the (now truncated) log still
+        # preserves everything — the checkpoint-seeded path
+        db.node.repartition_live(4)
+        for k in range(32):
+            vals, _ = db.read_objects_static(
+                None, [(k, "counter_pn", "b")])
+            assert vals[0] == (2 if k == 0 else 1), (k, vals[0])
+    finally:
+        db.close()
 
 
 class TestLiveHandoff:

@@ -260,3 +260,92 @@ class TestPartialSubscriptionSafeTime:
         finally:
             for dc in dcs:
                 dc.close()
+
+
+class TestLiveRehelloTcp:
+    """ISSUE 19 satellite: a widened interest spec is re-announced on
+    the LIVE TCP subscribe connection (no teardown/re-dial), the
+    publisher adopts it in place, and the converged end state is
+    identical to the same scenario over the in-proc bus."""
+
+    def _scenario(self, tmp_path, sub, make_buses):
+        """dc2 subscribes the low half, traffic lands in both halves,
+        dc2 widens to (LOW, HIGH) mid-traffic, and writes committed
+        AFTER the widen (above any backfill watermark) must arrive via
+        the re-announced stream. Returns the converged reads."""
+        buses = make_buses()
+        dcs = []
+        for i, b in enumerate(buses):
+            cfg = Config(interest_routing=True,
+                         interest_ranges=(None, (LOW,))[i],
+                         n_partitions=2, device_store=False,
+                         heartbeat_s=0.02, clock_wait_timeout_s=10.0)
+            dcs.append(DataCenter(f"dc{i + 1}", b, config=cfg,
+                                  data_dir=str(tmp_path / sub
+                                               / f"dc{i + 1}")))
+        connect_dcs(dcs)
+        for dc in dcs:
+            dc.start_bg_processes()
+        try:
+            dc1, dc2 = dcs
+            ct = None
+            for i in range(5):
+                ct = add(dc1, "kb_in", f"a{i}", clock=ct)
+                ct = add(dc1, "kx_out", f"b{i}", clock=ct)
+            poll_set(dc2, "kb_in", ct, [f"a{i}" for i in range(5)])
+
+            # on the Python TCP pub path, pin the live sender object:
+            # the widen below must be adopted by THIS connection, not
+            # a replacement dialed after a teardown
+            pub_bus, sender0 = dc1.bus, None
+            if hasattr(pub_bus, "_subscribers"):
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and sender0 is None:
+                    with pub_bus._lock:
+                        live = [s for s in pub_bus._subscribers
+                                if not s._dead]
+                    sender0 = live[0] if live else None
+                    time.sleep(0.01)
+                assert sender0 is not None, "no live TCP subscriber"
+
+            dc2.set_interest((LOW, HIGH))
+            for i in range(5, 8):
+                ct = add(dc1, "kx_out", f"b{i}", clock=ct)
+            poll_set(dc2, "kx_out", ct, [f"b{i}" for i in range(8)])
+            poll_set(dc2, "kb_in", ct, [f"a{i}" for i in range(5)])
+
+            if sender0 is not None:
+                deadline = time.monotonic() + 10
+                while (time.monotonic() < deadline
+                       and (sender0.interest_spec is None
+                            or len(sender0.interest_spec.ranges) != 2)):
+                    time.sleep(0.01)
+                assert sender0.interest_spec is not None \
+                    and tuple(sender0.interest_spec.ranges) \
+                    == (LOW, HIGH), \
+                    "publisher never adopted the re-announced spec"
+                with pub_bus._lock:
+                    live = [s for s in pub_bus._subscribers
+                            if not s._dead]
+                assert live == [sender0], \
+                    "widen tore the connection down instead of " \
+                    "re-announcing on it"
+            return (read_set(dc2, "kb_in", ct),
+                    read_set(dc2, "kx_out", ct))
+        finally:
+            for dc in dcs:
+                dc.close()
+            for b in buses:
+                getattr(b, "close", lambda: None)()
+
+    def test_tcp_live_rehello_matches_inproc(self, tmp_path):
+        from antidote_tpu.interdc.tcp import TcpTransport
+
+        got_tcp = self._scenario(
+            tmp_path, "tcp",
+            lambda: [TcpTransport(native_pub=False) for _ in range(2)])
+        bus = InProcBus()
+        got_inproc = self._scenario(tmp_path, "inproc",
+                                    lambda: [bus, bus])
+        assert got_tcp == got_inproc, \
+            "TCP live re-hello diverged from the in-proc bus"

@@ -1,0 +1,148 @@
+"""Streamed CKPT_READ bootstrap (ISSUE 19).
+
+The contract under test: the page-granular bootstrap client assembles
+the EXACT one-shot CKPT_READ answer (including interest-filtered
+pulls); a donor kill mid-stream leaves the caller-owned cursor state
+intact, and the next call resumes at the first un-acked page — the
+re-cut after the kill restarts only what the dead cut had acked,
+counted in STREAM_RESTARTS / STREAM_RESUME_REFETCH_BYTES, never a
+from-zero refetch; and a torn page fetch refuses loudly and re-pulls
+the SAME page without discarding acked progress.
+"""
+
+import os
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.clocks import VC
+from antidote_tpu.config import Config
+from antidote_tpu.interdc import InProcBus
+from antidote_tpu.interdc import query as idc_query
+from antidote_tpu.interdc.dc import DataCenter
+from antidote_tpu.interdc.transport import LinkDown
+
+#: small on purpose: with ~512B values the cut splits into many pages
+#: and the client needs several window-bounded pulls
+WINDOW = 8 * 1024
+
+
+def _commit(node, n, key):
+    pm = node.partition_of(key)
+    txid = ("dc1", n)
+    val = f"{key}:{n}:" + "x" * 512
+    pm.stage_update(txid, key, "register_lww",
+                    (node.clock.now_us(), ("dc1", n), val))
+    pm.single_commit(txid, VC({"dc1": node.clock.now_us()}),
+                     certify=False)
+
+
+@pytest.fixture
+def donor(tmp_path):
+    bus = InProcBus()
+    dc1 = DataCenter("dc1", bus, config=Config(
+        n_partitions=1, device_store=False, ckpt=True,
+        ckpt_ops=1 << 30, ckpt_bytes=1 << 40),
+        data_dir=str(tmp_path / "donor"))
+    for n in range(48):
+        _commit(dc1.node, n, f"b_{n:04d}")
+    yield bus, dc1
+    dc1.close()
+
+
+class _FaultOnce:
+    """Transport wrapper: fault the Nth CKPT_SEG pull exactly once —
+    either the donor dies (its in-memory page cache dies with it and
+    the link drops) or the answer's first page arrives torn."""
+
+    def __init__(self, inner, donor_dc, fault_on, mode):
+        self._inner = inner
+        self._donor = donor_dc
+        self._fault_on = fault_on
+        self._mode = mode
+        self._fired = False
+        self.seg_calls = 0
+
+    def request(self, origin, target, kind, payload):
+        if kind == idc_query.CKPT_SEG:
+            self.seg_calls += 1
+            if self.seg_calls == self._fault_on and not self._fired:
+                self._fired = True
+                if self._mode == "kill":
+                    self._donor._ckpt_serve_cache.clear()
+                    raise LinkDown("donor killed mid-stream (test)")
+                raws = self._inner.request(origin, target, kind,
+                                           payload)
+                return [raws[0][: max(1, len(raws[0]) // 2)],
+                        *raws[1:]]
+        return self._inner.request(origin, target, kind, payload)
+
+
+def test_streamed_equals_one_shot_including_ranges(donor):
+    bus, _dc1 = donor
+    for ranges in (None, (("b_0000", "b_0020"),)):
+        oracle = idc_query.fetch_ckpt_bootstrap(
+            bus, "probe", "dc1", 0, ranges=ranges)
+        assert oracle is not None and oracle["keys"]
+        state = {}
+        ans = idc_query.fetch_ckpt_bootstrap_streamed(
+            bus, "probe", "dc1", 0, ranges, WINDOW, state)
+        assert ans is not None
+        assert ans["keys"] == oracle["keys"]
+        for field in ("clock", "commit_opid", "op_counter"):
+            assert ans[field] == oracle[field], field
+        assert not state, \
+            "a completed pull must clear its cursor state"
+    # the filtered pull really elided the out-of-range keys
+    full = idc_query.fetch_ckpt_bootstrap(bus, "probe", "dc1", 0)
+    assert len(oracle["keys"]) < len(full["keys"])
+
+
+def test_donor_kill_mid_stream_resumes_at_ack_watermark(donor):
+    bus, dc1 = donor
+    reg = stats.registry
+    killer = _FaultOnce(bus, dc1, fault_on=3, mode="kill")
+    bytes0 = reg.stream_seg_bytes.value()
+    refetch0 = reg.stream_resume_refetch_bytes.value()
+    restarts0 = reg.stream_restarts.value()
+    state = {}
+    ans = idc_query.fetch_ckpt_bootstrap_streamed(
+        killer, "probe", "dc1", 0, None, WINDOW, state)
+    assert ans is None, "the kill did not interrupt the stream"
+    assert state, "the kill must preserve the cursor state"
+    acked = dict(state["pages"])
+    assert acked, "nothing was acked before the kill"
+    ans = idc_query.fetch_ckpt_bootstrap_streamed(
+        killer, "probe", "dc1", 0, None, WINDOW, state)
+    assert ans is not None, "resume after the donor kill failed"
+    oracle = idc_query.fetch_ckpt_bootstrap(bus, "probe", "dc1", 0)
+    assert ans["keys"] == oracle["keys"], \
+        "resumed streamed answer diverged from the one-shot oracle"
+    # the restart re-cut under a new bid: only the DEAD cut's acked
+    # pages were refetched (counted), never the whole bundle
+    assert reg.stream_restarts.value() == restarts0 + 1
+    total = reg.stream_seg_bytes.value() - bytes0
+    refetch = reg.stream_resume_refetch_bytes.value() - refetch0
+    assert 0 < refetch < total, (refetch, total)
+
+
+def test_torn_page_fetch_repulls_without_restart(donor):
+    bus, dc1 = donor
+    reg = stats.registry
+    tearer = _FaultOnce(bus, dc1, fault_on=2, mode="torn")
+    torn0 = reg.stream_torn_fetches.value()
+    restarts0 = reg.stream_restarts.value()
+    refetch0 = reg.stream_resume_refetch_bytes.value()
+    state = {}
+    ans = idc_query.fetch_ckpt_bootstrap_streamed(
+        tearer, "probe", "dc1", 0, None, WINDOW, state)
+    assert ans is not None
+    oracle = idc_query.fetch_ckpt_bootstrap(bus, "probe", "dc1", 0)
+    assert ans["keys"] == oracle["keys"]
+    assert reg.stream_torn_fetches.value() == torn0 + 1, \
+        "the torn page was not refused"
+    # a torn fetch re-pulls the SAME page against the SAME cut: no
+    # cursor restart, no acked progress discarded
+    assert reg.stream_restarts.value() == restarts0
+    assert reg.stream_resume_refetch_bytes.value() == refetch0
+    assert tearer.seg_calls > 2, "no re-pull after the torn page"

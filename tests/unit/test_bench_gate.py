@@ -282,9 +282,46 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # must not rise (keyspace-proportional again), device-resident
     # restart fraction must not fall (host-path pinning again)
     ("us/key", -1), ("resident pct", 1),
+    # elastic keyspace (ISSUE 19): resize wall cost per moved
+    # slot-key must not rise (fold re-reading whole logs instead of
+    # checkpoint seeds + suffix), the donor-kill refetch fraction
+    # must not rise (cursor no longer resuming at its ack watermark)
+    ("ms/moved key", -1), ("refetch pct", -1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_reshard_plane_regression(tmp_path, capsys):
+    """ISSUE 19 synthetic two-round trajectory: round 2's resize cost
+    per moved slot-key balloons (seeded folds re-reading whole logs
+    again) and the donor-kill refetch fraction climbs (the segment
+    cursor restarting from zero instead of its ack watermark) — both
+    directions must fail."""
+    old = {"schema_version": 1, "round": 1, "dry_run": False,
+           "metrics": {
+               "reshard_ms_per_moved_key": {"value": 0.05,
+                                            "unit": "ms/moved key"},
+               "bootstrap_resume_refetch_pct": {
+                   "value": 30.0, "unit": "refetch pct"}},
+           "failures": {}}
+    new = {"schema_version": 1, "round": 2, "dry_run": False,
+           "metrics": {
+               "reshard_ms_per_moved_key": {"value": 4.0,
+                                            "unit": "ms/moved key"},
+               "bootstrap_resume_refetch_pct": {
+                   "value": 97.0, "unit": "refetch pct"}},
+           "failures": {}}
+    import json
+
+    op, np_ = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    op.write_text(json.dumps(old))
+    np_.write_text(json.dumps(new))
+    rc = bench_gate.main([str(op), str(np_)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "reshard_ms_per_moved_key" in err
+    assert "bootstrap_resume_refetch_pct" in err
 
 
 def test_gate_fails_on_ckptseg_plane_regression(tmp_path, capsys):

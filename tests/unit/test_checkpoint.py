@@ -726,18 +726,38 @@ def test_post_restart_truncation_floors_cover_blind_window(tmp_path):
     node2.close()
 
 
-def test_repartition_refuses_truncated_log(tmp_path):
-    """A resize folds FULL histories; over a truncated log that would
-    silently lose the below-cut ops — it must refuse loudly."""
+def test_repartition_over_truncated_log_seeds_from_checkpoint(tmp_path):
+    """ISSUE 19 flips the pre-ISSUE-19 refusal: a truncated log no
+    longer blocks a resize — the fold seeds each slot from its
+    checkpoint cut and replays only the suffix, so no below-cut op is
+    lost.  With Config.resize_from_ckpt off the loud refusal stays
+    (a full-history fold over reclaimed bytes would silently lose
+    them)."""
     cfg = _mk_cfg(tmp_path, ckpt=True, ckpt_truncate=True,
                   n_partitions=2)
     node = Node(dc_id="dc1", config=cfg)
     _workload(node, n_txns=30)
     _force_ckpt(node)
     assert any(pm.log.log.truncated_base > 0 for pm in node.partitions)
+    before = _all_values(node)
+    cfg.resize_from_ckpt = False
     with pytest.raises(RuntimeError, match="truncated"):
         node.repartition(4)
+    assert len(node.partitions) == 2, "refused resize mutated the ring"
+    assert _all_values(node) == before, "refused resize mutated state"
+    cfg.resize_from_ckpt = True
+    node.repartition(4)
+    assert len(node.partitions) == 4
+    assert all(pm.log.renumbered for pm in node.partitions), \
+        "seeded fold must mark every re-cut log renumbered"
+    assert _all_values(node) == before, \
+        "seeded resize changed recovered values"
     node.close()
+    # the re-cut checkpoint + suffix must survive a cold restart
+    node2 = Node(dc_id="dc1", config=cfg)
+    assert _all_values(node2) == before, \
+        "seeded resize state lost across restart"
+    node2.close()
 
 
 # --------------------- commit concurrency during truncation (ISSUE 11)

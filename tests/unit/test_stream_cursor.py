@@ -1,0 +1,191 @@
+"""Resumable segment cursors (ISSUE 19).
+
+The contract under test: a streamed checkpoint transfer validates
+every fetched segment (magic + CRC), stages it durably, and tracks a
+per-segment ack watermark — a torn or short fetch refuses LOUDLY
+without acking and the transfer resumes at the first un-acked
+segment, never from zero; a manifest that changed under the cursor
+(donor re-cut, compaction, or a different donor after a kill)
+restarts it with the discarded progress counted in STREAM_RESTARTS /
+STREAM_RESUME_REFETCH_BYTES; commit republishes through the same
+segments-then-manifest rename discipline as install_bundle, so the
+receiver's on-disk checkpoint ends byte-identical to the donor's;
+and a monolithic (``ckpt_segmented=False``) donor streams as a
+zero-segment manifest the cursor commits after no fetches at all.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import pytest
+
+from antidote_tpu import stats
+from antidote_tpu.config import Config
+from antidote_tpu.oplog.checkpoint import (
+    BundleCursor,
+    CheckpointStore,
+    ckpt_from_config,
+)
+from antidote_tpu.txn.node import Node
+
+from tests.unit.test_checkpoint import _all_values, _commit
+from tests.unit.test_ckpt_segments import _mk
+
+
+def _donor(tmp_path, cuts=3, **cfg_kw):
+    """A 1-partition node with ``cuts`` checkpoint cuts (each cut
+    persists one dirty-delta segment); returns (node, store,
+    donor manifest path, want-values)."""
+    cfg = _mk(tmp_path, **cfg_kw)
+    node = Node(dc_id="dc1", config=cfg)
+    pm = node.partitions[0]
+    n = 0
+    for c in range(cuts):
+        for i in range(6):
+            _commit(node, n, [(f"k{c}_{i}", "counter_pn", 1)])
+            n += 1
+        assert pm.checkpoint_now() is not None
+    return node, pm.log.ckpt, pm.log.path + ".ckpt", _all_values(node)
+
+
+def _recv_path(tmp_path, donor_path):
+    d = tmp_path / "recv"
+    d.mkdir(exist_ok=True)
+    # real handoffs land the bundle at the receiver's own log path,
+    # which shares the donor's basename (same dc, same partition)
+    return str(d / os.path.basename(donor_path))
+
+
+def test_torn_fetch_refuses_unacked_and_resumes_byte_identical(
+        tmp_path):
+    node, st, donor_path, _want = _donor(tmp_path, cuts=3)
+    try:
+        man = st.bundle_manifest()
+        assert man is not None and len(man["segments"]) >= 2, \
+            "scenario needs a multi-segment bundle"
+        recv = _recv_path(tmp_path, donor_path)
+        cur = BundleCursor(recv)
+        assert cur.begin(man["manifest"]) is True
+        name0 = cur.pending()[0][0]
+        cur.offer(name0, st.read_segment_raw(name0))
+        # a fetch outside the adopted manifest can never stage
+        with pytest.raises(ValueError, match="not in the adopted"):
+            cur.offer("page-bogus", b"x")
+        # torn/short fetches of the NEXT segment refuse loudly, are
+        # never acked, and do not move the resume point
+        torn0 = stats.registry.stream_torn_fetches.value()
+        name1 = cur.pending()[0][0]
+        raw1 = st.read_segment_raw(name1)
+        cuts = (0, 1, len(raw1) // 2, len(raw1) - 1)
+        for cut in cuts:
+            with pytest.raises(ValueError, match="torn or short"):
+                cur.offer(name1, raw1[:cut])
+        assert stats.registry.stream_torn_fetches.value() \
+            == torn0 + len(cuts)
+        assert cur.acked_segments() == 1
+        assert cur.pending()[0][0] == name1, \
+            "the resume point moved past an un-acked segment"
+        with pytest.raises(ValueError, match="pending"):
+            cur.commit()
+        for name, _k, _b in list(cur.pending()):
+            cur.offer(name, st.read_segment_raw(name))
+        # a duplicate fetch after a retried round is a no-op
+        acked = cur.acked_segments()
+        cur.offer(name0, st.read_segment_raw(name0))
+        assert cur.acked_segments() == acked
+        cur.commit()
+        # the receiver's checkpoint is byte-identical to the donor's:
+        # manifest and every referenced segment
+        with open(recv, "rb") as f_r, open(donor_path, "rb") as f_d:
+            assert f_r.read() == f_d.read()
+        for name, _k, _b in man["segments"]:
+            with open(os.path.join(os.path.dirname(recv),
+                                   os.path.basename(name)), "rb") as f:
+                assert f.read() == st.read_segment_raw(name), name
+        assert not glob.glob(glob.escape(recv) + ".stage-*"), \
+            "staged files must not survive the commit"
+        st2 = CheckpointStore(recv, ckpt_from_config(Config()))
+        got, want = st2.load_doc(), st.load_doc()
+        assert got is not None
+        assert got["keys"] == want["keys"]
+        assert got["clock"] == want["clock"]
+    finally:
+        node.close()
+
+
+def test_manifest_change_restarts_and_counts_refetch(tmp_path):
+    node, st, donor_path, _want = _donor(tmp_path, cuts=2)
+    try:
+        man1 = st.bundle_manifest()
+        recv = _recv_path(tmp_path, donor_path)
+        cur = BundleCursor(recv)
+        assert cur.begin(man1["manifest"]) is True
+        name0, _k0, b0 = cur.pending()[0]
+        cur.offer(name0, st.read_segment_raw(name0))
+        staged = glob.glob(glob.escape(recv) + ".stage-*")
+        assert staged, "an acked segment must be durably staged"
+        # the donor re-cuts under the cursor: the adopted manifest is
+        # dead, so the acked progress is discarded — loudly counted
+        _commit(node, 999, [("late_key", "counter_pn", 1)])
+        assert node.partitions[0].checkpoint_now() is not None
+        man2 = st.bundle_manifest()
+        assert man2["manifest"] != man1["manifest"]
+        r0 = stats.registry.stream_restarts.value()
+        f0 = stats.registry.stream_resume_refetch_bytes.value()
+        assert cur.begin(man2["manifest"]) is True
+        assert stats.registry.stream_restarts.value() == r0 + 1
+        assert stats.registry.stream_resume_refetch_bytes.value() \
+            == f0 + b0
+        assert cur.acked_segments() == 0
+        for p in staged:
+            assert not os.path.exists(p), \
+                "stale staged segment survived the restart"
+        # re-adopting the SAME manifest resumes in place
+        assert cur.begin(man2["manifest"]) is False
+        for name, _k, _b in list(cur.pending()):
+            cur.offer(name, st.read_segment_raw(name))
+        cur.commit()
+        with open(recv, "rb") as f_r, open(donor_path, "rb") as f_d:
+            assert f_r.read() == f_d.read()
+    finally:
+        node.close()
+
+
+def test_torn_manifest_refuses_the_stream(tmp_path):
+    node, st, donor_path, _want = _donor(tmp_path, cuts=1)
+    try:
+        man = st.bundle_manifest()
+        cur = BundleCursor(_recv_path(tmp_path, donor_path))
+        raw = man["manifest"]
+        for cut in (0, 1, len(raw) // 2, len(raw) - 1):
+            with pytest.raises(ValueError, match="manifest"):
+                cur.begin(raw[:cut])
+        assert cur.manifest_raw is None
+        assert cur.begin(raw) is True
+    finally:
+        node.close()
+
+
+def test_monolithic_donor_streams_zero_segments(tmp_path):
+    """``ckpt_segmented=False`` donors carry their whole seed set in
+    the manifest bytes: the cursor adopts, has nothing pending, and
+    commit installs the document as-is."""
+    node, st, donor_path, _want = _donor(tmp_path, cuts=1,
+                                         ckpt_segmented=False)
+    try:
+        man = st.bundle_manifest()
+        assert man["segments"] == []
+        recv = _recv_path(tmp_path, donor_path)
+        cur = BundleCursor(recv)
+        assert cur.begin(man["manifest"]) is True
+        assert cur.pending() == []
+        cur.commit()
+        with open(recv, "rb") as f_r, open(donor_path, "rb") as f_d:
+            assert f_r.read() == f_d.read()
+        st2 = CheckpointStore(recv, ckpt_from_config(Config()))
+        got, want = st2.load_doc(), st.load_doc()
+        assert got is not None and got["keys"] == want["keys"]
+    finally:
+        node.close()

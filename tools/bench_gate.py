@@ -115,7 +115,16 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  # (the inf structural-regression rule above) — must
                  # be an exact entry because the "/frame" suffix is
                  # higher-better (txns/frame, ISSUE 6)
-                 "interest b/txn", "slices/frame"}
+                 "interest b/txn", "slices/frame",
+                 # elastic keyspace (ISSUE 19): resize wall cost per
+                 # moved slot-key rising means the fold re-reads whole
+                 # logs again instead of checkpoint seeds + suffix;
+                 # bytes re-fetched after a donor kill (as a pct of
+                 # the bundle) rising means the segment cursor stopped
+                 # resuming at its ack watermark — "refetch pct" must
+                 # be exact, plain "pct" would not match the two-word
+                 # unit and the metric would silently go ungated
+                 "ms/moved key", "refetch pct"}
 
 
 def repo_root() -> str:

@@ -168,6 +168,18 @@ _BLOCKING_ALWAYS = {
     "write_doc": "checkpoint write (pickle + fsync)",
     "load_doc": "checkpoint load",
     "checkpoint_now": "checkpoint cut+fold+persist",
+    # streamed segment transfer (ISSUE 19): manifest/segment reads,
+    # durable staging, and the staged-resize install are all file IO
+    # (often fsync-bearing) and must never run under a partition lock
+    "_load_segment": "segment read",
+    "bundle_manifest": "manifest read",
+    "read_segment_raw": "segment read",
+    "ship_bundle": "bundle read",
+    "install_bundle": "bundle install (write + fsync)",
+    "stage_resize_checkpoint": "resize-checkpoint stage (fsync)",
+    "commit_staged_resize_checkpoint": "resize-checkpoint install",
+    "offer": "segment stage (write + fsync)",
+    "commit": "bundle/txn commit",
 }
 
 #: terminal names that block only with a specific owner
@@ -872,16 +884,29 @@ class _Analyzer:
         for rel, tree in self._knob_read_trees():
             in_pkg = rel.startswith(PACKAGE_DIR)
             for node in ast.walk(tree):
-                if not isinstance(node, ast.Attribute):
+                # version-tolerant reads spell the knob as a string:
+                # getattr(config, "knob", default) — count them too,
+                # and hold their names to the same existence bar (a
+                # typo here is WORSE: the default hides it forever)
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "getattr" \
+                        and len(node.args) >= 2 \
+                        and self._is_config_owner(node.args[0]) \
+                        and isinstance(node.args[1], ast.Constant) \
+                        and isinstance(node.args[1].value, str):
+                    attr = node.args[1].value
+                elif isinstance(node, ast.Attribute) \
+                        and self._is_config_owner(node.value):
+                    attr = node.attr
+                else:
                     continue
-                if not self._is_config_owner(node.value):
-                    continue
-                reads.add(node.attr)
-                if in_pkg and node.attr not in knobs \
+                reads.add(attr)
+                if in_pkg and attr not in knobs \
                         and rel != f"{PACKAGE_DIR}/config.py":
                     problems.append(
                         f"{rel}:{node.lineno}: [knob-unknown] "
-                        f"Config.{node.attr} is read but not declared "
+                        f"Config.{attr} is read but not declared "
                         "on Config — a typo here silently falls "
                         "through to defaults")
         for knob in sorted(knobs - reads):
