@@ -286,6 +286,19 @@ class Config:
     #: the live data plane across a host's chips); "none" keeps the
     #: default device.  No-op with a single device.
     device_placement: str = "none"
+    #: pod-scale sharded materializer (antidote_tpu/mat/sharded.py):
+    #: shard every DevicePlane's key axis over ALL devices (one mesh,
+    #: rule-table partition specs, cross-chip fused group reads,
+    #: per-shard residency routing) instead of replicating state per
+    #: partition.  "auto" activates with >1 device on a real
+    #: accelerator backend only (the virtual CPU mesh the test suite
+    #: runs under stays on the single-chip baseline); True forces it
+    #: wherever >1 device exists (how the CPU-mesh tests/benches opt
+    #: in); False pins the legacy single-chip DevicePlane bit-for-bit
+    #: (the benches' comparison baseline).  Resolved once per node by
+    #: mat/sharded.sharded_from_config — the ONE factory, so every
+    #: partition of an assembly shards or none do.
+    mat_sharded: bool | str = "auto"
     #: fraction of transactions traced end-to-end (txid-deterministic;
     #: antidote_tpu/obs/spans.py).  1.0 traces everything (tests /
     #: debugging), 0 disables span recording entirely.  The default

@@ -37,6 +37,7 @@ rare host-side repack (store.orset_grow).
 from __future__ import annotations
 
 import atexit
+import contextlib
 import logging
 import threading
 import time
@@ -46,12 +47,14 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh as _Mesh
 
 from antidote_tpu import stats
 from antidote_tpu.clocks import VC, ClockDomain
 from antidote_tpu.obs import prof
 from antidote_tpu.obs.events import recorder
 from antidote_tpu.obs.spans import tracer
+from antidote_tpu.runtime import COLLECTIVE_LOCK
 from antidote_tpu.mat import ingest, store
 from antidote_tpu.mat.materializer import Payload
 
@@ -128,6 +131,19 @@ def fused_read(splits: list) -> list:
         results[i] = post(
             jax.tree_util.tree_map(np.asarray, outs[pos]))
     return results
+
+
+def collective_guard(dev):
+    """``COLLECTIVE_LOCK`` when ``dev`` is a mesh — the dispatch
+    launches a multi-chip program, and runtime.py's invariant ("every
+    collective launch site takes this lock") applies — else a no-op
+    context, so the single-chip paths keep their lock-free read
+    concurrency.  ``dev`` is the ``closure.device`` discriminator the
+    fused-read callers already group by: sharded planes publish their
+    mesh there (``_many_reader``), single-chip planes a Device."""
+    if isinstance(dev, _Mesh):
+        return COLLECTIVE_LOCK
+    return contextlib.nullcontext()
 
 
 class ReadBelowBase(Exception):
@@ -247,6 +263,14 @@ class _PlaneBase:
         #: costly, and on small hosts the compile threads compete with
         #: serving
         self._warm_kicked = False
+        #: mesh this plane's state is GSPMD-sharded over (set by
+        #: DevicePlane.place_sharded; None = single-chip).  While set,
+        #: every state-array dispatch is a MULTI-CHIP program and must
+        #: serialize under runtime.COLLECTIVE_LOCK (_collective_cm)
+        self._mesh = None
+        #: per-shard residency router (mat/sharded.ShardRouter), wired
+        #: alongside the mesh
+        self._router = None
 
     # -- subclass hooks -----------------------------------------------------
 
@@ -281,7 +305,11 @@ class _PlaneBase:
         the cluster data node's commit convoy.  The warm rows are all
         padding (key index = capacity, _pack_rows' sentinel), so
         executing the program is a no-op on the discarded result."""
-        if type(self)._append_fn is None:
+        if type(self)._append_fn is None or self._mesh is not None:
+            # sharded planes never warm in the background: the copies'
+            # dispatches are multi-chip programs, and a warm thread
+            # cannot take COLLECTIVE_LOCK without convoying the
+            # serving path behind a ~300ms compile
             return
         packed_mode = (self._ingest.enabled
                        and self._packed_perm() is not None)
@@ -347,6 +375,8 @@ class _PlaneBase:
         together with the growth itself); warming runs it on a copy in
         a compile thread instead.  Buckets cover the single-key reader
         (shape 1) and the first batched-dispatch bucket."""
+        if self._mesh is not None:
+            return  # see warm_appends: no background mesh dispatches
         shapes = tuple(
             (tuple(x.shape), str(getattr(x, "dtype", "")))
             for x in jax.tree_util.tree_leaves(self.st))
@@ -391,9 +421,33 @@ class _PlaneBase:
         _WARM_THREADS.append(t)
         t.start()
 
+    def _collective_cm(self):
+        """COLLECTIVE_LOCK while mesh-sharded (every dispatch on the
+        state is a multi-chip program — runtime.py's invariant), a
+        no-op context on the single-chip path."""
+        if self._mesh is not None:
+            return COLLECTIVE_LOCK
+        return contextlib.nullcontext()
+
+    def _reshard(self) -> None:
+        """Re-place the state per the rule table (mat/sharded.py).
+        GSPMD does not promise jit outputs keep their inputs'
+        shardings, and a grow rebuilds arrays on the default device —
+        re-placing after every flush/GC/grow keeps drift from
+        accumulating (device_put to an identical sharding is free)."""
+        if self._mesh is not None:
+            from antidote_tpu.mat import sharded as _sharded
+
+            self.st = _sharded.place_state(self._mesh, self.st)
+
     def _post_grow(self) -> None:
         """After any capacity growth: compile the append AND read
-        programs for the new shapes off the serving threads."""
+        programs for the new shapes off the serving threads (or, for
+        a mesh-sharded plane, re-shard the regrown arrays in place —
+        the grow rebuilt them unsharded on the default device)."""
+        if self._mesh is not None:
+            self._reshard()
+            return
         self.warm_appends()
         self.warm_reads()
 
@@ -418,15 +472,20 @@ class _PlaneBase:
             packed = ingest.pack_rows(rows, self.capacity,
                                       self.domain.d, self._row_cols,
                                       perm)
-            self.st, overflow = ingest.packed_append(
-                self.st, jnp.asarray(packed))
-            ingest.note_dispatch(n, packed.nbytes)
+            with self._collective_cm():
+                self.st, overflow = ingest.packed_append(
+                    self.st, jnp.asarray(packed))
+            ingest.note_dispatch(
+                n, packed.nbytes,
+                replicas=(self._mesh.shape["part"]
+                          if self._mesh is not None else 1))
             return np.asarray(overflow)[:n]
         ki, lo, arrays = _pack_rows(rows, self.capacity, self.domain.d,
                                     self._row_cols)
-        self.st, overflow = type(self)._append_fn(
-            self.st, jnp.asarray(ki), jnp.asarray(lo),
-            *(jnp.asarray(a) for a in arrays))
+        with self._collective_cm():
+            self.st, overflow = type(self)._append_fn(
+                self.st, jnp.asarray(ki), jnp.asarray(lo),
+                *(jnp.asarray(a) for a in arrays))
         return np.asarray(overflow)[:n]
 
     def _purge_idx(self, idx: int) -> None:
@@ -434,6 +493,13 @@ class _PlaneBase:
 
     def _device_gc(self, gst_dense: np.ndarray) -> None:
         raise NotImplementedError
+
+    def _run_device_gc(self, gst_dense: np.ndarray) -> None:
+        """The one `_device_gc` launch point: serialized under the
+        collective lock while mesh-sharded (the fold is a multi-chip
+        program)."""
+        with self._collective_cm():
+            self._device_gc(gst_dense)
 
     # -- directories --------------------------------------------------------
 
@@ -528,7 +594,17 @@ class _PlaneBase:
             raise ReadBelowBase()  # evicted during the flush — host path
         rv = self._read_vc_dense(read_vc)
         st = self.st
-        return self._reader(st, idx, rv)
+        r = self._reader(st, idx, rv)
+        if self._mesh is None:
+            return r
+
+        def locked_read():
+            # mesh-sharded: the fold is a multi-chip launch — same
+            # serialization rule as the appends (runtime.py invariant)
+            with COLLECTIVE_LOCK:
+                return r()
+
+        return locked_read
 
     def _reader(self, st, idx: int, rv):
         """Subclass hook: closure materializing key ``idx`` of the
@@ -577,13 +653,24 @@ class _PlaneBase:
 
         def run():
             count_read_dispatch()
-            out = fn(*args)
-            return post(jax.tree_util.tree_map(np.asarray, out))
+            with self._collective_cm():
+                out = fn(*args)
+                out = jax.tree_util.tree_map(np.asarray, out)
+            return post(out)
 
         run.split = (spec, post)
-        leaf = jax.tree_util.tree_leaves(st)[0]
-        run.device = next(iter(leaf.devices())) \
-            if hasattr(leaf, "devices") else None
+        if self._mesh is not None:
+            # the mesh IS the fusing discriminator: every sharded
+            # plane's fold is the same multi-chip program family, so
+            # cross-partition callers group them all into ONE
+            # fused_read (leaf.devices() would be nondeterministic
+            # for a sharded array — any of N chips — and break the
+            # grouping)
+            run.device = self._mesh
+        else:
+            leaf = jax.tree_util.tree_leaves(st)[0]
+            run.device = next(iter(leaf.devices())) \
+                if hasattr(leaf, "devices") else None
         return run
 
     def read_many(self, keys: list, read_vc: Optional[VC]) -> dict:
@@ -650,7 +737,13 @@ class _PlaneBase:
         self.rows = [r for r in self.rows if r[0] != idx]
         self.pending_keys.discard(key)
         self.rev_keys[idx] = _Evicted
-        self._purge_idx(idx)
+        with self._collective_cm():
+            self._purge_idx(idx)
+        if self._router is not None:
+            # the owning shard's lanes just overflowed (or the key was
+            # displaced): charge that shard's economy so it stops
+            # admitting new device residents until the next fold
+            self._router.note_evict(idx, self.capacity)
         log.debug("device plane: evicted %r (%s)", key, self.type_name)
         recorder.record("device", "evict", plane=self.type_name,
                         key=key)
@@ -763,7 +856,7 @@ class _PlaneBase:
                     pairs = self._ss_pairs(self._last_stable)
                     if pairs is not None:
                         gst = self._dense_vc(pairs)
-                        self._device_gc(gst)
+                        self._run_device_gc(gst)
                         self._base_vc = self._base_vc.join(
                             self._last_stable)
                         self._has_base = True
@@ -775,7 +868,7 @@ class _PlaneBase:
                     # retried rows landed after the fold above, so fold
                     # once more at the same horizon (rows above it are
                     # untouched)
-                    self._device_gc(gst)
+                    self._run_device_gc(gst)
                 if overflow2.any() and self.no_log_replay:
                     # EMERGENCY fold (unlogged mode): dropping an
                     # overflowed row here is permanent data loss — no
@@ -787,7 +880,7 @@ class _PlaneBase:
                     # path, which unlogged mode already degrades.
                     inf = np.full(self.domain.d, _VC_INF,
                                   dtype=np.int64)
-                    self._device_gc(inf)
+                    self._run_device_gc(inf)
                     self._base_vc = self._base_vc.join(
                         self._ring_vc_bound)
                     self._has_base = True
@@ -808,6 +901,7 @@ class _PlaneBase:
                 for key in bad_keys:
                     if key is not _Evicted:
                         self.evict(key)
+        self._reshard()
         stats.registry.device_flush_latency.observe(
             time.perf_counter() - t0)
         recorder.record("device", "flush", plane=self.type_name,
@@ -827,7 +921,12 @@ class _PlaneBase:
             return
         with prof.annotate(f"device_gc:{self.type_name}"), \
                 tracer.span(f"device_gc:{self.type_name}", "device"):
-            self._device_gc(self._dense_vc(pairs))
+            self._run_device_gc(self._dense_vc(pairs))
+        self._reshard()
+        if self._router is not None:
+            # a fold freed ring lanes on every shard — reset the
+            # overflow economy so shards re-admit device residents
+            self._router.note_fold()
         recorder.record("device", "gc", plane=self.type_name,
                         horizon=dict(stable_vc))
         self._base_vc = self._base_vc.join(stable_vc)
@@ -2439,6 +2538,11 @@ class DevicePlane:
         #: mesh device this partition's plane states are committed to
         #: (None = default device); see place_on
         self.device = None
+        #: jax.sharding.Mesh the plane states are GSPMD-sharded over
+        #: (None = single-chip); see place_sharded.  Mutually exclusive
+        #: with ``device`` — a plane is pinned to ONE chip or sharded
+        #: over all of them, never both.
+        self.mesh = None
         #: when set (by the owning PartitionManager), threshold flushes
         #: and GCs are SCHEDULED here instead of running inline on the
         #: committing transaction's back — group commit: the commit
@@ -2526,6 +2630,84 @@ class DevicePlane:
         for plane in self.planes.values():
             _place(plane)
 
+    def place_sharded(self, mesh) -> None:
+        """Shard every plane's state arrays over ``mesh`` per the named
+        partition rules (mat/sharded.py PARTITION_RULES) — the pod-
+        scale materializer: the key axis splits across chips, clock-
+        domain directories replicate, and every subsequent dispatch on
+        the state is ONE multi-chip GSPMD program serialized under
+        runtime.COLLECTIVE_LOCK (_PlaneBase._collective_cm).  Each
+        plane also gets a per-shard residency router (ShardRouter):
+        evictions charge only the OWNING shard's overflow economy, so
+        one hot shard spilling cannot stop the other shards' keys from
+        staying device-resident.  RGA documents (host-side dict of
+        per-document trees) keep default placement, exactly like
+        place_on."""
+        from antidote_tpu.mat import sharded as _sharded
+
+        n_shards = int(mesh.shape["part"])
+
+        def _wire(p):
+            p._mesh = mesh
+            p._router = _sharded.ShardRouter(n_shards)
+            p.st = _sharded.place_state(mesh, p.st)
+
+        def _place(plane):
+            if isinstance(plane, MapPlane):
+                orig = plane._make_sub
+
+                def sharded_make(tn, _orig=orig):
+                    sub = _orig(tn)
+                    _wire(sub)
+                    return sub
+
+                plane._make_sub = sharded_make
+                for s in plane._all_planes():
+                    _wire(s)
+            elif isinstance(plane, RgaPlane):
+                pass  # per-document dict states: host-side, unsharded
+            else:
+                _wire(plane)
+
+        self.mesh = mesh
+        for plane in self.planes.values():
+            _place(plane)
+
+    def refresh_shard_stats(self) -> None:
+        """Publish the SHARD_* residency families (stats.py): per-shard
+        device-resident key counts across all sharded planes, plus the
+        device-resident percentage the config18 bench gates on
+        (resident keys vs resident + host-evicted)."""
+        if self.mesh is None:
+            return
+        n_shards = int(self.mesh.shape["part"])
+        per_shard = [0] * n_shards
+        resident = 0
+
+        def _count(p):
+            nonlocal resident
+            r = p._router
+            if r is None:
+                return
+            for idx, k in enumerate(p.rev_keys):
+                if k is _Evicted:
+                    continue
+                per_shard[r.shard_of(idx, p.capacity)] += 1
+                resident += 1
+
+        for plane in self.planes.values():
+            if isinstance(plane, MapPlane):
+                for s in plane._all_planes():
+                    _count(s)
+            elif not isinstance(plane, RgaPlane):
+                _count(plane)
+        for s, n in enumerate(per_shard):
+            stats.registry.shard_resident_keys.set(n, shard=str(s))
+        total = resident + len(self.host_only)
+        if total:
+            stats.registry.shard_device_resident_pct.set(
+                100.0 * resident / total)
+
     def set_evict_handler(self, fn: Callable[..., None],
                           export_state: bool = False) -> None:
         """Wire the eviction migration.  ``export_state=True`` marks a
@@ -2555,7 +2737,17 @@ class DevicePlane:
                     p._presence.evict_export = export_state
 
     def accepts(self, type_name: str, key) -> bool:
-        return type_name in self.planes and key not in self.host_only
+        if type_name not in self.planes or key in self.host_only:
+            return False
+        p = self.planes[type_name]
+        r = getattr(p, "_router", None)
+        if r is not None and key not in p.key_index:
+            # per-shard adaptive admission: a NEW key would land at
+            # the next directory index — if that index's owning shard
+            # overflowed since the last fold, route the key host-side
+            # instead of feeding a ring that will evict it right back
+            return r.admits(len(p.rev_keys), p.capacity)
+        return True
 
     def owns(self, type_name: str, key) -> bool:
         p = self.planes.get(type_name)
@@ -2697,6 +2889,7 @@ class DevicePlane:
         with tracer.span("device_gc_all", "device"):
             for p in self.planes.values():
                 p.gc(stable_vc)
+        self.refresh_shard_stats()
 
     def flush(self) -> None:
         with tracer.span("device_flush_all", "device"):

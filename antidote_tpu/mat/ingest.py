@@ -232,15 +232,18 @@ def note_flush(kind: str) -> None:
     tracer.instant("ingest_flush", "device", kind=kind)
 
 
-def note_dispatch(ops: int, h2d_bytes: int) -> None:
+def note_dispatch(ops: int, h2d_bytes: int, replicas: int = 1) -> None:
     """Record one packed device dispatch (``ops`` coalesced rows in
     one ``h2d_bytes`` upload) and refresh the amortization gauge —
     coalesced ops per dispatch over the process lifetime, the panel
-    and bench row the ISSUE's acceptance gates on."""
+    and bench row the ISSUE's acceptance gates on.  ``replicas``: how
+    many chips the upload lands on (the sharded stores replicate the
+    packed batch over the mesh, mat/sharded.py) — the byte counter
+    reports the REAL H2D traffic, not the logical tensor size."""
     reg = stats.registry
     reg.ingest_dispatches.inc()
     reg.ingest_coalesced_ops.inc(ops)
-    reg.ingest_h2d_bytes.inc(h2d_bytes)
+    reg.ingest_h2d_bytes.inc(h2d_bytes * max(int(replicas), 1))
     total = reg.ingest_dispatches.value()
     if total:
         reg.ingest_ops_per_dispatch.set(

@@ -262,7 +262,16 @@ class ReadServer:
 
     def _drain(self, batch: List[_Waiter]) -> None:
         """Group the batch by snapshot compatibility and fold each
-        group once; every waiter is marked done in the finally."""
+        group once; every waiter is marked done in the finally.
+
+        The fold dispatch economy is CROSS-group (ISSUE 20): every
+        group's device captures begin first, then all captures sharing
+        a device — including the mesh handle of a pod-sharded plane —
+        run as ONE ``fused_read`` program, then each group finishes
+        with its own revalidation.  A drain therefore costs O(devices)
+        dispatches, not O(groups x types): on a sharded node every
+        plane reports the SAME mesh, so the whole drain is one
+        multi-chip program (the config18 bench's O(1) gate)."""
         try:
             n_keys = sum(len(w.items) for w in batch)
             # a solo drain is unambiguously that waiter's work: carry
@@ -284,9 +293,7 @@ class ReadServer:
                             w.solo = True
                             w.done = True
                         self._cond.notify_all()
-                for kind, waiters, fold_vc, fr_map in groups:
-                    self._serve_group(kind, waiters, fold_vc, fr_map,
-                                      span_txid)
+                self._serve_groups(groups, span_txid)
                 served = len(batch) - len(solos)
                 if groups:
                     reg = stats.registry
@@ -389,9 +396,96 @@ class ReadServer:
             groups.append(("exact", ws, ws[0].vc, None))
         return groups, solos
 
-    def _serve_group(self, kind, waiters, fold_vc, fr_map,
-                     span_txid=None) -> None:
+    def _serve_groups(self, groups, span_txid=None) -> None:
+        """Fold every drain group and distribute values — with ONE
+        fused dispatch per device across ALL the groups.
+
+        Stage 1 begins every group (``read_many_begin`` captures the
+        device folds, reader counts taken).  Stage 2 buckets every
+        captured fold by its ``.device`` handle — a chip for a pinned
+        plane, the Mesh for a pod-sharded one (jax.sharding.Mesh
+        compares by content, so every sharded plane lands in one
+        bucket) — and runs each bucket as one ``fused_read`` under
+        ``collective_guard`` (multi-chip programs serialize on
+        runtime.COLLECTIVE_LOCK).  Stage 3 finishes each group:
+        ``read_many_finish`` distributes values, runs any non-fused
+        lone folds, and RELEASES the reader counts — it runs exactly
+        once per begun group, whatever stage 2 did.
+
+        The read-dispatch delta over the whole drain feeds
+        ``shard_read_dispatches_per_drain`` — the gauge the config18
+        bench gates at O(1) on a sharded node (vs O(groups x types)
+        unfused).
+
+        Deadlock discipline: a begin that would FLUSH must never run
+        while this thread still holds earlier begins' reader counts
+        (the flush's quiesce wait can only be released by our own
+        not-yet-run finishes).  The wave therefore begins groups with
+        ``nowait=True`` — a group whose begin would flush or block on
+        a prepared txn is DEFERRED to a sequential pass after the wave
+        finishes (zero own readers outstanding), where the blocking
+        begin is safe again."""
         pm = self._pm
+        from antidote_tpu.mat.device_plane import (
+            collective_guard, fused_read, read_dispatch_count)
+
+        d0 = read_dispatch_count()
+        began: List[tuple] = []
+        deferred: List[tuple] = []
+        by_dev: Dict[Any, list] = {}
+        for kind, waiters, fold_vc, fr_map in groups:
+            items = self._group_items(waiters)
+            with tracer.span("read_serve_fold", "device",
+                             txid=span_txid, keys=len(items)):
+                try:
+                    r = pm.read_many_begin(items, fold_vc, span_txid,
+                                           nowait=True)
+                except Exception as e:  # noqa: BLE001 — to waiters
+                    for w in waiters:
+                        w.error = e
+                    continue
+            if r is None:
+                deferred.append((kind, waiters, fold_vc, fr_map))
+                continue
+            out, batches = r
+            gi = len(began)
+            began.append((kind, waiters, fold_vc, fr_map, out,
+                          batches))
+            self._collect_splits(by_dev, gi, batches)
+        got_by = self._fuse(by_dev, collective_guard, fused_read)
+        finished = set()
+        try:
+            for gi, rec in enumerate(began):
+                finished.add(gi)
+                self._finish_group(rec, got_by.get(gi), span_txid)
+        finally:
+            # whatever happened above, every begun group's finish must
+            # run: it releases the reader counts read_many_begin took
+            # (a leak wedges every publish)
+            for gi, rec in enumerate(began):
+                if gi not in finished:
+                    _kind, waiters, fold_vc, _fr, out, batches = rec
+                    try:
+                        pm.read_many_finish(out, batches, fold_vc,
+                                            span_txid)
+                    except Exception as e:  # noqa: BLE001
+                        for w in waiters:
+                            if w.error is None:
+                                w.error = e
+        # sequential pass: the wave's readers are released, so these
+        # groups' begins may flush / wait on prepares safely (the
+        # pre-ISSUE-20 per-group shape, fused within each group)
+        for kind, waiters, fold_vc, fr_map in deferred:
+            self._serve_group_seq(kind, waiters, fold_vc, fr_map,
+                                  span_txid, collective_guard,
+                                  fused_read)
+        delta = read_dispatch_count() - d0
+        reg = stats.registry
+        reg.shard_serve_drains.inc()
+        reg.shard_read_dispatches_per_drain.set(delta)
+
+    @staticmethod
+    def _group_items(waiters) -> list:
         items = []
         seen = set()
         for w in waiters:
@@ -399,8 +493,73 @@ class ReadServer:
                 if pair not in seen:
                     seen.add(pair)
                     items.append(pair)
+        return items
+
+    @staticmethod
+    def _collect_splits(by_dev, gi, batches) -> None:
+        """Bucket a begun group's fused-capable fold captures by their
+        ``.device`` handle (a chip, or the Mesh of a sharded plane)."""
+        for bi, (_t, _pairs, closure) in enumerate(batches):
+            split = getattr(closure, "split", None) \
+                if closure is not None else None
+            if split is not None:
+                by_dev.setdefault(
+                    getattr(closure, "device", None), []).append(
+                        (gi, bi, split))
+
+    @staticmethod
+    def _fuse(by_dev, collective_guard, fused_read):
+        """One ``fused_read`` per device bucket (>=2 captures — a lone
+        fold dispatches itself in finish); returns {gi: {bi: got}}."""
+        got_by: Dict[int, Dict[int, dict]] = {}
+        for dev, entries in by_dev.items():
+            if dev is None or len(entries) < 2:
+                continue
+            try:
+                with tracer.span("read_serve_fused", "device",
+                                 folds=len(entries)), \
+                        collective_guard(dev):
+                    outs = fused_read([s for _gi, _bi, s in entries])
+            except Exception:  # noqa: BLE001 — per-fold fallback
+                log.exception("fused serve read failed; falling "
+                              "back to per-type folds")
+                continue
+            for (gi, bi, _s), got in zip(entries, outs):
+                got_by.setdefault(gi, {})[bi] = got
+        return got_by
+
+    def _serve_group_seq(self, kind, waiters, fold_vc, fr_map,
+                         span_txid, collective_guard,
+                         fused_read) -> None:
+        """Sequential (blocking-begin) serve of one deferred group:
+        begin may flush and wait, the group's own captures still fuse
+        per device, finish runs in a finally."""
+        pm = self._pm
+        items = self._group_items(waiters)
+        with tracer.span("read_serve_fold", "device", txid=span_txid,
+                         keys=len(items)):
+            try:
+                out, batches = pm.read_many_begin(items, fold_vc,
+                                                  span_txid)
+            except Exception as e:  # noqa: BLE001 — fanned to waiters
+                for w in waiters:
+                    w.error = e
+                return
+        by_dev: Dict[Any, list] = {}
+        self._collect_splits(by_dev, 0, batches)
+        got_by = self._fuse(by_dev, collective_guard, fused_read)
+        self._finish_group((kind, waiters, fold_vc, fr_map, out,
+                            batches), got_by.get(0), span_txid)
+
+    def _finish_group(self, rec, got_map, span_txid=None) -> None:
+        """Stage-3 of one group: distribute the (possibly pre-fused)
+        fold results to the group's waiters, with the covered groups'
+        frontier-identity revalidation."""
+        pm = self._pm
+        kind, waiters, fold_vc, fr_map, out, batches = rec
         try:
-            got = _fold_group(pm, items, fold_vc, span_txid=span_txid)
+            got = pm.read_many_finish(out, batches, fold_vc,
+                                      span_txid, got_map)
         except Exception as e:  # noqa: BLE001 — fanned to waiters
             for w in waiters:
                 w.error = e
@@ -428,47 +587,6 @@ class ReadServer:
                 w.values = pm.read_many(w.items, w.vc, txid=w.txid)
             except Exception as e:  # noqa: BLE001 — per-waiter
                 w.error = e
-
-
-def _fold_group(pm, items, fold_vc, txid=None, span_txid=None) -> Dict:
-    """ONE gathered dispatch for a drain group: ``read_many_begin``
-    captures every type's fold, captures sharing a chip run as a
-    single ``fused_read`` program, and ``read_many_finish``
-    distributes the values and releases the reader counts on every
-    path (the read_many_fused discipline, single-partition form)."""
-    from antidote_tpu.mat.device_plane import fused_read
-
-    with tracer.span("read_serve_fold", "device", txid=span_txid,
-                     keys=len(items)):
-        out, batches = pm.read_many_begin(items, fold_vc, txid)
-        got_map: Dict[int, dict] = {}
-        try:
-            by_dev: Dict[Any, list] = {}
-            for bi, (_t, _pairs, closure) in enumerate(batches):
-                split = getattr(closure, "split", None) \
-                    if closure is not None else None
-                if split is not None:
-                    by_dev.setdefault(
-                        getattr(closure, "device", None), []).append(
-                            (bi, split))
-            for dev, entries in by_dev.items():
-                if dev is None or len(entries) < 2:
-                    continue  # a lone fold dispatches itself in finish
-                try:
-                    outs = fused_read([s for _bi, s in entries])
-                except Exception:  # noqa: BLE001 — per-fold fallback
-                    log.exception("fused serve read failed; falling "
-                                  "back to per-type folds")
-                    continue
-                for (bi, _s), got in zip(entries, outs):
-                    got_map[bi] = got
-        except BaseException:
-            # finish must still run: it releases the reader counts
-            # read_many_begin took (a leak wedges every publish)
-            pm.read_many_finish(out, batches, fold_vc, txid)
-            raise
-        return pm.read_many_finish(out, batches, fold_vc, txid,
-                                   got_map)
 
 
 def read_groups(groups, snapshot_vc, txid=None) -> Dict:

@@ -11,26 +11,39 @@ not a gossip of Erlang dicts).
 Design (per "How to Scale Your Model" recipe: pick a mesh, annotate
 shardings, let XLA insert collectives):
 
-- **State**: one global shard state (e.g.
-  :class:`~antidote_tpu.mat.store.OrsetShardState`) whose [K, ...] /
-  [K*L, ...] arrays carry ``PartitionSpec("part")`` — contiguous key
-  ranges per chip, the ring made literal.
-- **Append**: the committed batch is replicated to every chip; each chip
-  masks to its own key range and scatters locally (``shard_map``).  No
-  all-to-all: for B ≪ K the duplicated decode is cheaper than routing,
-  and every chip sees the batch anyway when it rides the replication
-  stream.
+- **Rule table, not per-class field sets.**  :data:`PARTITION_RULES`
+  maps state-field names to partition specs (the t5x/fmengine
+  ``match_partition_rules`` pattern): per-key tables and the key-major
+  op rings carry ``PartitionSpec("part")``, the clock row and base
+  flag replicate.  One table covers EVERY plane type the DevicePlane
+  serves (orset/mvreg/flag, lww, rwset, set_go, counter) — and it is
+  what :func:`place_state` uses to shard a live plane's state in
+  place (DevicePlane.place_sharded).
+- **Arbitrary keyspaces.**  ``n_keys`` pads up to the next mesh
+  multiple; the padded tail keys are sentinel-masked (appends AND the
+  packed ingest path refuse them, reads slice them off), so a 100-key
+  space shards over 8 chips without the caller caring.
+- **Append**: the committed batch is replicated to every chip; each
+  chip masks to its own key range and scatters locally
+  (``shard_map``).  No all-to-all: for B ≪ K the duplicated decode is
+  cheaper than routing, and every chip sees the batch anyway when it
+  rides the replication stream.
 - **GST fold**: each chip reduces its own applied frontier, then
   ``lax.pmin`` over ``part`` merges them — the cross-shard collective
-  VERDICT/SURVEY name as the scaling hard-part — and the fold (GC) runs
-  locally at the collective horizon.
+  VERDICT/SURVEY name as the scaling hard-part — and the fold (GC)
+  runs locally at the collective horizon (:meth:`gc_collective`, or
+  :meth:`gc_at` for the live node's gossiped horizon).
 - **Point reads**: each chip folds its own keys, foreign keys produce
-  zeros, and a ``psum`` assembles the replicated result.
+  zeros, and a ``psum`` assembles the replicated result.  MANY waiter
+  groups batch into ONE mesh program (:meth:`read_keys_groups`): a
+  serve-window drain costs O(1) dispatches, not O(groups) — the
+  ``full_shard_read_ms`` 174-vs-74 fused gap from the hardware
+  self-capture, closed at the serve plane.
 
-The recipe is type-agnostic: :class:`_ShardedBase` owns the mesh
-bookkeeping, state sharding, and the collective GC (every shard state
-exposes the same op_ss/op_dc/op_ct/valid2d/base_vc/has_base surface);
-subclasses contribute only their store's append/read calls.
+Every multi-chip dispatch here runs under ``runtime.COLLECTIVE_LOCK``
+(machine-enforced by tools/concurrency_lint.py's [collective-lock]
+rule) and counts into the device plane's read-dispatch counter, so
+the benches' O(1)-per-drain assertions see one number.
 
 Exercised on the virtual 8-device CPU mesh by
 tests/device/test_sharded_store.py and by the driver's
@@ -40,39 +53,110 @@ tests/device/test_sharded_store.py and by the driver's
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+import re
+import time
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from antidote_tpu import stats
 from antidote_tpu.clocks import dense
 from antidote_tpu.obs import prof
 from antidote_tpu.runtime import COLLECTIVE_LOCK
 from antidote_tpu.mat import ingest, store
 
 
+# ---------------------------------------------------------------------------
+# partition-spec rule table
+#
+# The t5x / fmengine `match_partition_rules` pattern: ordered (regex,
+# PartitionSpec) pairs, first full match wins.  The table replaces the
+# per-class _key_fields frozensets — ONE place answers "how does this
+# state field shard" for every shard-state dataclass in mat/store.py,
+# and the same table shards a live DevicePlane's arrays in place.
+
+PARTITION_RULES: Tuple[Tuple[str, P], ...] = (
+    # per-key element/dot tables and per-key scalars: axis 0 is the
+    # key axis -> contiguous key ranges per chip (the ring literal)
+    (r"dots|adds|rmvs|present|value", P("part")),
+    (r"base_(ts|tie|val)", P("part")),
+    # packed op rings are key-major [K*L, ...]: rows shard WITH their
+    # keys (row = key*L + lane), keeping scatters chip-local
+    (r"ops|valid", P("part")),
+    # the clock row and base flag are tiny and every chip folds with
+    # them -> replicate
+    (r"base_vc|has_base", P()),
+)
+
+
+def match_partition_rules(name: str,
+                          rules: Sequence[Tuple[str, P]] = PARTITION_RULES
+                          ) -> P:
+    """Partition spec for a shard-state field name (first full-regex
+    match wins, like t5x's rule matcher).  Unmatched names are a
+    programming error — a new state field must take a position on
+    sharding, silently replicating it could hide an N-fold memory
+    regression."""
+    for pat, spec in rules:
+        if re.fullmatch(pat, name):
+            return spec
+    raise KeyError(f"no partition rule for state field {name!r}")
+
+
+def state_shardings(mesh: Mesh, st) -> dict:
+    """{field: NamedSharding} for a shard-state dataclass per the rule
+    table.  A key axis that does not divide the mesh falls back to
+    replication for that field (defensive: the DevicePlane's
+    capacities are powers of two and always divide; hand-built states
+    may not — replication is correct, just not distributed)."""
+    n = mesh.shape["part"]
+    out = {}
+    for f in dataclasses.fields(st):
+        if f.name == "n_lanes":
+            continue
+        spec = match_partition_rules(f.name)
+        a = getattr(st, f.name)
+        if spec == P("part") and (getattr(a, "ndim", 0) == 0
+                                  or a.shape[0] % n):
+            spec = P()
+        out[f.name] = NamedSharding(mesh, spec)
+    return out
+
+
+def place_state(mesh: Mesh, st):
+    """Re-place a shard state's arrays per the rule table (idempotent:
+    device_put to an identical sharding is a no-op).  The live plane
+    calls this after every flush/GC/grow so GSPMD output-sharding
+    drift can never accumulate."""
+    data = {name: jax.device_put(getattr(st, name), sh)
+            for name, sh in state_shardings(mesh, st).items()}
+    return type(st)(**data, n_lanes=st.n_lanes)
+
+
 class _ShardedBase:
     """Mesh bookkeeping + sharded state + collective GC, shared by the
-    per-type stores.  ``n_keys`` must divide evenly by the mesh size;
-    keys ``[i*K/n, (i+1)*K/n)`` live on chip i (contiguous ranges keep
-    the ops rows aligned to shard boundaries: row = key*L + lane)."""
+    per-type stores.  ``n_keys`` is padded up to the next mesh
+    multiple; keys ``[i*K/n, (i+1)*K/n)`` live on chip i (contiguous
+    ranges keep the ops rows aligned to shard boundaries:
+    row = key*L + lane).  Padded tail keys (``n_keys_logical`` ≤ k <
+    ``n_keys``) are sentinel-masked: appends refuse them, reads slice
+    them off, and their lanes stay invalid forever so the GC fold
+    ignores them."""
 
     #: the single-device store's GC fold for this state type
     _gc_fn = None
-    #: names of state fields partitioned over the key axis (everything
-    #: else — clock rows, scalars — replicates).  Explicit per class:
-    #: a shape heuristic would misroute e.g. a [D] base_vc whenever
-    #: n_dcs coincides with n_keys.
-    _key_fields: frozenset = frozenset()
-    #: the store's full-shard read (st, rv) -> key-sharded array
+    #: the store's full-shard read (st, rv) -> key-sharded array pytree
     _read_fn = None
-    #: the store's point read (st, key_idx, rv) -> single [B, ...] array
-    #: (tuple-returning reads like lww's need a bespoke override)
+    #: the store's point read (st, key_idx, rv) -> [B, ...] array
+    #: pytree (tuple-returning reads like lww's assemble generically
+    #: via tree_map — no bespoke override needed)
     _read_keys_fn = None
-    #: the store's append; must accept ``active=`` (the this-chip's-keys
-    #: filter: masked-off rows scatter nowhere and report no overflow)
+    #: the store's append; must accept ``active=`` (the this-chip's-
+    #: keys filter: masked-off rows scatter nowhere, no overflow)
     _append_store_fn = None
 
     def __init__(self, mesh: Mesh, n_keys: int, st,
@@ -80,23 +164,49 @@ class _ShardedBase:
         assert "part" in mesh.axis_names
         self.mesh = mesh
         self.n_shards = mesh.shape["part"]
-        assert n_keys % self.n_shards == 0, (
-            f"{n_keys} keys not divisible by {self.n_shards} shards")
-        self.n_keys = n_keys
-        self.keys_per_shard = n_keys // self.n_shards
+        #: caller-visible keyspace; ``n_keys`` below is the padded
+        #: device capacity (next mesh multiple)
+        self.n_keys_logical = n_keys
+        self.n_keys = n_keys + (-n_keys) % self.n_shards
+        self.keys_per_shard = self.n_keys // self.n_shards
         self.key_sh = NamedSharding(mesh, P("part"))
         self.rep = NamedSharding(mesh, P())
         #: coalesced-ingest knobs — built by the SAME factory the
         #: DevicePlane uses (ingest.ingest_from_config), so the mesh
         #: and single-shard assemblies honor identical knobs
         self.ingest = ingest_settings or ingest.ingest_from_config(None)
-        self.st = self._shard_state(st)
+        self.st = self._shard_state(self._pad_state(st))
         self._jits = {}
 
     # ------------------------------------------------------------ specs
 
     def _field_spec(self, name: str):
-        return P("part") if name in self._key_fields else P()
+        return match_partition_rules(name)
+
+    def _pad_state(self, st):
+        """Zero-pad every key-sharded field's leading axis from the
+        logical keyspace to the mesh multiple.  Zeros are the masked
+        sentinel everywhere: padded lanes are ``valid=False`` (never
+        folded), padded base rows never read (reads slice to the
+        logical keyspace first)."""
+        logical, padded = self.n_keys_logical, self.n_keys
+        if padded == logical:
+            return st
+        data = {}
+        for f in dataclasses.fields(st):
+            if f.name == "n_lanes":
+                continue
+            a = getattr(st, f.name)
+            if match_partition_rules(f.name) == P("part"):
+                mult = a.shape[0] // logical  # 1 for [K,...], L for [K*L,...]
+                assert a.shape[0] == logical * mult, (
+                    f"{f.name}: axis 0 = {a.shape[0]} is not a "
+                    f"multiple of n_keys = {logical}")
+                pad = jnp.zeros(((padded - logical) * mult,)
+                                + a.shape[1:], dtype=a.dtype)
+                a = jnp.concatenate([a, pad], axis=0)
+            data[f.name] = a
+        return type(st)(**data, n_lanes=st.n_lanes)
 
     def _shard_state(self, st):
         data = {
@@ -146,6 +256,20 @@ class _ShardedBase:
         local = key_idx - shard.astype(key_idx.dtype) * kps
         return local, (local >= 0) & (local < kps)
 
+    def _active_mask(self, key_idx):
+        """:meth:`_local_mask` plus the padded-tail sentinel: the
+        pack_rows drop sentinel (key == logical capacity) and any
+        padded tail key can land INSIDE the last shard's range, so
+        appends must also refuse keys at/above the logical keyspace
+        — without this, a padding row would scatter a bogus valid op
+        into a tail key and poison the derived GC frontier."""
+        local, mine = self._local_mask(key_idx)
+        return local, mine & (key_idx < self.n_keys_logical)
+
+    def _note_collective(self, t0: float) -> None:
+        stats.registry.shard_collective_seconds.inc(
+            time.perf_counter() - t0)
+
     # ------------------------------------------------------- stable fold
 
     def gc_collective(self, local_frontiers: Optional[jax.Array] = None
@@ -157,7 +281,11 @@ class _ShardedBase:
         has applied — in the live DC this is the dependency gate's
         watermark row per partition).  None derives each shard's
         frontier from its own ring (max applied commit VC), which is
-        exact in the closed single-stream setting.
+        exact in the closed single-stream setting — but note an IDLE
+        shard (no valid ops, no base; any padded tail makes the last
+        shard permanently idle for derived frontiers once its real
+        keys drain) reports frontier 0 and pins the pmin; live
+        callers pass explicit frontiers (:meth:`gc_at`).
 
         The horizon is ``pmin`` over shards — no key can still receive
         an op at-or-below every shard's applied frontier — computed ON
@@ -165,6 +293,7 @@ class _ShardedBase:
         stable_time_functions:min_merge duty (reference
         src/stable_time_functions.erl:39-85)."""
         gc = type(self)._gc_fn
+        t0 = time.perf_counter()
         if local_frontiers is None:
             def local_gc(st):
                 cvc = dense.commit_vc(st.op_ss, st.op_dc, st.op_ct)
@@ -181,6 +310,7 @@ class _ShardedBase:
                           donate=True)
             with COLLECTIVE_LOCK:
                 self.st, gst = fn(self.st)
+            self._note_collective(t0)
             return gst
 
         def local_gc_given(st, fr):
@@ -192,7 +322,17 @@ class _ShardedBase:
                       out_specs=(self._state_spec, P()), donate=True)
         with COLLECTIVE_LOCK:
             self.st, gst = fn(self.st, *self._rep_put(local_frontiers))
+        self._note_collective(t0)
         return gst
+
+    def gc_at(self, frontier) -> jax.Array:
+        """Fold at an EXPLICIT stable horizon (dense int[D] — the live
+        node's gossiped GST): every shard gets the same frontier, so
+        the pmin is the identity and an idle/padded tail shard cannot
+        pin the horizon at 0."""
+        fr = np.tile(np.asarray(frontier, dtype=np.int64).reshape(1, -1),
+                     (self.n_shards, 1))
+        return self.gc_collective(fr)
 
     # ----------------------------------------------------------- append
 
@@ -204,7 +344,7 @@ class _ShardedBase:
         ap = type(self)._append_store_fn
 
         def local_append(st, key_idx, lane_off, *payload):
-            local, mine = base._local_mask(key_idx)
+            local, mine = base._active_mask(key_idx)
             st, overflow = ap(
                 st, jnp.where(mine, local, base.keys_per_shard),
                 lane_off, *payload, active=mine)
@@ -221,8 +361,10 @@ class _ShardedBase:
         # takes this lock") covers it too, or a threaded append racing a
         # locked GC still aborts inside the XLA runtime
         args = self._rep_put(key_idx, lane_off, *payload)
+        t0 = time.perf_counter()
         with COLLECTIVE_LOCK, prof.annotate("sharded_append"):
             self.st, overflow = fn(self.st, *args)
+        self._note_collective(t0)
         return overflow
 
     def append_packed(self, packed, n_ops: Optional[int] = None
@@ -237,7 +379,7 @@ class _ShardedBase:
         def local_append_packed(st, packed):
             key_idx, lane_off, rows = ingest.split_packed(
                 packed, st.ops.dtype)
-            local, mine = base._local_mask(key_idx)
+            local, mine = base._active_mask(key_idx)
             st, overflow = store._scatter_rows(
                 st, jnp.where(mine, local, base.keys_per_shard),
                 lane_off, rows, active=mine)
@@ -248,20 +390,29 @@ class _ShardedBase:
                       out_specs=(self._state_spec, P()), donate=True)
         packed = np.asarray(packed, dtype=np.int64)
         (dev,) = self._rep_put(packed)
+        t0 = time.perf_counter()
         with COLLECTIVE_LOCK, prof.annotate("sharded_append_packed"):
             self.st, overflow = fn(self.st, dev)
+        self._note_collective(t0)
         if n_ops is None:
             # padding rows carry an out-of-range key (the pack_rows
-            # drop sentinel): counting them would inflate the
+            # drop sentinel — and any padded tail key counts as
+            # padding too): counting them would inflate the
             # ops-per-dispatch amortization gauge the benches gate on
-            n_ops = int(np.sum(packed[:, 0] < self.n_keys))
-        ingest.note_dispatch(n_ops, packed.nbytes)
+            n_ops = int(np.sum(packed[:, 0] < self.n_keys_logical))
+        # the upload replicates to every chip: account the real H2D
+        ingest.note_dispatch(n_ops, packed.nbytes,
+                             replicas=self.n_shards)
         return overflow
 
     # ------------------------------------------------------------- reads
 
-    def read(self, read_vc) -> jax.Array:
-        """Full-shard materialization at ``read_vc`` (sharded by key)."""
+    def read(self, read_vc):
+        """Full-shard materialization at ``read_vc`` (sharded by key;
+        a padded keyspace comes back host-side, sliced to the logical
+        keys)."""
+        from antidote_tpu.mat import device_plane as _dp
+
         (rv,) = self._rep_put(read_vc)
         read = type(self)._read_fn
 
@@ -274,30 +425,120 @@ class _ShardedBase:
         # program and must serialize with collective launches (the
         # read itself has no cross-shard reduce, but an interleaved
         # launch against a running pmin/psum still trips the runtime)
+        _dp.count_read_dispatch()
+        t0 = time.perf_counter()
         with COLLECTIVE_LOCK, prof.annotate("sharded_read"):
-            return fn(self.st, rv)
+            out = fn(self.st, rv)
+        self._note_collective(t0)
+        if self.n_keys != self.n_keys_logical:
+            out = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[:self.n_keys_logical], out)
+        return out
 
-    def read_keys(self, key_idx, read_vc) -> jax.Array:
+    def _local_read_keys_body(self):
+        """shard_map body for masked point reads: fold local keys,
+        zero the foreign (and padded) ones — generically over the
+        store's result pytree, so tuple reads (lww's (ts, tie, val),
+        rwset's (adds, rmvs)) assemble without bespoke overrides.
+        Booleans promote to ints under the zero-select exactly like
+        the historical single-array path, so results are
+        bit-compatible."""
+        base = self
+        read_keys = type(self)._read_keys_fn
+
+        def masked(st, key_idx, rv, ok):
+            local, mine = base._local_mask(key_idx)
+            mine = mine & ok
+            out = read_keys(st, jnp.where(mine, local, 0), rv)
+
+            def zero_foreign(o):
+                m = mine.reshape(mine.shape + (1,) * (o.ndim - 1))
+                return jnp.where(m, o, 0)
+
+            return jax.tree_util.tree_map(zero_foreign, out)
+
+        return masked
+
+    def read_keys(self, key_idx, read_vc):
         """Point reads for GLOBAL key indices, replicated to every chip
         (foreign shards contribute zeros; a psum assembles the
         answer — the mask broadcast adapts to the result rank)."""
-        base = self
-        read_keys = type(self)._read_keys_fn
+        from antidote_tpu.mat import device_plane as _dp
+
+        masked = self._local_read_keys_body()
         key_idx, rv = self._rep_put(key_idx, read_vc)
 
         def local_read_keys(st, key_idx, rv):
-            local, mine = base._local_mask(key_idx)
-            out = read_keys(st, jnp.where(mine, local, 0), rv)
-            m = mine.reshape(mine.shape + (1,) * (out.ndim - 1))
-            return jax.lax.psum(jnp.where(m, out, 0), "part")
+            out = masked(st, key_idx, rv,
+                         jnp.ones(key_idx.shape, dtype=bool))
+            return jax.tree_util.tree_map(
+                lambda o: jax.lax.psum(o, "part"), out)
 
         fn = self._sm(local_read_keys,
                       in_specs=(self._state_spec, P(), P()),
                       out_specs=P())
         # the psum assembling the replicated answer is a collective —
         # same serialization rule as append/gc (runtime.py invariant)
+        _dp.count_read_dispatch()
+        t0 = time.perf_counter()
         with COLLECTIVE_LOCK, prof.annotate("sharded_read_keys"):
-            return fn(self.st, key_idx, rv)
+            out = fn(self.st, key_idx, rv)
+        self._note_collective(t0)
+        return out
+
+    def read_keys_groups(self, groups: Sequence[Tuple[Any, Any]]
+                         ) -> List[Any]:
+        """Serve MANY waiter groups' point reads as ONE mesh program:
+        ``groups`` is [(key_idx[B_g], read_vc[D])], the whole drain's
+        worth of snapshot groups; the result list matches order, each
+        entry the group's assembled [B_g, ...] pytree.
+
+        The groups stack into [G, B] keys / [G, D] snapshots / [G, B]
+        validity (ragged groups pad with masked rows), the per-group
+        masked fold vmaps over G, and a single psum assembles every
+        group at once — a drain costs O(1) dispatches instead of
+        O(groups), the serve-plane mirror of the ingest plane's
+        one-upload economy."""
+        from antidote_tpu.mat import device_plane as _dp
+
+        if not groups:
+            return []
+        G = len(groups)
+        B = max(1, max(len(np.atleast_1d(k)) for k, _ in groups))
+        D = len(np.atleast_1d(groups[0][1]))
+        keys = np.zeros((G, B), dtype=np.int64)
+        vcs = np.zeros((G, D), dtype=np.int64)
+        ok = np.zeros((G, B), dtype=bool)
+        for g, (k, rv) in enumerate(groups):
+            k = np.atleast_1d(np.asarray(k))
+            keys[g, :len(k)] = k
+            ok[g, :len(k)] = True
+            vcs[g] = np.asarray(rv)
+        masked = self._local_read_keys_body()
+
+        def local_read_groups(st, keys, vcs, ok):
+            outs = jax.vmap(masked, in_axes=(None, 0, 0, 0))(
+                st, keys, vcs, ok)
+            return jax.tree_util.tree_map(
+                lambda o: jax.lax.psum(o, "part"), outs)
+
+        fn = self._sm(local_read_groups,
+                      in_specs=(self._state_spec, P(), P(), P()),
+                      out_specs=P())
+        args = self._rep_put(keys, vcs, ok)
+        _dp.count_read_dispatch()
+        stats.registry.shard_fused_group_dispatches.inc()
+        t0 = time.perf_counter()
+        with COLLECTIVE_LOCK, prof.annotate("sharded_read_groups"):
+            out = fn(self.st, *args)
+        self._note_collective(t0)
+        out = jax.tree_util.tree_map(np.asarray, out)
+        return [
+            jax.tree_util.tree_map(
+                lambda o, _g=g: o[_g, :len(np.atleast_1d(groups[_g][0]))],
+                out)
+            for g in range(G)
+        ]
 
 
 class ShardedOrsetStore(_ShardedBase):
@@ -307,7 +548,6 @@ class ShardedOrsetStore(_ShardedBase):
     _read_fn = staticmethod(store.orset_read)
     _read_keys_fn = staticmethod(store.orset_read_keys)
     _append_store_fn = staticmethod(store.orset_append)
-    _key_fields = frozenset({"dots", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
                  n_slots: int, n_dcs: int, dtype=jnp.int64,
@@ -316,6 +556,79 @@ class ShardedOrsetStore(_ShardedBase):
         # columns carry epoch-µs timestamps, which silently truncate in
         # int32 (callers that bench int32 pass it explicitly)
         super().__init__(mesh, n_keys, store.orset_shard_init(
+            n_keys, n_lanes, n_slots, n_dcs, dtype=dtype),
+            ingest_settings=ingest_settings)
+
+
+class ShardedMvregStore(_ShardedBase):
+    """Multi-value register over the mesh ring — shares the orset
+    shard state (dot tables ARE the winner set) with the mvreg
+    fold/read calls; flag_ew rides the same store (a flag is an mvreg
+    of booleans at the plane layer)."""
+
+    _gc_fn = staticmethod(store.mvreg_gc)
+    _read_fn = staticmethod(store.mvreg_read)
+    _read_keys_fn = staticmethod(store.mvreg_read_keys)
+    _append_store_fn = staticmethod(store.orset_append)
+
+    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
+                 n_slots: int, n_dcs: int, dtype=jnp.int64,
+                 ingest_settings=None):
+        super().__init__(mesh, n_keys, store.orset_shard_init(
+            n_keys, n_lanes, n_slots, n_dcs, dtype=dtype),
+            ingest_settings=ingest_settings)
+
+
+class ShardedLwwStore(_ShardedBase):
+    """Last-writer-wins register shard over the mesh; the tuple read
+    ((ts, tie, val) per key) assembles generically through the
+    tree_map'd psum."""
+
+    _gc_fn = staticmethod(store.lww_gc)
+    _read_fn = staticmethod(store.lww_read)
+    _read_keys_fn = staticmethod(store.lww_read_keys)
+    _append_store_fn = staticmethod(store.lww_append)
+
+    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
+                 n_dcs: int, dtype=jnp.int64, ingest_settings=None):
+        super().__init__(mesh, n_keys, store.lww_shard_init(
+            n_keys, n_lanes, n_dcs, dtype=dtype),
+            ingest_settings=ingest_settings)
+
+
+class ShardedRwsetStore(_ShardedBase):
+    """Remove-wins set shard over the mesh (adds/rmvs dot tables both
+    key-sharded by the rule table; the (adds, rmvs) tuple read
+    assembles like lww's)."""
+
+    _gc_fn = staticmethod(store.rwset_gc)
+    _read_fn = staticmethod(store.rwset_read)
+    _read_keys_fn = staticmethod(store.rwset_read_keys)
+    _append_store_fn = staticmethod(store.rwset_append)
+
+    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
+                 n_slots: int, n_dcs: int, dtype=jnp.int64,
+                 ingest_settings=None):
+        super().__init__(mesh, n_keys, store.rwset_shard_init(
+            n_keys, n_lanes, n_slots, n_dcs, dtype=dtype),
+            ingest_settings=ingest_settings)
+
+
+class ShardedSetGoStore(_ShardedBase):
+    """Grow-only set shard over the mesh (presence bitmap key-sharded;
+    full-shard reads go through store.setgo_read, added with this
+    module so every plane type the DevicePlane serves has the same
+    read surface)."""
+
+    _gc_fn = staticmethod(store.setgo_gc)
+    _read_fn = staticmethod(store.setgo_read)
+    _read_keys_fn = staticmethod(store.setgo_read_keys)
+    _append_store_fn = staticmethod(store.setgo_append)
+
+    def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
+                 n_slots: int, n_dcs: int, dtype=jnp.int64,
+                 ingest_settings=None):
+        super().__init__(mesh, n_keys, store.setgo_shard_init(
             n_keys, n_lanes, n_slots, n_dcs, dtype=dtype),
             ingest_settings=ingest_settings)
 
@@ -329,7 +642,6 @@ class ShardedCounterStore(_ShardedBase):
     _read_fn = staticmethod(store.counter_read)
     _read_keys_fn = staticmethod(store.counter_read_keys)
     _append_store_fn = staticmethod(store.counter_append)
-    _key_fields = frozenset({"value", "ops", "valid"})
 
     def __init__(self, mesh: Mesh, n_keys: int, n_lanes: int,
                  n_dcs: int, dtype=jnp.int64, ingest_settings=None):
@@ -338,3 +650,102 @@ class ShardedCounterStore(_ShardedBase):
             ingest_settings=ingest_settings)
 
 
+#: plane type -> sharded store class, the same keyspace the
+#: DevicePlane serves (flag_ew shares mvreg's state and fold; flag_dw
+#: is an rwset of one element at the plane layer; counter_pn is the
+#: counter shard).  Maps and RGA stay host-composed: their device
+#: residency is per-field sub-planes, which shard individually.
+SHARDED_STORES = {
+    "set_aw": ShardedOrsetStore,
+    "register_mv": ShardedMvregStore,
+    "flag_ew": ShardedMvregStore,
+    "flag_dw": ShardedRwsetStore,
+    "register_lww": ShardedLwwStore,
+    "set_rw": ShardedRwsetStore,
+    "set_go": ShardedSetGoStore,
+    "counter_pn": ShardedCounterStore,
+}
+
+
+# ---------------------------------------------------------------------------
+# factory + routing
+
+
+@dataclass(frozen=True)
+class ShardSettings:
+    """Resolved pod-sharding knobs — built from Config by
+    :func:`sharded_from_config` (the single factory, the
+    gate_from_config / ingest_from_config lesson)."""
+
+    #: mesh to shard the live DevicePlane over; None = single-chip
+    #: legacy path (bit-for-bit the bench baseline)
+    mesh: Optional[Mesh] = None
+    axis: str = "part"
+
+    @property
+    def enabled(self) -> bool:
+        return self.mesh is not None
+
+
+def sharded_from_config(config) -> ShardSettings:
+    """Resolve ``Config.mat_sharded`` (auto / True / False) to the
+    node's shard mesh.  ``auto`` activates only with >1 device on a
+    REAL accelerator backend: the virtual 8-device CPU mesh the tier-1
+    suite runs under is a test rig, not a pod — auto-flipping there
+    would silently re-route every existing test off the single-chip
+    baseline.  ``True`` forces sharding wherever >1 device exists
+    (how the CPU-mesh tests and benches opt in)."""
+    knob = "auto" if config is None else getattr(config, "mat_sharded",
+                                                 "auto")
+    if knob is False:
+        return ShardSettings()
+    devs = jax.devices()
+    if len(devs) < 2:
+        return ShardSettings()
+    if knob == "auto" and devs[0].platform == "cpu":
+        return ShardSettings()
+    return ShardSettings(mesh=Mesh(np.array(devs), ("part",)))
+
+
+class ShardRouter:
+    """Per-shard residency economy — the PR-3 host/device picker run
+    per chip instead of per process.  Each shard's own overflow record
+    decides whether NEW keys in its key range earn device residency:
+    an eviction marks the owning shard saturated (new keys route
+    host-side) until the next GC fold frees lanes and resets the
+    economy.  Evictions migrate only the owning shard's keys — the
+    other chips' residents are untouched."""
+
+    def __init__(self, n_shards: int):
+        self.n_shards = n_shards
+        #: overflow evictions since the last fold, per shard (the
+        #: saturation signal)
+        self._overflow = [0] * n_shards
+        #: lifetime evictions per shard (stats)
+        self.evictions = [0] * n_shards
+
+    def shard_of(self, idx: int, capacity: int) -> int:
+        """Owning shard of key index ``idx`` under a contiguous
+        P("part") layout of ``capacity`` keys."""
+        kps = max(1, capacity // self.n_shards)
+        return min(idx // kps, self.n_shards - 1)
+
+    def note_evict(self, idx: int, capacity: int) -> None:
+        s = self.shard_of(idx, capacity)
+        self._overflow[s] += 1
+        self.evictions[s] += 1
+        stats.registry.shard_evictions.inc(shard=str(s))
+
+    def note_fold(self) -> None:
+        """A GC fold freed ring lanes everywhere: every shard's
+        economy resets and saturated shards may earn residency
+        again."""
+        self._overflow = [0] * self.n_shards
+
+    def admits(self, idx: int, capacity: int) -> bool:
+        """May a NEW key at directory slot ``idx`` take device
+        residency?  False while its owning shard is saturated
+        (overflowed since the last fold) — the key serves host-side
+        instead, exactly the per-process picker's economy at per-shard
+        grain."""
+        return self._overflow[self.shard_of(idx, capacity)] == 0

@@ -1093,6 +1093,23 @@ def setgo_gc(st: SetGoShardState, gst: jax.Array) -> SetGoShardState:
 
 @kernel_span("mat.store")
 @jax.jit
+def setgo_read(st: SetGoShardState, read_vc: jax.Array) -> jax.Array:
+    """bool[K, E]: grow-only element presence for every key at
+    ``read_vc`` in one batched materialization (base bitmap + included
+    ring ops) — the full-shard form of :func:`setgo_read_keys`, added
+    so every plane type the DevicePlane serves has the same read
+    surface (the sharded stores' ``_read_fn`` slot)."""
+    K = st.present.shape[0]
+    base_vc = jnp.broadcast_to(st.base_vc, (K, st.base_vc.shape[0]))
+    has_base = jnp.broadcast_to(st.has_base, (K,))
+    mask = kernels.inclusion_mask(
+        st.op_dc, st.op_ct, st.op_ss, st.valid2d, base_vc, has_base,
+        read_vc)
+    return kernels.setgo_apply(st.present, st.elem_slot, mask)
+
+
+@kernel_span("mat.store")
+@jax.jit
 def setgo_read_keys(st: SetGoShardState, key_idx: jax.Array,
                     read_vc: jax.Array) -> jax.Array:
     """bool[B, E]: element presence for the requested keys."""

@@ -894,6 +894,48 @@ class Registry:
             "last evaluation, 0 when it breached",
             labels=("objective",))
 
+        # ---- pod-scale sharded materializer (ISSUE 20,
+        # mat/sharded.py + mat/device_plane.py place_sharded): the
+        # mesh-sharded live keyspace's residency economy and the
+        # fused cross-chip serve plane
+        self.shard_resident_keys = LabeledGauge(
+            "antidote_shard_resident_keys",
+            "Device-resident keys per mesh shard (contiguous key "
+            "ranges under the P('part') layout) — refreshed on every "
+            "device GC sweep",
+            labels=("shard",))
+        self.shard_evictions = Counter(
+            "antidote_shard_evictions_total",
+            "Keys evicted to the host path per owning shard (only "
+            "the owning shard's range migrates; the per-shard "
+            "routing economy's saturation signal)",
+            labels=("shard",))
+        self.shard_fused_group_dispatches = Counter(
+            "antidote_shard_fused_group_dispatches_total",
+            "Cross-chip fused group-read programs launched (one per "
+            "serve-window drain on the sharded path — the O(groups) "
+            "-> O(1) dispatch economy)")
+        self.shard_serve_drains = Counter(
+            "antidote_shard_serve_drains_total",
+            "Serve-window drains that went through the cross-group "
+            "fused dispatch accounting (the dispatches-per-drain "
+            "denominator)")
+        self.shard_read_dispatches_per_drain = Gauge(
+            "antidote_shard_read_dispatches_per_drain",
+            "Device read programs dispatched by the most recent "
+            "serve-window drain (fused cross-group reads hold this "
+            "at O(1); the unfused path pays one per group)")
+        self.shard_collective_seconds = Counter(
+            "antidote_shard_collective_seconds_total",
+            "Wall seconds inside mesh-collective dispatches "
+            "(append/GC/read programs under COLLECTIVE_LOCK, lock "
+            "wait included — the cross-chip serialization cost)")
+        self.shard_device_resident_pct = Gauge(
+            "antidote_shard_device_resident_pct",
+            "Percent of ever-seen keys currently device-resident "
+            "across all shards (100 * resident / (resident + "
+            "host_only)) — the per-shard routing economy's headline")
+
     def metrics(self):
         return (self.error_count, self.staleness, self.open_transactions,
                 self.aborted_transactions, self.operations,
@@ -963,7 +1005,13 @@ class Registry:
                 self.fleet_scrape_age, self.fleet_sources,
                 self.fleet_scrape_errors,
                 self.slo_burn_rate, self.slo_budget_remaining,
-                self.slo_ok)
+                self.slo_ok,
+                self.shard_resident_keys, self.shard_evictions,
+                self.shard_fused_group_dispatches,
+                self.shard_serve_drains,
+                self.shard_read_dispatches_per_drain,
+                self.shard_collective_seconds,
+                self.shard_device_resident_pct)
 
     def exposition(self) -> str:
         lines = []

@@ -1033,12 +1033,21 @@ class PartitionManager:
         return self.read_many_finish(out, dev_batches, snapshot_vc,
                                      txid)
 
-    def read_many_begin(self, items, snapshot_vc, txid=None):
+    def read_many_begin(self, items, snapshot_vc, txid=None,
+                        nowait=False):
         """First half of :meth:`read_many`: gate, split, flush, and
         capture the device folds (reader counts INCREMENTED — the
         caller MUST run read_many_finish exactly once, whatever
         happens).  Split out so a multi-partition caller can fuse the
-        captured folds across partitions per chip (read_many_fused)."""
+        captured folds across partitions per chip (read_many_fused).
+
+        ``nowait=True`` returns None instead of blocking or flushing:
+        no prepared-txn wait, no device flush.  The cross-GROUP fused
+        drain (mat/serve.py) begins several groups before finishing
+        any, so its later begins hold earlier begins' reader counts —
+        a flush's quiesce wait here would deadlock on the caller's OWN
+        readers.  A None defers the group to a sequential pass after
+        the fused wave releases its readers."""
         if snapshot_vc is not None:
             self.clock.wait_until(snapshot_vc.get_dc(self.dc_id))
         out: Dict[Tuple[Any, str], Any] = {}
@@ -1046,6 +1055,10 @@ class PartitionManager:
         with self._lock:
             self._read_check()
             if snapshot_vc is not None:
+                if nowait and any(
+                        self._blocking_prepared(k, snapshot_vc, txid)
+                        for k, _t in items):
+                    return None
                 deadline = time.monotonic() + self.read_wait_timeout
                 while any(self._blocking_prepared(k, snapshot_vc, txid)
                           for k, _t in items):
@@ -1089,6 +1102,8 @@ class PartitionManager:
                 plane = self.device.planes[type_name]
                 if not plane.pending_keys.isdisjoint(
                         [k for k, _fr, _ex in pairs]):
+                    if nowait:
+                        return None  # no closures yet — nothing leaks
                     self._wait_device_quiesce()
                     plane.flush()
             for type_name, pairs in by_type.items():
@@ -1499,7 +1514,8 @@ def read_many_fused(groups, snapshot_vc, txid=None
     path: each partition's read_many_begin increments its counts, and
     read_many_finish (which always runs, fused result or not) releases
     them."""
-    from antidote_tpu.mat.device_plane import fused_read
+    from antidote_tpu.mat.device_plane import (collective_guard,
+                                               fused_read)
 
     begun = []  # (pm, out, dev_batches)
     try:
@@ -1535,7 +1551,12 @@ def read_many_fused(groups, snapshot_vc, txid=None
             if len(entries) < 2 or dev is None:
                 continue  # a lone fold dispatches itself in finish
             try:
-                outs = fused_read([s for _gi, _bi, s in entries])
+                # ``dev`` is the Mesh handle when the partitions are
+                # pod-sharded (every sharded plane reports the same
+                # mesh, so the whole read is ONE multi-chip program)
+                # — which must serialize on COLLECTIVE_LOCK
+                with collective_guard(dev):
+                    outs = fused_read([s for _gi, _bi, s in entries])
             except Exception:  # noqa: BLE001 — per-fold fallback
                 log.exception("fused cross-partition read failed; "
                               "falling back to per-partition folds")

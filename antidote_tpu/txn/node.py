@@ -866,9 +866,21 @@ class Node:
         plane = None
         if self.config.device_store:
             from antidote_tpu.mat.device_plane import DevicePlane
+            from antidote_tpu.mat.sharded import sharded_from_config
 
             plane = DevicePlane(config=self.config)
-            if self.config.device_placement == "ring":
+            shard = sharded_from_config(self.config)
+            if shard.enabled:
+                # pod-scale materializer (ISSUE 20): the live keyspace
+                # shards ACROSS the mesh's chips — every partition's
+                # plane states split on the key axis per the named
+                # partition rules, with per-shard adaptive residency.
+                # Mutually exclusive with ring placement (a plane is
+                # sharded over all chips or pinned to one, never both);
+                # the one factory resolves the knob, so mat_sharded=
+                # False routes the legacy path bit-for-bit.
+                plane.place_sharded(shard.mesh)
+            elif self.config.device_placement == "ring":
                 import jax
 
                 devs = jax.devices()

@@ -39,7 +39,8 @@ CONFIGS = ("benches.config1_counter", "bench", "benches.config3_mvreg",
            "benches.config10_log", "benches.config11_ckpt",
            "benches.config12_fabric", "benches.config13_ckptseg",
            "benches.config14_nativeobs", "benches.config15_fleet",
-           "benches.config16_interest", "benches.config17_reshard")
+           "benches.config16_interest", "benches.config17_reshard",
+           "benches.config18_podshard")
 
 _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

@@ -23,3 +23,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: opt-in long-running reproduction loops (flake rehit "
+        "recipes, soak tests) — excluded from tier-1 via -m 'not "
+        "slow'")

@@ -157,3 +157,93 @@ def test_sharded_counter_matches_single_device():
     want_k = np.asarray(store.counter_read_keys(ref, keys, frontier))
     got_k = np.asarray(sh.read_keys(keys, frontier))
     assert (want_k == got_k).all()
+
+
+def test_odd_keyspace_pads_to_mesh_multiple():
+    """K=100 on 8 chips is not divisible: the key axis pads to 104 and
+    the 4 tail keys are sentinel-masked (appends refuse them, reads
+    slice them off) — every logical key, including K-1 on the padded
+    tail shard, is bit-identical to the unpadded single-device store."""
+    mesh = make_mesh(8)
+    K, B, D, n_dcs = 100, 96, 8, 3
+    sh = sharded.ShardedOrsetStore(mesh, K, n_lanes=4, n_slots=8,
+                                   n_dcs=D, dtype=jnp.int32)
+    assert sh.n_keys_logical == 100
+    assert sh.n_keys == 104 and sh.keys_per_shard == 13
+    ref = store.orset_shard_init(K, n_lanes=4, n_slots=8, n_dcs=D,
+                                 dtype=jnp.int32)
+    frontier = None
+    for i, s in enumerate(stream(K, B, 4, D, n_dcs, seed=11)):
+        args = tuple(jnp.asarray(s[f]) for f in FIELDS)
+        ov = sh.append(*args)
+        ref, ov_ref = store.orset_append(ref, *args)
+        assert (np.asarray(ov) == np.asarray(ov_ref)).all()
+        if i == 1:
+            # EXPLICIT horizon (the live node's gossiped GST): the
+            # fold must not let the idle padded tail pin the pmin at 0
+            gst = sh.gc_at(jnp.asarray(s["frontier"]))
+            assert (np.asarray(gst) == np.asarray(s["frontier"])).all()
+            ref = store.orset_gc(ref, gst.astype(ref.base_vc.dtype))
+        frontier = jnp.asarray(s["frontier"])
+    want = np.asarray(store.orset_read(ref, frontier))
+    got = np.asarray(sh.read(frontier))
+    assert got.shape[0] == K  # padded tail sliced off
+    assert (want == got).all()
+    # point reads across the REAL shard boundaries (13 keys/shard) and
+    # at the last logical key, which lives on the padded tail shard
+    keys = jnp.asarray(np.array([0, 12, 13, 50, 90, K - 1],
+                                dtype=np.int32))
+    want_k = np.asarray(store.orset_read_keys(ref, keys, frontier))
+    got_k = np.asarray(sh.read_keys(keys, frontier))
+    assert (want_k == got_k).all()
+
+
+def test_read_keys_groups_one_dispatch_matches_per_group():
+    """A whole drain's worth of waiter groups — ragged sizes, distinct
+    snapshot VCs — served by read_keys_groups costs exactly ONE mesh
+    dispatch and returns per-group results bit-identical to serving
+    each group through read_keys."""
+    from antidote_tpu.mat import device_plane as dp
+
+    mesh = make_mesh(8)
+    K, B, D, n_dcs = 128, 96, 8, 3
+    sh = sharded.ShardedOrsetStore(mesh, K, n_lanes=4, n_slots=8,
+                                   n_dcs=D, dtype=jnp.int32)
+    batches = stream(K, B, 3, D, n_dcs, seed=5)
+    for s in batches:
+        sh.append(*(jnp.asarray(s[f]) for f in FIELDS))
+    fr = np.asarray(batches[-1]["frontier"])
+    groups = [
+        (np.array([0, 17, 63], dtype=np.int32), fr),
+        (np.array([K - 1], dtype=np.int32), fr // 2),
+        (np.array([5, 5, 120, 33, 64], dtype=np.int32), fr),
+    ]
+    want = [np.asarray(sh.read_keys(jnp.asarray(k), jnp.asarray(v)))
+            for k, v in groups]
+    before = dp.read_dispatch_count()
+    got = sh.read_keys_groups(groups)
+    assert dp.read_dispatch_count() - before == 1
+    assert len(got) == len(groups)
+    for w, g in zip(want, got):
+        g = np.asarray(g)
+        assert g.shape == w.shape
+        assert (w == g).all()
+
+
+def test_sharded_from_config_knob_routing(monkeypatch):
+    """The ONE factory resolves mat_sharded: False is always the
+    legacy single-chip path, auto refuses the CPU test rig (the
+    virtual mesh is a rig, not a pod), True takes every device when
+    there are >=2 and degrades to legacy on a single device."""
+    from antidote_tpu.config import Config
+    from antidote_tpu.mat.sharded import sharded_from_config
+
+    assert not sharded_from_config(Config(mat_sharded=False)).enabled
+    assert not sharded_from_config(Config()).enabled  # auto, CPU rig
+    assert not sharded_from_config(None).enabled
+    st = sharded_from_config(Config(mat_sharded=True))
+    assert st.enabled
+    assert int(st.mesh.shape["part"]) == len(jax.devices())
+    real = jax.devices()
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: real[:1])
+    assert not sharded_from_config(Config(mat_sharded=True)).enabled

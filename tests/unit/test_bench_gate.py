@@ -287,9 +287,46 @@ def test_cli_exits_nonzero_on_config_failure(tmp_path, monkeypatch):
     # checkpoint seeds + suffix), the donor-kill refetch fraction
     # must not rise (cursor no longer resuming at its ack watermark)
     ("ms/moved key", -1), ("refetch pct", -1),
+    # pod-scale sharded materializer (ISSUE 20): a serve drain's
+    # device dispatch count must not rise (regression back to one
+    # fold per snapshot group x type instead of the cross-group
+    # fuse) — note the exact entry: the "/drain" suffix is
+    # higher-better for ISSUE 16's events/drain.  The device-resident
+    # share rides the existing "resident pct" up direction.
+    ("dispatches/drain", -1), ("events/drain", 1),
 ])
 def test_direction_table(unit, expect):
     assert bench_gate.direction(unit) == expect
+
+
+def test_gate_fails_on_podshard_plane_regression(tmp_path, capsys):
+    """ISSUE 20 synthetic two-round trajectory: round 2's serve drain
+    costs 8 device dispatches again (the cross-group fuse lost — one
+    fold per snapshot group x type) and the device-resident share
+    collapses (the per-shard router evicting globally again) — both
+    directions must fail."""
+    old = {"schema_version": 1, "round": 1, "dry_run": False,
+           "metrics": {
+               "shard_read_dispatches_per_drain": {
+                   "value": 0.5, "unit": "dispatches/drain"},
+               "shard_device_resident_pct": {
+                   "value": 93.75, "unit": "resident pct"}},
+           "failures": {}}
+    new = {"schema_version": 1, "round": 2, "dry_run": False,
+           "metrics": {
+               "shard_read_dispatches_per_drain": {
+                   "value": 8.0, "unit": "dispatches/drain"},
+               "shard_device_resident_pct": {
+                   "value": 41.0, "unit": "resident pct"}},
+           "failures": {}}
+    op, np_ = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    op.write_text(json.dumps(old))
+    np_.write_text(json.dumps(new))
+    rc = bench_gate.main([str(op), str(np_)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "shard_read_dispatches_per_drain" in err
+    assert "shard_device_resident_pct" in err
 
 
 def test_gate_fails_on_reshard_plane_regression(tmp_path, capsys):

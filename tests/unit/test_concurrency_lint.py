@@ -469,13 +469,85 @@ def test_fabric_endpoints_are_factory_routed(tmp_path):
     assert "TcpTransport" in problems[0]
 
 
+# -------------------------------- rule: collective launch discipline
+
+def test_collective_launch_outside_lock_fires(tmp_path):
+    """A name bound from ``self._sm(...)`` is a multi-chip launcher;
+    calling it with no collective region held is the runtime.py
+    invariant violated (interleaved ICI programs abort in XLA)."""
+    _write(tmp_path, "antidote_tpu/newshard.py",
+           "class S:\n"
+           "    def fold(self):\n"
+           "        fn = self._sm(self.body, in_specs=(), "
+           "out_specs=())\n"
+           "        return fn(self.st)\n")
+    problems = _lint(tmp_path, "collective-lock")
+    assert len(problems) == 1
+    assert "newshard.py:4" in problems[0]
+    assert "fn()" in problems[0]
+
+
+def test_collective_launch_under_any_region_form_passes(tmp_path):
+    """All three blessed region spellings cover a launch: the lock
+    itself, the device_plane guard helper, and the per-plane context
+    manager — including as one item of a multi-item with."""
+    _write(tmp_path, "antidote_tpu/newshard.py",
+           "from antidote_tpu.runtime import COLLECTIVE_LOCK\n"
+           "class S:\n"
+           "    def a(self):\n"
+           "        fn = self._sm(self.body, in_specs=(), "
+           "out_specs=())\n"
+           "        with COLLECTIVE_LOCK, prof.annotate('x'):\n"
+           "            return fn(self.st)\n"
+           "    def b(self, dev):\n"
+           "        fn = self._sm(self.body, in_specs=(), "
+           "out_specs=())\n"
+           "        with collective_guard(dev):\n"
+           "            return fn(self.st)\n"
+           "    def c(self):\n"
+           "        fn = jax.jit(shard_map_compat(self.body, "
+           "mesh=self.mesh, in_specs=(), out_specs=()))\n"
+           "        with self._collective_cm():\n"
+           "            return fn(self.st)\n")
+    assert _lint(tmp_path, "collective-lock") == []
+
+
+def test_shard_map_body_collectives_are_exempt(tmp_path):
+    """The ``lax.pmin`` inside the shard_map BODY is not a launch —
+    the body runs under the launcher's lock at call time.  Only the
+    launcher call itself is held to the rule."""
+    _write(tmp_path, "antidote_tpu/newshard.py",
+           "import jax\n"
+           "class S:\n"
+           "    def fold(self):\n"
+           "        def body(st):\n"
+           "            return jax.lax.pmin(st, 'part')\n"
+           "        fn = self._sm(body, in_specs=(), out_specs=())\n"
+           "        with COLLECTIVE_LOCK:\n"
+           "            return fn(self.st)\n")
+    assert _lint(tmp_path, "collective-lock") == []
+
+
+def test_collective_launch_lock_ok_audits(tmp_path):
+    """A reasoned ``# lock-ok`` on the launch line is the audited
+    escape hatch, same trail as [lock-blocking]."""
+    _write(tmp_path, "antidote_tpu/newshard.py",
+           "class S:\n"
+           "    def fold(self):\n"
+           "        fn = self._sm(self.body, in_specs=(), "
+           "out_specs=())\n"
+           "        return fn(self.st)  # lock-ok: single-thread "
+           "bootstrap, no concurrent collectives yet\n")
+    assert _lint(tmp_path, "collective-lock") == []
+
+
 def test_all_fixture_rules_are_tagged():
     """Every fixture above keys off a [tag] the module actually
     emits — guard the tag names against drift."""
     src = open(concurrency_lint.__file__).read()
     for tag in ("lock-blocking", "lock-ok-reason", "lock-order",
                 "knob-routing", "knob-unknown", "knob-dead",
-                "gil-policy"):
+                "gil-policy", "collective-lock"):
         assert f"[{tag}]" in src
 
 

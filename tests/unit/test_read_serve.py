@@ -137,7 +137,7 @@ class TestCoalescedEquivalence:
         published = []
         orig_begin = pm.read_many_begin
 
-        def begin_with_publish(items, vc, txid=None):
+        def begin_with_publish(items, vc, txid=None, **kw):
             if not published:
                 published.append(True)
                 with pm._lock:
@@ -146,7 +146,7 @@ class TestCoalescedEquivalence:
                         commit_dc="dc2", commit_time=5000,
                         snapshot_vc=VC({"dc2": 5000}),
                         txid=("dc2", "r1"), certified=True), None)
-            return orig_begin(items, vc, txid)
+            return orig_begin(items, vc, txid, **kw)
 
         pm.read_many_begin = begin_with_publish
         try:
@@ -258,7 +258,7 @@ class TestCoalescedEquivalence:
         db.update_objects_static(None, [(("k", CK), "increment", 1)])
         orig = pm.read_many_begin
 
-        def boom(items, vc, txid=None):
+        def boom(items, vc, txid=None, **kw):
             raise RuntimeError("fold exploded")
 
         pm.read_many_begin = boom

@@ -124,7 +124,14 @@ _LOWER_BETTER = {"s", "ms", "us", "µs", "ns", "seconds", "sec",
                  # resuming at its ack watermark — "refetch pct" must
                  # be exact, plain "pct" would not match the two-word
                  # unit and the metric would silently go ungated
-                 "ms/moved key", "refetch pct"}
+                 "ms/moved key", "refetch pct",
+                 # pod-scale sharded materializer (ISSUE 20): device
+                 # read dispatches per serve-window drain rising means
+                 # the cross-group fused read regressed toward one
+                 # mesh program per group — must be an exact entry
+                 # because the "/drain" suffix is higher-better
+                 # (events/drain, ISSUE 16)
+                 "dispatches/drain"}
 
 
 def repo_root() -> str:

@@ -66,6 +66,20 @@ attribute name — ``self.nl_wait_probe = quick.nl_wait`` is the
 deliberate zero-timeout GIL-held probe binding, a distinct entry
 point with its own policy.
 
+**collective launch discipline** [collective-lock]: runtime.py's
+``COLLECTIVE_LOCK`` invariant — every multi-chip program launch must
+hold the lock, or two threads' collectives interleave their ICI
+programs and abort inside the XLA runtime — machine-enforced (ISSUE
+20).  A name bound from a collective *builder* (``self._sm(...)``,
+``shard_map_compat(...)``, possibly wrapped in ``jax.jit``/profiler
+wrappers) is a launcher; calling it anywhere outside a ``with``
+region whose items include ``COLLECTIVE_LOCK`` (either spelling),
+``collective_guard(...)`` or ``_collective_cm()`` is a finding.  The
+``lax.pmin/pmax/psum`` calls INSIDE a shard_map body are exempt by
+construction — nested defs run at launch time, under the launcher's
+lock, not at definition time.  ``# lock-ok: <reason>`` audits the
+exceptions, same trail as [lock-blocking].
+
 **knob routing + coverage** [knob-*]: direct construction of a
 config-routed plane class (``_FACTORY_ROUTED``) anywhere in the
 package outside its blessed factory module is an error — the
@@ -196,6 +210,17 @@ _BLOCKING_OWNED = {
 
 #: Condition/Event wait verbs (exempt when waiting on the held lock)
 _WAIT_NAMES = {"wait", "wait_for"}
+
+#: collective-program builders: a name assigned from a call reaching
+#: one of these is a multi-chip launcher and must only be CALLED under
+#: a collective region ([collective-lock], runtime.py's invariant)
+_COLLECTIVE_BUILDERS = {"_sm", "shard_map_compat"}
+
+#: with-item terminal names that satisfy the collective-launch
+#: discipline: the lock itself (either import spelling), the
+#: device_plane guard helper, and the per-plane context manager
+_COLLECTIVE_REGIONS = {"COLLECTIVE_LOCK", "_COLLECTIVE_LOCK",
+                       "collective_guard", "_collective_cm"}
 
 #: native fabric entry points that BLOCK (condition waits, socket
 #: bind/teardown, mutex contention against event threads): must bind
@@ -781,6 +806,70 @@ class _Analyzer:
                     break  # one witness cycle is actionable enough
         return problems
 
+    # ------------------------------------ rule: collective launch lock
+
+    def lint_collective_lock(self) -> List[str]:
+        """Calls of names bound from a collective builder
+        (``self._sm(...)`` / ``shard_map_compat(...)``, possibly
+        wrapped in ``jax.jit``/profiler wrap calls) must sit inside a
+        ``with`` region whose items include COLLECTIVE_LOCK,
+        ``collective_guard(...)`` or ``_collective_cm()`` — two
+        threads' interleaved multi-chip programs abort inside the XLA
+        runtime, so runtime.py makes the lock the law and this rule
+        makes the law checkable.  Nested defs and lambdas (the
+        shard_map BODIES, where ``lax.pmin/pmax/psum`` live) are
+        skipped: they execute at launch time under the launcher's
+        region, not at definition time."""
+        problems: List[str] = []
+
+        def region_item(ctx: ast.expr) -> bool:
+            f = ctx.func if isinstance(ctx, ast.Call) else ctx
+            return _terminal(f) in _COLLECTIVE_REGIONS
+
+        for fn in self.funcs:
+            info = self.files[fn.rel]
+            launchers: Set[str] = set()
+
+            def scan(node, covered: bool):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                        continue
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        scan(child, covered or any(
+                            region_item(i.context_expr)
+                            for i in child.items))
+                        continue
+                    if isinstance(child, ast.Assign) and any(
+                            isinstance(n, ast.Call)
+                            and _terminal(n.func)
+                            in _COLLECTIVE_BUILDERS
+                            for n in ast.walk(child.value)):
+                        for t in child.targets:
+                            name = _terminal(t)
+                            if name:
+                                launchers.add(name)
+                    if isinstance(child, ast.Call):
+                        name = _terminal(child.func)
+                        if name in launchers and not covered \
+                                and not self._suppressed(
+                                    info, child.lineno):
+                            problems.append(
+                                f"{fn.rel}:{child.lineno}: "
+                                f"[collective-lock] multi-chip "
+                                f"program {name}() launched outside "
+                                f"a COLLECTIVE_LOCK region "
+                                f"({fn.qual}) — wrap the launch in "
+                                "`with COLLECTIVE_LOCK:` / "
+                                "`collective_guard(dev)` / "
+                                "`self._collective_cm()` or audit "
+                                "with `# lock-ok: <reason>`")
+                    scan(child, covered)
+
+            scan(fn.node, False)
+        return problems
+
     # ----------------------------------------- rule: GIL binding policy
 
     def lint_gil_bindings(self) -> List[str]:
@@ -988,6 +1077,7 @@ def lint(root: str) -> List[str]:
     problems.extend(an.lint_blocking())
     problems.extend(an.lint_lock_ok_reasons())
     problems.extend(an.lint_lock_order())
+    problems.extend(an.lint_collective_lock())
     problems.extend(an.lint_gil_bindings())
     problems.extend(an.lint_knobs())
     return problems
